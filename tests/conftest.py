@@ -1,0 +1,29 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+SURVEY §4 "distributed-without-a-cluster": tests must see multiple devices so
+sharded programs can be asserted equal to single-device ones, without TPU
+hardware.
+
+Two layers of forcing are required because the environment's sitecustomize
+registers a TPU PJRT plugin in every interpreter and *overrides*
+``jax_platforms`` via ``jax.config`` at startup — a plain ``JAX_PLATFORMS``
+env var is not enough. We (a) set ``XLA_FLAGS`` before any backend is
+initialized (backends init lazily, so conftest import time is early enough),
+and (b) write ``jax_platforms='cpu'`` back through ``jax.config``, which wins
+over the sitecustomize because it runs later. Tests must never claim the real
+TPU: it is a single-tenant tunnel and a concurrently-held grant wedges every
+other process on the machine.
+"""
+
+import os
+
+os.environ.setdefault("JAX_NUM_CPU_DEVICES", "8")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,60 @@
+"""CG solver vs ``np.linalg.solve`` on SPD systems (SURVEY §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trpo_tpu.ops import conjugate_gradient
+
+
+def spd_matrix(rng, n, cond=10.0):
+    q, _ = np.linalg.qr(rng.normal(size=(n, n)))
+    eigs = np.linspace(1.0, cond, n)
+    return (q * eigs) @ q.T
+
+
+def test_cg_solves_spd_system():
+    rng = np.random.default_rng(0)
+    a = spd_matrix(rng, 12)
+    b = rng.normal(size=12)
+    res = conjugate_gradient(
+        lambda v: jnp.asarray(a, jnp.float32) @ v,
+        jnp.asarray(b, jnp.float32),
+        cg_iters=12,
+        residual_tol=1e-12,
+    )
+    want = np.linalg.solve(a, b)
+    np.testing.assert_allclose(np.asarray(res.x), want, rtol=1e-3, atol=1e-3)
+
+
+def test_cg_early_exit_on_small_residual():
+    # b is an eigenvector → exact solve in 1 iteration; loop must stop early.
+    a = jnp.eye(8) * 4.0
+    b = jnp.ones(8)
+    res = conjugate_gradient(lambda v: a @ v, b, cg_iters=10, residual_tol=1e-10)
+    assert int(res.iterations) <= 2
+    np.testing.assert_allclose(np.asarray(res.x), np.ones(8) / 4.0, rtol=1e-5)
+
+
+def test_cg_iteration_cap_matches_reference_default():
+    # Default budget is 10 iterations (ref utils.py:185); on a hard system it
+    # must stop at the cap.
+    rng = np.random.default_rng(1)
+    a = spd_matrix(rng, 64, cond=1e4)
+    b = rng.normal(size=64)
+    res = conjugate_gradient(
+        lambda v: jnp.asarray(a, jnp.float32) @ v, jnp.asarray(b, jnp.float32)
+    )
+    assert int(res.iterations) == 10
+
+
+def test_cg_is_jittable():
+    a = jnp.eye(6) * 2.0
+
+    @jax.jit
+    def solve(b):
+        return conjugate_gradient(lambda v: a @ v, b).x
+
+    np.testing.assert_allclose(
+        np.asarray(solve(jnp.ones(6))), np.full(6, 0.5), rtol=1e-6
+    )

@@ -1,0 +1,81 @@
+"""Categorical / Gaussian log-prob, KL, entropy vs SciPy (SURVEY §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.stats
+
+from trpo_tpu.distributions import Categorical, DiagGaussian
+
+
+def test_categorical_logp_matches_softmax():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(5, 4)).astype(np.float32)
+    actions = np.array([0, 3, 1, 2, 2])
+    got = np.asarray(Categorical.logp({"logits": jnp.asarray(logits)}, jnp.asarray(actions)))
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    want = np.log(probs[np.arange(5), actions])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_categorical_kl_entropy_vs_scipy():
+    rng = np.random.default_rng(1)
+    lo = rng.normal(size=(6, 5)).astype(np.float32)
+    ln = rng.normal(size=(6, 5)).astype(np.float32)
+    po = np.exp(lo) / np.exp(lo).sum(-1, keepdims=True)
+    pn = np.exp(ln) / np.exp(ln).sum(-1, keepdims=True)
+    kl = np.asarray(Categorical.kl({"logits": jnp.asarray(lo)}, {"logits": jnp.asarray(ln)}))
+    ent = np.asarray(Categorical.entropy({"logits": jnp.asarray(lo)}))
+    want_kl = np.array([scipy.stats.entropy(po[i], pn[i]) for i in range(6)])
+    want_ent = np.array([scipy.stats.entropy(po[i]) for i in range(6)])
+    np.testing.assert_allclose(kl, want_kl, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(ent, want_ent, rtol=1e-3, atol=1e-4)
+
+
+def test_categorical_kl_self_zero_and_sampling_frequencies():
+    logits = jnp.asarray([[2.0, 0.0, -1.0]])
+    p = {"logits": logits}
+    assert abs(float(Categorical.kl(p, p)[0])) < 1e-7
+    key = jax.random.key(0)
+    samples = Categorical.sample(key, {"logits": jnp.tile(logits, (20000, 1))})
+    freq = np.bincount(np.asarray(samples), minlength=3) / 20000
+    want = np.exp([2.0, 0.0, -1.0]) / np.exp([2.0, 0.0, -1.0]).sum()
+    np.testing.assert_allclose(freq, want, atol=0.02)
+    assert int(Categorical.mode(p)[0]) == 0
+
+
+def test_gaussian_logp_vs_scipy():
+    rng = np.random.default_rng(2)
+    mean = rng.normal(size=(7, 3)).astype(np.float32)
+    log_std = rng.normal(size=(7, 3)).astype(np.float32) * 0.3
+    x = rng.normal(size=(7, 3)).astype(np.float32)
+    got = np.asarray(
+        DiagGaussian.logp(
+            {"mean": jnp.asarray(mean), "log_std": jnp.asarray(log_std)},
+            jnp.asarray(x),
+        )
+    )
+    want = scipy.stats.norm.logpdf(x, mean, np.exp(log_std)).sum(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_gaussian_kl_entropy_closed_form():
+    p_old = {"mean": jnp.asarray([[0.0, 0.0]]), "log_std": jnp.asarray([[0.0, 0.0]])}
+    p_new = {"mean": jnp.asarray([[1.0, 0.0]]), "log_std": jnp.asarray([[0.0, np.log(2.0)]])}
+    # KL(N(0,1)‖N(1,1)) = 0.5; KL(N(0,1)‖N(0,4)) = log2 + 1/8 - 1/2
+    want = 0.5 + (np.log(2.0) + 1.0 / 8.0 - 0.5)
+    assert abs(float(DiagGaussian.kl(p_old, p_new)[0]) - want) < 1e-5
+    assert abs(float(DiagGaussian.kl(p_old, p_old)[0])) < 1e-7
+    want_ent = 2 * scipy.stats.norm.entropy(0.0, 1.0)
+    assert abs(float(DiagGaussian.entropy(p_old)[0]) - want_ent) < 1e-5
+
+
+def test_gaussian_sample_moments():
+    key = jax.random.key(3)
+    p = {
+        "mean": jnp.full((50000, 2), jnp.asarray([1.0, -2.0])),
+        "log_std": jnp.full((50000, 2), jnp.asarray([0.0, np.log(0.5)])),
+    }
+    s = np.asarray(DiagGaussian.sample(key, p))
+    np.testing.assert_allclose(s.mean(0), [1.0, -2.0], atol=0.02)
+    np.testing.assert_allclose(s.std(0), [1.0, 0.5], atol=0.02)

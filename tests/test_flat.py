@@ -1,0 +1,44 @@
+"""Flatten/unflatten round trips and flat gradients (SURVEY §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trpo_tpu.models import init_mlp, apply_mlp
+from trpo_tpu.ops import flatten_params, flat_grad, numel, var_shapes
+
+
+def test_roundtrip_identity():
+    params = init_mlp(jax.random.key(0), 4, (8, 8), 2)
+    flat, unravel = flatten_params(params)
+    assert flat.ndim == 1
+    assert flat.shape[0] == numel(params)
+    rebuilt = unravel(flat)
+    for a, b in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(rebuilt)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_set_from_flat_semantics():
+    # Writing a new flat vector reproduces the reference's SetFromFlat
+    # (utils.py:125-149): every leaf gets its slice, shapes preserved.
+    params = init_mlp(jax.random.key(1), 3, (5,), 2)
+    flat, unravel = flatten_params(params)
+    new_flat = jnp.arange(flat.shape[0], dtype=jnp.float32)
+    new_params = unravel(new_flat)
+    assert var_shapes(new_params) == var_shapes(params)
+    reflat, _ = flatten_params(new_params)
+    np.testing.assert_array_equal(np.asarray(reflat), np.asarray(new_flat))
+
+
+def test_flat_grad_matches_manual():
+    params = init_mlp(jax.random.key(2), 3, (4,), 1)
+    x = jnp.ones((7, 3))
+
+    def loss(p):
+        return jnp.mean(apply_mlp(p, x) ** 2)
+
+    g = flat_grad(loss, params)
+    flat, unravel = flatten_params(params)
+    g2 = jax.grad(lambda f: loss(unravel(f)))(flat)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g2), rtol=1e-5, atol=1e-6)
+    assert g.shape == flat.shape

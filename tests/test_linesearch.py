@@ -1,0 +1,99 @@
+"""Line-search acceptance logic vs the reference's (``utils.py:170-182``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trpo_tpu.ops import backtracking_linesearch
+
+
+def reference_linesearch(f, x, fullstep, expected_improve_rate):
+    # Faithful NumPy re-statement of ref utils.py:170-182 for oracle checks.
+    max_backtracks, accept_ratio = 10, 0.1
+    fval = f(x)
+    for stepfrac in 0.5 ** np.arange(max_backtracks):
+        xnew = x + stepfrac * fullstep
+        newfval = f(xnew)
+        actual_improve = fval - newfval
+        expected_improve = expected_improve_rate * stepfrac
+        ratio = actual_improve / expected_improve
+        if ratio > accept_ratio and actual_improve > 0:
+            return xnew, True, stepfrac
+    return x, False, 0.0
+
+
+def quadratic(center):
+    def f(x):
+        return jnp.sum((x - center) ** 2)
+    return f
+
+
+def test_accepts_full_step_on_clean_descent():
+    f = quadratic(jnp.asarray([1.0, 1.0]))
+    x = jnp.zeros(2)
+    fullstep = jnp.asarray([1.0, 1.0])  # exact step to the minimum
+    eir = jnp.asarray(2.0)
+    res = backtracking_linesearch(f, x, fullstep, eir)
+    assert bool(res.success)
+    assert float(res.step_fraction) == 1.0
+    np.testing.assert_allclose(np.asarray(res.x), [1.0, 1.0], rtol=1e-6)
+
+
+def test_backtracks_on_overshoot():
+    f = quadratic(jnp.asarray([1.0]))
+    x = jnp.zeros(1)
+    fullstep = jnp.asarray([8.0])  # 8x overshoot: needs several halvings
+    eir = jnp.asarray(16.0)
+    res = backtracking_linesearch(f, x, fullstep, eir)
+    want_x, want_ok, want_frac = reference_linesearch(
+        lambda v: float(f(jnp.asarray(v))), np.zeros(1), np.array([8.0]), 16.0
+    )
+    assert bool(res.success) == want_ok
+    assert abs(float(res.step_fraction) - want_frac) < 1e-7
+    np.testing.assert_allclose(np.asarray(res.x), want_x, rtol=1e-6)
+
+
+def test_returns_original_params_on_failure():
+    # Ascent direction: nothing improves; must return x unchanged
+    # (ref utils.py:182).
+    f = quadratic(jnp.asarray([0.0]))
+    x = jnp.asarray([1.0])
+    fullstep = jnp.asarray([5.0])
+    res = backtracking_linesearch(f, x, fullstep, jnp.asarray(1.0))
+    assert not bool(res.success)
+    np.testing.assert_allclose(np.asarray(res.x), [1.0])
+    assert float(res.step_fraction) == 0.0
+
+
+def test_randomized_agreement_with_reference_logic():
+    rng = np.random.default_rng(0)
+    for trial in range(20):
+        dim = 3
+        center = rng.normal(size=dim)
+        x0 = rng.normal(size=dim)
+        fullstep = rng.normal(size=dim) * rng.uniform(0.1, 4.0)
+        eir = float(rng.uniform(0.01, 5.0))
+        f_np = lambda v: float(np.sum((v - center) ** 2))
+        f_jax = quadratic(jnp.asarray(center, jnp.float32))
+        want_x, want_ok, want_frac = reference_linesearch(
+            f_np, x0.copy(), fullstep, eir
+        )
+        res = backtracking_linesearch(
+            f_jax,
+            jnp.asarray(x0, jnp.float32),
+            jnp.asarray(fullstep, jnp.float32),
+            jnp.asarray(eir, jnp.float32),
+        )
+        assert bool(res.success) == want_ok, trial
+        assert abs(float(res.step_fraction) - want_frac) < 1e-6, trial
+        np.testing.assert_allclose(np.asarray(res.x), want_x, rtol=1e-4, atol=1e-5)
+
+
+def test_jittable():
+    f = quadratic(jnp.asarray([2.0]))
+
+    @jax.jit
+    def run(x):
+        return backtracking_linesearch(f, x, jnp.asarray([2.0]), jnp.asarray(4.0)).x
+
+    np.testing.assert_allclose(np.asarray(run(jnp.zeros(1))), [2.0], rtol=1e-6)

@@ -1,0 +1,119 @@
+"""Returns/GAE scans vs closed forms and the reference's SciPy filter
+semantics (``/root/reference/utils.py:14-16``)."""
+
+import jax.numpy as jnp
+import numpy as np
+import scipy.signal
+
+from trpo_tpu.ops import discount, discounted_returns_segmented, gae_advantages
+
+
+def ref_discount(x, gamma):
+    # The reference's exact implementation (utils.py:14-16).
+    return scipy.signal.lfilter([1], [1, -gamma], x[::-1], axis=0)[::-1]
+
+
+def test_discount_matches_reference_filter():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=37).astype(np.float32)
+    got = np.asarray(discount(jnp.asarray(x), 0.95))
+    want = ref_discount(x, 0.95)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_discount_batched():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(50, 4)).astype(np.float32)
+    got = np.asarray(discount(jnp.asarray(x), 0.9))
+    want = ref_discount(x, 0.9)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_discount_closed_form_constant_reward():
+    # y_t for constant reward 1: (1 - γ^(T-t)) / (1 - γ)
+    T, gamma = 20, 0.5
+    y = np.asarray(discount(jnp.ones(T), gamma))
+    t = np.arange(T)
+    want = (1 - gamma ** (T - t)) / (1 - gamma)
+    np.testing.assert_allclose(y, want, rtol=1e-6)
+
+
+def test_segmented_returns_respect_episode_boundaries():
+    rewards = jnp.asarray([1.0, 1.0, 1.0, 2.0, 2.0], jnp.float32)
+    dones = jnp.asarray([0.0, 0.0, 1.0, 0.0, 1.0])
+    y = np.asarray(discounted_returns_segmented(rewards, dones, 0.5))
+    # Episode 1: [1 + .5 + .25, 1 + .5, 1]; episode 2: [2 + 1, 2]
+    np.testing.assert_allclose(y, [1.75, 1.5, 1.0, 3.0, 2.0], rtol=1e-6)
+
+
+def test_segmented_matches_per_episode_reference_filter():
+    rng = np.random.default_rng(2)
+    lens = [7, 12, 5]
+    rewards = rng.normal(size=sum(lens)).astype(np.float32)
+    dones = np.zeros(sum(lens), np.float32)
+    for end in np.cumsum(lens):
+        dones[end - 1] = 1.0
+    got = np.asarray(
+        discounted_returns_segmented(jnp.asarray(rewards), jnp.asarray(dones), 0.95)
+    )
+    pieces, start = [], 0
+    for ln in lens:
+        pieces.append(ref_discount(rewards[start : start + ln], 0.95))
+        start += ln
+    np.testing.assert_allclose(got, np.concatenate(pieces), rtol=1e-4, atol=1e-5)
+
+
+def test_gae_lambda1_zero_baseline_is_plain_returns():
+    # With λ=1 and V≡0, advantages must equal discounted returns — the
+    # reference's advantage definition (trpo_inksci.py:104-105).
+    rng = np.random.default_rng(3)
+    T, N = 30, 4
+    rewards = rng.normal(size=(T, N)).astype(np.float32)
+    dones = np.zeros((T, N), np.float32)
+    dones[-1] = 1.0
+    values = np.zeros((T, N), np.float32)
+    adv, targets = gae_advantages(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(dones),
+        jnp.zeros(N), gamma=0.95, lam=1.0,
+    )
+    want = ref_discount(rewards, 0.95)
+    np.testing.assert_allclose(np.asarray(adv), want, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(targets), want, rtol=1e-4, atol=1e-5)
+
+
+def test_gae_truncation_bootstraps_last_value():
+    # A non-terminal final step must bootstrap from last_values — the fix for
+    # the reference rollout bug (utils.py:44).
+    rewards = jnp.asarray([[1.0], [1.0]])
+    values = jnp.asarray([[0.0], [0.0]])
+    dones = jnp.zeros((2, 1))
+    last_values = jnp.asarray([10.0])
+    adv, _ = gae_advantages(rewards, values, dones, last_values, 0.5, 1.0)
+    # A_1 = 1 + .5·10 = 6; A_0 = 1 + .5·6 = 4
+    np.testing.assert_allclose(np.asarray(adv)[:, 0], [4.0, 6.0], rtol=1e-6)
+
+
+def test_gae_against_naive_python_loop():
+    rng = np.random.default_rng(4)
+    T, N = 25, 3
+    rewards = rng.normal(size=(T, N)).astype(np.float32)
+    values = rng.normal(size=(T, N)).astype(np.float32)
+    dones = (rng.uniform(size=(T, N)) < 0.15).astype(np.float32)
+    last_values = rng.normal(size=N).astype(np.float32)
+    gamma, lam = 0.97, 0.9
+
+    adv = np.zeros((T, N), np.float64)
+    next_adv = np.zeros(N)
+    next_val = last_values.astype(np.float64)
+    for t in reversed(range(T)):
+        nonterm = 1.0 - dones[t]
+        delta = rewards[t] + gamma * nonterm * next_val - values[t]
+        next_adv = delta + gamma * lam * nonterm * next_adv
+        adv[t] = next_adv
+        next_val = values[t]
+
+    got, _ = gae_advantages(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(dones),
+        jnp.asarray(last_values), gamma, lam,
+    )
+    np.testing.assert_allclose(np.asarray(got), adv, rtol=1e-4, atol=1e-5)

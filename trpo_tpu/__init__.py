@@ -1,0 +1,45 @@
+"""trpo_tpu — a TPU-native Trust Region Policy Optimization framework.
+
+A ground-up JAX/XLA re-design of the capability set of the reference
+implementation (inksci/TRPO: ``trpo_inksci.py`` + ``utils.py``): TRPO with a
+natural-gradient step solved by conjugate gradient over Fisher-vector
+products, a backtracking line search, a value-function baseline, and
+environment rollouts — but engineered TPU-first:
+
+* the entire policy update (gradient -> CG -> step scaling -> line search ->
+  KL rollback) is **one jit-compiled device program** (`trpo_tpu.trpo`),
+  where the reference ran a host NumPy loop with one ``sess.run`` round trip
+  per CG iteration (reference ``utils.py:185-201``);
+* Fisher-vector products use forward-over-reverse ``jvp(grad(kl))`` instead
+  of the reference's double reverse-mode backprop (``trpo_inksci.py:56-70``);
+* rollouts run on-device via ``lax.scan`` over batched pure-JAX environments
+  (`trpo_tpu.envs`), replacing the per-step ``sess.run`` dispatch of the
+  reference (``utils.py:18-45``);
+* data parallelism is expressed with `jax.sharding` over a device Mesh, and
+  XLA emits the ICI collectives (`trpo_tpu.parallel`) — there is no NCCL/MPI
+  analogue to port because computation is single-program SPMD.
+
+Package map
+-----------
+- ``trpo_tpu.config``         — dataclass config + presets (ref: module globals)
+- ``trpo_tpu.distributions``  — categorical + diagonal-Gaussian policy heads
+- ``trpo_tpu.models``         — MLP / conv policy + value networks
+- ``trpo_tpu.ops``            — flat-param utils, returns/GAE scans, CG,
+                                line search, Fisher-vector products
+- ``trpo_tpu.trpo``           — the fused TRPO update step
+- ``trpo_tpu.vf``             — value-function baseline (critic)
+- ``trpo_tpu.envs``           — pure-JAX envs (CartPole, Pendulum, ...) +
+                                gymnasium adapter + FakeEnv
+- ``trpo_tpu.rollout``        — on-device scan rollouts / host rollouts
+- ``trpo_tpu.agent``          — ``TRPOAgent`` (init / act / learn), the
+                                reference's top-level API
+- ``trpo_tpu.parallel``       — mesh construction, sharded update, multihost
+- ``trpo_tpu.train``          — training loop + CLI
+- ``trpo_tpu.compat``         — the reference ``utils.py`` helper surface
+                                re-expressed over JAX (discount, linesearch,
+                                conjugate_gradient, cat_sample, ...)
+"""
+
+__version__ = "0.1.0"
+
+from trpo_tpu.config import TRPOConfig  # noqa: F401

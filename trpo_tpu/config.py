@@ -1,0 +1,133 @@
+"""Configuration for trpo_tpu.
+
+The reference keeps five hyperparameters in a module-level dict
+(``trpo_inksci.py:15-17``) with net widths, critic epochs, seed and env name
+hard-coded elsewhere (``trpo_inksci.py:39,179``, ``utils.py:7,59-61,84``).
+Here every knob is an explicit dataclass field, and the benchmark ladder from
+``BASELINE.json`` is expressed as named presets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class TRPOConfig:
+    # --- environment -----------------------------------------------------
+    env: str = "cartpole"          # preset env name (see trpo_tpu.envs.make)
+    n_envs: int = 8                # vectorized envs (BASELINE.json: "8 vectorized envs")
+    max_pathlength: int = 1000     # ref config["max_steps"] (trpo_inksci.py:17)
+    batch_timesteps: int = 1000    # ref config["episodes_per_roll"] — a timestep
+    #                                budget despite its name (SURVEY §2.1)
+
+    # --- discounting / advantages ---------------------------------------
+    gamma: float = 0.95            # ref config["gamma"]
+    lam: float = 1.0               # GAE(λ). λ=1 ≡ plain `returns − baseline`,
+    #                                the reference's advantage (trpo_inksci.py:104-105)
+    standardize_advantages: bool = True  # ref trpo_inksci.py:115-117
+
+    # --- trust region solve ----------------------------------------------
+    max_kl: float = 0.01           # ref config["max_kl"]
+    cg_iters: int = 10             # ref utils.py:185 default
+    cg_damping: float = 0.1        # ref config["cg_damping"]
+    cg_residual_tol: float = 1e-10  # ref utils.py:185
+    linesearch_backtracks: int = 10  # ref utils.py:171 (0.5**k, k<10)
+    linesearch_accept_ratio: float = 0.1  # ref utils.py:170
+    kl_rollback_factor: float = 2.0  # revert params if KL > factor·max_kl
+    #                                  (ref trpo_inksci.py:157-158)
+
+    # --- networks --------------------------------------------------------
+    policy_hidden: Tuple[int, ...] = (64,)   # ref: one 64-tanh layer (trpo_inksci.py:39)
+    policy_activation: str = "tanh"
+    vf_hidden: Tuple[int, ...] = (64, 64)    # ref critic: 64-relu × 2 (utils.py:59-61)
+    vf_activation: str = "relu"
+    vf_train_steps: int = 50       # ref: 50 full-batch Adam steps (utils.py:84)
+    vf_learning_rate: float = 1e-3  # TF 1.3 AdamOptimizer default
+    init_log_std: float = 0.0      # diagonal-Gaussian head (not in reference —
+    #                                required by BASELINE.json MuJoCo configs)
+    compute_dtype: str = "float32"  # forward dtype; the CG solve always runs fp32
+
+    # --- run control -----------------------------------------------------
+    seed: int = 1                  # ref utils.py:7 (was an import side effect)
+    n_iterations: int = 1000
+    reward_target: Optional[float] = None  # generalizes the ref's hard-coded
+    #                                        `mean reward > 1.1*500` stop
+    #                                        (trpo_inksci.py:135)
+    stop_on_explained_variance: Optional[float] = None  # ref's `exp > 0.8`
+    #                                        stop made opt-in (trpo_inksci.py:174-175)
+    debug_nans: bool = False       # debug-mode NaN checks; the ref had only an
+    #                                entropy!=entropy abort (trpo_inksci.py:172-173)
+
+    # --- parallelism -----------------------------------------------------
+    mesh_shape: Optional[Tuple[int, ...]] = None  # None → (n_local_devices,)
+    mesh_axes: Tuple[str, ...] = ("data",)
+    # model axis is only used when mesh_shape has 2 entries, e.g. (4, 2) with
+    # axes ("data", "model") shards wide policy layers over "model".
+
+    # --- io --------------------------------------------------------------
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 10
+    log_jsonl: Optional[str] = None
+
+    def replace(self, **kw) -> "TRPOConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Presets — the BASELINE.json config ladder.
+# ---------------------------------------------------------------------------
+
+PRESETS = {
+    # "CartPole-v0, 2-layer MLP discrete softmax policy (default run)"
+    "cartpole": TRPOConfig(env="cartpole"),
+    # "Pendulum-v0 continuous control (diagonal-Gaussian policy, CG-iters=10)"
+    "pendulum": TRPOConfig(
+        env="pendulum",
+        gamma=0.99,
+        lam=0.95,
+        batch_timesteps=4000,
+        max_pathlength=200,
+        n_envs=16,
+        policy_hidden=(64, 64),
+    ),
+    # "HalfCheetah-v2 MuJoCo (Gaussian MLP, batch 5k, damping=0.1)"
+    "halfcheetah": TRPOConfig(
+        env="gym:HalfCheetah-v4",
+        gamma=0.99,
+        lam=0.97,
+        batch_timesteps=5000,
+        max_pathlength=1000,
+        n_envs=8,
+        policy_hidden=(64, 64),
+        cg_damping=0.1,
+    ),
+    # "Humanoid-v2 MuJoCo (376-dim obs, batch 50k — large FVP matvec)"
+    "humanoid": TRPOConfig(
+        env="gym:Humanoid-v4",
+        gamma=0.99,
+        lam=0.97,
+        batch_timesteps=50_000,
+        max_pathlength=1000,
+        n_envs=64,
+        policy_hidden=(256, 256),
+        cg_damping=0.1,
+    ),
+    # "Atari Pong pixel conv policy (high-param FVP, 8 vectorized envs)"
+    "pong": TRPOConfig(
+        env="gym:ALE/Pong-v5",
+        gamma=0.99,
+        lam=0.95,
+        batch_timesteps=8000,
+        max_pathlength=10_000,
+        n_envs=8,
+        policy_hidden=(512,),   # dense head on top of the conv torso
+    ),
+}
+
+
+def get_preset(name: str) -> TRPOConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    return PRESETS[name]

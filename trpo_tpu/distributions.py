@@ -1,0 +1,124 @@
+"""Policy action distributions: categorical and diagonal Gaussian.
+
+The reference supports only discrete (categorical softmax) policies
+(``trpo_inksci.py:26,38-40``), computing probabilities explicitly and adding
+an ``eps=1e-6`` *inside* each log (``trpo_inksci.py:50-53``) to dodge
+``log(0)``. Per SURVEY §7 ("replicate the math, not the hack") we instead work
+in log space throughout (``log_softmax``), which is exact and numerically
+stable, and we add the diagonal-Gaussian head required by the MuJoCo configs
+in ``BASELINE.json`` (absent from the reference).
+
+Distribution parameters are plain pytrees so they flow through ``jit`` /
+``vmap`` / sharding untouched:
+
+* Categorical: ``{"logits": (..., K)}``
+* DiagGaussian: ``{"mean": (..., D), "log_std": (..., D)}``
+
+All ops are batched over leading axes and return per-sample values (no
+implicit mean-reduction — reduction placement is the caller's business, which
+matters for sharded ``psum`` placement in the TRPO step).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Categorical", "DiagGaussian", "make_distribution"]
+
+# Python float, NOT a jnp op: module import must never initialize a JAX
+# backend (the TPU tunnel is single-tenant; see tests/conftest.py).
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+class Categorical:
+    """Categorical distribution over ``K`` actions, parameterized by logits."""
+
+    name = "categorical"
+
+    @staticmethod
+    def logp(params, actions):
+        """Log π(a|s). ``actions``: integer array (...,). Ref: the
+        ``slice_2d`` prob gather at ``trpo_inksci.py:44-46``, in log space."""
+        logits = jax.nn.log_softmax(params["logits"], axis=-1)
+        return jnp.take_along_axis(
+            logits, actions[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+
+    @staticmethod
+    def kl(params_old, params_new):
+        """KL(old ‖ new) per sample. Ref math at ``trpo_inksci.py:50-51``."""
+        lp_old = jax.nn.log_softmax(params_old["logits"], axis=-1)
+        lp_new = jax.nn.log_softmax(params_new["logits"], axis=-1)
+        return jnp.sum(jnp.exp(lp_old) * (lp_old - lp_new), axis=-1)
+
+    @staticmethod
+    def entropy(params):
+        """Per-sample entropy. Ref math at ``trpo_inksci.py:52-53``."""
+        lp = jax.nn.log_softmax(params["logits"], axis=-1)
+        return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+
+    @staticmethod
+    def sample(key, params):
+        """Batched categorical sampling; replaces the O(N·K) Python-loop
+        inverse-CDF sampler of the reference (``utils.py:95-105``)."""
+        return jax.random.categorical(key, params["logits"], axis=-1)
+
+    @staticmethod
+    def mode(params):
+        """Greedy action — the reference's eval-mode argmax
+        (``trpo_inksci.py:83``)."""
+        return jnp.argmax(params["logits"], axis=-1)
+
+
+class DiagGaussian:
+    """Diagonal Gaussian over continuous actions (mean + per-dim log std).
+
+    Not present in the reference (it rejects ``Box`` action spaces by
+    construction, ``trpo_inksci.py:26``); required by the Pendulum /
+    HalfCheetah / Humanoid rungs of the BASELINE.json ladder.
+    """
+
+    name = "diag_gaussian"
+
+    @staticmethod
+    def logp(params, actions):
+        mean, log_std = params["mean"], params["log_std"]
+        z = (actions - mean) / jnp.exp(log_std)
+        return -0.5 * jnp.sum(z * z + 2.0 * log_std + _LOG_2PI, axis=-1)
+
+    @staticmethod
+    def kl(params_old, params_new):
+        mo, lso = params_old["mean"], params_old["log_std"]
+        mn, lsn = params_new["mean"], params_new["log_std"]
+        var_o, var_n = jnp.exp(2.0 * lso), jnp.exp(2.0 * lsn)
+        return jnp.sum(
+            lsn - lso + (var_o + (mo - mn) ** 2) / (2.0 * var_n) - 0.5, axis=-1
+        )
+
+    @staticmethod
+    def entropy(params):
+        log_std = params["log_std"]
+        return jnp.sum(log_std + 0.5 * (_LOG_2PI + 1.0), axis=-1)
+
+    @staticmethod
+    def sample(key, params):
+        mean, log_std = params["mean"], params["log_std"]
+        return mean + jnp.exp(log_std) * jax.random.normal(
+            key, mean.shape, mean.dtype
+        )
+
+    @staticmethod
+    def mode(params):
+        return params["mean"]
+
+
+_REGISTRY = {d.name: d for d in (Categorical, DiagGaussian)}
+
+
+def make_distribution(name: str):
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown distribution {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name]

@@ -1,0 +1,11 @@
+"""Policy and value networks (pure-functional pytree modules)."""
+
+from trpo_tpu.models.mlp import init_mlp, apply_mlp, init_linear  # noqa: F401
+from trpo_tpu.models.conv import init_atari_torso, apply_atari_torso  # noqa: F401
+from trpo_tpu.models.policy import (  # noqa: F401
+    DiscreteSpec,
+    BoxSpec,
+    Policy,
+    make_policy,
+    spec_from_env,
+)

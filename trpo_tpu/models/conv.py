@@ -1,0 +1,55 @@
+"""Convolutional torso for pixel policies (Atari rung of BASELINE.json).
+
+The reference has no conv nets (its only model is a 64-wide MLP,
+``trpo_inksci.py:38-40``); the Atari config in ``BASELINE.json`` ("pixel conv
+policy, high-param FVP") makes one a build obligation. Layout is NHWC —
+channels-last is the TPU-native layout (the MXU consumes the trailing
+dimension) — and the filter spec is the classic Nature-DQN torso
+(8×8/4 → 4×4/2 → 3×3/1), whose large channel counts map well onto 128-lane
+tiles.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["init_atari_torso", "apply_atari_torso", "ATARI_TORSO_SPEC"]
+
+# (kernel_h, kernel_w, out_channels, stride)
+ATARI_TORSO_SPEC = ((8, 8, 32, 4), (4, 4, 64, 2), (3, 3, 64, 1))
+
+_DIMSPEC = ("NHWC", "HWIO", "NHWC")
+
+
+def init_atari_torso(key, in_channels: int = 4, spec=ATARI_TORSO_SPEC):
+    keys = jax.random.split(key, len(spec))
+    convs = []
+    c_in = in_channels
+    for k, (kh, kw, c_out, _stride) in zip(keys, spec):
+        fan_in = kh * kw * c_in
+        w = jax.random.normal(k, (kh, kw, c_in, c_out), jnp.float32)
+        w = w * jnp.sqrt(2.0 / fan_in)
+        convs.append({"w": w, "b": jnp.zeros((c_out,), jnp.float32)})
+        c_in = c_out
+    return {"convs": convs}
+
+
+def apply_atari_torso(
+    params, x, spec=ATARI_TORSO_SPEC, compute_dtype=jnp.float32
+):
+    """``x``: (N, H, W, C) uint8 or float. Returns (N, features) fp32."""
+    h = jnp.asarray(x, compute_dtype)
+    if x.dtype == jnp.uint8:
+        h = h / jnp.asarray(255.0, compute_dtype)
+    for layer, (_kh, _kw, _c, stride) in zip(params["convs"], spec):
+        w = jnp.asarray(layer["w"], compute_dtype)
+        b = jnp.asarray(layer["b"], compute_dtype)
+        h = lax.conv_general_dilated(
+            h, w, window_strides=(stride, stride), padding="VALID",
+            dimension_numbers=_DIMSPEC,
+        )
+        h = jax.nn.relu(h + b)
+    h = h.reshape(h.shape[0], -1)
+    return jnp.asarray(h, jnp.float32)

@@ -1,0 +1,76 @@
+"""Pure-functional MLPs.
+
+The reference's policy is a prettytensor one-hidden-layer tanh net with a
+softmax head (``trpo_inksci.py:38-40``) and its critic a 64-relu-64-relu-1
+net (``utils.py:59-61``). Here networks are explicit pytrees of
+``{"w", "b"}`` dicts with a pure ``apply`` — no module framework, so params
+flow directly through ``ravel_pytree`` (the flat-vector contract, SURVEY §1)
+and through ``jax.sharding`` annotations for tensor-sharded wide layers.
+
+Compute dtype: ``apply_mlp`` optionally casts to bfloat16 for the matmuls
+(MXU-friendly) while keeping params and outputs fp32 — the trust-region
+solve itself always runs fp32 (see ``ops/cg.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_linear", "init_mlp", "apply_mlp", "ACTIVATIONS"]
+
+ACTIVATIONS = {
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+    "elu": jax.nn.elu,
+}
+
+
+def init_linear(key, in_dim: int, out_dim: int, scale: float | None = None):
+    """Orthogonal weight init (standard for on-policy RL), zero bias."""
+    if scale is None:
+        scale = float(jnp.sqrt(2.0))
+    w = jax.nn.initializers.orthogonal(scale)(key, (in_dim, out_dim), jnp.float32)
+    return {"w": w, "b": jnp.zeros((out_dim,), jnp.float32)}
+
+
+def init_mlp(
+    key,
+    in_dim: int,
+    hidden: Sequence[int],
+    out_dim: int,
+    final_scale: float = 0.01,
+):
+    """Init an MLP ``in_dim -> hidden... -> out_dim``.
+
+    The small ``final_scale`` keeps the initial policy near-uniform /
+    near-zero-mean, which stabilizes early trust-region steps.
+    """
+    sizes = [in_dim, *hidden, out_dim]
+    keys = jax.random.split(key, len(sizes) - 1)
+    layers = []
+    for i, (k, d_in, d_out) in enumerate(zip(keys, sizes[:-1], sizes[1:])):
+        scale = final_scale if i == len(sizes) - 2 else None
+        layers.append(init_linear(k, d_in, d_out, scale))
+    return {"layers": layers}
+
+
+def apply_mlp(params, x, activation: str = "tanh", compute_dtype=jnp.float32):
+    """Forward pass; activation on all but the last layer.
+
+    Matmuls run in ``compute_dtype`` (bf16 on TPU keeps them on the MXU at
+    full rate); the result is returned in fp32.
+    """
+    act = ACTIVATIONS[activation]
+    h = jnp.asarray(x, compute_dtype)
+    layers = params["layers"]
+    for i, layer in enumerate(layers):
+        w = jnp.asarray(layer["w"], compute_dtype)
+        b = jnp.asarray(layer["b"], compute_dtype)
+        h = h @ w + b
+        if i < len(layers) - 1:
+            h = act(h)
+    return jnp.asarray(h, jnp.float32)

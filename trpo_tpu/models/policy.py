@@ -1,0 +1,140 @@
+"""Policy abstraction: obs -> distribution parameters.
+
+Replaces the reference's hard-wired discrete softmax head
+(``trpo_inksci.py:26,38-40`` — which asserts ``Discrete`` action spaces by
+construction). A :class:`Policy` bundles a pure ``init`` and ``apply`` with
+the matching distribution; continuous (Box) action spaces get a
+state-independent learned ``log_std`` head, the standard TRPO/MuJoCo
+parameterization required by BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trpo_tpu.distributions import Categorical, DiagGaussian
+from trpo_tpu.models.mlp import apply_mlp, init_mlp
+from trpo_tpu.models.conv import apply_atari_torso, init_atari_torso
+
+__all__ = ["DiscreteSpec", "BoxSpec", "Policy", "make_policy", "spec_from_env"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscreteSpec:
+    """n discrete actions (gym/gymnasium ``Discrete``)."""
+    n: int
+
+
+@dataclasses.dataclass(frozen=True)
+class BoxSpec:
+    """dim-dimensional continuous actions (gym/gymnasium ``Box``)."""
+    dim: int
+
+
+class Policy(NamedTuple):
+    init: Callable[[jax.Array], Any]            # key -> params pytree
+    apply: Callable[[Any, jax.Array], Any]      # (params, obs) -> dist params
+    dist: Any                                   # Categorical | DiagGaussian
+    action_spec: Any
+
+
+def make_policy(
+    obs_shape: Tuple[int, ...],
+    action_spec,
+    hidden: Tuple[int, ...] = (64,),
+    activation: str = "tanh",
+    init_log_std: float = 0.0,
+    compute_dtype=jnp.float32,
+    conv_torso: Optional[bool] = None,
+) -> Policy:
+    """Build a policy for ``obs_shape`` / ``action_spec``.
+
+    1-D observations get an MLP (the reference's shape,
+    ``trpo_inksci.py:38-40``, generalized to arbitrary depth); 3-D (H, W, C)
+    observations get the Atari conv torso + dense head.
+    """
+    if conv_torso is None:
+        conv_torso = len(obs_shape) == 3
+
+    if isinstance(action_spec, DiscreteSpec):
+        out_dim, dist = action_spec.n, Categorical
+    elif isinstance(action_spec, BoxSpec):
+        out_dim, dist = action_spec.dim, DiagGaussian
+    else:
+        raise TypeError(f"unsupported action spec: {action_spec!r}")
+
+    if conv_torso:
+        if len(obs_shape) != 3:
+            raise ValueError("conv torso needs (H, W, C) observations")
+
+        def _feat_dim(torso_params):
+            # Derive the flattened feature width from the real forward fn
+            # (zero FLOPs) so it can never diverge from apply_atari_torso.
+            out = jax.eval_shape(
+                apply_atari_torso,
+                torso_params,
+                jax.ShapeDtypeStruct((1, *obs_shape), jnp.float32),
+            )
+            return out.shape[-1]
+
+        def init(key):
+            k_torso, k_head, k_std = jax.random.split(key, 3)
+            torso = init_atari_torso(k_torso, in_channels=obs_shape[2])
+            params = {
+                "torso": torso,
+                "head": init_mlp(k_head, _feat_dim(torso), hidden, out_dim),
+            }
+            if dist is DiagGaussian:
+                params["log_std"] = jnp.full(
+                    (out_dim,), init_log_std, jnp.float32
+                )
+            return params
+
+        def head_forward(params, obs):
+            feats = apply_atari_torso(
+                params["torso"], obs, compute_dtype=compute_dtype
+            )
+            return apply_mlp(
+                params["head"], feats, activation, compute_dtype
+            )
+    else:
+        obs_dim = int(jnp.prod(jnp.asarray(obs_shape)))
+
+        def init(key):
+            k_net, _ = jax.random.split(key)
+            params = {"net": init_mlp(k_net, obs_dim, hidden, out_dim)}
+            if dist is DiagGaussian:
+                params["log_std"] = jnp.full(
+                    (out_dim,), init_log_std, jnp.float32
+                )
+            return params
+
+        def head_forward(params, obs):
+            obs = obs.reshape(obs.shape[0], -1)
+            return apply_mlp(params["net"], obs, activation, compute_dtype)
+
+    def apply(params, obs):
+        raw = head_forward(params, obs)
+        if dist is Categorical:
+            return {"logits": raw}
+        log_std = jnp.broadcast_to(params["log_std"], raw.shape)
+        return {"mean": raw, "log_std": log_std}
+
+    return Policy(init=init, apply=apply, dist=dist, action_spec=action_spec)
+
+
+def spec_from_env(env) -> Tuple[Tuple[int, ...], Any]:
+    """(obs_shape, action_spec) from a trpo_tpu env or gymnasium env."""
+    # trpo_tpu pure-JAX envs expose these directly.
+    if hasattr(env, "obs_shape") and hasattr(env, "action_spec"):
+        return tuple(env.obs_shape), env.action_spec
+    # gymnasium
+    obs_shape = tuple(env.observation_space.shape)
+    space = env.action_space
+    if hasattr(space, "n"):
+        return obs_shape, DiscreteSpec(int(space.n))
+    return obs_shape, BoxSpec(int(space.shape[0]))

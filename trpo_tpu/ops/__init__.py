@@ -1,0 +1,17 @@
+"""Numeric / optimizer ops: the TPU-native realization of the reference's
+``utils.py`` boundary (SURVEY §1: flat-vector in, flat-vector out)."""
+
+from trpo_tpu.ops.flat import (  # noqa: F401
+    flatten_params,
+    flat_grad,
+    var_shapes,
+    numel,
+)
+from trpo_tpu.ops.returns import (  # noqa: F401
+    discount,
+    discounted_returns_segmented,
+    gae_advantages,
+)
+from trpo_tpu.ops.cg import conjugate_gradient  # noqa: F401
+from trpo_tpu.ops.linesearch import backtracking_linesearch  # noqa: F401
+from trpo_tpu.ops.fvp import make_fvp, materialize_fisher  # noqa: F401

@@ -1,0 +1,52 @@
+"""Flat-parameter utilities.
+
+The reference moves all second-order quantities through a single flat vector:
+``GetFlat`` / ``SetFromFlat`` build concat/slice+assign graphs over TF
+variables (``utils.py:125-158``), ``flatgrad`` concat-reshapes ``tf.gradients``
+output (``utils.py:119-122``), with ``var_shape`` / ``numel`` as helpers
+(``utils.py:108-116``). In JAX the whole machinery is ``ravel_pytree``: params
+are an immutable pytree, so "SetFromFlat" is just the unravel closure — no
+assign ops, no device round trip, and it composes with ``jit`` / ``grad``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+__all__ = ["flatten_params", "flat_grad", "var_shapes", "numel"]
+
+
+def flatten_params(params) -> Tuple[jax.Array, Callable]:
+    """Return ``(flat, unravel)``.
+
+    ``flat`` is the 1-D fp32 concatenation of all leaves (ref ``GetFlat``,
+    ``utils.py:151-158``); ``unravel(flat)`` rebuilds the pytree (ref
+    ``SetFromFlat``, ``utils.py:125-149``) — functionally, with no mutation.
+    """
+    return ravel_pytree(params)
+
+
+def flat_grad(fn: Callable, params) -> jax.Array:
+    """Flat gradient of a scalar function of a pytree (ref ``flatgrad``,
+    ``utils.py:119-122``)."""
+    return ravel_pytree(jax.grad(fn)(params))[0]
+
+
+def var_shapes(params):
+    """Static shapes of every leaf (ref ``var_shape``, ``utils.py:108-112``).
+
+    JAX shapes are always static under ``jit`` tracing, so the reference's
+    "shape function not fully known" assert has no analogue."""
+    return [leaf.shape for leaf in jax.tree_util.tree_leaves(params)]
+
+
+def numel(params) -> int:
+    """Total element count across the pytree (ref ``numel``,
+    ``utils.py:114-116``)."""
+    return sum(
+        int(jnp.size(leaf)) for leaf in jax.tree_util.tree_leaves(params)
+    )

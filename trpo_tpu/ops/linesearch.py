@@ -1,0 +1,76 @@
+"""Backtracking line search as a device ``while_loop``.
+
+The reference's ``linesearch`` (``utils.py:170-182``) evaluates the surrogate
+at up to 10 shrinking steps, each trial being a parameter *upload*
+(``SetFromFlat``) plus a full-batch ``sess.run`` — up to 20 host↔device
+crossings per update. SURVEY §7 flags keeping this on-device as a hard
+requirement for the 20× target: the data-dependent early exit becomes a
+``lax.while_loop`` carrying the candidate parameter vector in registers.
+
+Acceptance rule is the reference's exactly: accept the first step with
+``actual_improve > 0`` and ``actual_improve / expected_improve > accept_ratio``
+(expected improvement scaled by the current step fraction); if no step is
+accepted, return the original parameters (``utils.py:182``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["backtracking_linesearch", "LinesearchResult"]
+
+
+class LinesearchResult(NamedTuple):
+    x: jax.Array              # accepted params (== input x when nothing accepted)
+    success: jax.Array        # bool: did any step pass the acceptance test
+    step_fraction: jax.Array  # accepted 0.5**k (0.0 on failure)
+    loss: jax.Array           # loss at the returned params
+
+
+def backtracking_linesearch(
+    loss_fn: Callable[[jax.Array], jax.Array],
+    x: jax.Array,
+    fullstep: jax.Array,
+    expected_improve_rate: jax.Array,
+    max_backtracks: int = 10,
+    accept_ratio: float = 0.1,
+    backtrack_factor: float = 0.5,
+) -> LinesearchResult:
+    """Search along ``fullstep`` from ``x`` minimizing ``loss_fn``.
+
+    ``expected_improve_rate`` is the first-order predicted improvement at the
+    full step (``gᵀ·fullstep``); the reference scales it by the step fraction
+    when forming the ratio (``utils.py:176``).
+    """
+    fval = loss_fn(x)
+
+    def cond(state):
+        k, accepted, _, _, _ = state
+        return jnp.logical_and(k < max_backtracks, jnp.logical_not(accepted))
+
+    def body(state):
+        k, _, _, _, _ = state
+        frac = jnp.asarray(backtrack_factor, x.dtype) ** k.astype(x.dtype)
+        xnew = x + frac * fullstep
+        newfval = loss_fn(xnew)
+        actual_improve = fval - newfval
+        expected_improve = expected_improve_rate * frac
+        ratio = actual_improve / expected_improve
+        ok = jnp.logical_and(ratio > accept_ratio, actual_improve > 0.0)
+        return k + 1, ok, xnew, newfval, frac
+
+    k0 = jnp.asarray(0, jnp.int32)
+    _, accepted, xcand, fcand, frac = lax.while_loop(
+        cond, body, (k0, jnp.asarray(False), x, fval, jnp.asarray(0.0, x.dtype))
+    )
+    x_out = jnp.where(accepted, xcand, x)
+    return LinesearchResult(
+        x=x_out,
+        success=accepted,
+        step_fraction=jnp.where(accepted, frac, 0.0),
+        loss=jnp.where(accepted, fcand, fval),
+    )

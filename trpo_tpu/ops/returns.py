@@ -1,0 +1,95 @@
+"""Discounted returns and generalized advantage estimation as device scans.
+
+The reference computes returns with a host-side SciPy IIR filter
+(``discount``, ``utils.py:14-16``) applied per episode, and advantages as
+plain ``returns − baseline`` (``trpo_inksci.py:104-105``) — no GAE. Here both
+are ``lax.scan`` / ``lax.associative_scan`` programs over fixed-length
+``(T, N)`` trajectory tensors with a ``done`` mask handling episode
+boundaries, which is the long-trajectory ("sequence-parallel") analogue this
+problem actually admits (SURVEY §5): static shapes, O(log T) depth on device,
+batched over N envs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["discount", "discounted_returns_segmented", "gae_advantages"]
+
+
+def _affine_combine(right, left):
+    """Monoid op for reverse affine scans of ``y_t = b_t + a_t · y_{t+1}``.
+
+    With ``reverse=True`` the scan hands us (higher-index block, lower-index
+    block); composing outer∘inner gives ``(a_out·a_in, b_out + a_out·b_in)``
+    where the lower-index map is the outer one.
+    """
+    a_in, b_in = right
+    a_out, b_out = left
+    return a_out * a_in, b_out + a_out * b_in
+
+
+def _reverse_affine_scan(gammas, x):
+    _, y = lax.associative_scan(_affine_combine, (gammas, x), reverse=True)
+    return y
+
+
+def discount(x: jax.Array, gamma: float) -> jax.Array:
+    """Discounted cumulative sum along axis 0: ``y_t = Σ_k γ^k x_{t+k}``.
+
+    Exact functional replacement for the reference's
+    ``scipy.signal.lfilter([1], [1, -gamma], x[::-1])[::-1]``
+    (``utils.py:14-16``), as an O(log T) associative scan: the recurrence
+    ``y_t = x_t + γ y_{t+1}`` composes as an affine map scanned in reverse.
+    """
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float32)
+    return discounted_returns_segmented(x, jnp.zeros_like(x), gamma)
+
+
+def discounted_returns_segmented(
+    rewards: jax.Array, dones: jax.Array, gamma: float
+) -> jax.Array:
+    """Per-step discounted return with episode boundaries.
+
+    ``rewards``, ``dones``: ``(T, ...)`` with dones ∈ {0,1} marking the last
+    step of an episode. The discount factor is zeroed across a boundary, so
+    returns never leak between episodes packed into one fixed-length tensor.
+    """
+    rewards = jnp.asarray(rewards)
+    if not jnp.issubdtype(rewards.dtype, jnp.floating):
+        rewards = rewards.astype(jnp.float32)
+    dones = jnp.asarray(dones).astype(rewards.dtype)
+    gammas = gamma * (1.0 - dones)
+    return _reverse_affine_scan(gammas, rewards)
+
+
+def gae_advantages(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    last_values: jax.Array,
+    gamma: float,
+    lam: float,
+) -> tuple[jax.Array, jax.Array]:
+    """GAE(λ) advantages and value targets over ``(T, N)`` tensors.
+
+    ``last_values``: ``(N,)`` bootstrap values for the state after step T-1
+    (used only where the final step was a truncation, not a terminal). With
+    ``lam=1`` and a zero baseline this reduces to the reference's plain
+    discounted-returns advantage (``trpo_inksci.py:104-105``); the explicit
+    truncation bootstrap fixes the reference's non-terminating-episode rollout
+    bug (``utils.py:44``, SURVEY §7 "hard parts").
+
+    Returns ``(advantages, value_targets)``, both ``(T, N)``.
+    """
+    rewards = jnp.asarray(rewards)
+    dones = jnp.asarray(dones).astype(rewards.dtype)
+    next_values = jnp.concatenate([values[1:], last_values[None]], axis=0)
+    nonterminal = 1.0 - dones
+    deltas = rewards + gamma * nonterminal * next_values - values
+    adv = _reverse_affine_scan(gamma * lam * nonterminal, deltas)
+    return adv, adv + values
