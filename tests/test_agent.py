@@ -1,0 +1,114 @@
+"""Agent-level tests: fused iteration mechanics + CartPole end-to-end.
+
+The integration test mirrors the reference's own implicit success criterion
+("it learns", ``trpo_inksci.py:135``): CartPole mean episode reward must
+climb well above random within a bounded number of iterations at a fixed
+seed (SURVEY §4 "Integration").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+
+
+def small_cfg(**kw):
+    base = dict(
+        env="cartpole",
+        n_envs=8,
+        batch_timesteps=512,
+        gamma=0.99,
+        lam=0.97,
+        vf_train_steps=25,
+        n_iterations=3,
+    )
+    base.update(kw)
+    return TRPOConfig(**base)
+
+
+def test_iteration_runs_and_updates_state():
+    agent = TRPOAgent("cartpole", small_cfg())
+    state = agent.init_state()
+    state2, stats = agent.run_iteration(state)
+    assert int(state2.iteration) == 1
+    assert int(state2.total_timesteps) == agent.n_steps * 8
+    f0 = jax.flatten_util.ravel_pytree(state.policy_params)[0]
+    f1 = jax.flatten_util.ravel_pytree(state2.policy_params)[0]
+    assert float(jnp.linalg.norm(f1 - f0)) > 0.0
+    assert np.isfinite(stats["entropy"])
+    assert np.isfinite(stats["surrogate_loss"])
+    # iteration 0 used a zero baseline (ref parity utils.py:88-89): vf was
+    # unfitted when advantages were computed, but is fitted afterwards
+    assert bool(state2.vf_state.initialized)
+
+
+def test_learn_smoke_and_stats_keys():
+    agent = TRPOAgent("cartpole", small_cfg())
+    collected = []
+    state = agent.learn(
+        n_iterations=2, callback=lambda s, st: collected.append(st)
+    )
+    assert int(state.iteration) == 2
+    for key in (
+        "total_episodes",
+        "mean_episode_reward",
+        "entropy",
+        "vf_explained_variance",
+        "kl_old_new",
+        "surrogate_loss",
+        "time_elapsed_min",
+        "iteration_ms",
+    ):
+        assert key in collected[-1], key
+
+
+def test_act_modes():
+    agent = TRPOAgent("cartpole", small_cfg())
+    state = agent.init_state()
+    obs = jnp.zeros(4)
+    a_eval, dist = agent.act(state, obs, eval_mode=True)
+    assert a_eval.shape == ()
+    # eval action is the argmax of the dist
+    assert int(a_eval) == int(jnp.argmax(dist["logits"]))
+    a1, _ = agent.act(state, obs, key=jax.random.key(0))
+    a2, _ = agent.act(state, obs, key=jax.random.key(0))
+    assert int(a1) == int(a2)  # same key → same sample
+
+
+def test_deterministic_given_seed():
+    cfg = small_cfg()
+    s1, _ = TRPOAgent("cartpole", cfg).run_iteration(
+        TRPOAgent("cartpole", cfg).init_state(seed=7)
+    )
+    agent = TRPOAgent("cartpole", cfg)
+    s2, _ = agent.run_iteration(agent.init_state(seed=7))
+    f1 = jax.flatten_util.ravel_pytree(s1.policy_params)[0]
+    f2 = jax.flatten_util.ravel_pytree(s2.policy_params)[0]
+    np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
+
+
+@pytest.mark.slow
+def test_cartpole_learns():
+    cfg = TRPOConfig(
+        env="cartpole",
+        n_envs=16,
+        batch_timesteps=4000,
+        gamma=0.99,
+        lam=0.97,
+        max_kl=0.01,
+        vf_train_steps=50,
+        policy_hidden=(64,),
+        reward_target=400.0,
+        seed=1,
+    )
+    agent = TRPOAgent("cartpole", cfg)
+    rewards = []
+    agent.learn(
+        n_iterations=40,
+        callback=lambda s, st: rewards.append(st["mean_episode_reward"]),
+    )
+    best = max(rewards)
+    assert best >= 400.0, f"best mean episode reward {best}; curve={rewards}"

@@ -1,0 +1,95 @@
+"""Device rollout: shapes, episode accounting, auto-reset, scripted returns."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trpo_tpu.envs import CartPole, FakeEnv
+from trpo_tpu.models import make_policy
+from trpo_tpu.rollout import device_rollout, init_carry
+
+
+def make_setup(env, hidden=(8,), seed=0):
+    policy = make_policy(env.obs_shape, env.action_spec, hidden=hidden)
+    params = policy.init(jax.random.key(seed))
+    carry = init_carry(env, jax.random.key(seed + 1), n_envs=4)
+    return policy, params, carry
+
+
+def test_rollout_shapes_and_jit():
+    env = CartPole()
+    policy, params, carry = make_setup(env)
+    roll = jax.jit(
+        lambda p, c, k: device_rollout(env, policy, p, c, k, n_steps=20)
+    )
+    new_carry, traj = roll(params, carry, jax.random.key(2))
+    assert traj.obs.shape == (20, 4, 4)
+    assert traj.actions.shape == (20, 4)
+    assert traj.rewards.shape == (20, 4)
+    assert traj.next_obs.shape == (20, 4, 4)
+    assert traj.old_dist["logits"].shape == (20, 4, 2)
+
+
+def test_rollout_carry_continues_episodes():
+    # Rolling 10+10 steps with carried state must see the same episode
+    # lengths as rolling 20 straight (no restart between batches — the
+    # reference restarts envs every batch, utils.py:22-26).
+    env = FakeEnv(chain_len=7)
+    policy, params, carry0 = make_setup(env)
+    _, traj_a = device_rollout(env, policy, params, carry0, jax.random.key(5), 10)
+    carry_mid, _ = device_rollout(env, policy, params, carry0, jax.random.key(5), 10)
+    _, traj_b = device_rollout(env, policy, params, carry_mid, jax.random.key(6), 10)
+    dones = np.concatenate(
+        [np.asarray(traj_a.done), np.asarray(traj_b.done)], axis=0
+    )
+    # FakeEnv terminates every 7 steps deterministically: dones at t=6,13 in
+    # the concatenated 20 steps for every env.
+    for n in range(4):
+        np.testing.assert_array_equal(np.where(dones[:, n])[0], [6, 13])
+
+
+def test_rollout_episode_return_accounting():
+    env = FakeEnv(chain_len=5, reward_scale=1.0)
+    policy, params, carry = make_setup(env)
+    _, traj = device_rollout(env, policy, params, carry, jax.random.key(7), 15)
+    done = np.asarray(traj.done)
+    ep_ret = np.asarray(traj.episode_return)
+    ep_len = np.asarray(traj.episode_length)
+    # Wherever an episode ends, its length must be exactly 5 and the return
+    # equals the sum of that episode's rewards.
+    rewards = np.asarray(traj.rewards)
+    for t, n in zip(*np.where(done)):
+        assert ep_len[t, n] == 5
+        start = t - 4
+        np.testing.assert_allclose(
+            ep_ret[t, n], rewards[start : t + 1, n].sum(), rtol=1e-6
+        )
+
+
+def test_rollout_autoreset_restarts_observation():
+    env = FakeEnv(chain_len=3)
+    policy, params, carry = make_setup(env)
+    _, traj = device_rollout(env, policy, params, carry, jax.random.key(8), 7)
+    obs = np.asarray(traj.obs)          # one-hot of position
+    done = np.asarray(traj.done)
+    # The step AFTER a done must observe position 0 again.
+    for t, n in zip(*np.where(done[:-1])):
+        np.testing.assert_array_equal(obs[t + 1, n], [1, 0, 0])
+    # next_obs at the done step is the PRE-reset successor (position
+    # clamped at the end of the chain), not the reset obs.
+    nxt = np.asarray(traj.next_obs)
+    for t, n in zip(*np.where(done)):
+        np.testing.assert_array_equal(nxt[t, n], [0, 0, 1])
+
+
+def test_rollout_rewards_match_fake_script():
+    env = FakeEnv(chain_len=4, reward_scale=3.0)
+    policy, params, carry = make_setup(env, seed=3)
+    _, traj = device_rollout(env, policy, params, carry, jax.random.key(9), 8)
+    rewards = np.asarray(traj.rewards)
+    actions = np.asarray(traj.actions)
+    # reward = 3·pos when action==1 else 0; pos cycles 0,1,2,3,0,...
+    pos = np.tile([0, 1, 2, 3], 2)
+    for n in range(4):
+        want = np.where(actions[:, n] == 1, 3.0 * pos, 0.0)
+        np.testing.assert_allclose(rewards[:, n], want, rtol=1e-6)

@@ -1,0 +1,380 @@
+"""``TRPOAgent`` — the reference's top-level API (init / act / learn),
+re-architected so one training iteration is one device program.
+
+Reference shape (``trpo_inksci.py:21-176``): ``__init__`` builds the TF
+graph, ``act`` runs a batch-1 ``sess.run`` per env step, ``learn`` is a host
+loop of rollout → advantage calc → critic fit → CG/linesearch policy update,
+every stage crossing the host boundary (SURVEY §3.2 counts the round trips).
+
+Here, for pure-JAX envs the ENTIRE iteration — ``lax.scan`` rollout over
+vectorized envs, GAE, critic ``lax.scan`` fit, fused TRPO update — is a
+single jitted function of ``(TrainState, key)``. For host simulators
+(MuJoCo/Atari via gymnasium) only env stepping stays on host; everything
+else is the same fused program.
+
+Retained reference behaviors (citations in line): advantage
+standardization, KL rollback, NaN-entropy abort, reward-target and
+explained-variance stop heuristics (both made configurable — SURVEY §7
+quirks list), the seven printed stats.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from trpo_tpu import envs as envs_lib
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.models.policy import make_policy, spec_from_env
+from trpo_tpu.ops.returns import gae_from_next_values
+from trpo_tpu.rollout import Trajectory, device_rollout, host_rollout, init_carry
+from trpo_tpu.trpo import (
+    TRPOBatch,
+    TRPOStats,
+    make_trpo_update,
+    standardize_advantages,
+)
+from trpo_tpu.utils.metrics import StatsLogger, explained_variance
+from trpo_tpu.utils.timers import PhaseTimer
+from trpo_tpu.vf import VFState, create_value_function
+
+__all__ = ["TRPOAgent", "TrainState"]
+
+
+class TrainState(NamedTuple):
+    """Everything that evolves across iterations — the checkpointable unit."""
+    policy_params: Any
+    vf_state: VFState
+    env_carry: Any            # device envs only; None for host envs
+    rng: jax.Array
+    iteration: jax.Array      # int32 scalar
+    total_episodes: jax.Array  # int32 scalar (ref "Total number of episodes")
+    total_timesteps: jax.Array
+
+
+class TRPOAgent:
+    """TRPO on a TPU mesh. ``env`` may be an env name (see
+    ``trpo_tpu.envs.make``) or a constructed env object."""
+
+    def __init__(self, env, config: Optional[TRPOConfig] = None):
+        cfg = config or TRPOConfig()
+        if isinstance(env, str):
+            env = envs_lib.make(env, **(
+                {"n_envs": cfg.n_envs} if env.startswith("gym:") else {}
+            ))
+        self.env = env
+        self.cfg = cfg
+        self.is_device_env = envs_lib.is_device_env(env)
+
+        if cfg.debug_nans:
+            jax.config.update("jax_debug_nans", True)
+
+        obs_shape, action_spec = spec_from_env(env)
+        self.obs_shape = obs_shape
+        compute_dtype = jnp.dtype(cfg.compute_dtype)
+        self.policy = make_policy(
+            obs_shape,
+            action_spec,
+            hidden=tuple(cfg.policy_hidden),
+            activation=cfg.policy_activation,
+            init_log_std=cfg.init_log_std,
+            compute_dtype=compute_dtype,
+        )
+        obs_dim = int(math.prod(obs_shape))
+        self.vf = create_value_function(
+            obs_dim,
+            hidden=tuple(cfg.vf_hidden),
+            activation=cfg.vf_activation,
+            learning_rate=cfg.vf_learning_rate,
+            train_steps=cfg.vf_train_steps,
+            compute_dtype=compute_dtype,
+        )
+        self.trpo_update = make_trpo_update(self.policy, cfg)
+
+        # steps per env per iteration, so T·N ≥ batch_timesteps
+        # (ref batch budget semantics, trpo_inksci.py:17 + utils.py:21).
+        self.n_steps = max(1, -(-cfg.batch_timesteps // cfg.n_envs))
+
+        self._process_fn = jax.jit(self._process_trajectory)
+        if self.is_device_env:
+            self._iter_fn = jax.jit(self._device_iteration)
+        self._act_fn = jax.jit(self._act, static_argnames=("eval_mode",))
+
+    # ------------------------------------------------------------------
+    # state
+    # ------------------------------------------------------------------
+
+    def init_state(self, seed: Optional[int] = None) -> TrainState:
+        """Explicit-seed init (the reference seeds globals at import,
+        ``utils.py:7-10`` — here reproducibility is a parameter)."""
+        seed = self.cfg.seed if seed is None else seed
+        key = jax.random.key(seed)
+        k_policy, k_vf, k_env, k_run = jax.random.split(key, 4)
+        env_carry = (
+            init_carry(self.env, k_env, self.cfg.n_envs)
+            if self.is_device_env
+            else None
+        )
+        return TrainState(
+            policy_params=self.policy.init(k_policy),
+            vf_state=self.vf.init(k_vf),
+            env_carry=env_carry,
+            rng=k_run,
+            iteration=jnp.asarray(0, jnp.int32),
+            total_episodes=jnp.asarray(0, jnp.int32),
+            total_timesteps=jnp.asarray(0, jnp.int64)
+            if jax.config.jax_enable_x64
+            else jnp.asarray(0, jnp.int32),
+        )
+
+    # ------------------------------------------------------------------
+    # act (ref trpo_inksci.py:76-87)
+    # ------------------------------------------------------------------
+
+    def _act(self, params, obs, key, eval_mode: bool):
+        squeeze = obs.ndim == len(self.obs_shape)
+        if squeeze:
+            obs = obs[None]
+        dist = self.policy.apply(params, obs)
+        if eval_mode:  # static under jit: argmax/mode, ref trpo_inksci.py:83
+            action = self.policy.dist.mode(dist)
+        else:
+            action = self.policy.dist.sample(key, dist)
+        if squeeze:
+            action = jax.tree_util.tree_map(lambda a: a[0], action)
+            dist = jax.tree_util.tree_map(lambda d: d[0], dist)
+        return action, dist
+
+    def act(self, state: TrainState, obs, key=None, eval_mode: bool = False):
+        """Sample (train) or argmax (eval) an action for ``obs`` — the
+        reference's train/eval split at ``trpo_inksci.py:79-83`` minus the
+        vestigial ``prev_action`` buffer (SURVEY §7).
+
+        Train mode requires an explicit ``key``: a silent default would make
+        every call sample identically and kill exploration."""
+        if key is None:
+            if not eval_mode:
+                raise ValueError(
+                    "act(eval_mode=False) needs an explicit PRNG key; "
+                    "pass key=jax.random.key(...) or use eval_mode=True"
+                )
+            key = jax.random.key(0)  # unused by the mode/argmax path
+        return self._act_fn(
+            state.policy_params, jnp.asarray(obs), key, eval_mode
+        )
+
+    # ------------------------------------------------------------------
+    # the fused iteration
+    # ------------------------------------------------------------------
+
+    def _advantages(self, vf_state: VFState, traj: Trajectory):
+        T, N = traj.rewards.shape
+        flat = lambda x: x.reshape((T * N,) + x.shape[2:])
+        values = self.vf.predict(vf_state, flat(traj.obs)).reshape(T, N)
+        next_values = self.vf.predict(vf_state, flat(traj.next_obs)).reshape(
+            T, N
+        )
+        adv, vtarg = gae_from_next_values(
+            traj.rewards,
+            values,
+            next_values,
+            traj.terminated,
+            traj.done,
+            self.cfg.gamma,
+            self.cfg.lam,
+        )
+        return adv, vtarg, values
+
+    def _process_trajectory(self, train_state: TrainState, traj: Trajectory):
+        """advantages → critic fit → TRPO update → stats. One jitted
+        program; shared by the device and host paths."""
+        cfg = self.cfg
+        T, N = traj.rewards.shape
+        flat = lambda x: x.reshape((T * N,) + x.shape[2:])
+
+        adv, vtarg, values = self._advantages(train_state.vf_state, traj)
+        weight = jnp.ones(T * N, jnp.float32)
+        adv_flat = flat(adv)
+        if cfg.standardize_advantages:  # ref trpo_inksci.py:115-117
+            adv_flat = standardize_advantages(adv_flat, weight)
+
+        # Critic fit AFTER advantage computation — the reference's ordering
+        # (predict at trpo_inksci.py:103, fit at :143).
+        new_vf_state, vf_loss = self.vf.fit(
+            train_state.vf_state, flat(traj.obs), flat(vtarg), weight
+        )
+
+        batch = TRPOBatch(
+            obs=flat(traj.obs),
+            actions=flat(traj.actions),
+            advantages=adv_flat,
+            old_dist=jax.tree_util.tree_map(flat, traj.old_dist),
+            weight=weight,
+        )
+        new_policy_params, trpo_stats = self.trpo_update(
+            train_state.policy_params, batch
+        )
+
+        done_f = traj.done.astype(jnp.float32)
+        n_episodes = jnp.sum(traj.done)
+        ep_denom = jnp.maximum(n_episodes, 1)
+        # NaN (not 0) when no episode completed this batch — 0 would read as
+        # a real return.
+        no_eps = n_episodes == 0
+        mean_ep_reward = jnp.where(
+            no_eps, jnp.nan, jnp.sum(traj.episode_return * done_f) / ep_denom
+        )
+        mean_ep_length = jnp.where(
+            no_eps,
+            jnp.nan,
+            jnp.sum(traj.episode_length.astype(jnp.float32) * done_f)
+            / ep_denom,
+        )
+
+        stats = {
+            # --- the reference's seven stats (trpo_inksci.py:160-171) ---
+            "total_episodes": train_state.total_episodes
+            + n_episodes.astype(jnp.int32),
+            "mean_episode_reward": mean_ep_reward,
+            "entropy": trpo_stats.entropy,
+            "vf_explained_variance": explained_variance(
+                flat(values), flat(vtarg), weight
+            ),
+            "kl_old_new": trpo_stats.kl,
+            "surrogate_loss": trpo_stats.surrogate_after,
+            # (time elapsed is host-side, added by learn())
+            # --- extended observability (SURVEY §5) ---
+            "mean_episode_length": mean_ep_length,
+            "episodes_in_batch": n_episodes.astype(jnp.int32),
+            "vf_loss": vf_loss,
+            "surrogate_before": trpo_stats.surrogate_before,
+            "grad_norm": trpo_stats.grad_norm,
+            "step_norm": trpo_stats.step_norm,
+            "cg_iterations": trpo_stats.cg_iterations,
+            "cg_residual": trpo_stats.cg_residual,
+            "linesearch_success": trpo_stats.linesearch_success,
+            "linesearch_step_fraction": trpo_stats.step_fraction,
+            "kl_rolled_back": trpo_stats.rolled_back,
+        }
+
+        new_state = train_state._replace(
+            policy_params=new_policy_params,
+            vf_state=new_vf_state,
+            iteration=train_state.iteration + 1,
+            total_episodes=stats["total_episodes"],
+            total_timesteps=train_state.total_timesteps + T * N,
+        )
+        return new_state, stats
+
+    def _device_iteration(self, train_state: TrainState, _=None):
+        """rollout + process as ONE program (pure-JAX envs only)."""
+        rng, k_roll = jax.random.split(train_state.rng)
+        train_state = train_state._replace(rng=rng)
+        new_carry, traj = device_rollout(
+            self.env,
+            self.policy,
+            train_state.policy_params,
+            train_state.env_carry,
+            k_roll,
+            self.n_steps,
+        )
+        train_state = train_state._replace(env_carry=new_carry)
+        return self._process_trajectory(train_state, traj)
+
+    def run_iteration(self, train_state: TrainState):
+        """One training iteration; returns ``(new_state, stats_pytree)``."""
+        if self.is_device_env:
+            return self._iter_fn(train_state)
+        rng = jax.random.fold_in(train_state.rng, int(train_state.iteration))
+        traj = host_rollout(
+            self.env,
+            self.policy,
+            train_state.policy_params,
+            rng,
+            self.n_steps,
+            act_fn=getattr(self, "_host_act_fn", None) or self._make_host_act(),
+        )
+        return self._process_fn(train_state, traj)
+
+    def _make_host_act(self):
+        policy = self.policy
+
+        def act(params, obs, key):
+            dist = policy.apply(params, obs)
+            return policy.dist.sample(key, dist), dist
+
+        self._host_act_fn = jax.jit(act)
+        return self._host_act_fn
+
+    # ------------------------------------------------------------------
+    # learn (ref trpo_inksci.py:88-176)
+    # ------------------------------------------------------------------
+
+    def learn(
+        self,
+        n_iterations: Optional[int] = None,
+        state: Optional[TrainState] = None,
+        logger: Optional[StatsLogger] = None,
+        checkpointer=None,
+        callback=None,
+    ) -> TrainState:
+        """Outer training loop.
+
+        Stops on: iteration budget; ``cfg.reward_target`` (the reference's
+        hard-coded ``> 1.1·500`` heuristic at ``trpo_inksci.py:135``, made
+        configurable); opt-in ``cfg.stop_on_explained_variance`` (ref
+        ``trpo_inksci.py:174-175``); raises on NaN entropy (ref ``exit(-1)``
+        at ``trpo_inksci.py:172-173`` — an exception, not a process kill).
+        """
+        cfg = self.cfg
+        n_iterations = n_iterations or cfg.n_iterations
+        state = state or self.init_state()
+        own_logger = logger is None
+        logger = logger or StatsLogger(jsonl_path=cfg.log_jsonl)
+        timer = PhaseTimer()
+
+        try:
+            for _ in range(n_iterations):
+                with timer.phase("iteration"):
+                    state, stats = self.run_iteration(state)
+                    jax.block_until_ready(stats)
+                host_stats = {
+                    k: (v.item() if hasattr(v, "item") else v)
+                    for k, v in stats.items()
+                }
+                host_stats["time_elapsed_min"] = logger.elapsed_minutes()
+                host_stats["iteration_ms"] = timer.last_ms("iteration")
+                host_stats["timesteps_total"] = int(state.total_timesteps)
+                logger.log(int(state.iteration), host_stats)
+
+                if checkpointer is not None and (
+                    int(state.iteration) % cfg.checkpoint_every == 0
+                ):
+                    checkpointer.save(int(state.iteration), state)
+                if callback is not None:
+                    callback(state, host_stats)
+
+                ent = host_stats["entropy"]
+                if ent != ent:  # NaN check (ref trpo_inksci.py:172-173)
+                    raise FloatingPointError(
+                        "policy entropy is NaN — aborting training"
+                    )
+                if (
+                    cfg.reward_target is not None
+                    and host_stats["episodes_in_batch"] > 0
+                    and host_stats["mean_episode_reward"] >= cfg.reward_target
+                ):
+                    break
+                if (
+                    cfg.stop_on_explained_variance is not None
+                    and host_stats["vf_explained_variance"]
+                    > cfg.stop_on_explained_variance
+                ):
+                    break
+        finally:
+            if own_logger:
+                logger.close()
+        return state

@@ -1,0 +1,44 @@
+"""Environments.
+
+The reference drives a single host-side gym env with one ``sess.run`` per
+step (``utils.py:18-45`` + ``trpo_inksci.py:76-87``) — ~1000 host↔device
+round trips per training batch. Here the classic-control envs are pure JAX
+(``reset``/``step`` are jittable functions over an explicit state pytree), so
+rollouts run *on device* inside ``lax.scan``, batched over N envs with
+``vmap`` — zero per-step dispatch. Host-side gymnasium envs (MuJoCo, Atari)
+are supported through a vectorized adapter with batched device inference.
+
+``make(name)`` resolves:
+- ``"cartpole"``, ``"pendulum"``, ``"fake"`` → pure-JAX envs
+- ``"gym:<EnvId>"`` → gymnasium adapter (requires gymnasium + the env's deps)
+"""
+
+from trpo_tpu.envs.cartpole import CartPole  # noqa: F401
+from trpo_tpu.envs.pendulum import Pendulum  # noqa: F401
+from trpo_tpu.envs.fake import FakeEnv  # noqa: F401
+
+_JAX_ENVS = {
+    "cartpole": CartPole,
+    "pendulum": Pendulum,
+    "fake": FakeEnv,
+}
+
+
+def make(name: str, **kwargs):
+    """Build an env by preset name (see module docstring for the grammar)."""
+    if name.startswith("gym:"):
+        from trpo_tpu.envs.gym_adapter import GymVecEnv
+
+        return GymVecEnv(name[4:], **kwargs)
+    if name in _JAX_ENVS:
+        return _JAX_ENVS[name](**kwargs)
+    raise KeyError(
+        f"unknown env {name!r}; have {sorted(_JAX_ENVS)} or 'gym:<EnvId>'"
+    )
+
+
+def is_device_env(env) -> bool:
+    """True for pure-JAX envs whose step/reset are jittable."""
+    return hasattr(env, "step") and hasattr(env, "reset") and hasattr(
+        env, "obs_shape"
+    ) and not hasattr(env, "host_step")

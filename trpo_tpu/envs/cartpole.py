@@ -1,0 +1,97 @@
+"""CartPole as a pure-JAX environment.
+
+Implements the standard cart-pole swing-up-free balancing task (Barto, Sutton
+& Anderson 1983; the physics constants and termination bounds are the classic
+control ones used by gym/gymnasium CartPole) as jittable ``reset``/``step``
+functions over an explicit state pytree. The reference trains on gym's
+``CartPole-v0`` through host stepping (``trpo_inksci.py:179``,
+``utils.py:24,32``); on-device dynamics let the entire rollout→update
+training iteration compile into one XLA program.
+
+Episode cap defaults to 500 steps (the v1 convention), so the reference's
+"solved" bar of mean reward > 475-550 is reachable; pass
+``max_episode_steps=200`` for v0 semantics.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from trpo_tpu.models.policy import DiscreteSpec
+
+
+class CartPoleState(NamedTuple):
+    x: jax.Array          # cart position
+    x_dot: jax.Array
+    theta: jax.Array      # pole angle (rad)
+    theta_dot: jax.Array
+    t: jax.Array          # step index within episode (int32)
+
+
+class CartPole:
+    obs_shape = (4,)
+    action_spec = DiscreteSpec(2)
+
+    # Classic control constants.
+    gravity = 9.8
+    masscart = 1.0
+    masspole = 0.1
+    length = 0.5            # half the pole length
+    force_mag = 10.0
+    tau = 0.02              # integration timestep
+    x_threshold = 2.4
+    theta_threshold = 12 * 2 * jnp.pi / 360
+
+    def __init__(self, max_episode_steps: int = 500):
+        self.max_episode_steps = max_episode_steps
+
+    def reset(self, key):
+        vals = jax.random.uniform(key, (4,), jnp.float32, -0.05, 0.05)
+        state = CartPoleState(
+            x=vals[0], x_dot=vals[1], theta=vals[2], theta_dot=vals[3],
+            t=jnp.asarray(0, jnp.int32),
+        )
+        return state, self._obs(state)
+
+    def _obs(self, s: CartPoleState):
+        return jnp.stack([s.x, s.x_dot, s.theta, s.theta_dot])
+
+    def step(self, state: CartPoleState, action, key):
+        """One Euler step. ``action`` ∈ {0, 1}; ``key`` unused (deterministic
+        dynamics) but kept for a uniform env interface.
+
+        Returns ``(state', obs', reward, terminated, truncated)``.
+        """
+        del key
+        force = jnp.where(action == 1, self.force_mag, -self.force_mag)
+        cos_t, sin_t = jnp.cos(state.theta), jnp.sin(state.theta)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+
+        temp = (
+            force + polemass_length * state.theta_dot**2 * sin_t
+        ) / total_mass
+        theta_acc = (self.gravity * sin_t - cos_t * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * cos_t**2 / total_mass)
+        )
+        x_acc = temp - polemass_length * theta_acc * cos_t / total_mass
+
+        x = state.x + self.tau * state.x_dot
+        x_dot = state.x_dot + self.tau * x_acc
+        theta = state.theta + self.tau * state.theta_dot
+        theta_dot = state.theta_dot + self.tau * theta_acc
+        t = state.t + 1
+
+        new_state = CartPoleState(x, x_dot, theta, theta_dot, t)
+        terminated = jnp.logical_or(
+            jnp.abs(x) > self.x_threshold,
+            jnp.abs(theta) > self.theta_threshold,
+        )
+        truncated = jnp.logical_and(
+            t >= self.max_episode_steps, jnp.logical_not(terminated)
+        )
+        reward = jnp.asarray(1.0, jnp.float32)
+        return new_state, self._obs(new_state), reward, terminated, truncated

@@ -1,0 +1,53 @@
+"""Deterministic scripted environment for tests (SURVEY §4 "env fakes").
+
+A fixed-length chain: observation is a one-hot of the current position,
+reward equals ``position · reward_scale`` when action 1 is taken (else 0),
+the episode terminates after ``chain_len`` steps. Everything about a rollout
+against it (returns, advantages, episode packing) is computable by hand, so
+rollout/advantage tests need no simulator.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from trpo_tpu.models.policy import DiscreteSpec
+
+
+class FakeState(NamedTuple):
+    pos: jax.Array
+    t: jax.Array
+
+
+class FakeEnv:
+    def __init__(self, chain_len: int = 5, reward_scale: float = 1.0):
+        self.chain_len = chain_len
+        self.reward_scale = reward_scale
+        self.obs_shape = (chain_len,)
+        self.action_spec = DiscreteSpec(2)
+        self.max_episode_steps = chain_len
+
+    def reset(self, key):
+        del key
+        state = FakeState(
+            pos=jnp.asarray(0, jnp.int32), t=jnp.asarray(0, jnp.int32)
+        )
+        return state, self._obs(state)
+
+    def _obs(self, s: FakeState):
+        return jax.nn.one_hot(s.pos, self.chain_len, dtype=jnp.float32)
+
+    def step(self, state: FakeState, action, key):
+        del key
+        reward = jnp.where(
+            action == 1, state.pos * self.reward_scale, 0.0
+        ).astype(jnp.float32)
+        pos = jnp.minimum(state.pos + 1, self.chain_len - 1)
+        t = state.t + 1
+        new_state = FakeState(pos=pos, t=t)
+        terminated = t >= self.chain_len
+        truncated = jnp.asarray(False)
+        return new_state, self._obs(new_state), reward, terminated, truncated

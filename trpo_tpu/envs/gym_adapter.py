@@ -1,0 +1,103 @@
+"""Vectorized gymnasium adapter for host-side simulators (MuJoCo, Atari).
+
+The reference steps exactly one gym env from Python (``utils.py:18-45``).
+This adapter runs N envs (``BASELINE.json``: "8 vectorized envs"), exposes
+the auto-reset bookkeeping the device rollout needs (true pre-reset successor
+observations for truncation bootstrapping), and tracks episode returns /
+lengths the same way the device path does.
+
+gymnasium is an optional dependency: importing this module without it raises
+with a clear message, and env ids whose backends (mujoco, ale-py) are absent
+raise at construction — callers gate on availability (see
+``trpo_tpu.envs.make``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from trpo_tpu.models.policy import BoxSpec, DiscreteSpec
+
+__all__ = ["GymVecEnv"]
+
+
+class GymVecEnv:
+    """N synchronous gymnasium envs with explicit pre-reset final obs."""
+
+    def __init__(self, env_id: str, n_envs: int = 8, seed: int = 0, **kwargs):
+        try:
+            import gymnasium
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                "gymnasium is required for gym:* envs; use the pure-JAX envs "
+                "('cartpole', 'pendulum') otherwise"
+            ) from e
+        self._gym = gymnasium
+        self.env_id = env_id
+        self.n_envs = n_envs
+        self.envs = [gymnasium.make(env_id, **kwargs) for _ in range(n_envs)]
+        single = self.envs[0]
+        self.obs_shape = tuple(single.observation_space.shape)
+        space = single.action_space
+        if hasattr(space, "n"):
+            self.action_spec = DiscreteSpec(int(space.n))
+            self._continuous = False
+        else:
+            self.action_spec = BoxSpec(int(space.shape[0]))
+            self._continuous = True
+            self._act_low = np.asarray(space.low, np.float32)
+            self._act_high = np.asarray(space.high, np.float32)
+
+        self._obs = np.stack(
+            [env.reset(seed=seed + i)[0] for i, env in enumerate(self.envs)]
+        )
+        self.last_episode_returns = np.zeros(n_envs, np.float32)
+        self.last_episode_lengths = np.zeros(n_envs, np.int64)
+        self._running_returns = np.zeros(n_envs, np.float32)
+        self._running_lengths = np.zeros(n_envs, np.int64)
+
+    def host_step(self, actions: np.ndarray):
+        """Step all envs; auto-reset finished ones.
+
+        Returns ``(next_obs, rewards, terminated, truncated, final_obs)``
+        where ``final_obs`` is the TRUE successor observation (pre-reset) —
+        the quantity needed to bootstrap truncated episodes, which the
+        reference's rollout loses (``utils.py:44``).
+        """
+        n = self.n_envs
+        next_obs = np.empty_like(self._obs)
+        final_obs = np.empty_like(self._obs)
+        rewards = np.zeros(n, np.float32)
+        terminated = np.zeros(n, bool)
+        truncated = np.zeros(n, bool)
+
+        for i, env in enumerate(self.envs):
+            a = actions[i]
+            if self._continuous:
+                a = np.clip(a, self._act_low, self._act_high)
+            obs_i, r, term, trunc, _info = env.step(a)
+            rewards[i] = r
+            terminated[i] = term
+            truncated[i] = trunc
+            final_obs[i] = obs_i
+            if term or trunc:
+                obs_i, _ = env.reset()
+            next_obs[i] = obs_i
+
+        self._running_returns += rewards
+        self._running_lengths += 1
+        self.last_episode_returns = self._running_returns.copy()
+        self.last_episode_lengths = self._running_lengths.copy()
+        ended = np.logical_or(terminated, truncated)
+        self._running_returns[ended] = 0.0
+        self._running_lengths[ended] = 0
+
+        self._obs = next_obs
+        return next_obs, rewards, terminated, truncated, final_obs
+
+    def current_obs(self) -> np.ndarray:
+        return self._obs
+
+    def close(self):
+        for env in self.envs:
+            env.close()

@@ -1,0 +1,78 @@
+"""Pendulum swing-up as a pure-JAX environment (continuous actions).
+
+The classic underactuated pendulum task (gym/gymnasium ``Pendulum``): state
+(θ, θ̇), observation (cos θ, sin θ, θ̇), torque action clipped to ±2, cost
+``θ² + 0.1·θ̇² + 0.001·u²``. The BASELINE.json ladder's first continuous rung
+— exercises the diagonal-Gaussian policy head the reference lacks.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from trpo_tpu.models.policy import BoxSpec
+
+
+class PendulumState(NamedTuple):
+    theta: jax.Array
+    theta_dot: jax.Array
+    t: jax.Array
+
+
+def _angle_normalize(x):
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+class Pendulum:
+    obs_shape = (3,)
+    action_spec = BoxSpec(1)
+
+    max_speed = 8.0
+    max_torque = 2.0
+    dt = 0.05
+    g = 10.0
+    m = 1.0
+    l = 1.0
+
+    def __init__(self, max_episode_steps: int = 200):
+        self.max_episode_steps = max_episode_steps
+
+    def reset(self, key):
+        k1, k2 = jax.random.split(key)
+        theta = jax.random.uniform(k1, (), jnp.float32, -jnp.pi, jnp.pi)
+        theta_dot = jax.random.uniform(k2, (), jnp.float32, -1.0, 1.0)
+        state = PendulumState(theta, theta_dot, jnp.asarray(0, jnp.int32))
+        return state, self._obs(state)
+
+    def _obs(self, s: PendulumState):
+        return jnp.stack([jnp.cos(s.theta), jnp.sin(s.theta), s.theta_dot])
+
+    def step(self, state: PendulumState, action, key):
+        del key
+        u = jnp.clip(
+            jnp.reshape(action, ()), -self.max_torque, self.max_torque
+        )
+        th = _angle_normalize(state.theta)
+        cost = th**2 + 0.1 * state.theta_dot**2 + 0.001 * u**2
+
+        new_theta_dot = state.theta_dot + (
+            3.0 * self.g / (2.0 * self.l) * jnp.sin(state.theta)
+            + 3.0 / (self.m * self.l**2) * u
+        ) * self.dt
+        new_theta_dot = jnp.clip(new_theta_dot, -self.max_speed, self.max_speed)
+        new_theta = state.theta + new_theta_dot * self.dt
+        t = state.t + 1
+
+        new_state = PendulumState(new_theta, new_theta_dot, t)
+        terminated = jnp.asarray(False)
+        truncated = t >= self.max_episode_steps
+        return (
+            new_state,
+            self._obs(new_state),
+            -cost.astype(jnp.float32),
+            terminated,
+            truncated,
+        )

@@ -11,6 +11,7 @@ parameterization required by BASELINE.json.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -102,7 +103,7 @@ def make_policy(
                 params["head"], feats, activation, compute_dtype
             )
     else:
-        obs_dim = int(jnp.prod(jnp.asarray(obs_shape)))
+        obs_dim = math.prod(obs_shape)
 
         def init(key):
             k_net, _ = jax.random.split(key)

@@ -11,6 +11,7 @@ from trpo_tpu.ops.returns import (  # noqa: F401
     discount,
     discounted_returns_segmented,
     gae_advantages,
+    gae_from_next_values,
 )
 from trpo_tpu.ops.cg import conjugate_gradient  # noqa: F401
 from trpo_tpu.ops.linesearch import backtracking_linesearch  # noqa: F401

@@ -67,6 +67,34 @@ def discounted_returns_segmented(
     return _reverse_affine_scan(gammas, rewards)
 
 
+def gae_from_next_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    next_values: jax.Array,
+    terminated: jax.Array,
+    done: jax.Array,
+    gamma: float,
+    lam: float,
+) -> tuple[jax.Array, jax.Array]:
+    """GAE(λ) with explicit per-step successor values and a split
+    terminated/done mask — the general form for packed vectorized rollouts.
+
+    ``terminated`` marks true terminal states (no bootstrap: the TD target
+    drops ``γ·V(s')``); ``done`` marks every episode boundary including
+    time-limit truncations (the λ-accumulation cut). A truncated step thus
+    still bootstraps through ``next_values`` — the fix for the reference's
+    lost-final-state rollout bug (``utils.py:44``, SURVEY §7 "hard parts").
+
+    Returns ``(advantages, value_targets)``, both shaped like ``rewards``.
+    """
+    rewards = jnp.asarray(rewards)
+    terminated = jnp.asarray(terminated).astype(rewards.dtype)
+    done = jnp.asarray(done).astype(rewards.dtype)
+    deltas = rewards + gamma * (1.0 - terminated) * next_values - values
+    adv = _reverse_affine_scan(gamma * lam * (1.0 - done), deltas)
+    return adv, adv + values
+
+
 def gae_advantages(
     rewards: jax.Array,
     values: jax.Array,
@@ -74,22 +102,26 @@ def gae_advantages(
     last_values: jax.Array,
     gamma: float,
     lam: float,
+    terminated: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """GAE(λ) advantages and value targets over ``(T, N)`` tensors.
 
-    ``last_values``: ``(N,)`` bootstrap values for the state after step T-1
-    (used only where the final step was a truncation, not a terminal). With
-    ``lam=1`` and a zero baseline this reduces to the reference's plain
-    discounted-returns advantage (``trpo_inksci.py:104-105``); the explicit
-    truncation bootstrap fixes the reference's non-terminating-episode rollout
-    bug (``utils.py:44``, SURVEY §7 "hard parts").
+    Convenience form of :func:`gae_from_next_values` deriving successor
+    values from ``values`` shifted one step, with ``last_values`` (``(N,)``)
+    bootstrapping the state after step T-1. ``terminated`` defaults to
+    ``dones`` (every boundary treated as terminal — correct when no
+    mid-batch truncations exist); pass it separately when packing truncated
+    episodes. With ``lam=1`` and a zero baseline this reduces to the
+    reference's plain discounted-returns advantage
+    (``trpo_inksci.py:104-105``).
 
     Returns ``(advantages, value_targets)``, both ``(T, N)``.
     """
     rewards = jnp.asarray(rewards)
     dones = jnp.asarray(dones).astype(rewards.dtype)
+    if terminated is None:
+        terminated = dones
     next_values = jnp.concatenate([values[1:], last_values[None]], axis=0)
-    nonterminal = 1.0 - dones
-    deltas = rewards + gamma * nonterminal * next_values - values
-    adv = _reverse_affine_scan(gamma * lam * nonterminal, deltas)
-    return adv, adv + values
+    return gae_from_next_values(
+        rewards, values, next_values, terminated, dones, gamma, lam
+    )

@@ -1,0 +1,201 @@
+"""Rollout collection.
+
+The reference's ``rollout`` (``utils.py:18-45``) is a serial host loop —
+one ``sess.run`` per environment step, one env, ragged path dicts, and a
+latent stale-``path`` bug for non-terminating episodes (``utils.py:44``).
+Here the device path is a ``lax.scan`` over time of a ``vmap``-batched
+env+policy step with in-graph auto-reset: fixed ``(T, N)`` tensors, zero
+host dispatch, episodes packed contiguously with explicit
+``terminated``/``done`` flags (truncation bootstraps through the critic —
+the bug fix SURVEY §7 prescribes).
+
+For host-side simulators (MuJoCo/Atari via gymnasium) the same trajectory
+layout is produced by :func:`host_rollout`, with policy inference batched
+over the vectorized envs — one device call per *timestep across all envs*
+rather than per step of one env.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trpo_tpu.models.policy import Policy
+
+__all__ = ["Trajectory", "device_rollout", "init_env_states", "host_rollout"]
+
+
+class Trajectory(NamedTuple):
+    """Fixed-shape ``(T, N, ...)`` rollout tensors (time-major)."""
+    obs: jax.Array          # (T, N, *obs_shape) — s_t
+    actions: jax.Array      # (T, N) or (T, N, D)
+    rewards: jax.Array      # (T, N)
+    terminated: jax.Array   # (T, N) — env reached a terminal state at t
+    done: jax.Array         # (T, N) — terminated OR truncated (episode ends)
+    old_dist: Any           # dist params pytree (T, N, ...)
+    next_obs: jax.Array     # (T, N, *obs_shape) — s_{t+1} BEFORE auto-reset
+    episode_return: jax.Array  # (T, N) — running return, valid where done
+    episode_length: jax.Array  # (T, N) — running length, valid where done
+
+
+def init_env_states(env, key, n_envs: int):
+    """Reset ``n_envs`` device envs; returns ``(states, obs)`` batched."""
+    keys = jax.random.split(key, n_envs)
+    states, obs = jax.vmap(env.reset)(keys)
+    return states, obs
+
+
+def device_rollout(
+    env,
+    policy: Policy,
+    params,
+    carry,
+    key,
+    n_steps: int,
+):
+    """Collect ``n_steps × n_envs`` transitions fully on-device.
+
+    ``carry`` is ``(env_states, obs, episode_return, episode_length)`` from
+    :func:`init_env_states` / a previous call — env state persists across
+    training iterations so episodes continue rather than restarting every
+    batch (the reference restarts its env every batch, discarding progress
+    mid-episode — see ``utils.py:22-26``).
+
+    Jit-safe: designed to be traced inside the full training-step program.
+    Returns ``(new_carry, Trajectory)``.
+    """
+    env_states, obs0, ep_ret0, ep_len0 = carry
+
+    def step_fn(c, step_key):
+        states, obs, ep_ret, ep_len = c
+        k_act, k_step, k_reset = jax.random.split(step_key, 3)
+        n = obs.shape[0]
+
+        dist = policy.apply(params, obs)
+        actions = policy.dist.sample(k_act, dist)
+
+        step_keys = jax.random.split(k_step, n)
+        new_states, next_obs, rewards, terminated, truncated = jax.vmap(
+            env.step
+        )(states, actions, step_keys)
+        done = jnp.logical_or(terminated, truncated)
+
+        ep_ret = ep_ret + rewards
+        ep_len = ep_len + 1
+
+        # In-graph auto-reset for finished episodes.
+        reset_keys = jax.random.split(k_reset, n)
+        reset_states, reset_obs = jax.vmap(env.reset)(reset_keys)
+        sel = lambda d, a, b: jnp.where(
+            d.reshape((-1,) + (1,) * (a.ndim - 1)), a, b
+        )
+        carried_states = jax.tree_util.tree_map(
+            lambda r, s: sel(done, r, s), reset_states, new_states
+        )
+        carried_obs = sel(done, reset_obs, next_obs)
+
+        out = Trajectory(
+            obs=obs,
+            actions=actions,
+            rewards=rewards,
+            terminated=terminated,
+            done=done,
+            old_dist=dist,
+            next_obs=next_obs,
+            episode_return=ep_ret,
+            episode_length=ep_len,
+        )
+        ep_ret = jnp.where(done, 0.0, ep_ret)
+        ep_len = jnp.where(done, 0, ep_len)
+        return (carried_states, carried_obs, ep_ret, ep_len), out
+
+    step_keys = jax.random.split(key, n_steps)
+    new_carry, traj = jax.lax.scan(
+        step_fn, (env_states, obs0, ep_ret0, ep_len0), step_keys
+    )
+    return new_carry, traj
+
+
+def init_carry(env, key, n_envs: int):
+    """Full rollout carry: env states + obs + episode accumulators."""
+    states, obs = init_env_states(env, key, n_envs)
+    return (
+        states,
+        obs,
+        jnp.zeros(n_envs, jnp.float32),
+        jnp.zeros(n_envs, jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-simulator path (gymnasium)
+# ---------------------------------------------------------------------------
+
+
+def host_rollout(
+    vec_env,
+    policy: Policy,
+    params,
+    key,
+    n_steps: int,
+    act_fn=None,
+) -> Trajectory:
+    """Collect a ``(T, N)`` trajectory from a host vectorized env.
+
+    ``vec_env`` is a :class:`trpo_tpu.envs.gym_adapter.GymVecEnv`. Policy
+    inference is jitted and batched over the N envs (``act_fn`` may be a
+    pre-jitted ``(params, obs, key) -> (actions, dist)`` to reuse across
+    calls). The env boundary is the only host↔device traffic: one transfer
+    per timestep for all envs, vs the reference's per-env-step ``sess.run``
+    (``trpo_inksci.py:78``).
+    """
+    if act_fn is None:
+        act_fn = jax.jit(
+            lambda p, o, k: (
+                lambda d: (policy.dist.sample(k, d), d)
+            )(policy.apply(p, o))
+        )
+
+    obs = vec_env.current_obs()
+    T, N = n_steps, vec_env.n_envs
+    obs_buf, act_buf, rew_buf = [], [], []
+    term_buf, done_buf, dist_buf, next_obs_buf = [], [], [], []
+    ret_buf, len_buf = [], []
+
+    for t in range(T):
+        key, k_act = jax.random.split(key)
+        actions, dist = act_fn(params, jnp.asarray(obs), k_act)
+        actions_np = np.asarray(actions)
+        next_obs, rewards, terminated, truncated, final_obs = vec_env.host_step(
+            actions_np
+        )
+        obs_buf.append(np.asarray(obs))
+        act_buf.append(actions_np)
+        rew_buf.append(rewards)
+        term_buf.append(terminated)
+        done_buf.append(np.logical_or(terminated, truncated))
+        dist_buf.append(jax.tree_util.tree_map(np.asarray, dist))
+        # next_obs pre-reset: where an episode ended, the true successor
+        # state is final_obs (gymnasium autoresets under us).
+        next_obs_buf.append(final_obs)
+        ret_buf.append(vec_env.last_episode_returns.copy())
+        len_buf.append(vec_env.last_episode_lengths.copy())
+        obs = next_obs
+
+    stack = lambda xs: jnp.asarray(np.stack(xs))
+    return Trajectory(
+        obs=stack(obs_buf),
+        actions=stack(act_buf),
+        rewards=stack(rew_buf).astype(jnp.float32),
+        terminated=stack(term_buf),
+        done=stack(done_buf),
+        old_dist=jax.tree_util.tree_map(
+            lambda *xs: jnp.asarray(np.stack(xs)), *dist_buf
+        ),
+        next_obs=stack(next_obs_buf),
+        episode_return=stack(ret_buf).astype(jnp.float32),
+        episode_length=stack(len_buf),
+    )

@@ -1,0 +1,132 @@
+"""Training entry point + CLI.
+
+The reference's "CLI" is three module-level statements with every knob
+hard-coded (``trpo_inksci.py:179-181`` — importing the file IS running the
+experiment, no ``__main__`` guard, no flags). Here: argparse over the full
+:class:`TRPOConfig`, named presets for the BASELINE.json ladder, explicit
+seeding, JSONL logging, checkpoint/resume.
+
+Usage::
+
+    python -m trpo_tpu.train --preset cartpole --reward-target 550
+    python -m trpo_tpu.train --preset pendulum --iterations 100 --platform cpu
+    python -m trpo_tpu.train --preset cartpole --checkpoint-dir /tmp/ck --resume
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Sequence
+
+from trpo_tpu.config import PRESETS, TRPOConfig, get_preset
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="trpo_tpu.train",
+        description="TPU-native TRPO training",
+    )
+    p.add_argument(
+        "--preset",
+        default="cartpole",
+        choices=sorted(PRESETS),
+        help="config ladder rung (BASELINE.json)",
+    )
+    p.add_argument("--env", help="override env name (e.g. gym:Humanoid-v4)")
+    p.add_argument("--iterations", type=int, help="training iterations")
+    p.add_argument("--seed", type=int)
+    p.add_argument("--n-envs", type=int)
+    p.add_argument("--batch-timesteps", type=int)
+    p.add_argument("--max-kl", type=float)
+    p.add_argument("--cg-iters", type=int)
+    p.add_argument("--cg-damping", type=float)
+    p.add_argument("--gamma", type=float)
+    p.add_argument("--lam", type=float)
+    p.add_argument("--reward-target", type=float)
+    p.add_argument("--log-jsonl", help="append per-iteration stats here")
+    p.add_argument("--checkpoint-dir")
+    p.add_argument("--checkpoint-every", type=int)
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="resume from the latest checkpoint in --checkpoint-dir",
+    )
+    p.add_argument(
+        "--platform",
+        choices=("tpu", "cpu"),
+        help="force a JAX platform (default: environment's)",
+    )
+    p.add_argument(
+        "--debug-nans", action="store_true", help="enable jax NaN checking"
+    )
+    return p
+
+
+_OVERRIDES = {
+    "env": "env",
+    "iterations": "n_iterations",
+    "seed": "seed",
+    "n_envs": "n_envs",
+    "batch_timesteps": "batch_timesteps",
+    "max_kl": "max_kl",
+    "cg_iters": "cg_iters",
+    "cg_damping": "cg_damping",
+    "gamma": "gamma",
+    "lam": "lam",
+    "reward_target": "reward_target",
+    "log_jsonl": "log_jsonl",
+    "checkpoint_dir": "checkpoint_dir",
+    "checkpoint_every": "checkpoint_every",
+    "debug_nans": "debug_nans",
+}
+
+
+def config_from_args(args: argparse.Namespace) -> TRPOConfig:
+    cfg = get_preset(args.preset)
+    updates = {}
+    for arg_name, cfg_name in _OVERRIDES.items():
+        val = getattr(args, arg_name, None)
+        if val is not None and val is not False:
+            updates[cfg_name] = val
+    return dataclasses.replace(cfg, **updates)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.utils.metrics import StatsLogger
+
+    cfg = config_from_args(args)
+    agent = TRPOAgent(cfg.env, cfg)
+
+    checkpointer = None
+    state = None
+    if cfg.checkpoint_dir:
+        from trpo_tpu.utils.checkpoint import Checkpointer
+
+        checkpointer = Checkpointer(cfg.checkpoint_dir)
+        if args.resume and checkpointer.latest_step() is not None:
+            state = checkpointer.restore(agent.init_state())
+            print(f"resumed from step {checkpointer.latest_step()}")
+
+    logger = StatsLogger(jsonl_path=cfg.log_jsonl)
+    final = agent.learn(
+        state=state, logger=logger, checkpointer=checkpointer
+    )
+    print(
+        f"done: {int(final.iteration)} iterations, "
+        f"{int(final.total_timesteps)} timesteps, "
+        f"{int(final.total_episodes)} episodes"
+    )
+    logger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

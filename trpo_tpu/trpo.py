@@ -141,11 +141,21 @@ def make_trpo_update(
         flat_new = jnp.where(rollback, flat0, ls.x)
 
         new_params = unravel(flat_new)
+        # All post-update stats from ONE forward pass at the final params
+        # (the reference re-runs the graph per fetched loss,
+        # trpo_inksci.py:156).
         final_dist = policy.apply(new_params, batch.obs)
+        logp_new = policy.dist.logp(final_dist, batch.actions)
+        logp_old = policy.dist.logp(batch.old_dist, batch.actions)
+        surr_after = -_wmean(
+            jnp.exp(logp_new - logp_old) * batch.advantages, batch.weight
+        )
         stats = TRPOStats(
             surrogate_before=surr_before,
-            surrogate_after=surrogate_loss(policy, new_params, batch),
-            kl=kl_to_old_fn(flat_new),
+            surrogate_after=surr_after,
+            kl=_wmean(
+                policy.dist.kl(batch.old_dist, final_dist), batch.weight
+            ),
             entropy=_wmean(policy.dist.entropy(final_dist), batch.weight),
             grad_norm=grad_norm,
             step_norm=jnp.linalg.norm(flat_new - flat0),

@@ -1,0 +1,7 @@
+"""Observability, checkpointing, and misc utilities."""
+
+from trpo_tpu.utils.metrics import (  # noqa: F401
+    explained_variance,
+    StatsLogger,
+)
+from trpo_tpu.utils.timers import PhaseTimer  # noqa: F401

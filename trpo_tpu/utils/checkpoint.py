@@ -1,0 +1,58 @@
+"""Checkpoint / resume (SURVEY §5: absent in the reference — its only
+"snapshot" is the in-memory flat-param vector used for KL rollback,
+``trpo_inksci.py:144,158`` — here a first-class subsystem).
+
+Orbax checkpoints of the full :class:`trpo_tpu.agent.TrainState` (policy +
+critic + optimizer + env carry + RNG + counters), so a resumed run continues
+exactly where it stopped, including mid-episode env states.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["Checkpointer"]
+
+
+class Checkpointer:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def save(self, step: int, state) -> None:
+        self.manager.save(
+            step, args=self._ocp.args.StandardSave(state)
+        )
+        self.manager.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(self, template, step: Optional[int] = None):
+        """Restore into the structure of ``template`` (an abstract or
+        concrete TrainState from ``agent.init_state()``)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, "shape")
+            else x,
+            template,
+        )
+        return self.manager.restore(
+            step, args=self._ocp.args.StandardRestore(abstract)
+        )
+
+    def close(self):
+        self.manager.close()

@@ -1,0 +1,90 @@
+"""Metrics and structured logging.
+
+The reference's observability is a right-padded printed stats dict with
+seven entries — total episodes, mean reward, entropy, baseline explained
+variance, elapsed time, KL (old|new), surrogate loss
+(``trpo_inksci.py:160-171``) — plus an unused ``logging`` import. This
+module keeps those seven stats (parity), adds the SURVEY §5 obligations
+(CG-solve timing as a first-class stat, JSONL structured output), and
+implements ``explained_variance`` (ref ``utils.py:208-211``) as a
+jit-friendly function.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO, Optional
+
+import jax.numpy as jnp
+
+__all__ = ["explained_variance", "StatsLogger"]
+
+
+def explained_variance(ypred, y, weight=None):
+    """``1 − Var(y − ŷ)/Var(y)`` (ref ``utils.py:208-211``).
+
+    Jit-traceable; returns NaN when Var(y)=0 (the reference guards with an
+    ``isnan`` check host-side — callers here should use ``jnp.nan_to_num``
+    or check, same contract).
+    """
+    ypred = jnp.asarray(ypred, jnp.float32).reshape(-1)
+    y = jnp.asarray(y, jnp.float32).reshape(-1)
+    if weight is None:
+        weight = jnp.ones_like(y)
+    weight = jnp.asarray(weight, jnp.float32).reshape(-1)
+    wsum = jnp.maximum(jnp.sum(weight), 1.0)
+
+    def wvar(v):
+        m = jnp.sum(v * weight) / wsum
+        return jnp.sum((v - m) ** 2 * weight) / wsum
+
+    return 1.0 - wvar(y - ypred) / wvar(y)
+
+
+class StatsLogger:
+    """Aligned console stats + optional JSONL stream.
+
+    Console format mirrors the reference's padded two-column print
+    (``trpo_inksci.py:168-171``); every ``log`` call also appends one JSON
+    object per iteration to ``jsonl_path`` when configured (SURVEY §5
+    "structured metrics to stdout + JSONL").
+    """
+
+    def __init__(
+        self,
+        jsonl_path: Optional[str] = None,
+        stream: IO = sys.stdout,
+    ):
+        self.stream = stream
+        self._jsonl: Optional[IO] = (
+            open(jsonl_path, "a") if jsonl_path else None
+        )
+        self.start_time = time.time()
+
+    def log(self, iteration: int, stats: dict):
+        print(
+            f"\n-------- Iteration {iteration} ----------",
+            file=self.stream,
+        )
+        for k, v in stats.items():
+            if isinstance(v, float):
+                v = f"{v:.6g}"
+            print(f"{str(k):<40} {v}", file=self.stream)
+        if self._jsonl is not None:
+            rec = {"iteration": iteration}
+            for k, v in stats.items():
+                rec[k] = v
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+
+    def elapsed_minutes(self) -> float:
+        """"Time elapsed" stat, in minutes like the reference
+        (``trpo_inksci.py:167``)."""
+        return (time.time() - self.start_time) / 60.0
+
+    def close(self):
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None

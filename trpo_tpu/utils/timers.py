@@ -1,0 +1,63 @@
+"""Phase timers (SURVEY §5 tracing/profiling obligation).
+
+The reference records only total wall-clock ("Time elapsed",
+``trpo_inksci.py:89,167``). ``PhaseTimer`` gives per-phase cumulative and
+per-call timings around rollout / CG-solve / update, and can emit
+``jax.profiler`` trace annotations so phases show up named in TPU profiles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+__all__ = ["PhaseTimer"]
+
+
+class PhaseTimer:
+    def __init__(self, use_jax_profiler: bool = False):
+        self.totals = defaultdict(float)
+        self.counts = defaultdict(int)
+        self.last = {}
+        self.use_jax_profiler = use_jax_profiler
+
+    @contextlib.contextmanager
+    def phase(self, name: str, block_on=None):
+        """Time a phase. Pass ``block_on`` (any jax pytree) to block until
+        its computation is done — without it, async dispatch makes device
+        phases look free."""
+        ctx = (
+            jax.profiler.TraceAnnotation(name)
+            if self.use_jax_profiler
+            else contextlib.nullcontext()
+        )
+        start = time.perf_counter()
+        with ctx:
+            yield
+            if block_on is not None:
+                jax.block_until_ready(block_on)
+        dt = time.perf_counter() - start
+        self.totals[name] += dt
+        self.counts[name] += 1
+        self.last[name] = dt
+
+    def last_ms(self, name: str) -> float:
+        return self.last.get(name, 0.0) * 1e3
+
+    def mean_ms(self, name: str) -> float:
+        if not self.counts[name]:
+            return 0.0
+        return self.totals[name] / self.counts[name] * 1e3
+
+    def summary(self) -> dict:
+        return {
+            name: {
+                "mean_ms": self.mean_ms(name),
+                "total_s": self.totals[name],
+                "calls": self.counts[name],
+            }
+            for name in self.totals
+        }
