@@ -1,0 +1,155 @@
+"""Mesh parallelism on the 8-device virtual CPU mesh (SURVEY §4
+"distributed-without-a-cluster"): sharded programs must equal their
+single-device counterparts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.models import make_policy, DiscreteSpec
+from trpo_tpu.ops import conjugate_gradient, flatten_params, make_fvp
+from trpo_tpu.parallel import (
+    make_mesh,
+    make_sharded_fvp,
+    make_sharded_update,
+    shard_batch,
+)
+from trpo_tpu.parallel.sharded import pad_batch
+from trpo_tpu.trpo import TRPOBatch, make_trpo_update, standardize_advantages
+
+
+def setup(n=240, obs_dim=4, n_act=3, seed=0):
+    policy = make_policy((obs_dim,), DiscreteSpec(n_act), hidden=(16,))
+    params = policy.init(jax.random.key(seed))
+    k1, k2, k3 = jax.random.split(jax.random.key(seed + 1), 3)
+    obs = jax.random.normal(k1, (n, obs_dim))
+    dist = policy.apply(params, obs)
+    actions = policy.dist.sample(k2, dist)
+    w = jnp.ones(n)
+    adv = standardize_advantages(jax.random.normal(k3, (n,)), w)
+    batch = TRPOBatch(obs, actions, adv, jax.lax.stop_gradient(dist), w)
+    return policy, params, batch
+
+
+def test_mesh_has_8_devices():
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("data",)
+
+
+def test_make_mesh_validates():
+    with pytest.raises(ValueError):
+        make_mesh(shape=(16,), axes=("data",))  # over-subscription
+    with pytest.raises(ValueError):
+        make_mesh(shape=(4, 2), axes=("data",))  # rank mismatch
+    # A deliberately sub-sized mesh takes the first N devices.
+    mesh3 = make_mesh(shape=(3,), axes=("data",))
+    assert mesh3.devices.size == 3
+    mesh2d = make_mesh(shape=(4, 2), axes=("data", "model"))
+    assert mesh2d.shape == {"data": 4, "model": 2}
+
+
+def test_pad_batch_weights_zero():
+    _, _, batch = setup(n=10)
+    padded = pad_batch(batch, 8)
+    assert padded.weight.shape[0] == 16
+    assert float(jnp.sum(padded.weight)) == 10.0
+
+
+def test_sharded_fvp_equals_single_device():
+    policy, params, batch = setup()
+    cfg = TRPOConfig(cg_damping=0.1)
+    mesh = make_mesh()
+
+    flat0, unravel = flatten_params(params)
+    cur = jax.lax.stop_gradient(policy.apply(params, batch.obs))
+
+    def kl_fn(flat):
+        dist = policy.apply(unravel(flat), batch.obs)
+        return jnp.sum(policy.dist.kl(cur, dist) * batch.weight) / jnp.sum(
+            batch.weight
+        )
+
+    single_fvp = make_fvp(kl_fn, jnp.asarray(flat0, jnp.float32), 0.1)
+    sharded_fvp = make_sharded_fvp(policy, cfg, mesh)
+
+    sbatch = shard_batch(mesh, batch)
+    v = jax.random.normal(jax.random.key(9), flat0.shape)
+    got = np.asarray(sharded_fvp(params, sbatch, v))
+    want = np.asarray(single_fvp(jnp.asarray(v, jnp.float32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+
+
+def test_sharded_fvp_uneven_batch():
+    # 250 % 8 != 0: zero-weight padding must leave the FVP exact.
+    policy, params, batch = setup(n=250)
+    cfg = TRPOConfig(cg_damping=0.05)
+    mesh = make_mesh()
+    flat0, unravel = flatten_params(params)
+    cur = jax.lax.stop_gradient(policy.apply(params, batch.obs))
+
+    def kl_fn(flat):
+        dist = policy.apply(unravel(flat), batch.obs)
+        return jnp.mean(policy.dist.kl(cur, dist))
+
+    single_fvp = make_fvp(kl_fn, jnp.asarray(flat0, jnp.float32), 0.05)
+    sharded_fvp = make_sharded_fvp(policy, cfg, mesh)
+    sbatch = shard_batch(mesh, batch)
+    v = jnp.ones(flat0.shape[0])
+    np.testing.assert_allclose(
+        np.asarray(sharded_fvp(params, sbatch, v)),
+        np.asarray(single_fvp(v)),
+        rtol=2e-4,
+        atol=1e-5,
+    )
+
+
+def test_sharded_update_equals_single_device():
+    policy, params, batch = setup()
+    cfg = TRPOConfig()
+    mesh = make_mesh()
+
+    single = make_trpo_update(policy, cfg)
+    p_single, s_single = single(params, batch)
+
+    sharded = make_sharded_update(policy, cfg, mesh)
+    sbatch = shard_batch(mesh, batch)
+    p_shard, s_shard = sharded(params, sbatch)
+
+    f1 = jax.flatten_util.ravel_pytree(p_single)[0]
+    f2 = jax.flatten_util.ravel_pytree(p_shard)[0]
+    np.testing.assert_allclose(
+        np.asarray(f1), np.asarray(f2), rtol=1e-4, atol=1e-5
+    )
+    assert abs(float(s_single.kl) - float(s_shard.kl)) < 1e-5
+    assert bool(s_single.linesearch_success) == bool(s_shard.linesearch_success)
+
+
+def test_sharded_cg_solve_end_to_end():
+    # CG over the sharded FVP operator inside one jit — the north-star
+    # program shape — must match CG over the single-device operator.
+    policy, params, batch = setup()
+    cfg = TRPOConfig()
+    mesh = make_mesh()
+    flat0, unravel = flatten_params(params)
+    cur = jax.lax.stop_gradient(policy.apply(params, batch.obs))
+
+    def kl_fn(flat):
+        dist = policy.apply(unravel(flat), batch.obs)
+        return jnp.mean(policy.dist.kl(cur, dist))
+
+    b = jax.random.normal(jax.random.key(4), flat0.shape)
+
+    single_fvp = make_fvp(kl_fn, jnp.asarray(flat0, jnp.float32), 0.1)
+    x_single = conjugate_gradient(single_fvp, b).x
+
+    sharded_fvp = make_sharded_fvp(policy, cfg, mesh)
+    sbatch = shard_batch(mesh, batch)
+    x_shard = conjugate_gradient(
+        lambda v: sharded_fvp(params, sbatch, v), b
+    ).x
+    np.testing.assert_allclose(
+        np.asarray(x_shard), np.asarray(x_single), rtol=5e-3, atol=1e-4
+    )

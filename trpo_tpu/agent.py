@@ -98,6 +98,21 @@ class TRPOAgent:
         # (ref batch budget semantics, trpo_inksci.py:17 + utils.py:21).
         self.n_steps = max(1, -(-cfg.batch_timesteps // cfg.n_envs))
 
+        # Data-parallel mesh: env states and rollout tensors shard over
+        # "data"; params replicate; XLA inserts the psum reductions
+        # (SURVEY §2.4 build obligation). None → single-device placement.
+        self.mesh = None
+        if cfg.mesh_shape is not None:
+            from trpo_tpu.parallel import make_mesh
+
+            self.mesh = make_mesh(tuple(cfg.mesh_shape), tuple(cfg.mesh_axes))
+            dp = self.mesh.shape[cfg.mesh_axes[0]]
+            if cfg.n_envs % dp != 0:
+                raise ValueError(
+                    f"n_envs={cfg.n_envs} must divide evenly over the "
+                    f"{cfg.mesh_axes[0]}={dp} mesh axis"
+                )
+
         self._process_fn = jax.jit(self._process_trajectory)
         if self.is_device_env:
             self._iter_fn = jax.jit(self._device_iteration)
@@ -118,6 +133,15 @@ class TRPOAgent:
             if self.is_device_env
             else None
         )
+        if env_carry is not None and self.mesh is not None:
+            # Shard every env-carry leaf over its leading (env) axis; the
+            # jitted iteration then computes shard-local rollouts and XLA
+            # reduces the update over the mesh ("computation follows data").
+            from trpo_tpu.parallel import shard_leading_axis
+
+            env_carry = shard_leading_axis(
+                self.mesh, env_carry, self.cfg.mesh_axes[0]
+            )
         return TrainState(
             policy_params=self.policy.init(k_policy),
             vf_state=self.vf.init(k_vf),
@@ -297,6 +321,15 @@ class TRPOAgent:
             self.n_steps,
             act_fn=getattr(self, "_host_act_fn", None) or self._make_host_act(),
         )
+        if self.mesh is not None:
+            # Shard the (T, N, ...) trajectory over its env axis — the same
+            # layout the device path's sharded rollout produces, so the
+            # jitted processing runs data-parallel for host sims too.
+            from trpo_tpu.parallel import shard_leading_axis
+
+            traj = shard_leading_axis(
+                self.mesh, traj, self.cfg.mesh_axes[0], dim=1
+            )
         return self._process_fn(train_state, traj)
 
     def _make_host_act(self):

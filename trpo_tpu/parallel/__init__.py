@@ -1,0 +1,25 @@
+"""Mesh parallelism and the distributed communication layer.
+
+The reference has NO distributed layer: single process, single
+``tf.Session``, serial single-env rollout, and its only "communication
+backend" is ``feed_dict`` marshaling at every ``sess.run`` (SURVEY §2.4).
+The TPU-native equivalent is single-program SPMD: a ``jax.sharding.Mesh``
+over the chips, batch/env-state arrays sharded over the ``"data"`` axis, and
+XLA emitting the ICI collectives (``psum`` for the FVP/gradient reductions)
+from sharding annotations — there is no NCCL/MPI code to write, by design.
+
+- ``mesh.py``    — mesh construction + multi-host (DCN) initialization
+- ``sharded.py`` — sharded TRPO update / full iteration; explicit
+  ``shard_map``+``psum`` Fisher-vector product
+"""
+
+from trpo_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    initialize_distributed,
+)
+from trpo_tpu.parallel.sharded import (  # noqa: F401
+    shard_batch,
+    shard_leading_axis,
+    make_sharded_update,
+    make_sharded_fvp,
+)

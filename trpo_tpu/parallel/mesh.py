@@ -1,0 +1,69 @@
+"""Device-mesh construction and multi-host initialization.
+
+Single-host: a 1-D ``("data",)`` mesh over the local chips is the right
+shape for TRPO — the batch axis is the only large axis (SURVEY §2.4: the
+64-wide MLPs leave nothing worth tensor-sharding). Multi-host (DCN) scaling
+uses the standard ``jax.distributed`` service; after initialization the same
+mesh code sees the global device set and the same sharded programs run
+unchanged — collectives ride ICI within a slice and DCN across hosts, all
+emitted by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "initialize_distributed"]
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axes: Tuple[str, ...] = ("data",),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``Mesh`` over ``devices`` (default: all local devices).
+
+    ``shape=None`` → 1-D mesh over every device with the first axis name.
+    A multi-axis ``shape`` must multiply out to the device count, e.g.
+    ``shape=(4, 2), axes=("data", "model")``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),)
+        axes = axes[:1]
+    if len(shape) != len(axes):
+        raise ValueError(f"shape {shape} and axes {axes} rank mismatch")
+    total = int(np.prod(shape))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {total} devices, have {len(devices)}"
+        )
+    # An explicit smaller shape takes the first `total` devices — a
+    # deliberately sub-sized mesh (e.g. dryruns, partial-slice experiments)
+    # is valid; only over-subscription is an error.
+    dev_array = np.asarray(devices[:total]).reshape(shape)
+    return Mesh(dev_array, axes)
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join a multi-host JAX cluster (DCN layer).
+
+    Thin wrapper over ``jax.distributed.initialize`` so the framework has an
+    explicit, documented entry point for multi-host runs; with no arguments
+    it uses the TPU environment's auto-detection. Call once per process
+    before any device computation; after it, ``jax.devices()`` is global and
+    :func:`make_mesh` builds a cluster-wide mesh.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,189 @@
+"""Mesh-sharded TRPO: batch-parallel update and explicit-collective FVP.
+
+Two complementary formulations of the same data-parallel math:
+
+1. :func:`make_sharded_update` — GSPMD. The fused update from
+   ``trpo_tpu.trpo`` is jitted with the batch sharded over the ``"data"``
+   axis and params replicated; XLA propagates shardings through grad / CG /
+   linesearch and inserts ``psum`` reductions (over ICI) wherever the
+   program reduces over the batch — exactly the collectives one would write
+   by hand, derived from annotations.
+
+2. :func:`make_sharded_fvp` — explicit ``shard_map``. The Fisher-vector
+   product written with a hand-placed ``psum``: each shard computes its
+   local ``jvp∘grad`` over its batch slice, then the flat vectors are
+   mean-reduced across the mesh. This is the spelled-out version of what
+   GSPMD derives, kept (a) as an executable specification for tests —
+   sharded FVP must equal single-device FVP (SURVEY §4
+   "distributed-without-a-cluster") — and (b) as the hook point for a
+   future Pallas latency-hiding variant.
+
+The weighted sum/sum structure of every reduction in ``trpo_tpu.trpo``
+(``_wmean``) makes the batch-sharded means exact — no shard-size bias when
+``B % n_devices != 0`` padding carries zero weights.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.models.policy import Policy
+from trpo_tpu.trpo import TRPOBatch, TRPOStats, make_trpo_update
+
+__all__ = [
+    "shard_batch",
+    "shard_leading_axis",
+    "make_sharded_update",
+    "make_sharded_fvp",
+]
+
+
+def _batch_spec(batch, axis: str):
+    """PartitionSpec pytree: every leaf sharded on its leading dim."""
+    return jax.tree_util.tree_map(
+        lambda x: P(axis, *([None] * (jnp.ndim(x) - 1))), batch
+    )
+
+
+def shard_leading_axis(mesh: Mesh, tree, axis: str = "data", dim: int = 0):
+    """Place every leaf of ``tree`` sharded over dimension ``dim``.
+
+    The one placement rule this framework uses (env axis of the rollout
+    carry, batch axis of update inputs, env axis — ``dim=1`` — of
+    time-major host trajectories) — kept in one place so agent and parallel
+    paths cannot diverge. The sharded dim must divide the mesh axis; use
+    :func:`pad_batch` first if not.
+    """
+    def leaf_spec(x):
+        nd = jnp.ndim(x)
+        parts = [None] * nd
+        if nd > dim:
+            parts[dim] = axis
+        return P(*parts)
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, leaf_spec(x))), tree
+    )
+
+
+def pad_batch(batch: TRPOBatch, multiple: int) -> TRPOBatch:
+    """Zero-weight-pad the batch so its leading dim divides ``multiple``.
+
+    Padding rows carry ``weight=0`` so every ``_wmean`` in the update is
+    unchanged (see ``tests/test_trpo_step.py::test_padding_weight_invariance``).
+    """
+    b = batch.weight.shape[0]
+    rem = (-b) % multiple
+    if rem == 0:
+        return batch
+    pad = lambda x: jnp.concatenate(
+        [x, jnp.zeros((rem,) + x.shape[1:], x.dtype)], axis=0
+    )
+    return TRPOBatch(
+        obs=pad(batch.obs),
+        actions=pad(batch.actions),
+        advantages=pad(batch.advantages),
+        old_dist=jax.tree_util.tree_map(pad, batch.old_dist),
+        weight=pad(batch.weight),  # zeros: padding is weightless
+    )
+
+
+def shard_batch(mesh: Mesh, batch: TRPOBatch, axis: str = "data") -> TRPOBatch:
+    """Pad to the mesh size and place the batch sharded over ``axis``."""
+    return shard_leading_axis(mesh, pad_batch(batch, mesh.shape[axis]), axis)
+
+
+def make_sharded_update(
+    policy: Policy,
+    cfg: TRPOConfig,
+    mesh: Mesh,
+    axis: str = "data",
+) -> Callable[[Any, TRPOBatch], Tuple[Any, TRPOStats]]:
+    """Jit the fused TRPO update over ``mesh`` with a batch-sharded input.
+
+    Params in/out are replicated (``P()``); the batch must arrive sharded
+    (use :func:`shard_batch`). The returned function is the drop-in
+    mesh-parallel version of ``jax.jit(make_trpo_update(...))``.
+    """
+    update = make_trpo_update(policy, cfg)
+    replicated = NamedSharding(mesh, P())
+
+    def batch_shardings(batch):
+        return jax.tree_util.tree_map(
+            lambda x: NamedSharding(
+                mesh, P(axis, *([None] * (jnp.ndim(x) - 1)))
+            ),
+            batch,
+        )
+
+    def sharded(params, batch: TRPOBatch):
+        in_shardings = (
+            jax.tree_util.tree_map(lambda _: replicated, params),
+            batch_shardings(batch),
+        )
+        fn = jax.jit(update, in_shardings=in_shardings)
+        return fn(params, batch)
+
+    return sharded
+
+
+def make_sharded_fvp(
+    policy: Policy,
+    cfg: TRPOConfig,
+    mesh: Mesh,
+    axis: str = "data",
+):
+    """Explicit ``shard_map`` Fisher-vector product over a sharded batch.
+
+    Returns ``fvp_fn(params, batch, v) -> (F + λI)·v`` where ``batch`` is
+    sharded over ``axis`` and ``v``/``params`` are replicated. Math matches
+    ``trpo_tpu.ops.fvp.make_fvp`` over the full batch: per-shard weighted
+    KL-Hessian-vector products are combined as ``psum(local_sum)/psum(w)``
+    — the hand-written form of the collective GSPMD derives.
+    """
+    from jax.flatten_util import ravel_pytree
+
+    def fvp_fn(params, batch: TRPOBatch, v: jax.Array) -> jax.Array:
+        flat0, unravel = ravel_pytree(params)
+        flat0 = jnp.asarray(flat0, jnp.float32)
+
+        def local_fvp(flat0_rep, local_batch: TRPOBatch, v_rep):
+            # Cast params/tangent to device-varying so reverse-mode AD
+            # stays LOCAL to the shard. Without this, grad of a replicated
+            # primal auto-inserts its own psum (the broadcast rule's
+            # transpose) and the explicit psum below double-counts.
+            flat_loc = jax.lax.pcast(flat0_rep, axis, to="varying")
+            v_loc = jax.lax.pcast(v_rep, axis, to="varying")
+            cur = jax.lax.stop_gradient(
+                policy.apply(unravel(flat_loc), local_batch.obs)
+            )
+
+            def kl_sum(flat):
+                dist = policy.apply(unravel(flat), local_batch.obs)
+                return jnp.sum(
+                    policy.dist.kl(cur, dist) * local_batch.weight
+                )
+
+            hv = jax.jvp(jax.grad(kl_sum), (flat_loc,), (v_loc,))[1]
+            # Weighted-SUM KL per shard; one explicit psum pair makes the
+            # global mean exact under uneven/padded shards.
+            num = jax.lax.psum(hv, axis)
+            den = jax.lax.psum(jnp.sum(local_batch.weight), axis)
+            return num / jnp.maximum(den, 1.0) + cfg.cg_damping * v_rep
+
+        spec_batch = _batch_spec(batch, axis)
+        shard_fvp = jax.shard_map(
+            local_fvp,
+            mesh=mesh,
+            in_specs=(P(), spec_batch, P()),
+            out_specs=P(),
+        )
+        return shard_fvp(flat0, batch, jnp.asarray(v, jnp.float32))
+
+    return fvp_fn

@@ -44,12 +44,16 @@ class Checkpointer:
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        abstract = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
-            if hasattr(x, "shape")
-            else x,
-            template,
-        )
+
+        def as_abstract(x):
+            if not hasattr(x, "shape"):
+                return x
+            # Preserve sharding so a mesh run resumes sharded, not
+            # collapsed onto the default device.
+            sharding = getattr(x, "sharding", None)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+        abstract = jax.tree_util.tree_map(as_abstract, template)
         return self.manager.restore(
             step, args=self._ocp.args.StandardRestore(abstract)
         )
