@@ -1,0 +1,157 @@
+"""Locomotion-sim (MuJoCo-shaped) and Catch (pixel) env rungs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trpo_tpu import envs
+from trpo_tpu.envs import CatchPixels, ChainLocomotion, HalfCheetahSim, HumanoidSim
+
+
+def test_make_resolves_new_rungs():
+    assert isinstance(envs.make("halfcheetah-sim"), HalfCheetahSim)
+    assert isinstance(envs.make("humanoid-sim"), HumanoidSim)
+    assert isinstance(envs.make("catch"), CatchPixels)
+    assert envs.is_device_env(envs.make("humanoid-sim"))
+    assert envs.is_device_env(envs.make("catch"))
+
+
+def test_locomotion_dims_match_baseline_ladder():
+    hc = HalfCheetahSim()
+    assert hc.obs_shape == (17,) and hc.action_spec.dim == 6
+    hu = HumanoidSim()
+    assert hu.obs_shape == (376,) and hu.action_spec.dim == 17
+
+
+def test_chain_step_shapes_and_truncation():
+    env = ChainLocomotion(n_masses=3, obs_dim=7, max_episode_steps=4)
+    state, obs = env.reset(jax.random.key(0))
+    assert obs.shape == (7,)
+    for _ in range(4):
+        state, obs, r, term, trunc = env.step(
+            state, jnp.ones(3), jax.random.key(0)
+        )
+    assert not bool(term) and bool(trunc)
+    assert np.isfinite(float(r))
+
+
+def test_chain_forward_force_gives_positive_reward():
+    env = ChainLocomotion(n_masses=4, obs_dim=9)
+    state, _ = env.reset(jax.random.key(1))
+    total = 0.0
+    for _ in range(50):
+        state, _, r, _, _ = env.step(state, jnp.ones(4), jax.random.key(0))
+        total += float(r)
+    # Constant forward force reaches positive terminal velocity; control
+    # cost is bounded by the clip — net return must be positive.
+    assert total > 0.0
+    # Velocities are damped: the state must stay bounded.
+    assert float(jnp.max(jnp.abs(state.vel))) < 50.0
+
+
+def test_chain_action_clip():
+    env = ChainLocomotion(n_masses=2, obs_dim=3)
+    state, _ = env.reset(jax.random.key(0))
+    s_big, o_big, r_big, *_ = env.step(
+        state, jnp.full(2, 1e6), jax.random.key(0)
+    )
+    s_one, o_one, r_one, *_ = env.step(state, jnp.ones(2), jax.random.key(0))
+    np.testing.assert_allclose(
+        np.asarray(o_big), np.asarray(o_one), rtol=1e-6
+    )
+    assert abs(float(r_big) - float(r_one)) < 1e-6
+
+
+def test_chain_vmap_jit():
+    env = HalfCheetahSim()
+    keys = jax.random.split(jax.random.key(0), 4)
+    states, obs = jax.vmap(env.reset)(keys)
+    assert obs.shape == (4, 17)
+    step = jax.jit(jax.vmap(env.step))
+    acts = jnp.zeros((4, 6))
+    _, obs2, r, term, trunc = step(states, acts, keys)
+    assert obs2.shape == (4, 17) and r.shape == (4,)
+
+
+def test_catch_obs_and_episode():
+    env = CatchPixels()
+    assert env.obs_shape == (40, 40, 1)
+    state, obs = env.reset(jax.random.key(0))
+    assert obs.dtype == jnp.uint8
+    # Exactly two lit cells (ball + paddle), each cell_px² pixels at 255.
+    assert int(jnp.sum(obs > 0)) == 2 * env.cell_px**2
+    term = False
+    steps = 0
+    while not term and steps < 20:
+        state, obs, r, term_a, trunc = env.step(
+            state, jnp.asarray(1), jax.random.key(0)
+        )
+        term = bool(term_a)
+        steps += 1
+    assert term and steps == env.grid - 1
+    assert float(r) in (1.0, -1.0)
+
+
+def test_catch_tracking_policy_wins():
+    """Moving toward the ball column always catches it."""
+    env = CatchPixels()
+    state, _ = env.reset(jax.random.key(42))
+    term = False
+    r = 0.0
+    while not term:
+        move = jnp.sign(state.ball_col - state.paddle_col) + 1
+        state, _, r, term_a, _ = env.step(state, move, jax.random.key(0))
+        term = bool(term_a)
+    assert float(r) == 1.0
+
+
+def test_agent_iteration_humanoid_sim():
+    """The Humanoid-scale rung runs the full fused iteration."""
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+
+    cfg = TRPOConfig(
+        env="humanoid-sim",
+        n_envs=2,
+        batch_timesteps=16,
+        policy_hidden=(32,),
+        vf_hidden=(32,),
+        vf_train_steps=2,
+        cg_iters=3,
+    )
+    agent = TRPOAgent("humanoid-sim", cfg)
+    state = agent.init_state(seed=0)
+    state, stats = agent.run_iteration(state)
+    assert np.isfinite(float(stats["entropy"]))
+    assert np.isfinite(float(stats["kl_old_new"]))
+
+
+def test_agent_iteration_catch_conv_policy():
+    """The pixel rung: conv-torso policy through the full fused iteration."""
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+
+    cfg = TRPOConfig(
+        env="catch",
+        n_envs=2,
+        batch_timesteps=12,
+        policy_hidden=(32,),
+        vf_hidden=(32,),
+        vf_train_steps=2,
+        cg_iters=2,
+    )
+    agent = TRPOAgent("catch", cfg)
+    state = agent.init_state(seed=0)
+    state, stats = agent.run_iteration(state)
+    assert np.isfinite(float(stats["entropy"]))
+
+
+def test_max_pathlength_wires_through_agent():
+    """cfg.max_pathlength reaches envs that have a truncation knob."""
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+
+    cfg = TRPOConfig(env="pendulum", max_pathlength=7, n_envs=2,
+                     batch_timesteps=4)
+    agent = TRPOAgent("pendulum", cfg)
+    assert agent.env.max_episode_steps == 7

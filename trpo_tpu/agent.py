@@ -62,9 +62,21 @@ class TRPOAgent:
     def __init__(self, env, config: Optional[TRPOConfig] = None):
         cfg = config or TRPOConfig()
         if isinstance(env, str):
-            env = envs_lib.make(env, **(
-                {"n_envs": cfg.n_envs} if env.startswith("gym:") else {}
-            ))
+            if env.startswith("gym:"):
+                kwargs = {"n_envs": cfg.n_envs}
+            else:
+                # Honor cfg.max_pathlength (the reference's max_steps,
+                # trpo_inksci.py:17) for envs with a truncation knob; envs
+                # with a structurally fixed horizon (Catch) take none.
+                import inspect
+
+                cls = envs_lib._JAX_ENVS.get(env)
+                kwargs = {}
+                if cls is not None and "max_episode_steps" in (
+                    inspect.signature(cls.__init__).parameters
+                ):
+                    kwargs["max_episode_steps"] = cfg.max_pathlength
+            env = envs_lib.make(env, **kwargs)
         self.env = env
         self.cfg = cfg
         self.is_device_env = envs_lib.is_device_env(env)

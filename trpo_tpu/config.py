@@ -61,7 +61,8 @@ class TRPOConfig:
     #                                entropy!=entropy abort (trpo_inksci.py:172-173)
 
     # --- parallelism -----------------------------------------------------
-    mesh_shape: Optional[Tuple[int, ...]] = None  # None → (n_local_devices,)
+    mesh_shape: Optional[Tuple[int, ...]] = None  # None → single device, no
+    #                                mesh; set e.g. (8,) for data parallelism
     mesh_axes: Tuple[str, ...] = ("data",)
     # model axis is only used when mesh_shape has 2 entries, e.g. (4, 2) with
     # axes ("data", "model") shards wide policy layers over "model".
@@ -113,6 +114,38 @@ PRESETS = {
         n_envs=64,
         policy_hidden=(256, 256),
         cg_damping=0.1,
+    ),
+    # On-device stand-ins for the MuJoCo/Atari rungs (same obs/act dims,
+    # pure-JAX dynamics — see trpo_tpu.envs.locomotion / .catch): these run
+    # the full fused pipeline on TPU without external simulator binaries.
+    "halfcheetah-sim": TRPOConfig(
+        env="halfcheetah-sim",
+        gamma=0.99,
+        lam=0.97,
+        batch_timesteps=5000,
+        max_pathlength=500,
+        n_envs=32,
+        policy_hidden=(64, 64),
+        cg_damping=0.1,
+    ),
+    "humanoid-sim": TRPOConfig(
+        env="humanoid-sim",
+        gamma=0.99,
+        lam=0.97,
+        batch_timesteps=50_000,
+        max_pathlength=500,
+        n_envs=128,
+        policy_hidden=(256, 256),
+        cg_damping=0.1,
+    ),
+    "catch": TRPOConfig(
+        env="catch",
+        gamma=0.99,
+        lam=0.95,
+        batch_timesteps=2048,
+        # no max_pathlength: a Catch episode is fixed at grid-1 = 9 steps
+        n_envs=8,        # BASELINE.json: "8 vectorized envs"
+        policy_hidden=(512,),
     ),
     # "Atari Pong pixel conv policy (high-param FVP, 8 vectorized envs)"
     "pong": TRPOConfig(

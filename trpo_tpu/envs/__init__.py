@@ -9,18 +9,31 @@ rollouts run *on device* inside ``lax.scan``, batched over N envs with
 are supported through a vectorized adapter with batched device inference.
 
 ``make(name)`` resolves:
-- ``"cartpole"``, ``"pendulum"``, ``"fake"`` → pure-JAX envs
+- ``"cartpole"``, ``"pendulum"``, ``"fake"`` → pure-JAX classic control
+- ``"chain"``, ``"halfcheetah-sim"``, ``"humanoid-sim"`` → pure-JAX
+  continuous-control rungs at MuJoCo dimensions (BASELINE.json configs 3-4)
+- ``"catch"`` → pure-JAX pixel env for the conv-policy rung (config 5)
 - ``"gym:<EnvId>"`` → gymnasium adapter (requires gymnasium + the env's deps)
 """
 
 from trpo_tpu.envs.cartpole import CartPole  # noqa: F401
 from trpo_tpu.envs.pendulum import Pendulum  # noqa: F401
 from trpo_tpu.envs.fake import FakeEnv  # noqa: F401
+from trpo_tpu.envs.locomotion import (  # noqa: F401
+    ChainLocomotion,
+    HalfCheetahSim,
+    HumanoidSim,
+)
+from trpo_tpu.envs.catch import CatchPixels  # noqa: F401
 
 _JAX_ENVS = {
     "cartpole": CartPole,
     "pendulum": Pendulum,
     "fake": FakeEnv,
+    "chain": ChainLocomotion,
+    "halfcheetah-sim": HalfCheetahSim,
+    "humanoid-sim": HumanoidSim,
+    "catch": CatchPixels,
 }
 
 

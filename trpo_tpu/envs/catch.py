@@ -1,0 +1,87 @@
+"""Pure-JAX pixel environment for the conv-policy (Atari) rung.
+
+BASELINE.json config 5 is "Atari Pong-ram / pixel conv policy (high-param
+FVP, 8 vectorized envs)". Atari ROMs/emulators are not part of this image
+(real Atari runs go through ``envs.make("gym:ALE/Pong-v5")`` when
+available), so this provides the on-device pixel rung: *Catch* — the
+standard pixel control microbenchmark (a falling ball, a paddle, ±1 reward
+on the bottom row) — rendered as uint8 images sized for the Nature-DQN conv
+torso (``models/conv.py``). Everything (dynamics + rendering) is jittable,
+so conv-policy rollouts run inside the same fused ``lax.scan`` program as
+the vector envs, exercising the high-param FVP path end to end on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from trpo_tpu.models.policy import DiscreteSpec
+
+__all__ = ["CatchPixels"]
+
+
+class CatchState(NamedTuple):
+    ball_row: jax.Array    # int32, 0 = top
+    ball_col: jax.Array    # int32
+    paddle_col: jax.Array  # int32 (paddle lives on the bottom row)
+    t: jax.Array           # int32 step counter
+
+
+class CatchPixels:
+    """``grid×grid`` Catch rendered at ``cell_px`` px/cell, (H, W, 1) uint8.
+
+    Actions: 0 = left, 1 = stay, 2 = right. The ball falls one row per
+    step; when it reaches the bottom row the episode terminates with
+    reward +1 if the paddle is under it, −1 otherwise. Default 10×10 grid
+    at 4 px/cell → 40×40×1 observations (Nature-DQN torso → 1×1×64 feats).
+    """
+
+    def __init__(self, grid: int = 10, cell_px: int = 4):
+        self.grid = grid
+        self.cell_px = cell_px
+        side = grid * cell_px
+        self.obs_shape = (side, side, 1)
+        self.action_spec = DiscreteSpec(3)
+
+    def reset(self, key):
+        col = jax.random.randint(key, (), 0, self.grid)
+        state = CatchState(
+            ball_row=jnp.asarray(0, jnp.int32),
+            ball_col=col.astype(jnp.int32),
+            paddle_col=jnp.asarray(self.grid // 2, jnp.int32),
+            t=jnp.asarray(0, jnp.int32),
+        )
+        return state, self._obs(state)
+
+    def _obs(self, s: CatchState):
+        g, px = self.grid, self.cell_px
+        rows = jnp.arange(g)
+        ball = (
+            (rows == s.ball_row)[:, None] * (rows == s.ball_col)[None, :]
+        )
+        paddle = (
+            (rows == g - 1)[:, None] * (rows == s.paddle_col)[None, :]
+        )
+        cells = jnp.logical_or(ball, paddle)
+        img = jnp.repeat(jnp.repeat(cells, px, axis=0), px, axis=1)
+        return (img[..., None] * 255).astype(jnp.uint8)
+
+    def step(self, state: CatchState, action, key):
+        del key
+        move = jnp.reshape(action, ()).astype(jnp.int32) - 1
+        paddle = jnp.clip(state.paddle_col + move, 0, self.grid - 1)
+        ball_row = state.ball_row + 1
+        t = state.t + 1
+        new_state = CatchState(ball_row, state.ball_col, paddle, t)
+
+        at_bottom = ball_row >= self.grid - 1
+        caught = jnp.logical_and(at_bottom, paddle == state.ball_col)
+        reward = jnp.where(
+            at_bottom, jnp.where(caught, 1.0, -1.0), 0.0
+        ).astype(jnp.float32)
+        terminated = at_bottom
+        truncated = jnp.asarray(False)
+        return new_state, self._obs(new_state), reward, terminated, truncated

@@ -149,6 +149,10 @@ def make_sharded_fvp(
     """
     from jax.flatten_util import ravel_pytree
 
+    # One stable callable under ONE jit: the executable caches on shapes,
+    # so repeated calls (e.g. one per CG iteration) hit the compile cache
+    # instead of re-tracing the shard_map every invocation.
+    @jax.jit
     def fvp_fn(params, batch: TRPOBatch, v: jax.Array) -> jax.Array:
         flat0, unravel = ravel_pytree(params)
         flat0 = jnp.asarray(flat0, jnp.float32)
