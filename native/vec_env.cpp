@@ -1,0 +1,215 @@
+// Native vectorized environment stepper.
+//
+// The reference's environment layer is a serial host Python loop — one
+// interpreted env.step per timestep (reference utils.py:18-45). This is the
+// framework's native host runtime for that layer: batched C++ physics for
+// the classic-control envs, stepped N-at-a-time with in-place auto-reset,
+// driven from Python through a flat-array C ABI (ctypes — no pybind11
+// dependency). The TPU compute path stays JAX/XLA; this covers the
+// host-simulator side the way the reference's TF-1.3 C++ runtime covered
+// its kernels: compiled code under a thin Python surface.
+//
+// Physics mirror trpo_tpu/envs/cartpole.py and pendulum.py exactly
+// (same constants, same Euler integration order), so Python tests can
+// assert step-for-step agreement with the pure-JAX envs.
+//
+// Threading: envs are independent; OpenMP parallelizes the batch loop when
+// compiled with -fopenmp (each env owns its RNG state, so steps are
+// race-free by construction).
+
+#include <cmath>
+#include <cstdint>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// RNG: splitmix64 seeding + xorshift64* stream per env.
+// ---------------------------------------------------------------------------
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+static inline uint64_t xorshift64s(uint64_t* s) {
+  uint64_t x = *s;
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  *s = x;
+  return x * 0x2545f4914f6cdd1dULL;
+}
+
+// Uniform in [lo, hi).
+static inline float uniformf(uint64_t* s, float lo, float hi) {
+  const double u = (double)(xorshift64s(s) >> 11) * (1.0 / 9007199254740992.0);
+  return lo + (float)(u * (double)(hi - lo));
+}
+
+void trpo_native_seed(uint64_t* rng, int32_t n, uint64_t seed) {
+  for (int32_t i = 0; i < n; ++i) {
+    rng[i] = splitmix64(seed ^ splitmix64((uint64_t)i));
+    if (rng[i] == 0) rng[i] = 0x9e3779b97f4a7c15ULL;  // xorshift forbids 0
+  }
+}
+
+// ---------------------------------------------------------------------------
+// CartPole (constants/integration = trpo_tpu/envs/cartpole.py:39-97)
+// ---------------------------------------------------------------------------
+
+static const float CP_GRAVITY = 9.8f;
+static const float CP_MASSCART = 1.0f;
+static const float CP_MASSPOLE = 0.1f;
+static const float CP_LENGTH = 0.5f;
+static const float CP_FORCE_MAG = 10.0f;
+static const float CP_TAU = 0.02f;
+static const float CP_X_THRESHOLD = 2.4f;
+static const float CP_THETA_THRESHOLD = 12.0f * 2.0f * (float)M_PI / 360.0f;
+
+static inline void cartpole_reset_one(float* s, int32_t* t, uint64_t* rng) {
+  for (int k = 0; k < 4; ++k) s[k] = uniformf(rng, -0.05f, 0.05f);
+  *t = 0;
+}
+
+void trpo_native_cartpole_reset(float* state, int32_t* t, uint64_t* rng,
+                                int32_t n) {
+#pragma omp parallel for schedule(static)
+  for (int32_t i = 0; i < n; ++i) {
+    cartpole_reset_one(state + 4 * i, t + i, rng + i);
+  }
+}
+
+// Steps all n envs in place with auto-reset. Outputs:
+//   next_obs  (n,4) — post-reset observation (what the policy sees next)
+//   final_obs (n,4) — TRUE successor observation pre-reset (for truncation
+//                     bootstrapping; mirrors GymVecEnv.host_step)
+//   rewards (n), terminated (n), truncated (n)
+void trpo_native_cartpole_step(float* state, int32_t* t, uint64_t* rng,
+                               const int32_t* actions, int32_t n,
+                               int32_t max_steps, float* next_obs,
+                               float* final_obs, float* rewards,
+                               uint8_t* terminated, uint8_t* truncated) {
+#pragma omp parallel for schedule(static)
+  for (int32_t i = 0; i < n; ++i) {
+    float* s = state + 4 * i;
+    const float x = s[0], x_dot = s[1], theta = s[2], theta_dot = s[3];
+    const float force = actions[i] == 1 ? CP_FORCE_MAG : -CP_FORCE_MAG;
+    const float cos_t = std::cos(theta), sin_t = std::sin(theta);
+    const float total_mass = CP_MASSCART + CP_MASSPOLE;
+    const float polemass_length = CP_MASSPOLE * CP_LENGTH;
+
+    const float temp =
+        (force + polemass_length * theta_dot * theta_dot * sin_t) / total_mass;
+    const float theta_acc =
+        (CP_GRAVITY * sin_t - cos_t * temp) /
+        (CP_LENGTH * (4.0f / 3.0f - CP_MASSPOLE * cos_t * cos_t / total_mass));
+    const float x_acc = temp - polemass_length * theta_acc * cos_t / total_mass;
+
+    const float nx = x + CP_TAU * x_dot;
+    const float nx_dot = x_dot + CP_TAU * x_acc;
+    const float ntheta = theta + CP_TAU * theta_dot;
+    const float ntheta_dot = theta_dot + CP_TAU * theta_acc;
+    const int32_t nt = t[i] + 1;
+
+    const bool term = std::fabs(nx) > CP_X_THRESHOLD ||
+                      std::fabs(ntheta) > CP_THETA_THRESHOLD;
+    const bool trunc = (nt >= max_steps) && !term;
+
+    float* fo = final_obs + 4 * i;
+    fo[0] = nx; fo[1] = nx_dot; fo[2] = ntheta; fo[3] = ntheta_dot;
+    rewards[i] = 1.0f;
+    terminated[i] = term ? 1 : 0;
+    truncated[i] = trunc ? 1 : 0;
+
+    s[0] = nx; s[1] = nx_dot; s[2] = ntheta; s[3] = ntheta_dot;
+    t[i] = nt;
+    if (term || trunc) cartpole_reset_one(s, t + i, rng + i);
+    float* no = next_obs + 4 * i;
+    no[0] = s[0]; no[1] = s[1]; no[2] = s[2]; no[3] = s[3];
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Pendulum (constants/integration = trpo_tpu/envs/pendulum.py:33-78)
+// state per env: [theta, theta_dot]; obs: [cos, sin, theta_dot]
+// ---------------------------------------------------------------------------
+
+static const float PD_MAX_SPEED = 8.0f;
+static const float PD_MAX_TORQUE = 2.0f;
+static const float PD_DT = 0.05f;
+static const float PD_G = 10.0f;
+static const float PD_M = 1.0f;
+static const float PD_L = 1.0f;
+
+static inline float angle_normalize(float x) {
+  const float two_pi = 2.0f * (float)M_PI;
+  float y = std::fmod(x + (float)M_PI, two_pi);
+  if (y < 0) y += two_pi;
+  return y - (float)M_PI;
+}
+
+static inline void pendulum_reset_one(float* s, int32_t* t, uint64_t* rng) {
+  s[0] = uniformf(rng, -(float)M_PI, (float)M_PI);
+  s[1] = uniformf(rng, -1.0f, 1.0f);
+  *t = 0;
+}
+
+static inline void pendulum_obs(const float* s, float* o) {
+  o[0] = std::cos(s[0]);
+  o[1] = std::sin(s[0]);
+  o[2] = s[1];
+}
+
+void trpo_native_pendulum_reset(float* state, int32_t* t, uint64_t* rng,
+                                int32_t n) {
+#pragma omp parallel for schedule(static)
+  for (int32_t i = 0; i < n; ++i) {
+    pendulum_reset_one(state + 2 * i, t + i, rng + i);
+  }
+}
+
+void trpo_native_pendulum_step(float* state, int32_t* t, uint64_t* rng,
+                               const float* actions, int32_t n,
+                               int32_t max_steps, float* next_obs,
+                               float* final_obs, float* rewards,
+                               uint8_t* terminated, uint8_t* truncated) {
+#pragma omp parallel for schedule(static)
+  for (int32_t i = 0; i < n; ++i) {
+    float* s = state + 2 * i;
+    const float theta = s[0], theta_dot = s[1];
+    float u = actions[i];
+    if (u > PD_MAX_TORQUE) u = PD_MAX_TORQUE;
+    if (u < -PD_MAX_TORQUE) u = -PD_MAX_TORQUE;
+
+    const float th = angle_normalize(theta);
+    const float cost =
+        th * th + 0.1f * theta_dot * theta_dot + 0.001f * u * u;
+
+    float ntheta_dot =
+        theta_dot + (3.0f * PD_G / (2.0f * PD_L) * std::sin(theta) +
+                     3.0f / (PD_M * PD_L * PD_L) * u) *
+                        PD_DT;
+    if (ntheta_dot > PD_MAX_SPEED) ntheta_dot = PD_MAX_SPEED;
+    if (ntheta_dot < -PD_MAX_SPEED) ntheta_dot = -PD_MAX_SPEED;
+    const float ntheta = theta + ntheta_dot * PD_DT;
+    const int32_t nt = t[i] + 1;
+
+    const bool trunc = nt >= max_steps;
+
+    s[0] = ntheta; s[1] = ntheta_dot; t[i] = nt;
+    pendulum_obs(s, final_obs + 3 * i);
+    rewards[i] = -cost;
+    terminated[i] = 0;
+    truncated[i] = trunc ? 1 : 0;
+    if (trunc) pendulum_reset_one(s, t + i, rng + i);
+    pendulum_obs(s, next_obs + 3 * i);
+  }
+}
+
+}  // extern "C"

@@ -155,3 +155,15 @@ def test_max_pathlength_wires_through_agent():
                      batch_timesteps=4)
     agent = TRPOAgent("pendulum", cfg)
     assert agent.env.max_episode_steps == 7
+
+
+def test_default_horizon_untouched_and_fixed_horizon_rejected():
+    """max_pathlength=None keeps env defaults; fixed-horizon envs reject it."""
+    import pytest
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+
+    agent = TRPOAgent("cartpole", TRPOConfig(n_envs=2, batch_timesteps=4))
+    assert agent.env.max_episode_steps == 500  # CartPole's own default
+    with pytest.raises(TypeError, match="fixed horizon"):
+        envs.make("catch", max_episode_steps=12)

@@ -1,0 +1,139 @@
+"""Native (C++) vectorized env stepper vs the pure-JAX reference envs.
+
+The native stepper mirrors the JAX env physics constant-for-constant, so
+single steps from identical states must agree to float32 tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trpo_tpu import envs
+from trpo_tpu.envs.cartpole import CartPole, CartPoleState
+from trpo_tpu.envs.pendulum import Pendulum, PendulumState
+
+native = pytest.importorskip("trpo_tpu.envs.native")
+if not native.native_available():
+    pytest.skip("native library unavailable on this machine", allow_module_level=True)
+
+
+def test_make_resolves_native():
+    env = envs.make("native:cartpole", n_envs=4)
+    assert env.n_envs == 4
+    assert not envs.is_device_env(env)
+    with pytest.raises(KeyError):
+        envs.make("native:walker")
+
+
+def test_native_cartpole_matches_jax_physics():
+    n = 64
+    rng = np.random.default_rng(0)
+    env = native.NativeVecEnv("cartpole", n_envs=n, max_episode_steps=10**9)
+    # Overwrite native state with known random (non-terminal) states.
+    states = rng.uniform(-0.04, 0.04, size=(n, 4)).astype(np.float32)
+    env._state[:] = states
+    env._t[:] = 0
+    actions = rng.integers(0, 2, size=n).astype(np.int32)
+
+    next_obs, rewards, term, trunc, final_obs = env.host_step(actions)
+
+    jax_env = CartPole(max_episode_steps=10**9)
+    js = CartPoleState(
+        x=jnp.asarray(states[:, 0]), x_dot=jnp.asarray(states[:, 1]),
+        theta=jnp.asarray(states[:, 2]), theta_dot=jnp.asarray(states[:, 3]),
+        t=jnp.zeros(n, jnp.int32),
+    )
+    keys = jax.random.split(jax.random.key(0), n)
+    _, jobs, jr, jterm, jtrunc = jax.vmap(jax_env.step)(
+        js, jnp.asarray(actions), keys
+    )
+    np.testing.assert_allclose(final_obs, np.asarray(jobs), rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(term, np.asarray(jterm))
+    assert np.all(rewards == 1.0)
+    # No terminations from near-zero states → next_obs is the true successor.
+    np.testing.assert_allclose(next_obs, final_obs, rtol=1e-6)
+
+
+def test_native_pendulum_matches_jax_physics():
+    n = 64
+    rng = np.random.default_rng(1)
+    env = native.NativeVecEnv("pendulum", n_envs=n, max_episode_steps=10**9)
+    thetas = rng.uniform(-np.pi, np.pi, size=n).astype(np.float32)
+    theta_dots = rng.uniform(-1, 1, size=n).astype(np.float32)
+    env._state[:, 0] = thetas
+    env._state[:, 1] = theta_dots
+    env._t[:] = 0
+    actions = rng.uniform(-3, 3, size=n).astype(np.float32)  # exercises clip
+
+    _, rewards, term, trunc, final_obs = env.host_step(actions)
+
+    jax_env = Pendulum(max_episode_steps=10**9)
+    js = PendulumState(
+        theta=jnp.asarray(thetas), theta_dot=jnp.asarray(theta_dots),
+        t=jnp.zeros(n, jnp.int32),
+    )
+    keys = jax.random.split(jax.random.key(0), n)
+    _, jobs, jr, *_ = jax.vmap(jax_env.step)(
+        js, jnp.asarray(actions)[:, None], keys
+    )
+    np.testing.assert_allclose(final_obs, np.asarray(jobs), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(rewards, np.asarray(jr), rtol=1e-4, atol=1e-5)
+    assert not term.any()
+
+
+def test_native_auto_reset_and_bookkeeping():
+    env = native.NativeVecEnv("cartpole", n_envs=2, max_episode_steps=3)
+    for step in range(3):
+        _, _, term, trunc, _ = env.host_step(np.zeros(2, np.int32))
+    # By step 3 every env truncated (or terminated earlier and reset).
+    assert (env._t <= 3).all()
+    assert env.last_episode_lengths.max() <= 3
+    # Episode accumulators reset where episodes ended.
+    ended = np.logical_or(term, trunc)
+    assert env._running_lengths[ended].max(initial=0) == 0
+
+
+def test_native_rollout_through_agent():
+    """Full training iteration with the native host runtime underneath."""
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+
+    cfg = TRPOConfig(
+        env="native:cartpole",
+        n_envs=4,
+        batch_timesteps=64,
+        max_pathlength=50,
+        vf_train_steps=3,
+        cg_iters=3,
+    )
+    agent = TRPOAgent("native:cartpole", cfg)
+    assert agent.env.max_episode_steps == 50
+    state = agent.init_state(seed=0)
+    state, stats = agent.run_iteration(state)
+    assert np.isfinite(float(stats["entropy"]))
+    assert float(stats["mean_episode_reward"]) > 0  # cartpole rewards are 1/step
+
+
+def test_native_cartpole_learns():
+    """The reference's own bar, through the native runtime: reward rises."""
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+
+    cfg = TRPOConfig(
+        env="native:cartpole",
+        n_envs=8,
+        batch_timesteps=512,
+        max_pathlength=200,
+        gamma=0.99,
+        cg_iters=10,
+    )
+    agent = TRPOAgent("native:cartpole", cfg)
+    state = agent.init_state(seed=0)
+    rewards = []
+    for _ in range(10):
+        state, stats = agent.run_iteration(state)
+        r = float(stats["mean_episode_reward"])
+        if np.isfinite(r):
+            rewards.append(r)
+    assert rewards[-1] > rewards[0] + 10, rewards

@@ -62,21 +62,16 @@ class TRPOAgent:
     def __init__(self, env, config: Optional[TRPOConfig] = None):
         cfg = config or TRPOConfig()
         if isinstance(env, str):
-            if env.startswith("gym:"):
-                kwargs = {"n_envs": cfg.n_envs}
-            else:
-                # Honor cfg.max_pathlength (the reference's max_steps,
-                # trpo_inksci.py:17) for envs with a truncation knob; envs
-                # with a structurally fixed horizon (Catch) take none.
-                import inspect
-
-                cls = envs_lib._JAX_ENVS.get(env)
-                kwargs = {}
-                if cls is not None and "max_episode_steps" in (
-                    inspect.signature(cls.__init__).parameters
-                ):
-                    kwargs["max_episode_steps"] = cfg.max_pathlength
-            env = envs_lib.make(env, **kwargs)
+            kwargs = (
+                {"n_envs": cfg.n_envs}
+                if env.startswith(("gym:", "native:"))
+                else {}
+            )
+            # cfg.max_pathlength=None keeps the env's default horizon;
+            # a value overrides it for every env family (envs.make).
+            env = envs_lib.make(
+                env, max_episode_steps=cfg.max_pathlength, **kwargs
+            )
         self.env = env
         self.cfg = cfg
         self.is_device_env = envs_lib.is_device_env(env)
@@ -221,6 +216,7 @@ class TRPOAgent:
             traj.done,
             self.cfg.gamma,
             self.cfg.lam,
+            backend=self.cfg.scan_backend,
         )
         return adv, vtarg, values
 

@@ -18,7 +18,10 @@ class TRPOConfig:
     # --- environment -----------------------------------------------------
     env: str = "cartpole"          # preset env name (see trpo_tpu.envs.make)
     n_envs: int = 8                # vectorized envs (BASELINE.json: "8 vectorized envs")
-    max_pathlength: int = 1000     # ref config["max_steps"] (trpo_inksci.py:17)
+    max_pathlength: Optional[int] = None  # episode-step cap; None → each
+    #                                env's default horizon. The reference's
+    #                                max_steps=1000 (trpo_inksci.py:17)
+    #                                becomes an explicit override here.
     batch_timesteps: int = 1000    # ref config["episodes_per_roll"] — a timestep
     #                                budget despite its name (SURVEY §2.1)
 
@@ -27,6 +30,9 @@ class TRPOConfig:
     lam: float = 1.0               # GAE(λ). λ=1 ≡ plain `returns − baseline`,
     #                                the reference's advantage (trpo_inksci.py:104-105)
     standardize_advantages: bool = True  # ref trpo_inksci.py:115-117
+    scan_backend: str = "xla"      # "xla" (associative scan) or "pallas"
+    #                                (single-pass TPU kernel, ops/pallas_scan.py)
+    #                                for the GAE/returns recurrence
 
     # --- trust region solve ----------------------------------------------
     max_kl: float = 0.01           # ref config["max_kl"]

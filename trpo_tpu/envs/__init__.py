@@ -13,6 +13,8 @@ are supported through a vectorized adapter with batched device inference.
 - ``"chain"``, ``"halfcheetah-sim"``, ``"humanoid-sim"`` → pure-JAX
   continuous-control rungs at MuJoCo dimensions (BASELINE.json configs 3-4)
 - ``"catch"`` → pure-JAX pixel env for the conv-policy rung (config 5)
+- ``"native:cartpole"``, ``"native:pendulum"`` → C++ batched host stepper
+  (``native/vec_env.cpp`` via ctypes; builds lazily with g++)
 - ``"gym:<EnvId>"`` → gymnasium adapter (requires gymnasium + the env's deps)
 """
 
@@ -37,14 +39,38 @@ _JAX_ENVS = {
 }
 
 
-def make(name: str, **kwargs):
-    """Build an env by preset name (see module docstring for the grammar)."""
+def make(name: str, max_episode_steps=None, **kwargs):
+    """Build an env by preset name (see module docstring for the grammar).
+
+    ``max_episode_steps=None`` keeps each env's own default horizon; a value
+    overrides it — forwarded to gymnasium's TimeLimit for ``gym:`` envs, to
+    the native stepper for ``native:`` envs, and to the constructor for
+    pure-JAX envs that have the knob. Envs with a structurally fixed horizon
+    (Catch: the ball reaches the bottom in grid−1 steps) reject an override.
+    """
+    if max_episode_steps is not None:
+        kwargs["max_episode_steps"] = max_episode_steps
     if name.startswith("gym:"):
         from trpo_tpu.envs.gym_adapter import GymVecEnv
 
         return GymVecEnv(name[4:], **kwargs)
+    if name.startswith("native:"):
+        from trpo_tpu.envs.native import NativeVecEnv
+
+        return NativeVecEnv(name[len("native:"):], **kwargs)
     if name in _JAX_ENVS:
-        return _JAX_ENVS[name](**kwargs)
+        cls = _JAX_ENVS[name]
+        if "max_episode_steps" in kwargs:
+            import inspect
+
+            if "max_episode_steps" not in inspect.signature(
+                cls.__init__
+            ).parameters:
+                raise TypeError(
+                    f"env {name!r} has a fixed horizon; "
+                    "max_episode_steps is not supported"
+                )
+        return cls(**kwargs)
     raise KeyError(
         f"unknown env {name!r}; have {sorted(_JAX_ENVS)} or 'gym:<EnvId>'"
     )

@@ -1,0 +1,191 @@
+"""ctypes bindings for the native (C++) vectorized env stepper.
+
+The reference's environment layer is an interpreted serial loop — one
+Python ``env.step`` per timestep per env (reference ``utils.py:18-45``).
+:class:`NativeVecEnv` is the compiled host runtime for that layer: batched
+C++ physics (``native/vec_env.cpp``, OpenMP over envs) behind the same
+host-env interface as :class:`~trpo_tpu.envs.gym_adapter.GymVecEnv`, so
+``host_rollout`` and the agent drive it unchanged. Bindings are plain
+ctypes over a flat-array C ABI — no pybind11 (not in this image), no copy:
+the arrays live in NumPy and C++ steps them in place.
+
+The shared library builds lazily on first use (``make`` in ``native/``)
+and is cached; environments gate on :func:`native_available`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from trpo_tpu.models.policy import BoxSpec, DiscreteSpec
+
+__all__ = ["NativeVecEnv", "native_available", "load_library"]
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
+_LIB_NAME = "libtrpo_native.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def _build() -> pathlib.Path:
+    lib_path = _NATIVE_DIR / _LIB_NAME
+    src = _NATIVE_DIR / "vec_env.cpp"
+    if lib_path.exists() and lib_path.stat().st_mtime >= src.stat().st_mtime:
+        return lib_path
+    subprocess.run(
+        ["make", "-s", _LIB_NAME],
+        cwd=_NATIVE_DIR,
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+    return lib_path
+
+
+def load_library() -> ctypes.CDLL:
+    """Build (if needed) and load the native library; cached per process."""
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_error is not None:
+            raise RuntimeError(_load_error)
+        try:
+            lib = ctypes.CDLL(str(_build()))
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            _load_error = (
+                f"native env library unavailable (build failed): {detail}"
+            )
+            raise RuntimeError(_load_error) from e
+
+        lib.trpo_native_seed.argtypes = [_u64p, ctypes.c_int32, ctypes.c_uint64]
+        for prefix, act_p in (
+            ("cartpole", _i32p),
+            ("pendulum", _f32p),
+        ):
+            reset = getattr(lib, f"trpo_native_{prefix}_reset")
+            reset.argtypes = [_f32p, _i32p, _u64p, ctypes.c_int32]
+            step = getattr(lib, f"trpo_native_{prefix}_step")
+            step.argtypes = [
+                _f32p, _i32p, _u64p, act_p,
+                ctypes.c_int32, ctypes.c_int32,
+                _f32p, _f32p, _f32p, _u8p, _u8p,
+            ]
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    """True when the native library builds/loads on this machine."""
+    try:
+        load_library()
+        return True
+    except RuntimeError:
+        return False
+
+
+_KINDS = {
+    # kind -> (state_width, obs_dim, discrete_actions, default_max_steps)
+    "cartpole": (4, 4, True, 500),
+    "pendulum": (2, 3, False, 200),
+}
+
+
+class NativeVecEnv:
+    """N batched native envs behind the ``GymVecEnv`` host interface."""
+
+    def __init__(
+        self,
+        kind: str = "cartpole",
+        n_envs: int = 8,
+        seed: int = 0,
+        max_episode_steps: Optional[int] = None,
+    ):
+        if kind not in _KINDS:
+            raise KeyError(f"unknown native env {kind!r}; have {sorted(_KINDS)}")
+        self._lib = load_library()
+        state_w, obs_dim, discrete, default_steps = _KINDS[kind]
+        self.kind = kind
+        self.n_envs = n_envs
+        self.max_episode_steps = (
+            default_steps if max_episode_steps is None else max_episode_steps
+        )
+        self.obs_shape = (obs_dim,)
+        self.action_spec = DiscreteSpec(2) if discrete else BoxSpec(1)
+        self._discrete = discrete
+
+        n = n_envs
+        self._state = np.zeros((n, state_w), np.float32)
+        self._t = np.zeros(n, np.int32)
+        self._rng = np.zeros(n, np.uint64)
+        self._lib.trpo_native_seed(self._rng, n, np.uint64(seed))
+        self._reset = getattr(self._lib, f"trpo_native_{kind}_reset")
+        self._step = getattr(self._lib, f"trpo_native_{kind}_step")
+        self._reset(self._state, self._t, self._rng, n)
+        self._obs = self._observe()
+
+        self.last_episode_returns = np.zeros(n, np.float32)
+        self.last_episode_lengths = np.zeros(n, np.int64)
+        self._running_returns = np.zeros(n, np.float32)
+        self._running_lengths = np.zeros(n, np.int64)
+
+    def _observe(self) -> np.ndarray:
+        if self.kind == "cartpole":
+            return self._state.copy()
+        theta, theta_dot = self._state[:, 0], self._state[:, 1]
+        return np.stack(
+            [np.cos(theta), np.sin(theta), theta_dot], axis=1
+        ).astype(np.float32)
+
+    def host_step(self, actions: np.ndarray):
+        """Step all envs in native code; auto-reset inside. Same contract as
+        ``GymVecEnv.host_step`` (true pre-reset ``final_obs`` for truncation
+        bootstrapping)."""
+        n = self.n_envs
+        if self._discrete:
+            acts = np.ascontiguousarray(actions.reshape(n), np.int32)
+        else:
+            acts = np.ascontiguousarray(actions.reshape(n), np.float32)
+        next_obs = np.empty((n, self.obs_shape[0]), np.float32)
+        final_obs = np.empty_like(next_obs)
+        rewards = np.empty(n, np.float32)
+        terminated = np.empty(n, np.uint8)
+        truncated = np.empty(n, np.uint8)
+        self._step(
+            self._state, self._t, self._rng, acts,
+            np.int32(n), np.int32(self.max_episode_steps),
+            next_obs, final_obs, rewards, terminated, truncated,
+        )
+        terminated = terminated.astype(bool)
+        truncated = truncated.astype(bool)
+
+        self._running_returns += rewards
+        self._running_lengths += 1
+        self.last_episode_returns = self._running_returns.copy()
+        self.last_episode_lengths = self._running_lengths.copy()
+        ended = np.logical_or(terminated, truncated)
+        self._running_returns[ended] = 0.0
+        self._running_lengths[ended] = 0
+
+        self._obs = next_obs
+        return next_obs, rewards, terminated, truncated, final_obs
+
+    def current_obs(self) -> np.ndarray:
+        return self._obs
+
+    def close(self):
+        pass

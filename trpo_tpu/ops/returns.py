@@ -31,7 +31,17 @@ def _affine_combine(right, left):
     return a_out * a_in, b_out + a_out * b_in
 
 
-def _reverse_affine_scan(gammas, x):
+def _reverse_affine_scan(gammas, x, backend: str = "xla"):
+    """``y_t = x_t + γ_t·y_{t+1}``: O(log T)-depth associative scan
+    (``backend="xla"``) or the single-HBM-pass Pallas kernel
+    (``backend="pallas"``, (T, N) tensors only — see ``ops/pallas_scan.py``).
+    """
+    if backend == "pallas":
+        from trpo_tpu.ops.pallas_scan import reverse_affine_scan_pallas
+
+        return reverse_affine_scan_pallas(gammas, x)
+    if backend != "xla":
+        raise ValueError(f"unknown backend {backend!r}; have 'xla', 'pallas'")
     _, y = lax.associative_scan(_affine_combine, (gammas, x), reverse=True)
     return y
 
@@ -51,7 +61,7 @@ def discount(x: jax.Array, gamma: float) -> jax.Array:
 
 
 def discounted_returns_segmented(
-    rewards: jax.Array, dones: jax.Array, gamma: float
+    rewards: jax.Array, dones: jax.Array, gamma: float, backend: str = "xla"
 ) -> jax.Array:
     """Per-step discounted return with episode boundaries.
 
@@ -64,7 +74,7 @@ def discounted_returns_segmented(
         rewards = rewards.astype(jnp.float32)
     dones = jnp.asarray(dones).astype(rewards.dtype)
     gammas = gamma * (1.0 - dones)
-    return _reverse_affine_scan(gammas, rewards)
+    return _reverse_affine_scan(gammas, rewards, backend)
 
 
 def gae_from_next_values(
@@ -75,6 +85,7 @@ def gae_from_next_values(
     done: jax.Array,
     gamma: float,
     lam: float,
+    backend: str = "xla",
 ) -> tuple[jax.Array, jax.Array]:
     """GAE(λ) with explicit per-step successor values and a split
     terminated/done mask — the general form for packed vectorized rollouts.
@@ -91,7 +102,7 @@ def gae_from_next_values(
     terminated = jnp.asarray(terminated).astype(rewards.dtype)
     done = jnp.asarray(done).astype(rewards.dtype)
     deltas = rewards + gamma * (1.0 - terminated) * next_values - values
-    adv = _reverse_affine_scan(gamma * lam * (1.0 - done), deltas)
+    adv = _reverse_affine_scan(gamma * lam * (1.0 - done), deltas, backend)
     return adv, adv + values
 
 
