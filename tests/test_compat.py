@@ -1,0 +1,349 @@
+"""Tests for ``trpo_tpu.compat`` — the reference ``utils.py`` helper surface
+(reference ``utils.py:14-211``), checked against closed forms and against the
+production device ops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trpo_tpu import compat
+from trpo_tpu.envs.fake import FakeEnv
+
+
+# ---------------------------------------------------------------------------
+# discount (ref utils.py:14-16)
+# ---------------------------------------------------------------------------
+
+
+def test_discount_matches_closed_form():
+    gamma = 0.95
+    x = np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)
+    expected = np.zeros_like(x)
+    acc = 0.0
+    for t in reversed(range(len(x))):
+        acc = x[t] + gamma * acc
+        expected[t] = acc
+    out = compat.discount(x, gamma)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+    assert isinstance(out, np.ndarray)
+
+
+def test_discount_gamma_zero_is_identity():
+    x = np.asarray([3.0, -1.0, 2.0], np.float32)
+    np.testing.assert_allclose(compat.discount(x, 0.0), x, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# cat_sample (ref utils.py:95-105)
+# ---------------------------------------------------------------------------
+
+
+def test_cat_sample_respects_probabilities():
+    key = jax.random.key(0)
+    prob = np.tile(np.asarray([[0.8, 0.2]], np.float32), (4000, 1))
+    samples = compat.cat_sample(prob, key=key)
+    assert samples.shape == (4000,)
+    frac_zero = float(np.mean(samples == 0))
+    assert 0.75 < frac_zero < 0.85
+
+
+def test_cat_sample_degenerate_rows():
+    key = jax.random.key(1)
+    prob = np.asarray([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    samples = compat.cat_sample(prob, key=key)
+    np.testing.assert_array_equal(samples, [0, 1])
+
+
+def test_cat_sample_keyless_uses_module_stream():
+    compat.seed_everything(7)
+    a = compat.cat_sample(np.full((8, 3), 1 / 3, np.float32))
+    compat.seed_everything(7)
+    b = compat.cat_sample(np.full((8, 3), 1 / 3, np.float32))
+    np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# var_shape / numel / flatgrad (ref utils.py:108-122)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_params():
+    return {
+        "w": jnp.arange(6.0, dtype=jnp.float32).reshape(2, 3),
+        "b": jnp.ones((3,), jnp.float32),
+    }
+
+
+def test_var_shape_and_numel():
+    p = _tiny_params()
+    assert compat.var_shape(p["w"]) == [2, 3]
+    assert compat.numel(p["w"]) == 6
+    assert compat.numel(p) == 9
+
+
+def test_flatgrad_matches_manual():
+    p = _tiny_params()
+
+    def loss(params):
+        return jnp.sum(params["w"] ** 2) + jnp.sum(3.0 * params["b"])
+
+    g = compat.flatgrad(loss, p)
+    assert g.shape == (9,)
+    # ravel_pytree orders dict keys alphabetically: b before w
+    np.testing.assert_allclose(np.asarray(g[:3]), 3.0 * np.ones(3), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(g[3:]), 2.0 * np.arange(6.0), rtol=1e-6
+    )
+
+
+# ---------------------------------------------------------------------------
+# GetFlat / SetFromFlat (ref utils.py:125-158)
+# ---------------------------------------------------------------------------
+
+
+def test_get_set_flat_roundtrip():
+    p = _tiny_params()
+    gf = compat.GetFlat(p)
+    sff = compat.SetFromFlat(p)
+    theta = gf()
+    assert theta.shape == (9,)
+    rebuilt = sff(theta)
+    for k in p:
+        np.testing.assert_allclose(np.asarray(rebuilt[k]), np.asarray(p[k]))
+
+
+def test_set_from_flat_is_functional_and_validates():
+    p = _tiny_params()
+    sff = compat.SetFromFlat(p)
+    new = sff(np.zeros(9, np.float32))
+    # input pytree untouched (immutability, unlike the ref's tf.assign)
+    assert float(jnp.sum(jnp.abs(p["w"]))) > 0
+    assert float(jnp.sum(jnp.abs(new["w"]))) == 0
+    with pytest.raises(ValueError):
+        sff(np.zeros(5, np.float32))
+
+
+def test_get_flat_with_explicit_params():
+    p = _tiny_params()
+    gf = compat.GetFlat(p)
+    q = jax.tree_util.tree_map(lambda x: x * 2.0, p)
+    np.testing.assert_allclose(gf(q), 2.0 * gf(), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# slice_2d (ref utils.py:161-167)
+# ---------------------------------------------------------------------------
+
+
+def test_slice_2d_gathers_pairs():
+    x = np.arange(12).reshape(3, 4)
+    out = compat.slice_2d(x, [0, 1, 2], [3, 0, 2])
+    np.testing.assert_array_equal(np.asarray(out), [3, 4, 10])
+
+
+# ---------------------------------------------------------------------------
+# linesearch (ref utils.py:170-182)
+# ---------------------------------------------------------------------------
+
+
+def test_linesearch_accepts_full_step_on_quadratic():
+    # f(x) = |x|^2, full Newton step from x=2 lands at the optimum
+    f = lambda x: float(np.sum(np.asarray(x) ** 2))
+    x0 = np.asarray([2.0])
+    fullstep = np.asarray([-2.0])
+    out = compat.linesearch(f, x0, fullstep, expected_improve_rate=4.0)
+    np.testing.assert_allclose(out, [0.0], atol=1e-7)
+
+
+def test_linesearch_backtracks_on_overshoot():
+    f = lambda x: float(np.sum(np.asarray(x) ** 2))
+    x0 = np.asarray([1.0])
+    fullstep = np.asarray([-8.0])  # overshoots badly; 0.5^k shrinks it
+    out = compat.linesearch(f, x0, fullstep, expected_improve_rate=2.0)
+    assert float(np.sum(out**2)) < 1.0  # improved
+
+
+def test_linesearch_zero_expected_improvement_does_not_raise():
+    """ref semantics: NumPy division gives inf/nan instead of raising; an
+    inf ratio with positive actual improvement accepts the step."""
+    f = lambda x: float(np.sum(np.asarray(x) ** 2))
+    out = compat.linesearch(
+        f, np.asarray([1.0]), np.asarray([-1.0]), expected_improve_rate=0.0
+    )
+    np.testing.assert_allclose(out, [0.0], atol=1e-7)
+
+
+def test_linesearch_returns_original_on_failure():
+    f = lambda x: float(np.sum(np.asarray(x) ** 2))
+    x0 = np.asarray([0.0])  # already optimal; every step is worse
+    fullstep = np.asarray([1.0])
+    out = compat.linesearch(f, x0, fullstep, expected_improve_rate=1.0)
+    np.testing.assert_array_equal(out, x0)  # ref utils.py:182
+
+
+# ---------------------------------------------------------------------------
+# conjugate_gradient (ref utils.py:185-201)
+# ---------------------------------------------------------------------------
+
+
+def test_cg_matches_direct_solve():
+    rng = np.random.default_rng(0)
+    m = rng.normal(size=(12, 12))
+    a = m @ m.T + 12 * np.eye(12)  # SPD, well-conditioned
+    b = rng.normal(size=12)
+    x = compat.conjugate_gradient(lambda v: a @ v, b, cg_iters=50)
+    np.testing.assert_allclose(x, np.linalg.solve(a, b), rtol=1e-4)
+
+
+def test_cg_early_exit_on_identity():
+    b = np.asarray([1.0, 2.0, 3.0])
+    x = compat.conjugate_gradient(lambda v: v, b, cg_iters=10)
+    np.testing.assert_allclose(x, b, rtol=1e-6)
+
+
+def test_cg_matches_device_cg():
+    from trpo_tpu.ops.cg import conjugate_gradient as device_cg
+
+    rng = np.random.default_rng(3)
+    m = rng.normal(size=(8, 8)).astype(np.float32)
+    a = m @ m.T + 8 * np.eye(8, dtype=np.float32)
+    b = rng.normal(size=8).astype(np.float32)
+    x_host = compat.conjugate_gradient(lambda v: a @ v, b)
+    x_dev = device_cg(lambda v: jnp.asarray(a) @ v, jnp.asarray(b)).x
+    np.testing.assert_allclose(x_host, np.asarray(x_dev), atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# explained_variance (ref utils.py:208-211)
+# ---------------------------------------------------------------------------
+
+
+def test_explained_variance_perfect_and_zero():
+    y = np.asarray([1.0, 2.0, 3.0, 4.0])
+    assert compat.explained_variance(y, y) == pytest.approx(1.0)
+    # predicting the mean explains nothing
+    assert compat.explained_variance(np.full(4, 2.5), y) == pytest.approx(
+        0.0, abs=1e-6
+    )
+
+
+def test_explained_variance_nan_on_constant_targets():
+    y = np.ones(4)
+    assert np.isnan(compat.explained_variance(np.zeros(4), y))
+
+
+# ---------------------------------------------------------------------------
+# dict2 (ref utils.py:203-206)
+# ---------------------------------------------------------------------------
+
+
+def test_dict2_attribute_access():
+    d = compat.dict2(a=1, b="x")
+    assert d.a == 1 and d["b"] == "x"
+    d.c = 3
+    assert d["c"] == 3
+
+
+# ---------------------------------------------------------------------------
+# rollout (ref utils.py:18-45)
+# ---------------------------------------------------------------------------
+
+
+class _HostFakeEnv:
+    """Classic-gym wrapper over FakeEnv for the host collector."""
+
+    def __init__(self, chain_len=5):
+        self._env = FakeEnv(chain_len=chain_len)
+        self._state = None
+        self._key = jax.random.key(0)
+
+    def reset(self):
+        self._state, obs = self._env.reset(self._key)
+        return np.asarray(obs)
+
+    def step(self, action):
+        self._state, obs, reward, terminated, truncated = self._env.step(
+            self._state, jnp.asarray(action), self._key
+        )
+        done = bool(terminated) or bool(truncated)
+        return np.asarray(obs), float(reward), done, {}
+
+
+def _uniform_act(ob, key):
+    del ob
+    dist = np.asarray([0.5, 0.5], np.float32)
+    action = int(jax.random.bernoulli(key))
+    return action, dist
+
+
+def test_rollout_collects_enough_timesteps():
+    env = _HostFakeEnv(chain_len=5)
+    paths = compat.rollout(env, _uniform_act, max_pathlength=10, n_timesteps=12)
+    total = sum(len(p["rewards"]) for p in paths)
+    assert total >= 12
+    for p in paths:
+        assert set(p) == {"obs", "action_dists", "rewards", "actions"}
+        assert p["obs"].shape[0] == p["rewards"].shape[0]
+        assert p["action_dists"].shape == (len(p["rewards"]), 2)
+
+
+def test_rollout_truncation_packs_current_episode():
+    """The reference re-appends a stale path on truncation
+    (ref utils.py:44); ours packs the truncated episode itself."""
+    env = _HostFakeEnv(chain_len=50)  # episode longer than max_pathlength
+    paths = compat.rollout(env, _uniform_act, max_pathlength=4, n_timesteps=8)
+    assert all(len(p["rewards"]) == 4 for p in paths)
+    # each path's first obs is the reset obs (one-hot position 0)
+    for p in paths:
+        assert p["obs"][0][0] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# VF (ref utils.py:48-92)
+# ---------------------------------------------------------------------------
+
+
+def _make_path(t_len=20, obs_dim=3, seed=0):
+    rng = np.random.default_rng(seed)
+    obs = rng.normal(size=(t_len, obs_dim)).astype(np.float32)
+    path = {
+        "obs": obs,
+        "action_dists": np.full((t_len, 2), 0.5, np.float32),
+        "rewards": np.ones(t_len, np.float32),
+        "actions": np.zeros(t_len, np.int32),
+    }
+    # target: a simple linear function of obs — learnable by the critic
+    path["returns"] = (obs @ np.asarray([1.0, -2.0, 0.5])).astype(np.float32)
+    return path
+
+
+def test_vf_predicts_zeros_before_fit():
+    vf = compat.VF()
+    path = _make_path()
+    np.testing.assert_array_equal(
+        vf.predict(path), np.zeros(len(path["rewards"]), np.float32)
+    )
+
+
+def test_vf_fit_reduces_error():
+    vf = compat.VF(train_steps=50)
+    paths = [_make_path(seed=i) for i in range(4)]
+    returns = np.concatenate([p["returns"] for p in paths])
+    err_before = np.mean(
+        (np.concatenate([vf.predict(p) for p in paths]) - returns) ** 2
+    )
+    for _ in range(6):
+        vf.fit(paths)
+    err_after = np.mean(
+        (np.concatenate([vf.predict(p) for p in paths]) - returns) ** 2
+    )
+    assert err_after < 0.5 * err_before
+
+
+def test_vf_features_include_time_column():
+    vf = compat.VF()
+    path = _make_path(t_len=7, obs_dim=3)
+    feats = vf._features(path)
+    assert feats.shape == (7, 3 + 2 + 1)  # obs + action_dist + t/10
+    np.testing.assert_allclose(feats[:, -1], np.arange(7) / 10.0)

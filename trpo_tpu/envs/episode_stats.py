@@ -1,0 +1,42 @@
+"""Shared episode-return/length bookkeeping for host vectorized envs.
+
+Both host env families (``GymVecEnv``, ``NativeVecEnv``) expose
+``last_episode_returns`` / ``last_episode_lengths`` snapshots that
+``trpo_tpu.rollout`` and the agent's done-masked reward stats consume. The
+ordering contract is subtle (snapshot *includes* the current step, and the
+running accumulators reset *after* the snapshot, so a ``done`` step's
+snapshot holds that episode's final totals) — so it lives here once rather
+than being re-implemented per adapter.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["EpisodeStatsMixin"]
+
+
+class EpisodeStatsMixin:
+    """Mixin: call ``_init_episode_stats`` in ``__init__`` and
+    ``_update_episode_stats`` once per ``host_step``."""
+
+    def _init_episode_stats(self, n_envs: int) -> None:
+        self.last_episode_returns = np.zeros(n_envs, np.float32)
+        self.last_episode_lengths = np.zeros(n_envs, np.int64)
+        self._running_returns = np.zeros(n_envs, np.float32)
+        self._running_lengths = np.zeros(n_envs, np.int64)
+
+    def _update_episode_stats(
+        self, rewards: np.ndarray, ended: np.ndarray
+    ) -> None:
+        """Accumulate this step, snapshot, then zero finished episodes.
+
+        On a step where ``ended[i]`` is True, ``last_episode_returns[i]`` /
+        ``last_episode_lengths[i]`` hold episode totals including this final
+        step — the value the done-masked episode stats read."""
+        self._running_returns += rewards
+        self._running_lengths += 1
+        self.last_episode_returns = self._running_returns.copy()
+        self.last_episode_lengths = self._running_lengths.copy()
+        self._running_returns[ended] = 0.0
+        self._running_lengths[ended] = 0

@@ -16,12 +16,13 @@ from __future__ import annotations
 
 import numpy as np
 
+from trpo_tpu.envs.episode_stats import EpisodeStatsMixin
 from trpo_tpu.models.policy import BoxSpec, DiscreteSpec
 
 __all__ = ["GymVecEnv"]
 
 
-class GymVecEnv:
+class GymVecEnv(EpisodeStatsMixin):
     """N synchronous gymnasium envs with explicit pre-reset final obs."""
 
     def __init__(self, env_id: str, n_envs: int = 8, seed: int = 0, **kwargs):
@@ -51,10 +52,7 @@ class GymVecEnv:
         self._obs = np.stack(
             [env.reset(seed=seed + i)[0] for i, env in enumerate(self.envs)]
         )
-        self.last_episode_returns = np.zeros(n_envs, np.float32)
-        self.last_episode_lengths = np.zeros(n_envs, np.int64)
-        self._running_returns = np.zeros(n_envs, np.float32)
-        self._running_lengths = np.zeros(n_envs, np.int64)
+        self._init_episode_stats(n_envs)
 
     def host_step(self, actions: np.ndarray):
         """Step all envs; auto-reset finished ones.
@@ -84,13 +82,9 @@ class GymVecEnv:
                 obs_i, _ = env.reset()
             next_obs[i] = obs_i
 
-        self._running_returns += rewards
-        self._running_lengths += 1
-        self.last_episode_returns = self._running_returns.copy()
-        self.last_episode_lengths = self._running_lengths.copy()
-        ended = np.logical_or(terminated, truncated)
-        self._running_returns[ended] = 0.0
-        self._running_lengths[ended] = 0
+        self._update_episode_stats(
+            rewards, np.logical_or(terminated, truncated)
+        )
 
         self._obs = next_obs
         return next_obs, rewards, terminated, truncated, final_obs

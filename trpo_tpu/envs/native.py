@@ -18,12 +18,15 @@ from __future__ import annotations
 import ctypes
 import os
 import pathlib
+import shutil
 import subprocess
+import tempfile
 import threading
 from typing import Optional
 
 import numpy as np
 
+from trpo_tpu.envs.episode_stats import EpisodeStatsMixin
 from trpo_tpu.models.policy import BoxSpec, DiscreteSpec
 
 __all__ = ["NativeVecEnv", "native_available", "load_library"]
@@ -32,7 +35,6 @@ _NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
 _LIB_NAME = "libtrpo_native.so"
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
-_load_error: Optional[str] = None
 
 _f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
@@ -41,36 +43,53 @@ _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 
 
 def _build() -> pathlib.Path:
+    """Build the shared library if stale; atomic against concurrent builders.
+
+    Staleness is checked against every build input (source AND Makefile).
+    The compile runs in a scratch dir and the result is ``os.replace``d into
+    place — a concurrent process can never ``dlopen`` a half-written file,
+    it sees either the old library or the new one.
+    """
     lib_path = _NATIVE_DIR / _LIB_NAME
-    src = _NATIVE_DIR / "vec_env.cpp"
-    if lib_path.exists() and lib_path.stat().st_mtime >= src.stat().st_mtime:
+    inputs = [_NATIVE_DIR / "vec_env.cpp", _NATIVE_DIR / "Makefile"]
+    if lib_path.exists() and all(
+        lib_path.stat().st_mtime >= p.stat().st_mtime for p in inputs
+    ):
         return lib_path
-    subprocess.run(
-        ["make", "-s", _LIB_NAME],
-        cwd=_NATIVE_DIR,
-        check=True,
-        capture_output=True,
-        text=True,
-    )
+    with tempfile.TemporaryDirectory(dir=_NATIVE_DIR) as td:
+        scratch = pathlib.Path(td)
+        for p in inputs:
+            shutil.copy2(p, scratch / p.name)
+        subprocess.run(
+            ["make", "-s", _LIB_NAME],
+            cwd=scratch,
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+        # same directory => same filesystem => atomic rename
+        os.replace(scratch / _LIB_NAME, lib_path)
     return lib_path
 
 
 def load_library() -> ctypes.CDLL:
-    """Build (if needed) and load the native library; cached per process."""
-    global _lib, _load_error
+    """Build (if needed) and load the native library.
+
+    Success is cached per process; failure is NOT — a transient failure
+    (e.g. losing a build race, disk pressure) may clear on retry, and a
+    genuine toolchain failure re-raises fast.
+    """
+    global _lib
     with _lock:
         if _lib is not None:
             return _lib
-        if _load_error is not None:
-            raise RuntimeError(_load_error)
         try:
             lib = ctypes.CDLL(str(_build()))
         except (OSError, subprocess.CalledProcessError) as e:
             detail = getattr(e, "stderr", "") or str(e)
-            _load_error = (
+            raise RuntimeError(
                 f"native env library unavailable (build failed): {detail}"
-            )
-            raise RuntimeError(_load_error) from e
+            ) from e
 
         lib.trpo_native_seed.argtypes = [_u64p, ctypes.c_int32, ctypes.c_uint64]
         for prefix, act_p in (
@@ -98,14 +117,24 @@ def native_available() -> bool:
         return False
 
 
+def _default_horizon(kind: str) -> int:
+    """Default episode horizon, read from the JAX env class so the native
+    and JAX variants of the same env can never diverge on truncation."""
+    if kind == "cartpole":
+        from trpo_tpu.envs.cartpole import CartPole as cls
+    else:
+        from trpo_tpu.envs.pendulum import Pendulum as cls
+    return cls().max_episode_steps
+
+
 _KINDS = {
-    # kind -> (state_width, obs_dim, discrete_actions, default_max_steps)
-    "cartpole": (4, 4, True, 500),
-    "pendulum": (2, 3, False, 200),
+    # kind -> (state_width, obs_dim, discrete_actions)
+    "cartpole": (4, 4, True),
+    "pendulum": (2, 3, False),
 }
 
 
-class NativeVecEnv:
+class NativeVecEnv(EpisodeStatsMixin):
     """N batched native envs behind the ``GymVecEnv`` host interface."""
 
     def __init__(
@@ -118,7 +147,8 @@ class NativeVecEnv:
         if kind not in _KINDS:
             raise KeyError(f"unknown native env {kind!r}; have {sorted(_KINDS)}")
         self._lib = load_library()
-        state_w, obs_dim, discrete, default_steps = _KINDS[kind]
+        state_w, obs_dim, discrete = _KINDS[kind]
+        default_steps = _default_horizon(kind)
         self.kind = kind
         self.n_envs = n_envs
         self.max_episode_steps = (
@@ -138,10 +168,7 @@ class NativeVecEnv:
         self._reset(self._state, self._t, self._rng, n)
         self._obs = self._observe()
 
-        self.last_episode_returns = np.zeros(n, np.float32)
-        self.last_episode_lengths = np.zeros(n, np.int64)
-        self._running_returns = np.zeros(n, np.float32)
-        self._running_lengths = np.zeros(n, np.int64)
+        self._init_episode_stats(n)
 
     def _observe(self) -> np.ndarray:
         if self.kind == "cartpole":
@@ -173,13 +200,9 @@ class NativeVecEnv:
         terminated = terminated.astype(bool)
         truncated = truncated.astype(bool)
 
-        self._running_returns += rewards
-        self._running_lengths += 1
-        self.last_episode_returns = self._running_returns.copy()
-        self.last_episode_lengths = self._running_lengths.copy()
-        ended = np.logical_or(terminated, truncated)
-        self._running_returns[ended] = 0.0
-        self._running_lengths[ended] = 0
+        self._update_episode_stats(
+            rewards, np.logical_or(terminated, truncated)
+        )
 
         self._obs = next_obs
         return next_obs, rewards, terminated, truncated, final_obs
