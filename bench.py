@@ -73,7 +73,13 @@ BATCH = 50_000
 CG_ITERS = 10
 DAMPING = 0.1
 SOLVE_REPS = 5
-BASELINE_REPS = 2
+BASELINE_REPS = 1      # 10 full-batch CPU FVPs per rep — each is seconds
+
+_T0 = time.perf_counter()
+
+
+def _progress(msg: str) -> None:
+    print(f"bench[{time.perf_counter() - _T0:7.1f}s] {msg}", file=sys.stderr)
 
 
 def build_problem():
@@ -96,23 +102,43 @@ def build_problem():
     return kl_fn, flat0, g
 
 
-def time_fused_solve(kl_fn, flat0, g):
+def time_fused_solve(kl_fn, flat0, g, device=None):
     """Our path: CG + FVP as ONE device program, forced to CG_ITERS iters
-    (residual_tol=0 → no early exit; equal work vs the baseline loop)."""
+    (residual_tol=0 → no early exit; equal work vs the baseline loop).
+
+    ``device=None`` uses the default backend; passing an explicit device
+    (the CPU-fallback path) pins compilation and data there — config-level
+    platform switches don't work once backends are initialized.
+    """
+    import contextlib
+
     from trpo_tpu.ops import conjugate_gradient, make_fvp
 
-    @jax.jit
-    def solve(flat0, g):
-        fvp = make_fvp(lambda f: kl_fn(f), flat0, DAMPING)
-        return conjugate_gradient(fvp, -g, CG_ITERS, residual_tol=0.0).x
+    ctx = (
+        jax.default_device(device)
+        if device is not None
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        if device is not None:
+            flat0 = jax.device_put(np.asarray(flat0), device)
+            g = jax.device_put(np.asarray(g), device)
 
-    x = solve(flat0, g)           # compile + warm
-    jax.block_until_ready(x)
-    t0 = time.perf_counter()
-    for _ in range(SOLVE_REPS):
-        x = solve(flat0, g)
-    jax.block_until_ready(x)
-    dt = time.perf_counter() - t0
+        @jax.jit
+        def solve(flat0, g):
+            fvp = make_fvp(lambda f: kl_fn(f), flat0, DAMPING)
+            return conjugate_gradient(fvp, -g, CG_ITERS, residual_tol=0.0).x
+
+        _progress("fused solve: compiling")
+        x = solve(flat0, g)           # compile + warm
+        jax.block_until_ready(x)
+        _progress("fused solve: timing")
+        t0 = time.perf_counter()
+        for _ in range(SOLVE_REPS):
+            x = solve(flat0, g)
+        jax.block_until_ready(x)
+        dt = time.perf_counter() - t0
+        _progress("fused solve: done")
     return dt / (SOLVE_REPS * CG_ITERS) * 1e3, x
 
 
@@ -150,17 +176,40 @@ def time_reference_semantics(kl_fn, flat0, g):
                 rdotr = new_rdotr
             return x
 
-        x = cg_host()                         # compile + warm
+        _progress("baseline: compiling")
+        fvp_host(b)                           # compile + warm (one FVP)
+        _progress("baseline: timing")
         t0 = time.perf_counter()
         for _ in range(BASELINE_REPS):
             x = cg_host()
         dt = time.perf_counter() - t0
+        _progress("baseline: done")
     return dt / (BASELINE_REPS * CG_ITERS) * 1e3, x
 
 
 def main():
+    global _ACCEL
     kl_fn, flat0, g = build_problem()
-    ours_ms, x_ours = time_fused_solve(kl_fn, flat0, g)
+    try:
+        ours_ms, x_ours = time_fused_solve(kl_fn, flat0, g)
+    except Exception as e:  # tunnel flake mid-compile/run — retry once
+        _progress(f"accelerator attempt failed ({type(e).__name__}: {e}); "
+                  "retrying once")
+        try:
+            ours_ms, x_ours = time_fused_solve(kl_fn, flat0, g)
+        except Exception as e2:
+            if not _ACCEL:
+                raise  # already on CPU; a failure here is a real bug
+            _progress(f"retry failed ({type(e2).__name__}); falling back to "
+                      "CPU for the fused path")
+            # backends are already initialized, so a config-level platform
+            # switch is a no-op — pin the CPU device explicitly, and rebuild
+            # the problem there (kl_fn closes over accelerator-resident obs)
+            _ACCEL = False
+            cpu = jax.devices("cpu")[0]
+            with jax.default_device(cpu):
+                kl_fn, flat0, g = build_problem()
+            ours_ms, x_ours = time_fused_solve(kl_fn, flat0, g, device=cpu)
     base_ms, x_base = time_reference_semantics(kl_fn, flat0, g)
 
     # Both solvers must agree — a fast wrong solve is worthless.
@@ -178,7 +227,7 @@ def main():
                 "unit": "ms/iter",
                 "vs_baseline": round(base_ms / ours_ms, 2),
                 "baseline_ms_per_iter": round(base_ms, 3),
-                "backend": jax.default_backend(),
+                "backend": list(x_ours.devices())[0].platform,
                 "solution_cosine": round(cos, 6),
             }
         )

@@ -1,0 +1,161 @@
+"""TRPO written the reference's way, on this framework's compat surface.
+
+The reference composes its training loop by hand from the ``utils.py``
+toolbox: host rollouts, ``discount`` for returns, a lazily-built ``VF``
+baseline, flat-vector gradients, host-loop ``conjugate_gradient`` over a
+Fisher-vector-product closure, and host-loop ``linesearch``
+(reference ``trpo_inksci.py:88-176``). This example reproduces that exact
+workflow — every helper from ``trpo_tpu.compat``, the environment stepped by
+the native C++ batched stepper — so a user of the reference can see their
+code shape port one-to-one.
+
+It is also, deliberately, a demonstration of *why the fused path exists*:
+every CG iteration and line-search probe below is a host↔device round trip,
+exactly the reference's #1 performance defect (SURVEY §1). The production
+API (``examples/quickstart.py``) compiles the whole update into one XLA
+program instead.
+
+Run:  python examples/reference_style.py
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # sized for CPU; see quickstart.py
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from trpo_tpu import compat  # noqa: E402
+from trpo_tpu.envs.native import NativeVecEnv, native_available  # noqa: E402
+from trpo_tpu.models import DiscreteSpec, make_policy  # noqa: E402
+
+config = {
+    "max_pathlength": 200,
+    "timesteps_per_batch": 1000,
+    "gamma": 0.95,          # ref trpo_inksci.py:17
+    "cg_damping": 0.1,
+    "max_kl": 0.01,
+    "iterations": 15,
+}
+
+
+class SingleEnv:
+    """Classic-gym facade (reset() -> ob, step(a) -> (ob, r, done, info))
+    over the batched native stepper, batch size 1 — the reference's serial
+    env protocol (reference ``utils.py:18-45``)."""
+
+    def __init__(self):
+        self.vec = NativeVecEnv(
+            "cartpole", n_envs=1, seed=0,
+            max_episode_steps=config["max_pathlength"],
+        )
+
+    def reset(self):
+        return self.vec.reset_all()[0]
+
+    def step(self, action):
+        nxt, rew, term, trunc, _final = self.vec.host_step(
+            np.asarray([action])
+        )
+        return nxt[0], float(rew[0]), bool(term[0] or trunc[0]), {}
+
+
+def main():
+    assert native_available(), "native env library failed to build"
+    compat.seed_everything(1)  # ref utils.py:7-10, made explicit
+
+    env = SingleEnv()
+    policy = make_policy((4,), DiscreteSpec(2), hidden=(64,))
+    params = policy.init(jax.random.key(0))
+    gf = compat.GetFlat(params)
+    sff = compat.SetFromFlat(params)
+    vf = compat.VF()
+
+    @jax.jit
+    def action_probs(params, ob):
+        return jax.nn.softmax(policy.apply(params, ob[None])["logits"])[0]
+
+    def act(ob, key):
+        prob = np.asarray(action_probs(params, jnp.asarray(ob, jnp.float32)))
+        return int(compat.cat_sample(prob[None], key=key)[0]), prob
+
+    for iteration in range(config["iterations"]):
+        # -- rollout + returns + advantages (ref trpo_inksci.py:95-117) ---
+        paths = compat.rollout(
+            env, act, config["max_pathlength"], config["timesteps_per_batch"]
+        )
+        for path in paths:
+            path["returns"] = compat.discount(path["rewards"], config["gamma"])
+            path["baseline"] = vf.predict(path)
+            path["advant"] = path["returns"] - path["baseline"]
+
+        obs = jnp.asarray(np.concatenate([p["obs"] for p in paths]))
+        actions = jnp.asarray(np.concatenate([p["actions"] for p in paths]))
+        old_dist = jnp.asarray(
+            np.concatenate([p["action_dists"] for p in paths])
+        )
+        advant = np.concatenate([p["advant"] for p in paths])
+        advant = jnp.asarray((advant - advant.mean()) / (advant.std() + 1e-8))
+        vf.fit(paths)  # ref trpo_inksci.py:143
+
+        # -- losses over the flat-parameter vector (SURVEY §1 contract) ---
+        n = len(actions)
+
+        def surrogate(theta):
+            new_dist = jax.nn.softmax(policy.apply(sff(theta), obs)["logits"])
+            idx = jnp.arange(n)
+            ratio = compat.slice_2d(new_dist, idx, actions) / compat.slice_2d(
+                old_dist, idx, actions
+            )
+            return -jnp.mean(ratio * advant)  # ref trpo_inksci.py:44-48
+
+        def kl(theta):
+            new_dist = jax.nn.softmax(policy.apply(sff(theta), obs)["logits"])
+            return (
+                jnp.sum(old_dist * jnp.log((old_dist + 1e-8) / (new_dist + 1e-8)))
+                / n
+            )
+
+        theta_prev = jnp.asarray(gf(params))
+        g = np.asarray(jax.grad(surrogate)(theta_prev))
+
+        # -- natural-gradient solve (ref trpo_inksci.py:124-126,147-150) --
+        grad_kl = jax.grad(kl)
+
+        def fisher_vector_product(v):
+            hv = jax.jvp(
+                grad_kl, (theta_prev,), (jnp.asarray(v, jnp.float32),)
+            )[1]
+            return np.asarray(hv) + config["cg_damping"] * np.asarray(v)
+
+        stepdir = compat.conjugate_gradient(fisher_vector_product, -g)
+        shs = 0.5 * stepdir.dot(fisher_vector_product(stepdir))
+        fullstep = stepdir * np.sqrt(2 * config["max_kl"] / shs)
+
+        # -- line search + commit (ref trpo_inksci.py:153-158) ------------
+        theta_new = compat.linesearch(
+            lambda th: float(surrogate(jnp.asarray(th, jnp.float32))),
+            np.asarray(theta_prev),
+            fullstep,
+            -g.dot(fullstep),
+        )
+        params = sff(jnp.asarray(theta_new, jnp.float32))
+
+        mean_reward = float(np.mean([p["rewards"].sum() for p in paths]))
+        ev = compat.explained_variance(
+            np.concatenate([vf.predict(p) for p in paths]),
+            np.concatenate([p["returns"] for p in paths]),
+        )
+        print(
+            f"iter {iteration:2d}  mean_reward {mean_reward:7.1f}  "
+            f"explained_variance {ev:5.2f}"
+        )
+
+
+if __name__ == "__main__":
+    main()

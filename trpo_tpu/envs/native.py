@@ -207,6 +207,18 @@ class NativeVecEnv(EpisodeStatsMixin):
         self._obs = next_obs
         return next_obs, rewards, terminated, truncated, final_obs
 
+    def reset_all(self) -> np.ndarray:
+        """Hard-reset every env (fresh episodes); returns the new obs batch.
+
+        Auto-reset inside ``host_step`` covers steady-state training; this
+        is for callers that need episode boundaries under their own control
+        (e.g. reference-style serial rollouts)."""
+        self._reset(self._state, self._t, self._rng, self.n_envs)
+        self._obs = self._observe()
+        self._running_returns[:] = 0.0
+        self._running_lengths[:] = 0
+        return self._obs
+
     def current_obs(self) -> np.ndarray:
         return self._obs
 

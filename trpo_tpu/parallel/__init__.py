@@ -11,6 +11,8 @@ from sharding annotations — there is no NCCL/MPI code to write, by design.
 - ``mesh.py``    — mesh construction + multi-host (DCN) initialization
 - ``sharded.py`` — sharded TRPO update / full iteration; explicit
   ``shard_map``+``psum`` Fisher-vector product
+- ``seq.py``     — sequence (time-axis) parallelism: block-parallel
+  returns/GAE scans over trajectories sharded on a ``"seq"`` mesh axis
 """
 
 from trpo_tpu.parallel.mesh import (  # noqa: F401
@@ -22,4 +24,9 @@ from trpo_tpu.parallel.sharded import (  # noqa: F401
     shard_leading_axis,
     make_sharded_update,
     make_sharded_fvp,
+)
+from trpo_tpu.parallel.seq import (  # noqa: F401
+    sharded_reverse_affine_scan,
+    seq_sharded_returns,
+    seq_sharded_gae,
 )

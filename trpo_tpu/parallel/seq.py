@@ -1,0 +1,183 @@
+"""Sequence (time-axis) parallelism for long trajectories.
+
+The reference's notion of "sequence" is the episode trajectory, iterated by
+a host Python loop with an O(T) SciPy filter for returns (reference
+``utils.py:14-16,27``) — nothing distributed. This framework treats long
+trajectories as a first-class sharding axis: a ``(T, N)`` trajectory batch
+can be laid out with **T sharded across the mesh**, and the
+returns/GAE recurrences — the only cross-timestep computation TRPO has —
+run as a *block-parallel* scan:
+
+1. each device scans its local T-block independently (O(T/D) work),
+2. per-block affine summaries (one ``(a, b)`` pair per column) are
+   ``all_gather``ed over the ``seq`` axis — D pairs total, a few KB,
+   riding ICI,
+3. every device combines the summaries for the blocks to its right and
+   applies the incoming carry to its local block.
+
+This is the same block-summary + carry-exchange decomposition ring-attention
+style context parallelism uses for attention — applied to the linear
+recurrence this workload actually has. Total comms per scan: one
+``(2, D, N)`` gather instead of materializing the full ``(T, N)`` anywhere.
+
+Composes with data parallelism: a 2-D ``("data", "seq")`` mesh shards N
+across ``data`` and T across ``seq``; the gather stays within each ``seq``
+ring.
+
+Everything here is exact — results match the single-device
+``lax.associative_scan`` to float tolerance (asserted by
+``tests/test_seq_parallel.py`` on the 8-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from trpo_tpu.ops.returns import _affine_combine
+
+__all__ = [
+    "sharded_reverse_affine_scan",
+    "seq_sharded_returns",
+    "seq_sharded_gae",
+]
+
+
+def _local_reverse_scan(gammas, x):
+    """Single-block reverse affine scan.
+
+    Returns ``(y_local, a_cum)``: ``y_local`` is the block result assuming a
+    zero carry entering from the right; ``a_cum[t]`` is the product of
+    gammas from ``t`` to the block end. The block acts on the true incoming
+    carry ``c`` as ``y_t = y_local[t] + a_cum[t] · c``; its affine summary
+    is ``(a_cum[0], y_local[0])``.
+    """
+    a_cum, y_local = lax.associative_scan(
+        _affine_combine, (gammas, x), reverse=True
+    )
+    return y_local, a_cum
+
+
+def sharded_reverse_affine_scan(gammas, x, axis_name: str):
+    """``y_t = x_t + γ_t·y_{t+1}`` over a time axis sharded on ``axis_name``.
+
+    Call inside ``shard_map`` where ``gammas``/``x`` are the local
+    ``(T/D, ...)`` blocks of a globally ``(T, ...)`` array, sharded in
+    *time order* (device i holds timesteps ``[i·T/D, (i+1)·T/D)``).
+    """
+    y_local, a_cum = _local_reverse_scan(gammas, x)
+
+    idx = lax.axis_index(axis_name)
+    n_dev = lax.axis_size(axis_name)  # static mesh-axis size
+    # block summaries from every device: shapes (D, ...) — tiny
+    a_all = lax.all_gather(a_cum[0], axis_name)
+    b_all = lax.all_gather(y_local[0], axis_name)
+
+    # carry entering block i from the right = y at the first row of block
+    # i+1 = reverse-affine recurrence over the block summaries of i+1..D-1.
+    # D is the mesh axis size (small, static) — an unrolled host loop over
+    # blocks compiles to D fused steps; no scan bookkeeping needed.
+    carry = jnp.zeros_like(y_local[0])
+    carries = [carry]  # carries[j] = carry entering block D-1-j
+    for j in range(1, n_dev):
+        src = n_dev - j  # block whose summary extends the carry
+        carry = b_all[src] + a_all[src] * carry
+        carries.append(carry)
+    # carries list is indexed by D-1-i; select this device's entry
+    stacked = jnp.stack(carries[::-1])  # now indexed by block id i
+    my_carry = stacked[idx]
+
+    return y_local + a_cum * my_carry
+
+
+def _spec(seq_axis: str, batch_axis):
+    return P(seq_axis, batch_axis)
+
+
+# jitted shard_map programs, keyed by everything that changes the trace —
+# repeated per-iteration calls hit the executable cache instead of
+# re-tracing (the cached-jit convention of parallel/sharded.py)
+_scan_cache: dict = {}
+
+
+def _returns_fn(mesh, gamma, seq_axis, batch_axis):
+    key = ("returns", mesh, gamma, seq_axis, batch_axis)
+    if key not in _scan_cache:
+        spec = _spec(seq_axis, batch_axis)
+
+        def f(rew, dn):
+            gammas = gamma * (1.0 - dn.astype(rew.dtype))
+            return sharded_reverse_affine_scan(gammas, rew, seq_axis)
+
+        _scan_cache[key] = jax.jit(
+            shard_map(f, mesh=mesh, in_specs=(spec, spec), out_specs=spec)
+        )
+    return _scan_cache[key]
+
+
+def seq_sharded_returns(
+    mesh: Mesh,
+    rewards,
+    dones,
+    gamma: float,
+    seq_axis: str = "seq",
+    batch_axis=None,
+):
+    """Segmented discounted returns with the time axis sharded over the mesh.
+
+    Semantics match ``trpo_tpu.ops.returns.discounted_returns_segmented``
+    exactly (``done`` zeroes the discount across episode boundaries); the
+    ``(T, N)`` inputs/outputs are sharded ``P(seq_axis, batch_axis)``.
+    """
+    sharding = NamedSharding(mesh, _spec(seq_axis, batch_axis))
+    rewards = jax.device_put(jnp.asarray(rewards, jnp.float32), sharding)
+    dones = jax.device_put(jnp.asarray(dones, jnp.float32), sharding)
+    return _returns_fn(mesh, float(gamma), seq_axis, batch_axis)(
+        rewards, dones
+    )
+
+
+def seq_sharded_gae(
+    mesh: Mesh,
+    rewards,
+    values,
+    next_values,
+    terminated,
+    dones,
+    gamma: float,
+    lam: float,
+    seq_axis: str = "seq",
+    batch_axis=None,
+):
+    """GAE(λ) advantages + value targets, time-sharded over the mesh.
+
+    Matches ``trpo_tpu.ops.returns.gae_from_next_values``: the TD deltas are
+    elementwise (``next_values`` carries the true successor values, so no
+    halo exchange is needed at block boundaries), and the λ-discounted
+    accumulation is the block-parallel scan. Returns ``(advantages,
+    value_targets)`` with the input sharding.
+    """
+    key = ("gae", mesh, float(gamma), float(lam), seq_axis, batch_axis)
+    if key not in _scan_cache:
+        spec = _spec(seq_axis, batch_axis)
+
+        def f(rew, v, nv, term, dn):
+            delta = rew + gamma * nv * (1.0 - term.astype(rew.dtype)) - v
+            gammas = gamma * lam * (1.0 - dn.astype(rew.dtype))
+            adv = sharded_reverse_affine_scan(gammas, delta, seq_axis)
+            return adv, adv + v
+
+        _scan_cache[key] = jax.jit(
+            shard_map(
+                f, mesh=mesh, in_specs=(spec,) * 5, out_specs=(spec, spec)
+            )
+        )
+    sharding = NamedSharding(mesh, _spec(seq_axis, batch_axis))
+    args = [
+        jax.device_put(jnp.asarray(a, jnp.float32), sharding)
+        for a in (rewards, values, next_values, terminated, dones)
+    ]
+    return _scan_cache[key](*args)
