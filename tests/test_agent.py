@@ -112,3 +112,27 @@ def test_cartpole_learns():
     )
     best = max(rewards)
     assert best >= 400.0, f"best mean episode reward {best}; curve={rewards}"
+
+
+def test_evaluate_greedy_device_env():
+    """ref trpo_inksci.py:137-141 — post-training eval phase, as a method."""
+    cfg = TRPOConfig(env="cartpole", n_envs=4, batch_timesteps=256, seed=0)
+    agent = TRPOAgent("cartpole", cfg)
+    state = agent.init_state()
+    mean_ret, n_done = agent.evaluate(state, n_steps=128)
+    assert n_done > 0            # untrained pole falls well inside 128 steps
+    assert np.isfinite(mean_ret) and mean_ret > 0
+
+
+def test_evaluate_greedy_host_env():
+    pytest.importorskip("ctypes")
+    from trpo_tpu.envs.native import native_available
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    cfg = TRPOConfig(env="native:cartpole", n_envs=4, batch_timesteps=256, seed=0)
+    agent = TRPOAgent("native:cartpole", cfg)
+    state = agent.init_state()
+    mean_ret, n_done = agent.evaluate(state, n_steps=128)
+    assert n_done > 0
+    assert np.isfinite(mean_ret) and mean_ret > 0

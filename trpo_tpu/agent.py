@@ -21,10 +21,12 @@ quirks list), the seven printed stats.
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from trpo_tpu import envs as envs_lib
 from trpo_tpu.config import TRPOConfig
@@ -124,6 +126,8 @@ class TRPOAgent:
         if self.is_device_env:
             self._iter_fn = jax.jit(self._device_iteration)
         self._act_fn = jax.jit(self._act, static_argnames=("eval_mode",))
+        self._eval_roll_fns: dict = {}   # n_steps -> jitted eval rollout
+        self._host_eval_act_fn = None
 
     # ------------------------------------------------------------------
     # state
@@ -351,6 +355,61 @@ class TRPOAgent:
         return self._host_act_fn
 
     # ------------------------------------------------------------------
+    # evaluate (ref trpo_inksci.py:137-141 — the post-stop eval phase)
+    # ------------------------------------------------------------------
+
+    def evaluate(self, train_state: TrainState, n_steps: Optional[int] = None,
+                 seed: int = 0):
+        """Greedy-policy evaluation: fresh episodes, mode/argmax actions.
+
+        The reference, after hitting its reward target, flips ``train=False``
+        and runs 100 more render+argmax batches (``trpo_inksci.py:137-141``).
+        This is that phase as a function: ``n_steps`` timesteps per env
+        (default: one training batch's worth), no parameter updates, no
+        render. Returns ``(mean_episode_reward, episodes_completed)``
+        over episodes that finish inside the window.
+        """
+        n_steps = self.n_steps if n_steps is None else n_steps
+        if n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        k_init, k_roll = jax.random.split(jax.random.key(seed))
+        if self.is_device_env:
+            fn = self._eval_roll_fns.get(n_steps)
+            if fn is None:
+                fn = jax.jit(
+                    partial(device_rollout, self.env, self.policy,
+                            deterministic=True, n_steps=n_steps)
+                )
+                self._eval_roll_fns[n_steps] = fn
+            carry = init_carry(self.env, k_init, self.cfg.n_envs)
+            _, traj = fn(train_state.policy_params, carry, k_roll)
+        else:
+            self.env.reset_all()
+            if self._host_eval_act_fn is None:
+                policy = self.policy
+
+                def greedy(params, obs, k):
+                    dist = policy.apply(params, obs)
+                    return policy.dist.mode(dist), dist
+
+                self._host_eval_act_fn = jax.jit(greedy)
+            traj = host_rollout(
+                self.env, self.policy, train_state.policy_params, k_roll,
+                n_steps, act_fn=self._host_eval_act_fn,
+            )
+        done = np.asarray(traj.done)
+        rets = np.asarray(traj.episode_return)
+        n_done = int(done.sum())
+        if n_done:
+            mean_ret = float(rets[done].mean())
+        else:
+            # no episode finished inside the window (a good greedy policy on
+            # an unbounded task) — report the partial-episode return, which
+            # lower-bounds the true mean; episodes_completed = 0 signals it
+            mean_ret = float(rets[-1].mean())
+        return mean_ret, n_done
+
+    # ------------------------------------------------------------------
     # learn (ref trpo_inksci.py:88-176)
     # ------------------------------------------------------------------
 
@@ -361,6 +420,7 @@ class TRPOAgent:
         logger: Optional[StatsLogger] = None,
         checkpointer=None,
         callback=None,
+        use_jax_profiler: bool = False,
     ) -> TrainState:
         """Outer training loop.
 
@@ -375,7 +435,9 @@ class TRPOAgent:
         state = state or self.init_state()
         own_logger = logger is None
         logger = logger or StatsLogger(jsonl_path=cfg.log_jsonl)
-        timer = PhaseTimer()
+        # with use_jax_profiler, phases appear as named TraceAnnotations in
+        # jax.profiler traces (the CLI's --profile-dir wires this through)
+        timer = PhaseTimer(use_jax_profiler=use_jax_profiler)
 
         try:
             for _ in range(n_iterations):

@@ -55,6 +55,7 @@ def device_rollout(
     carry,
     key,
     n_steps: int,
+    deterministic: bool = False,
 ):
     """Collect ``n_steps × n_envs`` transitions fully on-device.
 
@@ -63,6 +64,10 @@ def device_rollout(
     training iterations so episodes continue rather than restarting every
     batch (the reference restarts its env every batch, discarding progress
     mid-episode — see ``utils.py:22-26``).
+
+    ``deterministic=True`` acts greedily (distribution mode) instead of
+    sampling — the reference's eval path (``trpo_inksci.py:82-83``) minus
+    the render call.
 
     Jit-safe: designed to be traced inside the full training-step program.
     Returns ``(new_carry, Trajectory)``.
@@ -75,7 +80,10 @@ def device_rollout(
         n = obs.shape[0]
 
         dist = policy.apply(params, obs)
-        actions = policy.dist.sample(k_act, dist)
+        if deterministic:
+            actions = policy.dist.mode(dist)
+        else:
+            actions = policy.dist.sample(k_act, dist)
 
         step_keys = jax.random.split(k_step, n)
         new_states, next_obs, rewards, terminated, truncated = jax.vmap(

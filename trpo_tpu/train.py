@@ -60,6 +60,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--debug-nans", action="store_true", help="enable jax NaN checking"
     )
+    p.add_argument(
+        "--profile-dir",
+        help="write a jax.profiler (TensorBoard/Perfetto) trace of the run "
+        "here; phase names from PhaseTimer annotate the timeline",
+    )
+    p.add_argument(
+        "--evaluate",
+        type=int,
+        metavar="N_STEPS",
+        default=None,
+        help="after training, run a greedy (argmax/mode) evaluation rollout "
+        "of N_STEPS per env and print its mean episode reward (the "
+        "reference's post-stop eval phase)",
+    )
     return p
 
 
@@ -116,14 +130,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"resumed from step {checkpointer.latest_step()}")
 
     logger = StatsLogger(jsonl_path=cfg.log_jsonl)
-    final = agent.learn(
-        state=state, logger=logger, checkpointer=checkpointer
+
+    import contextlib
+
+    import jax
+
+    profile_ctx = (
+        jax.profiler.trace(args.profile_dir)
+        if args.profile_dir
+        else contextlib.nullcontext()
     )
+    with profile_ctx:
+        final = agent.learn(
+            state=state,
+            logger=logger,
+            checkpointer=checkpointer,
+            use_jax_profiler=bool(args.profile_dir),
+        )
     print(
         f"done: {int(final.iteration)} iterations, "
         f"{int(final.total_timesteps)} timesteps, "
         f"{int(final.total_episodes)} episodes"
     )
+    if args.evaluate is not None:
+        mean_ret, n_done = agent.evaluate(final, n_steps=args.evaluate)
+        if n_done:
+            print(
+                f"greedy eval: mean episode reward {mean_ret:.1f} "
+                f"over {n_done} episodes"
+            )
+        else:
+            print(
+                f"greedy eval: no episode finished in {args.evaluate} steps; "
+                f"partial-episode reward ≥ {mean_ret:.1f}"
+            )
     logger.close()
     return 0
 
