@@ -102,6 +102,57 @@ def build_problem():
     return kl_fn, flat0, g
 
 
+def time_full_update(device=None):
+    """Secondary tracked metric (BASELINE.json): policy-updates/sec — the
+    ENTIRE fused natural-gradient update (surrogate grad → 10-iter CG over
+    FVPs → step scale → line search → KL rollback) as one jitted program at
+    the Humanoid operating point."""
+    import contextlib
+
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.models import make_policy, BoxSpec
+    from trpo_tpu.trpo import TRPOBatch, make_trpo_update
+
+    ctx = (
+        jax.default_device(device)
+        if device is not None
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        policy = make_policy((OBS_DIM,), BoxSpec(ACT_DIM), hidden=HIDDEN)
+        params = policy.init(jax.random.key(0))
+        obs = jax.random.normal(
+            jax.random.key(1), (BATCH, OBS_DIM), jnp.float32
+        )
+        dist = policy.apply(params, obs)
+        actions = policy.dist.sample(jax.random.key(2), dist)
+        batch = TRPOBatch(
+            obs=obs,
+            actions=actions,
+            advantages=jax.random.normal(
+                jax.random.key(3), (BATCH,), jnp.float32
+            ),
+            old_dist=dist,
+            weight=jnp.ones((BATCH,), jnp.float32),
+        )
+        cfg = TRPOConfig(
+            cg_iters=CG_ITERS, cg_damping=DAMPING, cg_residual_tol=0.0
+        )
+        update = jax.jit(make_trpo_update(policy, cfg))
+
+        _progress("full update: compiling")
+        new_params, stats = update(params, batch)
+        jax.block_until_ready(new_params)
+        _progress("full update: timing")
+        t0 = time.perf_counter()
+        for _ in range(SOLVE_REPS):
+            new_params, stats = update(params, batch)
+        jax.block_until_ready(new_params)
+        dt = time.perf_counter() - t0
+        _progress("full update: done")
+    return SOLVE_REPS / dt, dt / SOLVE_REPS * 1e3
+
+
 def time_fused_solve(kl_fn, flat0, g, device=None):
     """Our path: CG + FVP as ONE device program, forced to CG_ITERS iters
     (residual_tol=0 → no early exit; equal work vs the baseline loop).
@@ -210,6 +261,12 @@ def main():
             with jax.default_device(cpu):
                 kl_fn, flat0, g = build_problem()
             ours_ms, x_ours = time_fused_solve(kl_fn, flat0, g, device=cpu)
+    upd_dev = None if _ACCEL else jax.devices("cpu")[0]
+    try:
+        updates_per_sec, update_ms = time_full_update(device=upd_dev)
+    except Exception as e:  # secondary metric must not sink the headline
+        _progress(f"full-update timing failed ({type(e).__name__}: {e})")
+        updates_per_sec = update_ms = None
     base_ms, x_base = time_reference_semantics(kl_fn, flat0, g)
 
     # Both solvers must agree — a fast wrong solve is worthless.
@@ -229,6 +286,12 @@ def main():
                 "baseline_ms_per_iter": round(base_ms, 3),
                 "backend": list(x_ours.devices())[0].platform,
                 "solution_cosine": round(cos, 6),
+                "policy_updates_per_sec": None
+                if updates_per_sec is None
+                else round(updates_per_sec, 2),
+                "full_update_ms": None
+                if update_ms is None
+                else round(update_ms, 3),
             }
         )
     )

@@ -125,7 +125,6 @@ def test_evaluate_greedy_device_env():
 
 
 def test_evaluate_greedy_host_env():
-    pytest.importorskip("ctypes")
     from trpo_tpu.envs.native import native_available
 
     if not native_available():
@@ -136,3 +135,21 @@ def test_evaluate_greedy_host_env():
     mean_ret, n_done = agent.evaluate(state, n_steps=128)
     assert n_done > 0
     assert np.isfinite(mean_ret) and mean_ret > 0
+
+
+def test_evaluate_host_env_seed_reproducible_and_isolated():
+    """evaluate() on a host sim must be reproducible via its seed and must
+    leave the env freshly reset (no mid-eval state or stale running
+    returns leaking into subsequent training)."""
+    from trpo_tpu.envs.native import native_available
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    cfg = TRPOConfig(env="native:cartpole", n_envs=4, batch_timesteps=64, seed=0)
+    agent = TRPOAgent("native:cartpole", cfg)
+    state = agent.init_state()
+    r1, n1 = agent.evaluate(state, n_steps=64, seed=3)
+    r2, n2 = agent.evaluate(state, n_steps=64, seed=3)
+    assert (r1, n1) == (r2, n2)
+    assert np.all(agent.env._running_returns == 0.0)
+    assert np.all(agent.env._running_lengths == 0)

@@ -1,0 +1,81 @@
+"""CLI entry point (`python -m trpo_tpu.train`) — the reference's "entry"
+is three module-level statements (`trpo_inksci.py:179-181`); here a real
+CLI with presets, JSONL logging, checkpoint/resume, and greedy eval.
+
+Runs main() in-process (conftest already forces the 8-device CPU mesh;
+a subprocess would race for the single-tenant TPU tunnel).
+"""
+
+import json
+
+from trpo_tpu.train import build_parser, config_from_args, main
+
+TINY = [
+    "--preset", "cartpole",
+    "--iterations", "2",
+    "--batch-timesteps", "64",
+    "--n-envs", "4",
+    "--cg-iters", "4",
+    "--reward-target", "100000",  # never hit — run the full budget
+]
+
+
+def test_config_overrides():
+    args = build_parser().parse_args(
+        ["--preset", "pendulum", "--cg-iters", "3", "--seed", "42"]
+    )
+    cfg = config_from_args(args)
+    assert cfg.cg_iters == 3
+    assert cfg.seed == 42
+    assert cfg.env == "pendulum"
+
+
+def test_cli_trains_and_logs(tmp_path, capsys):
+    jsonl = tmp_path / "stats.jsonl"
+    rc = main(TINY + ["--log-jsonl", str(jsonl)])
+    assert rc == 0
+    rows = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert len(rows) == 2
+    # the reference's seven stats (trpo_inksci.py:160-171) must be present
+    for key in (
+        "total_episodes",
+        "mean_episode_reward",
+        "entropy",
+        "vf_explained_variance",
+        "kl_old_new",
+        "surrogate_loss",
+        "time_elapsed_min",
+    ):
+        assert key in rows[0], key
+    assert "done: 2 iterations" in capsys.readouterr().out
+
+
+def test_cli_checkpoint_resume(tmp_path, capsys):
+    ckdir = str(tmp_path / "ck")
+    rc = main(TINY + ["--checkpoint-dir", ckdir, "--checkpoint-every", "1"])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(
+        TINY[:2]
+        + ["--iterations", "1"]
+        + TINY[4:]
+        + ["--checkpoint-dir", ckdir, "--resume"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "resumed from step 2" in out
+    assert "done: 3 iterations" in out
+
+
+def test_cli_evaluate_rejects_nonpositive(capsys):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--evaluate", "0"])
+    capsys.readouterr()
+
+
+def test_cli_evaluate(capsys):
+    rc = main(TINY + ["--evaluate", "64"])
+    assert rc == 0
+    assert "greedy eval:" in capsys.readouterr().out

@@ -368,6 +368,13 @@ class TRPOAgent:
         (default: one training batch's worth), no parameter updates, no
         render. Returns ``(mean_episode_reward, episodes_completed)``
         over episodes that finish inside the window.
+
+        Device envs evaluate on a fresh carry — training env state is
+        untouched. Host simulators are shared mutable state, so evaluation
+        there necessarily interrupts in-progress training episodes; the env
+        is seeded-reset before (reproducibility) and hard-reset after, so a
+        subsequent ``learn`` resumes from clean episode boundaries rather
+        than mid-greedy-eval states.
         """
         n_steps = self.n_steps if n_steps is None else n_steps
         if n_steps < 1:
@@ -384,19 +391,17 @@ class TRPOAgent:
             carry = init_carry(self.env, k_init, self.cfg.n_envs)
             _, traj = fn(train_state.policy_params, carry, k_roll)
         else:
-            self.env.reset_all()
+            self.env.reset_all(seed=seed)
             if self._host_eval_act_fn is None:
-                policy = self.policy
-
-                def greedy(params, obs, k):
-                    dist = policy.apply(params, obs)
-                    return policy.dist.mode(dist), dist
-
-                self._host_eval_act_fn = jax.jit(greedy)
+                # reuse the already-jitted act path (argmax/mode branch)
+                self._host_eval_act_fn = lambda p, o, k: self._act_fn(
+                    p, o, k, True
+                )
             traj = host_rollout(
                 self.env, self.policy, train_state.policy_params, k_roll,
                 n_steps, act_fn=self._host_eval_act_fn,
             )
+            self.env.reset_all()
         done = np.asarray(traj.done)
         rets = np.asarray(traj.episode_return)
         n_done = int(done.sum())

@@ -89,13 +89,19 @@ class GymVecEnv(EpisodeStatsMixin):
         self._obs = next_obs
         return next_obs, rewards, terminated, truncated, final_obs
 
-    def reset_all(self) -> np.ndarray:
+    def reset_all(self, seed=None) -> np.ndarray:
         """Hard-reset every env (fresh episodes); returns the new obs batch.
 
         Auto-reset inside ``host_step`` covers steady-state training; this
         is for callers that need episode boundaries under their own control
-        (e.g. reference-style serial rollouts)."""
-        self._obs = np.stack([env.reset()[0] for env in self.envs])
+        (e.g. reference-style serial rollouts, reproducible evaluation —
+        ``seed`` reseeds env ``i`` with ``seed + i``)."""
+        self._obs = np.stack(
+            [
+                env.reset(seed=None if seed is None else seed + i)[0]
+                for i, env in enumerate(self.envs)
+            ]
+        )
         self._running_returns[:] = 0.0
         self._running_lengths[:] = 0
         return self._obs

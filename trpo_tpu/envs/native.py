@@ -207,12 +207,17 @@ class NativeVecEnv(EpisodeStatsMixin):
         self._obs = next_obs
         return next_obs, rewards, terminated, truncated, final_obs
 
-    def reset_all(self) -> np.ndarray:
+    def reset_all(self, seed=None) -> np.ndarray:
         """Hard-reset every env (fresh episodes); returns the new obs batch.
 
         Auto-reset inside ``host_step`` covers steady-state training; this
         is for callers that need episode boundaries under their own control
-        (e.g. reference-style serial rollouts)."""
+        (e.g. reference-style serial rollouts, reproducible evaluation —
+        ``seed`` reseeds the per-env RNG streams)."""
+        if seed is not None:
+            self._lib.trpo_native_seed(
+                self._rng, self.n_envs, np.uint64(seed)
+            )
         self._reset(self._state, self._t, self._rng, self.n_envs)
         self._obs = self._observe()
         self._running_returns[:] = 0.0

@@ -22,6 +22,13 @@ from typing import Optional, Sequence
 from trpo_tpu.config import PRESETS, TRPOConfig, get_preset
 
 
+def _positive_int(s: str) -> int:
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+    return v
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="trpo_tpu.train",
@@ -67,7 +74,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--evaluate",
-        type=int,
+        type=_positive_int,
         metavar="N_STEPS",
         default=None,
         help="after training, run a greedy (argmax/mode) evaluation rollout "

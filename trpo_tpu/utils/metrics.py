@@ -55,8 +55,11 @@ class StatsLogger:
     def __init__(
         self,
         jsonl_path: Optional[str] = None,
-        stream: IO = sys.stdout,
+        stream: Optional[IO] = None,
     ):
+        # None → resolve sys.stdout at each log() call, not here: binding
+        # the stream at construction breaks when stdout is swapped later
+        # (pytest capture, CLI redirection).
         self.stream = stream
         self._jsonl: Optional[IO] = (
             open(jsonl_path, "a") if jsonl_path else None
@@ -64,14 +67,15 @@ class StatsLogger:
         self.start_time = time.time()
 
     def log(self, iteration: int, stats: dict):
+        stream = self.stream if self.stream is not None else sys.stdout
         print(
             f"\n-------- Iteration {iteration} ----------",
-            file=self.stream,
+            file=stream,
         )
         for k, v in stats.items():
             if isinstance(v, float):
                 v = f"{v:.6g}"
-            print(f"{str(k):<40} {v}", file=self.stream)
+            print(f"{str(k):<40} {v}", file=stream)
         if self._jsonl is not None:
             rec = {"iteration": iteration}
             for k, v in stats.items():
