@@ -22,10 +22,26 @@ def cfg_with(**kw):
     return TRPOConfig(**base)
 
 
-def test_mesh_iteration_matches_single_device():
+import pytest
+
+
+@pytest.mark.parametrize(
+    "mesh_kwargs",
+    [
+        dict(mesh_shape=(8,)),  # 1-D data parallel
+        # 2-D data×seq: GAE sequence-parallel over the time axis
+        dict(mesh_shape=(4, 2), mesh_axes=("data", "seq")),
+    ],
+    ids=["data", "data-seq"],
+)
+def test_mesh_iteration_matches_single_device(mesh_kwargs):
+    """Mesh-sharded full training steps must match the single-device one
+    (placement changes execution, not math)."""
     a_single = TRPOAgent("cartpole", cfg_with())
-    a_mesh = TRPOAgent("cartpole", cfg_with(mesh_shape=(8,)))
+    a_mesh = TRPOAgent("cartpole", cfg_with(**mesh_kwargs))
     assert a_mesh.mesh is not None and a_mesh.mesh.devices.size == 8
+    if "mesh_axes" in mesh_kwargs:
+        assert a_mesh._seq_gae is not None
 
     s1, st1 = a_single.run_iteration(a_single.init_state(seed=11))
     s2, st2 = a_mesh.run_iteration(a_mesh.init_state(seed=11))
@@ -53,6 +69,39 @@ def test_mesh_validates_env_divisibility():
 
     with pytest.raises(ValueError):
         TRPOAgent("cartpole", cfg_with(n_envs=6, mesh_shape=(8,)))
+
+
+def test_mesh_seq_validates_step_divisibility():
+    # n_steps = ceil(56/8) = 7, not divisible by seq=2
+    with pytest.raises(ValueError, match="seq"):
+        TRPOAgent(
+            "cartpole",
+            cfg_with(
+                batch_timesteps=56,
+                mesh_shape=(4, 2),
+                mesh_axes=("data", "seq"),
+            ),
+        )
+
+
+def test_mesh_seq_rejects_seq_as_batch_axis():
+    with pytest.raises(ValueError, match="batch/env axis"):
+        TRPOAgent(
+            "cartpole",
+            cfg_with(mesh_shape=(2, 4), mesh_axes=("seq", "data")),
+        )
+
+
+def test_mesh_seq_rejects_pallas_scan_backend():
+    with pytest.raises(ValueError, match="scan_backend"):
+        TRPOAgent(
+            "cartpole",
+            cfg_with(
+                mesh_shape=(4, 2),
+                mesh_axes=("data", "seq"),
+                scan_backend="pallas",
+            ),
+        )
 
 
 def test_mesh_multi_iteration_learning_signal():

@@ -111,15 +111,49 @@ class TRPOAgent:
         # "data"; params replicate; XLA inserts the psum reductions
         # (SURVEY §2.4 build obligation). None → single-device placement.
         self.mesh = None
+        self._seq_gae = None
         if cfg.mesh_shape is not None:
             from trpo_tpu.parallel import make_mesh
 
             self.mesh = make_mesh(tuple(cfg.mesh_shape), tuple(cfg.mesh_axes))
+            if cfg.mesh_axes[0] == "seq":
+                raise ValueError(
+                    'mesh_axes[0] is the batch/env axis and cannot be named '
+                    '"seq"; put the sequence axis second, e.g. '
+                    'mesh_axes=("data", "seq")'
+                )
             dp = self.mesh.shape[cfg.mesh_axes[0]]
             if cfg.n_envs % dp != 0:
                 raise ValueError(
                     f"n_envs={cfg.n_envs} must divide evenly over the "
                     f"{cfg.mesh_axes[0]}={dp} mesh axis"
+                )
+            if "seq" in cfg.mesh_axes[1:]:
+                # 2-D data×seq mesh: GAE runs sequence-parallel — the time
+                # axis of the trajectory sharded over "seq", the block-
+                # parallel scan exchanging only per-block affine summaries
+                # (parallel/seq.py). The rest of the iteration stays
+                # batch-sharded; XLA relays out at the shard_map boundary.
+                if cfg.scan_backend != "xla":
+                    raise ValueError(
+                        f'scan_backend="{cfg.scan_backend}" is not supported '
+                        'with a "seq" mesh axis — the sequence-parallel GAE '
+                        "runs its block scans via lax.associative_scan; use "
+                        'scan_backend="xla" (or drop the seq axis to use '
+                        "the Pallas kernel)"
+                    )
+                sp = self.mesh.shape["seq"]
+                if self.n_steps % sp != 0:
+                    raise ValueError(
+                        f"steps per iteration ({self.n_steps} = "
+                        f"ceil(batch_timesteps/n_envs)) must divide evenly "
+                        f"over the seq={sp} mesh axis"
+                    )
+                from trpo_tpu.parallel import make_seq_gae
+
+                self._seq_gae = make_seq_gae(
+                    self.mesh, cfg.gamma, cfg.lam,
+                    seq_axis="seq", batch_axis=cfg.mesh_axes[0],
                 )
 
         self._process_fn = jax.jit(self._process_trajectory)
@@ -212,16 +246,25 @@ class TRPOAgent:
         next_values = self.vf.predict(vf_state, flat(traj.next_obs)).reshape(
             T, N
         )
-        adv, vtarg = gae_from_next_values(
-            traj.rewards,
-            values,
-            next_values,
-            traj.terminated,
-            traj.done,
-            self.cfg.gamma,
-            self.cfg.lam,
-            backend=self.cfg.scan_backend,
-        )
+        if self._seq_gae is not None:
+            adv, vtarg = self._seq_gae(
+                traj.rewards,
+                values,
+                next_values,
+                traj.terminated,
+                traj.done,
+            )
+        else:
+            adv, vtarg = gae_from_next_values(
+                traj.rewards,
+                values,
+                next_values,
+                traj.terminated,
+                traj.done,
+                self.cfg.gamma,
+                self.cfg.lam,
+                backend=self.cfg.scan_backend,
+            )
         return adv, vtarg, values
 
     def _process_trajectory(self, train_state: TrainState, traj: Trajectory):

@@ -70,8 +70,11 @@ class TRPOConfig:
     mesh_shape: Optional[Tuple[int, ...]] = None  # None → single device, no
     #                                mesh; set e.g. (8,) for data parallelism
     mesh_axes: Tuple[str, ...] = ("data",)
-    # model axis is only used when mesh_shape has 2 entries, e.g. (4, 2) with
-    # axes ("data", "model") shards wide policy layers over "model".
+    # A second mesh axis named "seq" (e.g. shape (4, 2), axes
+    # ("data", "seq")) runs GAE sequence-parallel: the trajectory's time
+    # axis is sharded over "seq" and the returns recurrence becomes the
+    # block-parallel scan of parallel/seq.py. Requires
+    # ceil(batch_timesteps / n_envs) divisible by the seq axis size.
 
     # --- io --------------------------------------------------------------
     checkpoint_dir: Optional[str] = None

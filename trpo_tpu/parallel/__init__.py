@@ -29,4 +29,5 @@ from trpo_tpu.parallel.seq import (  # noqa: F401
     sharded_reverse_affine_scan,
     seq_sharded_returns,
     seq_sharded_gae,
+    make_seq_gae,
 )

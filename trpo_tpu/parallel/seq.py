@@ -43,6 +43,7 @@ __all__ = [
     "sharded_reverse_affine_scan",
     "seq_sharded_returns",
     "seq_sharded_gae",
+    "make_seq_gae",
 ]
 
 
@@ -140,6 +141,34 @@ def seq_sharded_returns(
     )
 
 
+def make_seq_gae(
+    mesh: Mesh,
+    gamma: float,
+    lam: float,
+    seq_axis: str = "seq",
+    batch_axis=None,
+):
+    """Build a jit-traceable time-sharded GAE: ``(rewards, values,
+    next_values, terminated, done) -> (advantages, value_targets)`` over
+    ``(T, N)`` tensors with T sharded on ``seq_axis`` (and N on
+    ``batch_axis`` when given).
+
+    Unlike :func:`seq_sharded_gae` (a host-callable that places its inputs),
+    this returns the bare ``shard_map`` program, so it can be called INSIDE
+    a larger jitted step — the agent's fused training iteration uses it to
+    run GAE sequence-parallel on a 2-D ``("data", "seq")`` mesh.
+    """
+    spec = _spec(seq_axis, batch_axis)
+
+    def f(rew, v, nv, term, dn):
+        delta = rew + gamma * nv * (1.0 - term.astype(rew.dtype)) - v
+        gammas = gamma * lam * (1.0 - dn.astype(rew.dtype))
+        adv = sharded_reverse_affine_scan(gammas, delta, seq_axis)
+        return adv, adv + v
+
+    return shard_map(f, mesh=mesh, in_specs=(spec,) * 5, out_specs=(spec, spec))
+
+
 def seq_sharded_gae(
     mesh: Mesh,
     rewards,
@@ -162,18 +191,8 @@ def seq_sharded_gae(
     """
     key = ("gae", mesh, float(gamma), float(lam), seq_axis, batch_axis)
     if key not in _scan_cache:
-        spec = _spec(seq_axis, batch_axis)
-
-        def f(rew, v, nv, term, dn):
-            delta = rew + gamma * nv * (1.0 - term.astype(rew.dtype)) - v
-            gammas = gamma * lam * (1.0 - dn.astype(rew.dtype))
-            adv = sharded_reverse_affine_scan(gammas, delta, seq_axis)
-            return adv, adv + v
-
         _scan_cache[key] = jax.jit(
-            shard_map(
-                f, mesh=mesh, in_specs=(spec,) * 5, out_specs=(spec, spec)
-            )
+            make_seq_gae(mesh, gamma, lam, seq_axis, batch_axis)
         )
     sharding = NamedSharding(mesh, _spec(seq_axis, batch_axis))
     args = [
