@@ -4,6 +4,7 @@ match the single-device one exactly (placement changes execution, not math)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from trpo_tpu.agent import TRPOAgent
 from trpo_tpu.config import TRPOConfig
@@ -20,9 +21,6 @@ def cfg_with(**kw):
     )
     base.update(kw)
     return TRPOConfig(**base)
-
-
-import pytest
 
 
 @pytest.mark.parametrize(
@@ -65,8 +63,6 @@ def test_mesh_carry_is_sharded():
 
 
 def test_mesh_validates_env_divisibility():
-    import pytest
-
     with pytest.raises(ValueError):
         TRPOAgent("cartpole", cfg_with(n_envs=6, mesh_shape=(8,)))
 

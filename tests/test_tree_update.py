@@ -1,0 +1,169 @@
+"""Pytree-domain natural-gradient solve (tensor-parallel form).
+
+make_tree_trpo_update must match make_trpo_update (same math, different
+parameter layout), and with params sharded over a "model" mesh axis the
+whole solve must run sharded and still match.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.models import BoxSpec, DiscreteSpec, make_policy
+from trpo_tpu.ops.cg import conjugate_gradient
+from trpo_tpu.ops.treemath import tree_vdot
+from trpo_tpu.parallel import (
+    make_mesh,
+    policy_param_shardings,
+    shard_policy_params,
+)
+from trpo_tpu.trpo import (
+    TRPOBatch,
+    make_tree_trpo_update,
+    make_trpo_update,
+    standardize_advantages,
+)
+
+
+def _problem(spec, hidden=(32, 32), batch=256, obs_dim=6, seed=0):
+    policy = make_policy((obs_dim,), spec, hidden=hidden)
+    params = policy.init(jax.random.key(seed))
+    obs = jax.random.normal(jax.random.key(1), (batch, obs_dim))
+    dist = policy.apply(params, obs)
+    actions = policy.dist.sample(jax.random.key(2), dist)
+    w = jnp.ones(batch)
+    adv = standardize_advantages(
+        jax.random.normal(jax.random.key(3), (batch,)), w
+    )
+    batch_t = TRPOBatch(obs, actions, adv, jax.lax.stop_gradient(dist), w)
+    return policy, params, batch_t
+
+
+@pytest.mark.parametrize("spec", [DiscreteSpec(3), BoxSpec(2)], ids=["cat", "gauss"])
+def test_tree_update_matches_flat(spec):
+    policy, params, batch = _problem(spec)
+    cfg = TRPOConfig(cg_iters=8)
+    p_flat, s_flat = jax.jit(make_trpo_update(policy, cfg))(params, batch)
+    p_tree, s_tree = jax.jit(make_tree_trpo_update(policy, cfg))(params, batch)
+
+    f1 = jax.flatten_util.ravel_pytree(p_flat)[0]
+    f2 = jax.flatten_util.ravel_pytree(p_tree)[0]
+    np.testing.assert_allclose(np.asarray(f1), np.asarray(f2), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        float(s_flat.kl), float(s_tree.kl), rtol=1e-3, atol=1e-6
+    )
+    assert bool(s_flat.linesearch_success) == bool(s_tree.linesearch_success)
+    np.testing.assert_allclose(
+        float(s_flat.step_fraction), float(s_tree.step_fraction)
+    )
+
+
+def test_tree_cg_matches_flat_cg_on_spd_system():
+    n = 24
+    a = jax.random.normal(jax.random.key(0), (n, n))
+    A = a @ a.T / n + jnp.eye(n)  # well-conditioned: fp32 CG is tight
+    b = jax.random.normal(jax.random.key(1), (n,))
+    x_flat = conjugate_gradient(lambda v: A @ v, b, cg_iters=n).x
+
+    # the same system with the vector carried as a {w, b} pytree
+    split = 16
+    tree_b = {"w": b[:split].reshape(4, 4), "b": b[split:]}
+
+    def unpack(t):
+        return jnp.concatenate([t["w"].reshape(-1), t["b"]])
+
+    def pack(v):
+        return {"w": v[:split].reshape(4, 4), "b": v[split:]}
+
+    x_tree = conjugate_gradient(
+        lambda t: pack(A @ unpack(t)), tree_b, cg_iters=n
+    ).x
+    np.testing.assert_allclose(
+        np.asarray(unpack(x_tree)), np.asarray(x_flat), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(unpack(x_tree)),
+        np.asarray(jnp.linalg.solve(A, b)),
+        rtol=1e-3,
+        atol=1e-4,
+    )
+
+
+def test_tp_shardings_alternate_col_row():
+    mesh = make_mesh((2, 4), ("data", "model"))
+    policy = make_policy((8,), BoxSpec(4), hidden=(32, 32))
+    params = policy.init(jax.random.key(0))
+    sh = policy_param_shardings(params, mesh)
+    layers = sh["net"]["layers"]
+    specs = [
+        (tuple(l["w"].spec), tuple(l["b"].spec)) for l in layers
+    ]
+    # layer 0 col-split, layer 1 row-split, head (4-wide, 4∤? 4%4==0) col-split
+    assert specs[0] == ((None, "model"), ("model",))
+    assert specs[1] == (("model", None), ())
+    # log_std replicated
+    assert tuple(sh["log_std"].spec) == ()
+
+
+def test_tp_update_matches_replicated():
+    """The tensor-parallel solve over a ("data","model") mesh must equal the
+    single-device pytree solve."""
+    mesh = make_mesh((2, 4), ("data", "model"))
+    policy, params, batch = _problem(BoxSpec(2), hidden=(32, 32))
+    cfg = TRPOConfig(cg_iters=8)
+    update = jax.jit(make_tree_trpo_update(policy, cfg))
+
+    p_ref, s_ref = update(params, batch)
+
+    params_tp = shard_policy_params(params, mesh)
+    # sanity: the wide layers really are sharded over the model axis
+    # (device_set would be all mesh devices even for replicated layouts)
+    w0 = params_tp["net"]["layers"][0]["w"]
+    assert not w0.sharding.is_fully_replicated
+    p_tp, s_tp = update(params_tp, batch)
+
+    f1 = jax.flatten_util.ravel_pytree(p_ref)[0]
+    f2 = jax.flatten_util.ravel_pytree(p_tp)[0]
+    np.testing.assert_allclose(np.asarray(f1), np.asarray(f2), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        float(s_ref.kl), float(s_tp.kl), rtol=1e-3, atol=1e-6
+    )
+
+
+def test_tp_agent_iteration_matches_single_device():
+    from trpo_tpu.agent import TRPOAgent
+
+    base = dict(
+        env="cartpole",
+        n_envs=8,
+        batch_timesteps=256,
+        policy_hidden=(32, 32),
+        vf_train_steps=10,
+    )
+    a_single = TRPOAgent("cartpole", TRPOConfig(**base))
+    a_tp = TRPOAgent(
+        "cartpole",
+        TRPOConfig(**base, mesh_shape=(2, 4), mesh_axes=("data", "model")),
+    )
+    assert a_tp._tp_axis == "model"
+
+    s1, st1 = a_single.run_iteration(a_single.init_state(seed=11))
+    s2, st2 = a_tp.run_iteration(a_tp.init_state(seed=11))
+
+    f1 = jax.flatten_util.ravel_pytree(s1.policy_params)[0]
+    f2 = jax.flatten_util.ravel_pytree(s2.policy_params)[0]
+    np.testing.assert_allclose(
+        np.asarray(f1), np.asarray(f2), rtol=2e-4, atol=2e-5
+    )
+    assert abs(float(st1["kl_old_new"]) - float(st2["kl_old_new"])) < 1e-5
+
+
+def test_tree_vdot_matches_flat_dot():
+    t1 = {"a": jnp.arange(6.0).reshape(2, 3), "b": jnp.array([1.0, -2.0])}
+    t2 = {"a": jnp.ones((2, 3)), "b": jnp.array([0.5, 4.0])}
+    flat = lambda t: jnp.concatenate([t["a"].reshape(-1), t["b"]])
+    np.testing.assert_allclose(
+        float(tree_vdot(t1, t2)), float(jnp.dot(flat(t1), flat(t2)))
+    )

@@ -112,15 +112,17 @@ class TRPOAgent:
         # (SURVEY §2.4 build obligation). None → single-device placement.
         self.mesh = None
         self._seq_gae = None
+        self._tp_axis = None
         if cfg.mesh_shape is not None:
             from trpo_tpu.parallel import make_mesh
 
             self.mesh = make_mesh(tuple(cfg.mesh_shape), tuple(cfg.mesh_axes))
-            if cfg.mesh_axes[0] == "seq":
+            if cfg.mesh_axes[0] in ("seq", "model"):
                 raise ValueError(
-                    'mesh_axes[0] is the batch/env axis and cannot be named '
-                    '"seq"; put the sequence axis second, e.g. '
-                    'mesh_axes=("data", "seq")'
+                    "mesh_axes[0] is the batch/env axis and cannot be named "
+                    f'"{cfg.mesh_axes[0]}"; put the {cfg.mesh_axes[0]!r} '
+                    'axis second, e.g. mesh_axes=("data", '
+                    f'"{cfg.mesh_axes[0]}")'
                 )
             dp = self.mesh.shape[cfg.mesh_axes[0]]
             if cfg.n_envs % dp != 0:
@@ -128,6 +130,15 @@ class TRPOAgent:
                     f"n_envs={cfg.n_envs} must divide evenly over the "
                     f"{cfg.mesh_axes[0]}={dp} mesh axis"
                 )
+            if "model" in cfg.mesh_axes[1:]:
+                # Tensor parallelism: policy params sharded Megatron-style
+                # over "model" (parallel/tp.py), and the update switched to
+                # the pytree-domain solve so the sharding persists through
+                # grad/FVP/CG/linesearch (flattening would all-gather).
+                from trpo_tpu.trpo import make_tree_trpo_update
+
+                self.trpo_update = make_tree_trpo_update(self.policy, cfg)
+                self._tp_axis = "model"
             if "seq" in cfg.mesh_axes[1:]:
                 # 2-D data×seq mesh: GAE runs sequence-parallel — the time
                 # axis of the trajectory sharded over "seq", the block-
@@ -187,8 +198,15 @@ class TRPOAgent:
             env_carry = shard_leading_axis(
                 self.mesh, env_carry, self.cfg.mesh_axes[0]
             )
+        policy_params = self.policy.init(k_policy)
+        if self._tp_axis is not None:
+            from trpo_tpu.parallel import shard_policy_params
+
+            policy_params = shard_policy_params(
+                policy_params, self.mesh, self._tp_axis
+            )
         return TrainState(
-            policy_params=self.policy.init(k_policy),
+            policy_params=policy_params,
             vf_state=self.vf.init(k_vf),
             env_carry=env_carry,
             rng=k_run,

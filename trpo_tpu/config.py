@@ -70,11 +70,17 @@ class TRPOConfig:
     mesh_shape: Optional[Tuple[int, ...]] = None  # None → single device, no
     #                                mesh; set e.g. (8,) for data parallelism
     mesh_axes: Tuple[str, ...] = ("data",)
-    # A second mesh axis named "seq" (e.g. shape (4, 2), axes
-    # ("data", "seq")) runs GAE sequence-parallel: the trajectory's time
-    # axis is sharded over "seq" and the returns recurrence becomes the
-    # block-parallel scan of parallel/seq.py. Requires
-    # ceil(batch_timesteps / n_envs) divisible by the seq axis size.
+    # Axis 0 is always the batch/env (data-parallel) axis. Further axes
+    # compose with it by name:
+    #  - "seq"   (e.g. shape (4, 2), axes ("data", "seq")): GAE runs
+    #    sequence-parallel — the trajectory's time axis sharded over "seq",
+    #    the returns recurrence as parallel/seq.py's block-parallel scan.
+    #    Requires ceil(batch_timesteps / n_envs) divisible by the seq size.
+    #  - "model" (e.g. shape (2, 4), axes ("data", "model")): tensor
+    #    parallelism — policy MLP layers sharded Megatron-style
+    #    (parallel/tp.py) and the natural-gradient solve switched to the
+    #    pytree domain (trpo.make_tree_trpo_update) so shardings persist
+    #    through grad/FVP/CG/linesearch.
 
     # --- io --------------------------------------------------------------
     checkpoint_dir: Optional[str] = None

@@ -15,24 +15,31 @@ accumulation (SURVEY §7 "hard parts").
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from trpo_tpu.ops.treemath import (
+    tree_add_scaled,
+    tree_f32,
+    tree_vdot,
+    tree_zeros_like,
+)
+
 __all__ = ["conjugate_gradient", "CGResult"]
 
 
 class CGResult(NamedTuple):
-    x: jax.Array            # approximate solution of A x = b
+    x: Any                  # approximate solution of A x = b (same pytree as b)
     residual_norm_sq: jax.Array
     iterations: jax.Array   # iterations actually executed (early exit aware)
 
 
 def conjugate_gradient(
-    f_Ax: Callable[[jax.Array], jax.Array],
-    b: jax.Array,
+    f_Ax: Callable[[Any], Any],
+    b: Any,
     cg_iters: int = 10,
     residual_tol: float = 1e-10,
 ) -> CGResult:
@@ -43,10 +50,15 @@ def conjugate_gradient(
     residual_tol``. Differences are purely about execution: this is a traced
     ``lax.while_loop`` (data-dependent exit without leaving the device), and
     it returns diagnostics alongside the solution.
+
+    ``b`` may be a flat vector (the reference's contract) or ANY pytree —
+    e.g. a parameter pytree whose leaves are tensor-sharded over a
+    ``"model"`` mesh axis: the iterates keep ``b``'s structure/sharding and
+    only the scalar dot products reduce across the mesh.
     """
-    b = jnp.asarray(b, jnp.float32)
-    x0 = jnp.zeros_like(b)
-    rdotr0 = jnp.dot(b, b)
+    b = tree_f32(b)
+    x0 = tree_zeros_like(b)
+    rdotr0 = tree_vdot(b, b)
 
     def cond(state):
         i, _, _, _, rdotr = state
@@ -54,13 +66,13 @@ def conjugate_gradient(
 
     def body(state):
         i, x, r, p, rdotr = state
-        z = jnp.asarray(f_Ax(p), jnp.float32)
-        alpha = rdotr / jnp.dot(p, z)
-        x = x + alpha * p
-        r = r - alpha * z
-        new_rdotr = jnp.dot(r, r)
+        z = tree_f32(f_Ax(p))
+        alpha = rdotr / tree_vdot(p, z)
+        x = tree_add_scaled(x, alpha, p)
+        r = tree_add_scaled(r, -alpha, z)
+        new_rdotr = tree_vdot(r, r)
         mu = new_rdotr / rdotr
-        p = r + mu * p
+        p = tree_add_scaled(r, mu, p)
         return i + 1, x, r, p, new_rdotr
 
     i, x, r, _, rdotr = lax.while_loop(

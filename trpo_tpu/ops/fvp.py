@@ -18,12 +18,12 @@ Fisher information matrix, so no explicit "old" distribution is needed.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["make_fvp", "materialize_fisher"]
+__all__ = ["make_fvp", "make_tree_fvp", "materialize_fisher"]
 
 
 def make_fvp(
@@ -44,6 +44,32 @@ def make_fvp(
     def fvp(v: jax.Array) -> jax.Array:
         hv = jax.jvp(grad_kl, (flat_params,), (v,))[1]
         return jnp.asarray(hv, jnp.float32) + damping * v
+
+    return fvp
+
+
+def make_tree_fvp(
+    kl_fn: Callable[[Any], jax.Array],
+    params: Any,
+    damping: float = 0.0,
+) -> Callable[[Any], Any]:
+    """``make_fvp`` in the parameter-pytree domain: ``v ↦ (F + λI)v`` where
+    ``v`` shares ``params``'s pytree structure.
+
+    Same ``jvp∘grad`` math as :func:`make_fvp` without flattening — so a
+    tensor-sharded (``"model"``-axis) parameter layout is preserved through
+    the operator, and with it through the CG iterates that call it
+    (``ops/cg.py`` is pytree-polymorphic). This is what makes the
+    natural-gradient solve tensor-parallel: ``ravel_pytree`` would
+    all-gather every sharded leaf into one replicated vector.
+    """
+    grad_kl = jax.grad(kl_fn)
+
+    def fvp(v: Any) -> Any:
+        hv = jax.jvp(grad_kl, (params,), (v,))[1]
+        return jax.tree_util.tree_map(
+            lambda h, t: jnp.asarray(h, jnp.float32) + damping * t, hv, v
+        )
 
     return fvp
 

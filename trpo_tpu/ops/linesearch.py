@@ -15,26 +15,28 @@ accepted, return the original parameters (``utils.py:182``).
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from trpo_tpu.ops.treemath import tree_add_scaled, tree_where
+
 __all__ = ["backtracking_linesearch", "LinesearchResult"]
 
 
 class LinesearchResult(NamedTuple):
-    x: jax.Array              # accepted params (== input x when nothing accepted)
+    x: Any                    # accepted params (== input x when nothing accepted)
     success: jax.Array        # bool: did any step pass the acceptance test
     step_fraction: jax.Array  # accepted 0.5**k (0.0 on failure)
     loss: jax.Array           # loss at the returned params
 
 
 def backtracking_linesearch(
-    loss_fn: Callable[[jax.Array], jax.Array],
-    x: jax.Array,
-    fullstep: jax.Array,
+    loss_fn: Callable[[Any], jax.Array],
+    x: Any,
+    fullstep: Any,
     expected_improve_rate: jax.Array,
     max_backtracks: int = 10,
     accept_ratio: float = 0.1,
@@ -45,6 +47,10 @@ def backtracking_linesearch(
     ``expected_improve_rate`` is the first-order predicted improvement at the
     full step (``gᵀ·fullstep``); the reference scales it by the step fraction
     when forming the ratio (``utils.py:176``).
+
+    ``x``/``fullstep`` may be flat vectors (the reference's contract) or any
+    matching pytrees — candidate parameters are carried through the loop in
+    whatever (possibly mesh-sharded) layout they arrive in.
     """
     fval = loss_fn(x)
 
@@ -54,8 +60,10 @@ def backtracking_linesearch(
 
     def body(state):
         k, _, _, _, _ = state
-        frac = jnp.asarray(backtrack_factor, x.dtype) ** k.astype(x.dtype)
-        xnew = x + frac * fullstep
+        frac = jnp.asarray(backtrack_factor, jnp.float32) ** k.astype(
+            jnp.float32
+        )
+        xnew = tree_add_scaled(x, frac, fullstep)
         newfval = loss_fn(xnew)
         actual_improve = fval - newfval
         expected_improve = expected_improve_rate * frac
@@ -65,9 +73,11 @@ def backtracking_linesearch(
 
     k0 = jnp.asarray(0, jnp.int32)
     _, accepted, xcand, fcand, frac = lax.while_loop(
-        cond, body, (k0, jnp.asarray(False), x, fval, jnp.asarray(0.0, x.dtype))
+        cond,
+        body,
+        (k0, jnp.asarray(False), x, fval, jnp.asarray(0.0, jnp.float32)),
     )
-    x_out = jnp.where(accepted, xcand, x)
+    x_out = tree_where(accepted, xcand, x)
     return LinesearchResult(
         x=x_out,
         success=accepted,

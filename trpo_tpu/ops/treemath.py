@@ -1,0 +1,75 @@
+"""Pytree vector-space helpers for the natural-gradient solve.
+
+The reference's second-order machinery is flat-vector in / flat-vector out
+(``GetFlat``/``SetFromFlat``/``flatgrad``, SURVEY §1) — and this framework
+keeps that contract in ``ops/flat.py``. But flattening has a cost on a
+tensor-sharded mesh: ``ravel_pytree`` concatenates every leaf into ONE
+array, which forces an all-gather of model-sharded parameters. These
+helpers let CG / FVP / line search run directly on parameter pytrees, so a
+``"model"``-sharded layout flows through the whole solve with XLA inserting
+only the collectives the math needs (scalar psums for the dot products).
+
+All reductions accumulate in fp32 regardless of leaf dtype (the solve is
+fp32-only — see ``ops/cg.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "tree_f32",
+    "tree_zeros_like",
+    "tree_vdot",
+    "tree_norm",
+    "tree_add_scaled",
+    "tree_scale",
+    "tree_sub",
+    "tree_where",
+]
+
+_map = jax.tree_util.tree_map
+
+
+def tree_f32(t):
+    """Cast every leaf to float32."""
+    return _map(lambda x: jnp.asarray(x, jnp.float32), t)
+
+
+def tree_zeros_like(t):
+    return _map(jnp.zeros_like, t)
+
+
+def tree_vdot(a, b) -> jax.Array:
+    """Σ over leaves of ⟨a_leaf, b_leaf⟩, accumulated in fp32."""
+    dots = _map(
+        lambda x, y: jnp.vdot(
+            jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32)
+        ),
+        a,
+        b,
+    )
+    return jax.tree_util.tree_reduce(jnp.add, dots, jnp.asarray(0.0, jnp.float32))
+
+
+def tree_norm(t) -> jax.Array:
+    return jnp.sqrt(tree_vdot(t, t))
+
+
+def tree_add_scaled(x, alpha, y):
+    """``x + alpha · y`` leafwise (alpha a scalar)."""
+    return _map(lambda a, b: a + alpha * b, x, y)
+
+
+def tree_scale(alpha, t):
+    return _map(lambda x: alpha * x, t)
+
+
+def tree_sub(a, b):
+    return _map(lambda x, y: x - y, a, b)
+
+
+def tree_where(pred, a, b):
+    """Leafwise ``jnp.where(pred, a, b)`` for a scalar predicate."""
+    return _map(lambda x, y: jnp.where(pred, x, y), a, b)

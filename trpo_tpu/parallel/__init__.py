@@ -31,3 +31,7 @@ from trpo_tpu.parallel.seq import (  # noqa: F401
     seq_sharded_gae,
     make_seq_gae,
 )
+from trpo_tpu.parallel.tp import (  # noqa: F401
+    policy_param_shardings,
+    shard_policy_params,
+)

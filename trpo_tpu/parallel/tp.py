@@ -1,0 +1,86 @@
+"""Tensor parallelism: parameter shardings for a ``"model"`` mesh axis.
+
+The reference has nothing to tensor-shard (64-wide MLPs, SURVEY §2.4), but
+this framework's BASELINE ladder tops out at wide Gaussian MLP policies
+(Humanoid: 256×256) where sharding the hidden dimension over a ``"model"``
+axis is the standard Megatron split: even layers column-parallel
+(``W: P(None, "model")``, bias sharded), odd layers row-parallel
+(``W: P("model", None)``, bias replicated) — so the activation between a
+col/row pair stays sharded and XLA inserts exactly one reduction
+(all-reduce of the row-parallel matmul's partial sums) per pair.
+
+Combined with :func:`trpo_tpu.trpo.make_tree_trpo_update` (the pytree-domain
+solve), these shardings persist through grad, Fisher-vector products, CG
+iterates, line-search candidates, and the rollback select — the entire
+natural-gradient update runs tensor-parallel; only its scalar dot products
+cross the mesh.
+
+Leaves whose sharded dimension does not divide the axis size stay
+replicated (small heads, ``log_std``, conv torsos) — GSPMD handles the
+mixed layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["policy_param_shardings", "shard_policy_params"]
+
+
+def _layer_spec(layer_idx: int, name: str, leaf, axis: str, axis_size: int):
+    col = layer_idx % 2 == 0
+    if name == "w" and leaf.ndim == 2:
+        if col and leaf.shape[1] % axis_size == 0:
+            return P(None, axis)
+        if not col and leaf.shape[0] % axis_size == 0:
+            return P(axis, None)
+    elif name == "b" and leaf.ndim == 1:
+        # bias of a column-parallel layer lives on the sharded activation
+        if col and leaf.shape[0] % axis_size == 0:
+            return P(axis)
+    return P()
+
+
+def policy_param_shardings(
+    params: Any, mesh: Mesh, model_axis: str = "model"
+) -> Any:
+    """A pytree of ``NamedSharding``s (same structure as ``params``)
+    implementing the alternating col/row split for every ``{"layers": [...]}``
+    MLP stack in the policy pytree; everything else replicated."""
+    axis_size = mesh.shape[model_axis]
+    DictKey = jax.tree_util.DictKey
+    SequenceKey = jax.tree_util.SequenceKey
+
+    def spec(path, leaf):
+        for j, k in enumerate(path):
+            if (
+                isinstance(k, DictKey)
+                and k.key == "layers"
+                and j + 2 < len(path)
+                and isinstance(path[j + 1], SequenceKey)
+                and isinstance(path[j + 2], DictKey)
+            ):
+                return _layer_spec(
+                    path[j + 1].idx,
+                    path[j + 2].key,
+                    leaf,
+                    model_axis,
+                    axis_size,
+                )
+        return P()
+
+    return jax.tree_util.tree_map_with_path(
+        lambda p, leaf: NamedSharding(mesh, spec(p, leaf)), params
+    )
+
+
+def shard_policy_params(
+    params: Any, mesh: Mesh, model_axis: str = "model"
+) -> Any:
+    """Place ``params`` according to :func:`policy_param_shardings`."""
+    return jax.tree_util.tree_map(
+        jax.device_put, params, policy_param_shardings(params, mesh, model_axis)
+    )

@@ -37,10 +37,24 @@ from trpo_tpu.config import TRPOConfig
 from trpo_tpu.models.policy import Policy
 from trpo_tpu.ops.cg import conjugate_gradient
 from trpo_tpu.ops.flat import flatten_params
-from trpo_tpu.ops.fvp import make_fvp
+from trpo_tpu.ops.fvp import make_tree_fvp
 from trpo_tpu.ops.linesearch import backtracking_linesearch
+from trpo_tpu.ops.treemath import (
+    tree_f32,
+    tree_norm,
+    tree_scale,
+    tree_sub,
+    tree_vdot,
+    tree_where,
+)
 
-__all__ = ["TRPOBatch", "TRPOStats", "make_trpo_update", "surrogate_loss"]
+__all__ = [
+    "TRPOBatch",
+    "TRPOStats",
+    "make_trpo_update",
+    "make_tree_trpo_update",
+    "surrogate_loss",
+]
 
 
 class TRPOBatch(NamedTuple):
@@ -82,90 +96,134 @@ def surrogate_loss(policy: Policy, params, batch: TRPOBatch) -> jax.Array:
     return -_wmean(ratio * batch.advantages, batch.weight)
 
 
+def _natural_gradient_update(
+    policy: Policy, cfg: TRPOConfig, to_params: Callable[[Any], Any],
+    x0: Any, batch: TRPOBatch,
+) -> Tuple[Any, TRPOStats]:
+    """The fused solve, generic over the parameter REPRESENTATION.
+
+    ``x0`` is the optimization variable — a flat f32 vector (the reference's
+    contract) or the params pytree itself (the tensor-parallel form) — and
+    ``to_params`` maps it to the pytree ``policy.apply`` takes (``unravel``
+    or identity). Every op below (CG, FVP, line search, the tree helpers)
+    is pytree-polymorphic, so both representations share this one body.
+    """
+
+    def surr_fn(x):
+        return surrogate_loss(policy, to_params(x), batch)
+
+    def kl_to_old_fn(x):
+        dist_params = policy.apply(to_params(x), batch.obs)
+        return _wmean(
+            policy.dist.kl(batch.old_dist, dist_params), batch.weight
+        )
+
+    # Fisher metric at the current params: KL(stop_grad(π_θ) ‖ π_x)
+    # — the reference's `kl_firstfixed` (trpo_inksci.py:56).
+    cur_dist = jax.lax.stop_gradient(
+        policy.apply(to_params(x0), batch.obs)
+    )
+
+    def kl_fixed_fn(x):
+        dist_params = policy.apply(to_params(x), batch.obs)
+        return _wmean(policy.dist.kl(cur_dist, dist_params), batch.weight)
+
+    surr_before = surr_fn(x0)
+    g = jax.grad(surr_fn)(x0)
+    grad_norm = tree_norm(g)
+    neg_g = tree_scale(-1.0, g)
+
+    fvp = make_tree_fvp(kl_fixed_fn, x0, damping=cfg.cg_damping)
+    cg = conjugate_gradient(
+        fvp, neg_g, cg_iters=cfg.cg_iters, residual_tol=cfg.cg_residual_tol
+    )
+    stepdir = cg.x
+
+    # Step scaling to the KL radius (ref trpo_inksci.py:148-150).
+    shs = 0.5 * tree_vdot(stepdir, fvp(stepdir))
+    shs = jnp.maximum(shs, 1e-12)  # guard degenerate/zero-gradient solves
+    lm = jnp.sqrt(shs / cfg.max_kl)
+    fullstep = tree_scale(1.0 / lm, stepdir)
+    expected_improve_rate = tree_vdot(neg_g, stepdir) / lm
+
+    ls = backtracking_linesearch(
+        surr_fn,
+        x0,
+        fullstep,
+        expected_improve_rate,
+        max_backtracks=cfg.linesearch_backtracks,
+        accept_ratio=cfg.linesearch_accept_ratio,
+    )
+
+    # KL rollback (ref trpo_inksci.py:157-158).
+    kl_after = kl_to_old_fn(ls.x)
+    rollback = kl_after > cfg.kl_rollback_factor * cfg.max_kl
+    x_new = tree_where(rollback, x0, ls.x)
+
+    new_params = to_params(x_new)
+    # All post-update stats from ONE forward pass at the final params
+    # (the reference re-runs the graph per fetched loss,
+    # trpo_inksci.py:156).
+    final_dist = policy.apply(new_params, batch.obs)
+    logp_new = policy.dist.logp(final_dist, batch.actions)
+    logp_old = policy.dist.logp(batch.old_dist, batch.actions)
+    surr_after = -_wmean(
+        jnp.exp(logp_new - logp_old) * batch.advantages, batch.weight
+    )
+    stats = TRPOStats(
+        surrogate_before=surr_before,
+        surrogate_after=surr_after,
+        kl=_wmean(policy.dist.kl(batch.old_dist, final_dist), batch.weight),
+        entropy=_wmean(policy.dist.entropy(final_dist), batch.weight),
+        grad_norm=grad_norm,
+        step_norm=tree_norm(tree_sub(x_new, x0)),
+        cg_iterations=cg.iterations,
+        cg_residual=cg.residual_norm_sq,
+        linesearch_success=ls.success,
+        step_fraction=ls.step_fraction,
+        rolled_back=rollback,
+    )
+    return new_params, stats
+
+
 def make_trpo_update(
     policy: Policy, cfg: TRPOConfig
 ) -> Callable[[Any, TRPOBatch], Tuple[Any, TRPOStats]]:
-    """Build the fused update. Jit the result (or pass it to
-    ``trpo_tpu.parallel.make_sharded_update`` for a mesh-sharded version)."""
+    """Build the fused update in the FLAT-VECTOR domain — the reference's
+    parameter contract (SURVEY §1: flat-vector in, flat-vector out). Jit the
+    result (or pass it to ``trpo_tpu.parallel.make_sharded_update`` for a
+    mesh-sharded version)."""
 
     def update(params, batch: TRPOBatch) -> Tuple[Any, TRPOStats]:
         flat0, unravel = flatten_params(params)
         flat0 = jnp.asarray(flat0, jnp.float32)
+        return _natural_gradient_update(policy, cfg, unravel, flat0, batch)
 
-        def surr_fn(flat):
-            return surrogate_loss(policy, unravel(flat), batch)
+    return update
 
-        def kl_to_old_fn(flat):
-            dist_params = policy.apply(unravel(flat), batch.obs)
-            return _wmean(
-                policy.dist.kl(batch.old_dist, dist_params), batch.weight
-            )
 
-        # Fisher metric at the current params: KL(stop_grad(π_θ) ‖ π_flat)
-        # — the reference's `kl_firstfixed` (trpo_inksci.py:56).
-        cur_dist = jax.lax.stop_gradient(policy.apply(params, batch.obs))
+def make_tree_trpo_update(
+    policy: Policy, cfg: TRPOConfig
+) -> Callable[[Any, TRPOBatch], Tuple[Any, TRPOStats]]:
+    """:func:`make_trpo_update` in the parameter-PYTREE domain.
 
-        def kl_fixed_fn(flat):
-            dist_params = policy.apply(unravel(flat), batch.obs)
-            return _wmean(policy.dist.kl(cur_dist, dist_params), batch.weight)
+    Identical math and acceptance logic (both are thin wrappers over the
+    same ``_natural_gradient_update`` body), but grad / FVP / CG / line
+    search / rollback all operate on the params pytree directly — no
+    ``ravel_pytree``. This is the tensor-parallel form: with parameter
+    leaves sharded over a ``"model"`` mesh axis (``trpo_tpu.parallel.tp``),
+    the whole natural-gradient solve stays sharded (flattening would
+    all-gather every leaf into one replicated vector), and only the
+    solver's scalar dot products reduce across the mesh.
 
-        surr_before = surr_fn(flat0)
-        g = jax.grad(surr_fn)(flat0)
-        grad_norm = jnp.linalg.norm(g)
+    The flat variant remains the default: it is the reference's flat-vector
+    contract (SURVEY §1) and bit-stable against ``compat``/bench baselines.
+    """
 
-        fvp = make_fvp(kl_fixed_fn, flat0, damping=cfg.cg_damping)
-        cg = conjugate_gradient(
-            fvp, -g, cg_iters=cfg.cg_iters, residual_tol=cfg.cg_residual_tol
+    def update(params, batch: TRPOBatch) -> Tuple[Any, TRPOStats]:
+        return _natural_gradient_update(
+            policy, cfg, lambda p: p, tree_f32(params), batch
         )
-        stepdir = cg.x
-
-        # Step scaling to the KL radius (ref trpo_inksci.py:148-150).
-        shs = 0.5 * jnp.dot(stepdir, fvp(stepdir))
-        shs = jnp.maximum(shs, 1e-12)  # guard degenerate/zero-gradient solves
-        lm = jnp.sqrt(shs / cfg.max_kl)
-        fullstep = stepdir / lm
-        expected_improve_rate = jnp.dot(-g, stepdir) / lm
-
-        ls = backtracking_linesearch(
-            surr_fn,
-            flat0,
-            fullstep,
-            expected_improve_rate,
-            max_backtracks=cfg.linesearch_backtracks,
-            accept_ratio=cfg.linesearch_accept_ratio,
-        )
-
-        # KL rollback (ref trpo_inksci.py:157-158).
-        kl_after = kl_to_old_fn(ls.x)
-        rollback = kl_after > cfg.kl_rollback_factor * cfg.max_kl
-        flat_new = jnp.where(rollback, flat0, ls.x)
-
-        new_params = unravel(flat_new)
-        # All post-update stats from ONE forward pass at the final params
-        # (the reference re-runs the graph per fetched loss,
-        # trpo_inksci.py:156).
-        final_dist = policy.apply(new_params, batch.obs)
-        logp_new = policy.dist.logp(final_dist, batch.actions)
-        logp_old = policy.dist.logp(batch.old_dist, batch.actions)
-        surr_after = -_wmean(
-            jnp.exp(logp_new - logp_old) * batch.advantages, batch.weight
-        )
-        stats = TRPOStats(
-            surrogate_before=surr_before,
-            surrogate_after=surr_after,
-            kl=_wmean(
-                policy.dist.kl(batch.old_dist, final_dist), batch.weight
-            ),
-            entropy=_wmean(policy.dist.entropy(final_dist), batch.weight),
-            grad_norm=grad_norm,
-            step_norm=jnp.linalg.norm(flat_new - flat0),
-            cg_iterations=cg.iterations,
-            cg_residual=cg.residual_norm_sq,
-            linesearch_success=ls.success,
-            step_fraction=ls.step_fraction,
-            rolled_back=rollback,
-        )
-        return new_params, stats
 
     return update
 
