@@ -82,11 +82,22 @@ def _progress(msg: str) -> None:
     print(f"bench[{time.perf_counter() - _T0:7.1f}s] {msg}", file=sys.stderr)
 
 
-def build_problem():
+def build_problem(compute_dtype=None):
+    """``compute_dtype=bfloat16`` runs the policy matmuls (forward + jvp/vjp
+    inside the FVP) on the MXU at full rate; CG vectors, KL, and all solver
+    arithmetic stay fp32 (``ops/cg.py`` casts every iterate) — the
+    framework's documented TPU operating point (``models/mlp.py``). The
+    baseline path uses fp32 throughout (reference semantics), and the
+    solution-cosine assert below checks the bf16-matmul solve against it."""
     from trpo_tpu.models import make_policy, BoxSpec
     from trpo_tpu.ops import flatten_params
 
-    policy = make_policy((OBS_DIM,), BoxSpec(ACT_DIM), hidden=HIDDEN)
+    policy = make_policy(
+        (OBS_DIM,),
+        BoxSpec(ACT_DIM),
+        hidden=HIDDEN,
+        compute_dtype=compute_dtype or jnp.float32,
+    )
     params = policy.init(jax.random.key(0))
     obs = jax.random.normal(jax.random.key(1), (BATCH, OBS_DIM), jnp.float32)
     flat0, unravel = flatten_params(params)
@@ -119,7 +130,12 @@ def time_full_update(device=None):
         else contextlib.nullcontext()
     )
     with ctx:
-        policy = make_policy((OBS_DIM,), BoxSpec(ACT_DIM), hidden=HIDDEN)
+        policy = make_policy(
+            (OBS_DIM,),
+            BoxSpec(ACT_DIM),
+            hidden=HIDDEN,
+            compute_dtype=jnp.bfloat16 if device is None else jnp.float32,
+        )
         params = policy.init(jax.random.key(0))
         obs = jax.random.normal(
             jax.random.key(1), (BATCH, OBS_DIM), jnp.float32
@@ -240,7 +256,13 @@ def time_reference_semantics(kl_fn, flat0, g):
 
 def main():
     global _ACCEL
-    kl_fn, flat0, g = build_problem()
+    # Fused path at the TPU operating point (bf16 matmuls, fp32 solve);
+    # baseline at reference semantics (fp32 throughout). Params/g share
+    # keys, so both solve the same system up to matmul precision — the
+    # solution-cosine assert cross-checks them.
+    kl_fn, flat0, g = build_problem(
+        jnp.bfloat16 if _ACCEL else jnp.float32
+    )
     try:
         ours_ms, x_ours = time_fused_solve(kl_fn, flat0, g)
     except Exception as e:  # tunnel flake mid-compile/run — retry once
@@ -267,7 +289,16 @@ def main():
     except Exception as e:  # secondary metric must not sink the headline
         _progress(f"full-update timing failed ({type(e).__name__}: {e})")
         updates_per_sec = update_ms = None
-    base_ms, x_base = time_reference_semantics(kl_fn, flat0, g)
+    # Baseline at reference semantics: fp32 throughout. Off-accelerator the
+    # fused problem already IS fp32 — reuse it (a second 50k-batch build
+    # would be pure duplicate work); on-accelerator build the fp32 copy on
+    # the CPU backend, where the baseline runs.
+    if _ACCEL:
+        with jax.default_device(jax.devices("cpu")[0]):
+            kl_fn32, flat0_32, g32 = build_problem()
+    else:
+        kl_fn32, flat0_32, g32 = kl_fn, flat0, g
+    base_ms, x_base = time_reference_semantics(kl_fn32, flat0_32, g32)
 
     # Both solvers must agree — a fast wrong solve is worthless.
     cos = float(

@@ -160,6 +160,42 @@ def test_tp_agent_iteration_matches_single_device():
     assert abs(float(st1["kl_old_new"]) - float(st2["kl_old_new"])) < 1e-5
 
 
+def test_tp_agent_rejects_unshardable_policy():
+    """A model axis that shards nothing must error, not silently replicate."""
+    from trpo_tpu.agent import TRPOAgent
+
+    agent = TRPOAgent(
+        "cartpole",
+        TRPOConfig(
+            env="cartpole",
+            n_envs=8,
+            batch_timesteps=64,
+            policy_hidden=(10, 10),  # 10 % 4 != 0 → nothing to shard
+            mesh_shape=(2, 4),
+            mesh_axes=("data", "model"),
+        ),
+    )
+    with pytest.raises(ValueError, match="shards nothing"):
+        agent.init_state()
+
+
+def test_linesearch_preserves_bf16_dtype():
+    """The public ops API accepts non-f32 params (contract kept after the
+    pytree generalization)."""
+    from trpo_tpu.ops.linesearch import backtracking_linesearch
+
+    x = jnp.ones(8, jnp.bfloat16)
+    step = -jnp.ones(8, jnp.bfloat16)
+    res = backtracking_linesearch(
+        lambda v: jnp.sum(jnp.asarray(v, jnp.float32) ** 2),
+        x,
+        step,
+        expected_improve_rate=jnp.asarray(16.0),
+    )
+    assert res.x.dtype == jnp.bfloat16
+    assert bool(res.success)
+
+
 def test_tree_vdot_matches_flat_dot():
     t1 = {"a": jnp.arange(6.0).reshape(2, 3), "b": jnp.array([1.0, -2.0])}
     t2 = {"a": jnp.ones((2, 3)), "b": jnp.array([0.5, 4.0])}

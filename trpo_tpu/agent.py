@@ -205,6 +205,17 @@ class TRPOAgent:
             policy_params = shard_policy_params(
                 policy_params, self.mesh, self._tp_axis
             )
+            if all(
+                leaf.sharding.is_fully_replicated
+                for leaf in jax.tree_util.tree_leaves(policy_params)
+            ):
+                mp = self.mesh.shape[self._tp_axis]
+                raise ValueError(
+                    f"tensor parallelism over {self._tp_axis}={mp} shards "
+                    f"nothing: no policy layer dimension (hidden="
+                    f"{tuple(self.cfg.policy_hidden)}) divides the axis — "
+                    "resize the hidden layers or the mesh"
+                )
         return TrainState(
             policy_params=policy_params,
             vf_state=self.vf.init(k_vf),

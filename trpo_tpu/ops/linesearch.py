@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from trpo_tpu.ops.treemath import tree_add_scaled, tree_where
+from trpo_tpu.ops.treemath import tree_where
 
 __all__ = ["backtracking_linesearch", "LinesearchResult"]
 
@@ -63,7 +63,11 @@ def backtracking_linesearch(
         frac = jnp.asarray(backtrack_factor, jnp.float32) ** k.astype(
             jnp.float32
         )
-        xnew = tree_add_scaled(x, frac, fullstep)
+        # per-leaf dtype-preserving step: keeps the while_loop carry dtypes
+        # identical to the input x (which may be bf16 or mixed-dtype)
+        xnew = jax.tree_util.tree_map(
+            lambda a, s: a + jnp.asarray(frac, a.dtype) * s, x, fullstep
+        )
         newfval = loss_fn(xnew)
         actual_improve = fval - newfval
         expected_improve = expected_improve_rate * frac

@@ -9,14 +9,24 @@ helpers let CG / FVP / line search run directly on parameter pytrees, so a
 ``"model"``-sharded layout flows through the whole solve with XLA inserting
 only the collectives the math needs (scalar psums for the dot products).
 
-All reductions accumulate in fp32 regardless of leaf dtype (the solve is
-fp32-only — see ``ops/cg.py``).
+Generic tree arithmetic delegates to ``optax.tree_utils`` (already a
+dependency). The ones defined here exist for solver-specific semantics the
+optax versions don't give: **fp32 accumulation** of the dot products and
+norms regardless of leaf dtype (the solve is fp32-only — see ``ops/cg.py``)
+and an fp32 cast helper.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from optax.tree_utils import (  # noqa: F401  (re-exported)
+    tree_add_scale as tree_add_scaled,
+    tree_scale as _optax_tree_scale,
+    tree_sub,
+    tree_where,
+    tree_zeros_like,
+)
 
 __all__ = [
     "tree_f32",
@@ -37,12 +47,13 @@ def tree_f32(t):
     return _map(lambda x: jnp.asarray(x, jnp.float32), t)
 
 
-def tree_zeros_like(t):
-    return _map(jnp.zeros_like, t)
+def tree_scale(alpha, t):
+    return _optax_tree_scale(alpha, t)
 
 
 def tree_vdot(a, b) -> jax.Array:
-    """Σ over leaves of ⟨a_leaf, b_leaf⟩, accumulated in fp32."""
+    """Σ over leaves of ⟨a_leaf, b_leaf⟩, accumulated in fp32 (unlike
+    ``optax.tree_utils.tree_vdot``, which accumulates in the leaf dtype)."""
     dots = _map(
         lambda x, y: jnp.vdot(
             jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32)
@@ -50,26 +61,10 @@ def tree_vdot(a, b) -> jax.Array:
         a,
         b,
     )
-    return jax.tree_util.tree_reduce(jnp.add, dots, jnp.asarray(0.0, jnp.float32))
+    return jax.tree_util.tree_reduce(
+        jnp.add, dots, jnp.asarray(0.0, jnp.float32)
+    )
 
 
 def tree_norm(t) -> jax.Array:
     return jnp.sqrt(tree_vdot(t, t))
-
-
-def tree_add_scaled(x, alpha, y):
-    """``x + alpha · y`` leafwise (alpha a scalar)."""
-    return _map(lambda a, b: a + alpha * b, x, y)
-
-
-def tree_scale(alpha, t):
-    return _map(lambda x: alpha * x, t)
-
-
-def tree_sub(a, b):
-    return _map(lambda x, y: x - y, a, b)
-
-
-def tree_where(pred, a, b):
-    """Leafwise ``jnp.where(pred, a, b)`` for a scalar predicate."""
-    return _map(lambda x, y: jnp.where(pred, x, y), a, b)
