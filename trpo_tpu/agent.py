@@ -216,7 +216,7 @@ class TRPOAgent:
                     f"{tuple(self.cfg.policy_hidden)}) divides the axis — "
                     "resize the hidden layers or the mesh"
                 )
-        return TrainState(
+        state = TrainState(
             policy_params=policy_params,
             vf_state=self.vf.init(k_vf),
             env_carry=env_carry,
@@ -227,6 +227,26 @@ class TRPOAgent:
             if jax.config.jax_enable_x64
             else jnp.asarray(0, jnp.int32),
         )
+        if self.mesh is not None:
+            # Annotate EVERY remaining leaf replicated over the mesh. This
+            # matters for checkpoint/resume: Checkpointer.restore takes its
+            # placements from this template, and a leaf without a mesh
+            # sharding would restore committed to one device — incompatible
+            # with the mesh-sharded env carry in the same jitted step.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self.mesh, P())
+            shardings = jax.tree_util.tree_map(
+                lambda x: x.sharding
+                if (
+                    hasattr(x, "sharding")
+                    and not x.sharding.is_fully_replicated
+                )
+                else rep,
+                state,
+            )
+            state = jax.device_put(state, shardings)
+        return state
 
     # ------------------------------------------------------------------
     # act (ref trpo_inksci.py:76-87)
