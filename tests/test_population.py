@@ -1,0 +1,103 @@
+"""Population (vmapped multi-seed) training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.population import Population
+
+
+def _agent(**kw):
+    base = dict(
+        env="cartpole",
+        n_envs=4,
+        batch_timesteps=64,
+        cg_iters=4,
+        vf_train_steps=5,
+        policy_hidden=(16,),
+    )
+    base.update(kw)
+    return TRPOAgent("cartpole", TRPOConfig(**base))
+
+
+def test_population_runs_and_members_differ():
+    pop = Population(_agent(), seeds=[0, 1, 2, 3])
+    stats = pop.run_iteration()
+    assert stats["entropy"].shape == (4,)
+    assert int(pop.state.iteration[0]) == 1
+    # different seeds → different params after one update
+    f0 = jax.flatten_util.ravel_pytree(pop.member_state(0).policy_params)[0]
+    f1 = jax.flatten_util.ravel_pytree(pop.member_state(1).policy_params)[0]
+    assert not np.allclose(np.asarray(f0), np.asarray(f1))
+
+
+def test_population_member_matches_solo_run():
+    """vmapped member i must reproduce a solo run with the same seed."""
+    agent = _agent()
+    pop = Population(agent, seeds=[3, 5])
+    pop.run_iteration()
+    pop.run_iteration()
+
+    solo = agent.init_state(5)
+    solo, _ = agent.run_iteration(solo)
+    solo, _ = agent.run_iteration(solo)
+
+    f_pop = jax.flatten_util.ravel_pytree(pop.member_state(1).policy_params)[0]
+    f_solo = jax.flatten_util.ravel_pytree(solo.policy_params)[0]
+    np.testing.assert_allclose(
+        np.asarray(f_pop), np.asarray(f_solo), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_population_sharded_matches_unsharded():
+    from trpo_tpu.parallel import make_mesh
+
+    seeds = list(range(8))
+    ref = Population(_agent(), seeds=seeds)
+    ref_stats = ref.run_iteration()
+
+    mesh = make_mesh((8,), ("data",))
+    shd = Population(_agent(), seeds=seeds, mesh=mesh)
+    # the population axis must actually be split
+    assert not shd.state.rng.sharding.is_fully_replicated
+    shd_stats = shd.run_iteration()
+
+    np.testing.assert_allclose(
+        np.asarray(ref_stats["entropy"]),
+        np.asarray(shd_stats["entropy"]),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+    f_r = jax.flatten_util.ravel_pytree(ref.member_state(2).policy_params)[0]
+    f_s = jax.flatten_util.ravel_pytree(shd.member_state(2).policy_params)[0]
+    np.testing.assert_allclose(
+        np.asarray(f_r), np.asarray(f_s), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_population_best_member_ignores_nan():
+    stats = {
+        "mean_episode_reward": jnp.asarray([jnp.nan, 10.0, 5.0]),
+    }
+    pop = Population.__new__(Population)  # only best_member is exercised
+    assert Population.best_member(pop, stats) == 1
+
+
+def test_population_validates_inputs():
+    from trpo_tpu.parallel import make_mesh
+
+    with pytest.raises(ValueError, match="device env"):
+        Population(
+            TRPOAgent(
+                "native:cartpole",
+                TRPOConfig(env="native:cartpole", n_envs=2, batch_timesteps=16),
+            ),
+            seeds=[0],
+        )
+    with pytest.raises(ValueError, match="meshless"):
+        Population(_agent(n_envs=8, mesh_shape=(8,)), seeds=[0, 1])
+    with pytest.raises(ValueError, match="divide evenly"):
+        Population(_agent(), seeds=[0, 1, 2], mesh=make_mesh((8,), ("data",)))

@@ -1,0 +1,100 @@
+"""Population training: N independent TRPO runs as ONE device program.
+
+A capability with no reference analogue (the reference trains a single
+seed in a single process): ``jax.vmap`` over the agent's fused training
+iteration turns seed-replication — the standard way RL results are
+reported — into one batched XLA program. Every population member runs the
+full pipeline (rollout → GAE → critic fit → natural-gradient update) in
+lockstep; on a mesh, the population axis shards over ``"data"`` so members
+land on different chips (population parallelism composes with, rather than
+competes against, the batch sharding inside each member).
+
+Typical uses: seed sweeps at the cost of one (batched) run, and
+population-based selection (``best_member``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from trpo_tpu.agent import TRPOAgent, TrainState
+
+__all__ = ["Population"]
+
+
+class Population:
+    """N seeds of ``agent`` trained in lockstep under one ``vmap``.
+
+    ``agent`` must use a pure-JAX (device) env and must itself be meshless —
+    pass ``mesh``/``axis`` here instead to shard the POPULATION axis (each
+    member's env/batch axes stay local to its shard).
+    """
+
+    def __init__(
+        self,
+        agent: TRPOAgent,
+        seeds: Sequence[int],
+        mesh=None,
+        axis: str = "data",
+    ):
+        if not agent.is_device_env:
+            raise ValueError(
+                "Population needs a pure-JAX device env (host simulators "
+                "cannot be vmapped)"
+            )
+        if agent.mesh is not None:
+            raise ValueError(
+                "pass a meshless agent; the population axis is the thing "
+                "being sharded (mesh=... here)"
+            )
+        if len(seeds) == 0:
+            raise ValueError("population needs at least one seed")
+        if mesh is not None and len(seeds) % mesh.shape[axis] != 0:
+            raise ValueError(
+                f"population size {len(seeds)} must divide evenly over the "
+                f"{axis}={mesh.shape[axis]} mesh axis"
+            )
+        self.agent = agent
+        self.seeds = tuple(int(s) for s in seeds)
+        self.mesh = mesh
+
+        states = [agent.init_state(s) for s in self.seeds]
+        state = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *states
+        )
+        if mesh is not None:
+            from trpo_tpu.parallel import shard_leading_axis
+
+            state = shard_leading_axis(mesh, state, axis)
+        self.state: TrainState = state
+        self._step = jax.jit(jax.vmap(agent._device_iteration))
+
+    @property
+    def size(self) -> int:
+        return len(self.seeds)
+
+    def run_iteration(self):
+        """Advance every member one training iteration; returns the stats
+        pytree with a leading population axis."""
+        self.state, stats = self._step(self.state)
+        return stats
+
+    def run(self, n_iterations: int):
+        """``n_iterations`` lockstep iterations; returns a list of
+        per-iteration stats pytrees (each with leading population axis)."""
+        return [self.run_iteration() for _ in range(n_iterations)]
+
+    def member_state(self, i: int) -> TrainState:
+        """Extract one member's TrainState (e.g. the selection winner)."""
+        return jax.tree_util.tree_map(lambda x: x[i], self.state)
+
+    def best_member(self, stats) -> int:
+        """Index of the member with the highest mean episode reward in
+        ``stats`` (NaN — no finished episode — treated as worst)."""
+        r = jnp.nan_to_num(
+            jnp.asarray(stats["mean_episode_reward"]), nan=-jnp.inf
+        )
+        return int(jnp.argmax(r))
