@@ -84,15 +84,41 @@ class TRPOAgent:
         obs_shape, action_spec = spec_from_env(env)
         self.obs_shape = obs_shape
         compute_dtype = jnp.dtype(cfg.compute_dtype)
-        self.policy = make_policy(
-            obs_shape,
-            action_spec,
-            hidden=tuple(cfg.policy_hidden),
-            activation=cfg.policy_activation,
-            init_log_std=cfg.init_log_std,
-            compute_dtype=compute_dtype,
-        )
+        if cfg.policy_gru is not None:
+            if not self.is_device_env:
+                raise NotImplementedError(
+                    "policy_gru needs a pure-JAX device env (the hidden "
+                    "state threads through the on-device rollout scan)"
+                )
+            from trpo_tpu.models.recurrent import make_recurrent_policy
+
+            self.policy = make_recurrent_policy(
+                obs_shape,
+                action_spec,
+                hidden=tuple(cfg.policy_hidden),
+                gru_size=cfg.policy_gru,
+                activation=cfg.policy_activation,
+                init_log_std=cfg.init_log_std,
+                compute_dtype=compute_dtype,
+            )
+        else:
+            self.policy = make_policy(
+                obs_shape,
+                action_spec,
+                hidden=tuple(cfg.policy_hidden),
+                activation=cfg.policy_activation,
+                init_log_std=cfg.init_log_std,
+                compute_dtype=compute_dtype,
+            )
+        self.is_recurrent = cfg.policy_gru is not None
         obs_dim = int(math.prod(obs_shape))
+        if self.is_recurrent:
+            # POMDP critic: condition the value on the policy's GRU state
+            # as well — [obs, h] features, the TPU analogue of the
+            # reference VF's [obs, action_dist, t] inputs (utils.py:70-77).
+            # A memoryless critic over masked observations would alias
+            # states and bias the GAE targets.
+            obs_dim += cfg.policy_gru
         self.vf = create_value_function(
             obs_dim,
             hidden=tuple(cfg.vf_hidden),
@@ -131,6 +157,13 @@ class TRPOAgent:
                     f"{cfg.mesh_axes[0]}={dp} mesh axis"
                 )
             if "model" in cfg.mesh_axes[1:]:
+                if cfg.policy_gru is not None:
+                    raise NotImplementedError(
+                        "tensor parallelism over a GRU policy is not wired "
+                        "up (parallel/tp.py shards MLP layer layouts); use "
+                        'a "data" (and optionally "seq") mesh with '
+                        "policy_gru"
+                    )
                 # Tensor parallelism: policy params sharded Megatron-style
                 # over "model" (parallel/tp.py), and the update switched to
                 # the pytree-domain solve so the sharding persists through
@@ -185,7 +218,7 @@ class TRPOAgent:
         key = jax.random.key(seed)
         k_policy, k_vf, k_env, k_run = jax.random.split(key, 4)
         env_carry = (
-            init_carry(self.env, k_env, self.cfg.n_envs)
+            init_carry(self.env, k_env, self.cfg.n_envs, policy=self.policy)
             if self.is_device_env
             else None
         )
@@ -252,11 +285,16 @@ class TRPOAgent:
     # act (ref trpo_inksci.py:76-87)
     # ------------------------------------------------------------------
 
-    def _act(self, params, obs, key, eval_mode: bool):
+    def _act(self, params, obs, key, eval_mode: bool, h=None):
         squeeze = obs.ndim == len(self.obs_shape)
         if squeeze:
             obs = obs[None]
-        dist = self.policy.apply(params, obs)
+        if self.is_recurrent:
+            if squeeze:
+                h = h[None]
+            h_new, dist = self.policy.step(params, h, obs)
+        else:
+            h_new, dist = None, self.policy.apply(params, obs)
         if eval_mode:  # static under jit: argmax/mode, ref trpo_inksci.py:83
             action = self.policy.dist.mode(dist)
         else:
@@ -264,15 +302,22 @@ class TRPOAgent:
         if squeeze:
             action = jax.tree_util.tree_map(lambda a: a[0], action)
             dist = jax.tree_util.tree_map(lambda d: d[0], dist)
-        return action, dist
+            if h_new is not None:
+                h_new = h_new[0]
+        return action, dist, h_new
 
-    def act(self, state: TrainState, obs, key=None, eval_mode: bool = False):
+    def act(self, state: TrainState, obs, key=None, eval_mode: bool = False,
+            policy_carry=None):
         """Sample (train) or argmax (eval) an action for ``obs`` — the
         reference's train/eval split at ``trpo_inksci.py:79-83`` minus the
         vestigial ``prev_action`` buffer (SURVEY §7).
 
         Train mode requires an explicit ``key``: a silent default would make
-        every call sample identically and kill exploration."""
+        every call sample identically and kill exploration.
+
+        Returns ``(action, dist_params)`` — or, for a recurrent policy,
+        ``(action, dist_params, new_policy_carry)``; pass the carry back on
+        the next call (``policy_carry=None`` starts fresh memory)."""
         if key is None:
             if not eval_mode:
                 raise ValueError(
@@ -280,21 +325,47 @@ class TRPOAgent:
                     "pass key=jax.random.key(...) or use eval_mode=True"
                 )
             key = jax.random.key(0)  # unused by the mode/argmax path
-        return self._act_fn(
-            state.policy_params, jnp.asarray(obs), key, eval_mode
-        )
+        obs = jnp.asarray(obs)
+        if self.is_recurrent:
+            if policy_carry is None:
+                n = 1 if obs.ndim == len(self.obs_shape) else obs.shape[0]
+                policy_carry = self.policy.initial_state(n)
+                if obs.ndim == len(self.obs_shape):
+                    policy_carry = policy_carry[0]
+            return self._act_fn(
+                state.policy_params, obs, key, eval_mode, policy_carry
+            )
+        action, dist, _ = self._act_fn(state.policy_params, obs, key, eval_mode)
+        return action, dist
 
     # ------------------------------------------------------------------
     # the fused iteration
     # ------------------------------------------------------------------
 
-    def _advantages(self, vf_state: VFState, traj: Trajectory):
+    def _vf_features(self, traj: Trajectory):
+        """Critic inputs ``(current, next)``, flattened to ``(T·N, F)``.
+
+        Feedforward: observations alone. Recurrent: observations ⊕ the
+        policy's hidden state held when seeing them (``rollout.Trajectory``
+        ``policy_h``/``policy_h_next``) — the critic shares the policy's
+        state estimate instead of re-learning one from aliased obs."""
         T, N = traj.rewards.shape
         flat = lambda x: x.reshape((T * N,) + x.shape[2:])
-        values = self.vf.predict(vf_state, flat(traj.obs)).reshape(T, N)
-        next_values = self.vf.predict(vf_state, flat(traj.next_obs)).reshape(
-            T, N
+        if not self.is_recurrent:
+            return flat(traj.obs), flat(traj.next_obs)
+        join = lambda o, h: jnp.concatenate(
+            [flat(o).reshape(T * N, -1), flat(h)], axis=-1
         )
+        return (
+            join(traj.obs, traj.policy_h),
+            join(traj.next_obs, traj.policy_h_next),
+        )
+
+    def _advantages(self, vf_state: VFState, traj: Trajectory):
+        T, N = traj.rewards.shape
+        vf_in, vf_next_in = self._vf_features(traj)
+        values = self.vf.predict(vf_state, vf_in).reshape(T, N)
+        next_values = self.vf.predict(vf_state, vf_next_in).reshape(T, N)
         if self._seq_gae is not None:
             adv, vtarg = self._seq_gae(
                 traj.rewards,
@@ -331,17 +402,33 @@ class TRPOAgent:
 
         # Critic fit AFTER advantage computation — the reference's ordering
         # (predict at trpo_inksci.py:103, fit at :143).
+        vf_in, _ = self._vf_features(traj)
         new_vf_state, vf_loss = self.vf.fit(
-            train_state.vf_state, flat(traj.obs), flat(vtarg), weight
+            train_state.vf_state, vf_in, flat(vtarg), weight
         )
 
-        batch = TRPOBatch(
-            obs=flat(traj.obs),
-            actions=flat(traj.actions),
-            advantages=adv_flat,
-            old_dist=jax.tree_util.tree_map(flat, traj.old_dist),
-            weight=weight,
-        )
+        if self.is_recurrent:
+            # Recurrent batch keeps the (T, N) axes: the policy's apply
+            # replays the window through the GRU (resets + h0 from the
+            # rollout), and every reduction in the update is a shape-
+            # agnostic weighted mean — same math, different layout.
+            from trpo_tpu.models.recurrent import SeqObs
+
+            batch = TRPOBatch(
+                obs=SeqObs(traj.obs, traj.reset, traj.policy_h0),
+                actions=traj.actions,
+                advantages=adv_flat.reshape(T, N),
+                old_dist=traj.old_dist,
+                weight=weight.reshape(T, N),
+            )
+        else:
+            batch = TRPOBatch(
+                obs=flat(traj.obs),
+                actions=flat(traj.actions),
+                advantages=adv_flat,
+                old_dist=jax.tree_util.tree_map(flat, traj.old_dist),
+                weight=weight,
+            )
         new_policy_params, trpo_stats = self.trpo_update(
             train_state.policy_params, batch
         )
@@ -480,7 +567,9 @@ class TRPOAgent:
                             deterministic=True, n_steps=n_steps)
                 )
                 self._eval_roll_fns[n_steps] = fn
-            carry = init_carry(self.env, k_init, self.cfg.n_envs)
+            carry = init_carry(
+                self.env, k_init, self.cfg.n_envs, policy=self.policy
+            )
             _, traj = fn(train_state.policy_params, carry, k_roll)
         else:
             self.env.reset_all(seed=seed)
@@ -488,7 +577,7 @@ class TRPOAgent:
                 # reuse the already-jitted act path (argmax/mode branch)
                 self._host_eval_act_fn = lambda p, o, k: self._act_fn(
                     p, o, k, True
-                )
+                )[:2]
             traj = host_rollout(
                 self.env, self.policy, train_state.policy_params, k_roll,
                 n_steps, act_fn=self._host_eval_act_fn,

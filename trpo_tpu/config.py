@@ -47,6 +47,11 @@ class TRPOConfig:
     # --- networks --------------------------------------------------------
     policy_hidden: Tuple[int, ...] = (64,)   # ref: one 64-tanh layer (trpo_inksci.py:39)
     policy_activation: str = "tanh"
+    policy_gru: Optional[int] = None  # GRU hidden size → recurrent policy
+    #                                (models/recurrent.py; POMDPs). Device
+    #                                envs only; no reference analogue (its
+    #                                prev_action buffer was vestigial,
+    #                                trpo_inksci.py:31,85-86)
     vf_hidden: Tuple[int, ...] = (64, 64)    # ref critic: 64-relu × 2 (utils.py:59-61)
     vf_activation: str = "relu"
     vf_train_steps: int = 50       # ref: 50 full-batch Adam steps (utils.py:84)
@@ -152,6 +157,18 @@ PRESETS = {
         n_envs=128,
         policy_hidden=(256, 256),
         cg_damping=0.1,
+    ),
+    # Partially observable CartPole (velocities masked) + GRU policy — the
+    # recurrent-model-family rung; no reference analogue (SURVEY §2.1: the
+    # reference's prev_action history buffer is vestigial).
+    "cartpole-po": TRPOConfig(
+        env="cartpole-po",
+        policy_hidden=(64,),
+        policy_gru=64,
+        gamma=0.99,
+        lam=0.95,
+        batch_timesteps=2000,
+        n_envs=16,
     ),
     "catch": TRPOConfig(
         env="catch",

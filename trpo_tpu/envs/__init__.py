@@ -27,9 +27,20 @@ from trpo_tpu.envs.locomotion import (  # noqa: F401
     HumanoidSim,
 )
 from trpo_tpu.envs.catch import CatchPixels  # noqa: F401
+from trpo_tpu.envs.wrappers import MaskObservation  # noqa: F401
+
+
+def _cartpole_po(max_episode_steps: int = 500):
+    """CartPole with velocities hidden (obs = [x, theta]) — the classic
+    partially observable variant; needs a recurrent policy to solve."""
+    return MaskObservation(
+        CartPole(max_episode_steps=max_episode_steps), indices=(0, 2)
+    )
+
 
 _JAX_ENVS = {
     "cartpole": CartPole,
+    "cartpole-po": _cartpole_po,
     "pendulum": Pendulum,
     "fake": FakeEnv,
     "chain": ChainLocomotion,
@@ -63,8 +74,10 @@ def make(name: str, max_episode_steps=None, **kwargs):
         if "max_episode_steps" in kwargs:
             import inspect
 
+            # signature() resolves __init__ for classes and works for
+            # factory functions (e.g. cartpole-po) alike
             if "max_episode_steps" not in inspect.signature(
-                cls.__init__
+                cls
             ).parameters:
                 raise TypeError(
                     f"env {name!r} has a fixed horizon; "

@@ -1,0 +1,53 @@
+"""Device-env wrappers (pure-JAX, jittable).
+
+:class:`MaskObservation` projects observations onto a subset of indices —
+the standard way to turn a fully observable classic-control task into a
+POMDP (e.g. CartPole with velocities hidden: the policy must estimate them
+from history, which requires memory — ``models/recurrent.py``). No
+reference analogue (the reference is fully observable by construction).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+__all__ = ["MaskObservation"]
+
+
+class MaskObservation:
+    """Keep only ``indices`` of a 1-D observation; dynamics untouched.
+
+    Wraps any pure-JAX env (``reset``/``step``/``obs_shape``/``action_spec``
+    protocol, ``envs.is_device_env``).
+    """
+
+    def __init__(self, env, indices: Sequence[int]):
+        if len(env.obs_shape) != 1:
+            raise ValueError(
+                f"MaskObservation needs 1-D observations, got {env.obs_shape}"
+            )
+        dim = env.obs_shape[0]
+        bad = [i for i in indices if not 0 <= i < dim]
+        if bad or not indices:
+            raise ValueError(
+                f"indices {list(indices)} invalid for obs dim {dim}"
+            )
+        self.env = env
+        self.indices = jnp.asarray(tuple(indices), jnp.int32)
+        self.obs_shape: Tuple[int, ...] = (len(indices),)
+        self.action_spec = env.action_spec
+
+    def __getattr__(self, name):  # delegate e.g. max_episode_steps
+        return getattr(self.env, name)
+
+    def reset(self, key):
+        state, obs = self.env.reset(key)
+        return state, obs[self.indices]
+
+    def step(self, state, action, key):
+        state, obs, reward, terminated, truncated = self.env.step(
+            state, action, key
+        )
+        return state, obs[self.indices], reward, terminated, truncated

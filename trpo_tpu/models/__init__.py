@@ -9,3 +9,8 @@ from trpo_tpu.models.policy import (  # noqa: F401
     make_policy,
     spec_from_env,
 )
+from trpo_tpu.models.recurrent import (  # noqa: F401
+    RecurrentPolicy,
+    SeqObs,
+    make_recurrent_policy,
+)

@@ -1,0 +1,231 @@
+"""Recurrent (GRU) policies for partially observable tasks.
+
+The reference has no recurrence — its only nod to history is a vestigial
+``prev_action`` one-hot buffer that is maintained but never fed to the
+network (``trpo_inksci.py:31,85-86``, a leftover from its ancestor repo).
+This module supplies the real capability: a GRU layer between the MLP torso
+and the distribution head, so the policy can integrate observations over
+time (POMDPs: masked velocities, flickering pixels, memory tasks).
+
+TPU-first design notes:
+
+* The GRU's three gates are computed with TWO fused matmuls per step
+  (``x @ Wx`` and ``h @ Wh``, each ``(·, 3H)``) — one MXU pass per operand
+  instead of six small ones; gate nonlinearities fuse into the matmul
+  epilogue under XLA.
+* Sequence application is a ``lax.scan`` over time of that step — static
+  shapes, compiled once.  Episode boundaries inside a rollout window are
+  handled *in-graph*: a per-step ``reset`` flag zeroes the hidden state
+  before the step consumes it, so one fixed-shape ``(T, N)`` window can
+  contain many episodes (the same packing the feedforward path uses).
+* The hidden state that enters a training window (``h0``) is carried data,
+  not a parameter: ``apply`` wraps it in ``stop_gradient`` — gradients do
+  not flow across window boundaries (truncated BPTT at the window length).
+
+The TRPO update machinery (``trpo_tpu.trpo``) is reused untouched: its loss
+body only touches observations through ``policy.apply(params, batch.obs)``
+and reduces with shape-agnostic weighted means, so a recurrent batch simply
+keeps the ``(T, N)`` axes and passes a :class:`SeqObs` pytree where the
+feedforward path passes a flat ``(B, obs)`` array.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trpo_tpu.distributions import Categorical, DiagGaussian
+from trpo_tpu.models.mlp import ACTIVATIONS, apply_mlp, init_linear, init_mlp
+from trpo_tpu.models.policy import BoxSpec, DiscreteSpec
+
+__all__ = [
+    "SeqObs",
+    "RecurrentPolicy",
+    "init_gru",
+    "gru_step",
+    "make_recurrent_policy",
+]
+
+
+class SeqObs(NamedTuple):
+    """The "observation" a recurrent policy's ``apply`` consumes: a whole
+    time-major window plus the state context needed to replay it."""
+    obs: jax.Array      # (T, N, *obs_shape)
+    reset: jax.Array    # (T, N) bool — hidden state is zeroed BEFORE step t
+    h0: jax.Array       # (N, H) hidden state entering the window
+
+
+class RecurrentPolicy(NamedTuple):
+    """`Policy` plus the recurrent surface.
+
+    ``apply`` takes a :class:`SeqObs` (not a flat obs array) and returns
+    dist params with leading ``(T, N)``; ``step``/``initial_state`` are the
+    single-timestep interface the rollout threads through its scan.
+    """
+    init: Callable[[jax.Array], Any]
+    apply: Callable[[Any, SeqObs], Any]
+    dist: Any
+    action_spec: Any
+    initial_state: Callable[[int], jax.Array]       # n_envs -> (N, H) zeros
+    step: Callable[[Any, jax.Array, jax.Array], Tuple[jax.Array, Any]]
+    hidden_size: int
+
+
+def init_gru(key, in_dim: int, hidden: int):
+    """GRU parameters with fused gate weights: ``wx (in, 3H)``,
+    ``wh (H, 3H)``, gate order ``[reset, update, candidate]``."""
+    k_x, k_h = jax.random.split(key)
+    # Orthogonal per gate block (standard RNN init), assembled fused.
+    ortho = jax.nn.initializers.orthogonal(1.0)
+    wx = jnp.concatenate(
+        [ortho(k, (in_dim, hidden), jnp.float32)
+         for k in jax.random.split(k_x, 3)], axis=1,
+    )
+    wh = jnp.concatenate(
+        [ortho(k, (hidden, hidden), jnp.float32)
+         for k in jax.random.split(k_h, 3)], axis=1,
+    )
+    return {"wx": wx, "wh": wh, "b": jnp.zeros((3 * hidden,), jnp.float32)}
+
+
+def _gru_from_xw(params, h, xw, compute_dtype=jnp.float32):
+    """GRU update given the precomputed input projection ``xw = x @ wx + b``.
+
+    Split out so sequence replay can hoist the time-independent ``x @ wx``
+    (and the whole torso) into ONE large batched matmul over the window —
+    only the ``h @ wh`` recurrence genuinely needs to live in the scan."""
+    H = params["wh"].shape[0]
+    cd = compute_dtype
+    hw = jnp.asarray(h, cd) @ jnp.asarray(params["wh"], cd)
+    xr, xz, xn = xw[..., :H], xw[..., H:2 * H], xw[..., 2 * H:]
+    hr, hz, hn = hw[..., :H], hw[..., H:2 * H], hw[..., 2 * H:]
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    h_new = (1.0 - z) * n + z * jnp.asarray(h, cd)
+    return jnp.asarray(h_new, jnp.float32)
+
+
+def _input_proj(params, x, compute_dtype=jnp.float32):
+    """``x @ wx + b`` — the gates' input half, batchable over any axes."""
+    cd = compute_dtype
+    return jnp.asarray(x, cd) @ jnp.asarray(params["wx"], cd) + jnp.asarray(
+        params["b"], cd
+    )
+
+
+def gru_step(params, h, x, compute_dtype=jnp.float32):
+    """One GRU step, batched over leading axes. Two fused matmuls; solver-
+    facing output stays fp32 (same contract as ``apply_mlp``)."""
+    return _gru_from_xw(
+        params, h, _input_proj(params, x, compute_dtype), compute_dtype
+    )
+
+
+def make_recurrent_policy(
+    obs_shape: Tuple[int, ...],
+    action_spec,
+    hidden: Tuple[int, ...] = (64,),
+    gru_size: int = 64,
+    activation: str = "tanh",
+    init_log_std: float = 0.0,
+    compute_dtype=jnp.float32,
+) -> RecurrentPolicy:
+    """MLP torso → GRU(``gru_size``) → linear head.
+
+    ``hidden`` sizes the torso (activation applied after every torso layer,
+    including the last — the GRU is the "output layer" of the torso stack).
+    1-D observations only; a conv torso can be composed later the same way
+    the feedforward path does it.
+    """
+    if activation not in ACTIVATIONS:
+        raise KeyError(
+            f"unknown activation {activation!r}; have {sorted(ACTIVATIONS)}"
+        )
+    if isinstance(action_spec, DiscreteSpec):
+        out_dim, dist = action_spec.n, Categorical
+    elif isinstance(action_spec, BoxSpec):
+        out_dim, dist = action_spec.dim, DiagGaussian
+    else:
+        raise TypeError(f"unsupported action spec: {action_spec!r}")
+    obs_dim = math.prod(obs_shape)
+    feat_dim = hidden[-1] if hidden else obs_dim
+    act = ACTIVATIONS[activation]
+
+    def init(key):
+        k_torso, k_gru, k_head = jax.random.split(key, 3)
+        params = {
+            "gru": init_gru(k_gru, feat_dim, gru_size),
+            # small final scale: near-uniform initial policy (models/mlp.py)
+            "head": init_linear(k_head, gru_size, out_dim, scale=0.01),
+        }
+        if hidden:
+            # torso as an MLP whose "output layer" is the last hidden size;
+            # apply_mlp skips the activation on the final layer, so it is
+            # applied in _features below.
+            params["torso"] = init_mlp(
+                k_torso, obs_dim, hidden[:-1], hidden[-1], final_scale=None
+            )
+        if dist is DiagGaussian:
+            params["log_std"] = jnp.full((out_dim,), init_log_std, jnp.float32)
+        return params
+
+    def _features(params, obs):
+        x = obs.reshape(obs.shape[:-len(obs_shape)] + (obs_dim,))
+        if hidden:
+            x = act(apply_mlp(params["torso"], x, activation, compute_dtype))
+        return x
+
+    def _head(params, h):
+        w = jnp.asarray(params["head"]["w"], compute_dtype)
+        b = jnp.asarray(params["head"]["b"], compute_dtype)
+        raw = jnp.asarray(jnp.asarray(h, compute_dtype) @ w + b, jnp.float32)
+        if dist is Categorical:
+            return {"logits": raw}
+        return {
+            "mean": raw,
+            "log_std": jnp.broadcast_to(params["log_std"], raw.shape),
+        }
+
+    def initial_state(n_envs: int):
+        return jnp.zeros((n_envs, gru_size), jnp.float32)
+
+    def step(params, h, obs):
+        """(params, h (N,H), obs (N,*o)) -> (h', dist params (N,...))."""
+        h_new = gru_step(
+            params["gru"], h, _features(params, obs), compute_dtype
+        )
+        return h_new, _head(params, h_new)
+
+    def apply(params, seq: SeqObs):
+        """Replay a window: dist params with leading (T, N).
+
+        The torso and the gates' input projection are time-independent, so
+        they run as ONE (T·N)-row matmul each BEFORE the scan (large MXU
+        tiles); the scan body is only the (N, H)·(H, 3H) recurrence."""
+        h0 = jax.lax.stop_gradient(seq.h0)  # truncated BPTT at the window
+        xw = _input_proj(
+            params["gru"], _features(params, seq.obs), compute_dtype
+        )  # (T, N, 3H)
+
+        def scan_step(h, inputs):
+            xw_t, reset_t = inputs
+            h = jnp.where(reset_t[:, None], 0.0, h)
+            h = _gru_from_xw(params["gru"], h, xw_t, compute_dtype)
+            return h, h
+
+        _, hs = jax.lax.scan(scan_step, h0, (xw, seq.reset))
+        return _head(params, hs)
+
+    return RecurrentPolicy(
+        init=init,
+        apply=apply,
+        dist=dist,
+        action_spec=action_spec,
+        initial_state=initial_state,
+        step=step,
+        hidden_size=gru_size,
+    )

@@ -39,6 +39,18 @@ class Trajectory(NamedTuple):
     next_obs: jax.Array     # (T, N, *obs_shape) — s_{t+1} BEFORE auto-reset
     episode_return: jax.Array  # (T, N) — running return, valid where done
     episode_length: jax.Array  # (T, N) — running length, valid where done
+    # Recurrent policies only (None otherwise): per-step "hidden state was
+    # zeroed before consuming obs[t]" flags, and the (N, H) hidden state
+    # that entered this window — together they let the TRPO update replay
+    # the window exactly (models/recurrent.py SeqObs). ``policy_h``/
+    # ``policy_h_next`` are the memory before/after consuming obs[t] — the
+    # critic's history features (the TPU analogue of the reference VF
+    # taking the action distribution as an input, utils.py:70-77).
+    reset: Any = None          # (T, N) bool
+    policy_h0: Any = None      # (N, H)
+    policy_h: Any = None       # (T, N, H) — context entering step t
+    policy_h_next: Any = None  # (T, N, H) — context after obs[t] (pre-reset),
+    #                            i.e. the memory held when seeing next_obs[t]
 
 
 def init_env_states(env, key, n_envs: int):
@@ -71,15 +83,32 @@ def device_rollout(
 
     Jit-safe: designed to be traced inside the full training-step program.
     Returns ``(new_carry, Trajectory)``.
+
+    Recurrent policies (``models/recurrent.py``): the carry gains the policy
+    hidden state and a ``prev_done`` flag — ``h`` threads through the scan,
+    is zeroed at episode boundaries, and the emitted trajectory carries the
+    ``reset`` flags + window-entry ``h0`` the training replay needs.
     """
-    env_states, obs0, ep_ret0, ep_len0 = carry
+    recurrent = hasattr(policy, "step")
+    if recurrent:
+        env_states, obs0, ep_ret0, ep_len0, h0, prev_done0 = carry
+    else:
+        env_states, obs0, ep_ret0, ep_len0 = carry
+        h0 = prev_done0 = None
 
     def step_fn(c, step_key):
-        states, obs, ep_ret, ep_len = c
+        if recurrent:
+            states, obs, ep_ret, ep_len, h, prev_done = c
+        else:
+            states, obs, ep_ret, ep_len = c
+            h = prev_done = None
         k_act, k_step, k_reset = jax.random.split(step_key, 3)
         n = obs.shape[0]
 
-        dist = policy.apply(params, obs)
+        if recurrent:
+            h_new, dist = policy.step(params, h, obs)
+        else:
+            dist = policy.apply(params, obs)
         if deterministic:
             actions = policy.dist.mode(dist)
         else:
@@ -115,27 +144,48 @@ def device_rollout(
             next_obs=next_obs,
             episode_return=ep_ret,
             episode_length=ep_len,
+            # reset flag for THIS step: h was zeroed before consuming obs
+            reset=prev_done,
+            policy_h=h,
+            policy_h_next=h_new if recurrent else None,
         )
         ep_ret = jnp.where(done, 0.0, ep_ret)
         ep_len = jnp.where(done, 0, ep_len)
+        if recurrent:
+            h_next = jnp.where(done[:, None], 0.0, h_new)
+            return (
+                carried_states, carried_obs, ep_ret, ep_len, h_next, done,
+            ), out
         return (carried_states, carried_obs, ep_ret, ep_len), out
 
     step_keys = jax.random.split(key, n_steps)
-    new_carry, traj = jax.lax.scan(
-        step_fn, (env_states, obs0, ep_ret0, ep_len0), step_keys
-    )
+    if recurrent:
+        init = (env_states, obs0, ep_ret0, ep_len0, h0, prev_done0)
+    else:
+        init = (env_states, obs0, ep_ret0, ep_len0)
+    new_carry, traj = jax.lax.scan(step_fn, init, step_keys)
+    if recurrent:
+        traj = traj._replace(policy_h0=h0)
     return new_carry, traj
 
 
-def init_carry(env, key, n_envs: int):
-    """Full rollout carry: env states + obs + episode accumulators."""
+def init_carry(env, key, n_envs: int, policy=None):
+    """Full rollout carry: env states + obs + episode accumulators; for a
+    recurrent ``policy``, also its zero hidden state and a ``prev_done``
+    flag (True: the first window step starts a fresh episode memory)."""
     states, obs = init_env_states(env, key, n_envs)
-    return (
+    carry = (
         states,
         obs,
         jnp.zeros(n_envs, jnp.float32),
         jnp.zeros(n_envs, jnp.int32),
     )
+    if policy is not None and hasattr(policy, "step"):
+        carry = carry + (
+            policy.initial_state(n_envs),
+            jnp.ones(n_envs, bool),
+        )
+    return carry
 
 
 # ---------------------------------------------------------------------------
@@ -160,6 +210,13 @@ def host_rollout(
     per timestep for all envs, vs the reference's per-env-step ``sess.run``
     (``trpo_inksci.py:78``).
     """
+    if hasattr(policy, "step"):
+        raise NotImplementedError(
+            "recurrent policies currently require a pure-JAX device env "
+            "(the hidden state threads through the on-device rollout scan); "
+            "host-simulator support would need per-step hidden-state "
+            "round-trips — use a device env or a feedforward policy"
+        )
     if act_fn is None:
         act_fn = jax.jit(
             lambda p, o, k: (

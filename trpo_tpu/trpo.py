@@ -58,12 +58,20 @@ __all__ = [
 
 
 class TRPOBatch(NamedTuple):
-    """One update's worth of experience, flattened over (time, env) axes."""
-    obs: jax.Array          # (B, *obs_shape)
-    actions: jax.Array      # (B,) int or (B, D) float
-    advantages: jax.Array   # (B,) — already standardized by the caller
-    old_dist: Any           # distribution params pytree with leading (B, ...)
-    weight: jax.Array       # (B,) — 1.0 real step, 0.0 padding
+    """One update's worth of experience.
+
+    Two accepted layouts — every reduction below is a shape-agnostic
+    weighted mean, and ``obs`` only ever flows through ``policy.apply``:
+
+    * feedforward: leading axis ``(B,)`` = flattened (time, env);
+    * recurrent: leading axes ``(T, N)`` time-major, with ``obs`` a
+      ``models.recurrent.SeqObs`` pytree (window + resets + entry state).
+    """
+    obs: Any                # (B, *obs_shape) array — or SeqObs pytree
+    actions: jax.Array      # (B,) int or (B, D) float; recurrent: (T, N, ...)
+    advantages: jax.Array   # (B,) or (T, N) — already standardized
+    old_dist: Any           # dist params pytree, leading (B, ...)/(T, N, ...)
+    weight: jax.Array       # (B,) or (T, N) — 1.0 real step, 0.0 padding
 
 
 class TRPOStats(NamedTuple):

@@ -8,7 +8,10 @@ with a re-initialize-everything bug on lazy build (``utils.py:67``, SURVEY
 functional MLP + optax Adam whose entire fit (all epochs) is one jitted
 ``lax.scan`` — 1 device program instead of 50 ``sess.run`` calls — with
 eager initialization and observation-only features (the action-dist/time
-features are a prettytensor-era quirk; the GAE path makes them unnecessary).
+features are a prettytensor-era quirk; the GAE path makes them unnecessary —
+except for recurrent/POMDP agents, where the agent concatenates the policy's
+GRU state onto the obs so the critic is not state-aliased: ``agent.py
+_vf_features``, the honest analogue of the reference's extra inputs).
 Zeros-before-first-fit is preserved behaviorally via an ``initialized`` flag
 folded into the prediction, so iteration-0 advantages equal raw returns just
 like the reference (``utils.py:88-89``).
