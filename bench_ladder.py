@@ -1,0 +1,117 @@
+"""Config-ladder benchmark: full-training throughput per BASELINE rung.
+
+Complements ``bench.py`` (the driver-facing north-star CG metric) with
+end-to-end numbers across the BASELINE.json ladder's device-env rungs:
+each rung times ``TRPOAgent.run_iterations`` — K complete training
+iterations (rollout → GAE → critic fit → fused natural-gradient update)
+as ONE device program — and reports policy-updates/sec and env-steps/sec.
+
+Timing methodology per the tunneled-TPU rules in ``bench.py``: the K
+iterations chain inside one ``lax.scan`` (sequential by construction), the
+timed sync downloads one small stats leaf, and the trivial-fetch RTT is
+subtracted. Run: ``python bench_ladder.py [--rungs cartpole,catch ...]``.
+Results table: ``BENCH_LADDER.md``.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+# Importing bench FIRST reuses its wedged-tunnel gate: it probes backend
+# liveness in a killable subprocess before any jax call in THIS process,
+# and falls back to CPU if the single-tenant tunnel is stuck (bench.py's
+# module preamble). It also provides the shared RTT measurement.
+import bench as _bench
+from bench import _device_rtt  # noqa: E402
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from trpo_tpu.agent import TRPOAgent  # noqa: E402
+from trpo_tpu.config import get_preset  # noqa: E402
+
+# (preset, K iterations, overrides) — device-env rungs only: the ladder
+# times the fused on-device pipeline; gym:/MuJoCo binaries are external.
+RUNGS = {
+    "cartpole": (20, {}),
+    "cartpole-po": (20, {}),          # recurrent/POMDP rung
+    "pendulum": (10, {}),
+    "catch": (10, {}),                # conv/pixel rung
+    "halfcheetah-sim": (10, {}),
+    "humanoid-sim": (3, {}),          # batch 50k — the north-star shape
+}
+
+
+def bench_rung(name: str, k: int, overrides: dict, reps: int = 3):
+    cfg = get_preset(name).replace(**overrides)
+    agent = TRPOAgent(cfg.env, cfg)
+    state = agent.init_state(seed=0)
+    steps_per_iter = agent.n_steps * cfg.n_envs
+
+    t0 = time.perf_counter()
+    new_state, stats = agent.run_iterations(state, k)   # compile + warm
+    np.asarray(stats["entropy"])
+    compile_s = time.perf_counter() - t0
+    rtt = _device_rtt()
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _, stats = agent.run_iterations(state, k)
+        np.asarray(stats["entropy"])                    # small sync probe
+        best = min(best, time.perf_counter() - t0)
+    ent = np.asarray(stats["entropy"], np.float64)
+    assert np.all(np.isfinite(ent)), f"{name}: non-finite entropy"
+
+    per_iter = max(best - rtt, 1e-9) / k
+    return {
+        "rung": name,
+        "n_envs": cfg.n_envs,
+        "batch_timesteps": steps_per_iter,
+        "updates_per_sec": 1.0 / per_iter,
+        "env_steps_per_sec": steps_per_iter / per_iter,
+        "iter_ms": per_iter * 1e3,
+        "compile_s": compile_s,
+        "backend": jax.devices()[0].platform,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rungs", default=",".join(RUNGS))
+    ap.add_argument("--out", default=None, help="write a markdown table")
+    args = ap.parse_args()
+
+    rows = []
+    for name in args.rungs.split(","):
+        name = name.strip()
+        k, overrides = RUNGS[name]
+        print(f"ladder: {name} ...", file=sys.stderr)
+        rows.append(bench_rung(name, k, overrides))
+        print(json.dumps(rows[-1]))
+
+    if args.out:
+        lines = [
+            "| rung | envs | batch | iter ms | updates/s | env steps/s |",
+            "|---|---|---|---|---|---|",
+        ]
+        for r in rows:
+            lines.append(
+                f"| {r['rung']} | {r['n_envs']} | {r['batch_timesteps']} "
+                f"| {r['iter_ms']:.1f} | {r['updates_per_sec']:.1f} "
+                f"| {r['env_steps_per_sec']:,.0f} |"
+            )
+        with open(args.out, "w") as f:
+            f.write(
+                "# Ladder throughput — full fused training iterations "
+                f"({rows[0]['backend']})\n\n"
+                "One iteration = rollout + GAE + critic fit + TRPO "
+                "natural-gradient update, K iterations scanned into one "
+                "device program (`TRPOAgent.run_iterations`); RTT-corrected "
+                "timing (see `bench.py`).\n\n" + "\n".join(lines) + "\n"
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""`run_iterations`: K fused training iterations as one device program."""
+
+import jax
+import numpy as np
+import pytest
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+
+
+def _agent(**kw):
+    base = dict(
+        env="cartpole",
+        n_envs=4,
+        batch_timesteps=64,
+        cg_iters=4,
+        vf_train_steps=5,
+        policy_hidden=(16,),
+    )
+    base.update(kw)
+    return TRPOAgent(base.pop("env"), TRPOConfig(**base))
+
+
+def test_matches_sequential_iterations():
+    agent = _agent()
+    s_seq = agent.init_state(0)
+    for _ in range(3):
+        s_seq, stats_seq = agent.run_iteration(s_seq)
+
+    s_scan, stats_scan = agent.run_iterations(agent.init_state(0), 3)
+    assert stats_scan["entropy"].shape == (3,)
+    assert int(s_scan.iteration) == 3
+    np.testing.assert_allclose(
+        float(stats_scan["entropy"][-1]), float(stats_seq["entropy"]),
+        rtol=1e-5,
+    )
+    f_seq = jax.flatten_util.ravel_pytree(s_seq.policy_params)[0]
+    f_scan = jax.flatten_util.ravel_pytree(s_scan.policy_params)[0]
+    np.testing.assert_allclose(
+        np.asarray(f_seq), np.asarray(f_scan), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_recurrent_and_mesh():
+    agent = _agent(env="cartpole-po", n_envs=8, policy_gru=8,
+                   mesh_shape=(8,))
+    state, stats = agent.run_iterations(agent.init_state(0), 2)
+    assert stats["entropy"].shape == (2,)
+    assert np.all(np.isfinite(np.asarray(stats["entropy"])))
+
+
+def test_rejects_bad_inputs():
+    agent = _agent()
+    with pytest.raises(ValueError):
+        agent.run_iterations(agent.init_state(0), 0)
+    host = TRPOAgent(
+        "gym:CartPole-v1", TRPOConfig(env="gym:CartPole-v1", n_envs=2)
+    )
+    with pytest.raises(NotImplementedError):
+        host.run_iterations(None, 2)

@@ -205,6 +205,7 @@ class TRPOAgent:
             self._iter_fn = jax.jit(self._device_iteration)
         self._act_fn = jax.jit(self._act, static_argnames=("eval_mode",))
         self._eval_roll_fns: dict = {}   # n_steps -> jitted eval rollout
+        self._multi_iter_fns: dict = {}  # n -> jitted n-iteration scan
         self._host_eval_act_fn = None
 
     # ------------------------------------------------------------------
@@ -498,6 +499,36 @@ class TRPOAgent:
         )
         train_state = train_state._replace(env_carry=new_carry)
         return self._process_trajectory(train_state, traj)
+
+    def run_iterations(self, train_state: TrainState, n: int):
+        """``n`` full training iterations as ONE device program.
+
+        ``lax.scan`` over the fused iteration: rollout → GAE → critic fit →
+        natural-gradient update, ``n`` times, with zero host involvement in
+        between — the end point of the design spectrum that starts at the
+        reference's one-``sess.run``-per-env-step loop (SURVEY §3.2).
+        Returns ``(final_state, stats)`` where every stats leaf has a
+        leading ``(n,)`` axis. Device envs only; stop conditions
+        (reward target, NaN abort — ``learn``) cannot fire mid-scan, so use
+        ``learn`` when those matter and this for throughput.
+        """
+        if not self.is_device_env:
+            raise NotImplementedError(
+                "run_iterations fuses rollouts into the device program — "
+                "host-simulator envs must use run_iteration/learn"
+            )
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        fn = self._multi_iter_fns.get(n)
+        if fn is None:
+            def many(state):
+                # _device_iteration already has the (carry, _) scan-body
+                # signature
+                return jax.lax.scan(
+                    self._device_iteration, state, None, length=n
+                )
+            fn = self._multi_iter_fns[n] = jax.jit(many)
+        return fn(train_state)
 
     def run_iteration(self, train_state: TrainState):
         """One training iteration; returns ``(new_state, stats_pytree)``."""
