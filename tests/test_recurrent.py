@@ -221,3 +221,21 @@ def test_host_env_rejects_recurrent():
 def test_tp_mesh_rejects_recurrent():
     with pytest.raises(NotImplementedError):
         _agent(n_envs=8, mesh_shape=(4, 2), mesh_axes=("data", "model"))
+
+
+def test_recurrent_fvp_subsample():
+    """Env-axis curvature subsampling composes with the GRU replay."""
+    policy = _policy()
+    params = policy.init(jax.random.key(0))
+    seq = _window(jax.random.key(1), policy)
+    dist = policy.apply(params, seq)
+    actions = policy.dist.sample(jax.random.key(2), dist)
+    w = jnp.ones((T, N), jnp.float32)
+    adv = standardize_advantages(
+        jax.random.normal(jax.random.key(3), (T, N)), w
+    )
+    batch = TRPOBatch(seq, actions, adv, jax.lax.stop_gradient(dist), w)
+    cfg = TRPOConfig(cg_iters=5, fvp_subsample=0.5)
+    new_params, stats = jax.jit(make_trpo_update(policy, cfg))(params, batch)
+    assert float(stats.surrogate_after) <= float(stats.surrogate_before)
+    assert np.isfinite(float(stats.kl))

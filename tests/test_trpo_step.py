@@ -117,3 +117,72 @@ def test_zero_advantage_makes_tiny_step():
     # negligibly and nothing is NaN.
     assert np.isfinite(float(stats.kl))
     assert float(stats.grad_norm) < 1e-5
+
+
+def test_fvp_subsample_solves_close_to_full():
+    """Subsampled-curvature update: same direction (high cosine step),
+    trust region respected, and fraction=1.0 ≡ None exactly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.models import BoxSpec, make_policy
+    from trpo_tpu.trpo import TRPOBatch, make_trpo_update, standardize_advantages
+
+    policy = make_policy((6,), BoxSpec(2), hidden=(32,))
+    params = policy.init(jax.random.key(0))
+    obs = jax.random.normal(jax.random.key(1), (2048, 6), jnp.float32)
+    dist = policy.apply(params, obs)
+    actions = policy.dist.sample(jax.random.key(2), dist)
+    w = jnp.ones(2048)
+    adv = standardize_advantages(
+        jax.random.normal(jax.random.key(3), (2048,)), w
+    )
+    batch = TRPOBatch(obs, actions, adv, jax.lax.stop_gradient(dist), w)
+
+    def step_delta(cfg):
+        new_params, stats = jax.jit(make_trpo_update(policy, cfg))(
+            params, batch
+        )
+        d = jax.flatten_util.ravel_pytree(new_params)[0] - \
+            jax.flatten_util.ravel_pytree(params)[0]
+        return np.asarray(d), stats
+
+    d_full, s_full = step_delta(TRPOConfig())
+    d_one, _ = step_delta(TRPOConfig(fvp_subsample=1.0))
+    np.testing.assert_array_equal(d_full, d_one)
+
+    d_sub, s_sub = step_delta(TRPOConfig(fvp_subsample=0.2))
+    cos = d_full @ d_sub / (
+        np.linalg.norm(d_full) * np.linalg.norm(d_sub) + 1e-12
+    )
+    assert cos > 0.9, f"subsampled step diverged: cosine {cos}"
+    assert float(s_sub.kl) <= 2.0 * 0.01 + 1e-6
+    assert float(s_sub.surrogate_after) <= float(s_sub.surrogate_before)
+
+
+def test_fvp_subsample_validates_fraction():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.models import DiscreteSpec, make_policy
+    from trpo_tpu.trpo import TRPOBatch, make_trpo_update
+
+    policy = make_policy((3,), DiscreteSpec(2), hidden=(8,))
+    params = policy.init(jax.random.key(0))
+    obs = jnp.zeros((16, 3))
+    dist = policy.apply(params, obs)
+    batch = TRPOBatch(
+        obs, jnp.zeros(16, jnp.int32), jnp.zeros(16),
+        jax.lax.stop_gradient(dist), jnp.ones(16),
+    )
+    for bad in (-0.5, 0.0, 5.0):
+        with pytest.raises(ValueError):
+            make_trpo_update(policy, TRPOConfig(fvp_subsample=bad))(
+                params, batch
+            )
+    # an in-range fraction just under 1 must actually subsample (ceil
+    # stride), never silently run full-batch
+    from trpo_tpu.trpo import _fvp_batch
+    assert _fvp_batch(batch, 0.75).weight.shape[0] == 8

@@ -43,6 +43,12 @@ class TRPOConfig:
     linesearch_accept_ratio: float = 0.1  # ref utils.py:170
     kl_rollback_factor: float = 2.0  # revert params if KL > factor·max_kl
     #                                  (ref trpo_inksci.py:157-158)
+    fvp_subsample: Optional[float] = None  # Fisher-vector products on this
+    #                                fraction of the batch (every k-th
+    #                                sample); grad/linesearch/rollback stay
+    #                                full-batch. The curvature estimate
+    #                                tolerates sampling noise — the classic
+    #                                TRPO large-batch throughput lever.
 
     # --- networks --------------------------------------------------------
     policy_hidden: Tuple[int, ...] = (64,)   # ref: one 64-tanh layer (trpo_inksci.py:39)

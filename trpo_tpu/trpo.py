@@ -28,6 +28,7 @@ concatenated paths.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
@@ -104,6 +105,42 @@ def surrogate_loss(policy: Policy, params, batch: TRPOBatch) -> jax.Array:
     return -_wmean(ratio * batch.advantages, batch.weight)
 
 
+def _fvp_batch(batch: TRPOBatch, fraction) -> TRPOBatch:
+    """Strided subsample of the batch for Fisher-vector products.
+
+    The classic TRPO throughput lever: the curvature estimate tolerates far
+    more sampling noise than the gradient, so the FVP — evaluated
+    ``cg_iters``+1 times per update, the dominant cost — can run on every
+    k-th sample while gradient/line-search/rollback stay full-batch.
+    Static stride → static shapes under jit. Feedforward batches stride the
+    flat axis; recurrent ones stride the ENV axis (striding time would
+    break the GRU replay).
+    """
+    if fraction is None:
+        return batch
+    if not 0.0 < fraction <= 1.0:
+        raise ValueError(f"fvp_subsample must be in (0, 1], got {fraction}")
+    if fraction == 1.0:
+        return batch
+    # ceil: a valid fraction < 1 always subsamples (effective fraction
+    # 1/stride ≤ requested — never a silent full-batch no-op).
+    stride = max(int(math.ceil(1.0 / fraction)), 2)
+    from trpo_tpu.models.recurrent import SeqObs
+
+    if isinstance(batch.obs, SeqObs):
+        # stride the ENV axis; SeqObs.h0 is (N, H), the rest (T, N, ...)
+        sub = lambda x: x[:, ::stride]
+        obs = SeqObs(
+            obs=sub(batch.obs.obs),
+            reset=sub(batch.obs.reset),
+            h0=batch.obs.h0[::stride],
+        )
+        return jax.tree_util.tree_map(sub, batch._replace(obs=None))._replace(
+            obs=obs
+        )
+    return jax.tree_util.tree_map(lambda x: x[::stride], batch)
+
+
 def _natural_gradient_update(
     policy: Policy, cfg: TRPOConfig, to_params: Callable[[Any], Any],
     x0: Any, batch: TRPOBatch,
@@ -127,14 +164,16 @@ def _natural_gradient_update(
         )
 
     # Fisher metric at the current params: KL(stop_grad(π_θ) ‖ π_x)
-    # — the reference's `kl_firstfixed` (trpo_inksci.py:56).
+    # — the reference's `kl_firstfixed` (trpo_inksci.py:56) — evaluated on
+    # the (optionally subsampled, see _fvp_batch) curvature batch.
+    fb = _fvp_batch(batch, cfg.fvp_subsample)
     cur_dist = jax.lax.stop_gradient(
-        policy.apply(to_params(x0), batch.obs)
+        policy.apply(to_params(x0), fb.obs)
     )
 
     def kl_fixed_fn(x):
-        dist_params = policy.apply(to_params(x), batch.obs)
-        return _wmean(policy.dist.kl(cur_dist, dist_params), batch.weight)
+        dist_params = policy.apply(to_params(x), fb.obs)
+        return _wmean(policy.dist.kl(cur_dist, dist_params), fb.weight)
 
     surr_before = surr_fn(x0)
     g = jax.grad(surr_fn)(x0)
