@@ -210,12 +210,26 @@ def test_recurrent_learns_memory_task():
     assert last_len > first_len * 0.9  # not collapsing; usually improves
 
 
-def test_host_env_rejects_recurrent():
-    with pytest.raises(NotImplementedError):
-        TRPOAgent(
-            "gym:CartPole-v1",
-            TRPOConfig(env="gym:CartPole-v1", policy_gru=8),
-        )
+def test_host_env_recurrent_trains():
+    """GRU policy over a host-simulator env: memory threads through the
+    batched host stepping, persists across windows, and the same (T, N)
+    replay update runs."""
+    agent = TRPOAgent(
+        "gym:CartPole-v1",
+        TRPOConfig(
+            env="gym:CartPole-v1", n_envs=4, batch_timesteps=64,
+            cg_iters=4, vf_train_steps=5, policy_hidden=(16,), policy_gru=8,
+        ),
+    )
+    state = agent.init_state(0)
+    h0 = np.asarray(state.env_carry[0])
+    assert h0.shape == (4, 8)
+    state, stats = agent.run_iteration(state)
+    state, stats = agent.run_iteration(state)
+    assert np.isfinite(float(stats["entropy"]))
+    assert not np.allclose(h0, np.asarray(state.env_carry[0]))
+    mean_ret, n_done = agent.evaluate(state, n_steps=32)
+    assert np.isfinite(mean_ret)
 
 
 def test_tp_mesh_rejects_recurrent():
@@ -239,3 +253,22 @@ def test_recurrent_fvp_subsample():
     new_params, stats = jax.jit(make_trpo_update(policy, cfg))(params, batch)
     assert float(stats.surrogate_after) <= float(stats.surrogate_before)
     assert np.isfinite(float(stats.kl))
+
+
+def test_host_recurrent_eval_resets_memory():
+    """evaluate() hard-resets the shared host envs; the next training
+    iteration must start from zeroed GRU memory, not dead-episode context."""
+    agent = TRPOAgent(
+        "gym:CartPole-v1",
+        TRPOConfig(
+            env="gym:CartPole-v1", n_envs=4, batch_timesteps=32,
+            cg_iters=3, vf_train_steps=3, policy_hidden=(16,), policy_gru=8,
+        ),
+    )
+    state = agent.init_state(0)
+    state, _ = agent.run_iteration(state)
+    agent.evaluate(state, n_steps=8)
+    assert agent._host_env_reset_pending
+    state, stats = agent.run_iteration(state)
+    assert not agent._host_env_reset_pending
+    assert np.isfinite(float(stats["entropy"]))

@@ -85,11 +85,6 @@ class TRPOAgent:
         self.obs_shape = obs_shape
         compute_dtype = jnp.dtype(cfg.compute_dtype)
         if cfg.policy_gru is not None:
-            if not self.is_device_env:
-                raise NotImplementedError(
-                    "policy_gru needs a pure-JAX device env (the hidden "
-                    "state threads through the on-device rollout scan)"
-                )
             from trpo_tpu.models.recurrent import make_recurrent_policy
 
             self.policy = make_recurrent_policy(
@@ -218,11 +213,19 @@ class TRPOAgent:
         seed = self.cfg.seed if seed is None else seed
         key = jax.random.key(seed)
         k_policy, k_vf, k_env, k_run = jax.random.split(key, 4)
-        env_carry = (
-            init_carry(self.env, k_env, self.cfg.n_envs, policy=self.policy)
-            if self.is_device_env
-            else None
-        )
+        if self.is_device_env:
+            env_carry = init_carry(
+                self.env, k_env, self.cfg.n_envs, policy=self.policy
+            )
+        elif self.is_recurrent:
+            # host sims: the env lives outside, but the policy memory is
+            # ours to carry — (h, prev_done), persisted across windows
+            env_carry = (
+                self.policy.initial_state(self.cfg.n_envs),
+                jnp.ones(self.cfg.n_envs, bool),
+            )
+        else:
+            env_carry = None
         if env_carry is not None and self.mesh is not None:
             # Shard every env-carry leaf over its leading (env) axis; the
             # jitted iteration then computes shard-local rollouts and XLA
@@ -535,33 +538,67 @@ class TRPOAgent:
         if self.is_device_env:
             return self._iter_fn(train_state)
         rng = jax.random.fold_in(train_state.rng, int(train_state.iteration))
-        traj = host_rollout(
+        policy_state = None
+        if self.is_recurrent:
+            policy_state = train_state.env_carry
+            if getattr(self, "_host_env_reset_pending", False):
+                # evaluate() hard-reset the shared host envs; stale GRU
+                # memory must not leak into the fresh episodes
+                policy_state = (
+                    self.policy.initial_state(self.cfg.n_envs),
+                    jnp.ones(self.cfg.n_envs, bool),
+                )
+                self._host_env_reset_pending = False
+        out = host_rollout(
             self.env,
             self.policy,
             train_state.policy_params,
             rng,
             self.n_steps,
             act_fn=getattr(self, "_host_act_fn", None) or self._make_host_act(),
+            policy_state=policy_state,
         )
+        if self.is_recurrent:
+            traj, (h, prev_done) = out
+            new_carry = (jnp.asarray(h), jnp.asarray(prev_done))
+            if self.mesh is not None:
+                # keep the placement init_state established (env axis
+                # sharded) — a drifting placement would recompile the
+                # jitted processing and break the checkpoint template
+                from trpo_tpu.parallel import shard_leading_axis
+
+                new_carry = shard_leading_axis(
+                    self.mesh, new_carry, self.cfg.mesh_axes[0], dim=0
+                )
+            train_state = train_state._replace(env_carry=new_carry)
+        else:
+            traj = out
         if self.mesh is not None:
             # Shard the (T, N, ...) trajectory over its env axis — the same
             # layout the device path's sharded rollout produces, so the
             # jitted processing runs data-parallel for host sims too.
+            # (policy_h0 is (N, H): its env axis is dim 0, not 1.)
             from trpo_tpu.parallel import shard_leading_axis
 
+            h0 = traj.policy_h0
             traj = shard_leading_axis(
-                self.mesh, traj, self.cfg.mesh_axes[0], dim=1
+                self.mesh,
+                traj._replace(policy_h0=None),
+                self.cfg.mesh_axes[0],
+                dim=1,
             )
+            if h0 is not None:
+                traj = traj._replace(
+                    policy_h0=shard_leading_axis(
+                        self.mesh, h0, self.cfg.mesh_axes[0], dim=0
+                    )
+                )
         return self._process_fn(train_state, traj)
 
     def _make_host_act(self):
-        policy = self.policy
+        from trpo_tpu.rollout import make_host_act_fn
 
-        def act(params, obs, key):
-            dist = policy.apply(params, obs)
-            return policy.dist.sample(key, dist), dist
-
-        self._host_act_fn = jax.jit(act)
+        self._host_act_fn = make_host_act_fn(self.policy)
         return self._host_act_fn
 
     # ------------------------------------------------------------------
@@ -604,15 +641,26 @@ class TRPOAgent:
             _, traj = fn(train_state.policy_params, carry, k_roll)
         else:
             self.env.reset_all(seed=seed)
-            if self._host_eval_act_fn is None:
-                # reuse the already-jitted act path (argmax/mode branch)
-                self._host_eval_act_fn = lambda p, o, k: self._act_fn(
-                    p, o, k, True
-                )[:2]
-            traj = host_rollout(
-                self.env, self.policy, train_state.policy_params, k_roll,
-                n_steps, act_fn=self._host_eval_act_fn,
-            )
+            if self.is_recurrent:
+                # fresh memory, greedy actions; host_rollout builds and
+                # caches nothing here — eval is rare. The hard resets make
+                # any carried training memory stale: flag it so the next
+                # run_iteration starts from zeroed hidden state.
+                self._host_env_reset_pending = True
+                traj, _ = host_rollout(
+                    self.env, self.policy, train_state.policy_params,
+                    k_roll, n_steps, deterministic=True,
+                )
+            else:
+                if self._host_eval_act_fn is None:
+                    # reuse the already-jitted act path (argmax/mode branch)
+                    self._host_eval_act_fn = lambda p, o, k: self._act_fn(
+                        p, o, k, True
+                    )[:2]
+                traj = host_rollout(
+                    self.env, self.policy, train_state.policy_params, k_roll,
+                    n_steps, act_fn=self._host_eval_act_fn,
+                )
             self.env.reset_all()
         done = np.asarray(traj.done)
         rets = np.asarray(traj.episode_return)

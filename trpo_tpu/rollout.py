@@ -25,7 +25,13 @@ import numpy as np
 
 from trpo_tpu.models.policy import Policy
 
-__all__ = ["Trajectory", "device_rollout", "init_env_states", "host_rollout"]
+__all__ = [
+    "Trajectory",
+    "device_rollout",
+    "init_env_states",
+    "host_rollout",
+    "make_host_act_fn",
+]
 
 
 class Trajectory(NamedTuple):
@@ -193,6 +199,35 @@ def init_carry(env, key, n_envs: int, policy=None):
 # ---------------------------------------------------------------------------
 
 
+def make_host_act_fn(policy: Policy, deterministic: bool = False):
+    """The ONE builder for host-loop policy inference (used by
+    :func:`host_rollout`'s default and cached by the agent): jitted
+    ``(params, obs, key) -> (actions, dist)`` — recurrent policies take a
+    trailing ``h`` and return a trailing ``h'``."""
+    if hasattr(policy, "step"):
+        def act_rec(params, obs, key, h):
+            h_new, dist = policy.step(params, h, obs)
+            action = (
+                policy.dist.mode(dist)
+                if deterministic
+                else policy.dist.sample(key, dist)
+            )
+            return action, dist, h_new
+
+        return jax.jit(act_rec)
+
+    def act(params, obs, key):
+        dist = policy.apply(params, obs)
+        action = (
+            policy.dist.mode(dist)
+            if deterministic
+            else policy.dist.sample(key, dist)
+        )
+        return action, dist
+
+    return jax.jit(act)
+
+
 def host_rollout(
     vec_env,
     policy: Policy,
@@ -200,48 +235,64 @@ def host_rollout(
     key,
     n_steps: int,
     act_fn=None,
-) -> Trajectory:
+    policy_state=None,
+    deterministic: bool = False,
+):
     """Collect a ``(T, N)`` trajectory from a host vectorized env.
 
     ``vec_env`` is a :class:`trpo_tpu.envs.gym_adapter.GymVecEnv`. Policy
     inference is jitted and batched over the N envs (``act_fn`` may be a
-    pre-jitted ``(params, obs, key) -> (actions, dist)`` to reuse across
-    calls). The env boundary is the only host↔device traffic: one transfer
-    per timestep for all envs, vs the reference's per-env-step ``sess.run``
-    (``trpo_inksci.py:78``).
+    pre-jitted callable to reuse across calls: feedforward
+    ``(params, obs, key) -> (actions, dist)``; recurrent
+    ``(params, obs, key, h) -> (actions, dist, h')``). The env boundary is
+    the only host↔device traffic: one transfer per timestep for all envs,
+    vs the reference's per-env-step ``sess.run`` (``trpo_inksci.py:78``).
+
+    Recurrent policies: ``policy_state`` is ``(h, prev_done)`` from the
+    previous window (``None`` → fresh zeros), the hidden state is zeroed at
+    episode boundaries exactly like the device path, and the return value
+    becomes ``(Trajectory, new_policy_state)`` — the trajectory carries
+    ``reset``/``policy_h0``/``policy_h``/``policy_h_next`` for the
+    training-time replay.
     """
-    if hasattr(policy, "step"):
-        raise NotImplementedError(
-            "recurrent policies currently require a pure-JAX device env "
-            "(the hidden state threads through the on-device rollout scan); "
-            "host-simulator support would need per-step hidden-state "
-            "round-trips — use a device env or a feedforward policy"
-        )
+    recurrent = hasattr(policy, "step")
     if act_fn is None:
-        act_fn = jax.jit(
-            lambda p, o, k: (
-                lambda d: (policy.dist.sample(k, d), d)
-            )(policy.apply(p, o))
-        )
+        act_fn = make_host_act_fn(policy, deterministic=deterministic)
 
     obs = vec_env.current_obs()
     T, N = n_steps, vec_env.n_envs
+    if recurrent:
+        if policy_state is None:
+            policy_state = (
+                policy.initial_state(N),
+                np.ones(N, bool),
+            )
+        h, prev_done = policy_state
+        h0_window = jnp.asarray(h)
     obs_buf, act_buf, rew_buf = [], [], []
     term_buf, done_buf, dist_buf, next_obs_buf = [], [], [], []
     ret_buf, len_buf = [], []
+    reset_buf, h_pre_buf, h_post_buf = [], [], []
 
     for t in range(T):
         key, k_act = jax.random.split(key)
-        actions, dist = act_fn(params, jnp.asarray(obs), k_act)
+        if recurrent:
+            actions, dist, h_new = act_fn(params, jnp.asarray(obs), k_act, h)
+            reset_buf.append(np.asarray(prev_done).copy())
+            h_pre_buf.append(np.asarray(h))
+            h_post_buf.append(np.asarray(h_new))
+        else:
+            actions, dist = act_fn(params, jnp.asarray(obs), k_act)
         actions_np = np.asarray(actions)
         next_obs, rewards, terminated, truncated, final_obs = vec_env.host_step(
             actions_np
         )
+        done = np.logical_or(terminated, truncated)
         obs_buf.append(np.asarray(obs))
         act_buf.append(actions_np)
         rew_buf.append(rewards)
         term_buf.append(terminated)
-        done_buf.append(np.logical_or(terminated, truncated))
+        done_buf.append(done)
         dist_buf.append(jax.tree_util.tree_map(np.asarray, dist))
         # next_obs pre-reset: where an episode ended, the true successor
         # state is final_obs (gymnasium autoresets under us).
@@ -249,9 +300,13 @@ def host_rollout(
         ret_buf.append(vec_env.last_episode_returns.copy())
         len_buf.append(vec_env.last_episode_lengths.copy())
         obs = next_obs
+        if recurrent:
+            # zero memory at episode boundaries (device-path parity)
+            h = jnp.where(jnp.asarray(done)[:, None], 0.0, h_new)
+            prev_done = done
 
     stack = lambda xs: jnp.asarray(np.stack(xs))
-    return Trajectory(
+    traj = Trajectory(
         obs=stack(obs_buf),
         actions=stack(act_buf),
         rewards=stack(rew_buf).astype(jnp.float32),
@@ -264,3 +319,12 @@ def host_rollout(
         episode_return=stack(ret_buf).astype(jnp.float32),
         episode_length=stack(len_buf),
     )
+    if not recurrent:
+        return traj
+    traj = traj._replace(
+        reset=stack(reset_buf),
+        policy_h0=h0_window,
+        policy_h=stack(h_pre_buf),
+        policy_h_next=stack(h_post_buf),
+    )
+    return traj, (h, prev_done)
