@@ -58,3 +58,56 @@ def test_rejects_bad_inputs():
     )
     with pytest.raises(NotImplementedError):
         host.run_iterations(None, 2)
+
+
+def test_learn_fused_chunks_match_unfused():
+    """learn(fuse_iterations=k) logs every iteration and reaches the same
+    params as unfused learn."""
+    from trpo_tpu.utils.metrics import StatsLogger
+
+    logged = []
+
+    class Capture(StatsLogger):
+        def log(self, iteration, stats):
+            logged.append((iteration, dict(stats)))
+
+    a1 = _agent()
+    s1 = a1.learn(n_iterations=4, state=a1.init_state(0), logger=Capture())
+    assert [i for i, _ in logged] == [1, 2, 3, 4]
+
+    logged2 = []
+
+    class Capture2(StatsLogger):
+        def log(self, iteration, stats):
+            logged2.append((iteration, dict(stats)))
+
+    a2 = _agent(fuse_iterations=3)
+    s2 = a2.learn(n_iterations=4, state=a2.init_state(0), logger=Capture2())
+    assert [i for i, _ in logged2] == [1, 2, 3, 4]  # chunk 3 then chunk 1
+    assert int(s2.iteration) == 4
+
+    f1 = jax.flatten_util.ravel_pytree(s1.policy_params)[0]
+    f2 = jax.flatten_util.ravel_pytree(s2.policy_params)[0]
+    np.testing.assert_allclose(
+        np.asarray(f1), np.asarray(f2), rtol=1e-4, atol=1e-6
+    )
+    # per-iteration stats identical between the two paths
+    np.testing.assert_allclose(
+        logged[2][1]["entropy"], logged2[2][1]["entropy"], rtol=1e-5
+    )
+
+
+def test_learn_fused_stop_and_checkpoint(tmp_path):
+    """Reward-target stop fires from inside a chunk; checkpoints land on
+    crossed boundaries."""
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    agent = _agent(fuse_iterations=2, reward_target=5.0,
+                   checkpoint_every=2)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    state = agent.learn(
+        n_iterations=10, state=agent.init_state(0), checkpointer=ck
+    )
+    # CartPole rewards exceed 5 immediately -> stops at the first chunk
+    assert int(state.iteration) == 2
+    assert ck.latest_step() == 2

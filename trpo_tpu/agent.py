@@ -703,44 +703,78 @@ class TRPOAgent:
         # with use_jax_profiler, phases appear as named TraceAnnotations in
         # jax.profiler traces (the CLI's --profile-dir wires this through)
         timer = PhaseTimer(use_jax_profiler=use_jax_profiler)
+        # fused chunks: one device program (and ONE host sync) per `chunk`
+        # iterations — the sync is ~100ms RTT on a tunneled TPU, which
+        # would otherwise dominate a ~10ms update. Host envs roll out on
+        # the host each iteration, so there is nothing to fuse.
+        chunk = max(1, cfg.fuse_iterations) if self.is_device_env else 1
+        steps_per_iter = self.n_steps * cfg.n_envs
+
+        def _stop(host_stats) -> bool:
+            ent = host_stats["entropy"]
+            if ent != ent:  # NaN check (ref trpo_inksci.py:172-173)
+                raise FloatingPointError(
+                    "policy entropy is NaN — aborting training"
+                )
+            if (
+                cfg.reward_target is not None
+                and host_stats["episodes_in_batch"] > 0
+                and host_stats["mean_episode_reward"] >= cfg.reward_target
+            ):
+                return True
+            return (
+                cfg.stop_on_explained_variance is not None
+                and host_stats["vf_explained_variance"]
+                > cfg.stop_on_explained_variance
+            )
 
         try:
-            for _ in range(n_iterations):
+            done = 0
+            while done < n_iterations:
+                k = min(chunk, n_iterations - done)
                 with timer.phase("iteration"):
-                    state, stats = self.run_iteration(state)
-                    jax.block_until_ready(stats)
-                host_stats = {
-                    k: (v.item() if hasattr(v, "item") else v)
-                    for k, v in stats.items()
-                }
-                host_stats["time_elapsed_min"] = logger.elapsed_minutes()
-                host_stats["iteration_ms"] = timer.last_ms("iteration")
-                host_stats["timesteps_total"] = int(state.total_timesteps)
-                logger.log(int(state.iteration), host_stats)
-
-                if checkpointer is not None and (
-                    int(state.iteration) % cfg.checkpoint_every == 0
-                ):
-                    checkpointer.save(int(state.iteration), state)
+                    if k == 1:
+                        state, stats = self.run_iteration(state)
+                        # ONE bulk transfer: per-leaf .item()/asarray would
+                        # pay the host↔device round trip per stat
+                        stack = {
+                            key: v[None]
+                            for key, v in jax.device_get(stats).items()
+                        }
+                    else:
+                        state, stats = self.run_iterations(state, k)
+                        stack = jax.device_get(stats)
+                done += k
+                it_end = int(state.iteration)
+                per_iter_ms = timer.last_ms("iteration") / k
+                ts_end = int(state.total_timesteps)
+                stop = False
+                host_stats = None
+                for j in range(k):
+                    host_stats = {
+                        key: stack[key][j].item() for key in stack
+                    }
+                    host_stats["time_elapsed_min"] = logger.elapsed_minutes()
+                    host_stats["iteration_ms"] = per_iter_ms
+                    host_stats["timesteps_total"] = (
+                        ts_end - (k - 1 - j) * steps_per_iter
+                    )
+                    logger.log(it_end - k + 1 + j, host_stats)
+                    # stop conditions are evaluated per iteration, but the
+                    # returned state is end-of-chunk — with fuse_iterations
+                    # > 1, training may overshoot the trigger by < chunk.
+                    stop = stop or _stop(host_stats)
                 if callback is not None:
+                    # once per chunk, with MATCHED (state, stats): the
+                    # end-of-chunk state and its own iteration's stats
                     callback(state, host_stats)
 
-                ent = host_stats["entropy"]
-                if ent != ent:  # NaN check (ref trpo_inksci.py:172-173)
-                    raise FloatingPointError(
-                        "policy entropy is NaN — aborting training"
-                    )
-                if (
-                    cfg.reward_target is not None
-                    and host_stats["episodes_in_batch"] > 0
-                    and host_stats["mean_episode_reward"] >= cfg.reward_target
+                if checkpointer is not None and (
+                    it_end // cfg.checkpoint_every
+                    > (it_end - k) // cfg.checkpoint_every
                 ):
-                    break
-                if (
-                    cfg.stop_on_explained_variance is not None
-                    and host_stats["vf_explained_variance"]
-                    > cfg.stop_on_explained_variance
-                ):
+                    checkpointer.save(it_end, state)
+                if stop:
                     break
         finally:
             if own_logger:

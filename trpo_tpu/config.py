@@ -69,6 +69,12 @@ class TRPOConfig:
     # --- run control -----------------------------------------------------
     seed: int = 1                  # ref utils.py:7 (was an import side effect)
     n_iterations: int = 1000
+    fuse_iterations: int = 1       # learn() runs this many iterations per
+    #                                device program (agent.run_iterations) —
+    #                                one host sync per chunk instead of per
+    #                                iteration (the sync costs ~100ms RTT on
+    #                                a tunneled TPU). Device envs only; stop
+    #                                conditions fire at chunk granularity.
     reward_target: Optional[float] = None  # generalizes the ref's hard-coded
     #                                        `mean reward > 1.1*500` stop
     #                                        (trpo_inksci.py:135)

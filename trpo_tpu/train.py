@@ -51,6 +51,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gamma", type=float)
     p.add_argument("--lam", type=float)
     p.add_argument("--reward-target", type=float)
+    p.add_argument(
+        "--fuse-iterations",
+        type=_positive_int,
+        help="iterations per fused device program (one host sync per "
+        "chunk; device envs only)",
+    )
     p.add_argument("--log-jsonl", help="append per-iteration stats here")
     p.add_argument("--checkpoint-dir")
     p.add_argument("--checkpoint-every", type=int)
@@ -96,6 +102,7 @@ _OVERRIDES = {
     "gamma": "gamma",
     "lam": "lam",
     "reward_target": "reward_target",
+    "fuse_iterations": "fuse_iterations",
     "log_jsonl": "log_jsonl",
     "checkpoint_dir": "checkpoint_dir",
     "checkpoint_every": "checkpoint_every",
