@@ -54,9 +54,10 @@ class TRPOConfig:
     policy_hidden: Tuple[int, ...] = (64,)   # ref: one 64-tanh layer (trpo_inksci.py:39)
     policy_activation: str = "tanh"
     policy_gru: Optional[int] = None  # GRU hidden size → recurrent policy
-    #                                (models/recurrent.py; POMDPs). Device
-    #                                envs only; no reference analogue (its
-    #                                prev_action buffer was vestigial,
+    #                                (models/recurrent.py; POMDPs), over
+    #                                device AND host-simulator envs. No
+    #                                reference analogue (its prev_action
+    #                                buffer was vestigial,
     #                                trpo_inksci.py:31,85-86)
     vf_hidden: Tuple[int, ...] = (64, 64)    # ref critic: 64-relu × 2 (utils.py:59-61)
     vf_activation: str = "relu"
