@@ -136,3 +136,28 @@ def test_max_to_keep_prunes(tmp_path):
         assert steps == [2, 3]
     finally:
         ckpt.close()
+
+
+def test_checkpoint_restores_recurrent_state(tmp_path):
+    """TrainState with GRU memory in the carry (device env: scan carry;
+    host env: (h, prev_done)) round-trips through Orbax and training
+    continues identically."""
+    agent = TRPOAgent(
+        "cartpole-po",
+        TRPOConfig(env="cartpole-po", n_envs=4, batch_timesteps=64,
+                   cg_iters=3, vf_train_steps=3, policy_hidden=(16,),
+                   policy_gru=8),
+    )
+    state = agent.init_state(0)
+    state, _ = agent.run_iteration(state)
+    ck = Checkpointer(str(tmp_path / "rec"))
+    try:
+        ck.save(1, state)
+        restored = ck.restore(agent.init_state(0))
+    finally:
+        ck.close()
+    _assert_tree_equal(state, restored)
+
+    s1, stats1 = agent.run_iteration(state)
+    s2, stats2 = agent.run_iteration(restored)
+    _assert_tree_equal(s1, s2)
