@@ -152,13 +152,6 @@ class TRPOAgent:
                     f"{cfg.mesh_axes[0]}={dp} mesh axis"
                 )
             if "model" in cfg.mesh_axes[1:]:
-                if cfg.policy_gru is not None:
-                    raise NotImplementedError(
-                        "tensor parallelism over a GRU policy is not wired "
-                        "up (parallel/tp.py shards MLP layer layouts); use "
-                        'a "data" (and optionally "seq") mesh with '
-                        "policy_gru"
-                    )
                 # Tensor parallelism: policy params sharded Megatron-style
                 # over "model" (parallel/tp.py), and the update switched to
                 # the pytree-domain solve so the sharding persists through
@@ -247,11 +240,13 @@ class TRPOAgent:
                 for leaf in jax.tree_util.tree_leaves(policy_params)
             ):
                 mp = self.mesh.shape[self._tp_axis]
+                dims = f"hidden={tuple(self.cfg.policy_hidden)}"
+                if self.is_recurrent:
+                    dims += f", gru_size={self.cfg.policy_gru}"
                 raise ValueError(
                     f"tensor parallelism over {self._tp_axis}={mp} shards "
-                    f"nothing: no policy layer dimension (hidden="
-                    f"{tuple(self.cfg.policy_hidden)}) divides the axis — "
-                    "resize the hidden layers or the mesh"
+                    f"nothing: no policy layer dimension ({dims}) divides "
+                    "the axis — resize the layers or the mesh"
                 )
         state = TrainState(
             policy_params=policy_params,

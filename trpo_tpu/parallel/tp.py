@@ -47,9 +47,10 @@ def _layer_spec(layer_idx: int, name: str, leaf, axis: str, axis_size: int):
 def policy_param_shardings(
     params: Any, mesh: Mesh, model_axis: str = "model"
 ) -> Any:
-    """A pytree of ``NamedSharding``s (same structure as ``params``)
-    implementing the alternating col/row split for every ``{"layers": [...]}``
-    MLP stack in the policy pytree; everything else replicated."""
+    """A pytree of ``NamedSharding``s (same structure as ``params``):
+    alternating col/row split for every ``{"layers": [...]}`` MLP stack,
+    row-parallel input splits for ``{"gru": ...}`` gate projections
+    (see the inline comment), everything else replicated."""
     axis_size = mesh.shape[model_axis]
     DictKey = jax.tree_util.DictKey
     SequenceKey = jax.tree_util.SequenceKey
@@ -70,6 +71,27 @@ def policy_param_shardings(
                     model_axis,
                     axis_size,
                 )
+            if (
+                isinstance(k, DictKey)
+                and k.key == "gru"
+                and j + 1 < len(path)
+                and isinstance(path[j + 1], DictKey)
+            ):
+                # GRU (models/recurrent.py): both gate projections split
+                # ROW-parallel on their input dim — xw/hw partial sums
+                # reduce across the mesh (one all-reduce each per step) and
+                # the hidden state h stays replicated, which the recurrence
+                # needs anyway. The fused (·, 3H) output axis is NOT sharded
+                # (gate-block slicing at H boundaries would misalign with
+                # shard boundaries); bias is replicated, added post-reduce.
+                name = path[j + 1].key
+                if (
+                    name in ("wx", "wh")
+                    and leaf.ndim == 2
+                    and leaf.shape[0] % axis_size == 0
+                ):
+                    return P(model_axis, None)
+                return P()
         return P()
 
     return jax.tree_util.tree_map_with_path(
