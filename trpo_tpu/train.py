@@ -57,6 +57,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="iterations per fused device program (one host sync per "
         "chunk; device envs only)",
     )
+    p.add_argument(
+        "--fvp-subsample",
+        type=float,
+        help="curvature (Fisher-vector-product) batch fraction in (0, 1] — "
+        "every k-th sample; gradient/line search stay full-batch",
+    )
     p.add_argument("--log-jsonl", help="append per-iteration stats here")
     p.add_argument("--checkpoint-dir")
     p.add_argument("--checkpoint-every", type=int)
@@ -103,6 +109,7 @@ _OVERRIDES = {
     "lam": "lam",
     "reward_target": "reward_target",
     "fuse_iterations": "fuse_iterations",
+    "fvp_subsample": "fvp_subsample",
     "log_jsonl": "log_jsonl",
     "checkpoint_dir": "checkpoint_dir",
     "checkpoint_every": "checkpoint_every",
