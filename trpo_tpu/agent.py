@@ -50,11 +50,14 @@ class TrainState(NamedTuple):
     """Everything that evolves across iterations — the checkpointable unit."""
     policy_params: Any
     vf_state: VFState
-    env_carry: Any            # device envs only; None for host envs
+    env_carry: Any            # device envs only; recurrent-host policy
+    #                           memory; None otherwise
     rng: jax.Array
     iteration: jax.Array      # int32 scalar
     total_episodes: jax.Array  # int32 scalar (ref "Total number of episodes")
     total_timesteps: jax.Array
+    obs_norm: Any = None      # utils/normalize.RunningStats when
+    #                           cfg.normalize_obs, else None
 
 
 class TRPOAgent:
@@ -106,6 +109,12 @@ class TRPOAgent:
                 compute_dtype=compute_dtype,
             )
         self.is_recurrent = cfg.policy_gru is not None
+        if cfg.normalize_obs and not self.is_device_env:
+            raise NotImplementedError(
+                "normalize_obs currently requires a pure-JAX device env "
+                "(the statistics thread through the fused iteration); "
+                "normalize observations in a host-env wrapper instead"
+            )
         obs_dim = int(math.prod(obs_shape))
         if self.is_recurrent:
             # POMDP critic: condition the value on the policy's GRU state
@@ -248,10 +257,16 @@ class TRPOAgent:
                     f"nothing: no policy layer dimension ({dims}) divides "
                     "the axis — resize the layers or the mesh"
                 )
+        obs_norm = None
+        if self.cfg.normalize_obs:
+            from trpo_tpu.utils.normalize import init_stats
+
+            obs_norm = init_stats(self.obs_shape)
         state = TrainState(
             policy_params=policy_params,
             vf_state=self.vf.init(k_vf),
             env_carry=env_carry,
+            obs_norm=obs_norm,
             rng=k_run,
             iteration=jnp.asarray(0, jnp.int32),
             total_episodes=jnp.asarray(0, jnp.int32),
@@ -284,7 +299,11 @@ class TRPOAgent:
     # act (ref trpo_inksci.py:76-87)
     # ------------------------------------------------------------------
 
-    def _act(self, params, obs, key, eval_mode: bool, h=None):
+    def _act(self, params, obs, key, eval_mode: bool, h=None, obs_norm=None):
+        if obs_norm is not None:  # traced input: fused into the jitted act
+            from trpo_tpu.utils.normalize import normalize
+
+            obs = normalize(obs_norm, obs)
         squeeze = obs.ndim == len(self.obs_shape)
         if squeeze:
             obs = obs[None]
@@ -332,14 +351,41 @@ class TRPOAgent:
                 if obs.ndim == len(self.obs_shape):
                     policy_carry = policy_carry[0]
             return self._act_fn(
-                state.policy_params, obs, key, eval_mode, policy_carry
+                state.policy_params, obs, key, eval_mode, policy_carry,
+                state.obs_norm,
             )
-        action, dist, _ = self._act_fn(state.policy_params, obs, key, eval_mode)
+        action, dist, _ = self._act_fn(
+            state.policy_params, obs, key, eval_mode, None, state.obs_norm
+        )
         return action, dist
 
     # ------------------------------------------------------------------
     # the fused iteration
     # ------------------------------------------------------------------
+
+    def _normed_policy(self, stats):
+        """The policy with ``stats``-normalization fused in front (identity
+        when stats is None). Built inside a trace so the (dynamic) stats
+        stay a traced input, while the underlying policy stays static."""
+        if stats is None:
+            return self.policy
+        from trpo_tpu.utils.normalize import normalize
+
+        pol = self.policy
+        if self.is_recurrent:
+            # The rollout only calls .step, and the training replay
+            # normalizes trajectory DATA against the raw policy instead —
+            # but the wrapped object must stay self-consistent (“a policy
+            # over raw observations”), so .apply is wrapped too: a caller
+            # getting a step that normalizes and an apply that doesn't
+            # would be a silent-wrong-numbers trap.
+            return pol._replace(
+                step=lambda p, h, o: pol.step(p, h, normalize(stats, o)),
+                apply=lambda p, seq: pol.apply(
+                    p, seq._replace(obs=normalize(stats, seq.obs))
+                ),
+            )
+        return pol._replace(apply=lambda p, o: pol.apply(p, normalize(stats, o)))
 
     def _vf_features(self, traj: Trajectory):
         """Critic inputs ``(current, next)``, flattened to ``(T·N, F)``.
@@ -392,6 +438,20 @@ class TRPOAgent:
         cfg = self.cfg
         T, N = traj.rewards.shape
         flat = lambda x: x.reshape((T * N,) + x.shape[2:])
+
+        new_obs_norm = train_state.obs_norm
+        if train_state.obs_norm is not None:
+            # Normalize with the stats the ROLLOUT used (start-of-iteration)
+            # so the replayed distributions match old_dist exactly; fold the
+            # raw observations in afterwards for the next iteration.
+            from trpo_tpu.utils.normalize import normalize, update_stats
+
+            stats = train_state.obs_norm
+            new_obs_norm = update_stats(stats, flat(traj.obs))
+            traj = traj._replace(
+                obs=normalize(stats, traj.obs),
+                next_obs=normalize(stats, traj.next_obs),
+            )
 
         adv, vtarg, values = self._advantages(train_state.vf_state, traj)
         weight = jnp.ones(T * N, jnp.float32)
@@ -477,6 +537,7 @@ class TRPOAgent:
         new_state = train_state._replace(
             policy_params=new_policy_params,
             vf_state=new_vf_state,
+            obs_norm=new_obs_norm,
             iteration=train_state.iteration + 1,
             total_episodes=stats["total_episodes"],
             total_timesteps=train_state.total_timesteps + T * N,
@@ -489,7 +550,7 @@ class TRPOAgent:
         train_state = train_state._replace(rng=rng)
         new_carry, traj = device_rollout(
             self.env,
-            self.policy,
+            self._normed_policy(train_state.obs_norm),
             train_state.policy_params,
             train_state.env_carry,
             k_roll,
@@ -625,15 +686,20 @@ class TRPOAgent:
         if self.is_device_env:
             fn = self._eval_roll_fns.get(n_steps)
             if fn is None:
-                fn = jax.jit(
-                    partial(device_rollout, self.env, self.policy,
-                            deterministic=True, n_steps=n_steps)
-                )
-                self._eval_roll_fns[n_steps] = fn
+                def _eval_roll(params, carry, key, stats):
+                    return device_rollout(
+                        self.env, self._normed_policy(stats), params,
+                        carry, key, n_steps, deterministic=True,
+                    )
+
+                fn = self._eval_roll_fns[n_steps] = jax.jit(_eval_roll)
             carry = init_carry(
                 self.env, k_init, self.cfg.n_envs, policy=self.policy
             )
-            _, traj = fn(train_state.policy_params, carry, k_roll)
+            _, traj = fn(
+                train_state.policy_params, carry, k_roll,
+                train_state.obs_norm,
+            )
         else:
             self.env.reset_all(seed=seed)
             if self.is_recurrent:

@@ -66,6 +66,13 @@ class TRPOConfig:
     init_log_std: float = 0.0      # diagonal-Gaussian head (not in reference —
     #                                required by BASELINE.json MuJoCo configs)
     compute_dtype: str = "float32"  # forward dtype; the CG solve always runs fp32
+    normalize_obs: bool = False    # running obs normalization (Welford,
+    #                                utils/normalize.py) applied to policy
+    #                                and critic inputs; statistics live in
+    #                                TrainState (checkpointed, per-member
+    #                                under population vmap). Device envs
+    #                                only. Absent from the reference;
+    #                                standard for MuJoCo-scale TRPO
 
     # --- run control -----------------------------------------------------
     seed: int = 1                  # ref utils.py:7 (was an import side effect)

@@ -80,6 +80,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--debug-nans", action="store_true", help="enable jax NaN checking"
     )
     p.add_argument(
+        "--normalize-obs",
+        action="store_true",
+        help="running observation normalization (device envs)",
+    )
+    p.add_argument(
         "--profile-dir",
         help="write a jax.profiler (TensorBoard/Perfetto) trace of the run "
         "here; phase names from PhaseTimer annotate the timeline",
@@ -114,6 +119,7 @@ _OVERRIDES = {
     "checkpoint_dir": "checkpoint_dir",
     "checkpoint_every": "checkpoint_every",
     "debug_nans": "debug_nans",
+    "normalize_obs": "normalize_obs",
 }
 
 

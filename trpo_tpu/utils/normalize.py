@@ -1,0 +1,68 @@
+"""Running observation normalization (Welford/Chan parallel merge).
+
+Standard equipment for MuJoCo-scale TRPO (obs components span orders of
+magnitude; un-normalized they starve the tanh torso) that the reference
+lacks entirely. Implemented as a pure pytree so it lives inside
+``TrainState`` — jit-traceable, vmap-safe (population training keeps
+per-member statistics), checkpointed with everything else, and mesh-
+friendly: the batch moments are plain global means, which GSPMD lowers to
+``psum`` reductions when the batch axis is sharded.
+
+The agent applies the statistics *as of the start of an iteration* to both
+the rollout and the update replay (so the acting distribution and
+``old_dist`` in the batch are computed from identical inputs), then folds
+the iteration's raw observations into the statistics for the next one.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["RunningStats", "init_stats", "update_stats", "normalize"]
+
+
+class RunningStats(NamedTuple):
+    count: jax.Array   # scalar f32 — total weight folded in so far
+    mean: jax.Array    # (*shape,)
+    m2: jax.Array      # (*shape,) — sum of squared deviations
+
+
+def init_stats(shape: Tuple[int, ...]) -> RunningStats:
+    return RunningStats(
+        count=jnp.asarray(0.0, jnp.float32),
+        mean=jnp.zeros(shape, jnp.float32),
+        m2=jnp.zeros(shape, jnp.float32),
+    )
+
+
+def update_stats(stats: RunningStats, obs: jax.Array) -> RunningStats:
+    """Fold a batch of observations (leading axes = batch) into ``stats``
+    via Chan et al.'s parallel merge — one pass, no host involvement."""
+    feat_ndim = stats.mean.ndim
+    batch_axes = tuple(range(obs.ndim - feat_ndim))
+    obs = jnp.asarray(obs, jnp.float32)
+    n_b = jnp.asarray(
+        jnp.prod(jnp.asarray([obs.shape[a] for a in batch_axes])), jnp.float32
+    )
+    mean_b = jnp.mean(obs, axis=batch_axes)
+    m2_b = jnp.sum((obs - mean_b) ** 2, axis=batch_axes)
+
+    delta = mean_b - stats.mean
+    tot = stats.count + n_b
+    new_mean = stats.mean + delta * (n_b / tot)
+    new_m2 = stats.m2 + m2_b + delta**2 * (stats.count * n_b / tot)
+    return RunningStats(count=tot, mean=new_mean, m2=new_m2)
+
+
+def normalize(
+    stats: RunningStats, obs: jax.Array, clip: float = 10.0
+) -> jax.Array:
+    """``(obs − mean) / std`` with the usual ±clip guard; identity while
+    no data has been folded in (count == 0)."""
+    var = stats.m2 / jnp.maximum(stats.count, 1.0)
+    std = jnp.sqrt(var + 1e-8)
+    out = jnp.clip((obs - stats.mean) / std, -clip, clip)
+    return jnp.where(stats.count > 0.0, out, obs)
