@@ -109,3 +109,39 @@ def test_mesh_multi_iteration_learning_signal():
         state, stats = agent.run_iteration(state)
     assert np.isfinite(stats["entropy"])
     assert bool(stats["linesearch_success"])
+
+
+def test_everything_composed(tmp_path):
+    """Kitchen sink: 2-D data×seq mesh + obs normalization + fused
+    multi-iteration chunks + checkpoint/resume, continuing bit-close."""
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    cfg = TRPOConfig(
+        env="pendulum",
+        n_envs=8,
+        batch_timesteps=64,   # 8 steps/env, divisible by seq=2
+        cg_iters=3,
+        vf_train_steps=3,
+        policy_hidden=(16,),
+        normalize_obs=True,
+        mesh_shape=(4, 2),
+        mesh_axes=("data", "seq"),
+    )
+    agent = TRPOAgent("pendulum", cfg)
+    state, stats = agent.run_iterations(agent.init_state(0), 2)
+    assert np.all(np.isfinite(np.asarray(stats["entropy"])))
+    assert float(state.obs_norm.count) == 128.0
+
+    ck = Checkpointer(str(tmp_path / "ks"))
+    try:
+        ck.save(2, state)
+        restored = ck.restore(agent.init_state(0))
+    finally:
+        ck.close()
+
+    s1, st1 = agent.run_iterations(state, 2)
+    s2, st2 = agent.run_iterations(restored, 2)
+    np.testing.assert_allclose(
+        np.asarray(st1["entropy"]), np.asarray(st2["entropy"]), rtol=1e-5
+    )
+    assert int(s2.iteration) == 4
