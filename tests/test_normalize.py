@@ -102,12 +102,67 @@ def test_normalization_learning_not_degraded():
     assert rewards[-1] > rewards[0]  # pendulum returns rise from ~-1400
 
 
-def test_host_env_rejects_normalization():
+def _host_agent(**kw):
+    base = dict(env="gym:CartPole-v1", n_envs=2, batch_timesteps=32,
+                cg_iters=3, vf_train_steps=3, policy_hidden=(16,),
+                normalize_obs=True)
+    base.update(kw)
+    return TRPOAgent(base["env"], TRPOConfig(**base))
+
+
+def test_gym_env_normalizes_on_host():
+    """gym: env names get ONE shared running-stats object in the adapter,
+    mirrored into TrainState (checkpointable) each iteration."""
+    agent = _host_agent()
+    state = agent.init_state(0)
+    assert state.obs_norm is not None
+    c0 = float(state.obs_norm.count)   # initial reset already folded N obs
+    state, stats = agent.run_iteration(state)
+    assert np.isfinite(float(stats["entropy"]))
+    assert float(state.obs_norm.count) > c0
+    # mirror matches the env's own statistics
+    count, mean, m2 = agent.env.obs_stats_state()
+    np.testing.assert_allclose(
+        np.asarray(state.obs_norm.mean), mean, rtol=1e-6
+    )
+
+
+def test_host_normalization_eval_frozen_and_resumable():
+    """evaluate() must not shift training statistics; a restored state
+    re-seeds the adapter's statistics."""
+    agent = _host_agent()
+    state, _ = agent.run_iteration(agent.init_state(0))
+    before = np.asarray(state.obs_norm.count)
+    agent.evaluate(state, n_steps=8)
+    count, _, _ = agent.env.obs_stats_state()
+    np.testing.assert_allclose(count, before)  # eval folded nothing
+    assert not agent.env._norm_frozen
+
+    # "resume": fresh agent (fresh env stats), restored-state push
+    agent2 = _host_agent()
+    s2, _ = agent2.run_iteration(state)
+    count2, _, _ = agent2.env.obs_stats_state()
+    assert float(count2) > float(before)  # continued from state's stats
+
+
+def test_unroutable_host_env_rejects_normalization():
+    """A pre-constructed adapter WITHOUT normalize_obs has no hook ->
+    clear error; constructed WITH it, it is accepted."""
+    from trpo_tpu.envs import make
+
+    env = make("gym:CartPole-v1", n_envs=2)
     with pytest.raises(NotImplementedError):
-        TRPOAgent(
-            "gym:CartPole-v1",
-            TRPOConfig(env="gym:CartPole-v1", normalize_obs=True),
-        )
+        TRPOAgent(env, TRPOConfig(env="gym:CartPole-v1", normalize_obs=True))
+
+    env_n = make("gym:CartPole-v1", n_envs=2, normalize_obs=True)
+    agent = TRPOAgent(
+        env_n,
+        TRPOConfig(env="gym:CartPole-v1", n_envs=2, batch_timesteps=32,
+                   cg_iters=3, vf_train_steps=3, policy_hidden=(16,),
+                   normalize_obs=True),
+    )
+    state, stats = agent.run_iteration(agent.init_state(0))
+    assert np.isfinite(float(stats["entropy"]))
 
 
 def test_checkpoint_roundtrips_stats(tmp_path):
@@ -125,3 +180,27 @@ def test_checkpoint_roundtrips_stats(tmp_path):
         np.asarray(state.obs_norm.mean), np.asarray(restored.obs_norm.mean)
     )
     assert float(restored.obs_norm.count) == 64.0
+
+
+def test_stats_install_renormalizes_cached_obs():
+    """set_obs_stats_state must re-scale the cached current obs, and act()
+    must not double-normalize env-produced observations."""
+    from trpo_tpu.envs import make
+
+    env = make("gym:CartPole-v1", n_envs=2, normalize_obs=True)
+    raw = env._raw_obs.copy()
+    shifted = (np.float32(1000.0), 5.0 * np.ones(4, np.float32),
+               1000.0 * np.ones(4, np.float32))
+    env.set_obs_stats_state(shifted)
+    expected = env._apply_norm(raw)
+    np.testing.assert_allclose(env.current_obs(), expected, rtol=1e-6)
+
+    agent = _host_agent()
+    state = agent.init_state(0)
+    obs = agent.env.current_obs()[0]  # already normalized by the adapter
+    _, dist = agent.act(state, obs, key=jax.random.key(0))
+    # reference: raw policy on the same (already normalized) obs
+    ref = agent.policy.apply(state.policy_params, jnp.asarray(obs)[None])
+    np.testing.assert_allclose(
+        np.asarray(dist["logits"]), np.asarray(ref["logits"])[0], rtol=1e-6
+    )

@@ -66,12 +66,19 @@ class TRPOAgent:
 
     def __init__(self, env, config: Optional[TRPOConfig] = None):
         cfg = config or TRPOConfig()
+        host_normalized = False
         if isinstance(env, str):
             kwargs = (
                 {"n_envs": cfg.n_envs}
                 if env.startswith(("gym:", "native:"))
                 else {}
             )
+            if cfg.normalize_obs and env.startswith("gym:"):
+                # host analogue of the device-side running normalization:
+                # ONE shared running-stats object inside the adapter
+                # (envs/gym_adapter.py), mirrored into TrainState below
+                kwargs["normalize_obs"] = True
+                host_normalized = True
             # cfg.max_pathlength=None keeps the env's default horizon;
             # a value overrides it for every env family (envs.make).
             env = envs_lib.make(
@@ -109,11 +116,26 @@ class TRPOAgent:
                 compute_dtype=compute_dtype,
             )
         self.is_recurrent = cfg.policy_gru is not None
-        if cfg.normalize_obs and not self.is_device_env:
+        # Device envs: statistics thread through the fused iteration
+        # (TrainState.obs_norm, device-managed). gym: envs: the adapter
+        # owns shared running stats; TrainState.obs_norm mirrors them so
+        # checkpoints carry them. Anything else host-side has no hook.
+        host_normalized = host_normalized or bool(
+            getattr(env, "has_obs_norm", False)
+        )
+        self._obs_norm_on_device = cfg.normalize_obs and self.is_device_env
+        self._obs_norm_host = (not self.is_device_env) and host_normalized
+        if (
+            cfg.normalize_obs
+            and not self.is_device_env
+            and not host_normalized
+        ):
             raise NotImplementedError(
-                "normalize_obs currently requires a pure-JAX device env "
-                "(the statistics thread through the fused iteration); "
-                "normalize observations in a host-env wrapper instead"
+                "normalize_obs supports pure-JAX device envs (fused running "
+                'statistics) and GymVecEnv ("gym:<Id>" names construct it '
+                "with normalize_obs=True automatically; pre-constructed "
+                "adapters must pass it themselves); native: host envs have "
+                "no normalization hook"
             )
         obs_dim = int(math.prod(obs_shape))
         if self.is_recurrent:
@@ -258,10 +280,16 @@ class TRPOAgent:
                     "the axis — resize the layers or the mesh"
                 )
         obs_norm = None
-        if self.cfg.normalize_obs:
+        if self._obs_norm_on_device:
             from trpo_tpu.utils.normalize import init_stats
 
             obs_norm = init_stats(self.obs_shape)
+        elif self._obs_norm_host:
+            from trpo_tpu.utils.normalize import RunningStats
+
+            obs_norm = RunningStats(
+                *(jnp.asarray(x) for x in self.env.obs_stats_state())
+            )
         state = TrainState(
             policy_params=policy_params,
             vf_state=self.vf.init(k_vf),
@@ -350,12 +378,17 @@ class TRPOAgent:
                 policy_carry = self.policy.initial_state(n)
                 if obs.ndim == len(self.obs_shape):
                     policy_carry = policy_carry[0]
+        # Only device-managed statistics normalize here: host-normalized
+        # adapters already return normalized observations (normalizing
+        # again would skew every manually-driven act() call).
+        act_norm = state.obs_norm if self._obs_norm_on_device else None
+        if self.is_recurrent:
             return self._act_fn(
                 state.policy_params, obs, key, eval_mode, policy_carry,
-                state.obs_norm,
+                act_norm,
             )
         action, dist, _ = self._act_fn(
-            state.policy_params, obs, key, eval_mode, None, state.obs_norm
+            state.policy_params, obs, key, eval_mode, None, act_norm
         )
         return action, dist
 
@@ -440,7 +473,7 @@ class TRPOAgent:
         flat = lambda x: x.reshape((T * N,) + x.shape[2:])
 
         new_obs_norm = train_state.obs_norm
-        if train_state.obs_norm is not None:
+        if self._obs_norm_on_device and train_state.obs_norm is not None:
             # Normalize with the stats the ROLLOUT used (start-of-iteration)
             # so the replayed distributions match old_dist exactly; fold the
             # raw observations in afterwards for the next iteration.
@@ -594,6 +627,14 @@ class TRPOAgent:
         if self.is_device_env:
             return self._iter_fn(train_state)
         rng = jax.random.fold_in(train_state.rng, int(train_state.iteration))
+        if self._obs_norm_host:
+            # TrainState is the checkpointed source of truth: push its
+            # statistics into the adapter before collecting (a restored
+            # state thus re-seeds the env's normalization), read the
+            # updated ones back after.
+            self.env.set_obs_stats_state(
+                tuple(np.asarray(x) for x in train_state.obs_norm)
+            )
         policy_state = None
         if self.is_recurrent:
             policy_state = train_state.env_carry
@@ -614,6 +655,14 @@ class TRPOAgent:
             act_fn=getattr(self, "_host_act_fn", None) or self._make_host_act(),
             policy_state=policy_state,
         )
+        if self._obs_norm_host:
+            from trpo_tpu.utils.normalize import RunningStats
+
+            train_state = train_state._replace(
+                obs_norm=RunningStats(
+                    *(jnp.asarray(x) for x in self.env.obs_stats_state())
+                )
+            )
         if self.is_recurrent:
             traj, (h, prev_done) = out
             new_carry = (jnp.asarray(h), jnp.asarray(prev_done))
@@ -701,28 +750,39 @@ class TRPOAgent:
                 train_state.obs_norm,
             )
         else:
-            self.env.reset_all(seed=seed)
-            if self.is_recurrent:
-                # fresh memory, greedy actions; host_rollout builds and
-                # caches nothing here — eval is rare. The hard resets make
-                # any carried training memory stale: flag it so the next
-                # run_iteration starts from zeroed hidden state.
-                self._host_env_reset_pending = True
-                traj, _ = host_rollout(
-                    self.env, self.policy, train_state.policy_params,
-                    k_roll, n_steps, deterministic=True,
+            if self._obs_norm_host:
+                # evaluation must not shift the training statistics; push
+                # the state's stats and freeze folding for the whole eval
+                self.env.set_obs_stats_state(
+                    tuple(np.asarray(x) for x in train_state.obs_norm)
                 )
-            else:
-                if self._host_eval_act_fn is None:
-                    # reuse the already-jitted act path (argmax/mode branch)
-                    self._host_eval_act_fn = lambda p, o, k: self._act_fn(
-                        p, o, k, True
-                    )[:2]
-                traj = host_rollout(
-                    self.env, self.policy, train_state.policy_params, k_roll,
-                    n_steps, act_fn=self._host_eval_act_fn,
-                )
-            self.env.reset_all()
+                self.env.freeze_obs_stats(True)
+            try:
+                self.env.reset_all(seed=seed)
+                if self.is_recurrent:
+                    # fresh memory, greedy actions; host_rollout builds and
+                    # caches nothing here — eval is rare. The hard resets
+                    # make any carried training memory stale: flag it so the
+                    # next run_iteration starts from zeroed hidden state.
+                    self._host_env_reset_pending = True
+                    traj, _ = host_rollout(
+                        self.env, self.policy, train_state.policy_params,
+                        k_roll, n_steps, deterministic=True,
+                    )
+                else:
+                    if self._host_eval_act_fn is None:
+                        # reuse the jitted act path (argmax/mode branch)
+                        self._host_eval_act_fn = lambda p, o, k: self._act_fn(
+                            p, o, k, True
+                        )[:2]
+                    traj = host_rollout(
+                        self.env, self.policy, train_state.policy_params,
+                        k_roll, n_steps, act_fn=self._host_eval_act_fn,
+                    )
+                self.env.reset_all()
+            finally:
+                if self._obs_norm_host:
+                    self.env.freeze_obs_stats(False)
         done = np.asarray(traj.done)
         rets = np.asarray(traj.episode_return)
         n_done = int(done.sum())

@@ -25,7 +25,8 @@ __all__ = ["GymVecEnv"]
 class GymVecEnv(EpisodeStatsMixin):
     """N synchronous gymnasium envs with explicit pre-reset final obs."""
 
-    def __init__(self, env_id: str, n_envs: int = 8, seed: int = 0, **kwargs):
+    def __init__(self, env_id: str, n_envs: int = 8, seed: int = 0,
+                 normalize_obs: bool = False, **kwargs):
         try:
             import gymnasium
         except ImportError as e:  # pragma: no cover
@@ -49,10 +50,88 @@ class GymVecEnv(EpisodeStatsMixin):
             self._act_low = np.asarray(space.low, np.float32)
             self._act_high = np.asarray(space.high, np.float32)
 
-        self._obs = np.stack(
-            [env.reset(seed=seed + i)[0] for i, env in enumerate(self.envs)]
+        # Shared running obs normalization (ONE statistics object across all
+        # envs — the host analogue of the device path's fused RunningStats,
+        # utils/normalize.py). The agent mirrors these into TrainState every
+        # iteration so checkpoints carry them, and freezes them during
+        # evaluation.
+        self.has_obs_norm = bool(normalize_obs)
+        self._norm_frozen = False
+        if self.has_obs_norm:
+            self._n_count = 0.0
+            self._n_mean = np.zeros(self.obs_shape, np.float64)
+            self._n_m2 = np.zeros(self.obs_shape, np.float64)
+
+        self._obs = self._fold_and_normalize(
+            np.stack(
+                [
+                    env.reset(seed=seed + i)[0]
+                    for i, env in enumerate(self.envs)
+                ]
+            )
         )
         self._init_episode_stats(n_envs)
+
+    # -- shared running obs normalization ---------------------------------
+
+    def _fold_and_normalize(self, obs_batch: np.ndarray) -> np.ndarray:
+        """Fold a raw ``(N, *obs)`` batch into the shared statistics (unless
+        frozen) and return it normalized. Chan/Welford merge — the same math
+        as ``utils/normalize.update_stats``."""
+        if not self.has_obs_norm:
+            return obs_batch
+        # keep the raw batch: installing restored statistics later must be
+        # able to re-normalize the cached current obs (set_obs_stats_state)
+        self._raw_obs = np.asarray(obs_batch).copy()
+        if not self._norm_frozen:
+            b = np.asarray(obs_batch, np.float64)
+            n_b = float(b.shape[0])
+            mean_b = b.mean(axis=0)
+            m2_b = ((b - mean_b) ** 2).sum(axis=0)
+            delta = mean_b - self._n_mean
+            tot = self._n_count + n_b
+            self._n_mean = self._n_mean + delta * (n_b / tot)
+            self._n_m2 = self._n_m2 + m2_b + delta**2 * (
+                self._n_count * n_b / tot
+            )
+            self._n_count = tot
+        return self._apply_norm(obs_batch)
+
+    def _apply_norm(self, obs: np.ndarray) -> np.ndarray:
+        if not self.has_obs_norm or self._n_count == 0.0:
+            return obs
+        var = self._n_m2 / max(self._n_count, 1.0)
+        std = np.sqrt(var + 1e-8)
+        return np.clip(
+            (obs - self._n_mean) / std, -10.0, 10.0
+        ).astype(np.float32)
+
+    def obs_stats_state(self):
+        """(count, mean, m2) float32 arrays — the checkpointable mirror."""
+        if not self.has_obs_norm:
+            return None
+        return (
+            np.float32(self._n_count),
+            self._n_mean.astype(np.float32),
+            self._n_m2.astype(np.float32),
+        )
+
+    def set_obs_stats_state(self, state) -> None:
+        """Install (count, mean, m2) — e.g. restored from a checkpoint.
+
+        The cached current observations are re-normalized under the new
+        statistics so the next rollout's first step is consistent with the
+        rest of its batch."""
+        count, mean, m2 = state
+        self._n_count = float(count)
+        self._n_mean = np.asarray(mean, np.float64)
+        self._n_m2 = np.asarray(m2, np.float64)
+        self._obs = self._apply_norm(self._raw_obs)
+
+    def freeze_obs_stats(self, frozen: bool = True) -> None:
+        """Stop/resume folding new data in (evaluation must not shift the
+        training statistics)."""
+        self._norm_frozen = frozen
 
     def host_step(self, actions: np.ndarray):
         """Step all envs; auto-reset finished ones.
@@ -86,6 +165,10 @@ class GymVecEnv(EpisodeStatsMixin):
             rewards, np.logical_or(terminated, truncated)
         )
 
+        # one shared-stats fold per step; final_obs (truncation bootstrap
+        # successors) normalized with the same statistics, not re-folded
+        next_obs = self._fold_and_normalize(next_obs)
+        final_obs = self._apply_norm(final_obs)
         self._obs = next_obs
         return next_obs, rewards, terminated, truncated, final_obs
 
@@ -96,11 +179,13 @@ class GymVecEnv(EpisodeStatsMixin):
         is for callers that need episode boundaries under their own control
         (e.g. reference-style serial rollouts, reproducible evaluation —
         ``seed`` reseeds env ``i`` with ``seed + i``)."""
-        self._obs = np.stack(
-            [
-                env.reset(seed=None if seed is None else seed + i)[0]
-                for i, env in enumerate(self.envs)
-            ]
+        self._obs = self._fold_and_normalize(
+            np.stack(
+                [
+                    env.reset(seed=None if seed is None else seed + i)[0]
+                    for i, env in enumerate(self.envs)
+                ]
+            )
         )
         self._running_returns[:] = 0.0
         self._running_lengths[:] = 0
