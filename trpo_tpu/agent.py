@@ -32,7 +32,13 @@ from trpo_tpu import envs as envs_lib
 from trpo_tpu.config import TRPOConfig
 from trpo_tpu.models.policy import make_policy, spec_from_env
 from trpo_tpu.ops.returns import gae_from_next_values
-from trpo_tpu.rollout import Trajectory, device_rollout, host_rollout, init_carry
+from trpo_tpu.rollout import (
+    Trajectory,
+    device_rollout,
+    host_rollout,
+    init_carry,
+    pipelined_host_rollout,
+)
 from trpo_tpu.trpo import (
     TRPOBatch,
     TRPOStats,
@@ -158,6 +164,32 @@ class TRPOAgent:
         # steps per env per iteration, so T·N ≥ batch_timesteps
         # (ref batch budget semantics, trpo_inksci.py:17 + utils.py:21).
         self.n_steps = max(1, -(-cfg.batch_timesteps // cfg.n_envs))
+
+        if cfg.host_pipeline_groups > 1:
+            # fail at construction, not mid-training: the pipelined rollout
+            # (host/device overlap) has hard requirements
+            if self.is_device_env:
+                raise ValueError(
+                    "host_pipeline_groups applies to host-simulator envs "
+                    "(gym:/native:); device envs roll out inside the fused "
+                    "device program and have no host loop to pipeline"
+                )
+            if self.is_recurrent:
+                raise ValueError(
+                    "host_pipeline_groups supports feedforward policies "
+                    "only (recurrent window-replay bookkeeping is not "
+                    "pipelined); set policy_gru=None or groups=1"
+                )
+            if not hasattr(self.env, "host_step_slice"):
+                raise ValueError(
+                    f"{type(self.env).__name__} has no host_step_slice — "
+                    "group stepping is unavailable for this adapter"
+                )
+            if cfg.host_pipeline_groups > cfg.n_envs:
+                raise ValueError(
+                    f"host_pipeline_groups={cfg.host_pipeline_groups} "
+                    f"exceeds n_envs={cfg.n_envs}"
+                )
 
         # Data-parallel mesh: env states and rollout tensors shard over
         # "data"; params replicate; XLA inserts the psum reductions
@@ -646,15 +678,29 @@ class TRPOAgent:
                     jnp.ones(self.cfg.n_envs, bool),
                 )
                 self._host_env_reset_pending = False
-        out = host_rollout(
-            self.env,
-            self.policy,
-            train_state.policy_params,
-            rng,
-            self.n_steps,
-            act_fn=getattr(self, "_host_act_fn", None) or self._make_host_act(),
-            policy_state=policy_state,
-        )
+        act_fn = getattr(self, "_host_act_fn", None) or self._make_host_act()
+        if self.cfg.host_pipeline_groups > 1:
+            # overlap host env stepping with device inference (feedforward
+            # only — enforced at construction)
+            out = pipelined_host_rollout(
+                self.env,
+                self.policy,
+                train_state.policy_params,
+                rng,
+                self.n_steps,
+                n_groups=self.cfg.host_pipeline_groups,
+                act_fn=act_fn,
+            )
+        else:
+            out = host_rollout(
+                self.env,
+                self.policy,
+                train_state.policy_params,
+                rng,
+                self.n_steps,
+                act_fn=act_fn,
+                policy_state=policy_state,
+            )
         if self._obs_norm_host:
             from trpo_tpu.utils.normalize import RunningStats
 

@@ -92,6 +92,18 @@ class TRPOConfig:
     #                                entropy!=entropy abort (trpo_inksci.py:172-173)
 
     # --- parallelism -----------------------------------------------------
+    host_pipeline_groups: int = 1  # host-simulator envs only: split the N
+    #                                envs into this many groups and software-
+    #                                pipeline the rollout — one group steps
+    #                                on the host while the other groups'
+    #                                policy inference is in flight on the
+    #                                device (rollout.pipelined_host_rollout;
+    #                                SURVEY §7 "overlap env stepping with
+    #                                device compute"). 1 = the strict
+    #                                alternation of host_rollout. Feedforward
+    #                                policies; needs an adapter with
+    #                                host_step_slice (gym:/native: both have
+    #                                it).
     mesh_shape: Optional[Tuple[int, ...]] = None  # None → single device, no
     #                                mesh; set e.g. (8,) for data parallelism
     mesh_axes: Tuple[str, ...] = ("data",)

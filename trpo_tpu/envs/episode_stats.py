@@ -34,9 +34,19 @@ class EpisodeStatsMixin:
         On a step where ``ended[i]`` is True, ``last_episode_returns[i]`` /
         ``last_episode_lengths[i]`` hold episode totals including this final
         step — the value the done-masked episode stats read."""
-        self._running_returns += rewards
-        self._running_lengths += 1
-        self.last_episode_returns = self._running_returns.copy()
-        self.last_episode_lengths = self._running_lengths.copy()
-        self._running_returns[ended] = 0.0
-        self._running_lengths[ended] = 0
+        self._update_episode_stats_slice(rewards, ended, 0, len(rewards))
+
+    def _update_episode_stats_slice(
+        self, rewards: np.ndarray, ended: np.ndarray, lo: int, hi: int
+    ) -> None:
+        """Same contract for envs ``[lo, hi)`` only — the group-stepping
+        path (``host_step_slice``) used by the pipelined rollout. Slices of
+        the snapshot arrays are written in place; envs outside the slice
+        keep their previous snapshot (they are mid-step elsewhere in the
+        pipeline)."""
+        self._running_returns[lo:hi] += rewards
+        self._running_lengths[lo:hi] += 1
+        self.last_episode_returns[lo:hi] = self._running_returns[lo:hi]
+        self.last_episode_lengths[lo:hi] = self._running_lengths[lo:hi]
+        self._running_returns[lo:hi][ended] = 0.0
+        self._running_lengths[lo:hi][ended] = 0

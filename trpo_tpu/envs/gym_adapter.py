@@ -74,27 +74,46 @@ class GymVecEnv(EpisodeStatsMixin):
 
     # -- shared running obs normalization ---------------------------------
 
+    def _fold(self, obs_batch: np.ndarray) -> None:
+        """Chan/Welford-merge a raw batch into the shared statistics — the
+        same math as ``utils/normalize.update_stats``."""
+        b = np.asarray(obs_batch, np.float64)
+        n_b = float(b.shape[0])
+        mean_b = b.mean(axis=0)
+        m2_b = ((b - mean_b) ** 2).sum(axis=0)
+        delta = mean_b - self._n_mean
+        tot = self._n_count + n_b
+        self._n_mean = self._n_mean + delta * (n_b / tot)
+        self._n_m2 = self._n_m2 + m2_b + delta**2 * (
+            self._n_count * n_b / tot
+        )
+        self._n_count = tot
+
     def _fold_and_normalize(self, obs_batch: np.ndarray) -> np.ndarray:
         """Fold a raw ``(N, *obs)`` batch into the shared statistics (unless
-        frozen) and return it normalized. Chan/Welford merge — the same math
-        as ``utils/normalize.update_stats``."""
+        frozen) and return it normalized."""
         if not self.has_obs_norm:
             return obs_batch
         # keep the raw batch: installing restored statistics later must be
         # able to re-normalize the cached current obs (set_obs_stats_state)
         self._raw_obs = np.asarray(obs_batch).copy()
         if not self._norm_frozen:
-            b = np.asarray(obs_batch, np.float64)
-            n_b = float(b.shape[0])
-            mean_b = b.mean(axis=0)
-            m2_b = ((b - mean_b) ** 2).sum(axis=0)
-            delta = mean_b - self._n_mean
-            tot = self._n_count + n_b
-            self._n_mean = self._n_mean + delta * (n_b / tot)
-            self._n_m2 = self._n_m2 + m2_b + delta**2 * (
-                self._n_count * n_b / tot
-            )
-            self._n_count = tot
+            self._fold(obs_batch)
+        return self._apply_norm(obs_batch)
+
+    def _fold_and_normalize_slice(
+        self, obs_batch: np.ndarray, lo: int, hi: int
+    ) -> np.ndarray:
+        """Slice variant for group stepping: raw rows ``[lo, hi)`` replace
+        their cache entries, the slice folds into the SAME shared statistics
+        (one fold per group step instead of per full step — the merge is
+        associative, so the statistics converge identically), and the slice
+        comes back normalized under the statistics as of now."""
+        if not self.has_obs_norm:
+            return obs_batch
+        self._raw_obs[lo:hi] = obs_batch
+        if not self._norm_frozen:
+            self._fold(obs_batch)
         return self._apply_norm(obs_batch)
 
     def _apply_norm(self, obs: np.ndarray) -> np.ndarray:
@@ -141,35 +160,48 @@ class GymVecEnv(EpisodeStatsMixin):
         the quantity needed to bootstrap truncated episodes, which the
         reference's rollout loses (``utils.py:44``).
         """
-        n = self.n_envs
-        next_obs = np.empty_like(self._obs)
-        final_obs = np.empty_like(self._obs)
-        rewards = np.zeros(n, np.float32)
-        terminated = np.zeros(n, bool)
-        truncated = np.zeros(n, bool)
+        return self.host_step_slice(actions, 0, self.n_envs)
 
-        for i, env in enumerate(self.envs):
-            a = actions[i]
+    def host_step_slice(self, actions: np.ndarray, lo: int, hi: int):
+        """Step only envs ``[lo, hi)`` — same per-env contract as
+        :meth:`host_step` with every array sliced to the group.
+
+        This is the group-stepping surface ``rollout.pipelined_host_rollout``
+        drives: one group steps on the host while another group's policy
+        inference is in flight on the device. Episode stats and the shared
+        normalization statistics update for the slice only; normalization
+        folds once per group step (associative merge — same limit as the
+        full-batch fold)."""
+        m = hi - lo
+        next_obs = np.empty((m,) + self._obs.shape[1:], self._obs.dtype)
+        final_obs = np.empty_like(next_obs)
+        rewards = np.zeros(m, np.float32)
+        terminated = np.zeros(m, bool)
+        truncated = np.zeros(m, bool)
+
+        for j, env in enumerate(self.envs[lo:hi]):
+            a = actions[j]
             if self._continuous:
                 a = np.clip(a, self._act_low, self._act_high)
-            obs_i, r, term, trunc, _info = env.step(a)
-            rewards[i] = r
-            terminated[i] = term
-            truncated[i] = trunc
-            final_obs[i] = obs_i
+            obs_j, r, term, trunc, _info = env.step(a)
+            rewards[j] = r
+            terminated[j] = term
+            truncated[j] = trunc
+            final_obs[j] = obs_j
             if term or trunc:
-                obs_i, _ = env.reset()
-            next_obs[i] = obs_i
+                obs_j, _ = env.reset()
+            next_obs[j] = obs_j
 
-        self._update_episode_stats(
-            rewards, np.logical_or(terminated, truncated)
+        self._update_episode_stats_slice(
+            rewards, np.logical_or(terminated, truncated), lo, hi
         )
 
-        # one shared-stats fold per step; final_obs (truncation bootstrap
-        # successors) normalized with the same statistics, not re-folded
-        next_obs = self._fold_and_normalize(next_obs)
+        # one shared-stats fold per (group) step; final_obs (truncation
+        # bootstrap successors) normalized with the same statistics, not
+        # re-folded
+        next_obs = self._fold_and_normalize_slice(next_obs, lo, hi)
         final_obs = self._apply_norm(final_obs)
-        self._obs = next_obs
+        self._obs[lo:hi] = next_obs
         return next_obs, rewards, terminated, truncated, final_obs
 
     def reset_all(self, seed=None) -> np.ndarray:
@@ -189,10 +221,13 @@ class GymVecEnv(EpisodeStatsMixin):
         )
         self._running_returns[:] = 0.0
         self._running_lengths[:] = 0
-        return self._obs
+        # a copy: group stepping updates the cache in place
+        return self._obs.copy()
 
     def current_obs(self) -> np.ndarray:
-        return self._obs
+        # a copy: group stepping (host_step_slice) updates the cache in
+        # place, and callers buffer what this returns
+        return self._obs.copy()
 
     def close(self):
         for env in self.envs:

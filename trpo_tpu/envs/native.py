@@ -182,29 +182,41 @@ class NativeVecEnv(EpisodeStatsMixin):
         """Step all envs in native code; auto-reset inside. Same contract as
         ``GymVecEnv.host_step`` (true pre-reset ``final_obs`` for truncation
         bootstrapping)."""
-        n = self.n_envs
+        return self.host_step_slice(actions, 0, self.n_envs)
+
+    def host_step_slice(self, actions: np.ndarray, lo: int, hi: int):
+        """Step only envs ``[lo, hi)`` — the group-stepping surface for
+        ``rollout.pipelined_host_rollout`` (one group steps in native code
+        while another group's inference is in flight on the device). Row
+        slices of the state/counter/RNG arrays are C-contiguous views, so
+        the C++ stepper runs on them in place with zero copies."""
+        m = hi - lo
         if self._discrete:
-            acts = np.ascontiguousarray(actions.reshape(n), np.int32)
+            acts = np.ascontiguousarray(
+                np.asarray(actions).reshape(m), np.int32
+            )
         else:
-            acts = np.ascontiguousarray(actions.reshape(n), np.float32)
-        next_obs = np.empty((n, self.obs_shape[0]), np.float32)
+            acts = np.ascontiguousarray(
+                np.asarray(actions).reshape(m), np.float32
+            )
+        next_obs = np.empty((m, self.obs_shape[0]), np.float32)
         final_obs = np.empty_like(next_obs)
-        rewards = np.empty(n, np.float32)
-        terminated = np.empty(n, np.uint8)
-        truncated = np.empty(n, np.uint8)
+        rewards = np.empty(m, np.float32)
+        terminated = np.empty(m, np.uint8)
+        truncated = np.empty(m, np.uint8)
         self._step(
-            self._state, self._t, self._rng, acts,
-            np.int32(n), np.int32(self.max_episode_steps),
+            self._state[lo:hi], self._t[lo:hi], self._rng[lo:hi], acts,
+            np.int32(m), np.int32(self.max_episode_steps),
             next_obs, final_obs, rewards, terminated, truncated,
         )
         terminated = terminated.astype(bool)
         truncated = truncated.astype(bool)
 
-        self._update_episode_stats(
-            rewards, np.logical_or(terminated, truncated)
+        self._update_episode_stats_slice(
+            rewards, np.logical_or(terminated, truncated), lo, hi
         )
 
-        self._obs = next_obs
+        self._obs[lo:hi] = next_obs
         return next_obs, rewards, terminated, truncated, final_obs
 
     def reset_all(self, seed=None) -> np.ndarray:
@@ -222,10 +234,13 @@ class NativeVecEnv(EpisodeStatsMixin):
         self._obs = self._observe()
         self._running_returns[:] = 0.0
         self._running_lengths[:] = 0
-        return self._obs
+        # a copy: group stepping updates the cache in place
+        return self._obs.copy()
 
     def current_obs(self) -> np.ndarray:
-        return self._obs
+        # a copy: group stepping (host_step_slice) updates the cache in
+        # place, and callers buffer what this returns
+        return self._obs.copy()
 
     def close(self):
         pass

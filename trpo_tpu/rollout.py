@@ -30,6 +30,7 @@ __all__ = [
     "device_rollout",
     "init_env_states",
     "host_rollout",
+    "pipelined_host_rollout",
     "make_host_act_fn",
 ]
 
@@ -328,3 +329,136 @@ def host_rollout(
         policy_h_next=stack(h_post_buf),
     )
     return traj, (h, prev_done)
+
+
+def pipelined_host_rollout(
+    vec_env,
+    policy: Policy,
+    params,
+    key,
+    n_steps: int,
+    n_groups: int = 2,
+    act_fn=None,
+    deterministic: bool = False,
+):
+    """Host rollout with device inference and host env stepping OVERLAPPED.
+
+    :func:`host_rollout` is a strict alternation: the host blocks on the
+    device for the batch's actions, then the device sits idle while the host
+    steps every env. This variant splits the ``N`` envs into ``n_groups``
+    contiguous groups and software-pipelines them — when group ``g``'s
+    actions are fetched and its envs are stepping on the host (via the
+    adapters' ``host_step_slice``), the inference for the OTHER groups is
+    already in flight on the device (JAX dispatch is asynchronous; only the
+    ``np.asarray`` fetch of a group's own actions blocks). Device compute —
+    and, on a tunneled TPU, the transfer round trip — hides behind host
+    simulation instead of adding to it. This is the "overlap env stepping
+    with device compute" obligation of SURVEY §7; the reference's rollout
+    is the degenerate fully-serial case (one env, one ``sess.run`` per step,
+    ``utils.py:18-45``).
+
+    Semantics match :func:`host_rollout` per group and per timestep (every
+    group advances exactly once per ``t``; the trajectory is the env-axis
+    concatenation of the groups, in env order). With a deterministic policy
+    the result is bit-identical to the serial rollout; with sampling the
+    per-group PRNG keys necessarily differ from the serial batch key, and
+    with shared obs-normalization the statistics fold per group step instead
+    of per full step (associative merge — same limit). Feedforward policies
+    only: a recurrent policy's hidden state is carried strictly in step
+    order per env, which the pipeline preserves, but the window-replay
+    bookkeeping is not wired here — use :func:`host_rollout`.
+    """
+    if hasattr(policy, "step"):
+        raise NotImplementedError(
+            "pipelined_host_rollout supports feedforward policies; "
+            "recurrent policies use host_rollout"
+        )
+    if not hasattr(vec_env, "host_step_slice"):
+        raise TypeError(
+            f"{type(vec_env).__name__} has no host_step_slice — the env "
+            "adapter does not support group stepping"
+        )
+    N = vec_env.n_envs
+    if not 2 <= n_groups <= N:
+        raise ValueError(
+            f"n_groups must be in [2, n_envs={N}], got {n_groups} "
+            "(1 group is host_rollout)"
+        )
+    if act_fn is None:
+        act_fn = make_host_act_fn(policy, deterministic=deterministic)
+
+    # contiguous near-equal groups covering [0, N)
+    cuts = np.linspace(0, N, n_groups + 1).round().astype(int)
+    groups = [(int(cuts[g]), int(cuts[g + 1])) for g in range(n_groups)]
+
+    T = n_steps
+    obs_g = [np.asarray(vec_env.current_obs()[lo:hi]) for lo, hi in groups]
+    # per-group time-major buffers; assembled by env-axis concat at the end
+    buf = [
+        {
+            "obs": [], "actions": [], "rewards": [], "terminated": [],
+            "done": [], "dist": [], "next_obs": [], "ret": [], "len": [],
+        }
+        for _ in range(n_groups)
+    ]
+
+    # flat (T·G,) split indexed as [t·G + g]: works for typed keys AND
+    # legacy uint32 PRNGKey arrays (whose trailing (2,) would break a
+    # (T, G) reshape)
+    keys = jax.random.split(key, T * n_groups)
+    # prologue: put every group's t=0 inference in flight before fetching any
+    pending = [
+        act_fn(params, jnp.asarray(obs_g[g]), keys[g])
+        for g in range(n_groups)
+    ]
+    for t in range(T):
+        for g, (lo, hi) in enumerate(groups):
+            actions_dev, dist_dev = pending[g]
+            # blocks on THIS group's inference only; the other groups'
+            # dispatches keep the device busy while this group host-steps
+            actions_np = np.asarray(actions_dev)
+            dist_np = jax.tree_util.tree_map(np.asarray, dist_dev)
+            next_obs, rewards, terminated, truncated, final_obs = (
+                vec_env.host_step_slice(actions_np, lo, hi)
+            )
+            done = np.logical_or(terminated, truncated)
+            b = buf[g]
+            b["obs"].append(obs_g[g])
+            b["actions"].append(actions_np)
+            b["rewards"].append(rewards)
+            b["terminated"].append(terminated)
+            b["done"].append(done)
+            b["dist"].append(dist_np)
+            b["next_obs"].append(final_obs)
+            b["ret"].append(vec_env.last_episode_returns[lo:hi].copy())
+            b["len"].append(vec_env.last_episode_lengths[lo:hi].copy())
+            obs_g[g] = next_obs
+            if t + 1 < T:
+                pending[g] = act_fn(
+                    params,
+                    jnp.asarray(next_obs),
+                    keys[(t + 1) * n_groups + g],
+                )
+
+    # (T, m_g, ...) per group → (T, N, ...) by env-axis concatenation
+    cat = lambda k: jnp.asarray(
+        np.concatenate([np.stack(buf[g][k]) for g in range(n_groups)], axis=1)
+    )
+    dist_groups = [
+        jax.tree_util.tree_map(lambda *xs: np.stack(xs), *buf[g]["dist"])
+        for g in range(n_groups)
+    ]
+    old_dist = jax.tree_util.tree_map(
+        lambda *xs: jnp.asarray(np.concatenate(xs, axis=1)), *dist_groups
+    )
+    return Trajectory(
+        obs=cat("obs"),
+        actions=cat("actions"),
+        rewards=cat("rewards").astype(jnp.float32),
+        terminated=cat("terminated"),
+        done=cat("done"),
+        old_dist=old_dist,
+        next_obs=cat("next_obs"),
+        episode_return=cat("ret").astype(jnp.float32),
+        episode_length=cat("len"),
+    )
