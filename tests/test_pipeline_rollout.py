@@ -162,6 +162,30 @@ def test_agent_pipelined_host_training():
     assert int(stats["episodes_in_batch"]) > 0
 
 
+def test_packed_act_fn_matches_unpacked():
+    """Transfer packing (one fetched array instead of actions + one per
+    dist leaf) must be value-exact for both policy families."""
+    for spec in [BoxSpec(3), DiscreteSpec(4)]:
+        policy = make_policy((5,), spec, hidden=(8,))
+        params = policy.init(jax.random.key(0))
+        obs = jax.random.normal(jax.random.key(1), (7, 5))
+        packed = make_host_act_fn(policy)(params, obs, jax.random.key(2))
+        unpacked = make_host_act_fn(policy, pack=False)(
+            params, obs, jax.random.key(2)
+        )
+        a_p, d_p = packed
+        a_u, d_u = unpacked
+        assert a_p.dtype == np.asarray(a_u).dtype
+        np.testing.assert_array_equal(a_p, np.asarray(a_u))
+        jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y)
+            ),
+            d_p,
+            jax.tree_util.tree_map(np.asarray, d_u),
+        )
+
+
 def test_legacy_prngkey_and_reset_copy():
     """Regressions: legacy uint32 PRNGKey arrays must work (their trailing
     (2,) breaks naive key reshapes), and reset_all must return an array

@@ -185,10 +185,13 @@ class TRPOAgent:
                     f"{type(self.env).__name__} has no host_step_slice — "
                     "group stepping is unavailable for this adapter"
                 )
-            if cfg.host_pipeline_groups > cfg.n_envs:
+            # the adapter's true env count, not cfg's: pre-constructed env
+            # objects may disagree with cfg.n_envs
+            env_count = getattr(self.env, "n_envs", cfg.n_envs)
+            if cfg.host_pipeline_groups > env_count:
                 raise ValueError(
                     f"host_pipeline_groups={cfg.host_pipeline_groups} "
-                    f"exceeds n_envs={cfg.n_envs}"
+                    f"exceeds the adapter's n_envs={env_count}"
                 )
 
         # Data-parallel mesh: env states and rollout tensors shard over

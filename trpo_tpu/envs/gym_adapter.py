@@ -14,6 +14,8 @@ raise at construction — callers gate on availability (see
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from trpo_tpu.envs.episode_stats import EpisodeStatsMixin
@@ -57,6 +59,9 @@ class GymVecEnv(EpisodeStatsMixin):
         # evaluation.
         self.has_obs_norm = bool(normalize_obs)
         self._norm_frozen = False
+        # group-stepping threads (pipelined rollout) share these statistics;
+        # the lock keeps the read-modify-write merge atomic per fold
+        self._norm_lock = threading.Lock()
         if self.has_obs_norm:
             self._n_count = 0.0
             self._n_mean = np.zeros(self.obs_shape, np.float64)
@@ -102,19 +107,26 @@ class GymVecEnv(EpisodeStatsMixin):
         return self._apply_norm(obs_batch)
 
     def _fold_and_normalize_slice(
-        self, obs_batch: np.ndarray, lo: int, hi: int
-    ) -> np.ndarray:
+        self, obs_batch: np.ndarray, lo: int, hi: int, extra=None
+    ):
         """Slice variant for group stepping: raw rows ``[lo, hi)`` replace
         their cache entries, the slice folds into the SAME shared statistics
         (one fold per group step instead of per full step — the merge is
         associative, so the statistics converge identically), and the slice
-        comes back normalized under the statistics as of now."""
+        comes back normalized under the statistics as of now. ``extra`` (the
+        truncation-bootstrap ``final_obs``) is normalized under the SAME
+        statistics snapshot, inside the same lock hold — a concurrent group
+        thread's fold must never be observed mid-update."""
         if not self.has_obs_norm:
-            return obs_batch
+            return obs_batch if extra is None else (obs_batch, extra)
         self._raw_obs[lo:hi] = obs_batch
-        if not self._norm_frozen:
-            self._fold(obs_batch)
-        return self._apply_norm(obs_batch)
+        with self._norm_lock:
+            if not self._norm_frozen:
+                self._fold(obs_batch)
+            normed = self._apply_norm(obs_batch)
+            if extra is None:
+                return normed
+            return normed, self._apply_norm(extra)
 
     def _apply_norm(self, obs: np.ndarray) -> np.ndarray:
         if not self.has_obs_norm or self._n_count == 0.0:
@@ -197,10 +209,11 @@ class GymVecEnv(EpisodeStatsMixin):
         )
 
         # one shared-stats fold per (group) step; final_obs (truncation
-        # bootstrap successors) normalized with the same statistics, not
-        # re-folded
-        next_obs = self._fold_and_normalize_slice(next_obs, lo, hi)
-        final_obs = self._apply_norm(final_obs)
+        # bootstrap successors) normalized with the same statistics — under
+        # the same lock hold — not re-folded
+        next_obs, final_obs = self._fold_and_normalize_slice(
+            next_obs, lo, hi, extra=final_obs
+        )
         self._obs[lo:hi] = next_obs
         return next_obs, rewards, terminated, truncated, final_obs
 

@@ -200,11 +200,24 @@ def init_carry(env, key, n_envs: int, policy=None):
 # ---------------------------------------------------------------------------
 
 
-def make_host_act_fn(policy: Policy, deterministic: bool = False):
+def make_host_act_fn(
+    policy: Policy, deterministic: bool = False, pack: bool = True
+):
     """The ONE builder for host-loop policy inference (used by
-    :func:`host_rollout`'s default and cached by the agent): jitted
+    :func:`host_rollout`'s default and cached by the agent):
     ``(params, obs, key) -> (actions, dist)`` — recurrent policies take a
-    trailing ``h`` and return a trailing ``h'``."""
+    trailing ``h`` and return a trailing ``h'``.
+
+    ``pack=True`` (feedforward only): the jitted program concatenates the
+    actions and every distribution leaf into ONE ``(N, K)`` float32 array,
+    fetched with a single transfer and split back on the host. Each
+    device→host fetch is a full round trip — on a tunneled TPU ~100 ms
+    regardless of size — and the unpacked path pays one per actions array
+    plus one per dist leaf, so packing cuts the per-step rollout latency by
+    that factor (~3× for a Gaussian policy). The split/casts are exact
+    (float32 leaves round-trip bitwise; integer actions are < 2²⁴).
+    ``pack=False`` returns device arrays and lets the caller control the
+    fetches."""
     if hasattr(policy, "step"):
         def act_rec(params, obs, key, h):
             h_new, dist = policy.step(params, h, obs)
@@ -226,7 +239,68 @@ def make_host_act_fn(policy: Policy, deterministic: bool = False):
         )
         return action, dist
 
-    return jax.jit(act)
+    if not pack:
+        return jax.jit(act)
+
+    def act_packed(params, obs, key):
+        action, dist = act(params, obs, key)
+        n = obs.shape[0]
+        cols = [action.reshape(n, -1).astype(jnp.float32)] + [
+            leaf.reshape(n, -1).astype(jnp.float32)
+            for leaf in jax.tree_util.tree_leaves(dist)
+        ]
+        return jnp.concatenate(cols, axis=1)
+
+    jitted = jax.jit(act_packed)
+    jitted_unpacked = jax.jit(act)
+    meta_cache: dict = {}  # obs trailing shape -> unpack recipe (or None)
+
+    def _f32_safe(dt: np.dtype) -> bool:
+        # exact through a float32 round trip: f32 itself, narrower floats
+        # (bf16/f16 upcast losslessly), and small integers (action indices
+        # ≪ 2²⁴). float64 leaves would silently lose bits — don't pack.
+        if dt == np.float32 or np.issubdtype(dt, np.integer):
+            return True
+        return np.issubdtype(dt, np.floating) and np.dtype(dt).itemsize < 4
+
+    def call(params, obs, key):
+        m = meta_cache.get(obs.shape[1:], "?")
+        if m == "?":
+            a_s, d_s = jax.eval_shape(act, params, obs, key)
+            leaves, treedef = jax.tree_util.tree_flatten(d_s)
+            if all(
+                _f32_safe(np.dtype(x.dtype)) for x in [a_s] + leaves
+            ):
+                m = (
+                    a_s.shape[1:],
+                    np.dtype(a_s.dtype),
+                    [
+                        (leaf.shape[1:], np.dtype(leaf.dtype))
+                        for leaf in leaves
+                    ],
+                    treedef,
+                )
+            else:
+                m = None  # e.g. x64 mode — packing would round f64 leaves
+            meta_cache[obs.shape[1:]] = m
+        if m is None:
+            return jitted_unpacked(params, obs, key)
+        a_trail, a_dtype, leaf_meta, treedef = m
+        out = np.asarray(jitted(params, obs, key))  # the ONE transfer
+        n = out.shape[0]
+        ncols = int(np.prod(a_trail, dtype=int))
+        action = out[:, :ncols].reshape((n,) + a_trail).astype(a_dtype)
+        off = ncols
+        leaves = []
+        for trail, dt in leaf_meta:
+            c = int(np.prod(trail, dtype=int))
+            leaves.append(
+                out[:, off:off + c].reshape((n,) + trail).astype(dt)
+            )
+            off += c
+        return action, jax.tree_util.tree_unflatten(treedef, leaves)
+
+    return call
 
 
 def host_rollout(
@@ -359,13 +433,22 @@ def pipelined_host_rollout(
 
     Semantics match :func:`host_rollout` per group and per timestep (every
     group advances exactly once per ``t``; the trajectory is the env-axis
-    concatenation of the groups, in env order). With a deterministic policy
-    the result is bit-identical to the serial rollout; with sampling the
-    per-group PRNG keys necessarily differ from the serial batch key, and
-    with shared obs-normalization the statistics fold per group step instead
-    of per full step (associative merge — same limit). Feedforward policies
-    only: a recurrent policy's hidden state is carried strictly in step
-    order per env, which the pipeline preserves, but the window-replay
+    concatenation of the groups, in env order). Each group runs in its own
+    thread: a group's act→fetch→step chain is inherently serial, so the
+    concurrency is ACROSS groups — one group's device round trip overlaps
+    another group's env stepping, and env stepping itself spreads over
+    cores wherever the simulator releases the GIL (MuJoCo bindings, the
+    native C++ stepper, device transfers all do; JAX's dispatch/compile
+    paths are thread-safe). With a deterministic policy the result is
+    bit-identical to the serial rollout — group chains are independent, so
+    thread scheduling cannot change values. With sampling the per-group
+    PRNG keys necessarily differ from the serial batch key. With shared
+    obs-normalization the fold order across groups is scheduler-dependent:
+    statistics converge to the same limit (associative merge under a lock),
+    and each recorded observation is exactly what the policy saw —
+    internally consistent, which is what the replay requires. Feedforward
+    policies only: a recurrent policy's hidden state is carried strictly in
+    step order per env, which the pipeline preserves, but the window-replay
     bookkeeping is not wired here — use :func:`host_rollout`.
     """
     if hasattr(policy, "step"):
@@ -392,7 +475,7 @@ def pipelined_host_rollout(
     groups = [(int(cuts[g]), int(cuts[g + 1])) for g in range(n_groups)]
 
     T = n_steps
-    obs_g = [np.asarray(vec_env.current_obs()[lo:hi]) for lo, hi in groups]
+    obs0 = np.asarray(vec_env.current_obs())
     # per-group time-major buffers; assembled by env-axis concat at the end
     buf = [
         {
@@ -406,39 +489,39 @@ def pipelined_host_rollout(
     # legacy uint32 PRNGKey arrays (whose trailing (2,) would break a
     # (T, G) reshape)
     keys = jax.random.split(key, T * n_groups)
-    # prologue: put every group's t=0 inference in flight before fetching any
-    pending = [
-        act_fn(params, jnp.asarray(obs_g[g]), keys[g])
-        for g in range(n_groups)
-    ]
-    for t in range(T):
-        for g, (lo, hi) in enumerate(groups):
-            actions_dev, dist_dev = pending[g]
-            # blocks on THIS group's inference only; the other groups'
-            # dispatches keep the device busy while this group host-steps
+
+    def run_group(g: int) -> None:
+        lo, hi = groups[g]
+        b = buf[g]
+        obs = obs0[lo:hi]
+        for t in range(T):
+            actions_dev, dist_dev = act_fn(
+                params, jnp.asarray(obs), keys[t * n_groups + g]
+            )
+            # blocks on THIS group's chain only; the other groups step
+            # their envs / fetch their actions concurrently
             actions_np = np.asarray(actions_dev)
             dist_np = jax.tree_util.tree_map(np.asarray, dist_dev)
             next_obs, rewards, terminated, truncated, final_obs = (
                 vec_env.host_step_slice(actions_np, lo, hi)
             )
-            done = np.logical_or(terminated, truncated)
-            b = buf[g]
-            b["obs"].append(obs_g[g])
+            b["obs"].append(obs)
             b["actions"].append(actions_np)
             b["rewards"].append(rewards)
             b["terminated"].append(terminated)
-            b["done"].append(done)
+            b["done"].append(np.logical_or(terminated, truncated))
             b["dist"].append(dist_np)
             b["next_obs"].append(final_obs)
             b["ret"].append(vec_env.last_episode_returns[lo:hi].copy())
             b["len"].append(vec_env.last_episode_lengths[lo:hi].copy())
-            obs_g[g] = next_obs
-            if t + 1 < T:
-                pending[g] = act_fn(
-                    params,
-                    jnp.asarray(next_obs),
-                    keys[(t + 1) * n_groups + g],
-                )
+            obs = next_obs
+
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(n_groups) as pool:
+        futures = [pool.submit(run_group, g) for g in range(n_groups)]
+        for f in futures:
+            f.result()  # re-raises any group's exception
 
     # (T, m_g, ...) per group → (T, N, ...) by env-axis concatenation
     cat = lambda k: jnp.asarray(

@@ -63,6 +63,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="curvature (Fisher-vector-product) batch fraction in (0, 1] — "
         "every k-th sample; gradient/line search stay full-batch",
     )
+    p.add_argument(
+        "--host-pipeline-groups",
+        type=_positive_int,
+        help="host-simulator envs: split the envs into this many groups and "
+        "overlap one group's host stepping with the others' device "
+        "inference (rollout.pipelined_host_rollout); 1 = serial",
+    )
     p.add_argument("--log-jsonl", help="append per-iteration stats here")
     p.add_argument("--checkpoint-dir")
     p.add_argument("--checkpoint-every", type=int)
@@ -115,6 +122,7 @@ _OVERRIDES = {
     "reward_target": "reward_target",
     "fuse_iterations": "fuse_iterations",
     "fvp_subsample": "fvp_subsample",
+    "host_pipeline_groups": "host_pipeline_groups",
     "log_jsonl": "log_jsonl",
     "checkpoint_dir": "checkpoint_dir",
     "checkpoint_every": "checkpoint_every",
