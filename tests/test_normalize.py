@@ -165,6 +165,43 @@ def test_unroutable_host_env_rejects_normalization():
     assert np.isfinite(float(stats["entropy"]))
 
 
+def test_native_env_normalizes_on_host():
+    """native: envs share the SAME ObsNormMixin machinery as gym: envs —
+    running statistics in the adapter, mirrored into TrainState, obs
+    visibly standardized."""
+    from trpo_tpu.envs import native
+
+    if not native.native_available():
+        pytest.skip("native env library unavailable")
+
+    agent = TRPOAgent(
+        "native:cartpole",
+        TRPOConfig(env="native:cartpole", n_envs=4, batch_timesteps=64,
+                   cg_iters=3, vf_train_steps=3, policy_hidden=(16,),
+                   normalize_obs=True),
+    )
+    state = agent.init_state(0)
+    assert state.obs_norm is not None
+    c0 = float(state.obs_norm.count)
+    state, stats = agent.run_iteration(state)
+    assert np.isfinite(float(stats["entropy"]))
+    assert float(state.obs_norm.count) > c0
+    count, mean, m2 = agent.env.obs_stats_state()
+    np.testing.assert_allclose(
+        np.asarray(state.obs_norm.mean), mean, rtol=1e-6
+    )
+    # pipelined group stepping folds the same shared statistics
+    agent_p = TRPOAgent(
+        "native:cartpole",
+        TRPOConfig(env="native:cartpole", n_envs=4, batch_timesteps=64,
+                   cg_iters=3, vf_train_steps=3, policy_hidden=(16,),
+                   normalize_obs=True, host_pipeline_groups=2),
+    )
+    sp, stp = agent_p.run_iteration(agent_p.init_state(0))
+    assert np.isfinite(float(stp["entropy"]))
+    assert float(sp.obs_norm.count) > 4.0
+
+
 def test_checkpoint_roundtrips_stats(tmp_path):
     from trpo_tpu.utils.checkpoint import Checkpointer
 

@@ -162,6 +162,33 @@ def test_agent_pipelined_host_training():
     assert int(stats["episodes_in_batch"]) > 0
 
 
+def test_pipelined_normalized_rollout_is_reproducible():
+    """With shared obs-normalization, the pipelined rollout defers folds
+    and normalizes under window-start statistics — two identically-seeded
+    runs must agree bitwise despite thread scheduling."""
+    def run():
+        env = native.NativeVecEnv(
+            "cartpole", n_envs=6, seed=11, max_episode_steps=10,
+            normalize_obs=True,
+        )
+        policy = _policy_for(env)
+        params = policy.init(jax.random.key(0))
+        traj = pipelined_host_rollout(
+            env, policy, params, jax.random.key(3), 25, n_groups=3
+        )
+        return traj, env.obs_stats_state()
+
+    t1, s1 = run()
+    t2, s2 = run()
+    for name, a in _traj_arrays(t1).items():
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(_traj_arrays(t2)[name]), err_msg=name
+        )
+    for a, b in zip(s1, s2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(s1[0]) == 6 + 25 * 6  # initial reset + T*N folded
+
+
 def test_packed_act_fn_matches_unpacked():
     """Transfer packing (one fetched array instead of actions + one per
     dist leaf) must be value-exact for both policy families."""

@@ -79,10 +79,11 @@ class TRPOAgent:
                 if env.startswith(("gym:", "native:"))
                 else {}
             )
-            if cfg.normalize_obs and env.startswith("gym:"):
+            if cfg.normalize_obs and env.startswith(("gym:", "native:")):
                 # host analogue of the device-side running normalization:
                 # ONE shared running-stats object inside the adapter
-                # (envs/gym_adapter.py), mirrored into TrainState below
+                # (envs/obs_norm.py, shared by the gymnasium and native
+                # adapters), mirrored into TrainState below
                 kwargs["normalize_obs"] = True
                 host_normalized = True
             # cfg.max_pathlength=None keeps the env's default horizon;
@@ -138,10 +139,11 @@ class TRPOAgent:
         ):
             raise NotImplementedError(
                 "normalize_obs supports pure-JAX device envs (fused running "
-                'statistics) and GymVecEnv ("gym:<Id>" names construct it '
-                "with normalize_obs=True automatically; pre-constructed "
-                "adapters must pass it themselves); native: host envs have "
-                "no normalization hook"
+                "statistics) and the host adapters (GymVecEnv/NativeVecEnv "
+                '— "gym:<Id>"/"native:<kind>" names construct them with '
+                "normalize_obs=True automatically; pre-constructed adapters "
+                "must pass it themselves); this host env has no "
+                "normalization hook"
             )
         obs_dim = int(math.prod(obs_shape))
         if self.is_recurrent:
@@ -820,10 +822,12 @@ class TRPOAgent:
                     )
                 else:
                     if self._host_eval_act_fn is None:
-                        # reuse the jitted act path (argmax/mode branch)
-                        self._host_eval_act_fn = lambda p, o, k: self._act_fn(
-                            p, o, k, True
-                        )[:2]
+                        # packed transfers (one fetch per step), mode branch
+                        from trpo_tpu.rollout import make_host_act_fn
+
+                        self._host_eval_act_fn = make_host_act_fn(
+                            self.policy, deterministic=True
+                        )
                     traj = host_rollout(
                         self.env, self.policy, train_state.policy_params,
                         k_roll, n_steps, act_fn=self._host_eval_act_fn,

@@ -14,17 +14,16 @@ raise at construction — callers gate on availability (see
 
 from __future__ import annotations
 
-import threading
-
 import numpy as np
 
 from trpo_tpu.envs.episode_stats import EpisodeStatsMixin
+from trpo_tpu.envs.obs_norm import ObsNormMixin
 from trpo_tpu.models.policy import BoxSpec, DiscreteSpec
 
 __all__ = ["GymVecEnv"]
 
 
-class GymVecEnv(EpisodeStatsMixin):
+class GymVecEnv(EpisodeStatsMixin, ObsNormMixin):
     """N synchronous gymnasium envs with explicit pre-reset final obs."""
 
     def __init__(self, env_id: str, n_envs: int = 8, seed: int = 0,
@@ -52,20 +51,10 @@ class GymVecEnv(EpisodeStatsMixin):
             self._act_low = np.asarray(space.low, np.float32)
             self._act_high = np.asarray(space.high, np.float32)
 
-        # Shared running obs normalization (ONE statistics object across all
-        # envs — the host analogue of the device path's fused RunningStats,
-        # utils/normalize.py). The agent mirrors these into TrainState every
-        # iteration so checkpoints carry them, and freezes them during
-        # evaluation.
-        self.has_obs_norm = bool(normalize_obs)
-        self._norm_frozen = False
-        # group-stepping threads (pipelined rollout) share these statistics;
-        # the lock keeps the read-modify-write merge atomic per fold
-        self._norm_lock = threading.Lock()
-        if self.has_obs_norm:
-            self._n_count = 0.0
-            self._n_mean = np.zeros(self.obs_shape, np.float64)
-            self._n_m2 = np.zeros(self.obs_shape, np.float64)
+        # Shared running obs normalization (ONE statistics object across
+        # all envs): ObsNormMixin — the host analogue of the device path's
+        # fused RunningStats (utils/normalize.py), shared with NativeVecEnv.
+        self._init_obs_norm(self.obs_shape, normalize_obs)
 
         self._obs = self._fold_and_normalize(
             np.stack(
@@ -76,93 +65,6 @@ class GymVecEnv(EpisodeStatsMixin):
             )
         )
         self._init_episode_stats(n_envs)
-
-    # -- shared running obs normalization ---------------------------------
-
-    def _fold(self, obs_batch: np.ndarray) -> None:
-        """Chan/Welford-merge a raw batch into the shared statistics — the
-        same math as ``utils/normalize.update_stats``."""
-        b = np.asarray(obs_batch, np.float64)
-        n_b = float(b.shape[0])
-        mean_b = b.mean(axis=0)
-        m2_b = ((b - mean_b) ** 2).sum(axis=0)
-        delta = mean_b - self._n_mean
-        tot = self._n_count + n_b
-        self._n_mean = self._n_mean + delta * (n_b / tot)
-        self._n_m2 = self._n_m2 + m2_b + delta**2 * (
-            self._n_count * n_b / tot
-        )
-        self._n_count = tot
-
-    def _fold_and_normalize(self, obs_batch: np.ndarray) -> np.ndarray:
-        """Fold a raw ``(N, *obs)`` batch into the shared statistics (unless
-        frozen) and return it normalized."""
-        if not self.has_obs_norm:
-            return obs_batch
-        # keep the raw batch: installing restored statistics later must be
-        # able to re-normalize the cached current obs (set_obs_stats_state)
-        self._raw_obs = np.asarray(obs_batch).copy()
-        if not self._norm_frozen:
-            self._fold(obs_batch)
-        return self._apply_norm(obs_batch)
-
-    def _fold_and_normalize_slice(
-        self, obs_batch: np.ndarray, lo: int, hi: int, extra=None
-    ):
-        """Slice variant for group stepping: raw rows ``[lo, hi)`` replace
-        their cache entries, the slice folds into the SAME shared statistics
-        (one fold per group step instead of per full step — the merge is
-        associative, so the statistics converge identically), and the slice
-        comes back normalized under the statistics as of now. ``extra`` (the
-        truncation-bootstrap ``final_obs``) is normalized under the SAME
-        statistics snapshot, inside the same lock hold — a concurrent group
-        thread's fold must never be observed mid-update."""
-        if not self.has_obs_norm:
-            return obs_batch if extra is None else (obs_batch, extra)
-        self._raw_obs[lo:hi] = obs_batch
-        with self._norm_lock:
-            if not self._norm_frozen:
-                self._fold(obs_batch)
-            normed = self._apply_norm(obs_batch)
-            if extra is None:
-                return normed
-            return normed, self._apply_norm(extra)
-
-    def _apply_norm(self, obs: np.ndarray) -> np.ndarray:
-        if not self.has_obs_norm or self._n_count == 0.0:
-            return obs
-        var = self._n_m2 / max(self._n_count, 1.0)
-        std = np.sqrt(var + 1e-8)
-        return np.clip(
-            (obs - self._n_mean) / std, -10.0, 10.0
-        ).astype(np.float32)
-
-    def obs_stats_state(self):
-        """(count, mean, m2) float32 arrays — the checkpointable mirror."""
-        if not self.has_obs_norm:
-            return None
-        return (
-            np.float32(self._n_count),
-            self._n_mean.astype(np.float32),
-            self._n_m2.astype(np.float32),
-        )
-
-    def set_obs_stats_state(self, state) -> None:
-        """Install (count, mean, m2) — e.g. restored from a checkpoint.
-
-        The cached current observations are re-normalized under the new
-        statistics so the next rollout's first step is consistent with the
-        rest of its batch."""
-        count, mean, m2 = state
-        self._n_count = float(count)
-        self._n_mean = np.asarray(mean, np.float64)
-        self._n_m2 = np.asarray(m2, np.float64)
-        self._obs = self._apply_norm(self._raw_obs)
-
-    def freeze_obs_stats(self, frozen: bool = True) -> None:
-        """Stop/resume folding new data in (evaluation must not shift the
-        training statistics)."""
-        self._norm_frozen = frozen
 
     def host_step(self, actions: np.ndarray):
         """Step all envs; auto-reset finished ones.

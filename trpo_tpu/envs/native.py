@@ -27,6 +27,7 @@ from typing import Optional
 import numpy as np
 
 from trpo_tpu.envs.episode_stats import EpisodeStatsMixin
+from trpo_tpu.envs.obs_norm import ObsNormMixin
 from trpo_tpu.models.policy import BoxSpec, DiscreteSpec
 
 __all__ = ["NativeVecEnv", "native_available", "load_library"]
@@ -134,7 +135,7 @@ _KINDS = {
 }
 
 
-class NativeVecEnv(EpisodeStatsMixin):
+class NativeVecEnv(EpisodeStatsMixin, ObsNormMixin):
     """N batched native envs behind the ``GymVecEnv`` host interface."""
 
     def __init__(
@@ -143,6 +144,7 @@ class NativeVecEnv(EpisodeStatsMixin):
         n_envs: int = 8,
         seed: int = 0,
         max_episode_steps: Optional[int] = None,
+        normalize_obs: bool = False,
     ):
         if kind not in _KINDS:
             raise KeyError(f"unknown native env {kind!r}; have {sorted(_KINDS)}")
@@ -166,7 +168,10 @@ class NativeVecEnv(EpisodeStatsMixin):
         self._reset = getattr(self._lib, f"trpo_native_{kind}_reset")
         self._step = getattr(self._lib, f"trpo_native_{kind}_step")
         self._reset(self._state, self._t, self._rng, n)
-        self._obs = self._observe()
+        # Shared running obs normalization (ObsNormMixin) — same machinery
+        # as GymVecEnv, so native: envs support normalize_obs identically.
+        self._init_obs_norm(self.obs_shape, normalize_obs)
+        self._obs = self._fold_and_normalize(self._observe())
 
         self._init_episode_stats(n)
 
@@ -216,6 +221,11 @@ class NativeVecEnv(EpisodeStatsMixin):
             rewards, np.logical_or(terminated, truncated), lo, hi
         )
 
+        # shared-stats fold (no-op unless normalize_obs); final_obs
+        # normalized under the same statistics snapshot, same lock hold
+        next_obs, final_obs = self._fold_and_normalize_slice(
+            next_obs, lo, hi, extra=final_obs
+        )
         self._obs[lo:hi] = next_obs
         return next_obs, rewards, terminated, truncated, final_obs
 
@@ -231,7 +241,7 @@ class NativeVecEnv(EpisodeStatsMixin):
                 self._rng, self.n_envs, np.uint64(seed)
             )
         self._reset(self._state, self._t, self._rng, self.n_envs)
-        self._obs = self._observe()
+        self._obs = self._fold_and_normalize(self._observe())
         self._running_returns[:] = 0.0
         self._running_lengths[:] = 0
         # a copy: group stepping updates the cache in place

@@ -1,0 +1,169 @@
+"""Shared running observation normalization for host vectorized envs.
+
+One statistics object per adapter, folded with the Chan/Welford merge (the
+same math as the device path's ``utils/normalize.py``), shared by ALL envs
+in the adapter and by both host adapter families (``GymVecEnv``,
+``NativeVecEnv``) through this mixin. The agent mirrors the statistics into
+``TrainState`` every iteration so checkpoints carry them, re-seeds them on
+restore (``set_obs_stats_state``), and freezes folding during evaluation.
+
+Thread-safety: group-stepping threads (``rollout.pipelined_host_rollout``)
+fold concurrently — the read-modify-write merge and every normalization
+read happen under one lock, so a fold is never observed mid-update.
+
+The reference has no normalization at all (observations feed the policy
+raw, ``trpo_inksci.py:77``); this is standard equipment for the MuJoCo-
+scale rungs of ``BASELINE.json``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["ObsNormMixin"]
+
+
+class ObsNormMixin:
+    """Call ``_init_obs_norm(obs_shape, enabled)`` in ``__init__`` (before
+    producing the first observation batch), then route every outgoing
+    observation batch through ``_fold_and_normalize`` /
+    ``_fold_and_normalize_slice``."""
+
+    def _init_obs_norm(self, obs_shape, enabled: bool) -> None:
+        self.has_obs_norm = bool(enabled)
+        self._norm_frozen = False
+        # group-stepping threads share these statistics; the lock keeps the
+        # read-modify-write merge atomic per fold
+        self._norm_lock = threading.Lock()
+        self._deferred = None  # begin_deferred_fold() buffers per group
+        if self.has_obs_norm:
+            self._n_count = 0.0
+            self._n_mean = np.zeros(obs_shape, np.float64)
+            self._n_m2 = np.zeros(obs_shape, np.float64)
+
+    # -- folding ----------------------------------------------------------
+
+    def _fold(self, obs_batch: np.ndarray) -> None:
+        """Chan/Welford-merge a raw batch into the shared statistics — the
+        same math as ``utils/normalize.update_stats``. Caller holds the
+        lock."""
+        b = np.asarray(obs_batch, np.float64)
+        n_b = float(b.shape[0])
+        mean_b = b.mean(axis=0)
+        m2_b = ((b - mean_b) ** 2).sum(axis=0)
+        delta = mean_b - self._n_mean
+        tot = self._n_count + n_b
+        self._n_mean = self._n_mean + delta * (n_b / tot)
+        self._n_m2 = self._n_m2 + m2_b + delta**2 * (
+            self._n_count * n_b / tot
+        )
+        self._n_count = tot
+
+    def _apply_norm(self, obs: np.ndarray) -> np.ndarray:
+        """Normalize under the current statistics (lock held by caller on
+        concurrent paths)."""
+        if not self.has_obs_norm or self._n_count == 0.0:
+            return obs
+        var = self._n_m2 / max(self._n_count, 1.0)
+        std = np.sqrt(var + 1e-8)
+        return np.clip(
+            (obs - self._n_mean) / std, -10.0, 10.0
+        ).astype(np.float32)
+
+    def _fold_and_normalize(self, obs_batch: np.ndarray) -> np.ndarray:
+        """Fold a full raw ``(N, *obs)`` batch (unless frozen) and return it
+        normalized."""
+        if not self.has_obs_norm:
+            return obs_batch
+        # keep the raw batch: installing restored statistics later must be
+        # able to re-normalize the cached current obs (set_obs_stats_state)
+        self._raw_obs = np.asarray(obs_batch).copy()
+        with self._norm_lock:
+            if not self._norm_frozen:
+                self._fold(obs_batch)
+            return self._apply_norm(obs_batch)
+
+    def _fold_and_normalize_slice(
+        self, obs_batch: np.ndarray, lo: int, hi: int, extra=None
+    ):
+        """Slice variant for group stepping: raw rows ``[lo, hi)`` replace
+        their cache entries, the slice folds into the SAME shared statistics
+        (one fold per group step instead of per full step — the merge is
+        associative, so the statistics converge identically), and the slice
+        comes back normalized under the statistics as of now. ``extra`` (the
+        truncation-bootstrap ``final_obs``) is normalized under the SAME
+        statistics snapshot, inside the same lock hold — a concurrent group
+        thread's fold must never be observed mid-update."""
+        if not self.has_obs_norm:
+            return obs_batch if extra is None else (obs_batch, extra)
+        self._raw_obs[lo:hi] = obs_batch
+        with self._norm_lock:
+            if self._deferred is not None:
+                # deferred mode: buffer the raw batch (freshly allocated by
+                # the caller — safe to keep by reference) and normalize
+                # under the window-start statistics
+                self._deferred.setdefault(lo, []).append(obs_batch)
+            elif not self._norm_frozen:
+                self._fold(obs_batch)
+            normed = self._apply_norm(obs_batch)
+            if extra is None:
+                return normed
+            return normed, self._apply_norm(extra)
+
+    # -- deferred folding (pipelined rollouts) -----------------------------
+
+    def begin_deferred_fold(self) -> None:
+        """Enter deferred mode: every subsequent slice fold is buffered and
+        the whole window normalizes under the statistics as of NOW — the
+        host analogue of the device path's start-of-iteration statistics.
+        :func:`end_deferred_fold` merges the buffers in deterministic group
+        order, so a threaded (scheduler-nondeterministic) rollout produces
+        bit-reproducible statistics and observations for a fixed seed."""
+        if not self.has_obs_norm:
+            return
+        with self._norm_lock:
+            self._deferred = {}
+
+    def end_deferred_fold(self) -> None:
+        """Leave deferred mode, merging the buffered raw batches in (group,
+        arrival) order — independent of thread scheduling."""
+        if not self.has_obs_norm:
+            return
+        with self._norm_lock:
+            deferred, self._deferred = self._deferred, None
+            if deferred and not self._norm_frozen:
+                for lo in sorted(deferred):
+                    for batch in deferred[lo]:
+                        self._fold(batch)
+
+    # -- checkpoint mirror / control --------------------------------------
+
+    def obs_stats_state(self):
+        """(count, mean, m2) float32 arrays — the checkpointable mirror."""
+        if not self.has_obs_norm:
+            return None
+        return (
+            np.float32(self._n_count),
+            self._n_mean.astype(np.float32),
+            self._n_m2.astype(np.float32),
+        )
+
+    def set_obs_stats_state(self, state) -> None:
+        """Install (count, mean, m2) — e.g. restored from a checkpoint.
+
+        The cached current observations are re-normalized under the new
+        statistics so the next rollout's first step is consistent with the
+        rest of its batch."""
+        count, mean, m2 = state
+        with self._norm_lock:
+            self._n_count = float(count)
+            self._n_mean = np.asarray(mean, np.float64)
+            self._n_m2 = np.asarray(m2, np.float64)
+            self._obs = self._apply_norm(self._raw_obs)
+
+    def freeze_obs_stats(self, frozen: bool = True) -> None:
+        """Stop/resume folding new data in (evaluation must not shift the
+        training statistics)."""
+        self._norm_frozen = frozen

@@ -443,10 +443,12 @@ def pipelined_host_rollout(
     bit-identical to the serial rollout — group chains are independent, so
     thread scheduling cannot change values. With sampling the per-group
     PRNG keys necessarily differ from the serial batch key. With shared
-    obs-normalization the fold order across groups is scheduler-dependent:
-    statistics converge to the same limit (associative merge under a lock),
-    and each recorded observation is exactly what the policy saw —
-    internally consistent, which is what the replay requires. Feedforward
+    obs-normalization the window runs in the adapter's DEFERRED mode: every
+    observation normalizes under the window-start statistics (the host
+    analogue of the device path's start-of-iteration stats) and the raw
+    batches merge in deterministic group order afterwards — so a fixed seed
+    reproduces bitwise despite thread scheduling, and each recorded
+    observation is exactly what the policy saw. Feedforward
     policies only: a recurrent policy's hidden state is carried strictly in
     step order per env, which the pipeline preserves, but the window-replay
     bookkeeping is not wired here — use :func:`host_rollout`.
@@ -518,10 +520,20 @@ def pipelined_host_rollout(
 
     import concurrent.futures
 
-    with concurrent.futures.ThreadPoolExecutor(n_groups) as pool:
-        futures = [pool.submit(run_group, g) for g in range(n_groups)]
-        for f in futures:
-            f.result()  # re-raises any group's exception
+    # shared-normalization adapters: normalize the window under start-of-
+    # window statistics, merge folds deterministically at the end (see
+    # ObsNormMixin.begin_deferred_fold — scheduler-independent results)
+    deferred = hasattr(vec_env, "begin_deferred_fold")
+    if deferred:
+        vec_env.begin_deferred_fold()
+    try:
+        with concurrent.futures.ThreadPoolExecutor(n_groups) as pool:
+            futures = [pool.submit(run_group, g) for g in range(n_groups)]
+            for f in futures:
+                f.result()  # re-raises any group's exception
+    finally:
+        if deferred:
+            vec_env.end_deferred_fold()
 
     # (T, m_g, ...) per group → (T, N, ...) by env-axis concatenation
     cat = lambda k: jnp.asarray(
