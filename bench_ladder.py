@@ -31,8 +31,8 @@ import numpy as np  # noqa: E402
 from trpo_tpu.agent import TRPOAgent  # noqa: E402
 from trpo_tpu.config import get_preset  # noqa: E402
 
-# (preset, K iterations, overrides) — device-env rungs only: the ladder
-# times the fused on-device pipeline; gym:/MuJoCo binaries are external.
+# (preset, K iterations, overrides) — device-env rungs: the ladder times
+# the fused on-device pipeline.
 RUNGS = {
     "cartpole": (20, {}),
     "cartpole-po": (20, {}),          # recurrent/POMDP rung
@@ -41,6 +41,27 @@ RUNGS = {
     "halfcheetah-sim": (10, {}),
     "humanoid-sim": (3, {}),          # batch 50k — the north-star shape
 }
+
+# Host-simulator rungs: env stepping on the host (real MuJoCo via
+# gymnasium), policy inference on the device through the packed act path
+# (rollout.make_host_act_fn(pack=True) — one fetch per step). Iteration =
+# host rollout + the same fused GAE/critic/update program. Gated on the
+# simulator being importable. Batch reduced vs the preset: per-step host
+# latency through a tunneled TPU is RTT-bound, and the rung exists to
+# record the steady-state env-steps/s of the host boundary, which is
+# batch-size independent.
+HOST_RUNGS = {
+    "halfcheetah-host": (
+        "halfcheetah", 2, {"batch_timesteps": 1000},
+        ("gymnasium", "mujoco"),
+    ),
+}
+
+
+def _missing(module: str) -> bool:
+    import importlib.util
+
+    return importlib.util.find_spec(module) is None
 
 
 def bench_rung(name: str, k: int, overrides: dict, reps: int = 3):
@@ -77,15 +98,59 @@ def bench_rung(name: str, k: int, overrides: dict, reps: int = 3):
     }
 
 
+def bench_host_rung(name: str, preset: str, iters: int, overrides: dict):
+    cfg = get_preset(preset).replace(**overrides)
+    agent = TRPOAgent(cfg.env, cfg)
+    state = agent.init_state(seed=0)
+    steps_per_iter = agent.n_steps * cfg.n_envs
+
+    t0 = time.perf_counter()
+    state, stats = agent.run_iteration(state)           # compile + warm
+    float(np.asarray(stats["entropy"]))
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, stats = agent.run_iteration(state)
+        float(np.asarray(stats["entropy"]))
+    per_iter = (time.perf_counter() - t0) / iters
+    assert np.isfinite(float(np.asarray(stats["entropy"])))
+    return {
+        "rung": name,
+        "n_envs": cfg.n_envs,
+        "batch_timesteps": steps_per_iter,
+        "updates_per_sec": 1.0 / per_iter,
+        "env_steps_per_sec": steps_per_iter / per_iter,
+        "iter_ms": per_iter * 1e3,
+        "compile_s": compile_s,
+        "backend": jax.devices()[0].platform + "+host-sim",
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rungs", default=",".join(RUNGS))
+    ap.add_argument(
+        "--rungs", default=",".join(list(RUNGS) + list(HOST_RUNGS))
+    )
     ap.add_argument("--out", default=None, help="write a markdown table")
     args = ap.parse_args()
 
     rows = []
     for name in args.rungs.split(","):
         name = name.strip()
+        if name in HOST_RUNGS:
+            preset, iters, overrides, needs = HOST_RUNGS[name]
+            missing = [m for m in needs if _missing(m)]
+            if missing:
+                print(
+                    f"ladder: {name} skipped (no {', '.join(missing)})",
+                    file=sys.stderr,
+                )
+                continue
+            print(f"ladder: {name} (host sim) ...", file=sys.stderr)
+            rows.append(bench_host_rung(name, preset, iters, overrides))
+            print(json.dumps(rows[-1]))
+            continue
         k, overrides = RUNGS[name]
         print(f"ladder: {name} ...", file=sys.stderr)
         rows.append(bench_rung(name, k, overrides))
@@ -102,6 +167,16 @@ def main():
                 f"| {r['iter_ms']:.1f} | {r['updates_per_sec']:.1f} "
                 f"| {r['env_steps_per_sec']:,.0f} |"
             )
+        note = ""
+        if any(r["backend"].endswith("host-sim") for r in rows):
+            note = (
+                "\n`*-host` rungs step a REAL external simulator (MuJoCo "
+                "via gymnasium) on the host with device inference through "
+                "the packed act path (one fetch per step, each a full "
+                f"device round trip — measured {_device_rtt() * 1e3:.0f} ms "
+                "here); they measure the host boundary, not device "
+                "compute.\n"
+            )
         with open(args.out, "w") as f:
             f.write(
                 "# Ladder throughput — full fused training iterations "
@@ -109,7 +184,8 @@ def main():
                 "One iteration = rollout + GAE + critic fit + TRPO "
                 "natural-gradient update, K iterations scanned into one "
                 "device program (`TRPOAgent.run_iterations`); RTT-corrected "
-                "timing (see `bench.py`).\n\n" + "\n".join(lines) + "\n"
+                "timing (see `bench.py`).\n\n"
+                + "\n".join(lines) + "\n" + note
             )
 
 

@@ -1,0 +1,70 @@
+"""Host-simulator training: real MuJoCo on the host, everything else fused
+on the device.
+
+The reference drives ONE host gym env with one ``sess.run`` per step
+(reference ``utils.py:18-45`` + ``trpo_inksci.py:76-87``). This example is
+the same workload at the framework's operating point for external
+simulators (the BASELINE HalfCheetah/Humanoid rungs):
+
+- N vectorized MuJoCo envs behind ``GymVecEnv`` (gymnasium), with shared
+  running observation normalization (``envs/obs_norm.py``);
+- policy inference batched over all envs and fetched as ONE packed array
+  per step (``rollout.make_host_act_fn(pack=True)`` — 3× on a
+  high-latency device link);
+- optionally, the envs split into groups whose host stepping overlaps the
+  other groups' device round trips (``host_pipeline_groups`` — wins on
+  multicore hosts);
+- GAE, the critic fit, and the fused natural-gradient update as one jitted
+  device program per iteration (the same program device envs use).
+
+Run:  python examples/mujoco_host.py            # needs gymnasium + mujoco
+      python examples/mujoco_host.py --pipeline 4
+"""
+
+import argparse
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import jax
+
+# This machine routes JAX to a TPU by default; the example is sized for
+# CPU so it runs anywhere. Delete this line to train on the accelerator.
+jax.config.update("jax_platforms", "cpu")
+
+from trpo_tpu.agent import TRPOAgent          # noqa: E402
+from trpo_tpu.config import get_preset        # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--env", default="gym:HalfCheetah-v4")
+    ap.add_argument("--iterations", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=2000)
+    ap.add_argument(
+        "--pipeline", type=int, default=1,
+        help="host_pipeline_groups: >1 overlaps env stepping with device "
+        "inference (multicore hosts)",
+    )
+    args = ap.parse_args()
+
+    cfg = get_preset("halfcheetah").replace(
+        env=args.env,
+        n_iterations=args.iterations,
+        batch_timesteps=args.batch,
+        normalize_obs=True,              # standard for MuJoCo-scale TRPO
+        host_pipeline_groups=args.pipeline,
+    )
+    agent = TRPOAgent(cfg.env, cfg)
+    state = agent.learn()
+    mean_ret, n_done = agent.evaluate(state, n_steps=250)
+    tag = f"over {n_done} episodes" if n_done else "(partial episode)"
+    print(
+        f"finished at iteration {int(state.iteration)}; "
+        f"greedy eval return {mean_ret:.1f} {tag}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,5 @@
-"""Recurrent (GRU) policies: cell semantics, window replay, TRPO update,
-full agent integration on the partially observable CartPole."""
+"""Recurrent (GRU/LSTM) policies: cell semantics, window replay, TRPO
+update, full agent integration on the partially observable CartPole."""
 
 import jax
 import jax.numpy as jnp
@@ -26,13 +26,14 @@ def _window(key, policy, resets=None):
     obs = jax.random.normal(k_obs, (T, N) + OBS, jnp.float32)
     if resets is None:
         resets = jnp.zeros((T, N), bool).at[0].set(True)
-    h0 = jnp.zeros((N, policy.hidden_size), jnp.float32)
+    h0 = jnp.zeros((N, policy.state_size), jnp.float32)
     return SeqObs(obs, resets, h0)
 
 
-def test_apply_matches_scan_of_step():
+@pytest.mark.parametrize("cell", ["gru", "lstm"])
+def test_apply_matches_scan_of_step(cell):
     """Window replay ≡ stepping the single-step interface manually."""
-    policy = _policy()
+    policy = _policy(cell=cell)
     params = policy.init(jax.random.key(0))
     seq = _window(jax.random.key(1), policy)
 
@@ -49,10 +50,11 @@ def test_apply_matches_scan_of_step():
     )
 
 
-def test_reset_isolates_episodes():
+@pytest.mark.parametrize("cell", ["gru", "lstm"])
+def test_reset_isolates_episodes(cell):
     """A mid-window reset makes the suffix identical to a fresh window —
     and without the reset the suffix differs (memory is real)."""
-    policy = _policy()
+    policy = _policy(cell=cell)
     params = policy.init(jax.random.key(0))
     seq = _window(jax.random.key(1), policy)
     cut = T // 2
@@ -149,6 +151,50 @@ def test_agent_integration_pomdp():
     assert not np.allclose(np.asarray(h_before), np.asarray(h_after))
     # reset bookkeeping made it into the update path
     assert state.env_carry[5].shape == (4,)
+
+
+def test_agent_integration_pomdp_lstm():
+    """The LSTM cell drives the SAME machinery: packed [h|c] state in the
+    rollout carry (width 2H), the critic conditions on the full state, and
+    the fused update runs with finite stats. End to end it learns."""
+    agent = _agent(policy_cell="lstm", env="cartpole-po")
+    state = agent.init_state(0)
+    packed = state.env_carry[4]
+    assert packed.shape == (4, 16)  # 2H for gru_size=8
+    assert agent.policy.state_size == 16
+    # forget-gate bias init
+    b = np.asarray(state.policy_params["lstm"]["b"])
+    assert b.shape == (32,) and np.all(b[8:16] == 1.0) and np.all(b[:8] == 0)
+    state, stats = agent.run_iteration(state)
+    state, stats = agent.run_iteration(state)
+    assert np.isfinite(float(stats["entropy"]))
+    assert np.isfinite(float(stats["surrogate_loss"]))
+    # critic input layer sized obs + 2H
+    w0 = state.vf_state.params["layers"][0]["w"]
+    assert w0.shape[0] == 2 + 16
+
+
+def test_lstm_learns_memory_task():
+    """Masked CartPole needs velocity estimation from memory: the LSTM
+    policy's mean episode length must grow over training."""
+    agent = _agent(
+        policy_cell="lstm",
+        batch_timesteps=1000,
+        n_envs=8,
+        cg_iters=10,
+        vf_train_steps=25,
+        gamma=0.99,
+        lam=0.95,
+    )
+    state = agent.init_state(0)
+    first = None
+    for _ in range(12):
+        state, stats = agent.run_iterations(state, 1)
+        r = float(np.asarray(stats["mean_episode_reward"])[-1])
+        if first is None and np.isfinite(r):
+            first = r
+    last = float(np.asarray(stats["mean_episode_reward"])[-1])
+    assert first is not None and last > 1.5 * first
 
 
 def test_recurrent_critic_sees_hidden_state():

@@ -112,6 +112,7 @@ class TRPOAgent:
                 activation=cfg.policy_activation,
                 init_log_std=cfg.init_log_std,
                 compute_dtype=compute_dtype,
+                cell=cfg.policy_cell,
             )
         else:
             self.policy = make_policy(
@@ -147,12 +148,13 @@ class TRPOAgent:
             )
         obs_dim = int(math.prod(obs_shape))
         if self.is_recurrent:
-            # POMDP critic: condition the value on the policy's GRU state
-            # as well — [obs, h] features, the TPU analogue of the
-            # reference VF's [obs, action_dist, t] inputs (utils.py:70-77).
-            # A memoryless critic over masked observations would alias
-            # states and bias the GAE targets.
-            obs_dim += cfg.policy_gru
+            # POMDP critic: condition the value on the policy's recurrent
+            # state as well — [obs, state] features, the TPU analogue of
+            # the reference VF's [obs, action_dist, t] inputs
+            # (utils.py:70-77). A memoryless critic over masked
+            # observations would alias states and bias the GAE targets.
+            # state_size: H for GRU, 2H for LSTM (packed [h|c]).
+            obs_dim += self.policy.state_size
         self.vf = create_value_function(
             obs_dim,
             hidden=tuple(cfg.vf_hidden),

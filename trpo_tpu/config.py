@@ -53,12 +53,15 @@ class TRPOConfig:
     # --- networks --------------------------------------------------------
     policy_hidden: Tuple[int, ...] = (64,)   # ref: one 64-tanh layer (trpo_inksci.py:39)
     policy_activation: str = "tanh"
-    policy_gru: Optional[int] = None  # GRU hidden size → recurrent policy
-    #                                (models/recurrent.py; POMDPs), over
-    #                                device AND host-simulator envs. No
-    #                                reference analogue (its prev_action
-    #                                buffer was vestigial,
+    policy_gru: Optional[int] = None  # recurrent-cell hidden size →
+    #                                recurrent policy (models/recurrent.py;
+    #                                POMDPs), over device AND host-simulator
+    #                                envs. No reference analogue (its
+    #                                prev_action buffer was vestigial,
     #                                trpo_inksci.py:31,85-86)
+    policy_cell: str = "gru"       # recurrence type: "gru" or "lstm"
+    #                                (packed [h|c] state); only read when
+    #                                policy_gru is set
     vf_hidden: Tuple[int, ...] = (64, 64)    # ref critic: 64-relu × 2 (utils.py:59-61)
     vf_activation: str = "relu"
     vf_train_steps: int = 50       # ref: 50 full-batch Adam steps (utils.py:84)

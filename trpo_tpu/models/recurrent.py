@@ -1,11 +1,19 @@
-"""Recurrent (GRU) policies for partially observable tasks.
+"""Recurrent (GRU / LSTM) policies for partially observable tasks.
 
 The reference has no recurrence — its only nod to history is a vestigial
 ``prev_action`` one-hot buffer that is maintained but never fed to the
 network (``trpo_inksci.py:31,85-86``, a leftover from its ancestor repo).
-This module supplies the real capability: a GRU layer between the MLP torso
-and the distribution head, so the policy can integrate observations over
-time (POMDPs: masked velocities, flickering pixels, memory tasks).
+This module supplies the real capability: a recurrent cell (GRU or LSTM)
+between the MLP torso and the distribution head, so the policy can
+integrate observations over time (POMDPs: masked velocities, flickering
+pixels, memory tasks).
+
+Both cells share one external contract: the recurrent state is ONE
+``(N, state_size)`` array (``state_size = H`` for GRU; ``2H`` for LSTM,
+``[h | c]`` packed along the feature axis). Packing keeps every consumer —
+the rollout scan's carry, episode-boundary zeroing, the trajectory's
+``policy_h`` tensors, the POMDP critic's ``[obs, state]`` features,
+checkpointing, mesh sharding — cell-agnostic.
 
 TPU-first design notes:
 
@@ -46,6 +54,8 @@ __all__ = [
     "RecurrentPolicy",
     "init_gru",
     "gru_step",
+    "init_lstm",
+    "lstm_step",
     "make_recurrent_policy",
 ]
 
@@ -69,9 +79,11 @@ class RecurrentPolicy(NamedTuple):
     apply: Callable[[Any, SeqObs], Any]
     dist: Any
     action_spec: Any
-    initial_state: Callable[[int], jax.Array]       # n_envs -> (N, H) zeros
+    initial_state: Callable[[int], jax.Array]  # n_envs -> (N, state) zeros
     step: Callable[[Any, jax.Array, jax.Array], Tuple[jax.Array, Any]]
-    hidden_size: int
+    hidden_size: int     # the cell's H
+    state_size: int = 0  # carried-state width: H (GRU) or 2H (LSTM [h|c]);
+    #                      0 is a pre-state_size default, see make_*
 
 
 def init_gru(key, in_dim: int, hidden: int):
@@ -125,6 +137,64 @@ def gru_step(params, h, x, compute_dtype=jnp.float32):
     )
 
 
+def init_lstm(key, in_dim: int, hidden: int):
+    """LSTM parameters with fused gate weights: ``wx (in, 4H)``,
+    ``wh (H, 4H)``, gate order ``[input, forget, cell, output]``; the
+    forget-gate bias starts at 1.0 (the standard long-memory init)."""
+    k_x, k_h = jax.random.split(key)
+    ortho = jax.nn.initializers.orthogonal(1.0)
+    wx = jnp.concatenate(
+        [ortho(k, (in_dim, hidden), jnp.float32)
+         for k in jax.random.split(k_x, 4)], axis=1,
+    )
+    wh = jnp.concatenate(
+        [ortho(k, (hidden, hidden), jnp.float32)
+         for k in jax.random.split(k_h, 4)], axis=1,
+    )
+    b = jnp.zeros((4 * hidden,), jnp.float32)
+    b = b.at[hidden:2 * hidden].set(1.0)  # forget gate
+    return {"wx": wx, "wh": wh, "b": b}
+
+
+def _lstm_from_xw(params, state, xw, compute_dtype=jnp.float32):
+    """LSTM update given the precomputed input projection. ``state`` is the
+    packed ``[h | c]`` ``(..., 2H)`` array (see module docstring)."""
+    H = params["wh"].shape[0]
+    cd = compute_dtype
+    h, c = state[..., :H], state[..., H:]
+    hw = jnp.asarray(h, cd) @ jnp.asarray(params["wh"], cd)
+    xi, xf, xg, xo = (
+        xw[..., :H], xw[..., H:2 * H], xw[..., 2 * H:3 * H], xw[..., 3 * H:]
+    )
+    hi, hf, hg, ho = (
+        hw[..., :H], hw[..., H:2 * H], hw[..., 2 * H:3 * H], hw[..., 3 * H:]
+    )
+    i = jax.nn.sigmoid(xi + hi)
+    f = jax.nn.sigmoid(xf + hf)
+    g = jnp.tanh(xg + hg)
+    o = jax.nn.sigmoid(xo + ho)
+    c_new = f * jnp.asarray(c, cd) + i * g
+    h_new = o * jnp.tanh(c_new)
+    return jnp.asarray(
+        jnp.concatenate([h_new, c_new], axis=-1), jnp.float32
+    )
+
+
+def lstm_step(params, state, x, compute_dtype=jnp.float32):
+    """One LSTM step over the packed ``[h | c]`` state, batched over
+    leading axes."""
+    return _lstm_from_xw(
+        params, state, _input_proj(params, x, compute_dtype), compute_dtype
+    )
+
+
+# cell name -> (param key/init, step-from-xw, gate count, state multiple)
+_CELLS = {
+    "gru": (init_gru, _gru_from_xw, 3, 1),
+    "lstm": (init_lstm, _lstm_from_xw, 4, 2),
+}
+
+
 def make_recurrent_policy(
     obs_shape: Tuple[int, ...],
     action_spec,
@@ -133,18 +203,24 @@ def make_recurrent_policy(
     activation: str = "tanh",
     init_log_std: float = 0.0,
     compute_dtype=jnp.float32,
+    cell: str = "gru",
 ) -> RecurrentPolicy:
-    """MLP torso → GRU(``gru_size``) → linear head.
+    """MLP torso → recurrent cell(``gru_size``) → linear head.
 
-    ``hidden`` sizes the torso (activation applied after every torso layer,
-    including the last — the GRU is the "output layer" of the torso stack).
-    1-D observations only; a conv torso can be composed later the same way
-    the feedforward path does it.
+    ``cell`` selects the recurrence: ``"gru"`` (default) or ``"lstm"``
+    (packed ``[h | c]`` state — see module docstring). ``hidden`` sizes the
+    torso (activation applied after every torso layer, including the last —
+    the cell is the "output layer" of the torso stack). 1-D observations
+    only; a conv torso can be composed later the same way the feedforward
+    path does it.
     """
     if activation not in ACTIVATIONS:
         raise KeyError(
             f"unknown activation {activation!r}; have {sorted(ACTIVATIONS)}"
         )
+    if cell not in _CELLS:
+        raise KeyError(f"unknown cell {cell!r}; have {sorted(_CELLS)}")
+    cell_init, cell_from_xw, _n_gates, state_mult = _CELLS[cell]
     if isinstance(action_spec, DiscreteSpec):
         out_dim, dist = action_spec.n, Categorical
     elif isinstance(action_spec, BoxSpec):
@@ -158,7 +234,7 @@ def make_recurrent_policy(
     def init(key):
         k_torso, k_gru, k_head = jax.random.split(key, 3)
         params = {
-            "gru": init_gru(k_gru, feat_dim, gru_size),
+            cell: cell_init(k_gru, feat_dim, gru_size),
             # small final scale: near-uniform initial policy (models/mlp.py)
             "head": init_linear(k_head, gru_size, out_dim, scale=0.01),
         }
@@ -179,7 +255,10 @@ def make_recurrent_policy(
             x = act(apply_mlp(params["torso"], x, activation, compute_dtype))
         return x
 
-    def _head(params, h):
+    def _head(params, state):
+        # LSTM: the head (like the next step's projections) consumes the h
+        # half of the packed state; c is memory only
+        h = state[..., :gru_size]
         w = jnp.asarray(params["head"]["w"], compute_dtype)
         b = jnp.asarray(params["head"]["b"], compute_dtype)
         raw = jnp.asarray(jnp.asarray(h, compute_dtype) @ w + b, jnp.float32)
@@ -191,12 +270,15 @@ def make_recurrent_policy(
         }
 
     def initial_state(n_envs: int):
-        return jnp.zeros((n_envs, gru_size), jnp.float32)
+        return jnp.zeros((n_envs, gru_size * state_mult), jnp.float32)
 
     def step(params, h, obs):
-        """(params, h (N,H), obs (N,*o)) -> (h', dist params (N,...))."""
-        h_new = gru_step(
-            params["gru"], h, _features(params, obs), compute_dtype
+        """(params, state (N,S), obs (N,*o)) -> (state', dist (N,...))."""
+        h_new = cell_from_xw(
+            params[cell],
+            h,
+            _input_proj(params[cell], _features(params, obs), compute_dtype),
+            compute_dtype,
         )
         return h_new, _head(params, h_new)
 
@@ -205,16 +287,16 @@ def make_recurrent_policy(
 
         The torso and the gates' input projection are time-independent, so
         they run as ONE (T·N)-row matmul each BEFORE the scan (large MXU
-        tiles); the scan body is only the (N, H)·(H, 3H) recurrence."""
+        tiles); the scan body is only the (N, H)·(H, gates·H) recurrence."""
         h0 = jax.lax.stop_gradient(seq.h0)  # truncated BPTT at the window
         xw = _input_proj(
-            params["gru"], _features(params, seq.obs), compute_dtype
-        )  # (T, N, 3H)
+            params[cell], _features(params, seq.obs), compute_dtype
+        )  # (T, N, gates·H)
 
         def scan_step(h, inputs):
             xw_t, reset_t = inputs
             h = jnp.where(reset_t[:, None], 0.0, h)
-            h = _gru_from_xw(params["gru"], h, xw_t, compute_dtype)
+            h = cell_from_xw(params[cell], h, xw_t, compute_dtype)
             return h, h
 
         _, hs = jax.lax.scan(scan_step, h0, (xw, seq.reset))
@@ -228,4 +310,5 @@ def make_recurrent_policy(
         initial_state=initial_state,
         step=step,
         hidden_size=gru_size,
+        state_size=gru_size * state_mult,
     )

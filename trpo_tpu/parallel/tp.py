@@ -73,17 +73,18 @@ def policy_param_shardings(
                 )
             if (
                 isinstance(k, DictKey)
-                and k.key == "gru"
+                and k.key in ("gru", "lstm")
                 and j + 1 < len(path)
                 and isinstance(path[j + 1], DictKey)
             ):
-                # GRU (models/recurrent.py): both gate projections split
-                # ROW-parallel on their input dim — xw/hw partial sums
-                # reduce across the mesh (one all-reduce each per step) and
-                # the hidden state h stays replicated, which the recurrence
-                # needs anyway. The fused (·, 3H) output axis is NOT sharded
-                # (gate-block slicing at H boundaries would misalign with
-                # shard boundaries); bias is replicated, added post-reduce.
+                # Recurrent cells (models/recurrent.py): both gate
+                # projections split ROW-parallel on their input dim — xw/hw
+                # partial sums reduce across the mesh (one all-reduce each
+                # per step) and the hidden state stays replicated, which
+                # the recurrence needs anyway. The fused (·, gates·H)
+                # output axis is NOT sharded (gate-block slicing at H
+                # boundaries would misalign with shard boundaries); bias is
+                # replicated, added post-reduce.
                 name = path[j + 1].key
                 if (
                     name in ("wx", "wh")
