@@ -156,6 +156,9 @@ def main():
         rows.append(bench_rung(name, k, overrides))
         print(json.dumps(rows[-1]))
 
+    if not rows:
+        print("ladder: no rungs ran (all skipped)", file=sys.stderr)
+        return
     if args.out:
         lines = [
             "| rung | envs | batch | iter ms | updates/s | env steps/s |",

@@ -8,6 +8,8 @@ a subprocess would race for the single-tenant TPU tunnel).
 
 import json
 
+import pytest
+
 from trpo_tpu.train import build_parser, config_from_args, main
 
 TINY = [
@@ -28,6 +30,21 @@ def test_config_overrides():
     assert cfg.cg_iters == 3
     assert cfg.seed == 42
     assert cfg.env == "pendulum"
+
+
+def test_config_network_overrides():
+    args = build_parser().parse_args(
+        ["--policy-hidden", "32,16", "--policy-gru", "8",
+         "--policy-cell", "lstm"]
+    )
+    cfg = config_from_args(args)
+    assert cfg.policy_hidden == (32, 16)
+    assert cfg.policy_gru == 8
+    assert cfg.policy_cell == "lstm"
+    with pytest.raises(SystemExit):
+        config_from_args(
+            build_parser().parse_args(["--policy-hidden", "32,abc"])
+        )
 
 
 def test_cli_trains_and_logs(tmp_path, capsys):

@@ -64,6 +64,20 @@ def build_parser() -> argparse.ArgumentParser:
         "every k-th sample; gradient/line search stay full-batch",
     )
     p.add_argument(
+        "--policy-hidden",
+        help="comma-separated MLP torso sizes, e.g. 256,256",
+    )
+    p.add_argument(
+        "--policy-gru",
+        type=_positive_int,
+        help="recurrent-cell hidden size (enables the recurrent policy)",
+    )
+    p.add_argument(
+        "--policy-cell",
+        choices=("gru", "lstm"),
+        help="recurrence type when --policy-gru is set",
+    )
+    p.add_argument(
         "--host-pipeline-groups",
         type=_positive_int,
         help="host-simulator envs: split the envs into this many groups and "
@@ -122,6 +136,8 @@ _OVERRIDES = {
     "reward_target": "reward_target",
     "fuse_iterations": "fuse_iterations",
     "fvp_subsample": "fvp_subsample",
+    "policy_gru": "policy_gru",
+    "policy_cell": "policy_cell",
     "host_pipeline_groups": "host_pipeline_groups",
     "log_jsonl": "log_jsonl",
     "checkpoint_dir": "checkpoint_dir",
@@ -138,6 +154,19 @@ def config_from_args(args: argparse.Namespace) -> TRPOConfig:
         val = getattr(args, arg_name, None)
         if val is not None and val is not False:
             updates[cfg_name] = val
+    if getattr(args, "policy_hidden", None):
+        try:
+            sizes = tuple(
+                int(s) for s in args.policy_hidden.split(",") if s.strip()
+            )
+        except ValueError:
+            sizes = None
+        if not sizes or any(v < 1 for v in sizes):
+            raise SystemExit(
+                f"--policy-hidden must be comma-separated positive ints, "
+                f"got {args.policy_hidden!r}"
+            )
+        updates["policy_hidden"] = sizes
     return dataclasses.replace(cfg, **updates)
 
 
