@@ -186,3 +186,85 @@ def test_fvp_subsample_validates_fraction():
     # stride), never silently run full-batch
     from trpo_tpu.trpo import _fvp_batch
     assert _fvp_batch(batch, 0.75).weight.shape[0] == 8
+
+
+def test_adaptive_damping_feedback():
+    """LM feedback: λ grows after failure signals, shrinks after clean
+    steps, clamps at the configured bounds, and threads through the fused
+    update as a traced scalar."""
+    from trpo_tpu.trpo import _next_damping
+
+    cfg = TRPOConfig(
+        adaptive_damping=True, cg_damping=0.1,
+        damping_grow=2.0, damping_shrink=0.5,
+        damping_min=0.05, damping_max=0.3,
+    )
+    lam = jnp.float32(0.1)
+    ok, fail = jnp.bool_(True), jnp.bool_(False)
+    tol = dict(rtol=1e-6)
+    # clean step → shrink (0.1 * 0.5 = 0.05, at the floor)
+    np.testing.assert_allclose(_next_damping(cfg, lam, ok, fail), 0.05, **tol)
+    # line-search failure → grow
+    np.testing.assert_allclose(_next_damping(cfg, lam, fail, fail), 0.2, **tol)
+    # rollback → grow; clamps at max
+    np.testing.assert_allclose(
+        _next_damping(cfg, jnp.float32(0.25), ok, ok), 0.3, **tol
+    )
+
+    # traced through the jitted update: stats carry λ used and λ next
+    policy = make_policy((4,), DiscreteSpec(3), hidden=(16,))
+    params = policy.init(jax.random.key(0))
+    batch = make_batch(policy, params, jax.random.key(1))
+    update = jax.jit(make_trpo_update(policy, cfg))
+    _, s1 = update(params, batch, jnp.float32(0.1))
+    np.testing.assert_allclose(float(s1.damping), 0.1, rtol=1e-6)
+    grew = bool(s1.rolled_back) or not bool(s1.linesearch_success)
+    expect = 0.2 if grew else 0.05
+    np.testing.assert_allclose(float(s1.damping_next), expect, rtol=1e-6)
+    # a different λ re-uses the same compiled program (traced, not baked)
+    _, s2 = update(params, batch, jnp.float32(0.2))
+    np.testing.assert_allclose(float(s2.damping), 0.2, rtol=1e-6)
+
+
+def test_adaptive_damping_through_agent(tmp_path):
+    """λ rides TrainState across fused iterations and checkpoints."""
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    cfg = TRPOConfig(
+        env="cartpole", n_envs=4, batch_timesteps=64, cg_iters=3,
+        vf_train_steps=3, policy_hidden=(16,), adaptive_damping=True,
+    )
+    agent = TRPOAgent("cartpole", cfg)
+    state = agent.init_state(0)
+    np.testing.assert_allclose(float(state.cg_damping), cfg.cg_damping,
+                               rtol=1e-6)
+    state, stats = agent.run_iterations(state, 3)
+    lam = float(state.cg_damping)
+    assert cfg.damping_min <= lam <= cfg.damping_max
+    assert np.asarray(stats["cg_damping"]).shape == (3,)
+
+    ck = Checkpointer(str(tmp_path / "ad"))
+    try:
+        ck.save(1, state)
+        restored = ck.restore(agent.init_state(0))
+    finally:
+        ck.close()
+    np.testing.assert_allclose(float(restored.cg_damping), lam, rtol=1e-6)
+
+
+def test_adaptive_damping_through_sharded_update():
+    """make_sharded_update forwards the λ scalar (replicated) — the
+    mesh-parallel path adapts identically."""
+    from trpo_tpu.parallel import make_mesh
+    from trpo_tpu.parallel.sharded import make_sharded_update, shard_batch
+
+    cfg = TRPOConfig(adaptive_damping=True, cg_iters=3)
+    policy = make_policy((4,), DiscreteSpec(2), hidden=(8,))
+    params = policy.init(jax.random.key(0))
+    batch = make_batch(policy, params, jax.random.key(1), n=64)
+    mesh = make_mesh((8,), ("data",))
+    sharded = make_sharded_update(policy, cfg, mesh)
+    _, stats = sharded(params, shard_batch(mesh, batch), jnp.float32(0.07))
+    np.testing.assert_allclose(float(stats.damping), 0.07, rtol=1e-6)
+    assert float(stats.damping_next) != float(stats.damping)

@@ -64,6 +64,8 @@ class TrainState(NamedTuple):
     total_timesteps: jax.Array
     obs_norm: Any = None      # utils/normalize.RunningStats when
     #                           cfg.normalize_obs, else None
+    cg_damping: Any = None    # f32 scalar when cfg.adaptive_damping
+    #                           (trpo._next_damping feedback), else None
 
 
 class TRPOAgent:
@@ -340,6 +342,9 @@ class TRPOAgent:
             total_timesteps=jnp.asarray(0, jnp.int64)
             if jax.config.jax_enable_x64
             else jnp.asarray(0, jnp.int32),
+            cg_damping=jnp.float32(self.cfg.cg_damping)
+            if self.cfg.adaptive_damping
+            else None,
         )
         if self.mesh is not None:
             # Annotate EVERY remaining leaf replicated over the mesh. This
@@ -561,7 +566,7 @@ class TRPOAgent:
                 weight=weight,
             )
         new_policy_params, trpo_stats = self.trpo_update(
-            train_state.policy_params, batch
+            train_state.policy_params, batch, train_state.cg_damping
         )
 
         done_f = traj.done.astype(jnp.float32)
@@ -604,6 +609,7 @@ class TRPOAgent:
             "linesearch_success": trpo_stats.linesearch_success,
             "linesearch_step_fraction": trpo_stats.step_fraction,
             "kl_rolled_back": trpo_stats.rolled_back,
+            "cg_damping": trpo_stats.damping,
         }
 
         new_state = train_state._replace(
@@ -613,6 +619,9 @@ class TRPOAgent:
             iteration=train_state.iteration + 1,
             total_episodes=stats["total_episodes"],
             total_timesteps=train_state.total_timesteps + T * N,
+            cg_damping=trpo_stats.damping_next
+            if self.cfg.adaptive_damping
+            else train_state.cg_damping,
         )
         return new_state, stats
 

@@ -38,6 +38,17 @@ class TRPOConfig:
     max_kl: float = 0.01           # ref config["max_kl"]
     cg_iters: int = 10             # ref utils.py:185 default
     cg_damping: float = 0.1        # ref config["cg_damping"]
+    adaptive_damping: bool = False  # Levenberg–Marquardt feedback: grow λ
+    #                                after a failed line search / KL
+    #                                rollback, shrink it after clean steps
+    #                                (trpo._next_damping); λ starts at
+    #                                cg_damping and rides TrainState. The
+    #                                reference's λ is a constant added
+    #                                host-side (trpo_inksci.py:126)
+    damping_grow: float = 2.0
+    damping_shrink: float = 0.95
+    damping_min: float = 1e-3
+    damping_max: float = 10.0
     cg_residual_tol: float = 1e-10  # ref utils.py:185
     linesearch_backtracks: int = 10  # ref utils.py:171 (0.5**k, k<10)
     linesearch_accept_ratio: float = 0.1  # ref utils.py:170
@@ -126,6 +137,25 @@ class TRPOConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 10
     log_jsonl: Optional[str] = None
+
+    def __post_init__(self):
+        # fail at construction, not mid-training: inverted feedback knobs
+        # would silently make conditioning worse on every failure signal
+        if self.adaptive_damping:
+            if not self.damping_grow > 1.0:
+                raise ValueError(
+                    f"damping_grow must be > 1, got {self.damping_grow}"
+                )
+            if not 0.0 < self.damping_shrink <= 1.0:
+                raise ValueError(
+                    f"damping_shrink must be in (0, 1], "
+                    f"got {self.damping_shrink}"
+                )
+            if not 0.0 < self.damping_min <= self.damping_max:
+                raise ValueError(
+                    f"need 0 < damping_min <= damping_max, got "
+                    f"({self.damping_min}, {self.damping_max})"
+                )
 
     def replace(self, **kw) -> "TRPOConfig":
         return dataclasses.replace(self, **kw)

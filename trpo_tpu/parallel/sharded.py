@@ -122,13 +122,19 @@ def make_sharded_update(
             batch,
         )
 
-    def sharded(params, batch: TRPOBatch):
-        in_shardings = (
+    def sharded(params, batch: TRPOBatch, damping=None):
+        in_shardings = [
             jax.tree_util.tree_map(lambda _: replicated, params),
             batch_shardings(batch),
+        ]
+        if damping is None:
+            fn = jax.jit(update, in_shardings=tuple(in_shardings))
+            return fn(params, batch)
+        # adaptive damping: the λ scalar rides along, replicated
+        fn = jax.jit(
+            update, in_shardings=tuple(in_shardings + [replicated])
         )
-        fn = jax.jit(update, in_shardings=in_shardings)
-        return fn(params, batch)
+        return fn(params, batch, damping)
 
     return sharded
 

@@ -48,6 +48,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-kl", type=float)
     p.add_argument("--cg-iters", type=int)
     p.add_argument("--cg-damping", type=float)
+    p.add_argument(
+        "--adaptive-damping",
+        action="store_true",
+        help="Levenberg–Marquardt feedback on the CG damping: grow after "
+        "failed line search / KL rollback, shrink after clean steps",
+    )
     p.add_argument("--gamma", type=float)
     p.add_argument("--lam", type=float)
     p.add_argument("--reward-target", type=float)
@@ -131,6 +137,7 @@ _OVERRIDES = {
     "max_kl": "max_kl",
     "cg_iters": "cg_iters",
     "cg_damping": "cg_damping",
+    "adaptive_damping": "adaptive_damping",
     "gamma": "gamma",
     "lam": "lam",
     "reward_target": "reward_target",

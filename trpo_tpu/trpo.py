@@ -87,6 +87,9 @@ class TRPOStats(NamedTuple):
     linesearch_success: jax.Array
     step_fraction: jax.Array
     rolled_back: jax.Array
+    damping: jax.Array = jnp.float32(0.0)       # λ used this update
+    damping_next: jax.Array = jnp.float32(0.0)  # λ for the NEXT update
+    #   (== damping unless cfg.adaptive_damping — see _next_damping)
 
 
 def _wmean(x: jax.Array, w: jax.Array) -> jax.Array:
@@ -141,9 +144,24 @@ def _fvp_batch(batch: TRPOBatch, fraction) -> TRPOBatch:
     return jax.tree_util.tree_map(lambda x: x[::stride], batch)
 
 
+def _next_damping(cfg: TRPOConfig, damping, ls_success, rollback):
+    """Levenberg–Marquardt-style trust-region feedback on the CG damping.
+
+    The reference's damping is a constant added host-side per FVP call
+    (``trpo_inksci.py:126``). With ``cfg.adaptive_damping``, failure signals
+    from THIS update (line search found no acceptable step, or the KL
+    rollback fired — the quadratic model was bad) grow λ for the next one;
+    a cleanly accepted step shrinks it. All in-graph scalars; the damping
+    rides ``TrainState`` between iterations, so the fused multi-iteration
+    scan adapts too."""
+    grow = jnp.logical_or(rollback, jnp.logical_not(ls_success))
+    factor = jnp.where(grow, cfg.damping_grow, cfg.damping_shrink)
+    return jnp.clip(damping * factor, cfg.damping_min, cfg.damping_max)
+
+
 def _natural_gradient_update(
     policy: Policy, cfg: TRPOConfig, to_params: Callable[[Any], Any],
-    x0: Any, batch: TRPOBatch,
+    x0: Any, batch: TRPOBatch, damping=None,
 ) -> Tuple[Any, TRPOStats]:
     """The fused solve, generic over the parameter REPRESENTATION.
 
@@ -152,6 +170,9 @@ def _natural_gradient_update(
     ``to_params`` maps it to the pytree ``policy.apply`` takes (``unravel``
     or identity). Every op below (CG, FVP, line search, the tree helpers)
     is pytree-polymorphic, so both representations share this one body.
+
+    ``damping`` overrides ``cfg.cg_damping`` when given (a traced scalar —
+    the adaptive-damping state carried between iterations).
     """
 
     def surr_fn(x):
@@ -180,7 +201,10 @@ def _natural_gradient_update(
     grad_norm = tree_norm(g)
     neg_g = tree_scale(-1.0, g)
 
-    fvp = make_tree_fvp(kl_fixed_fn, x0, damping=cfg.cg_damping)
+    if damping is None:
+        damping = jnp.float32(cfg.cg_damping)
+    damping = jnp.asarray(damping, jnp.float32)
+    fvp = make_tree_fvp(kl_fixed_fn, x0, damping=damping)
     cg = conjugate_gradient(
         fvp, neg_g, cg_iters=cfg.cg_iters, residual_tol=cfg.cg_residual_tol
     )
@@ -217,6 +241,11 @@ def _natural_gradient_update(
     surr_after = -_wmean(
         jnp.exp(logp_new - logp_old) * batch.advantages, batch.weight
     )
+    damping_next = (
+        _next_damping(cfg, damping, ls.success, rollback)
+        if cfg.adaptive_damping
+        else damping
+    )
     stats = TRPOStats(
         surrogate_before=surr_before,
         surrogate_after=surr_after,
@@ -229,6 +258,8 @@ def _natural_gradient_update(
         linesearch_success=ls.success,
         step_fraction=ls.step_fraction,
         rolled_back=rollback,
+        damping=damping,
+        damping_next=damping_next,
     )
     return new_params, stats
 
@@ -241,10 +272,12 @@ def make_trpo_update(
     result (or pass it to ``trpo_tpu.parallel.make_sharded_update`` for a
     mesh-sharded version)."""
 
-    def update(params, batch: TRPOBatch) -> Tuple[Any, TRPOStats]:
+    def update(params, batch: TRPOBatch, damping=None):
         flat0, unravel = flatten_params(params)
         flat0 = jnp.asarray(flat0, jnp.float32)
-        return _natural_gradient_update(policy, cfg, unravel, flat0, batch)
+        return _natural_gradient_update(
+            policy, cfg, unravel, flat0, batch, damping
+        )
 
     return update
 
@@ -267,9 +300,9 @@ def make_tree_trpo_update(
     contract (SURVEY §1) and bit-stable against ``compat``/bench baselines.
     """
 
-    def update(params, batch: TRPOBatch) -> Tuple[Any, TRPOStats]:
+    def update(params, batch: TRPOBatch, damping=None):
         return _natural_gradient_update(
-            policy, cfg, lambda p: p, tree_f32(params), batch
+            policy, cfg, lambda p: p, tree_f32(params), batch, damping
         )
 
     return update
