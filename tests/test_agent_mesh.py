@@ -145,3 +145,40 @@ def test_everything_composed(tmp_path):
         np.asarray(st1["entropy"]), np.asarray(st2["entropy"]), rtol=1e-5
     )
     assert int(s2.iteration) == 4
+
+
+def test_everything_composed_adaptive(tmp_path):
+    """Kitchen sink #2: mesh + adaptive damping + curvature subsampling +
+    obs normalization through fused chunks and resume — the λ scalar and
+    statistics both survive the checkpoint and keep adapting."""
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    cfg = TRPOConfig(
+        env="cartpole",
+        n_envs=8,
+        batch_timesteps=128,
+        cg_iters=3,
+        vf_train_steps=3,
+        policy_hidden=(16,),
+        normalize_obs=True,
+        adaptive_damping=True,
+        fvp_subsample=0.5,
+        mesh_shape=(8,),
+    )
+    agent = TRPOAgent("cartpole", cfg)
+    state, stats = agent.run_iterations(agent.init_state(0), 3)
+    assert np.all(np.isfinite(np.asarray(stats["entropy"])))
+    lam = float(state.cg_damping)
+    assert cfg.damping_min <= lam <= cfg.damping_max
+    assert np.asarray(stats["cg_damping"]).shape == (3,)
+
+    ck = Checkpointer(str(tmp_path / "ksa"))
+    try:
+        ck.save(3, state)
+        restored = ck.restore(agent.init_state(0))
+    finally:
+        ck.close()
+    assert float(restored.cg_damping) == lam
+    s2, st2 = agent.run_iterations(restored, 2)
+    assert float(s2.cg_damping) != lam  # still adapting after resume
+    assert np.all(np.isfinite(np.asarray(st2["entropy"])))
