@@ -104,6 +104,11 @@ class TRPOAgent:
         self.obs_shape = obs_shape
         compute_dtype = jnp.dtype(cfg.compute_dtype)
         if cfg.policy_gru is not None:
+            if cfg.policy_experts is not None:
+                raise ValueError(
+                    "policy_gru and policy_experts are mutually exclusive "
+                    "(no recurrent-MoE model family)"
+                )
             from trpo_tpu.models.recurrent import make_recurrent_policy
 
             self.policy = make_recurrent_policy(
@@ -115,6 +120,18 @@ class TRPOAgent:
                 init_log_std=cfg.init_log_std,
                 compute_dtype=compute_dtype,
                 cell=cfg.policy_cell,
+            )
+        elif cfg.policy_experts is not None:
+            from trpo_tpu.models.moe import make_moe_policy
+
+            self.policy = make_moe_policy(
+                obs_shape,
+                action_spec,
+                hidden=tuple(cfg.policy_hidden),
+                n_experts=cfg.policy_experts,
+                activation=cfg.policy_activation,
+                init_log_std=cfg.init_log_std,
+                compute_dtype=compute_dtype,
             )
         else:
             self.policy = make_policy(
@@ -210,7 +227,7 @@ class TRPOAgent:
             from trpo_tpu.parallel import make_mesh
 
             self.mesh = make_mesh(tuple(cfg.mesh_shape), tuple(cfg.mesh_axes))
-            if cfg.mesh_axes[0] in ("seq", "model"):
+            if cfg.mesh_axes[0] in ("seq", "model", "expert"):
                 raise ValueError(
                     "mesh_axes[0] is the batch/env axis and cannot be named "
                     f'"{cfg.mesh_axes[0]}"; put the {cfg.mesh_axes[0]!r} '
@@ -223,15 +240,33 @@ class TRPOAgent:
                     f"n_envs={cfg.n_envs} must divide evenly over the "
                     f"{cfg.mesh_axes[0]}={dp} mesh axis"
                 )
-            if "model" in cfg.mesh_axes[1:]:
-                # Tensor parallelism: policy params sharded Megatron-style
-                # over "model" (parallel/tp.py), and the update switched to
-                # the pytree-domain solve so the sharding persists through
-                # grad/FVP/CG/linesearch (flattening would all-gather).
+            param_axes = [
+                ax for ax in ("model", "expert") if ax in cfg.mesh_axes[1:]
+            ]
+            if len(param_axes) > 1:
+                raise ValueError(
+                    'mesh axes "model" and "expert" do not compose in one '
+                    "mesh — pick one parameter-sharding axis"
+                )
+            if param_axes:
+                # Parameter sharding: "model" = Megatron col/row tensor
+                # parallelism; "expert" = MoE expert parallelism (whole
+                # experts per shard, models/moe.py). Either way the update
+                # switches to the pytree-domain solve so the sharding
+                # persists through grad/FVP/CG/linesearch (flattening
+                # would all-gather).
                 from trpo_tpu.trpo import make_tree_trpo_update
 
                 self.trpo_update = make_tree_trpo_update(self.policy, cfg)
-                self._tp_axis = "model"
+                self._tp_axis = param_axes[0]
+                if (
+                    self._tp_axis == "expert"
+                    and cfg.policy_experts is None
+                ):
+                    raise ValueError(
+                        'an "expert" mesh axis needs an MoE policy — set '
+                        "policy_experts"
+                    )
             if "seq" in cfg.mesh_axes[1:]:
                 # 2-D data×seq mesh: GAE runs sequence-parallel — the time
                 # axis of the trajectory sharded over "seq", the block-
@@ -312,13 +347,16 @@ class TRPOAgent:
                 for leaf in jax.tree_util.tree_leaves(policy_params)
             ):
                 mp = self.mesh.shape[self._tp_axis]
-                dims = f"hidden={tuple(self.cfg.policy_hidden)}"
-                if self.is_recurrent:
-                    dims += f", gru_size={self.cfg.policy_gru}"
+                if self._tp_axis == "expert":
+                    dims = f"n_experts={self.cfg.policy_experts}"
+                else:
+                    dims = f"hidden={tuple(self.cfg.policy_hidden)}"
+                    if self.is_recurrent:
+                        dims += f", gru_size={self.cfg.policy_gru}"
                 raise ValueError(
-                    f"tensor parallelism over {self._tp_axis}={mp} shards "
-                    f"nothing: no policy layer dimension ({dims}) divides "
-                    "the axis — resize the layers or the mesh"
+                    f"parameter sharding over {self._tp_axis}={mp} shards "
+                    f"nothing: no policy dimension ({dims}) divides "
+                    "the axis — resize the model or the mesh"
                 )
         obs_norm = None
         if self._obs_norm_on_device:

@@ -73,6 +73,12 @@ class TRPOConfig:
     policy_cell: str = "gru"       # recurrence type: "gru" or "lstm"
     #                                (packed [h|c] state); only read when
     #                                policy_gru is set
+    policy_experts: Optional[int] = None  # K → soft mixture-of-experts
+    #                                torso (models/moe.py): K parallel MLP
+    #                                experts blended by a learned gate;
+    #                                shardable over an "expert" mesh axis.
+    #                                No reference analogue (one fixed net,
+    #                                trpo_inksci.py:38-40)
     vf_hidden: Tuple[int, ...] = (64, 64)    # ref critic: 64-relu × 2 (utils.py:59-61)
     vf_activation: str = "relu"
     vf_train_steps: int = 50       # ref: 50 full-batch Adam steps (utils.py:84)
@@ -132,6 +138,9 @@ class TRPOConfig:
     #    (parallel/tp.py) and the natural-gradient solve switched to the
     #    pytree domain (trpo.make_tree_trpo_update) so shardings persist
     #    through grad/FVP/CG/linesearch.
+    #  - "expert" (axes ("data", "expert"), with policy_experts set):
+    #    expert parallelism — whole MoE experts per shard (models/moe.py),
+    #    same pytree-domain solve.
 
     # --- io --------------------------------------------------------------
     checkpoint_dir: Optional[str] = None

@@ -14,3 +14,4 @@ from trpo_tpu.models.recurrent import (  # noqa: F401
     SeqObs,
     make_recurrent_policy,
 )
+from trpo_tpu.models.moe import make_moe_policy  # noqa: F401

@@ -1,0 +1,149 @@
+"""Mixture-of-experts policy torso — the expert-parallel model family.
+
+The reference has exactly one network shape (a 64-tanh MLP,
+``trpo_inksci.py:38-40``). This module adds a soft (dense) mixture of
+experts: ``K`` parallel MLP torsos whose outputs are blended by a learned
+softmax gate, feeding the usual distribution head. Soft routing is chosen
+deliberately over hard top-k:
+
+* it is smooth, so the natural-gradient machinery — which differentiates
+  the policy TWICE (the FVP is ``jvp(grad(kl))``, SURVEY §3.4) — needs no
+  straight-through estimators or routing discontinuities;
+* it is one batched einsum per layer over a stacked ``(K, d_in, d_out)``
+  weight tensor — a single large MXU contraction instead of K small ones.
+
+TPU mapping (the "EP" mesh axis): every expert-stacked leaf has leading
+axis ``K`` and shards as ``P("expert", ...)`` (``parallel/tp.py``); the
+gate and head replicate. Under GSPMD the per-expert contractions compute
+shard-locally and the blend's contraction over ``k`` becomes one
+all-reduce — the dense-MoE analogue of Megatron's row-parallel reduce.
+The natural-gradient solve keeps the expert sharding end-to-end via the
+pytree-domain update (``trpo.make_tree_trpo_update``), exactly like
+tensor parallelism does.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trpo_tpu.distributions import Categorical, DiagGaussian
+from trpo_tpu.models.mlp import ACTIVATIONS, init_linear
+from trpo_tpu.models.policy import BoxSpec, DiscreteSpec, Policy
+
+__all__ = ["init_moe_mlp", "apply_moe_mlp", "make_moe_policy"]
+
+
+def init_moe_mlp(key, n_experts: int, in_dim: int, hidden, out_dim: int):
+    """Expert-stacked MLP params: each leaf gains a leading ``(K,)`` axis
+    (``w (K, d_in, d_out)``, ``b (K, d_out)``) — the layout the
+    ``"expert"`` mesh axis shards."""
+    sizes = [in_dim, *hidden, out_dim]
+    keys = jax.random.split(key, (len(sizes) - 1) * n_experts).reshape(
+        len(sizes) - 1, n_experts
+    )
+    layers = []
+    for i, (d_in, d_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        per_expert = [init_linear(keys[i, k], d_in, d_out) for k in
+                      range(n_experts)]
+        layers.append({
+            "w": jnp.stack([p["w"] for p in per_expert]),
+            "b": jnp.stack([p["b"] for p in per_expert]),
+        })
+    return {"layers": layers}
+
+
+def apply_moe_mlp(params, gate_weights, x, activation="tanh",
+                  compute_dtype=jnp.float32):
+    """All experts forward densely, then blend by the gate.
+
+    ``x (B, d)``, ``gate_weights (B, K)`` → ``(B, out)``. One einsum per
+    layer over the stacked weights; the final blend contracts the expert
+    axis (the all-reduce point under expert sharding)."""
+    act = ACTIVATIONS[activation]
+    cd = compute_dtype
+    h = jnp.asarray(x, cd)  # (B, d); gains the expert axis at layer 0
+    layers = params["layers"]
+    for i, layer in enumerate(layers):
+        w = jnp.asarray(layer["w"], cd)
+        b = jnp.asarray(layer["b"], cd)
+        eq = "bi,kio->bko" if h.ndim == 2 else "bki,kio->bko"
+        h = jnp.einsum(eq, h, w) + b[None]
+        if i < len(layers) - 1:
+            h = act(h)
+    # blend: contract the expert axis with the gate — psum under sharding
+    out = jnp.einsum("bko,bk->bo", h, jnp.asarray(gate_weights, cd))
+    return jnp.asarray(out, jnp.float32)
+
+
+def make_moe_policy(
+    obs_shape: Tuple[int, ...],
+    action_spec,
+    hidden: Tuple[int, ...] = (64,),
+    n_experts: int = 4,
+    activation: str = "tanh",
+    init_log_std: float = 0.0,
+    compute_dtype=jnp.float32,
+) -> Policy:
+    """Soft-MoE policy: gate(obs) blends ``n_experts`` MLP torsos into the
+    distribution head. Same :class:`Policy` contract as ``make_policy`` —
+    every consumer (rollout, critic, the fused update) is unchanged."""
+    if activation not in ACTIVATIONS:
+        raise KeyError(
+            f"unknown activation {activation!r}; have {sorted(ACTIVATIONS)}"
+        )
+    if n_experts < 2:
+        raise ValueError(f"n_experts must be >= 2, got {n_experts}")
+    if isinstance(action_spec, DiscreteSpec):
+        out_dim, dist = action_spec.n, Categorical
+    elif isinstance(action_spec, BoxSpec):
+        out_dim, dist = action_spec.dim, DiagGaussian
+    else:
+        raise TypeError(f"unsupported action spec: {action_spec!r}")
+    if len(obs_shape) != 1:
+        raise ValueError("MoE torso takes 1-D observations")
+    obs_dim = math.prod(obs_shape)
+    feat_dim = hidden[-1] if hidden else obs_dim
+
+    def init(key):
+        k_gate, k_experts, k_head = jax.random.split(key, 3)
+        params = {
+            "gate": init_linear(k_gate, obs_dim, n_experts, scale=0.01),
+            "experts": init_moe_mlp(
+                k_experts, n_experts, obs_dim, hidden[:-1], feat_dim
+            ),
+            # small final scale: near-uniform initial policy (models/mlp.py)
+            "head": init_linear(k_head, feat_dim, out_dim, scale=0.01),
+        }
+        if dist is DiagGaussian:
+            params["log_std"] = jnp.full((out_dim,), init_log_std,
+                                         jnp.float32)
+        return params
+
+    def apply(params, obs):
+        x = obs.reshape(obs.shape[0], -1)
+        cd = compute_dtype
+        gw = jnp.asarray(params["gate"]["w"], cd)
+        gb = jnp.asarray(params["gate"]["b"], cd)
+        gate = jax.nn.softmax(jnp.asarray(x, cd) @ gw + gb, axis=-1)
+        # activation after the blend: the experts' last layer is the
+        # torso's feature layer (mirrors the recurrent torso's convention)
+        feats = ACTIVATIONS[activation](
+            apply_moe_mlp(
+                params["experts"], gate, x, activation, compute_dtype
+            )
+        )
+        hw = jnp.asarray(params["head"]["w"], cd)
+        hb = jnp.asarray(params["head"]["b"], cd)
+        raw = jnp.asarray(jnp.asarray(feats, cd) @ hw + hb, jnp.float32)
+        if dist is Categorical:
+            return {"logits": raw}
+        return {
+            "mean": raw,
+            "log_std": jnp.broadcast_to(params["log_std"], raw.shape),
+        }
+
+    return Policy(init=init, apply=apply, dist=dist, action_spec=action_spec)

@@ -57,6 +57,14 @@ def policy_param_shardings(
 
     def spec(path, leaf):
         for j, k in enumerate(path):
+            if isinstance(k, DictKey) and k.key == "experts":
+                # MoE (models/moe.py): expert-stacked leaves (leading K
+                # axis) shard over the axis — each device holds K/D whole
+                # experts, and the gate-blend's contraction over k becomes
+                # the all-reduce. Gate/head (outside "experts") replicate.
+                if leaf.ndim >= 2 and leaf.shape[0] % axis_size == 0:
+                    return P(model_axis, *([None] * (leaf.ndim - 1)))
+                return P()
             if (
                 isinstance(k, DictKey)
                 and k.key == "layers"

@@ -84,6 +84,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="recurrence type when --policy-gru is set",
     )
     p.add_argument(
+        "--policy-experts",
+        type=_positive_int,
+        help="K experts for the soft mixture-of-experts torso",
+    )
+    p.add_argument(
         "--host-pipeline-groups",
         type=_positive_int,
         help="host-simulator envs: split the envs into this many groups and "
@@ -145,6 +150,7 @@ _OVERRIDES = {
     "fvp_subsample": "fvp_subsample",
     "policy_gru": "policy_gru",
     "policy_cell": "policy_cell",
+    "policy_experts": "policy_experts",
     "host_pipeline_groups": "host_pipeline_groups",
     "log_jsonl": "log_jsonl",
     "checkpoint_dir": "checkpoint_dir",
