@@ -166,3 +166,25 @@ def test_moe_config_validation():
             policy_experts=3, mesh_shape=(4, 2),
             mesh_axes=("data", "expert"),
         ).init_state(0)
+
+
+def test_expert_sharded_checkpoint_roundtrip(tmp_path):
+    """An expert-sharded TrainState checkpoints and restores with its
+    shardings intact, and training continues identically."""
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    agent = _agent(mesh_shape=(4, 2), mesh_axes=("data", "expert"))
+    state, _ = agent.run_iteration(agent.init_state(0))
+    ck = Checkpointer(str(tmp_path / "moe"))
+    try:
+        ck.save(1, state)
+        restored = ck.restore(agent.init_state(0))
+    finally:
+        ck.close()
+    w = restored.policy_params["experts"]["layers"][0]["w"]
+    assert not w.sharding.is_fully_replicated, "restored experts unsharded"
+    s1, st1 = agent.run_iteration(state)
+    s2, st2 = agent.run_iteration(restored)
+    np.testing.assert_allclose(
+        float(st1["entropy"]), float(st2["entropy"]), rtol=1e-5
+    )
