@@ -40,7 +40,10 @@ config = {
     "gamma": 0.95,          # ref trpo_inksci.py:17
     "cg_damping": 0.1,
     "max_kl": 0.01,
-    "iterations": 15,
+    # few iterations on purpose: this path re-traces the un-jitted losses
+    # on every CG/line-search probe (the reference's execution model), so
+    # expect tens of seconds per iteration — that slowness is the exhibit
+    "iterations": 5,
 }
 
 
