@@ -96,3 +96,34 @@ def test_cli_evaluate(capsys):
     rc = main(TINY + ["--evaluate", "64"])
     assert rc == 0
     assert "greedy eval:" in capsys.readouterr().out
+
+
+def test_config_mesh_overrides():
+    cfg = config_from_args(
+        build_parser().parse_args(
+            ["--mesh-shape", "4,2", "--mesh-axes", "data,seq",
+             "--compute-dtype", "bfloat16"]
+        )
+    )
+    assert cfg.mesh_shape == (4, 2)
+    assert cfg.mesh_axes == ("data", "seq")
+    assert cfg.compute_dtype == "bfloat16"
+    # bare --mesh-shape defaults the axis names to ("data",)
+    cfg2 = config_from_args(build_parser().parse_args(["--mesh-shape", "8"]))
+    assert cfg2.mesh_shape == (8,) and cfg2.mesh_axes == ("data",)
+    for bad in (["--mesh-shape", "4,0"], ["--mesh-axes", "data"],
+                ["--mesh-shape", "4,2", "--mesh-axes", "data"]):
+        with pytest.raises(SystemExit):
+            config_from_args(build_parser().parse_args(bad))
+
+
+def test_cli_mesh_training_runs(capsys):
+    """Full CLI training over an 8-device data mesh (virtual CPU)."""
+    rc = main([
+        "--preset", "cartpole", "--iterations", "2",
+        "--batch-timesteps", "64", "--mesh-shape", "8",
+        "--platform", "cpu",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "done: 2 iterations" in out

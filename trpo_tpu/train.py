@@ -89,6 +89,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="K experts for the soft mixture-of-experts torso",
     )
     p.add_argument(
+        "--mesh-shape",
+        help="comma-separated device mesh shape, e.g. 8 or 4,2 "
+        "(with --mesh-axes)",
+    )
+    p.add_argument(
+        "--mesh-axes",
+        help='comma-separated mesh axis names, e.g. data or "data,seq" / '
+        '"data,model" / "data,expert" (axis 0 is the batch axis)',
+    )
+    p.add_argument(
+        "--compute-dtype",
+        choices=("float32", "bfloat16"),
+        help="forward-pass matmul dtype (the CG solve stays fp32)",
+    )
+    p.add_argument(
         "--host-pipeline-groups",
         type=_positive_int,
         help="host-simulator envs: split the envs into this many groups and "
@@ -152,12 +167,26 @@ _OVERRIDES = {
     "policy_cell": "policy_cell",
     "policy_experts": "policy_experts",
     "host_pipeline_groups": "host_pipeline_groups",
+    "compute_dtype": "compute_dtype",
     "log_jsonl": "log_jsonl",
     "checkpoint_dir": "checkpoint_dir",
     "checkpoint_every": "checkpoint_every",
     "debug_nans": "debug_nans",
     "normalize_obs": "normalize_obs",
 }
+
+
+def _csv_positive_ints(flag: str, raw: str) -> tuple:
+    """Parse a comma-separated positive-int flag value or exit cleanly."""
+    try:
+        vals = tuple(int(s) for s in raw.split(",") if s.strip())
+    except ValueError:
+        vals = ()
+    if not vals or any(v < 1 for v in vals):
+        raise SystemExit(
+            f"{flag} must be comma-separated positive ints, got {raw!r}"
+        )
+    return vals
 
 
 def config_from_args(args: argparse.Namespace) -> TRPOConfig:
@@ -168,18 +197,30 @@ def config_from_args(args: argparse.Namespace) -> TRPOConfig:
         if val is not None and val is not False:
             updates[cfg_name] = val
     if getattr(args, "policy_hidden", None):
-        try:
-            sizes = tuple(
-                int(s) for s in args.policy_hidden.split(",") if s.strip()
-            )
-        except ValueError:
-            sizes = None
-        if not sizes or any(v < 1 for v in sizes):
+        updates["policy_hidden"] = _csv_positive_ints(
+            "--policy-hidden", args.policy_hidden
+        )
+    if getattr(args, "mesh_shape", None):
+        shape = _csv_positive_ints("--mesh-shape", args.mesh_shape)
+        updates["mesh_shape"] = shape
+        if len(shape) > 1 and not getattr(args, "mesh_axes", None):
             raise SystemExit(
-                f"--policy-hidden must be comma-separated positive ints, "
-                f"got {args.policy_hidden!r}"
+                f"a multi-dimensional --mesh-shape {shape} requires "
+                '--mesh-axes (e.g. "data,seq")'
             )
-        updates["policy_hidden"] = sizes
+        axes = tuple(
+            s.strip()
+            for s in (args.mesh_axes or "data").split(",")
+            if s.strip()
+        )
+        if len(axes) != len(shape):
+            raise SystemExit(
+                f"--mesh-axes {axes} must name one axis per --mesh-shape "
+                f"dimension {shape}"
+            )
+        updates["mesh_axes"] = axes
+    elif getattr(args, "mesh_axes", None):
+        raise SystemExit("--mesh-axes requires --mesh-shape")
     return dataclasses.replace(cfg, **updates)
 
 
