@@ -16,7 +16,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["discount", "discounted_returns_segmented", "gae_advantages"]
+__all__ = [
+    "discount",
+    "discounted_returns_segmented",
+    "gae_advantages",
+    "gae_from_next_values",
+]
 
 
 def _affine_combine(right, left):
