@@ -1,0 +1,84 @@
+"""Worker process for the multi-host (DCN-layer) test.
+
+Each of two processes joins a real ``jax.distributed`` cluster over
+loopback (the Gloo CPU collectives backend), contributes 4 virtual CPU
+devices, builds the GLOBAL 8-device mesh, and runs the SAME sharded TRPO
+natural-gradient update multi-controller style: identical replicated
+params, the batch constructed as a global array (each process provides
+its addressable shards via ``make_array_from_callback``), cross-process
+``psum``s inside the solve. Printed KL must match across processes.
+
+Spawned by ``tests/test_multihost.py``; must force the CPU platform
+BEFORE any backend touch (the machine's default platform is a
+single-tenant TPU tunnel — see tests/conftest.py).
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def main(pid: int, coord: str) -> None:
+    from trpo_tpu.parallel import (
+        initialize_distributed,
+        make_mesh,
+        make_sharded_update,
+    )
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.models import DiscreteSpec, make_policy
+    from trpo_tpu.trpo import TRPOBatch, standardize_advantages
+
+    initialize_distributed(
+        coordinator_address=coord, num_processes=2, process_id=pid
+    )
+    assert jax.device_count() == 2 * jax.local_device_count(), (
+        jax.device_count(), jax.local_device_count())
+
+    mesh = make_mesh()  # global mesh spanning both processes
+    policy = make_policy((4,), DiscreteSpec(2), hidden=(8,))
+    # identical on both processes (same seed) -> valid replicated input
+    params = jax.tree_util.tree_map(
+        np.asarray, policy.init(jax.random.key(0))
+    )
+    B = 64
+    rng = np.random.default_rng(0)
+    obs_np = rng.normal(size=(B, 4)).astype(np.float32)
+    dist_np = jax.tree_util.tree_map(
+        np.asarray, policy.apply(params, jnp.asarray(obs_np))
+    )
+    act_np = np.asarray(policy.dist.sample(
+        jax.random.key(1), jax.tree_util.tree_map(jnp.asarray, dist_np)
+    ))
+    adv_np = np.asarray(standardize_advantages(
+        jnp.asarray(rng.normal(size=(B,)).astype(np.float32)), jnp.ones(B)
+    ))
+
+    def gshard(x):
+        sh = NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+        return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+
+    batch = TRPOBatch(
+        obs=gshard(obs_np),
+        actions=gshard(act_np),
+        advantages=gshard(adv_np),
+        old_dist=jax.tree_util.tree_map(gshard, dist_np),
+        weight=gshard(np.ones(B, np.float32)),
+    )
+    update = make_sharded_update(policy, TRPOConfig(cg_iters=5), mesh)
+    _, stats = update(params, batch)
+    kl = float(stats.kl)
+    assert np.isfinite(kl) and bool(stats.linesearch_success)
+    assert float(stats.surrogate_after) < float(stats.surrogate_before)
+    # both processes print the same solve result — the test asserts
+    # bitwise agreement, so print the exact bits
+    print(f"MULTIHOST_OK pid={pid} kl={kl.hex()}")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), sys.argv[2])

@@ -87,8 +87,11 @@ class TRPOStats(NamedTuple):
     linesearch_success: jax.Array
     step_fraction: jax.Array
     rolled_back: jax.Array
-    damping: jax.Array = jnp.float32(0.0)       # λ used this update
-    damping_next: jax.Array = jnp.float32(0.0)  # λ for the NEXT update
+    # plain-float defaults: a jnp scalar here would build a device array at
+    # class-definition time, initializing the XLA backend on import and
+    # breaking jax.distributed.initialize ordering for multi-host users
+    damping: Any = 0.0       # λ used this update
+    damping_next: Any = 0.0  # λ for the NEXT update
     #   (== damping unless cfg.adaptive_damping — see _next_damping)
 
 
