@@ -25,7 +25,6 @@ The weighted sum/sum structure of every reduction in ``trpo_tpu.trpo``
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, Tuple
 
 import jax

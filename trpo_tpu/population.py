@@ -15,7 +15,7 @@ population-based selection (``best_member``).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
