@@ -34,10 +34,16 @@ Package map
 - ``trpo_tpu.agent``          — ``TRPOAgent`` (init / act / learn), the
                                 reference's top-level API
 - ``trpo_tpu.parallel``       — mesh construction, sharded update, multihost
+- ``trpo_tpu.population``     — vmapped multi-seed population training
 - ``trpo_tpu.train``          — training loop + CLI
+- ``trpo_tpu.utils``          — metrics/JSONL logging, phase timers,
+                                Orbax checkpointing, running obs statistics
 - ``trpo_tpu.compat``         — the reference ``utils.py`` helper surface
                                 re-expressed over JAX (discount, linesearch,
                                 conjugate_gradient, cat_sample, ...)
+
+See ``docs/API.md`` for the full public surface and ``PARITY.md`` for the
+component-by-component reference mapping.
 """
 
 __version__ = "0.1.0"
