@@ -118,3 +118,17 @@ def test_population_of_recurrent_agents():
     f0 = jax.flatten_util.ravel_pytree(pop.member_state(0).policy_params)[0]
     f1 = jax.flatten_util.ravel_pytree(pop.member_state(1).policy_params)[0]
     assert not np.allclose(np.asarray(f0), np.asarray(f1))
+
+
+def test_population_with_adaptive_damping():
+    """Per-member λ under vmap: each member carries and adapts its own
+    damping scalar (leading population axis)."""
+    agent = TRPOAgent("cartpole", TRPOConfig(
+        n_envs=4, batch_timesteps=64, cg_iters=3, vf_train_steps=3,
+        policy_hidden=(16,), adaptive_damping=True,
+    ))
+    pop = Population(agent, seeds=[0, 1, 2])
+    pop.run_iteration()
+    lam = np.asarray(pop.state.cg_damping)
+    assert lam.shape == (3,)
+    assert np.all((lam >= agent.cfg.damping_min) & (lam <= agent.cfg.damping_max))
