@@ -31,15 +31,23 @@ import numpy as np  # noqa: E402
 from trpo_tpu.agent import TRPOAgent  # noqa: E402
 from trpo_tpu.config import get_preset  # noqa: E402
 
-# (preset, K iterations, overrides) — device-env rungs: the ladder times
-# the fused on-device pipeline.
+# name -> (K iterations, overrides) — device-env rungs: the ladder times
+# the fused on-device pipeline. (Variant rungs below carry the base preset
+# explicitly: name -> (preset, K, overrides).)
 RUNGS = {
     "cartpole": (20, {}),
-    "cartpole-po": (20, {}),          # recurrent/POMDP rung
+    "cartpole-po": (20, {}),          # recurrent (GRU) / POMDP rung
     "pendulum": (10, {}),
     "catch": (10, {}),                # conv/pixel rung
     "halfcheetah-sim": (10, {}),
     "humanoid-sim": (3, {}),          # batch 50k — the north-star shape
+}
+
+# model-family variants: same env, different policy family — the ladder
+# records every family's fused-iteration throughput
+VARIANT_RUNGS = {
+    "cartpole-po-lstm": ("cartpole-po", 20, {"policy_cell": "lstm"}),
+    "cartpole-moe": ("cartpole", 20, {"policy_experts": 4}),
 }
 
 # Host-simulator rungs: env stepping on the host (real MuJoCo via
@@ -64,8 +72,9 @@ def _missing(module: str) -> bool:
     return importlib.util.find_spec(module) is None
 
 
-def bench_rung(name: str, k: int, overrides: dict, reps: int = 3):
-    cfg = get_preset(name).replace(**overrides)
+def bench_rung(name: str, k: int, overrides: dict, reps: int = 3,
+               preset: str = None):
+    cfg = get_preset(preset or name).replace(**overrides)
     agent = TRPOAgent(cfg.env, cfg)
     state = agent.init_state(seed=0)
     steps_per_iter = agent.n_steps * cfg.n_envs
@@ -130,7 +139,10 @@ def bench_host_rung(name: str, preset: str, iters: int, overrides: dict):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--rungs", default=",".join(list(RUNGS) + list(HOST_RUNGS))
+        "--rungs",
+        default=",".join(
+            list(RUNGS) + list(VARIANT_RUNGS) + list(HOST_RUNGS)
+        ),
     )
     ap.add_argument("--out", default=None, help="write a markdown table")
     args = ap.parse_args()
@@ -151,10 +163,14 @@ def main():
             rows.append(bench_host_rung(name, preset, iters, overrides))
             print(json.dumps(rows[-1]))
             continue
-        k, overrides = RUNGS[name]
+        if name in VARIANT_RUNGS:
+            preset, k, overrides = VARIANT_RUNGS[name]
+        else:
+            preset, (k, overrides) = name, RUNGS[name]
         print(f"ladder: {name} ...", file=sys.stderr)
-        rows.append(bench_rung(name, k, overrides))
-        print(json.dumps(rows[-1]))
+        row = bench_rung(name, k, overrides, preset=preset)
+        rows.append(row)
+        print(json.dumps(row))
 
     if not rows:
         print("ladder: no rungs ran (all skipped)", file=sys.stderr)
