@@ -185,3 +185,29 @@ def test_evaluate_host_env_seed_reproducible_and_isolated():
     assert (r1, n1) == (r2, n2)
     assert np.all(agent.env._running_returns == 0.0)
     assert np.all(agent.env._running_lengths == 0)
+
+
+def test_learn_aborts_on_nan_entropy():
+    """The reference kills the process on NaN entropy (`exit(-1)`,
+    trpo_inksci.py:172-173); here it must raise, not exit — poisoned
+    parameters produce NaN stats and learn() aborts on the first check."""
+    cfg = small_cfg(batch_timesteps=64, vf_train_steps=2, cg_iters=2)
+    agent = TRPOAgent("cartpole", cfg)
+    state = agent.init_state(0)
+    bad = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, jnp.nan), state.policy_params
+    )
+    with pytest.raises(FloatingPointError, match="entropy"):
+        agent.learn(n_iterations=2, state=state._replace(policy_params=bad))
+
+
+def test_learn_stops_on_explained_variance():
+    """The reference's `exp > 0.8` stop (trpo_inksci.py:174-175) is opt-in
+    here; with an impossible-to-miss threshold it halts immediately."""
+    cfg = small_cfg(
+        batch_timesteps=64, vf_train_steps=2, cg_iters=2,
+        stop_on_explained_variance=-10.0,  # any finite ev exceeds this
+    )
+    agent = TRPOAgent("cartpole", cfg)
+    state = agent.learn(n_iterations=5, state=agent.init_state(0))
+    assert int(state.iteration) == 1  # stopped after the first iteration
