@@ -1,0 +1,66 @@
+"""Observability: phase timers, profiler traces, NaN debug mode.
+
+SURVEY §5's tracing/profiling obligations — the reference has only a
+wall-clock print (``trpo_inksci.py:89,167``).
+"""
+
+import jax
+import pytest
+
+from trpo_tpu.utils.timers import PhaseTimer
+
+
+def test_phase_timer_records_and_nests():
+    t = PhaseTimer()
+    with t.phase("outer"):
+        with t.phase("inner"):
+            sum(range(1000))
+    assert t.last_ms("outer") >= t.last_ms("inner") >= 0.0
+    # unknown phases read as 0, not an error (callers print summaries
+    # unconditionally)
+    assert t.last_ms("never-ran") == 0.0
+
+
+def test_phase_timer_jax_profiler_annotations():
+    """use_jax_profiler=True wraps phases in TraceAnnotations — must not
+    error even outside an active trace."""
+    t = PhaseTimer(use_jax_profiler=True)
+    with t.phase("annotated"):
+        jax.block_until_ready(jax.numpy.ones(8) * 2)
+    assert t.last_ms("annotated") >= 0.0
+
+
+def test_cli_profile_dir_writes_trace(tmp_path):
+    """--profile-dir produces a profiler trace (the CLI's jax.profiler
+    wiring, validated end to end)."""
+    from trpo_tpu.train import main
+
+    out = tmp_path / "trace"
+    rc = main([
+        "--preset", "cartpole", "--iterations", "1",
+        "--batch-timesteps", "32", "--platform", "cpu",
+        "--profile-dir", str(out),
+    ])
+    assert rc == 0
+    produced = list(out.rglob("*.xplane.pb")) + list(
+        out.rglob("*.trace.json.gz")
+    )
+    assert produced, f"no trace files under {out}"
+
+
+def test_debug_nans_flag_enables_jax_checking():
+    """TRPOConfig.debug_nans flips jax's NaN checking at agent
+    construction (restored afterwards so the rest of the suite is
+    unaffected)."""
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+
+    before = jax.config.jax_debug_nans
+    try:
+        TRPOAgent(
+            "cartpole",
+            TRPOConfig(n_envs=2, batch_timesteps=8, debug_nans=True),
+        )
+        assert jax.config.jax_debug_nans is True
+    finally:
+        jax.config.update("jax_debug_nans", before)
