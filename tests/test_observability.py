@@ -5,7 +5,6 @@ wall-clock print (``trpo_inksci.py:89,167``).
 """
 
 import jax
-import pytest
 
 from trpo_tpu.utils.timers import PhaseTimer
 
