@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Full local validation: everything that must be green before a round ends.
+#
+#   bash scripts/check.sh          # tests + dryrun (CPU, safe anywhere)
+#   bash scripts/check.sh --bench  # also the TPU benchmarks (single-tenant
+#                                  # device — never run concurrently with
+#                                  # another TPU process)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== pytest (8-device virtual CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== driver entry: compile check + multichip dryrun (8 virtual CPUs) =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python __graft_entry__.py
+
+if [[ "${1:-}" == "--bench" ]]; then
+    echo "== north-star benchmark (real device) =="
+    python bench.py
+    echo "== ladder benchmark (real device) =="
+    python bench_ladder.py --out BENCH_LADDER.md
+fi
+
+echo "ALL CHECKS PASSED"
