@@ -161,3 +161,51 @@ def test_checkpoint_restores_recurrent_state(tmp_path):
     s1, stats1 = agent.run_iteration(state)
     s2, stats2 = agent.run_iteration(restored)
     _assert_tree_equal(s1, s2)
+
+
+def test_restore_across_adaptive_damping_flip(tmp_path):
+    """TrainState.cg_damping is a f32 scalar iff cfg.adaptive_damping, so
+    flipping the flag between save and restore changes the pytree
+    structure. Restore must tolerate both directions (round-1 advisor
+    finding): adaptive->fixed drops the saved scalar; fixed->adaptive
+    seeds the scalar from the template (cfg.cg_damping)."""
+    kwargs = dict(
+        n_envs=4, batch_timesteps=64, cg_iters=4, vf_train_steps=5,
+        policy_hidden=(16,), vf_hidden=(16,), seed=7,
+    )
+    adaptive = TRPOAgent(
+        "cartpole", TRPOConfig(adaptive_damping=True, **kwargs)
+    )
+    fixed = TRPOAgent("cartpole", TRPOConfig(**kwargs))
+
+    # adaptive -> fixed
+    state = adaptive.init_state()
+    state, _ = adaptive.run_iteration(state)
+    assert state.cg_damping is not None
+    ckpt = Checkpointer(str(tmp_path / "a2f"))
+    try:
+        ckpt.save(int(state.iteration), state)
+        restored = ckpt.restore(fixed.init_state())
+    finally:
+        ckpt.close()
+    assert restored.cg_damping is None
+    _assert_tree_equal(state._replace(cg_damping=None), restored)
+    fixed.run_iteration(restored)  # restored state is usable
+
+    # fixed -> adaptive
+    state_f = fixed.init_state()
+    state_f, _ = fixed.run_iteration(state_f)
+    ckpt = Checkpointer(str(tmp_path / "f2a"))
+    try:
+        ckpt.save(int(state_f.iteration), state_f)
+        restored2 = ckpt.restore(adaptive.init_state())
+    finally:
+        ckpt.close()
+    np.testing.assert_allclose(
+        np.asarray(restored2.cg_damping),
+        np.asarray(adaptive.init_state().cg_damping),
+    )
+    _assert_tree_equal(
+        state_f, restored2._replace(cg_damping=None)
+    )
+    adaptive.run_iteration(restored2)  # restored state is usable

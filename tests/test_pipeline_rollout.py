@@ -253,3 +253,54 @@ def test_pipeline_config_validation():
     params = policy.init(jax.random.key(0))
     with pytest.raises(ValueError, match="n_groups"):
         pr(env, policy, params, jax.random.key(0), 4, n_groups=3)
+
+
+def test_deferred_fold_refreshes_cached_obs():
+    """After a pipelined window the adapter's cached current obs must be
+    normalized under the merged post-window statistics, not the stale
+    window-start statistics (round-1 advisor finding) — direct users of
+    pipelined_host_rollout see a consistent first step next window."""
+    env = native.NativeVecEnv(
+        "cartpole", n_envs=4, seed=3, max_episode_steps=10,
+        normalize_obs=True,
+    )
+    policy = _policy_for(env)
+    params = policy.init(jax.random.key(0))
+    pipelined_host_rollout(
+        env, policy, params, jax.random.key(1), 12, n_groups=2
+    )
+    with env._norm_lock:
+        expect = env._apply_norm(env._raw_obs)
+    np.testing.assert_array_equal(np.asarray(env._obs), np.asarray(expect))
+
+
+def test_wide_int_action_without_bound_is_not_packed():
+    """The packed transfer casts through float32; an int32 action leaf is
+    only exact when its values are < 2^24, a bound knowable only for
+    categorical policies. A non-categorical integer action must take the
+    unpacked path and round-trip exactly (round-1 advisor finding)."""
+    big = 2**24 + 1  # not representable in float32
+
+    class BigIntDist:
+        name = "bigint"
+
+        @staticmethod
+        def sample(key, params):
+            return params["base"].astype(jnp.int32)
+
+        @staticmethod
+        def mode(params):
+            return params["base"].astype(jnp.int32)
+
+    class BigIntPolicy:
+        dist = BigIntDist
+
+        @staticmethod
+        def apply(params, obs):
+            return {"base": jnp.full((obs.shape[0],), big, jnp.int32)}
+
+    act = make_host_act_fn(BigIntPolicy())
+    action, dist = act({}, jnp.zeros((3, 2), jnp.float32), jax.random.key(0))
+    assert np.asarray(action).dtype == np.int32
+    np.testing.assert_array_equal(np.asarray(action), big)
+    np.testing.assert_array_equal(np.asarray(dist["base"]), big)

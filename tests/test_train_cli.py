@@ -127,3 +127,23 @@ def test_cli_mesh_training_runs(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "done: 2 iterations" in out
+
+
+def test_mesh_axes_alone_with_preset_mesh(monkeypatch):
+    """--mesh-axes without --mesh-shape must fall back to the preset's
+    mesh_shape instead of always raising (round-1 advisor finding)."""
+    import dataclasses
+
+    from trpo_tpu import config as config_mod
+
+    preset = dataclasses.replace(
+        config_mod.get_preset("cartpole"), mesh_shape=(8,)
+    )
+    monkeypatch.setitem(config_mod.PRESETS, "_meshpreset", preset)
+    cfg = config_from_args(
+        build_parser().parse_args(
+            ["--preset", "_meshpreset", "--mesh-axes", "data"]
+        )
+    )
+    assert cfg.mesh_shape == (8,)
+    assert cfg.mesh_axes == ("data",)

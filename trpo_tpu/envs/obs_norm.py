@@ -137,6 +137,13 @@ class ObsNormMixin:
                 for lo in sorted(deferred):
                     for batch in deferred[lo]:
                         self._fold(batch)
+                # the cached current obs were normalized under the
+                # window-start statistics; refresh them so the next
+                # window's first step is consistent with its batch (the
+                # agent path re-installs stats via set_obs_stats_state,
+                # but direct pipelined_host_rollout users do not)
+                if getattr(self, "_raw_obs", None) is not None:
+                    self._obs = self._apply_norm(self._raw_obs)
 
     # -- checkpoint mirror / control --------------------------------------
 

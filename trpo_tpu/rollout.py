@@ -257,19 +257,38 @@ def make_host_act_fn(
 
     def _f32_safe(dt: np.dtype) -> bool:
         # exact through a float32 round trip: f32 itself, narrower floats
-        # (bf16/f16 upcast losslessly), and small integers (action indices
-        # ≪ 2²⁴). float64 leaves would silently lose bits — don't pack.
-        if dt == np.float32 or np.issubdtype(dt, np.integer):
+        # (bf16/f16 upcast losslessly), and integers whose whole range fits
+        # the 24-bit mantissa. Wider integers and float64 would silently
+        # round — don't pack them here (the action leaf gets its own
+        # bounded-range check below).
+        if dt == np.float32:
             return True
+        if np.issubdtype(dt, np.integer):
+            return np.dtype(dt).itemsize <= 2
         return np.issubdtype(dt, np.floating) and np.dtype(dt).itemsize < 4
+
+    def _int_action_safe(dist_leaves) -> bool:
+        # a wide (int32/int64) action leaf packs exactly only when its
+        # VALUES are < 2²⁴; that bound is knowable only for categorical
+        # policies, where indices range over the logits width
+        if getattr(policy.dist, "name", None) != "categorical":
+            return False
+        widths = [
+            leaf.shape[-1] for leaf in dist_leaves if len(leaf.shape) > 1
+        ]
+        return bool(widths) and max(widths) < 2**24
 
     def call(params, obs, key):
         m = meta_cache.get(obs.shape[1:], "?")
         if m == "?":
             a_s, d_s = jax.eval_shape(act, params, obs, key)
             leaves, treedef = jax.tree_util.tree_flatten(d_s)
-            if all(
-                _f32_safe(np.dtype(x.dtype)) for x in [a_s] + leaves
+            action_ok = _f32_safe(np.dtype(a_s.dtype)) or (
+                np.issubdtype(np.dtype(a_s.dtype), np.integer)
+                and _int_action_safe(leaves)
+            )
+            if action_ok and all(
+                _f32_safe(np.dtype(x.dtype)) for x in leaves
             ):
                 m = (
                     a_s.shape[1:],

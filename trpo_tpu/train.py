@@ -200,27 +200,36 @@ def config_from_args(args: argparse.Namespace) -> TRPOConfig:
         updates["policy_hidden"] = _csv_positive_ints(
             "--policy-hidden", args.policy_hidden
         )
-    if getattr(args, "mesh_shape", None):
-        shape = _csv_positive_ints("--mesh-shape", args.mesh_shape)
-        updates["mesh_shape"] = shape
-        if len(shape) > 1 and not getattr(args, "mesh_axes", None):
+    mesh_shape_flag = getattr(args, "mesh_shape", None)
+    mesh_axes_flag = getattr(args, "mesh_axes", None)
+    if mesh_shape_flag or mesh_axes_flag:
+        if mesh_shape_flag:
+            shape = _csv_positive_ints("--mesh-shape", mesh_shape_flag)
+            updates["mesh_shape"] = shape
+        elif cfg.mesh_shape:
+            # axes alone may rename a preset-supplied mesh
+            shape = tuple(cfg.mesh_shape)
+        else:
+            raise SystemExit(
+                "--mesh-axes requires --mesh-shape (the preset defines "
+                "no mesh)"
+            )
+        if len(shape) > 1 and not mesh_axes_flag:
             raise SystemExit(
                 f"a multi-dimensional --mesh-shape {shape} requires "
                 '--mesh-axes (e.g. "data,seq")'
             )
         axes = tuple(
             s.strip()
-            for s in (args.mesh_axes or "data").split(",")
+            for s in (mesh_axes_flag or "data").split(",")
             if s.strip()
         )
         if len(axes) != len(shape):
             raise SystemExit(
-                f"--mesh-axes {axes} must name one axis per --mesh-shape "
+                f"--mesh-axes {axes} must name one axis per mesh-shape "
                 f"dimension {shape}"
             )
         updates["mesh_axes"] = axes
-    elif getattr(args, "mesh_axes", None):
-        raise SystemExit("--mesh-axes requires --mesh-shape")
     return dataclasses.replace(cfg, **updates)
 
 

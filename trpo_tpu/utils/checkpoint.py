@@ -53,10 +53,51 @@ class Checkpointer:
             sharding = getattr(x, "sharding", None)
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
 
-        abstract = jax.tree_util.tree_map(as_abstract, template)
-        return self.manager.restore(
-            step, args=self._ocp.args.StandardRestore(abstract)
+        # TrainState.cg_damping is a f32 scalar iff cfg.adaptive_damping,
+        # so flipping the flag between save and restore changes the pytree
+        # structure. Tolerate both directions: adaptive→fixed drops the
+        # saved scalar, fixed→adaptive seeds the scalar from the template
+        # (agent.init_state puts cfg.cg_damping there).
+        flippable = hasattr(template, "_replace") and hasattr(
+            template, "cg_damping"
         )
+        abstract = jax.tree_util.tree_map(as_abstract, template)
+        try:
+            restored = self.manager.restore(
+                step, args=self._ocp.args.StandardRestore(abstract)
+            )
+        except Exception as first_err:
+            if not flippable:
+                raise
+            alt = template._replace(
+                cg_damping=None
+                if template.cg_damping is not None
+                else jax.ShapeDtypeStruct((), "float32")
+            )
+            abstract_alt = jax.tree_util.tree_map(as_abstract, alt)
+            try:
+                restored = self.manager.restore(
+                    step, args=self._ocp.args.StandardRestore(abstract_alt)
+                )
+            except Exception:
+                # the failure was not a damping flip — surface the
+                # original error, not the retry's
+                raise first_err
+        if flippable and (
+            (template.cg_damping is None)
+            != (getattr(restored, "cg_damping", None) is None)
+        ):
+            seed = template.cg_damping
+            if seed is not None and not hasattr(seed, "__array__"):
+                # abstract template leaf (ShapeDtypeStruct): materialize a
+                # concrete zero — the adaptive-damping feedback re-adapts
+                # within an iteration; a concrete template (the normal
+                # agent.init_state() path) seeds cfg.cg_damping instead
+                import jax.numpy as jnp
+
+                seed = jnp.zeros(seed.shape, seed.dtype)
+            restored = restored._replace(cg_damping=seed)
+        return restored
 
     def close(self):
         self.manager.close()
