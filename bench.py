@@ -22,7 +22,9 @@ Prints ONE JSON line:
 "vs_baseline": <baseline_ms_per_iter / ours_ms_per_iter>}``.
 """
 
+import contextlib
 import json
+import os
 import subprocess
 import sys
 import time
@@ -52,7 +54,10 @@ def _tpu_usable(probe_timeout_s: int = 150) -> bool:
         return False
 
 
-_ACCEL = _tpu_usable()
+# BENCH_FORCE_CPU=1 skips the accelerator probe entirely (local smoke
+# validation without touching the single-tenant TPU tunnel); BENCH_BATCH
+# shrinks the problem for the same purpose. The driver runs with neither.
+_ACCEL = os.environ.get("BENCH_FORCE_CPU") != "1" and _tpu_usable()
 import jax  # noqa: E402
 
 if not _ACCEL:
@@ -69,7 +74,7 @@ import numpy as np  # noqa: E402
 OBS_DIM = 376          # Humanoid-v2 observation size (BASELINE.json)
 ACT_DIM = 17           # Humanoid-v2 action size
 HIDDEN = (256, 256)
-BATCH = 50_000
+BATCH = int(os.environ.get("BENCH_BATCH", 50_000))
 CG_ITERS = 10
 DAMPING = 0.1
 FVP_SUB = 0.2          # curvature-subsampling operating point (see main)
@@ -82,6 +87,126 @@ _T0 = time.perf_counter()
 
 def _progress(msg: str) -> None:
     print(f"bench[{time.perf_counter() - _T0:7.1f}s] {msg}", file=sys.stderr)
+
+
+# -- FLOP / MFU accounting ---------------------------------------------------
+#
+# Dense bf16-matmul peak per JAX *device* (TPU generations where a chip has
+# two TensorCores expose one device per core; v4+ megacore exposes one device
+# per chip). Public spec-sheet numbers, TFLOP/s.
+_PEAK_BF16_TFLOPS = [
+    # (kind substring, bf16 TFLOP/s, HBM GB/s) — spec-sheet numbers
+    ("v6", 918.0, 1640.0),
+    ("v5p", 459.0, 2765.0),
+    ("v5 lite", 197.0, 819.0),   # v5e device_kind is "TPU v5 lite"
+    ("v5litepod", 197.0, 819.0),
+    ("v5e", 197.0, 819.0),
+    ("v5", 459.0, 2765.0),
+    ("v4", 275.0, 1228.0),
+    ("v3", 61.5, 450.0),
+    ("v2", 22.5, 300.0),
+]
+
+
+def _peak_tflops(device):
+    """(bf16 dense-matmul peak TFLOP/s, HBM GB/s) for this device, or
+    (None, None) when unknown (CPU fallback, exotic kinds) — MFU/roofline
+    are then reported as null, never guessed."""
+    kind = getattr(device, "device_kind", "").lower()
+    if "tpu" not in kind and device.platform != "tpu":
+        return None, None
+    for tag, peak, bw in _PEAK_BF16_TFLOPS:
+        if tag in kind:
+            return peak, bw
+    return None, None
+
+
+def _program_flops(jitted, *args):
+    """Total FLOPs of one execution of a jitted program, from the compiled
+    executable's XLA cost analysis; None when the backend doesn't report.
+
+    ONLY valid for loop-free programs: XLA's cost analysis counts a
+    ``while``/``scan`` body ONCE regardless of trip count, so lowering the
+    fused (looped) solver would undercount by ~the iteration count. The
+    accounting below therefore lowers single-kernel programs (one FVP, one
+    grad, one KL eval) and composes them analytically."""
+    try:
+        an = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(an, (list, tuple)):
+            an = an[0]
+        flops = float(an.get("flops", float("nan")))
+        nbytes = float(an.get("bytes accessed", float("nan")))
+        if not (np.isfinite(flops) and flops > 0):
+            return None, None
+        return flops, (nbytes if np.isfinite(nbytes) and nbytes > 0 else None)
+    except Exception:
+        return None, None
+
+
+def _analytic_fvp_tangent_flops() -> float:
+    """Analytic FLOPs for ONE CG iteration of the FUSED solve: the
+    jvp-of-grad tangent pass ≈ 3 forward-equivalents (a forward-mode
+    sweep through the forward+backward graph costs about what the
+    reverse-mode grad itself does: fwd + 2×bwd ≈ 3 forwards). The primal
+    linearization point (grad of KL at flat0) is loop-invariant — XLA's
+    while-loop LICM hoists it out of the CG loop, so it is amortized over
+    all 10 iterations, and the stop-gradient old-dist forward likewise.
+    Cross-checks the XLA cost-analysis number in the JSON."""
+    dims = [OBS_DIM] + list(HIDDEN)
+    weights = sum(a * b for a, b in zip(dims[:-1], dims[1:]))
+    weights += HIDDEN[-1] * ACT_DIM  # Gaussian mean head (logstd: no matmul)
+    forward = 2.0 * BATCH * weights
+    return 3.0 * forward
+
+
+def flop_accounting(kl_fn, flat0, g):
+    """Measured FLOP counts for the solver's constituent (loop-free)
+    programs, composed into per-CG-iter and per-update totals.
+
+    * ``fvp``: one standalone Fisher-vector product — primal
+      re-linearization + tangent pass (≈6 forward-equivalents).
+    * ``grad``: one reverse-mode grad of the mean KL (≈3 forwards) — also
+      the cost model for the surrogate gradient (same network, same batch,
+      scalar loss of the same shape).
+    * ``kl_eval``: one KL forward evaluation (two applies, old + new) —
+      the cost model for a linesearch trial (surrogate + KL eval share the
+      applies in the fused program).
+    * ``tangent`` = fvp − grad: the per-iteration cost INSIDE the fused CG
+      loop, where the primal is loop-invariant and hoisted (XLA LICM).
+
+    ``update_model`` composes the fused update's accepted-first-try path
+    (the overwhelmingly common case, and a LOWER bound otherwise):
+    surrogate grad + primal linearization + (CG_ITERS+1) tangents (10 CG
+    + 1 step-scale sᵀFs product) + 3 KL-shaped evals (initial losses, one
+    linesearch trial, final losses)."""
+    from trpo_tpu.ops import make_fvp
+
+    def fvp_prog(flat, v):
+        return make_fvp(kl_fn, flat, DAMPING)(v)
+
+    fvp, fvp_bytes = _program_flops(jax.jit(fvp_prog), flat0, g)
+    grad, grad_bytes = _program_flops(jax.jit(jax.grad(kl_fn)), flat0)
+    kl_eval, _ = _program_flops(jax.jit(kl_fn), flat0)
+    if fvp is None or grad is None:
+        return {}
+    tangent = max(fvp - grad, 0.0)
+    acct = {
+        "fvp": fvp,
+        "grad": grad,
+        "kl_eval": kl_eval,
+        "tangent": tangent,
+        "flops_per_cg_iter": tangent,
+    }
+    if fvp_bytes is not None and grad_bytes is not None:
+        # HBM traffic of the per-iteration tangent work — with the FLOPs
+        # this gives the arithmetic intensity, hence which roofline
+        # (compute vs bandwidth) bounds the solve
+        acct["bytes_per_cg_iter"] = max(fvp_bytes - grad_bytes, 0.0)
+    if kl_eval is not None:
+        acct["flops_per_update"] = (
+            2.0 * grad + (CG_ITERS + 1) * tangent + 3.0 * kl_eval
+        )
+    return acct
 
 
 def _device_rtt() -> float:
@@ -303,6 +428,63 @@ def time_fused_solve(kl_fn, flat0, g, device=None):
     return per_iter_ms, x
 
 
+def _host_cg_loop(fvp_host, b, iters=None):
+    """The reference's host NumPy CG recurrence (``utils.py:185-201``) —
+    shared by the CPU baseline and the fusion-ablation row so both compare
+    the SAME solver semantics against the fused path."""
+    x = np.zeros_like(b)
+    r = b.copy()
+    p = b.copy()
+    rdotr = r.dot(r)
+    for _ in range(iters or CG_ITERS):
+        z = fvp_host(p)
+        alpha = rdotr / p.dot(z)
+        x += alpha * p
+        r -= alpha * z
+        new_rdotr = r.dot(r)
+        p = r + (new_rdotr / rdotr) * p
+        rdotr = new_rdotr
+    return x
+
+
+def time_host_driven_cg(kl_fn, flat0, g):
+    """Fusion ablation: the SAME jit-compiled device FVP (bf16 matmuls on
+    the accelerator) but the reference's host-driven CG loop
+    (``utils.py:185-201``) — tangent uploaded, FVP run, result downloaded,
+    damping and all CG vector arithmetic on the host, once per iteration.
+
+    Separates the two effects bundled in the headline speedup: chip speedup
+    (this row vs the CPU baseline) and fusion speedup (the fused solve vs
+    this row). Reported both raw and RTT-corrected — on the tunneled
+    accelerator each iteration pays ~100 ms of transport that a locally
+    attached host would not; the corrected number is the fair
+    locally-attached estimate (and an upper bound on the host loop's
+    speed, i.e. a LOWER bound on the fusion win)."""
+    @jax.jit
+    def fvp_dev(flat, v):
+        grad_kl = jax.grad(kl_fn)
+        return jax.jvp(grad_kl, (flat,), (v,))[1]
+
+    def fvp_host(p):                          # one round trip per call
+        out = fvp_dev(flat0, jnp.asarray(p, jnp.float32))
+        return np.asarray(out) + DAMPING * p
+
+    b = -np.asarray(g)
+    _progress("host-driven CG: compiling")
+    fvp_host(b)                               # compile + warm
+    rtt = _device_rtt()
+    n_loops = 3
+    _progress(f"host-driven CG: timing (rtt {rtt * 1e3:.0f} ms)")
+    t0 = time.perf_counter()
+    for _ in range(n_loops):
+        x = _host_cg_loop(fvp_host, b)
+    dt = time.perf_counter() - t0
+    _progress("host-driven CG: done")
+    raw_ms = dt / (n_loops * CG_ITERS) * 1e3
+    corrected_ms = max(raw_ms - rtt * 1e3, 1e-6)
+    return raw_ms, corrected_ms, x
+
+
 def time_reference_semantics(kl_fn, flat0, g):
     """Reference path: host NumPy CG; ONE device FVP call per iteration
     with host transfer both ways + host-side damping (ref utils.py:185-201,
@@ -322,27 +504,12 @@ def time_reference_semantics(kl_fn, flat0, g):
 
         b = -np.asarray(g)
 
-        def cg_host():
-            x = np.zeros_like(b)
-            r = b.copy()
-            p = b.copy()
-            rdotr = r.dot(r)
-            for _ in range(CG_ITERS):
-                z = fvp_host(p)
-                alpha = rdotr / p.dot(z)
-                x += alpha * p
-                r -= alpha * z
-                new_rdotr = r.dot(r)
-                p = r + (new_rdotr / rdotr) * p
-                rdotr = new_rdotr
-            return x
-
         _progress("baseline: compiling")
         fvp_host(b)                           # compile + warm (one FVP)
         _progress("baseline: timing")
         t0 = time.perf_counter()
         for _ in range(BASELINE_REPS):
-            x = cg_host()
+            x = _host_cg_loop(fvp_host, b)
         dt = time.perf_counter() - t0
         _progress("baseline: done")
     return dt / (BASELINE_REPS * CG_ITERS) * 1e3, x
@@ -377,6 +544,44 @@ def main():
             with jax.default_device(cpu):
                 kl_fn, flat0, g = build_problem()
             ours_ms, x_ours = time_fused_solve(kl_fn, flat0, g, device=cpu)
+    # FLOP accounting on the same problem (loop-free lowered programs;
+    # compile-only, nothing executed — see flop_accounting docstring).
+    # After a TPU fallback, pin the lowering to CPU: compiling against a
+    # wedged tunnel hangs rather than raising, so the try/except alone
+    # would not protect this path.
+    _progress("flop accounting: lowering single-kernel programs")
+    acct_ctx = (
+        contextlib.nullcontext()
+        if _ACCEL
+        else jax.default_device(jax.devices("cpu")[0])
+    )
+    try:
+        with acct_ctx:
+            acct = flop_accounting(kl_fn, flat0, g)
+    except Exception as e:
+        _progress(f"flop accounting failed ({type(e).__name__}: {e})")
+        acct = {}
+    # Fusion ablation (accelerator only): same device FVP, host CG loop.
+    host_cg_raw_ms = host_cg_ms = None
+    if _ACCEL:
+        try:
+            host_cg_raw_ms, host_cg_ms, x_hd = time_host_driven_cg(
+                kl_fn, flat0, g
+            )
+            # the ablation rows only mean something if they solved the
+            # same system — same guard as the baseline's cosine check
+            cos_hd = float(
+                np.dot(np.asarray(x_ours), x_hd)
+                / (np.linalg.norm(np.asarray(x_ours)) * np.linalg.norm(x_hd))
+            )
+            if not cos_hd > 0.99:
+                _progress(
+                    f"host-driven ablation solution mismatch (cosine "
+                    f"{cos_hd:.4f}) — dropping the ablation rows"
+                )
+                host_cg_raw_ms = host_cg_ms = None
+        except Exception as e:
+            _progress(f"host-driven ablation failed ({type(e).__name__}: {e})")
     upd_dev = None if _ACCEL else jax.devices("cpu")[0]
     try:
         updates_per_sec, update_ms = time_full_update(device=upd_dev)
@@ -414,26 +619,86 @@ def main():
     )
     assert cos > 0.99, f"solver mismatch: cosine {cos}"
 
+    dev = list(x_ours.devices())[0]
+    peak, hbm_gbps = _peak_tflops(dev)
+    tflops_solve = tflops_update = None
+    if acct.get("flops_per_cg_iter"):
+        tflops_solve = acct["flops_per_cg_iter"] / (ours_ms * 1e-3) / 1e12
+    if acct.get("flops_per_update") and update_ms:
+        tflops_update = acct["flops_per_update"] / (update_ms * 1e-3) / 1e12
+    # Roofline: which bound applies at this arithmetic intensity, and how
+    # close the solve runs to it (MFU alone understates a bandwidth-bound
+    # kernel; this says what the SHAPE allows on this chip).
+    intensity = roofline_tflops = roofline_frac = None
+    if acct.get("bytes_per_cg_iter") and acct.get("flops_per_cg_iter"):
+        intensity = acct["flops_per_cg_iter"] / acct["bytes_per_cg_iter"]
+        if peak is not None and hbm_gbps is not None:
+            roofline_tflops = min(peak, intensity * hbm_gbps / 1e3)
+            if tflops_solve is not None:
+                roofline_frac = tflops_solve / roofline_tflops
+
+    def _r(v, nd=4):
+        return None if v is None else round(v, nd)
+
+    def _mfu(achieved):
+        if peak is None or achieved is None:
+            return None
+        return round(achieved / peak, 4)
+
     print(
         json.dumps(
             {
-                "metric": "cg_solve_ms_per_iter_humanoid_shape_batch50k",
+                # label tracks the actual batch (BENCH_BATCH smoke runs
+                # must not masquerade as the full-size benchmark)
+                "metric": (
+                    "cg_solve_ms_per_iter_humanoid_shape_batch"
+                    + (
+                        f"{BATCH // 1000}k"
+                        if BATCH % 1000 == 0
+                        else str(BATCH)
+                    )
+                ),
                 "value": round(ours_ms, 4),
                 "unit": "ms/iter",
                 "vs_baseline": round(base_ms / ours_ms, 2),
                 "baseline_ms_per_iter": round(base_ms, 3),
-                "backend": list(x_ours.devices())[0].platform,
+                "backend": dev.platform,
+                "device_kind": dev.device_kind,
                 "solution_cosine": round(cos, 6),
-                "policy_updates_per_sec": None
-                if updates_per_sec is None
-                else round(updates_per_sec, 2),
-                "full_update_ms": None
-                if update_ms is None
-                else round(update_ms, 3),
-                "policy_updates_per_sec_fvp_subsample": None
-                if updates_per_sec_sub is None
-                else round(updates_per_sec_sub, 2),
+                "policy_updates_per_sec": _r(updates_per_sec, 2),
+                "full_update_ms": _r(update_ms, 3),
+                "policy_updates_per_sec_fvp_subsample": _r(
+                    updates_per_sec_sub, 2
+                ),
                 "fvp_subsample": FVP_SUB,
+                # -- FLOP / MFU accounting (XLA cost analysis of loop-free
+                #    single-kernel programs, composed per flop_accounting;
+                #    null when the backend doesn't report or the peak is
+                #    unknown) --
+                "peak_bf16_tflops": peak,
+                "flops_per_cg_iter": _r(acct.get("flops_per_cg_iter"), 0),
+                "analytic_flops_per_cg_iter": round(
+                    _analytic_fvp_tangent_flops(), 0
+                ),
+                "achieved_tflops_solve": _r(tflops_solve, 2),
+                "mfu_solve": _mfu(tflops_solve),
+                "flops_per_update": _r(acct.get("flops_per_update"), 0),
+                "achieved_tflops_update": _r(tflops_update, 2),
+                "mfu_update": _mfu(tflops_update),
+                "hbm_gbps": hbm_gbps,
+                "bytes_per_cg_iter": _r(acct.get("bytes_per_cg_iter"), 0),
+                "arithmetic_intensity_flops_per_byte": _r(intensity, 1),
+                "roofline_tflops": _r(roofline_tflops, 1),
+                "roofline_fraction_solve": _r(roofline_frac, 3),
+                # -- fusion ablation: same device FVP, host CG loop --
+                "host_driven_cg_ms_per_iter": _r(host_cg_ms, 3),
+                "host_driven_cg_ms_per_iter_raw": _r(host_cg_raw_ms, 3),
+                "fusion_speedup": None
+                if host_cg_ms is None
+                else round(host_cg_ms / ours_ms, 2),
+                "chip_speedup_host_driven_vs_cpu": None
+                if host_cg_ms is None
+                else round(base_ms / host_cg_ms, 2),
             }
         )
     )

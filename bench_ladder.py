@@ -39,6 +39,8 @@ RUNGS = {
     "cartpole-po": (20, {}),          # recurrent (GRU) / POMDP rung
     "pendulum": (10, {}),
     "catch": (10, {}),                # conv/pixel rung
+    "pong-sim": (3, {}),              # Atari-scale conv FVP: 84×84×4 obs,
+    #                                   ≈1.7M-param Nature policy
     "halfcheetah-sim": (10, {}),
     "humanoid-sim": (3, {}),          # batch 50k — the north-star shape
 }
@@ -61,6 +63,15 @@ VARIANT_RUNGS = {
 HOST_RUNGS = {
     "halfcheetah-host": (
         "halfcheetah", 2, {"batch_timesteps": 1000},
+        ("gymnasium", "mujoco"),
+    ),
+    # host_inference="cpu": params pushed to the host CPU backend once per
+    # iteration, rollout pays ZERO device round trips — the fix for the
+    # RTT-bound row above (the policy is a 64×64 MLP; inference is
+    # microseconds next to a ~100 ms tunnel round trip)
+    "halfcheetah-host-cpuinf": (
+        "halfcheetah", 2,
+        {"batch_timesteps": 1000, "host_inference": "cpu"},
         ("gymnasium", "mujoco"),
     ),
 }

@@ -167,3 +167,70 @@ def test_default_horizon_untouched_and_fixed_horizon_rejected():
     assert agent.env.max_episode_steps == 500  # CartPole's own default
     with pytest.raises(TypeError, match="fixed horizon"):
         envs.make("catch", max_episode_steps=12)
+
+
+def test_catch_frame_stack_history():
+    """frames=4: channel k shows the board as of k steps ago — channel 0
+    of step t must reappear as channel k at step t+k."""
+    env = CatchPixels(grid=6, cell_px=2, frames=4)
+    assert env.obs_shape == (12, 12, 4)
+    state, obs = env.reset(jax.random.key(3))
+    assert obs.dtype == jnp.uint8
+    # warmup: all four channels show the initial board
+    for k in range(1, 4):
+        np.testing.assert_array_equal(
+            np.asarray(obs[..., k]), np.asarray(obs[..., 0])
+        )
+    frames_seen = [np.asarray(obs[..., 0])]
+    for _ in range(3):
+        state, obs, _, _, _ = env.step(
+            state, jnp.asarray(2), jax.random.key(0)
+        )
+        frames_seen.append(np.asarray(obs[..., 0]))
+        for k in range(1, 4):
+            idx = max(len(frames_seen) - 1 - k, 0)
+            np.testing.assert_array_equal(
+                np.asarray(obs[..., k]), frames_seen[idx]
+            )
+
+
+def test_pong_sim_is_nature_shape_and_high_param():
+    """The Atari-scale rung: exact Nature-DQN input (84,84,4) and a
+    >=1M-param conv policy (VERDICT r1 item 2 — the 'high-param FVP'
+    property the Atari rung exists to prove)."""
+    from trpo_tpu import envs
+    from trpo_tpu.models import make_policy
+
+    env = envs.make("pong-sim")
+    assert env.obs_shape == (84, 84, 4)
+    assert envs.is_device_env(env)
+    policy = make_policy(env.obs_shape, env.action_spec, hidden=(512,))
+    params = policy.init(jax.random.key(0))
+    n_params = sum(
+        int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params)
+    )
+    assert n_params >= 1_000_000, n_params
+
+
+def test_agent_iteration_pong_sim_small():
+    """Frame-stacked pixel env through the full fused iteration (small
+    grid so the CPU test stays fast; the real 84x84x4 shape is exercised
+    by bench_ladder's pong-sim rung on hardware)."""
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.envs import CatchPixels
+
+    env = CatchPixels(grid=6, cell_px=2, frames=4)
+    cfg = TRPOConfig(
+        env="pong-sim",
+        n_envs=2,
+        batch_timesteps=12,
+        policy_hidden=(32,),
+        vf_hidden=(32,),
+        vf_train_steps=2,
+        cg_iters=2,
+    )
+    agent = TRPOAgent(env, cfg)
+    state = agent.init_state(seed=0)
+    state, stats = agent.run_iteration(state)
+    assert np.isfinite(float(stats["entropy"]))

@@ -1,0 +1,79 @@
+"""Eval-time rendering + actionable gym construction errors.
+
+VERDICT r1: the only reference behavior without an equivalent was eval-mode
+``env.render()`` (``trpo_inksci.py:82``) — closed here via
+``TRPOAgent.evaluate(render=True)`` capturing rgb_array frames from the gym
+adapter; and the ``pong`` preset must fail actionably when its ALE backend
+is absent rather than surface a bare registry error.
+"""
+
+import importlib.util
+
+import numpy as np
+import pytest
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu import envs
+
+_has = lambda m: importlib.util.find_spec(m) is not None
+
+needs_gym = pytest.mark.skipif(
+    not _has("gymnasium"), reason="gymnasium unavailable"
+)
+
+_TINY = dict(
+    n_envs=2, batch_timesteps=32, cg_iters=3, vf_train_steps=3,
+    policy_hidden=(16,), vf_hidden=(16,), seed=0,
+)
+
+
+@needs_gym
+@pytest.mark.skipif(not _has("pygame"), reason="pygame (renderer) absent")
+def test_evaluate_render_captures_frames():
+    env = envs.make(
+        "gym:CartPole-v1", n_envs=2, render_mode="rgb_array"
+    )
+    agent = TRPOAgent(env, TRPOConfig(env="gym:CartPole-v1", **_TINY))
+    state = agent.init_state(seed=0)
+    mean_ret, n_done, frames = agent.evaluate(
+        state, n_steps=5, seed=1, render=True
+    )
+    assert np.isfinite(mean_ret)
+    assert len(frames) == 5
+    for f in frames:
+        assert f.ndim == 3 and f.shape[2] == 3 and f.dtype == np.uint8
+    env.close()
+
+
+@needs_gym
+def test_render_without_mode_is_actionable():
+    env = envs.make("gym:CartPole-v1", n_envs=2)
+    agent = TRPOAgent(env, TRPOConfig(env="gym:CartPole-v1", **_TINY))
+    state = agent.init_state(seed=0)
+    with pytest.raises(Exception, match="render_mode"):
+        agent.evaluate(state, n_steps=3, render=True)
+    env.close()
+
+
+def test_render_rejected_for_device_envs():
+    agent = TRPOAgent("cartpole", TRPOConfig(**_TINY))
+    state = agent.init_state(seed=0)
+    with pytest.raises(ValueError, match="host adapter"):
+        agent.evaluate(state, n_steps=3, render=True)
+
+
+@needs_gym
+@pytest.mark.skipif(
+    _has("ale_py"), reason="ale-py present — the pong preset would work"
+)
+def test_pong_preset_fails_actionably_without_ale():
+    """BASELINE config 5's real-Atari id must fail with a message naming
+    the missing backend and the on-device stand-in, not a bare registry
+    error (VERDICT r1 item 8)."""
+    with pytest.raises(RuntimeError) as ei:
+        envs.make("gym:ALE/Pong-v5", n_envs=1)
+    msg = str(ei.value)
+    assert "ALE/Pong-v5" in msg
+    assert "ale-py" in msg
+    assert "pong-sim" in msg

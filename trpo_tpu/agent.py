@@ -217,6 +217,22 @@ class TRPOAgent:
                     f"exceeds the adapter's n_envs={env_count}"
                 )
 
+        # host_inference="cpu": run rollout inference on the host CPU
+        # backend (params pushed once per iteration) so host-simulator
+        # collection pays ZERO device round trips — the accelerator only
+        # sees the batched update. The device act program stays the
+        # reference's per-step boundary (utils.py:28) generalized; this is
+        # the other side of that boundary choice.
+        self._host_inference_cpu = cfg.host_inference == "cpu"
+        if self._host_inference_cpu:
+            if self.is_device_env:
+                raise ValueError(
+                    'host_inference="cpu" applies to host-simulator envs '
+                    "(gym:/native:); device envs roll out inside the fused "
+                    "device program and have no host inference to move"
+                )
+            self._host_cpu_device = jax.devices("cpu")[0]
+
         # Data-parallel mesh: env states and rollout tensors shard over
         # "data"; params replicate; XLA inserts the psum reductions
         # (SURVEY §2.4 build obligation). None → single-device placement.
@@ -733,13 +749,23 @@ class TRPOAgent:
                 )
                 self._host_env_reset_pending = False
         act_fn = getattr(self, "_host_act_fn", None) or self._make_host_act()
+        params_roll = train_state.policy_params
+        if self._host_inference_cpu:
+            # one params download per iteration (vs one device round trip
+            # per env step); the CPU-committed params pull the whole act
+            # chain — key splits included — onto the host backend
+            cpu = self._host_cpu_device
+            params_roll = jax.device_put(params_roll, cpu)
+            rng = jax.device_put(rng, cpu)
+            if policy_state is not None:
+                policy_state = jax.device_put(policy_state, cpu)
         if self.cfg.host_pipeline_groups > 1:
             # overlap host env stepping with device inference (feedforward
             # only — enforced at construction)
             out = pipelined_host_rollout(
                 self.env,
                 self.policy,
-                train_state.policy_params,
+                params_roll,
                 rng,
                 self.n_steps,
                 n_groups=self.cfg.host_pipeline_groups,
@@ -749,7 +775,7 @@ class TRPOAgent:
             out = host_rollout(
                 self.env,
                 self.policy,
-                train_state.policy_params,
+                params_roll,
                 rng,
                 self.n_steps,
                 act_fn=act_fn,
@@ -765,6 +791,14 @@ class TRPOAgent:
             )
         if self.is_recurrent:
             traj, (h, prev_done) = out
+            if self._host_inference_cpu:
+                # drop the CPU commitment (via NumPy) so the carry joins
+                # the device-resident TrainState — a CPU-committed leaf
+                # would make the jitted processing reject the mixed state
+                h, prev_done = np.asarray(h), np.asarray(prev_done)
+                traj = traj._replace(
+                    policy_h0=jnp.asarray(np.asarray(traj.policy_h0))
+                )
             new_carry = (jnp.asarray(h), jnp.asarray(prev_done))
             if self.mesh is not None:
                 # keep the placement init_state established (env axis
@@ -803,7 +837,11 @@ class TRPOAgent:
     def _make_host_act(self):
         from trpo_tpu.rollout import make_host_act_fn
 
-        self._host_act_fn = make_host_act_fn(self.policy)
+        # CPU inference has no transfer round trip to amortize — skip the
+        # packed single-fetch concat and return plain arrays
+        self._host_act_fn = make_host_act_fn(
+            self.policy, pack=not self._host_inference_cpu
+        )
         return self._host_act_fn
 
     # ------------------------------------------------------------------
@@ -811,15 +849,22 @@ class TRPOAgent:
     # ------------------------------------------------------------------
 
     def evaluate(self, train_state: TrainState, n_steps: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0, render: bool = False):
         """Greedy-policy evaluation: fresh episodes, mode/argmax actions.
 
         The reference, after hitting its reward target, flips ``train=False``
-        and runs 100 more render+argmax batches (``trpo_inksci.py:137-141``).
-        This is that phase as a function: ``n_steps`` timesteps per env
-        (default: one training batch's worth), no parameter updates, no
-        render. Returns ``(mean_episode_reward, episodes_completed)``
-        over episodes that finish inside the window.
+        and runs 100 more render+argmax batches (``trpo_inksci.py:137-141``,
+        rendering inside eval-mode ``act`` at ``trpo_inksci.py:82``). This
+        is that phase as a function: ``n_steps`` timesteps per env (default:
+        one training batch's worth), no parameter updates. Returns
+        ``(mean_episode_reward, episodes_completed)`` over episodes that
+        finish inside the window.
+
+        ``render=True`` (host simulators with a renderer, e.g. ``gym:``
+        adapters constructed with ``render_mode="rgb_array"``) captures one
+        RGB frame of env 0 per step and returns
+        ``(mean_episode_reward, episodes_completed, frames)`` — the
+        pull-based equivalent of the reference's per-step ``env.render()``.
 
         Device envs evaluate on a fresh carry — training env state is
         untouched. Host simulators are shared mutable state, so evaluation
@@ -831,6 +876,18 @@ class TRPOAgent:
         n_steps = self.n_steps if n_steps is None else n_steps
         if n_steps < 1:
             raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+        frames: list = []
+        step_callback = None
+        if render:
+            if self.is_device_env or not hasattr(self.env, "render_frame"):
+                raise ValueError(
+                    "render=True needs a host adapter with a renderer — "
+                    "construct the env with rendering enabled, e.g. "
+                    "envs.make('gym:<Id>', render_mode='rgb_array') "
+                    "(pure-JAX device envs and the native C++ stepper "
+                    "have no pixel renderer)"
+                )
+            step_callback = lambda t: frames.append(self.env.render_frame())
         k_init, k_roll = jax.random.split(jax.random.key(seed))
         if self.is_device_env:
             fn = self._eval_roll_fns.get(n_steps)
@@ -857,6 +914,12 @@ class TRPOAgent:
                     tuple(np.asarray(x) for x in train_state.obs_norm)
                 )
                 self.env.freeze_obs_stats(True)
+            eval_params = train_state.policy_params
+            if self._host_inference_cpu:
+                eval_params = jax.device_put(
+                    eval_params, self._host_cpu_device
+                )
+                k_roll = jax.device_put(k_roll, self._host_cpu_device)
             try:
                 self.env.reset_all(seed=seed)
                 if self.is_recurrent:
@@ -866,8 +929,9 @@ class TRPOAgent:
                     # next run_iteration starts from zeroed hidden state.
                     self._host_env_reset_pending = True
                     traj, _ = host_rollout(
-                        self.env, self.policy, train_state.policy_params,
+                        self.env, self.policy, eval_params,
                         k_roll, n_steps, deterministic=True,
+                        step_callback=step_callback,
                     )
                 else:
                     if self._host_eval_act_fn is None:
@@ -875,16 +939,25 @@ class TRPOAgent:
                         from trpo_tpu.rollout import make_host_act_fn
 
                         self._host_eval_act_fn = make_host_act_fn(
-                            self.policy, deterministic=True
+                            self.policy,
+                            deterministic=True,
+                            pack=not self._host_inference_cpu,
                         )
                     traj = host_rollout(
-                        self.env, self.policy, train_state.policy_params,
+                        self.env, self.policy, eval_params,
                         k_roll, n_steps, act_fn=self._host_eval_act_fn,
+                        step_callback=step_callback,
                     )
-                self.env.reset_all()
             finally:
-                if self._obs_norm_host:
-                    self.env.freeze_obs_stats(False)
+                # hard-reset EVEN on failure (e.g. render_frame raising):
+                # the docstring's "subsequent learn resumes from clean
+                # episode boundaries" must hold for callers that catch
+                # the error and keep training
+                try:
+                    self.env.reset_all()
+                finally:
+                    if self._obs_norm_host:
+                        self.env.freeze_obs_stats(False)
         done = np.asarray(traj.done)
         rets = np.asarray(traj.episode_return)
         n_done = int(done.sum())
@@ -895,6 +968,8 @@ class TRPOAgent:
             # an unbounded task) — report the partial-episode return, which
             # lower-bounds the true mean; episodes_completed = 0 signals it
             mean_ret = float(rets[-1].mean())
+        if render:
+            return mean_ret, n_done, frames
         return mean_ret, n_done
 
     # ------------------------------------------------------------------

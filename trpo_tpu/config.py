@@ -124,6 +124,23 @@ class TRPOConfig:
     #                                policies; needs an adapter with
     #                                host_step_slice (gym:/native: both have
     #                                it).
+    host_inference: str = "device"  # host-simulator envs only: where rollout
+    #                                policy inference runs. "device" jits it
+    #                                on the default (TPU) backend — right
+    #                                when the policy is big enough to beat
+    #                                the transfer cost. "cpu" jits the SAME
+    #                                act program on the host CPU backend:
+    #                                params are pushed to host memory once
+    #                                per iteration and every env step stays
+    #                                on the host — zero device round trips
+    #                                during collection, the accelerator only
+    #                                sees the batched update. On a tunneled
+    #                                TPU (~100 ms/round trip) this is the
+    #                                difference between ~10 and ~1000s of
+    #                                env-steps/s for small MLP policies.
+    #                                Replaces the reference's per-step
+    #                                sess.run boundary (utils.py:28) with a
+    #                                *choice* of boundary.
     mesh_shape: Optional[Tuple[int, ...]] = None  # None → single device, no
     #                                mesh; set e.g. (8,) for data parallelism
     mesh_axes: Tuple[str, ...] = ("data",)
@@ -150,6 +167,11 @@ class TRPOConfig:
     def __post_init__(self):
         # fail at construction, not mid-training: inverted feedback knobs
         # would silently make conditioning worse on every failure signal
+        if self.host_inference not in ("device", "cpu"):
+            raise ValueError(
+                'host_inference must be "device" or "cpu", got '
+                f"{self.host_inference!r}"
+            )
         if self.adaptive_damping:
             if not self.damping_grow > 1.0:
                 raise ValueError(
@@ -250,6 +272,18 @@ PRESETS = {
         lam=0.95,
         batch_timesteps=2048,
         # no max_pathlength: a Catch episode is fixed at grid-1 = 9 steps
+        n_envs=8,        # BASELINE.json: "8 vectorized envs"
+        policy_hidden=(512,),
+    ),
+    # On-device Atari-scale pixel rung: 84×84×4 frame-stacked uint8 obs,
+    # Nature conv torso + 512 dense head (≈1.7M params) — the high-param
+    # conv FVP of BASELINE.json config 5 at the TRUE input shape, without
+    # the (absent) ALE binaries. Episodes are grid−1 = 20 steps.
+    "pong-sim": TRPOConfig(
+        env="pong-sim",
+        gamma=0.99,
+        lam=0.95,
+        batch_timesteps=2048,
         n_envs=8,        # BASELINE.json: "8 vectorized envs"
         policy_hidden=(512,),
     ),

@@ -13,6 +13,8 @@ are supported through a vectorized adapter with batched device inference.
 - ``"chain"``, ``"halfcheetah-sim"``, ``"humanoid-sim"`` → pure-JAX
   continuous-control rungs at MuJoCo dimensions (BASELINE.json configs 3-4)
 - ``"catch"`` → pure-JAX pixel env for the conv-policy rung (config 5)
+- ``"pong-sim"`` → Catch at the Nature-DQN Atari shape (84×84×4
+  frame-stacked pixels; the high-param conv-FVP rung on device)
 - ``"native:cartpole"``, ``"native:pendulum"`` → C++ batched host stepper
   (``native/vec_env.cpp`` via ctypes; builds lazily with g++)
 - ``"gym:<EnvId>"`` → gymnasium adapter (requires gymnasium + the env's deps)
@@ -28,6 +30,13 @@ from trpo_tpu.envs.locomotion import (  # noqa: F401
 )
 from trpo_tpu.envs.catch import CatchPixels  # noqa: F401
 from trpo_tpu.envs.wrappers import MaskObservation  # noqa: F401
+
+
+def _pong_sim(grid: int = 21, cell_px: int = 4, frames: int = 4):
+    """Catch at the exact Nature-DQN Atari input shape — 84×84×4 uint8
+    frame-stacked pixels (BASELINE.json config 5's on-device stand-in at
+    true conv-FVP scale; the real-Atari path is ``gym:ALE/Pong-v5``)."""
+    return CatchPixels(grid=grid, cell_px=cell_px, frames=frames)
 
 
 def _cartpole_po(max_episode_steps: int = 500):
@@ -47,6 +56,7 @@ _JAX_ENVS = {
     "halfcheetah-sim": HalfCheetahSim,
     "humanoid-sim": HumanoidSim,
     "catch": CatchPixels,
+    "pong-sim": _pong_sim,
 }
 
 

@@ -9,6 +9,13 @@ on the bottom row) — rendered as uint8 images sized for the Nature-DQN conv
 torso (``models/conv.py``). Everything (dynamics + rendering) is jittable,
 so conv-policy rollouts run inside the same fused ``lax.scan`` program as
 the vector envs, exercising the high-param FVP path end to end on TPU.
+
+``frames > 1`` renders the last ``frames`` board positions as stacked
+channels (newest first) — the pixel-history observation DQN-style Atari
+preprocessing produces by frame-stacking. ``CatchPixels(grid=21, cell_px=4,
+frames=4)`` is exactly the Nature input shape: 84×84×4 uint8 (the
+``"pong-sim"`` registry name), putting the conv FVP at true Atari scale
+(≥1.6M-param policy with the standard 512-dense head).
 """
 
 from __future__ import annotations
@@ -28,46 +35,70 @@ class CatchState(NamedTuple):
     ball_col: jax.Array    # int32
     paddle_col: jax.Array  # int32 (paddle lives on the bottom row)
     t: jax.Array           # int32 step counter
+    hist: jax.Array        # (frames, 3) int32 [ball_row, ball_col,
+    #                        paddle_col] of the last `frames` boards,
+    #                        newest first (row 0 == the current state).
+    #                        NOTE: adding this field (round 2) changed the
+    #                        TrainState.env_carry pytree for catch runs —
+    #                        checkpoints saved before frame-stacking
+    #                        existed do not restore into the new template
 
 
 class CatchPixels:
-    """``grid×grid`` Catch rendered at ``cell_px`` px/cell, (H, W, 1) uint8.
+    """``grid×grid`` Catch rendered at ``cell_px`` px/cell, (H, W, frames)
+    uint8 — channel ``k`` shows the board as of ``k`` steps ago.
 
     Actions: 0 = left, 1 = stay, 2 = right. The ball falls one row per
     step; when it reaches the bottom row the episode terminates with
     reward +1 if the paddle is under it, −1 otherwise. Default 10×10 grid
-    at 4 px/cell → 40×40×1 observations (Nature-DQN torso → 1×1×64 feats).
+    at 4 px/cell, single frame → 40×40×1 observations (Nature-DQN torso →
+    1×1×64 feats); ``grid=21, cell_px=4, frames=4`` → the 84×84×4 Atari
+    rung.
     """
 
-    def __init__(self, grid: int = 10, cell_px: int = 4):
+    def __init__(self, grid: int = 10, cell_px: int = 4, frames: int = 1):
+        if frames < 1:
+            raise ValueError(f"frames must be >= 1, got {frames}")
         self.grid = grid
         self.cell_px = cell_px
+        self.frames = frames
         side = grid * cell_px
-        self.obs_shape = (side, side, 1)
+        self.obs_shape = (side, side, frames)
         self.action_spec = DiscreteSpec(3)
 
     def reset(self, key):
         col = jax.random.randint(key, (), 0, self.grid)
+        ball_row = jnp.asarray(0, jnp.int32)
+        ball_col = col.astype(jnp.int32)
+        paddle_col = jnp.asarray(self.grid // 2, jnp.int32)
+        frame = jnp.stack([ball_row, ball_col, paddle_col])
         state = CatchState(
-            ball_row=jnp.asarray(0, jnp.int32),
-            ball_col=col.astype(jnp.int32),
-            paddle_col=jnp.asarray(self.grid // 2, jnp.int32),
+            ball_row=ball_row,
+            ball_col=ball_col,
+            paddle_col=paddle_col,
             t=jnp.asarray(0, jnp.int32),
+            # pre-episode history: the initial board, repeated — the
+            # standard frame-stack warmup
+            hist=jnp.tile(frame[None, :], (self.frames, 1)),
         )
         return state, self._obs(state)
 
-    def _obs(self, s: CatchState):
+    def _render_frame(self, ball_row, ball_col, paddle_col):
         g, px = self.grid, self.cell_px
         rows = jnp.arange(g)
-        ball = (
-            (rows == s.ball_row)[:, None] * (rows == s.ball_col)[None, :]
-        )
-        paddle = (
-            (rows == g - 1)[:, None] * (rows == s.paddle_col)[None, :]
-        )
+        ball = (rows == ball_row)[:, None] * (rows == ball_col)[None, :]
+        paddle = (rows == g - 1)[:, None] * (rows == paddle_col)[None, :]
         cells = jnp.logical_or(ball, paddle)
         img = jnp.repeat(jnp.repeat(cells, px, axis=0), px, axis=1)
-        return (img[..., None] * 255).astype(jnp.uint8)
+        return (img * 255).astype(jnp.uint8)
+
+    def _obs(self, s: CatchState):
+        # (frames, H, W) → (H, W, frames): channels-last is the TPU-native
+        # conv layout (models/conv.py)
+        frames = jax.vmap(
+            lambda f: self._render_frame(f[0], f[1], f[2])
+        )(s.hist)
+        return jnp.transpose(frames, (1, 2, 0))
 
     def step(self, state: CatchState, action, key):
         del key
@@ -75,7 +106,9 @@ class CatchPixels:
         paddle = jnp.clip(state.paddle_col + move, 0, self.grid - 1)
         ball_row = state.ball_row + 1
         t = state.t + 1
-        new_state = CatchState(ball_row, state.ball_col, paddle, t)
+        frame = jnp.stack([ball_row, state.ball_col, paddle])
+        hist = jnp.concatenate([frame[None, :], state.hist[:-1]], axis=0)
+        new_state = CatchState(ball_row, state.ball_col, paddle, t, hist)
 
         at_bottom = ball_row >= self.grid - 1
         caught = jnp.logical_and(at_bottom, paddle == state.ball_col)

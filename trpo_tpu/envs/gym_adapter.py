@@ -38,7 +38,42 @@ class GymVecEnv(EpisodeStatsMixin, ObsNormMixin):
         self._gym = gymnasium
         self.env_id = env_id
         self.n_envs = n_envs
-        self.envs = [gymnasium.make(env_id, **kwargs) for _ in range(n_envs)]
+        self.envs = []
+        try:
+            for _ in range(n_envs):
+                self.envs.append(gymnasium.make(env_id, **kwargs))
+        except Exception as e:
+            # release whatever was constructed before the failure (native
+            # simulator / render contexts don't wait for GC politely)
+            for env in self.envs:
+                try:
+                    env.close()
+                except Exception:
+                    pass
+            # Re-diagnose ONLY missing-dependency failures (absent ale-py
+            # for ALE/*, absent mujoco for MuJoCo ids); anything else —
+            # typo'd ids, bad kwargs — propagates gymnasium's own
+            # accurate error (e.g. "did you mean CartPole-v1")
+            err_mod = getattr(gymnasium, "error", None)
+            dep_types = tuple(
+                t
+                for t in (
+                    ImportError,
+                    getattr(err_mod, "DependencyNotInstalled", None),
+                    getattr(err_mod, "NamespaceNotFound", None),
+                )
+                if isinstance(t, type)
+            )
+            if not isinstance(e, dep_types):
+                raise
+            raise RuntimeError(
+                f"could not construct gym env {env_id!r}: {e}\n"
+                "The id's simulator backend is likely not installed "
+                "(ALE/* needs the 'ale-py' package; MuJoCo ids need "
+                "'mujoco'). Install it, or use an on-device stand-in: "
+                "'pong-sim' (84x84x4 pixel rung) for ALE/Pong, "
+                "'humanoid-sim'/'halfcheetah-sim' for the MuJoCo rungs."
+            ) from e
         single = self.envs[0]
         self.obs_shape = tuple(single.observation_space.shape)
         space = single.action_space
@@ -143,6 +178,22 @@ class GymVecEnv(EpisodeStatsMixin, ObsNormMixin):
         # a copy: group stepping (host_step_slice) updates the cache in
         # place, and callers buffer what this returns
         return self._obs.copy()
+
+    def render_frame(self) -> np.ndarray:
+        """RGB frame of env 0 — eval-time rendering (the reference renders
+        inside eval-mode ``act``, ``trpo_inksci.py:82``; here a pull-based
+        hook the agent's ``evaluate(render=True)`` drives per step).
+        Requires construction with ``render_mode="rgb_array"`` (forwarded
+        to ``gymnasium.make`` via ``**kwargs``)."""
+        frame = self.envs[0].render()
+        if frame is None:
+            raise RuntimeError(
+                "rendering returned None — construct the adapter with "
+                "GymVecEnv(env_id, render_mode='rgb_array') (or pass "
+                "render_mode through envs.make('gym:<Id>', "
+                "render_mode='rgb_array'))"
+            )
+        return np.asarray(frame)
 
     def close(self):
         for env in self.envs:

@@ -331,6 +331,7 @@ def host_rollout(
     act_fn=None,
     policy_state=None,
     deterministic: bool = False,
+    step_callback=None,
 ):
     """Collect a ``(T, N)`` trajectory from a host vectorized env.
 
@@ -370,13 +371,25 @@ def host_rollout(
 
     for t in range(T):
         key, k_act = jax.random.split(key)
+        # obs stays a NumPy array: jit places it with the computation,
+        # which follows the COMMITTED params — on-device params keep the
+        # old behavior, CPU-committed params (host_inference="cpu") keep
+        # the whole act chain on the host with zero device round trips.
+        # A jnp.asarray here would pin obs to the default (device) backend
+        # and force a transfer per step in CPU-inference mode.
         if recurrent:
-            actions, dist, h_new = act_fn(params, jnp.asarray(obs), k_act, h)
+            actions, dist, h_new = act_fn(params, obs, k_act, h)
             reset_buf.append(np.asarray(prev_done).copy())
             h_pre_buf.append(np.asarray(h))
             h_post_buf.append(np.asarray(h_new))
         else:
-            actions, dist = act_fn(params, jnp.asarray(obs), k_act)
+            actions, dist = act_fn(params, obs, k_act)
+        if step_callback is not None:
+            # pre-step hook, reference semantics: the frame shows the state
+            # the policy just acted on (the ref renders inside eval-mode
+            # act, trpo_inksci.py:82) — after host_step, finished envs are
+            # already auto-reset and the acted-on state is gone
+            step_callback(t)
         actions_np = np.asarray(actions)
         next_obs, rewards, terminated, truncated, final_obs = vec_env.host_step(
             actions_np
@@ -395,8 +408,9 @@ def host_rollout(
         len_buf.append(vec_env.last_episode_lengths.copy())
         obs = next_obs
         if recurrent:
-            # zero memory at episode boundaries (device-path parity)
-            h = jnp.where(jnp.asarray(done)[:, None], 0.0, h_new)
+            # zero memory at episode boundaries (device-path parity);
+            # done stays NumPy so the where runs wherever h_new lives
+            h = jnp.where(done[:, None], 0.0, h_new)
             prev_done = done
 
     stack = lambda xs: jnp.asarray(np.stack(xs))
@@ -516,8 +530,12 @@ def pipelined_host_rollout(
         b = buf[g]
         obs = obs0[lo:hi]
         for t in range(T):
+            # NumPy obs: placement follows the committed params (see
+            # host_rollout) — also what keeps this thread's dispatch on
+            # the CPU backend under host_inference="cpu", where thread-
+            # local default-device context would not propagate here
             actions_dev, dist_dev = act_fn(
-                params, jnp.asarray(obs), keys[t * n_groups + g]
+                params, obs, keys[t * n_groups + g]
             )
             # blocks on THIS group's chain only; the other groups step
             # their envs / fetch their actions concurrently

@@ -132,6 +132,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="running observation normalization (device envs)",
     )
     p.add_argument(
+        "--host-inference",
+        choices=("device", "cpu"),
+        help="where host-simulator rollout inference runs: the default "
+        "accelerator ('device') or the host CPU backend ('cpu' — zero "
+        "device round trips during collection; right for small policies "
+        "on high-latency links)",
+    )
+    p.add_argument(
         "--profile-dir",
         help="write a jax.profiler (TensorBoard/Perfetto) trace of the run "
         "here; phase names from PhaseTimer annotate the timeline",
@@ -167,6 +175,7 @@ _OVERRIDES = {
     "policy_cell": "policy_cell",
     "policy_experts": "policy_experts",
     "host_pipeline_groups": "host_pipeline_groups",
+    "host_inference": "host_inference",
     "compute_dtype": "compute_dtype",
     "log_jsonl": "log_jsonl",
     "checkpoint_dir": "checkpoint_dir",
