@@ -845,6 +845,34 @@ class TRPOAgent:
         return self._host_act_fn
 
     # ------------------------------------------------------------------
+    # host-env checkpoint sidecar (SURVEY §5 checkpoint obligation)
+    # ------------------------------------------------------------------
+
+    def snapshot_host_env(self):
+        """Host-simulator resume state, or None (device envs keep theirs
+        in ``TrainState.env_carry``; adapters without a snapshot surface
+        restart episodes on resume — the documented fallback)."""
+        if self.is_device_env or not hasattr(
+            self.env, "env_state_snapshot"
+        ):
+            return None
+        return self.env.env_state_snapshot()
+
+    def restore_host_env(self, snapshot) -> None:
+        """Install a sidecar snapshot captured by :meth:`snapshot_host_env`
+        (no-op for ``None`` — the restart-semantics fallback)."""
+        if snapshot is None:
+            return
+        if self.is_device_env or not hasattr(
+            self.env, "env_state_restore"
+        ):
+            raise ValueError(
+                "this agent's env has no host snapshot surface — the "
+                "sidecar belongs to a gym:/native: adapter run"
+            )
+        self.env.env_state_restore(snapshot)
+
+    # ------------------------------------------------------------------
     # evaluate (ref trpo_inksci.py:137-141 — the post-stop eval phase)
     # ------------------------------------------------------------------
 
@@ -1072,6 +1100,14 @@ class TRPOAgent:
                     > (it_end - k) // cfg.checkpoint_every
                 ):
                     checkpointer.save(it_end, state)
+                    # host-simulator state sidecar (exact resume for
+                    # native:, best-effort for gym: — see
+                    # utils/checkpoint.py); device envs carry theirs in
+                    # TrainState.env_carry already
+                    if hasattr(checkpointer, "save_host_env"):
+                        checkpointer.save_host_env(
+                            it_end, self.snapshot_host_env()
+                        )
                 if stop:
                     break
         finally:

@@ -50,3 +50,22 @@ class EpisodeStatsMixin:
         self.last_episode_lengths[lo:hi] = self._running_lengths[lo:hi]
         self._running_returns[lo:hi][ended] = 0.0
         self._running_lengths[lo:hi][ended] = 0
+
+    # -- checkpoint mirror -------------------------------------------------
+
+    def _episode_stats_snapshot(self) -> dict:
+        """Copy of the counters for host-env checkpoint sidecars (SURVEY §5
+        checkpoint obligation; the device path carries its counters in
+        TrainState)."""
+        return {
+            "running_returns": self._running_returns.copy(),
+            "running_lengths": self._running_lengths.copy(),
+            "last_returns": self.last_episode_returns.copy(),
+            "last_lengths": self.last_episode_lengths.copy(),
+        }
+
+    def _episode_stats_restore(self, snap: dict) -> None:
+        self._running_returns[:] = snap["running_returns"]
+        self._running_lengths[:] = snap["running_lengths"]
+        self.last_episode_returns[:] = snap["last_returns"]
+        self.last_episode_lengths[:] = snap["last_lengths"]

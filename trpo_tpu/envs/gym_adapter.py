@@ -179,6 +179,106 @@ class GymVecEnv(EpisodeStatsMixin, ObsNormMixin):
         # place, and callers buffer what this returns
         return self._obs.copy()
 
+    # -- checkpoint fidelity (best-effort for external simulators) --------
+
+    @staticmethod
+    def _find_time_limit(env):
+        """The wrapper carrying TimeLimit's ``_elapsed_steps``, wherever
+        it sits in the chain; None when the env has no TimeLimit."""
+        e = env
+        while e is not None and e is not getattr(e, "unwrapped", None):
+            if hasattr(e, "_elapsed_steps"):
+                return e
+            e = getattr(e, "env", None)
+        return None
+
+    def env_state_snapshot(self) -> dict:
+        """Best-effort mid-episode resume state (SURVEY §5 checkpoint
+        obligation): episode counters + obs cache always; per-env
+        simulator state where the backend exposes it — MuJoCo
+        (qpos/qvel/time via ``MujocoEnv.set_state``) and classic control
+        (the ``state`` attribute). Envs whose simulator hides its state
+        snapshot as ``None`` and restart episodes on restore (documented
+        restart semantics; obs-norm statistics ride TrainState either
+        way)."""
+        sims = []
+        for env in self.envs:
+            u = env.unwrapped
+            tl = self._find_time_limit(env)
+            elapsed = None if tl is None else tl._elapsed_steps
+            if hasattr(u, "data") and hasattr(u, "set_state"):
+                sims.append({
+                    "backend": "mujoco",
+                    "qpos": np.asarray(u.data.qpos, np.float64).copy(),
+                    "qvel": np.asarray(u.data.qvel, np.float64).copy(),
+                    "time": float(u.data.time),
+                    "elapsed": elapsed,
+                })
+            elif getattr(u, "state", None) is not None:
+                sims.append({
+                    "backend": "state",
+                    "state": np.asarray(u.state, np.float64).copy(),
+                    "elapsed": elapsed,
+                })
+            else:
+                sims.append(None)  # opaque simulator — restart on restore
+        snap = {
+            "env_id": self.env_id,
+            "sims": sims,
+            "obs": self._obs.copy(),
+            **self._episode_stats_snapshot(),
+        }
+        if self.has_obs_norm:
+            snap["raw_obs"] = self._raw_obs.copy()
+        return snap
+
+    def env_state_restore(self, snap: dict) -> None:
+        if snap.get("env_id") != self.env_id:
+            raise ValueError(
+                f"snapshot is for {snap.get('env_id')!r}, this adapter "
+                f"is {self.env_id!r}"
+            )
+        if len(snap["sims"]) != self.n_envs:
+            raise ValueError(
+                f"snapshot holds {len(snap['sims'])} envs, this adapter "
+                f"has {self.n_envs} — resume with the same n_envs"
+            )
+        reset_obs = {}
+        for i, (env, sim) in enumerate(zip(self.envs, snap["sims"])):
+            if sim is None:
+                # opaque backend: documented restart — this env begins a
+                # FRESH episode, so it must see the reset obs and zeroed
+                # counters, not the dead pre-checkpoint episode's
+                obs_i, _ = env.reset()
+                reset_obs[i] = np.asarray(obs_i)
+                continue
+            u = env.unwrapped
+            # reset first: wrappers (TimeLimit) and lazy backend state
+            # need a live episode to overwrite
+            env.reset()
+            if sim["backend"] == "mujoco":
+                u.set_state(sim["qpos"], sim["qvel"])
+                u.data.time = sim["time"]
+            else:
+                u.state = np.asarray(sim["state"], np.float64)
+            if sim.get("elapsed") is not None:
+                tl = self._find_time_limit(env)
+                if tl is not None:
+                    tl._elapsed_steps = sim["elapsed"]
+        self._obs = np.asarray(snap["obs"]).copy()
+        if self.has_obs_norm and "raw_obs" in snap:
+            self._raw_obs = np.asarray(snap["raw_obs"]).copy()
+        self._episode_stats_restore(snap)
+        for i, raw in reset_obs.items():
+            if self.has_obs_norm:
+                self._raw_obs[i] = raw
+                with self._norm_lock:
+                    self._obs[i] = self._apply_norm(raw)
+            else:
+                self._obs[i] = raw
+            self._running_returns[i] = 0.0
+            self._running_lengths[i] = 0
+
     def render_frame(self) -> np.ndarray:
         """RGB frame of env 0 — eval-time rendering (the reference renders
         inside eval-mode ``act``, ``trpo_inksci.py:82``; here a pull-based

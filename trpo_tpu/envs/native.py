@@ -254,3 +254,44 @@ class NativeVecEnv(EpisodeStatsMixin, ObsNormMixin):
 
     def close(self):
         pass
+
+    # -- checkpoint fidelity (exact for native envs) -----------------------
+
+    def env_state_snapshot(self) -> dict:
+        """EXACT resume state: simulator buffers live host-side (the C++
+        stepper mutates these numpy arrays in place), so unlike external
+        simulators nothing is hidden — state + step counters + per-env RNG
+        streams + episode counters + obs cache round-trip bitwise. The
+        agent's checkpoint path stores this as a host sidecar next to the
+        Orbax TrainState (utils/checkpoint.py)."""
+        snap = {
+            "kind": self.kind,
+            "state": self._state.copy(),
+            "t": self._t.copy(),
+            "rng": self._rng.copy(),
+            "obs": self._obs.copy(),
+            **self._episode_stats_snapshot(),
+        }
+        if self.has_obs_norm:
+            snap["raw_obs"] = self._raw_obs.copy()
+        return snap
+
+    def env_state_restore(self, snap: dict) -> None:
+        if snap.get("kind") != self.kind:
+            raise ValueError(
+                f"snapshot is for native env {snap.get('kind')!r}, "
+                f"this adapter is {self.kind!r}"
+            )
+        if np.asarray(snap["state"]).shape != self._state.shape:
+            raise ValueError(
+                f"snapshot holds {np.asarray(snap['state']).shape[0]} "
+                f"envs, this adapter has {self.n_envs} — resume with the "
+                "same n_envs"
+            )
+        self._state[:] = snap["state"]
+        self._t[:] = snap["t"]
+        self._rng[:] = snap["rng"]
+        self._obs = np.asarray(snap["obs"]).copy()
+        if self.has_obs_norm and "raw_obs" in snap:
+            self._raw_obs = np.asarray(snap["raw_obs"]).copy()
+        self._episode_stats_restore(snap)

@@ -263,6 +263,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         checkpointer = Checkpointer(cfg.checkpoint_dir)
         if args.resume and checkpointer.latest_step() is not None:
             state = checkpointer.restore(agent.init_state())
+            # host-simulator sidecar: exact resume for native:, best-effort
+            # for gym: (None → documented episode-restart semantics)
+            agent.restore_host_env(checkpointer.restore_host_env())
             print(f"resumed from step {checkpointer.latest_step()}")
 
     logger = StatsLogger(jsonl_path=cfg.log_jsonl)

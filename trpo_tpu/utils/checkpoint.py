@@ -5,6 +5,14 @@
 Orbax checkpoints of the full :class:`trpo_tpu.agent.TrainState` (policy +
 critic + optimizer + env carry + RNG + counters), so a resumed run continues
 exactly where it stopped, including mid-episode env states.
+
+Host-simulator state (gym:/native: adapters) lives OUTSIDE TrainState and
+rides as a pickle sidecar next to the Orbax step (:meth:`save_host_env` /
+:meth:`restore_host_env`): exact resume for ``native:`` envs (their
+state/step/RNG buffers are host NumPy), best-effort for ``gym:`` (MuJoCo
+``qpos``/``qvel``/time, classic-control ``state``, TimeLimit counters),
+and for opaque backends the documented fallback — episodes restart on
+resume while obs-normalization statistics still restore via TrainState.
 """
 
 from __future__ import annotations
@@ -98,6 +106,52 @@ class Checkpointer:
                 seed = jnp.zeros(seed.shape, seed.dtype)
             restored = restored._replace(cg_damping=seed)
         return restored
+
+    # -- host-env sidecar --------------------------------------------------
+    #
+    # Host-simulator state (envs/*.env_state_snapshot) is host-side NumPy
+    # with backend-specific, sometimes-absent pieces — it does not belong
+    # in the device-resident TrainState pytree (which must keep a stable
+    # jit template). It rides NEXT TO the Orbax step as a pickle sidecar:
+    # exact resume for native: envs, best-effort (MuJoCo qpos/qvel/time,
+    # classic-control state) for gym: envs, documented episode-restart
+    # for opaque backends.
+
+    def _aux_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"host_env_{step}.pkl")
+
+    def save_host_env(self, step: int, snapshot) -> None:
+        import pickle
+
+        if snapshot is None:
+            return
+        with open(self._aux_path(step), "wb") as f:
+            pickle.dump(snapshot, f)
+        # prune sidecars whose Orbax step was garbage-collected
+        keep = {self._aux_path(s) for s in self.manager.all_steps()}
+        keep.add(self._aux_path(step))
+        for name in os.listdir(self.directory):
+            if name.startswith("host_env_") and name.endswith(".pkl"):
+                p = os.path.join(self.directory, name)
+                if p not in keep:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+
+    def restore_host_env(self, step: Optional[int] = None):
+        """The sidecar for ``step`` (default: latest), or None if that
+        checkpoint predates sidecars / the env needed none."""
+        import pickle
+
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        try:
+            with open(self._aux_path(step), "rb") as f:
+                return pickle.load(f)
+        except FileNotFoundError:
+            return None
 
     def close(self):
         self.manager.close()
