@@ -243,6 +243,14 @@ class GymVecEnv(EpisodeStatsMixin, ObsNormMixin):
                 f"snapshot holds {len(snap['sims'])} envs, this adapter "
                 f"has {self.n_envs} — resume with the same n_envs"
             )
+        if self.has_obs_norm and "raw_obs" not in snap:
+            # silently continuing would leave _obs/_raw_obs inconsistent
+            # (set_obs_stats_state re-normalizes from construction-time
+            # raw obs while the simulator sits mid-episode)
+            raise ValueError(
+                "snapshot was taken without normalize_obs; resume with "
+                "the same normalize_obs setting"
+            )
         reset_obs = {}
         for i, (env, sim) in enumerate(zip(self.envs, snap["sims"])):
             if sim is None:

@@ -288,6 +288,11 @@ class NativeVecEnv(EpisodeStatsMixin, ObsNormMixin):
                 f"envs, this adapter has {self.n_envs} — resume with the "
                 "same n_envs"
             )
+        if self.has_obs_norm and "raw_obs" not in snap:
+            raise ValueError(
+                "snapshot was taken without normalize_obs; resume with "
+                "the same normalize_obs setting"
+            )
         self._state[:] = snap["state"]
         self._t[:] = snap["t"]
         self._rng[:] = snap["rng"]

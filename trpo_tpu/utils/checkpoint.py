@@ -125,8 +125,13 @@ class Checkpointer:
 
         if snapshot is None:
             return
-        with open(self._aux_path(step), "wb") as f:
+        # atomic: a crash mid-dump must not leave a truncated sidecar for
+        # the next resume to choke on (the Orbax side is already
+        # crash-safe via save + wait_until_finished)
+        tmp = self._aux_path(step) + ".tmp"
+        with open(tmp, "wb") as f:
             pickle.dump(snapshot, f)
+        os.replace(tmp, self._aux_path(step))
         # prune sidecars whose Orbax step was garbage-collected
         keep = {self._aux_path(s) for s in self.manager.all_steps()}
         keep.add(self._aux_path(step))
@@ -151,6 +156,17 @@ class Checkpointer:
             with open(self._aux_path(step), "rb") as f:
                 return pickle.load(f)
         except FileNotFoundError:
+            return None
+        except (OSError, EOFError, pickle.UnpicklingError) as e:
+            # unreadable/corrupt sidecar: fall back to the documented
+            # episode-restart semantics rather than sinking the resume
+            import sys
+
+            print(
+                f"checkpoint: host-env sidecar for step {step} unreadable "
+                f"({type(e).__name__}) — episodes will restart",
+                file=sys.stderr,
+            )
             return None
 
     def close(self):
