@@ -34,26 +34,29 @@ from trpo_tpu.config import get_preset  # noqa: E402
 # name -> (K iterations, overrides) — device-env rungs: the ladder times
 # the fused on-device pipeline. (Variant rungs below carry the base preset
 # explicitly: name -> (preset, K, overrides).)
+# K is sized so the timed window (K × iter time) is several× the ~110 ms
+# tunnel RTT — shorter chains leave the RTT subtraction noise-dominated
+# (round-1 numbers for the sub-ms rungs wobbled 2× between runs).
 RUNGS = {
-    "cartpole": (20, {}),
-    "cartpole-po": (20, {}),          # recurrent (GRU) / POMDP rung
-    "pendulum": (10, {}),
-    "catch": (10, {}),                # conv/pixel rung
-    "pong-sim": (3, {}),              # Atari-scale conv FVP: 84×84×4 obs,
+    "cartpole": (300, {}),
+    "cartpole-po": (60, {}),          # recurrent (GRU) / POMDP rung
+    "pendulum": (150, {}),
+    "catch": (40, {}),                # conv/pixel rung
+    "pong-sim": (6, {}),              # Atari-scale conv FVP: 84×84×4 obs,
     #                                   ≈1.7M-param Nature policy
-    "halfcheetah-sim": (10, {}),
-    "humanoid-sim": (3, {}),          # batch 50k — the north-star shape
+    "halfcheetah-sim": (200, {}),
+    "humanoid-sim": (12, {}),         # batch 50k — the north-star shape
 }
 
 # model-family variants: same env, different policy family — the ladder
 # records every family's fused-iteration throughput
 VARIANT_RUNGS = {
-    "cartpole-po-lstm": ("cartpole-po", 20, {"policy_cell": "lstm"}),
-    "cartpole-moe": ("cartpole", 20, {"policy_experts": 4}),
+    "cartpole-po-lstm": ("cartpole-po", 60, {"policy_cell": "lstm"}),
+    "cartpole-moe": ("cartpole", 300, {"policy_experts": 4}),
     # GAE/returns recurrence through the Pallas single-HBM-pass kernel
     # instead of the XLA associative scan (ops/pallas_scan.py) — the
     # whole-iteration view of the --pallas kernel shootout
-    "humanoid-sim-pallas": ("humanoid-sim", 3, {"scan_backend": "pallas"}),
+    "humanoid-sim-pallas": ("humanoid-sim", 12, {"scan_backend": "pallas"}),
 }
 
 # Host-simulator rungs: env stepping on the host (real MuJoCo via

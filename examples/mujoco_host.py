@@ -14,6 +14,11 @@ simulators (the BASELINE HalfCheetah/Humanoid rungs):
 - optionally, the envs split into groups whose host stepping overlaps the
   other groups' device round trips (``host_pipeline_groups`` — wins on
   multicore hosts);
+- optionally, inference moved to the host CPU backend entirely
+  (``--host-inference cpu`` — zero device round trips per step; the ~13×
+  lever behind the real-Humanoid run in the README. Only meaningful with
+  ``--platform tpu``: under the default CPU pin, "device" inference IS
+  host-CPU inference and the flag changes nothing);
 - GAE, the critic fit, and the fused natural-gradient update as one jitted
   device program per iteration (the same program device envs use).
 
@@ -29,16 +34,18 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 import jax
 
-# This machine routes JAX to a TPU by default; the example is sized for
-# CPU so it runs anywhere. Delete this line to train on the accelerator.
-jax.config.update("jax_platforms", "cpu")
-
 from trpo_tpu.agent import TRPOAgent          # noqa: E402
 from trpo_tpu.config import get_preset        # noqa: E402
 
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--platform", choices=("cpu", "tpu"), default="cpu",
+        help="JAX platform for the update program. Default cpu so the "
+        "example runs anywhere; 'tpu' uses the accelerator (and makes "
+        "--host-inference an actual placement choice)",
+    )
     ap.add_argument("--env", default="gym:HalfCheetah-v4")
     ap.add_argument("--iterations", type=int, default=10)
     ap.add_argument("--batch", type=int, default=2000)
@@ -47,7 +54,16 @@ def main():
         help="host_pipeline_groups: >1 overlaps env stepping with device "
         "inference (multicore hosts)",
     )
+    ap.add_argument(
+        "--host-inference", choices=("device", "cpu"), default="device",
+        help="'cpu' runs rollout inference on the host backend — zero "
+        "device round trips during collection (small policies behind "
+        "high-latency links)",
+    )
     args = ap.parse_args()
+    # must run before any backend use; this machine otherwise routes every
+    # process to the TPU by default
+    jax.config.update("jax_platforms", args.platform)
 
     cfg = get_preset("halfcheetah").replace(
         env=args.env,
@@ -55,6 +71,7 @@ def main():
         batch_timesteps=args.batch,
         normalize_obs=True,              # standard for MuJoCo-scale TRPO
         host_pipeline_groups=args.pipeline,
+        host_inference=args.host_inference,
     )
     agent = TRPOAgent(cfg.env, cfg)
     state = agent.learn()

@@ -1,0 +1,56 @@
+"""The driver-facing benchmark artifacts must stay runnable.
+
+``bench.py`` is executed by the round driver on real hardware; a syntax
+error or schema drift there silently costs the round its benchmark
+record. This smoke test runs it end-to-end on the CPU backend at a
+shrunk batch (BENCH_FORCE_CPU skips the accelerator probe entirely — it
+must never touch the single-tenant TPU tunnel from the test suite) and
+pins the JSON contract the driver and the BENCH_LADDER docs consume.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_bench_cpu_smoke_json_contract():
+    env = dict(os.environ)
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_BATCH"] = "512"
+    out = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.strip().splitlines()[-1]
+    j = json.loads(line)
+    # driver contract
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in j, key
+    assert j["unit"] == "ms/iter"
+    assert j["value"] > 0
+    assert j["metric"].endswith("batch512")  # label tracks BENCH_BATCH
+    assert j["backend"] == "cpu"
+    # round-2 accounting fields exist (values may be null off-TPU)
+    for key in (
+        "flops_per_cg_iter",
+        "analytic_flops_per_cg_iter",
+        "mfu_solve",
+        "min_arithmetic_intensity_flops_per_byte",
+        "host_driven_cg_ms_per_iter",
+        "fusion_speedup",
+    ):
+        assert key in j, key
+    # the two FLOP counts must agree to within 2x (cross-check that the
+    # loop-free lowering isn't silently miscounting)
+    if j["flops_per_cg_iter"]:
+        ratio = j["flops_per_cg_iter"] / j["analytic_flops_per_cg_iter"]
+        assert 0.5 < ratio < 2.0, ratio
