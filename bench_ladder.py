@@ -305,7 +305,7 @@ def _write_out(path: str, rows) -> None:
     for r in rows:
         lines.append(
             f"| {r['rung']} | {r['n_envs']} | {r['batch_timesteps']} "
-            f"| {r['iter_ms']:.1f} | {r['updates_per_sec']:.1f} "
+            f"| {r['iter_ms']:.1f} | {r['updates_per_sec']:.2f} "
             f"| {r['env_steps_per_sec']:,.0f} |"
         )
     note = ""

@@ -182,3 +182,28 @@ def test_everything_composed_adaptive(tmp_path):
     s2, st2 = agent.run_iterations(restored, 2)
     assert float(s2.cg_damping) != lam  # still adapting after resume
     assert np.all(np.isfinite(np.asarray(st2["entropy"])))
+
+
+def test_three_axis_mesh_data_seq_model():
+    """The 3-D composition — batch over "data", trajectory time through
+    the sequence-parallel GAE over "seq", AND Megatron tensor sharding
+    over "model" (pytree-domain solve) — runs as one program on a 2x2x2
+    mesh and keeps the params model-sharded."""
+    cfg = TRPOConfig(
+        env="cartpole",
+        n_envs=4,
+        batch_timesteps=32,   # 8 steps/env — divisible by seq=2
+        policy_hidden=(4, 4),
+        vf_train_steps=2,
+        cg_iters=3,
+        mesh_shape=(2, 2, 2),
+        mesh_axes=("data", "seq", "model"),
+    )
+    agent = TRPOAgent("cartpole", cfg)
+    state = agent.init_state(seed=0)
+    w0 = state.policy_params["net"]["layers"][0]["w"]
+    assert not w0.sharding.is_fully_replicated
+    state, stats = agent.run_iteration(state)
+    assert np.isfinite(float(stats["entropy"]))
+    assert np.isfinite(float(stats["kl_old_new"]))
+    assert int(state.iteration) == 1
