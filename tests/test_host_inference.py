@@ -133,3 +133,4 @@ def test_cpu_inference_rejected_for_device_envs():
 def test_bad_host_inference_value_rejected():
     with pytest.raises(ValueError, match="host_inference"):
         TRPOConfig(host_inference="gpu")
+

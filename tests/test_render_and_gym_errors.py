@@ -77,3 +77,30 @@ def test_pong_preset_fails_actionably_without_ale():
     assert "ALE/Pong-v5" in msg
     assert "ale-py" in msg
     assert "pong-sim" in msg
+
+
+@needs_gym
+def test_cpu_inference_gym_adapter_with_pipeline():
+    """The three host levers compose on the gymnasium adapter: cpu
+    inference x group pipelining x shared obs normalization (lives here
+    rather than test_host_inference.py because that module is gated on
+    the native env library, which this test does not need)."""
+    cfg = TRPOConfig(
+        env="gym:CartPole-v1",
+        host_inference="cpu",
+        host_pipeline_groups=2,
+        normalize_obs=True,
+        n_envs=4,
+        batch_timesteps=64,
+        cg_iters=3,
+        vf_train_steps=3,
+        policy_hidden=(16,),
+        vf_hidden=(16,),
+        seed=11,
+    )
+    agent = TRPOAgent("gym:CartPole-v1", cfg)
+    state = agent.init_state(seed=3)
+    for _ in range(2):
+        state, stats = agent.run_iteration(state)
+    assert np.isfinite(float(stats["entropy"]))
+    assert state.obs_norm is not None
