@@ -485,6 +485,59 @@ def time_host_driven_cg(kl_fn, flat0, g):
     return raw_ms, corrected_ms, x
 
 
+def time_standalone_fvp(kl_fn, flat0, g, n_chain=400):
+    """The STABLE fusion ablation: per-call cost of one standalone FVP
+    with a MOVING linearization point — the device work a host-driven CG
+    loop cannot avoid even with zero transport (each call re-runs the
+    primal grad; the fused loop's `lax.while_loop` LICM-hoists it once
+    per solve). Chained-dependent timing per `_device_rtt` rules, so
+    unlike `time_host_driven_cg` (raw ≈ one tunnel RTT per iteration,
+    corrected = small difference of large numbers) this number
+    reproduces run to run. this ÷ fused-per-iter = the kernel-level
+    fusion factor (main() reports it as fusion_speedup_kernel_level);
+    the rest of the host-driven gap is dispatch+transport. Dtypes match
+    the fused path exactly: the linearization point stays fp32 (the
+    solver domain — build_problem keeps flat fp32; bf16 casting happens
+    inside policy.apply on both paths)."""
+    from trpo_tpu.ops import make_fvp
+
+    @jax.jit
+    def chained(flat0, g):
+        def body(carry, _):
+            # carry-dependent linearization point: float-noise-level but
+            # opaque — forces the primal to recompute every call, as a
+            # host loop's separate dispatches would
+            flat = flat0 + jnp.float32(1e-30) * carry
+            hv = make_fvp(kl_fn, flat, DAMPING)(
+                g + jnp.float32(1e-30) * carry
+            )
+            return hv, ()
+
+        hv, _ = jax.lax.scan(
+            body, jnp.zeros_like(g), None, length=n_chain
+        )
+        return hv, hv.sum()
+
+    _progress("standalone FVP: compiling")
+    hv, probe = chained(flat0, g)
+    np.asarray(probe)
+    rtt = _device_rtt()
+    _progress(f"standalone FVP: timing (rtt {rtt * 1e3:.0f} ms)")
+    best = float("inf")
+    for _ in range(TIMING_REPS):
+        t0 = time.perf_counter()
+        hv, probe = chained(flat0, g)
+        np.asarray(probe)
+        best = min(best, time.perf_counter() - t0)
+    _progress("standalone FVP: done")
+    if best <= rtt:
+        _progress(
+            f"WARNING: standalone-FVP chain ({best * 1e3:.1f} ms) not "
+            f"above RTT ({rtt * 1e3:.1f} ms) — per-call time clamped"
+        )
+    return max(best - rtt, 1e-9) / n_chain * 1e3
+
+
 def time_reference_semantics(kl_fn, flat0, g):
     """Reference path: host NumPy CG; ONE device FVP call per iteration
     with host transfer both ways + host-side damping (ref utils.py:185-201,
@@ -562,8 +615,15 @@ def main():
         _progress(f"flop accounting failed ({type(e).__name__}: {e})")
         acct = {}
     # Fusion ablation (accelerator only): same device FVP, host CG loop.
+    standalone_fvp_ms = None
     host_cg_raw_ms = host_cg_ms = None
     if _ACCEL:
+        try:
+            standalone_fvp_ms = time_standalone_fvp(kl_fn, flat0, g)
+        except Exception as e:
+            _progress(
+                f"standalone-FVP timing failed ({type(e).__name__}: {e})"
+            )
         try:
             host_cg_raw_ms, host_cg_ms, x_hd = time_host_driven_cg(
                 kl_fn, flat0, g
@@ -706,6 +766,13 @@ def main():
                 "fusion_speedup": None
                 if host_cg_ms is None
                 else round(host_cg_ms / ours_ms, 2),
+                # stable variant: chained standalone FVPs (moving
+                # linearization point) — the zero-transport lower bound on
+                # any host-driven loop's per-iteration device cost
+                "standalone_fvp_ms": _r(standalone_fvp_ms, 3),
+                "fusion_speedup_kernel_level": None
+                if standalone_fvp_ms is None
+                else round(standalone_fvp_ms / ours_ms, 2),
                 "chip_speedup_host_driven_vs_cpu": None
                 if host_cg_ms is None
                 else round(base_ms / host_cg_ms, 2),

@@ -47,6 +47,8 @@ def test_bench_cpu_smoke_json_contract():
         "min_arithmetic_intensity_flops_per_byte",
         "host_driven_cg_ms_per_iter",
         "fusion_speedup",
+        "standalone_fvp_ms",
+        "fusion_speedup_kernel_level",
     ):
         assert key in j, key
     # the two FLOP counts must agree to within 2x (cross-check that the
