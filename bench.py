@@ -317,13 +317,15 @@ def time_full_update(device=None, fvp_subsample=None):
         update = make_trpo_update(policy, cfg)
         # full updates are ~4× a bare solve; CPU path: see time_fused_solve.
         # The subsampled update is ~5× cheaper — chain proportionally more
-        # so the timed window stays well above the tunnel-RTT jitter.
+        # so the timed window stays SEVERAL× the tunnel-RTT jitter (a
+        # ~100 ms window against a ~110 ms RTT made round-1's updates/s
+        # wobble ~1.7× between runs).
         if device is not None:
             n_chain = 2
         elif fvp_subsample and fvp_subsample < 1.0:
-            n_chain = CHAIN
+            n_chain = 3 * CHAIN
         else:
-            n_chain = max(CHAIN // 4, 1)
+            n_chain = CHAIN
         n_reps = TIMING_REPS if device is None else 1
 
         @jax.jit
@@ -531,11 +533,14 @@ def time_standalone_fvp(kl_fn, flat0, g, n_chain=400):
         best = min(best, time.perf_counter() - t0)
     _progress("standalone FVP: done")
     if best <= rtt:
+        # an invalid measurement must not publish a ~0 ms row (which the
+        # JSON would read as an infinite fusion win) — drop it instead
         _progress(
             f"WARNING: standalone-FVP chain ({best * 1e3:.1f} ms) not "
-            f"above RTT ({rtt * 1e3:.1f} ms) — per-call time clamped"
+            f"above RTT ({rtt * 1e3:.1f} ms) — dropping the row"
         )
-    return max(best - rtt, 1e-9) / n_chain * 1e3
+        return None
+    return (best - rtt) / n_chain * 1e3
 
 
 def time_reference_semantics(kl_fn, flat0, g):
