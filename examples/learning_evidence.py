@@ -26,6 +26,7 @@ RUNGS = {
     "pendulum": (300, 50),
     "cartpole-po": (200, 40),
     "catch": (200, 40),
+    "pong-sim": (900, 25),   # Atari-scale 84×84×4 conv FVP
     "halfcheetah-sim": (300, 50),
     "humanoid-sim": (200, 25),
 }
