@@ -209,3 +209,98 @@ def test_restore_across_adaptive_damping_flip(tmp_path):
         state_f, restored2._replace(cg_damping=None)
     )
     adaptive.run_iteration(restored2)  # restored state is usable
+
+
+def test_damping_flip_abstract_template_seeds_positive(tmp_path):
+    """Fixed->adaptive restore through an ABSTRACT template must seed
+    cg_damping with the TRPOConfig default (0.1), never zero — a zero
+    would make the first post-resume CG solve run undamped (ADVICE r2)."""
+    import jax
+
+    kwargs = dict(
+        n_envs=4, batch_timesteps=64, cg_iters=4, vf_train_steps=5,
+        policy_hidden=(16,), vf_hidden=(16,), seed=7,
+    )
+    fixed = TRPOAgent("cartpole", TRPOConfig(**kwargs))
+    adaptive = TRPOAgent(
+        "cartpole", TRPOConfig(adaptive_damping=True, **kwargs)
+    )
+    state_f = fixed.init_state()
+    state_f, _ = fixed.run_iteration(state_f)
+    ckpt = Checkpointer(str(tmp_path / "abs"))
+    try:
+        ckpt.save(int(state_f.iteration), state_f)
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, "shape")
+            else x,
+            adaptive.init_state(),
+        )
+        restored = ckpt.restore(abstract)
+    finally:
+        ckpt.close()
+    damping = float(np.asarray(restored.cg_damping))
+    assert damping == pytest.approx(0.1), (
+        f"abstract-template damping seed must be the config default, "
+        f"got {damping}"
+    )
+
+
+@pytest.mark.parametrize("direction", ["data_to_tp", "tp_to_data"])
+def test_restore_across_mesh_topologies(tmp_path, direction):
+    """A TrainState saved under one mesh topology must restore into a
+    DIFFERENT one — (8,) pure-data into (4,2) data×model and vice versa —
+    with the restored run producing the same iteration stats as the
+    uninterrupted source run (VERDICT r2 item 7: shardings are saved with
+    the state; the template's shardings must win on restore)."""
+    kwargs = dict(
+        n_envs=8, batch_timesteps=128, cg_iters=4, vf_train_steps=5,
+        policy_hidden=(8, 8), vf_hidden=(16,), seed=3,
+    )
+    a_data = TRPOAgent("cartpole", TRPOConfig(mesh_shape=(8,), **kwargs))
+    a_tp = TRPOAgent(
+        "cartpole",
+        TRPOConfig(
+            mesh_shape=(4, 2), mesh_axes=("data", "model"), **kwargs
+        ),
+    )
+    src, dst = (
+        (a_data, a_tp) if direction == "data_to_tp" else (a_tp, a_data)
+    )
+
+    state = src.init_state(seed=5)
+    state, _ = src.run_iteration(state)
+    ckpt = Checkpointer(str(tmp_path / direction))
+    try:
+        ckpt.save(int(state.iteration), state)
+        restored = ckpt.restore(dst.init_state())
+    finally:
+        ckpt.close()
+
+    # the destination topology's placement won: params land with the
+    # destination template's sharding, not the saved one
+    w0 = restored.policy_params["net"]["layers"][0]["w"]
+    w0_dst = dst.init_state().policy_params["net"]["layers"][0]["w"]
+    assert w0.sharding == w0_dst.sharding
+    if dst is a_tp:
+        assert not w0.sharding.is_fully_replicated, (
+            "restore must re-shard params over the model axis"
+        )
+
+    # values crossed unchanged
+    f_src = jax.flatten_util.ravel_pytree(state.policy_params)[0]
+    f_dst = jax.flatten_util.ravel_pytree(restored.policy_params)[0]
+    np.testing.assert_array_equal(np.asarray(f_src), np.asarray(f_dst))
+
+    # the continued run matches the uninterrupted one (same math, new mesh)
+    s_cont, st_cont = src.run_iteration(state)
+    s_rest, st_rest = dst.run_iteration(restored)
+    for k in (
+        "entropy", "kl_old_new", "surrogate_loss", "mean_episode_reward"
+    ):
+        assert abs(float(st_cont[k]) - float(st_rest[k])) < 1e-4, k
+    f1 = jax.flatten_util.ravel_pytree(s_cont.policy_params)[0]
+    f2 = jax.flatten_util.ravel_pytree(s_rest.policy_params)[0]
+    np.testing.assert_allclose(
+        np.asarray(f1), np.asarray(f2), rtol=1e-4, atol=1e-5
+    )

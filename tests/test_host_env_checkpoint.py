@@ -181,3 +181,119 @@ def test_gym_restore_rejects_n_envs_mismatch():
     with pytest.raises(ValueError, match="n_envs"):
         dst.env_state_restore(snap)
     src.close(); dst.close()
+
+
+# -- pickle-free sidecar format (ADVICE r2) --------------------------------
+
+
+@needs_native
+def test_sidecar_is_pickle_free_npz(tmp_path):
+    """The sidecar on disk must be loadable with allow_pickle=False — an
+    untrusted checkpoint dir can never execute code on restore."""
+    env = native.NativeVecEnv("cartpole", n_envs=2, seed=3)
+    for _ in range(4):
+        env.host_step(np.zeros(2, np.int64))
+    snap = env.env_state_snapshot()
+    ck = Checkpointer(str(tmp_path / "ck"))
+    try:
+        ck.save_host_env(7, snap)
+        path = tmp_path / "ck" / "host_env_7.npz"
+        assert path.exists(), "sidecar must be .npz, not .pkl"
+        with np.load(path, allow_pickle=False):
+            pass  # opening with pickle disabled must not raise
+        back = ck.restore_host_env(7)
+    finally:
+        ck.close()
+    assert back["kind"] == snap["kind"]
+    for k in ("state", "t", "rng", "obs"):
+        np.testing.assert_array_equal(back[k], snap[k])
+    env.env_state_restore(back)  # adapter accepts the round-tripped form
+
+
+def test_sidecar_codec_nested_and_bigints(tmp_path):
+    """The codec must carry nested dict/list/None structures and
+    arbitrary-precision ints (PCG64 state words exceed uint64)."""
+    ck = Checkpointer(str(tmp_path / "ck"))
+    snap = {
+        "sims": [
+            None,
+            {
+                "backend": "state",
+                "state": np.arange(4.0),
+                "elapsed": 12,
+                "np_random": {
+                    "bit_generator": "PCG64",
+                    "state": {"state": 2**100 + 7, "inc": 2**90 + 1},
+                    "has_uint32": 0,
+                    "uinteger": 0,
+                },
+            },
+        ],
+        "obs": np.ones((2, 4), np.float32),
+        "flag": True,
+        "note": "hello",
+    }
+    try:
+        ck.save_host_env(1, snap)
+        back = ck.restore_host_env(1)
+    finally:
+        ck.close()
+    assert back["sims"][0] is None
+    assert back["sims"][1]["np_random"]["state"]["state"] == 2**100 + 7
+    assert back["flag"] is True and back["note"] == "hello"
+    np.testing.assert_array_equal(back["obs"], snap["obs"])
+
+
+def test_sidecar_prunes_stale_tmp_and_reads_legacy_pkl(tmp_path):
+    ck = Checkpointer(str(tmp_path / "ck"))
+    d = tmp_path / "ck"
+    # a crash mid-save leaves a tmp: the next save must clean it up
+    (d / "host_env_3.npz.tmp").write_bytes(b"partial")
+    (d / "host_env_3.pkl.tmp").write_bytes(b"partial")
+    # a legacy pickle sidecar from an older run must still restore
+    import pickle
+
+    with open(d / "host_env_2.pkl", "wb") as f:
+        pickle.dump({"obs": np.zeros(3)}, f)
+    try:
+        # legacy read works while the file exists
+        legacy = ck.restore_host_env(2)
+        np.testing.assert_array_equal(legacy["obs"], np.zeros(3))
+        ck.save_host_env(5, {"obs": np.ones(3)})
+        assert not (d / "host_env_3.npz.tmp").exists()
+        assert not (d / "host_env_3.pkl.tmp").exists()
+        # the legacy sidecar had no Orbax step → pruned with the rest
+        assert not (d / "host_env_2.pkl").exists()
+    finally:
+        ck.close()
+
+
+def test_sidecar_corrupt_falls_back_to_none(tmp_path, capsys):
+    ck = Checkpointer(str(tmp_path / "ck"))
+    (tmp_path / "ck" / "host_env_9.npz").write_bytes(b"not a zip at all")
+    try:
+        assert ck.restore_host_env(9) is None
+    finally:
+        ck.close()
+    assert "unreadable" in capsys.readouterr().err
+
+
+@needs_gym
+def test_gym_snapshot_captures_reset_randomness():
+    """Post-resume episode resets must replay the SAME randomness as the
+    uninterrupted run (ADVICE r2: np_random bit-generator state rides the
+    snapshot)."""
+    env = envs.make("gym:CartPole-v1", n_envs=1, seed=11)
+    for _ in range(3):
+        env.host_step(np.zeros(1, np.int64))
+    snap = env.env_state_snapshot()
+    assert snap["sims"][0]["np_random"] is not None
+
+    # uninterrupted: what obs does the next reset produce?
+    o_uninterrupted, _ = env.envs[0].reset()
+
+    # resumed: restore, then reset — must match bit-for-bit
+    env.env_state_restore(snap)
+    o_resumed, _ = env.envs[0].reset()
+    np.testing.assert_array_equal(o_uninterrupted, o_resumed)
+    env.close()

@@ -13,11 +13,42 @@ from trpo_tpu import envs
 from trpo_tpu.envs.cartpole import CartPole, CartPoleState
 from trpo_tpu.envs.pendulum import Pendulum, PendulumState
 
-native = pytest.importorskip("trpo_tpu.envs.native")
-if not native.native_available():
-    pytest.skip("native library unavailable on this machine", allow_module_level=True)
+from trpo_tpu.envs import native
+
+# Build canary (VERDICT r2 item 8): the C++ stepper must BUILD on any
+# machine that has the toolchain — a toolchain regression must fail the
+# suite loudly, not silently drop the native coverage (including the
+# host_inference=cpu bit-identity guarantee) via wholesale skips. Only a
+# machine with no C++ toolchain at all may skip.
+import os as _os
+import shutil as _shutil
+
+# the Makefile honors CXX ?= g++ — probe the compiler it would actually use
+_toolchain = all(
+    _shutil.which(t) for t in ("make", _os.environ.get("CXX", "g++"))
+)
 
 
+@pytest.mark.skipif(
+    not _toolchain, reason="no C++ toolchain (make/g++) on this machine"
+)
+def test_native_library_builds():
+    """Hard-failing: with a toolchain present, the build must succeed."""
+    lib = native.load_library()  # raises RuntimeError with stderr on failure
+    assert lib is not None
+    assert native.native_available()
+
+
+# The remaining tests exercise the built library; they skip only when the
+# canary above has already failed (or no toolchain exists) — the canary is
+# the loud signal, these stay readable.
+needs_native = pytest.mark.skipif(
+    not native.native_available(),
+    reason="native library unavailable — see test_native_library_builds",
+)
+
+
+@needs_native
 def test_make_resolves_native():
     env = envs.make("native:cartpole", n_envs=4)
     assert env.n_envs == 4
@@ -26,6 +57,7 @@ def test_make_resolves_native():
         envs.make("native:walker")
 
 
+@needs_native
 def test_native_cartpole_matches_jax_physics():
     n = 64
     rng = np.random.default_rng(0)
@@ -55,6 +87,7 @@ def test_native_cartpole_matches_jax_physics():
     np.testing.assert_allclose(next_obs, final_obs, rtol=1e-6)
 
 
+@needs_native
 def test_native_pendulum_matches_jax_physics():
     n = 64
     rng = np.random.default_rng(1)
@@ -82,6 +115,7 @@ def test_native_pendulum_matches_jax_physics():
     assert not term.any()
 
 
+@needs_native
 def test_native_auto_reset_and_bookkeeping():
     env = native.NativeVecEnv("cartpole", n_envs=2, max_episode_steps=3)
     for step in range(3):
@@ -94,6 +128,7 @@ def test_native_auto_reset_and_bookkeeping():
     assert env._running_lengths[ended].max(initial=0) == 0
 
 
+@needs_native
 def test_native_rollout_through_agent():
     """Full training iteration with the native host runtime underneath."""
     from trpo_tpu.agent import TRPOAgent
@@ -115,6 +150,7 @@ def test_native_rollout_through_agent():
     assert float(stats["mean_episode_reward"]) > 0  # cartpole rewards are 1/step
 
 
+@needs_native
 def test_native_cartpole_learns():
     """The reference's own bar, through the native runtime: reward rises."""
     from trpo_tpu.agent import TRPOAgent

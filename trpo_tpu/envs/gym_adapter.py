@@ -206,19 +206,32 @@ class GymVecEnv(EpisodeStatsMixin, ObsNormMixin):
             u = env.unwrapped
             tl = self._find_time_limit(env)
             elapsed = None if tl is None else tl._elapsed_steps
+            # episode-reset randomness rides along: without the
+            # bit-generator state a resumed run replays DIFFERENT resets
+            # than the uninterrupted run would have
+            rng_state = None
+            np_random = getattr(u, "np_random", None)
+            if np_random is not None and hasattr(np_random, "bit_generator"):
+                rng_state = np_random.bit_generator.state
             if hasattr(u, "data") and hasattr(u, "set_state"):
                 sims.append({
                     "backend": "mujoco",
                     "qpos": np.asarray(u.data.qpos, np.float64).copy(),
                     "qvel": np.asarray(u.data.qvel, np.float64).copy(),
+                    "ctrl": np.asarray(u.data.ctrl, np.float64).copy(),
+                    "qacc_warmstart": np.asarray(
+                        u.data.qacc_warmstart, np.float64
+                    ).copy(),
                     "time": float(u.data.time),
                     "elapsed": elapsed,
+                    "np_random": rng_state,
                 })
             elif getattr(u, "state", None) is not None:
                 sims.append({
                     "backend": "state",
                     "state": np.asarray(u.state, np.float64).copy(),
                     "elapsed": elapsed,
+                    "np_random": rng_state,
                 })
             else:
                 sims.append(None)  # opaque simulator — restart on restore
@@ -267,8 +280,14 @@ class GymVecEnv(EpisodeStatsMixin, ObsNormMixin):
             if sim["backend"] == "mujoco":
                 u.set_state(sim["qpos"], sim["qvel"])
                 u.data.time = sim["time"]
+                if sim.get("ctrl") is not None:
+                    u.data.ctrl[:] = sim["ctrl"]
+                if sim.get("qacc_warmstart") is not None:
+                    u.data.qacc_warmstart[:] = sim["qacc_warmstart"]
             else:
                 u.state = np.asarray(sim["state"], np.float64)
+            if sim.get("np_random") is not None:
+                u.np_random.bit_generator.state = sim["np_random"]
             if sim.get("elapsed") is not None:
                 tl = self._find_time_limit(env)
                 if tl is not None:

@@ -7,8 +7,8 @@ critic + optimizer + env carry + RNG + counters), so a resumed run continues
 exactly where it stopped, including mid-episode env states.
 
 Host-simulator state (gym:/native: adapters) lives OUTSIDE TrainState and
-rides as a pickle sidecar next to the Orbax step (:meth:`save_host_env` /
-:meth:`restore_host_env`): exact resume for ``native:`` envs (their
+rides as a pickle-free ``.npz`` sidecar next to the Orbax step
+(:meth:`save_host_env` / :meth:`restore_host_env`): exact resume for ``native:`` envs (their
 state/step/RNG buffers are host NumPy), best-effort for ``gym:`` (MuJoCo
 ``qpos``/``qvel``/time, classic-control ``state``, TimeLimit counters),
 and for opaque backends the documented fallback — episodes restart on
@@ -97,13 +97,25 @@ class Checkpointer:
         ):
             seed = template.cg_damping
             if seed is not None and not hasattr(seed, "__array__"):
-                # abstract template leaf (ShapeDtypeStruct): materialize a
-                # concrete zero — the adaptive-damping feedback re-adapts
-                # within an iteration; a concrete template (the normal
-                # agent.init_state() path) seeds cfg.cg_damping instead
+                # abstract template leaf (ShapeDtypeStruct): materialize the
+                # TRPOConfig default damping, NOT zero — the first
+                # post-resume CG solve must not run undamped (damping exists
+                # for Fisher conditioning); the adaptive feedback re-adapts
+                # from there within an iteration. A concrete template (the
+                # normal agent.init_state() path) seeds cfg.cg_damping
+                # instead and never reaches this branch.
+                import dataclasses
+
                 import jax.numpy as jnp
 
-                seed = jnp.zeros(seed.shape, seed.dtype)
+                from trpo_tpu.config import TRPOConfig
+
+                default_damping = next(
+                    f.default
+                    for f in dataclasses.fields(TRPOConfig)
+                    if f.name == "cg_damping"
+                )
+                seed = jnp.full(seed.shape, default_damping, seed.dtype)
             restored = restored._replace(cg_damping=seed)
         return restored
 
@@ -112,54 +124,84 @@ class Checkpointer:
     # Host-simulator state (envs/*.env_state_snapshot) is host-side NumPy
     # with backend-specific, sometimes-absent pieces — it does not belong
     # in the device-resident TrainState pytree (which must keep a stable
-    # jit template). It rides NEXT TO the Orbax step as a pickle sidecar:
-    # exact resume for native: envs, best-effort (MuJoCo qpos/qvel/time,
-    # classic-control state) for gym: envs, documented episode-restart
-    # for opaque backends.
+    # jit template). It rides NEXT TO the Orbax step as a pickle-free
+    # ``.npz`` sidecar (nested dict/list structure as JSON, arrays as npz
+    # entries, loaded with ``allow_pickle=False`` so an untrusted
+    # checkpoint dir can never execute code on restore): exact resume for
+    # native: envs, best-effort (MuJoCo qpos/qvel/time, classic-control
+    # state) for gym: envs, documented episode-restart for opaque
+    # backends. Legacy ``.pkl`` sidecars from older checkpoints are still
+    # read — those are trusted-by-assumption (they came from this user's
+    # own earlier run).
 
     def _aux_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"host_env_{step}.npz")
+
+    def _aux_path_legacy(self, step: int) -> str:
         return os.path.join(self.directory, f"host_env_{step}.pkl")
 
     def save_host_env(self, step: int, snapshot) -> None:
-        import pickle
+        import numpy as np
 
         if snapshot is None:
             return
+        structure, arrays = _flatten_snapshot(snapshot)
+        arrays["__structure__"] = np.asarray(structure)  # JSON, '<U' dtype
         # atomic: a crash mid-dump must not leave a truncated sidecar for
-        # the next resume to choke on (the Orbax side is already
-        # crash-safe via save + wait_until_finished)
+        # the next resume to choke on (a partial *.tmp is pruned by the
+        # next save; the Orbax side is already crash-safe via save +
+        # wait_until_finished)
         tmp = self._aux_path(step) + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(snapshot, f)
+            np.savez(f, **arrays)
         os.replace(tmp, self._aux_path(step))
-        # prune sidecars whose Orbax step was garbage-collected
-        keep = {self._aux_path(s) for s in self.manager.all_steps()}
-        keep.add(self._aux_path(step))
+        # prune: sidecars whose Orbax step was garbage-collected, plus any
+        # *.tmp left by a crash mid-save (always safe to delete — a tmp is
+        # only live inside this method)
+        keep = {
+            p
+            for s in list(self.manager.all_steps()) + [step]
+            for p in (self._aux_path(s), self._aux_path_legacy(s))
+        }
         for name in os.listdir(self.directory):
-            if name.startswith("host_env_") and name.endswith(".pkl"):
-                p = os.path.join(self.directory, name)
-                if p not in keep:
-                    try:
-                        os.remove(p)
-                    except OSError:
-                        pass
+            if not name.startswith("host_env_"):
+                continue
+            if not name.endswith((".pkl", ".npz", ".tmp")):
+                continue
+            p = os.path.join(self.directory, name)
+            if p not in keep:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
 
     def restore_host_env(self, step: Optional[int] = None):
         """The sidecar for ``step`` (default: latest), or None if that
         checkpoint predates sidecars / the env needed none."""
-        import pickle
+        import numpy as np
 
         step = self.latest_step() if step is None else step
         if step is None:
             return None
         try:
-            with open(self._aux_path(step), "rb") as f:
-                return pickle.load(f)
-        except FileNotFoundError:
+            path = self._aux_path(step)
+            if os.path.exists(path):
+                with np.load(path, allow_pickle=False) as z:
+                    return _unflatten_snapshot(
+                        str(z["__structure__"]), z
+                    )
+            legacy = self._aux_path_legacy(step)
+            if os.path.exists(legacy):
+                import pickle
+
+                with open(legacy, "rb") as f:
+                    return pickle.load(f)
             return None
-        except (OSError, EOFError, pickle.UnpicklingError) as e:
-            # unreadable/corrupt sidecar: fall back to the documented
-            # episode-restart semantics rather than sinking the resume
+        except Exception as e:
+            # unreadable/corrupt/garbled sidecar — whatever it raises
+            # (zip errors, JSON errors, unpickling, construction-time
+            # surprises): fall back to the documented episode-restart
+            # semantics rather than sinking the resume
             import sys
 
             print(
@@ -171,3 +213,71 @@ class Checkpointer:
 
     def close(self):
         self.manager.close()
+
+
+# -- pickle-free snapshot codec -------------------------------------------
+#
+# Host-env snapshots are nested dict/list/None/scalar/ndarray structures
+# (see envs/*.env_state_snapshot). Arrays go into the npz as entries
+# "a0", "a1", ...; the containing structure serializes as JSON with
+# {"__npz__": key} placeholders. JSON carries arbitrary-precision ints
+# natively, which matters for np_random bit-generator state (PCG64 state
+# words exceed uint64). Anything else is a programming error and raises at
+# save time — never at restore time.
+
+
+def _flatten_snapshot(obj):
+    import json
+
+    import numpy as np
+
+    arrays = {}
+
+    def flatten(x):
+        if x is None or isinstance(x, (bool, int, float, str)):
+            return x
+        if isinstance(x, np.bool_):
+            return bool(x)
+        if isinstance(x, np.integer):
+            return int(x)
+        if isinstance(x, np.floating):
+            return float(x)
+        if isinstance(x, np.ndarray):
+            if x.dtype == object:
+                # np.savez would silently PICKLE an object array, making
+                # the sidecar fail only at restore time — reject now
+                raise TypeError(
+                    "host-env snapshot holds an object-dtype array; "
+                    "snapshots must use numeric/str dtypes"
+                )
+            key = f"a{len(arrays)}"
+            arrays[key] = x
+            return {"__npz__": key}
+        if isinstance(x, dict):
+            return {"__dict__": {str(k): flatten(v) for k, v in x.items()}}
+        if isinstance(x, (list, tuple)):
+            return {"__list__": [flatten(v) for v in x]}
+        raise TypeError(
+            f"host-env snapshot holds a {type(x).__name__}; snapshots must "
+            "be nested dict/list/None/scalar/ndarray structures"
+        )
+
+    return json.dumps(flatten(obj)), arrays
+
+
+def _unflatten_snapshot(structure_json: str, npz):
+    import json
+
+    import numpy as np
+
+    def unflatten(x):
+        if isinstance(x, dict):
+            if "__npz__" in x:
+                return np.asarray(npz[x["__npz__"]])
+            if "__dict__" in x:
+                return {k: unflatten(v) for k, v in x["__dict__"].items()}
+            if "__list__" in x:
+                return [unflatten(v) for v in x["__list__"]]
+        return x
+
+    return unflatten(json.loads(structure_json))
