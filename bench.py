@@ -143,7 +143,16 @@ def _program_flops(jitted, *args):
         return None, None
 
 
-def _analytic_fvp_tangent_flops() -> float:
+def _forward_flops(hidden=None) -> float:
+    """FLOPs of one policy forward pass (2·batch·weights)."""
+    hidden = HIDDEN if hidden is None else tuple(hidden)
+    dims = [OBS_DIM] + list(hidden)
+    weights = sum(a * b for a, b in zip(dims[:-1], dims[1:]))
+    weights += hidden[-1] * ACT_DIM  # Gaussian mean head (logstd: no matmul)
+    return 2.0 * BATCH * weights
+
+
+def _analytic_fvp_tangent_flops(hidden=None) -> float:
     """Analytic FLOPs for ONE CG iteration of the FUSED solve: the
     jvp-of-grad tangent pass ≈ 3 forward-equivalents (a forward-mode
     sweep through the forward+backward graph costs about what the
@@ -152,11 +161,42 @@ def _analytic_fvp_tangent_flops() -> float:
     while-loop LICM hoists it out of the CG loop, so it is amortized over
     all 10 iterations, and the stop-gradient old-dist forward likewise.
     Cross-checks the XLA cost-analysis number in the JSON."""
-    dims = [OBS_DIM] + list(HIDDEN)
-    weights = sum(a * b for a, b in zip(dims[:-1], dims[1:]))
-    weights += HIDDEN[-1] * ACT_DIM  # Gaussian mean head (logstd: no matmul)
-    forward = 2.0 * BATCH * weights
-    return 3.0 * forward
+    return 3.0 * _forward_flops(hidden)
+
+
+def _analytic_acct() -> dict:
+    """The analytic FLOP model as a full accounting dict — the fallback
+    when XLA cost analysis reports nothing on this backend (VERDICT r2
+    item 1: the artifact of record must carry non-null MFU, tagged with
+    its provenance, instead of nulling out a number the analytic model
+    already derives). Mirrors ``flop_accounting``'s composition:
+    grad ≈ 3 forwards, tangent ≈ 3 forwards, KL eval = 2 forwards (old +
+    new apply). Bytes-derived fields stay absent — traffic is NOT
+    analytically modeled (the round-2 overcounting lesson)."""
+    forward = _forward_flops()
+    tangent = 3.0 * forward
+    grad = 3.0 * forward
+    kl_eval = 2.0 * forward
+    return {
+        "fvp": grad + tangent,
+        "grad": grad,
+        "kl_eval": kl_eval,
+        "tangent": tangent,
+        "flops_per_cg_iter": tangent,
+        "flops_per_update": (
+            2.0 * grad + (CG_ITERS + 1) * tangent + 3.0 * kl_eval
+        ),
+    }
+
+
+def _cost_analysis_usable() -> bool:
+    """Whether ``cost_analysis()`` reports FLOPs on this backend, probed
+    with a trivial program — the round-2 driver run spent ~156 s lowering
+    the full 50k-batch programs only to find the tunneled backend reports
+    nothing. A 1×1 matmul answers the same question in milliseconds."""
+    probe = jax.jit(lambda a: a @ a)
+    flops, _ = _program_flops(probe, jnp.ones((4, 4), jnp.float32))
+    return flops is not None
 
 
 def flop_accounting(kl_fn, flat0, g):
@@ -237,7 +277,7 @@ def _chain_inputs(g, key, n):
     return g[None, :] + 1e-6 * noise
 
 
-def build_problem(compute_dtype=None):
+def build_problem(compute_dtype=None, hidden=None):
     """``compute_dtype=bfloat16`` runs the policy matmuls (forward + jvp/vjp
     inside the FVP) on the MXU at full rate; CG vectors, KL, and all solver
     arithmetic stay fp32 (``ops/cg.py`` casts every iterate) — the
@@ -250,7 +290,7 @@ def build_problem(compute_dtype=None):
     policy = make_policy(
         (OBS_DIM,),
         BoxSpec(ACT_DIM),
-        hidden=HIDDEN,
+        hidden=HIDDEN if hidden is None else tuple(hidden),
         compute_dtype=compute_dtype or jnp.float32,
     )
     params = policy.init(jax.random.key(0))
@@ -428,6 +468,49 @@ def time_fused_solve(kl_fn, flat0, g, device=None):
         )
     per_iter_ms = max(best - rtt, 1e-6) / (n_chain * CG_ITERS) * 1e3
     return per_iter_ms, x
+
+
+def width_study(widths, device=None):
+    """MFU-vs-width scaling (VERDICT r2 item 2): the 256-wide headline
+    shape runs bandwidth-bound; this measures the SAME fused solve at
+    wider hiddens (same 376-obs/17-act, same batch) to show MFU climbing
+    toward compute-bound as arithmetic intensity grows — turning "27% MFU
+    is the shape's ceiling" from argument into data. Per-width numbers
+    use the analytic tangent FLOP model (tagged as such in the JSON; the
+    model is the same one the headline falls back to).
+
+    ``device`` pins the whole study (build included) — after a TPU→CPU
+    fallback the default backend is the wedged tunnel, which HANGS on
+    compile rather than raising; every step here must stay guarded and
+    pinned."""
+    rows = []
+    ctx = (
+        jax.default_device(device)
+        if device is not None
+        else contextlib.nullcontext()
+    )
+    for w in widths:
+        hidden = (w, w)
+        _progress(f"width study: hidden {hidden}")
+        try:
+            with ctx:
+                kl_fn, flat0, g = build_problem(
+                    jnp.bfloat16 if _ACCEL else jnp.float32, hidden=hidden
+                )
+            ms, _x = time_fused_solve(kl_fn, flat0, g, device=device)
+        except Exception as e:
+            _progress(f"width {w} failed ({type(e).__name__}: {e})")
+            continue
+        tangent = _analytic_fvp_tangent_flops(hidden)
+        rows.append(
+            {
+                "hidden": list(hidden),
+                "ms_per_iter": round(ms, 4),
+                "analytic_flops_per_cg_iter": round(tangent, 0),
+                "achieved_tflops": round(tangent / (ms * 1e-3) / 1e12, 2),
+            }
+        )
+    return rows
 
 
 def _host_cg_loop(fvp_host, b, iters=None):
@@ -617,19 +700,48 @@ def main():
     # compile-only, nothing executed — see flop_accounting docstring).
     # After a TPU fallback, pin the lowering to CPU: compiling against a
     # wedged tunnel hangs rather than raising, so the try/except alone
-    # would not protect this path.
-    _progress("flop accounting: lowering single-kernel programs")
+    # would not protect this path. When the backend's cost analysis
+    # reports nothing (probed cheaply first — the round-2 driver run spent
+    # ~156 s lowering 50k-batch programs for nothing), fall back to the
+    # analytic FLOP model so MFU is never null, tagged with its source
+    # (VERDICT r2 item 1).
     acct_ctx = (
         contextlib.nullcontext()
         if _ACCEL
         else jax.default_device(jax.devices("cpu")[0])
     )
+    acct, flops_source = {}, None
     try:
         with acct_ctx:
-            acct = flop_accounting(kl_fn, flat0, g)
+            # BENCH_FORCE_ANALYTIC exercises the fallback path on backends
+            # where cost analysis works (tests; cross-checking the model)
+            if (
+                os.environ.get("BENCH_FORCE_ANALYTIC") != "1"
+                and _cost_analysis_usable()
+            ):
+                _progress("flop accounting: lowering single-kernel programs")
+                acct = flop_accounting(kl_fn, flat0, g)
+            else:
+                _progress(
+                    "flop accounting: backend reports no cost analysis — "
+                    "using the analytic FLOP model"
+                )
     except Exception as e:
         _progress(f"flop accounting failed ({type(e).__name__}: {e})")
         acct = {}
+    # a COMPLETE measured accounting (per-iter and per-update both
+    # positive) wins; anything partial or degenerate falls back to the
+    # analytic model wholesale (mixing sources inside one composition
+    # would mislabel the result), keeping only the measured bytes field —
+    # traffic has no analytic model
+    if acct.get("flops_per_cg_iter") and acct.get("flops_per_update"):
+        flops_source = "xla_cost_analysis"
+    else:
+        measured_bytes = acct.get("bytes_per_cg_iter")
+        acct = _analytic_acct()
+        if measured_bytes:
+            acct["bytes_per_cg_iter"] = measured_bytes
+        flops_source = "analytic"
     # Fusion ablation (accelerator only): same device FVP, host CG loop.
     standalone_fvp_ms = None
     host_cg_raw_ms = host_cg_ms = None
@@ -687,6 +799,41 @@ def main():
     else:
         kl_fn32, flat0_32, g32 = kl_fn, flat0, g
     base_ms, x_base = time_reference_semantics(kl_fn32, flat0_32, g32)
+
+    # Transport-free fusion ablation (VERDICT r2 item 5): the baseline
+    # above IS the host-driven CG loop on the in-process CPU backend
+    # (zero tunnel transport); running the FUSED solve on that same CPU
+    # backend isolates fusion× with no ~100 ms RTT anywhere in either
+    # measurement — unlike the accelerator host-driven row, whose
+    # corrected value subtracts a ~100 ms RTT from a ~100 ms window.
+    #   fusion_speedup            = host-driven CPU / fused CPU
+    #   chip_speedup_fused_vs_cpu = fused CPU / fused accelerator
+    # and their product recovers ~vs_baseline (modulo bf16 matmuls on
+    # the chip path).
+    if _ACCEL:
+        try:
+            cpu = jax.devices("cpu")[0]
+            fused_cpu_ms, _x_cpu = time_fused_solve(
+                kl_fn32, flat0_32, g32, device=cpu
+            )
+        except Exception as e:
+            _progress(f"CPU fused solve failed ({type(e).__name__}: {e})")
+            fused_cpu_ms = None
+    else:
+        fused_cpu_ms = ours_ms  # already the same backend
+
+    # MFU-vs-width scaling study (VERDICT r2 item 2) — accelerator only
+    # by default; BENCH_WIDTHS overrides (e.g. "8,16" for CPU smoke runs,
+    # "" to skip).
+    widths_env = os.environ.get("BENCH_WIDTHS")
+    if widths_env is not None:
+        widths = [int(w) for w in widths_env.split(",") if w.strip()]
+    else:
+        widths = [512, 1024] if _ACCEL else []
+    # off-accelerator (incl. after a tunnel fallback) pin everything to
+    # CPU — the default backend may be a wedged tunnel that hangs
+    width_dev = None if _ACCEL else jax.devices("cpu")[0]
+    width_rows = width_study(widths, device=width_dev) if widths else []
 
     # Both solvers must agree — a fast wrong solve is worthless.
     cos = float(
@@ -752,10 +899,14 @@ def main():
                     updates_per_sec_sub, 2
                 ),
                 "fvp_subsample": FVP_SUB,
-                # -- FLOP / MFU accounting (XLA cost analysis of loop-free
-                #    single-kernel programs, composed per flop_accounting;
-                #    null when the backend doesn't report or the peak is
-                #    unknown) --
+                # -- FLOP / MFU accounting. flops_source says where the
+                #    FLOP counts came from: "xla_cost_analysis" (lowered
+                #    loop-free programs, composed per flop_accounting) or
+                #    "analytic" (the closed-form model — used whenever the
+                #    backend reports no cost analysis, so MFU is never
+                #    null while bytes-derived fields stay null when
+                #    unmeasured) --
+                "flops_source": flops_source,
                 "peak_bf16_tflops": peak,
                 "flops_per_cg_iter": _r(acct.get("flops_per_cg_iter"), 0),
                 "analytic_flops_per_cg_iter": round(
@@ -776,12 +927,25 @@ def main():
                 "min_arithmetic_intensity_flops_per_byte": _r(intensity, 1),
                 "unfused_traffic_roofline_tflops": _r(roofline_tflops, 1),
                 "solve_vs_unfused_roofline": _r(roofline_frac, 3),
-                # -- fusion ablation: same device FVP, host CG loop --
+                # -- fusion ablation, transport-free (VERDICT r2 item 5):
+                #    both sides of fusion_speedup run on the in-process
+                #    CPU backend (baseline = host-driven CG loop, fused =
+                #    the same solve as one program), so no tunnel RTT
+                #    contaminates either number; chip_speedup_fused_vs_cpu
+                #    compares the SAME fused program across backends --
+                "fused_cpu_ms_per_iter": _r(fused_cpu_ms, 3),
+                "fusion_speedup": None
+                if fused_cpu_ms is None
+                else round(base_ms / fused_cpu_ms, 2),
+                "chip_speedup_fused_vs_cpu": None
+                if fused_cpu_ms is None
+                else round(fused_cpu_ms / ours_ms, 2),
+                # accelerator host-driven row: raw only (the corrected
+                # variant subtracts ~RTT from ~RTT and is dropped as
+                # noise; kept for the transport-cost story, not for
+                # speedup claims)
                 "host_driven_cg_ms_per_iter": _r(host_cg_ms, 3),
                 "host_driven_cg_ms_per_iter_raw": _r(host_cg_raw_ms, 3),
-                "fusion_speedup": None
-                if host_cg_ms is None
-                else round(host_cg_ms / ours_ms, 2),
                 # stable variant: chained standalone FVPs (moving
                 # linearization point) — the zero-transport lower bound on
                 # any host-driven loop's per-iteration device cost
@@ -789,9 +953,17 @@ def main():
                 "fusion_speedup_kernel_level": None
                 if standalone_fvp_ms is None
                 else round(standalone_fvp_ms / ours_ms, 2),
-                "chip_speedup_host_driven_vs_cpu": None
-                if host_cg_ms is None
-                else round(base_ms / host_cg_ms, 2),
+                # -- MFU-vs-width scaling study (VERDICT r2 item 2);
+                #    analytic FLOP model per width --
+                "width_study": [
+                    {
+                        **row,
+                        "analytic_mfu": None
+                        if peak is None
+                        else round(row["achieved_tflops"] / peak, 4),
+                    }
+                    for row in width_rows
+                ],
             }
         )
     )

@@ -1,0 +1,210 @@
+"""TPU exploration: can the fused CG solve beat 0.82 ms/iter? (VERDICT r2
+item 3 — bf16 linearization-residual caching — plus explicit
+``jax.linearize`` hoisting.)
+
+Variants, all solving the SAME Humanoid-shape system (376 obs / 17 act /
+256×256 / batch 50k, bf16 matmuls, fp32 CG domain):
+
+  A  current:   ``make_fvp`` — ``jvp(grad_kl)`` re-stated per CG iteration;
+                XLA LICM is trusted to hoist the loop-invariant primal.
+  B  linearize: ``jax.linearize(grad_kl, flat0)`` ONCE outside the CG
+                while_loop — residuals (linearization activations) are
+                computed and stored explicitly before the loop; each
+                iteration replays only the tangent pass.
+  C  B + bf16-resident obs: the observation constant the tangent pass
+                re-reads every iteration is stored bf16 (37.6 MB vs 75 MB),
+                making the cast a no-op instead of trusting LICM to hoist it.
+  D  C + bf16 tangent domain: CG vectors stay fp32 (solver invariant), but
+                the tangent entering the linearized function is pre-cast
+                once per iteration — probes whether fp32→bf16 casts of the
+                661k-param tangent vector matter (expected: no).
+
+Each variant is timed with bench.py's discipline: CHAIN dependent solves in
+one ``lax.scan`` program, scalar probe sync, RTT-corrected, best of
+TIMING_REPS. Cosine similarity of every variant's solution against A is
+asserted ≥ 0.9999 (the VERDICT bar).
+
+Run ALONE on the chip (single-tenant tunnel): ``python scripts/explore_fvp.py``.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("EXPLORE_CPU") == "1":  # smoke-validation off the tunnel
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+OBS_DIM, ACT_DIM, HIDDEN = 376, 17, (256, 256)
+BATCH = int(os.environ.get("EXPLORE_BATCH", 50_000))
+CG_ITERS = 10
+DAMPING = 0.1
+CHAIN = int(os.environ.get("EXPLORE_CHAIN", 40))
+TIMING_REPS = 3
+
+_T0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"explore[{time.perf_counter() - _T0:7.1f}s] {msg}", file=sys.stderr)
+
+
+def device_rtt():
+    trip = jax.jit(lambda c: c + 1.0)
+    np.asarray(trip(jnp.float32(0)))
+    samples = []
+    for i in range(5):
+        t0 = time.perf_counter()
+        np.asarray(trip(jnp.float32(i + 1)))
+        samples.append(time.perf_counter() - t0)
+    return sorted(samples)[len(samples) // 2]
+
+
+def build(obs_dtype=jnp.float32):
+    from trpo_tpu.models import make_policy, BoxSpec
+    from trpo_tpu.ops import flatten_params
+
+    policy = make_policy(
+        (OBS_DIM,), BoxSpec(ACT_DIM), hidden=HIDDEN,
+        compute_dtype=jnp.bfloat16,
+    )
+    params = policy.init(jax.random.key(0))
+    obs = jax.random.normal(jax.random.key(1), (BATCH, OBS_DIM), jnp.float32)
+    obs = jnp.asarray(obs, obs_dtype)
+    flat0, unravel = flatten_params(params)
+    flat0 = jnp.asarray(flat0, jnp.float32)
+
+    def kl_fn(flat):
+        cur = jax.lax.stop_gradient(policy.apply(unravel(flat0), obs))
+        dist = policy.apply(unravel(flat), obs)
+        return jnp.mean(policy.dist.kl(cur, dist))
+
+    g = jax.random.normal(jax.random.key(2), flat0.shape, jnp.float32)
+    g = g / jnp.linalg.norm(g)
+    return kl_fn, flat0, g
+
+
+def time_variant(name, make_solve, flat0, g):
+    """make_solve(flat0) -> (v -> x) solving (F+damping I)x = v inside jit."""
+
+    @jax.jit
+    def chained(flat0, G):
+        solve = make_solve(flat0)
+
+        def body(carry, g_i):
+            rhs = -(g_i + jnp.float32(1e-30) * carry[0])
+            x = solve(rhs)
+            return x, ()
+
+        x_last, _ = jax.lax.scan(body, jnp.zeros_like(flat0), G)
+        return x_last, x_last.sum()
+
+    noise = jax.random.normal(jax.random.key(7), (CHAIN, g.shape[0]), jnp.float32)
+    G = g[None, :] + 1e-6 * noise
+    log(f"{name}: compiling")
+    x, probe = chained(flat0, G)
+    np.asarray(probe)
+    rtt = device_rtt()
+    best = float("inf")
+    for _ in range(TIMING_REPS):
+        t0 = time.perf_counter()
+        x, probe = chained(flat0, G)
+        np.asarray(probe)
+        best = min(best, time.perf_counter() - t0)
+    x_host = np.asarray(x)
+    per_iter_ms = max(best - rtt, 1e-6) / (CHAIN * CG_ITERS) * 1e3
+    log(f"{name}: {per_iter_ms:.4f} ms/iter (rtt {rtt*1e3:.0f} ms)")
+    return per_iter_ms, x_host
+
+
+def main():
+    from trpo_tpu.ops import conjugate_gradient, make_fvp
+
+    results = {}
+
+    # A — current path
+    kl_fn, flat0, g = build()
+
+    def solve_A(f0):
+        fvp = make_fvp(kl_fn, f0, DAMPING)
+        return lambda rhs: conjugate_gradient(
+            fvp, rhs, CG_ITERS, residual_tol=0.0
+        ).x
+
+    ms_a, x_a = time_variant("A current", solve_A, flat0, g)
+    results["A_current_ms"] = round(ms_a, 4)
+
+    # B — explicit linearize outside the loop
+    def solve_B(f0):
+        grad_kl = jax.grad(kl_fn)
+        _, f_jvp = jax.linearize(grad_kl, f0)
+
+        def fvp(v):
+            return jnp.asarray(f_jvp(v), jnp.float32) + DAMPING * v
+
+        return lambda rhs: conjugate_gradient(
+            fvp, rhs, CG_ITERS, residual_tol=0.0
+        ).x
+
+    try:
+        ms_b, x_b = time_variant("B linearize", solve_B, flat0, g)
+        cos_b = float(np.dot(x_a, x_b) / (np.linalg.norm(x_a) * np.linalg.norm(x_b)))
+        results.update(B_linearize_ms=round(ms_b, 4), B_cosine=round(cos_b, 6))
+    except Exception as e:
+        log(f"B failed: {type(e).__name__}: {e}")
+
+    # C — B + obs stored bf16
+    kl_fn_c, flat0_c, g_c = build(obs_dtype=jnp.bfloat16)
+
+    def solve_C(f0):
+        grad_kl = jax.grad(kl_fn_c)
+        _, f_jvp = jax.linearize(grad_kl, f0)
+
+        def fvp(v):
+            return jnp.asarray(f_jvp(v), jnp.float32) + DAMPING * v
+
+        return lambda rhs: conjugate_gradient(
+            fvp, rhs, CG_ITERS, residual_tol=0.0
+        ).x
+
+    try:
+        ms_c, x_c = time_variant("C bf16 obs", solve_C, flat0_c, g_c)
+        cos_c = float(np.dot(x_a, x_c) / (np.linalg.norm(x_a) * np.linalg.norm(x_c)))
+        results.update(C_bf16obs_ms=round(ms_c, 4), C_cosine=round(cos_c, 6))
+    except Exception as e:
+        log(f"C failed: {type(e).__name__}: {e}")
+
+    # D — C + pre-cast tangent probe
+    def solve_D(f0):
+        grad_kl = jax.grad(kl_fn_c)
+        _, f_jvp = jax.linearize(grad_kl, f0)
+
+        def fvp(v):
+            hv = f_jvp(jnp.asarray(jnp.asarray(v, jnp.bfloat16), jnp.float32))
+            return jnp.asarray(hv, jnp.float32) + DAMPING * v
+
+        return lambda rhs: conjugate_gradient(
+            fvp, rhs, CG_ITERS, residual_tol=0.0
+        ).x
+
+    try:
+        ms_d, x_d = time_variant("D bf16 tangent", solve_D, flat0_c, g_c)
+        cos_d = float(np.dot(x_a, x_d) / (np.linalg.norm(x_a) * np.linalg.norm(x_d)))
+        results.update(D_bf16tan_ms=round(ms_d, 4), D_cosine=round(cos_d, 6))
+    except Exception as e:
+        log(f"D failed: {type(e).__name__}: {e}")
+
+    dev = jax.devices()[0]
+    results["device"] = f"{dev.platform}:{dev.device_kind}"
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,189 @@
+"""Phase breakdown of the pong-sim rung (VERDICT r2 item 6).
+
+The Atari-scale rung (84×84×4 CatchPixels, ≈1.7M-param Nature conv policy)
+runs at ~13 it/s — 5× slower than the other device rungs. Suspicion: the
+renderer re-draws all ``frames`` history boards every step
+(``envs/catch.py`` vmaps ``_render_frame`` over the 4-frame history)
+instead of rendering once and shifting channels. This measures where the
+iteration actually goes:
+
+  iter        one full fused training iteration (rollout + GAE + critic +
+              TRPO update), the ladder's number
+  render      the per-step observation render alone: scan of T rollout
+              steps × vmap(n_envs) of ``CatchPixels._obs``
+  env_step    the full env step (dynamics + render) over the same scan
+  act         rollout-side policy inference: scan of T steps × conv
+              forward on (n_envs, 84, 84, 4)
+  update      the fused TRPO update (grad → CG/FVP → linesearch) on a
+              synthetic full batch — the conv-FVP cost
+
+All timings chained inside single jit programs, RTT-corrected (bench.py
+discipline). Run ALONE on the chip: ``python scripts/profile_pong.py``.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("PROFILE_CPU") == "1":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+N_ENVS = 8
+BATCH = int(os.environ.get("PROFILE_BATCH", 2048))
+ITERS = int(os.environ.get("PROFILE_ITERS", 6))
+
+_T0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"profile[{time.perf_counter() - _T0:7.1f}s] {msg}", file=sys.stderr)
+
+
+def device_rtt():
+    trip = jax.jit(lambda c: c + 1.0)
+    np.asarray(trip(jnp.float32(0)))
+    samples = []
+    for i in range(5):
+        t0 = time.perf_counter()
+        np.asarray(trip(jnp.float32(i + 1)))
+        samples.append(time.perf_counter() - t0)
+    return sorted(samples)[len(samples) // 2]
+
+
+def timed(name, fn, *args, reps=3):
+    log(f"{name}: compiling")
+    out = fn(*args)
+    jax.block_until_ready(out)
+    rtt = device_rtt()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    ms = max(best - rtt, 1e-6) * 1e3
+    log(f"{name}: {ms:.2f} ms")
+    return ms
+
+
+def main():
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import get_preset
+    from trpo_tpu.envs.catch import CatchPixels
+
+    cfg = get_preset("pong-sim")
+    cfg = cfg.replace(batch_timesteps=BATCH) if hasattr(cfg, "replace") else cfg
+    env = CatchPixels(grid=21, cell_px=4, frames=4)
+    T = BATCH // N_ENVS
+    results = {"batch_timesteps": BATCH, "n_envs": N_ENVS, "scan_steps": T}
+
+    # -- full fused iteration (chained) ------------------------------------
+    agent = TRPOAgent("pong-sim", cfg)
+    state = agent.init_state(seed=0)
+    state, _ = agent.run_iterations(state, 1)  # warm/compile path A
+
+    def iters(s):
+        s2, stats = agent.run_iterations(s, ITERS)
+        return stats["entropy"]
+
+    ms = timed("iter", iters, state)
+    results["iter_ms"] = round(ms / ITERS, 2)
+
+    # -- render-only scan --------------------------------------------------
+    key = jax.random.key(0)
+    keys = jax.random.split(key, N_ENVS)
+    s0, _ = jax.vmap(env.reset)(keys)
+
+    @jax.jit
+    def render_scan(hist0):
+        def body(carry, _):
+            # perturb hist by carry so nothing hoists; render all envs
+            hist = hist0._replace(
+                hist=hist0.hist + carry[None, None, None].astype(jnp.int32) * 0
+            )
+            obs = jax.vmap(env._obs)(hist)
+            return carry + obs.sum(dtype=jnp.int32), ()
+
+        c, _ = jax.lax.scan(body, jnp.int32(0), None, length=T)
+        return c
+
+    ms = timed("render", render_scan, s0)
+    results["render_ms_per_iter"] = round(ms, 2)
+
+    # -- full env step scan (dynamics + render) ----------------------------
+    @jax.jit
+    def step_scan(s):
+        def body(carry, _):
+            s, acc = carry
+            a = jnp.zeros((N_ENVS,), jnp.int32) + (acc % 3)
+            ks = jax.random.split(jax.random.key(0), N_ENVS)
+            s2, obs, r, term, trunc = jax.vmap(env.step)(s, a, ks)
+            return (s2, acc + obs.sum(dtype=jnp.int32)), ()
+
+        (s_last, acc), _ = jax.lax.scan(body, (s, jnp.int32(0)), None, length=T)
+        return acc
+
+    ms = timed("env_step", step_scan, s0)
+    results["env_step_ms_per_iter"] = round(ms, 2)
+
+    # -- rollout-side conv inference scan ----------------------------------
+    policy = agent.policy
+    params = state.policy_params
+    obs_step = jnp.zeros((N_ENVS,) + env.obs_shape, jnp.uint8)
+
+    @jax.jit
+    def act_scan(params, obs):
+        def body(carry, _):
+            o = obs + carry.astype(jnp.uint8)
+            dist = policy.apply(params, o)
+            leaf = jax.tree_util.tree_leaves(dist)[0]
+            return (leaf.sum() * 0).astype(jnp.uint8), ()
+
+        c, _ = jax.lax.scan(body, jnp.uint8(0), None, length=T)
+        return c
+
+    ms = timed("act", act_scan, params, obs_step)
+    results["act_ms_per_iter"] = round(ms, 2)
+
+    # -- fused TRPO update on a synthetic full batch -----------------------
+    from trpo_tpu.trpo import TRPOBatch, make_trpo_update
+
+    obs_b = jax.random.randint(
+        jax.random.key(1), (BATCH,) + env.obs_shape, 0, 255, jnp.uint8
+    )
+    dist = policy.apply(params, obs_b)
+    actions = policy.dist.sample(jax.random.key(2), dist)
+    batch = TRPOBatch(
+        obs=obs_b,
+        actions=actions,
+        advantages=jax.random.normal(jax.random.key(3), (BATCH,), jnp.float32),
+        old_dist=jax.lax.stop_gradient(dist),
+        weight=jnp.ones((BATCH,), jnp.float32),
+    )
+    update = jax.jit(make_trpo_update(policy, cfg))
+
+    def upd(params, batch):
+        p2, stats = update(params, batch)
+        return stats.kl
+
+    ms = timed("update", upd, params, batch)
+    results["update_ms_per_iter"] = round(ms, 2)
+
+    results["render_pct_of_iter"] = round(
+        100.0 * results["render_ms_per_iter"] / results["iter_ms"], 1
+    )
+    dev = jax.devices()[0]
+    results["device"] = f"{dev.platform}:{dev.device_kind}"
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

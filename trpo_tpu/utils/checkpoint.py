@@ -104,18 +104,13 @@ class Checkpointer:
                 # from there within an iteration. A concrete template (the
                 # normal agent.init_state() path) seeds cfg.cg_damping
                 # instead and never reaches this branch.
-                import dataclasses
-
                 import jax.numpy as jnp
 
                 from trpo_tpu.config import TRPOConfig
 
-                default_damping = next(
-                    f.default
-                    for f in dataclasses.fields(TRPOConfig)
-                    if f.name == "cg_damping"
+                seed = jnp.full(
+                    seed.shape, TRPOConfig.cg_damping, seed.dtype
                 )
-                seed = jnp.full(seed.shape, default_damping, seed.dtype)
             restored = restored._replace(cg_damping=seed)
         return restored
 
