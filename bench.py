@@ -22,6 +22,8 @@ Prints ONE JSON line:
 "vs_baseline": <baseline_ms_per_iter / ours_ms_per_iter>}``.
 """
 
+from __future__ import annotations
+
 import contextlib
 import json
 import os
@@ -178,7 +180,9 @@ def _analytic_acct() -> dict:
     grad = 3.0 * forward
     kl_eval = 2.0 * forward
     return {
-        "fvp": grad + tangent,
+        # standalone GGN FVP: one primal forward + the 3-forward tangent
+        "fvp": forward + tangent,
+        "forward": forward,
         "grad": grad,
         "kl_eval": kl_eval,
         "tangent": tangent,
@@ -199,49 +203,60 @@ def _cost_analysis_usable() -> bool:
     return flops is not None
 
 
-def flop_accounting(kl_fn, flat0, g):
+def flop_accounting(problem: Problem):
     """Measured FLOP counts for the solver's constituent (loop-free)
     programs, composed into per-CG-iter and per-update totals.
 
-    * ``fvp``: one standalone Fisher-vector product — primal
-      re-linearization + tangent pass (≈6 forward-equivalents).
-    * ``grad``: one reverse-mode grad of the mean KL (≈3 forwards) — also
-      the cost model for the surrogate gradient (same network, same batch,
-      scalar loss of the same shape).
+    * ``fvp``: one standalone Gauss-Newton Fisher-vector product (the
+      framework's default) — primal linearization + forward tangent +
+      backward (≈4 forward-equivalents).
+    * ``forward``: one policy apply — the loop-invariant primal the fused
+      CG loop hoists (XLA LICM / explicit ``jax.linearize``).
+    * ``grad``: one reverse-mode grad of the mean KL (≈3 forwards) — the
+      cost model for the surrogate gradient.
     * ``kl_eval``: one KL forward evaluation (two applies, old + new) —
-      the cost model for a linesearch trial (surrogate + KL eval share the
-      applies in the fused program).
-    * ``tangent`` = fvp − grad: the per-iteration cost INSIDE the fused CG
-      loop, where the primal is loop-invariant and hoisted (XLA LICM).
+      the cost model for a linesearch trial.
+    * ``tangent`` = fvp − forward: the per-iteration cost INSIDE the
+      fused CG loop (forward tangent + backward ≈ 3 forwards).
 
     ``update_model`` composes the fused update's accepted-first-try path
     (the overwhelmingly common case, and a LOWER bound otherwise):
     surrogate grad + primal linearization + (CG_ITERS+1) tangents (10 CG
     + 1 step-scale sᵀFs product) + 3 KL-shaped evals (initial losses, one
     linesearch trial, final losses)."""
-    from trpo_tpu.ops import make_fvp
+    from trpo_tpu.ops import make_ggn_fvp
+
+    weight = jnp.ones((BATCH,), jnp.float32)
 
     def fvp_prog(flat, v):
-        return make_fvp(kl_fn, flat, DAMPING)(v)
+        return make_ggn_fvp(
+            problem.apply_fn, problem.fisher_weight, flat, weight, DAMPING
+        )(v)
 
-    fvp, fvp_bytes = _program_flops(jax.jit(fvp_prog), flat0, g)
-    grad, grad_bytes = _program_flops(jax.jit(jax.grad(kl_fn)), flat0)
-    kl_eval, _ = _program_flops(jax.jit(kl_fn), flat0)
-    if fvp is None or grad is None:
+    fvp, fvp_bytes = _program_flops(
+        jax.jit(fvp_prog), problem.flat0, problem.g
+    )
+    forward, forward_bytes = _program_flops(
+        jax.jit(problem.apply_fn), problem.flat0
+    )
+    grad, _ = _program_flops(jax.jit(jax.grad(problem.kl_fn)), problem.flat0)
+    kl_eval, _ = _program_flops(jax.jit(problem.kl_fn), problem.flat0)
+    if fvp is None or forward is None or grad is None:
         return {}
-    tangent = max(fvp - grad, 0.0)
+    tangent = max(fvp - forward, 0.0)
     acct = {
         "fvp": fvp,
+        "forward": forward,
         "grad": grad,
         "kl_eval": kl_eval,
         "tangent": tangent,
         "flops_per_cg_iter": tangent,
     }
-    if fvp_bytes is not None and grad_bytes is not None:
+    if fvp_bytes is not None and forward_bytes is not None:
         # HBM traffic of the per-iteration tangent work — with the FLOPs
         # this gives the arithmetic intensity, hence which roofline
         # (compute vs bandwidth) bounds the solve
-        acct["bytes_per_cg_iter"] = max(fvp_bytes - grad_bytes, 0.0)
+        acct["bytes_per_cg_iter"] = max(fvp_bytes - forward_bytes, 0.0)
     if kl_eval is not None:
         acct["flops_per_update"] = (
             2.0 * grad + (CG_ITERS + 1) * tangent + 3.0 * kl_eval
@@ -277,7 +292,24 @@ def _chain_inputs(g, key, n):
     return g[None, :] + 1e-6 * noise
 
 
-def build_problem(compute_dtype=None, hidden=None):
+class Problem:
+    """One benchmark problem instance.
+
+    ``kl_fn`` drives the reference-semantics paths (host CG baseline,
+    jvp∘grad ablations); ``apply_fn``/``fisher_weight`` drive the
+    framework's default Gauss-Newton solve (``ops/fvp.make_ggn_fvp`` —
+    ``cfg.fvp_mode="ggn"``). Both compute the same Fisher (validated by
+    the solution-cosine asserts)."""
+
+    def __init__(self, kl_fn, apply_fn, fisher_weight, flat0, g):
+        self.kl_fn = kl_fn
+        self.apply_fn = apply_fn
+        self.fisher_weight = fisher_weight
+        self.flat0 = flat0
+        self.g = g
+
+
+def build_problem(compute_dtype=None, hidden=None) -> Problem:
     """``compute_dtype=bfloat16`` runs the policy matmuls (forward + jvp/vjp
     inside the FVP) on the MXU at full rate; CG vectors, KL, and all solver
     arithmetic stay fp32 (``ops/cg.py`` casts every iterate) — the
@@ -298,14 +330,17 @@ def build_problem(compute_dtype=None, hidden=None):
     flat0, unravel = flatten_params(params)
     flat0 = jnp.asarray(flat0, jnp.float32)
 
+    def apply_fn_at(flat):
+        return policy.apply(unravel(flat), obs)
+
     def kl_fn(flat):
-        cur = jax.lax.stop_gradient(policy.apply(unravel(flat0), obs))
-        dist = policy.apply(unravel(flat), obs)
+        cur = jax.lax.stop_gradient(apply_fn_at(flat0))
+        dist = apply_fn_at(flat)
         return jnp.mean(policy.dist.kl(cur, dist))
 
     g = jax.random.normal(jax.random.key(2), flat0.shape, jnp.float32)
     g = g / jnp.linalg.norm(g)
-    return kl_fn, flat0, g
+    return Problem(kl_fn, apply_fn_at, policy.dist.fisher_weight, flat0, g)
 
 
 def time_full_update(device=None, fvp_subsample=None):
@@ -398,9 +433,12 @@ def time_full_update(device=None, fvp_subsample=None):
     return 1.0 / per_update, per_update * 1e3
 
 
-def time_fused_solve(kl_fn, flat0, g, device=None):
+def time_fused_solve(problem: Problem, device=None):
     """Our path: CG + FVP as ONE device program, forced to CG_ITERS iters
-    (residual_tol=0 → no early exit; equal work vs the baseline loop).
+    (residual_tol=0 → no early exit; equal work vs the baseline loop),
+    using the framework's DEFAULT Fisher-vector product — the Gauss-Newton
+    factorization (``cfg.fvp_mode="ggn"``, ``ops/fvp.make_ggn_fvp``; 1.9×
+    the jvp∘grad form on the v5e at this shape, identical solutions).
 
     CHAIN solves run as a single ``lax.scan`` whose carry makes each solve
     depend on the previous one — strictly sequential on device, timed with
@@ -412,8 +450,9 @@ def time_fused_solve(kl_fn, flat0, g, device=None):
     """
     import contextlib
 
-    from trpo_tpu.ops import conjugate_gradient, make_fvp
+    from trpo_tpu.ops import conjugate_gradient, make_ggn_fvp
 
+    flat0, g = problem.flat0, problem.g
     ctx = (
         jax.default_device(device)
         if device is not None
@@ -429,10 +468,17 @@ def time_fused_solve(kl_fn, flat0, g, device=None):
         n_chain = CHAIN if (_ACCEL and device is None) else 3
         n_reps = TIMING_REPS if (_ACCEL and device is None) else 1
         G = _chain_inputs(g, jax.random.key(7), n_chain)
+        weight = jnp.ones((BATCH,), jnp.float32)
 
         @jax.jit
         def chained_solves(flat0, G):
-            fvp = make_fvp(lambda f: kl_fn(f), flat0, DAMPING)
+            fvp = make_ggn_fvp(
+                problem.apply_fn,
+                problem.fisher_weight,
+                flat0,
+                weight,
+                damping=DAMPING,
+            )
 
             def body(carry, g_i):
                 # eps·carry[0] is float-noise-level but opaque to the
@@ -494,10 +540,10 @@ def width_study(widths, device=None):
         _progress(f"width study: hidden {hidden}")
         try:
             with ctx:
-                kl_fn, flat0, g = build_problem(
+                prob = build_problem(
                     jnp.bfloat16 if _ACCEL else jnp.float32, hidden=hidden
                 )
-            ms, _x = time_fused_solve(kl_fn, flat0, g, device=device)
+            ms, _x = time_fused_solve(prob, device=device)
         except Exception as e:
             _progress(f"width {w} failed ({type(e).__name__}: {e})")
             continue
@@ -532,29 +578,36 @@ def _host_cg_loop(fvp_host, b, iters=None):
     return x
 
 
-def time_host_driven_cg(kl_fn, flat0, g):
-    """Fusion ablation: the SAME jit-compiled device FVP (bf16 matmuls on
-    the accelerator) but the reference's host-driven CG loop
-    (``utils.py:185-201``) — tangent uploaded, FVP run, result downloaded,
-    damping and all CG vector arithmetic on the host, once per iteration.
+def time_host_driven_cg(problem: Problem):
+    """Transport ablation: the SAME device FVP the fused solve uses (the
+    Gauss-Newton form, bf16 matmuls on the accelerator) but the
+    reference's host-driven CG loop (``utils.py:185-201``) — tangent
+    uploaded, FVP run, result downloaded, damping and all CG vector
+    arithmetic on the host, once per iteration.
 
-    Separates the two effects bundled in the headline speedup: chip speedup
-    (this row vs the CPU baseline) and fusion speedup (the fused solve vs
-    this row). Reported both raw and RTT-corrected — on the tunneled
-    accelerator each iteration pays ~100 ms of transport that a locally
-    attached host would not; the corrected number is the fair
-    locally-attached estimate (and an upper bound on the host loop's
-    speed, i.e. a LOWER bound on the fusion win)."""
+    On this tunneled setup raw ≈ one ~100 ms round trip per iteration —
+    transport dwarfs compute — so the row documents the transport cost;
+    speedup claims come from the transport-free CPU pair in ``main``.
+    The RTT-corrected value is dropped when it lands below the jitter
+    floor (subtracting ~RTT from ~RTT is noise, round-2 lesson)."""
+    from trpo_tpu.ops import make_ggn_fvp
+
+    weight = jnp.ones((BATCH,), jnp.float32)
+
     @jax.jit
     def fvp_dev(flat, v):
-        grad_kl = jax.grad(kl_fn)
-        return jax.jvp(grad_kl, (flat,), (v,))[1]
+        # damping added host-side (reference semantics)
+        return make_ggn_fvp(
+            problem.apply_fn, problem.fisher_weight, flat, weight, 0.0
+        )(v)
+
+    flat0 = problem.flat0
 
     def fvp_host(p):                          # one round trip per call
         out = fvp_dev(flat0, jnp.asarray(p, jnp.float32))
         return np.asarray(out) + DAMPING * p
 
-    b = -np.asarray(g)
+    b = -np.asarray(problem.g)
     _progress("host-driven CG: compiling")
     fvp_host(b)                               # compile + warm
     rtt = _device_rtt()
@@ -581,21 +634,23 @@ def time_host_driven_cg(kl_fn, flat0, g):
     return raw_ms, corrected_ms, x
 
 
-def time_standalone_fvp(kl_fn, flat0, g, n_chain=400):
-    """The STABLE fusion ablation: per-call cost of one standalone FVP
-    with a MOVING linearization point — the device work a host-driven CG
-    loop cannot avoid even with zero transport (each call re-runs the
-    primal grad; the fused loop's `lax.while_loop` LICM-hoists it once
-    per solve). Chained-dependent timing per `_device_rtt` rules, so
-    unlike `time_host_driven_cg` (raw ≈ one tunnel RTT per iteration,
-    corrected = small difference of large numbers) this number
-    reproduces run to run. this ÷ fused-per-iter = the kernel-level
-    fusion factor (main() reports it as fusion_speedup_kernel_level);
-    the rest of the host-driven gap is dispatch+transport. Dtypes match
-    the fused path exactly: the linearization point stays fp32 (the
-    solver domain — build_problem keeps flat fp32; bf16 casting happens
-    inside policy.apply on both paths)."""
-    from trpo_tpu.ops import make_fvp
+def time_standalone_fvp(problem: Problem, n_chain=400):
+    """The STABLE kernel-level fusion ablation: per-call cost of one
+    standalone FVP (Gauss-Newton form — same as the fused path) with a
+    MOVING linearization point — the device work a host-driven CG loop
+    cannot avoid even with zero transport (each call re-pays the primal
+    linearization; the fused loop hoists it once per solve).
+    Chained-dependent timing per `_device_rtt` rules, so unlike
+    `time_host_driven_cg` (raw ≈ one tunnel RTT per iteration) this
+    number reproduces run to run. this ÷ fused-per-iter = the
+    kernel-level fusion factor (fusion_speedup_kernel_level); the rest
+    of the host-driven gap is dispatch+transport. Dtypes match the fused
+    path exactly (flat stays fp32; bf16 casting happens inside
+    policy.apply on both paths)."""
+    from trpo_tpu.ops import make_ggn_fvp
+
+    weight = jnp.ones((BATCH,), jnp.float32)
+    flat0, g = problem.flat0, problem.g
 
     @jax.jit
     def chained(flat0, g):
@@ -604,9 +659,10 @@ def time_standalone_fvp(kl_fn, flat0, g, n_chain=400):
             # opaque — forces the primal to recompute every call, as a
             # host loop's separate dispatches would
             flat = flat0 + jnp.float32(1e-30) * carry
-            hv = make_fvp(kl_fn, flat, DAMPING)(
-                g + jnp.float32(1e-30) * carry
-            )
+            hv = make_ggn_fvp(
+                problem.apply_fn, problem.fisher_weight, flat, weight,
+                DAMPING,
+            )(g + jnp.float32(1e-30) * carry)
             return hv, ()
 
         hv, _ = jax.lax.scan(
@@ -637,24 +693,26 @@ def time_standalone_fvp(kl_fn, flat0, g, n_chain=400):
     return (best - rtt) / n_chain * 1e3
 
 
-def time_reference_semantics(kl_fn, flat0, g):
+def time_reference_semantics(problem: Problem):
     """Reference path: host NumPy CG; ONE device FVP call per iteration
     with host transfer both ways + host-side damping (ref utils.py:185-201,
-    trpo_inksci.py:124-126), on the CPU backend."""
+    trpo_inksci.py:124-126) — the FVP as the reference computes it, double
+    backprop of the stop-grad KL (here jvp∘grad, same graph shape) — on
+    the CPU backend."""
     cpu = jax.devices("cpu")[0]
     with jax.default_device(cpu):
-        flat_c = jax.device_put(np.asarray(flat0), cpu)
+        flat_c = jax.device_put(np.asarray(problem.flat0), cpu)
 
         @jax.jit
         def fvp_dev(flat, v):
-            grad_kl = jax.grad(kl_fn)
+            grad_kl = jax.grad(problem.kl_fn)
             return jax.jvp(grad_kl, (flat,), (v,))[1]
 
         def fvp_host(p):                      # one round trip per call
             out = fvp_dev(flat_c, jax.device_put(p.astype(np.float32), cpu))
             return np.asarray(out) + DAMPING * p
 
-        b = -np.asarray(g)
+        b = -np.asarray(problem.g)
 
         _progress("baseline: compiling")
         fvp_host(b)                           # compile + warm (one FVP)
@@ -667,22 +725,58 @@ def time_reference_semantics(kl_fn, flat0, g):
     return dt / (BASELINE_REPS * CG_ITERS) * 1e3, x
 
 
+def time_host_driven_cpu_ggn(problem: Problem):
+    """The fusion isolator: the reference's host-driven CG loop on the
+    in-process CPU backend but with the SAME Gauss-Newton FVP the fused
+    solve uses — so (this ÷ fused-CPU) is pure loop fusion, uncontaminated
+    by either transport (both in-process) or the FVP factorization swap
+    (both GGN). The plain baseline above keeps the reference's jvp∘grad
+    FVP; its ratio to the fused solve is the overall solver-vs-reference
+    win on identical hardware."""
+    from trpo_tpu.ops import make_ggn_fvp
+
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        flat_c = jax.device_put(np.asarray(problem.flat0), cpu)
+        weight = jnp.ones((BATCH,), jnp.float32)
+
+        @jax.jit
+        def fvp_dev(flat, v):
+            return make_ggn_fvp(
+                problem.apply_fn, problem.fisher_weight, flat, weight, 0.0
+            )(v)
+
+        def fvp_host(p):
+            out = fvp_dev(flat_c, jax.device_put(p.astype(np.float32), cpu))
+            return np.asarray(out) + DAMPING * p
+
+        b = -np.asarray(problem.g)
+        _progress("host-driven CPU (GGN): compiling")
+        fvp_host(b)
+        _progress("host-driven CPU (GGN): timing")
+        t0 = time.perf_counter()
+        x = _host_cg_loop(fvp_host, b)
+        dt = time.perf_counter() - t0
+        _progress("host-driven CPU (GGN): done")
+    return dt / CG_ITERS * 1e3, x
+
+
 def main():
     global _ACCEL
     # Fused path at the TPU operating point (bf16 matmuls, fp32 solve);
     # baseline at reference semantics (fp32 throughout). Params/g share
     # keys, so both solve the same system up to matmul precision — the
     # solution-cosine assert cross-checks them.
-    kl_fn, flat0, g = build_problem(
+    problem = build_problem(
         jnp.bfloat16 if _ACCEL else jnp.float32
     )
     try:
-        ours_ms, x_ours = time_fused_solve(kl_fn, flat0, g)
+        ours_ms, x_ours = time_fused_solve(problem)
     except Exception as e:  # tunnel flake mid-compile/run — retry once
         _progress(f"accelerator attempt failed ({type(e).__name__}: {e}); "
                   "retrying once")
         try:
-            ours_ms, x_ours = time_fused_solve(kl_fn, flat0, g)
+            ours_ms, x_ours = time_fused_solve(problem)
         except Exception as e2:
             if not _ACCEL:
                 raise  # already on CPU; a failure here is a real bug
@@ -690,12 +784,13 @@ def main():
                       "CPU for the fused path")
             # backends are already initialized, so a config-level platform
             # switch is a no-op — pin the CPU device explicitly, and rebuild
-            # the problem there (kl_fn closes over accelerator-resident obs)
+            # the problem there (apply_fn closes over accelerator-resident
+            # obs)
             _ACCEL = False
             cpu = jax.devices("cpu")[0]
             with jax.default_device(cpu):
-                kl_fn, flat0, g = build_problem()
-            ours_ms, x_ours = time_fused_solve(kl_fn, flat0, g, device=cpu)
+                problem = build_problem()
+            ours_ms, x_ours = time_fused_solve(problem, device=cpu)
     # FLOP accounting on the same problem (loop-free lowered programs;
     # compile-only, nothing executed — see flop_accounting docstring).
     # After a TPU fallback, pin the lowering to CPU: compiling against a
@@ -720,7 +815,7 @@ def main():
                 and _cost_analysis_usable()
             ):
                 _progress("flop accounting: lowering single-kernel programs")
-                acct = flop_accounting(kl_fn, flat0, g)
+                acct = flop_accounting(problem)
             else:
                 _progress(
                     "flop accounting: backend reports no cost analysis — "
@@ -747,14 +842,14 @@ def main():
     host_cg_raw_ms = host_cg_ms = None
     if _ACCEL:
         try:
-            standalone_fvp_ms = time_standalone_fvp(kl_fn, flat0, g)
+            standalone_fvp_ms = time_standalone_fvp(problem)
         except Exception as e:
             _progress(
                 f"standalone-FVP timing failed ({type(e).__name__}: {e})"
             )
         try:
             host_cg_raw_ms, host_cg_ms, x_hd = time_host_driven_cg(
-                kl_fn, flat0, g
+                problem
             )
             # the ablation rows only mean something if they solved the
             # same system — same guard as the baseline's cosine check
@@ -795,32 +890,41 @@ def main():
     # the CPU backend, where the baseline runs.
     if _ACCEL:
         with jax.default_device(jax.devices("cpu")[0]):
-            kl_fn32, flat0_32, g32 = build_problem()
+            problem32 = build_problem()
     else:
-        kl_fn32, flat0_32, g32 = kl_fn, flat0, g
-    base_ms, x_base = time_reference_semantics(kl_fn32, flat0_32, g32)
+        problem32 = problem
+    base_ms, x_base = time_reference_semantics(problem32)
 
-    # Transport-free fusion ablation (VERDICT r2 item 5): the baseline
-    # above IS the host-driven CG loop on the in-process CPU backend
-    # (zero tunnel transport); running the FUSED solve on that same CPU
-    # backend isolates fusion× with no ~100 ms RTT anywhere in either
-    # measurement — unlike the accelerator host-driven row, whose
-    # corrected value subtracts a ~100 ms RTT from a ~100 ms window.
-    #   fusion_speedup            = host-driven CPU / fused CPU
-    #   chip_speedup_fused_vs_cpu = fused CPU / fused accelerator
-    # and their product recovers ~vs_baseline (modulo bf16 matmuls on
-    # the chip path).
+    # Transport-free ablations (VERDICT r2 item 5) — every ratio below
+    # compares programs on the SAME in-process CPU backend, so no ~100 ms
+    # tunnel RTT contaminates either side (unlike the accelerator
+    # host-driven row, whose corrected value subtracts ~RTT from ~RTT):
+    #   fusion_speedup = host-driven CG with the SAME GGN FVP ÷ fused GGN
+    #                    solve — pure loop fusion, matched factorization;
+    #   solver_speedup_vs_reference_cpu = reference-semantics baseline
+    #                    (host CG, jvp∘grad FVP) ÷ fused GGN solve — the
+    #                    overall our-solver-vs-reference win per backend
+    #                    (bundles fusion + the GGN factorization);
+    #   chip_speedup_fused_vs_cpu = fused CPU ÷ fused accelerator — the
+    #                    same program across backends.
     if _ACCEL:
         try:
             cpu = jax.devices("cpu")[0]
             fused_cpu_ms, _x_cpu = time_fused_solve(
-                kl_fn32, flat0_32, g32, device=cpu
+                problem32, device=cpu
             )
         except Exception as e:
             _progress(f"CPU fused solve failed ({type(e).__name__}: {e})")
             fused_cpu_ms = None
     else:
         fused_cpu_ms = ours_ms  # already the same backend
+    try:
+        host_ggn_cpu_ms, _x_hg = time_host_driven_cpu_ggn(problem32)
+    except Exception as e:
+        _progress(
+            f"host-driven CPU GGN loop failed ({type(e).__name__}: {e})"
+        )
+        host_ggn_cpu_ms = None
 
     # MFU-vs-width scaling study (VERDICT r2 item 2) — accelerator only
     # by default; BENCH_WIDTHS overrides (e.g. "8,16" for CPU smoke runs,
@@ -927,14 +1031,22 @@ def main():
                 "min_arithmetic_intensity_flops_per_byte": _r(intensity, 1),
                 "unfused_traffic_roofline_tflops": _r(roofline_tflops, 1),
                 "solve_vs_unfused_roofline": _r(roofline_frac, 3),
-                # -- fusion ablation, transport-free (VERDICT r2 item 5):
-                #    both sides of fusion_speedup run on the in-process
-                #    CPU backend (baseline = host-driven CG loop, fused =
-                #    the same solve as one program), so no tunnel RTT
-                #    contaminates either number; chip_speedup_fused_vs_cpu
-                #    compares the SAME fused program across backends --
+                # -- transport-free ablations (VERDICT r2 item 5): all
+                #    CPU-side rows run on the in-process CPU backend, so
+                #    no tunnel RTT contaminates any ratio. fusion_speedup
+                #    pairs MATCHED GGN FVPs (host loop vs fused program —
+                #    pure loop fusion); solver_speedup_vs_reference_cpu
+                #    pairs our fused GGN solve against the reference-
+                #    semantics baseline (host CG + jvp∘grad FVP) on the
+                #    same backend (fusion + factorization bundled);
+                #    chip_speedup_fused_vs_cpu compares the SAME fused
+                #    program across backends --
                 "fused_cpu_ms_per_iter": _r(fused_cpu_ms, 3),
+                "host_driven_cpu_ggn_ms_per_iter": _r(host_ggn_cpu_ms, 3),
                 "fusion_speedup": None
+                if fused_cpu_ms is None or host_ggn_cpu_ms is None
+                else round(host_ggn_cpu_ms / fused_cpu_ms, 2),
+                "solver_speedup_vs_reference_cpu": None
                 if fused_cpu_ms is None
                 else round(base_ms / fused_cpu_ms, 2),
                 "chip_speedup_fused_vs_cpu": None

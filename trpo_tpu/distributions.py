@@ -72,6 +72,18 @@ class Categorical:
         (``trpo_inksci.py:83``)."""
         return jnp.argmax(params["logits"], axis=-1)
 
+    @staticmethod
+    def fisher_weight(params0, tangent):
+        """Dist-space Fisher action ``M·d`` at ``params0`` — the Hessian of
+        ``KL(stop_grad(π₀) ‖ π)`` w.r.t. the NEW dist's logits, evaluated
+        at π = π₀: ``diag(p) − p pᵀ`` per sample. Powers the Gauss-Newton
+        Fisher-vector product (``ops.fvp.make_ggn_fvp``) — identical math
+        to differentiating the KL twice (ref ``trpo_inksci.py:56-70``),
+        factored as jvp→M→vjp instead."""
+        p = jax.nn.softmax(params0["logits"], axis=-1)
+        d = tangent["logits"]
+        return {"logits": p * d - p * jnp.sum(p * d, axis=-1, keepdims=True)}
+
 
 class DiagGaussian:
     """Diagonal Gaussian over continuous actions (mean + per-dim log std).
@@ -109,6 +121,19 @@ class DiagGaussian:
         return mean + jnp.exp(log_std) * jax.random.normal(
             key, mean.shape, mean.dtype
         )
+
+    @staticmethod
+    def fisher_weight(params0, tangent):
+        """Dist-space Fisher action ``M·d`` at ``params0`` (see
+        ``Categorical.fisher_weight``): for a diagonal Gaussian in
+        (mean, log σ) coordinates the KL Hessian at equal dists is
+        ``diag(1/σ²)`` on the mean block and ``2·I`` on the log_std block
+        (zero cross terms)."""
+        inv_var = jnp.exp(-2.0 * params0["log_std"])
+        return {
+            "mean": tangent["mean"] * inv_var,
+            "log_std": 2.0 * tangent["log_std"],
+        }
 
     @staticmethod
     def mode(params):

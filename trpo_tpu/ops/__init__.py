@@ -15,4 +15,8 @@ from trpo_tpu.ops.returns import (  # noqa: F401
 )
 from trpo_tpu.ops.cg import conjugate_gradient  # noqa: F401
 from trpo_tpu.ops.linesearch import backtracking_linesearch  # noqa: F401
-from trpo_tpu.ops.fvp import make_fvp, materialize_fisher  # noqa: F401
+from trpo_tpu.ops.fvp import (  # noqa: F401
+    make_fvp,
+    make_ggn_fvp,
+    materialize_fisher,
+)

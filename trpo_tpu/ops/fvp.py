@@ -23,7 +23,12 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
-__all__ = ["make_fvp", "make_tree_fvp", "materialize_fisher"]
+__all__ = [
+    "make_fvp",
+    "make_ggn_fvp",
+    "make_tree_fvp",
+    "materialize_fisher",
+]
 
 
 def make_fvp(
@@ -67,6 +72,59 @@ def make_tree_fvp(
 
     def fvp(v: Any) -> Any:
         hv = jax.jvp(grad_kl, (params,), (v,))[1]
+        return jax.tree_util.tree_map(
+            lambda h, t: jnp.asarray(h, jnp.float32) + damping * t, hv, v
+        )
+
+    return fvp
+
+
+def make_ggn_fvp(
+    apply_fn: Callable[[Any], Any],
+    fisher_weight: Callable[[Any, Any], Any],
+    x0: Any,
+    weight: jax.Array,
+    damping: float = 0.0,
+) -> Callable[[Any], Any]:
+    """Gauss-Newton form of the Fisher-vector product:
+    ``F·v = Jᵀ (M · (J v))`` with ``J`` the Jacobian of the dist params
+    w.r.t. the optimization variable and ``M`` the dist-space KL Hessian
+    (``dist.fisher_weight``). For exponential-family heads this is
+    EXACTLY the Fisher/KL-Hessian the reference differentiates twice for
+    (``trpo_inksci.py:56-70``) — same math, different factorization.
+
+    Why it exists: the ``jvp∘grad`` form (:func:`make_fvp`) replays a
+    tangent sweep through the forward *and backward* graph every CG
+    iteration; this form replays a forward tangent plus a plain backward
+    — same FLOPs (~3 forward-equivalents) but a better memory-access
+    pattern. Measured on the v5e at the Humanoid operating point
+    (376→256²→17, batch 50k, bf16 matmuls): **0.44 vs 0.83 ms/iter,
+    1.9×**, solution cosine 1.0 (``scripts/explore_ggn.py``).
+
+    ``apply_fn(x) -> dist_params`` must close over the batch obs;
+    ``weight`` is the per-sample weight column (padding-exact weighted
+    mean, broadcast against the dist leaves' trailing axis). ``x0`` may
+    be a flat vector or a params pytree — the operator is domain-
+    polymorphic like everything in ``ops/``. Linearization residuals are
+    computed once (``jax.linearize`` / ``jax.vjp`` outside the caller's
+    CG loop) and reused across iterations."""
+    d0, f_jvp = jax.linearize(apply_fn, x0)
+    # transpose the ONE linearization instead of a second jax.vjp trace —
+    # same pullback, and eager callers don't pay a duplicate primal
+    # forward (inside jit XLA CSE would dedup it anyway)
+    f_vjp = jax.linear_transpose(f_jvp, x0)
+    d0 = jax.lax.stop_gradient(d0)
+    w_norm = weight / jnp.maximum(jnp.sum(weight), 1.0)
+
+    def fvp(v: Any) -> Any:
+        d = f_jvp(v)
+        m = fisher_weight(d0, d)
+        m = jax.tree_util.tree_map(
+            lambda t: jnp.asarray(t, jnp.float32)
+            * jnp.expand_dims(w_norm, -1),
+            m,
+        )
+        hv = f_vjp(m)[0]
         return jax.tree_util.tree_map(
             lambda h, t: jnp.asarray(h, jnp.float32) + damping * t, hv, v
         )

