@@ -1,24 +1,26 @@
 """Phase breakdown of the pong-sim rung (VERDICT r2 item 6).
 
 The Atari-scale rung (84×84×4 CatchPixels, ≈1.7M-param Nature conv policy)
-runs at ~13 it/s — 5× slower than the other device rungs. Suspicion: the
-renderer re-draws all ``frames`` history boards every step
-(``envs/catch.py`` vmaps ``_render_frame`` over the 4-frame history)
-instead of rendering once and shifting channels. This measures where the
-iteration actually goes:
+was the slowest device rung by 5×. This measures where the iteration goes:
 
   iter        one full fused training iteration (rollout + GAE + critic +
               TRPO update), the ladder's number
-  render      the per-step observation render alone: scan of T rollout
-              steps × vmap(n_envs) of ``CatchPixels._obs``
-  env_step    the full env step (dynamics + render) over the same scan
-  act         rollout-side policy inference: scan of T steps × conv
-              forward on (n_envs, 84, 84, 4)
-  update      the fused TRPO update (grad → CG/FVP → linesearch) on a
-              synthetic full batch — the conv-FVP cost
+  render      per-iteration observation render cost: T rollout steps ×
+              vmap(n_envs) of ``CatchPixels._obs``
+  env_step    full env step (dynamics + render) over the same scan
+  act         rollout-side policy inference: T steps × conv forward on
+              (n_envs, 84, 84, 4)
+  update      the fused TRPO update (grad → CG/GGN-FVP → linesearch) on a
+              full batch
+  vf_fit      the critic fit (vf_train_steps full-batch Adam steps on the
+              flattened-pixel MLP)
+  vf_predict  the two GAE-side value predictions
 
-All timings chained inside single jit programs, RTT-corrected (bench.py
-discipline). Run ALONE on the chip: ``python scripts/profile_pong.py``.
+EVERY phase is timed as a chained multi-repetition jit program whose
+window is several× the ~110 ms tunnel RTT (single calls are RTT noise —
+the round-2 lesson), RTT-corrected, best of reps.
+
+Run ALONE on the chip: ``python scripts/profile_pong.py``.
 """
 
 import json
@@ -39,6 +41,7 @@ sys.path.insert(0, ".")
 N_ENVS = 8
 BATCH = int(os.environ.get("PROFILE_BATCH", 2048))
 ITERS = int(os.environ.get("PROFILE_ITERS", 6))
+SCALE = float(os.environ.get("PROFILE_SCALE", 1.0))  # shrink chains (CPU)
 
 _T0 = time.perf_counter()
 
@@ -59,6 +62,7 @@ def device_rtt():
 
 
 def timed(name, fn, *args, reps=3):
+    """fn(*args) -> scalar-ish; returns best wall ms, RTT-corrected."""
     log(f"{name}: compiling")
     out = fn(*args)
     jax.block_until_ready(out)
@@ -70,7 +74,7 @@ def timed(name, fn, *args, reps=3):
         jax.block_until_ready(out)
         best = min(best, time.perf_counter() - t0)
     ms = max(best - rtt, 1e-6) * 1e3
-    log(f"{name}: {ms:.2f} ms")
+    log(f"{name}: {ms:.2f} ms total window (rtt {rtt*1e3:.0f} ms)")
     return ms
 
 
@@ -79,8 +83,7 @@ def main():
     from trpo_tpu.config import get_preset
     from trpo_tpu.envs.catch import CatchPixels
 
-    cfg = get_preset("pong-sim")
-    cfg = cfg.replace(batch_timesteps=BATCH) if hasattr(cfg, "replace") else cfg
+    cfg = get_preset("pong-sim").replace(batch_timesteps=BATCH)
     env = CatchPixels(grid=21, cell_px=4, frames=4)
     T = BATCH // N_ENVS
     results = {"batch_timesteps": BATCH, "n_envs": N_ENVS, "scan_steps": T}
@@ -88,16 +91,18 @@ def main():
     # -- full fused iteration (chained) ------------------------------------
     agent = TRPOAgent("pong-sim", cfg)
     state = agent.init_state(seed=0)
-    state, _ = agent.run_iterations(state, 1)  # warm/compile path A
+    state, _ = agent.run_iterations(state, 1)  # warm/compile
 
     def iters(s):
         s2, stats = agent.run_iterations(s, ITERS)
         return stats["entropy"]
 
     ms = timed("iter", iters, state)
-    results["iter_ms"] = round(ms / ITERS, 2)
+    iter_ms = ms / ITERS
+    results["iter_ms"] = round(iter_ms, 2)
 
-    # -- render-only scan --------------------------------------------------
+    # -- render / env-step / act scans: R× the per-iteration step count ---
+    R = max(1, int(32 * SCALE))
     key = jax.random.key(0)
     keys = jax.random.split(key, N_ENVS)
     s0, _ = jax.vmap(env.reset)(keys)
@@ -105,20 +110,18 @@ def main():
     @jax.jit
     def render_scan(hist0):
         def body(carry, _):
-            # perturb hist by carry so nothing hoists; render all envs
             hist = hist0._replace(
-                hist=hist0.hist + carry[None, None, None].astype(jnp.int32) * 0
+                hist=hist0.hist + (carry % 2)[None, None, None]
             )
             obs = jax.vmap(env._obs)(hist)
             return carry + obs.sum(dtype=jnp.int32), ()
 
-        c, _ = jax.lax.scan(body, jnp.int32(0), None, length=T)
+        c, _ = jax.lax.scan(body, jnp.int32(0), None, length=T * R)
         return c
 
     ms = timed("render", render_scan, s0)
-    results["render_ms_per_iter"] = round(ms, 2)
+    results["render_ms_per_iter"] = round(ms / R, 2)
 
-    # -- full env step scan (dynamics + render) ----------------------------
     @jax.jit
     def step_scan(s):
         def body(carry, _):
@@ -128,13 +131,14 @@ def main():
             s2, obs, r, term, trunc = jax.vmap(env.step)(s, a, ks)
             return (s2, acc + obs.sum(dtype=jnp.int32)), ()
 
-        (s_last, acc), _ = jax.lax.scan(body, (s, jnp.int32(0)), None, length=T)
+        (s_last, acc), _ = jax.lax.scan(
+            body, (s, jnp.int32(0)), None, length=T * R
+        )
         return acc
 
     ms = timed("env_step", step_scan, s0)
-    results["env_step_ms_per_iter"] = round(ms, 2)
+    results["env_step_ms_per_iter"] = round(ms / R, 2)
 
-    # -- rollout-side conv inference scan ----------------------------------
     policy = agent.policy
     params = state.policy_params
     obs_step = jnp.zeros((N_ENVS,) + env.obs_shape, jnp.uint8)
@@ -142,20 +146,23 @@ def main():
     @jax.jit
     def act_scan(params, obs):
         def body(carry, _):
-            o = obs + carry.astype(jnp.uint8)
+            o = obs + carry
             dist = policy.apply(params, o)
             leaf = jax.tree_util.tree_leaves(dist)[0]
             return (leaf.sum() * 0).astype(jnp.uint8), ()
 
-        c, _ = jax.lax.scan(body, jnp.uint8(0), None, length=T)
+        c, _ = jax.lax.scan(
+            body, jnp.uint8(0), None, length=T * R
+        )
         return c
 
     ms = timed("act", act_scan, params, obs_step)
-    results["act_ms_per_iter"] = round(ms, 2)
+    results["act_ms_per_iter"] = round(ms / R, 2)
 
-    # -- fused TRPO update on a synthetic full batch -----------------------
+    # -- fused TRPO update, chained U× ------------------------------------
     from trpo_tpu.trpo import TRPOBatch, make_trpo_update
 
+    U = max(1, int(16 * SCALE))
     obs_b = jax.random.randint(
         jax.random.key(1), (BATCH,) + env.obs_shape, 0, 255, jnp.uint8
     )
@@ -168,18 +175,70 @@ def main():
         old_dist=jax.lax.stop_gradient(dist),
         weight=jnp.ones((BATCH,), jnp.float32),
     )
-    update = jax.jit(make_trpo_update(policy, cfg))
+    update = make_trpo_update(policy, cfg)
 
-    def upd(params, batch):
-        p2, stats = update(params, batch)
-        return stats.kl
+    @jax.jit
+    def upd_chain(params, batch):
+        def body(p, _):
+            p2, stats = update(p, batch)
+            return p2, stats.kl
 
-    ms = timed("update", upd, params, batch)
-    results["update_ms_per_iter"] = round(ms, 2)
+        p_last, kls = jax.lax.scan(body, params, None, length=U)
+        return kls.sum()
 
-    results["render_pct_of_iter"] = round(
-        100.0 * results["render_ms_per_iter"] / results["iter_ms"], 1
+    ms = timed("update", upd_chain, params, batch)
+    results["update_ms_per_iter"] = round(ms / U, 2)
+
+    # -- critic fit, chained F× -------------------------------------------
+    F = max(1, int(8 * SCALE))
+    vf = agent.vf
+    targets = jax.random.normal(jax.random.key(4), (BATCH,), jnp.float32)
+    w = jnp.ones((BATCH,), jnp.float32)
+    vf_state = state.vf_state
+
+    @jax.jit
+    def fit_chain(vf_state, obs_b, targets, w):
+        def body(s, _):
+            s2, losses = vf.fit(s, obs_b, targets, w)
+            return s2, jnp.sum(losses)
+
+        s_last, ls = jax.lax.scan(body, vf_state, None, length=F)
+        return ls.sum()
+
+    ms = timed("vf_fit", fit_chain, vf_state, obs_b, targets, w)
+    results["vf_fit_ms_per_iter"] = round(ms / F, 2)
+
+    # -- GAE-side predicts (2 per iteration), chained P× -------------------
+    P = max(1, int(64 * SCALE))
+
+    @jax.jit
+    def predict_chain(vf_state, obs_b):
+        def body(c, _):
+            v = vf.predict(vf_state, obs_b)
+            return c + v.sum() * 0, ()
+
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=2 * P)
+        return c
+
+    ms = timed("vf_predict_x2", predict_chain, vf_state, obs_b)
+    results["vf_predict_ms_per_iter"] = round(ms / P, 2)
+
+    accounted = sum(
+        results[k]
+        for k in (
+            "env_step_ms_per_iter",
+            "act_ms_per_iter",
+            "update_ms_per_iter",
+            "vf_fit_ms_per_iter",
+            "vf_predict_ms_per_iter",
+        )
     )
+    results["accounted_ms"] = round(accounted, 2)
+    results["accounted_pct"] = round(100.0 * accounted / iter_ms, 1)
+    for k in ("render", "vf_fit", "update"):
+        results[f"{k}_pct_of_iter"] = round(
+            100.0 * results[f"{k}_ms_per_iter"] / iter_ms, 1
+        )
     dev = jax.devices()[0]
     results["device"] = f"{dev.platform}:{dev.device_kind}"
     print(json.dumps(results))
