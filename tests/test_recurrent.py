@@ -333,3 +333,22 @@ def test_host_recurrent_eval_resets_memory():
     state, stats = agent.run_iteration(state)
     assert not agent._host_env_reset_pending
     assert np.isfinite(float(stats["entropy"]))
+
+
+def test_recurrent_fvp_mode_parity():
+    """GGN and jvp_grad must land on the same update through the GRU
+    policy too — the (T, N, D) dist-leaf / (T, N) weight broadcast in
+    make_ggn_fvp is what this pins."""
+    kwargs = dict(
+        env="cartpole", n_envs=4, batch_timesteps=64, policy_gru=8,
+        policy_hidden=(8,), vf_train_steps=3, cg_iters=3, seed=5,
+    )
+    a_ggn = TRPOAgent("cartpole", TRPOConfig(fvp_mode="ggn", **kwargs))
+    a_jg = TRPOAgent("cartpole", TRPOConfig(fvp_mode="jvp_grad", **kwargs))
+    s1, _ = a_ggn.run_iteration(a_ggn.init_state(seed=3))
+    s2, _ = a_jg.run_iteration(a_jg.init_state(seed=3))
+    f1 = jax.flatten_util.ravel_pytree(s1.policy_params)[0]
+    f2 = jax.flatten_util.ravel_pytree(s2.policy_params)[0]
+    np.testing.assert_allclose(
+        np.asarray(f1), np.asarray(f2), rtol=1e-4, atol=1e-5
+    )

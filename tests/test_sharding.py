@@ -153,3 +153,31 @@ def test_sharded_cg_solve_end_to_end():
     np.testing.assert_allclose(
         np.asarray(x_shard), np.asarray(x_single), rtol=5e-3, atol=1e-4
     )
+
+
+def test_sharded_ggn_fvp_equals_single_device():
+    """The explicit shard_map spelling of the DEFAULT (Gauss-Newton) FVP
+    must equal the single-device op — including under zero-weight padding
+    (uneven 250 % 8 batch)."""
+    from trpo_tpu.ops import make_ggn_fvp
+    from trpo_tpu.parallel import make_sharded_ggn_fvp
+
+    for n in (256, 250):
+        policy, params, batch = setup(n=n)
+        cfg = TRPOConfig(cg_damping=0.1)
+        mesh = make_mesh()
+        flat0, unravel = flatten_params(params)
+
+        single_fvp = make_ggn_fvp(
+            lambda f: policy.apply(unravel(f), batch.obs),
+            policy.dist.fisher_weight,
+            jnp.asarray(flat0, jnp.float32),
+            batch.weight,
+            damping=0.1,
+        )
+        sharded_fvp = make_sharded_ggn_fvp(policy, cfg, mesh)
+        sbatch = shard_batch(mesh, batch)
+        v = jax.random.normal(jax.random.key(9), flat0.shape)
+        got = np.asarray(sharded_fvp(params, sbatch, v))
+        want = np.asarray(single_fvp(jnp.asarray(v, jnp.float32)))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)

@@ -32,6 +32,15 @@ def test_config_overrides():
     assert cfg.env == "pendulum"
 
 
+def test_config_fvp_mode_override():
+    cfg = config_from_args(build_parser().parse_args([]))
+    assert cfg.fvp_mode == "ggn"  # the fast factorization is the default
+    cfg = config_from_args(
+        build_parser().parse_args(["--fvp-mode", "jvp_grad"])
+    )
+    assert cfg.fvp_mode == "jvp_grad"
+
+
 def test_config_network_overrides():
     args = build_parser().parse_args(
         ["--policy-hidden", "32,16", "--policy-gru", "8",

@@ -24,6 +24,7 @@ from trpo_tpu.parallel.sharded import (  # noqa: F401
     shard_leading_axis,
     make_sharded_update,
     make_sharded_fvp,
+    make_sharded_ggn_fvp,
 )
 from trpo_tpu.parallel.seq import (  # noqa: F401
     sharded_reverse_affine_scan,

@@ -40,6 +40,7 @@ __all__ = [
     "shard_leading_axis",
     "make_sharded_update",
     "make_sharded_fvp",
+    "make_sharded_ggn_fvp",
 ]
 
 
@@ -138,50 +139,30 @@ def make_sharded_update(
     return sharded
 
 
-def make_sharded_fvp(
-    policy: Policy,
-    cfg: TRPOConfig,
-    mesh: Mesh,
-    axis: str = "data",
-):
-    """Explicit ``shard_map`` Fisher-vector product over a sharded batch.
+def _make_shard_map_fvp(cfg: TRPOConfig, mesh: Mesh, axis: str, local_body):
+    """Shared scaffold for the explicit-``shard_map`` FVP spellings.
 
-    Returns ``fvp_fn(params, batch, v) -> (F + λI)·v`` where ``batch`` is
-    sharded over ``axis`` and ``v``/``params`` are replicated. Math matches
-    ``trpo_tpu.ops.fvp.make_fvp`` over the full batch: per-shard weighted
-    KL-Hessian-vector products are combined as ``psum(local_sum)/psum(w)``
-    — the hand-written form of the collective GSPMD derives.
+    ``local_body(flat_loc, unravel, local_batch, v_loc)`` returns the
+    shard's weighted-SUM Hessian-vector product (f32 flat vector); this
+    wrapper supplies everything both factorizations share — the single
+    stable jit (executable caches on shapes, so one call per CG iteration
+    hits the compile cache), ravel/unravel, the device-varying ``pcast``
+    casts (without which AD through a replicated primal auto-inserts its
+    own psum on the broadcast transpose and the explicit psum below
+    double-counts), the ``psum(num)/psum(weight)`` pair that makes the
+    global weighted mean exact under uneven/padded shards, and damping.
     """
     from jax.flatten_util import ravel_pytree
 
-    # One stable callable under ONE jit: the executable caches on shapes,
-    # so repeated calls (e.g. one per CG iteration) hit the compile cache
-    # instead of re-tracing the shard_map every invocation.
     @jax.jit
     def fvp_fn(params, batch: TRPOBatch, v: jax.Array) -> jax.Array:
         flat0, unravel = ravel_pytree(params)
         flat0 = jnp.asarray(flat0, jnp.float32)
 
         def local_fvp(flat0_rep, local_batch: TRPOBatch, v_rep):
-            # Cast params/tangent to device-varying so reverse-mode AD
-            # stays LOCAL to the shard. Without this, grad of a replicated
-            # primal auto-inserts its own psum (the broadcast rule's
-            # transpose) and the explicit psum below double-counts.
             flat_loc = jax.lax.pcast(flat0_rep, axis, to="varying")
             v_loc = jax.lax.pcast(v_rep, axis, to="varying")
-            cur = jax.lax.stop_gradient(
-                policy.apply(unravel(flat_loc), local_batch.obs)
-            )
-
-            def kl_sum(flat):
-                dist = policy.apply(unravel(flat), local_batch.obs)
-                return jnp.sum(
-                    policy.dist.kl(cur, dist) * local_batch.weight
-                )
-
-            hv = jax.jvp(jax.grad(kl_sum), (flat_loc,), (v_loc,))[1]
-            # Weighted-SUM KL per shard; one explicit psum pair makes the
-            # global mean exact under uneven/padded shards.
+            hv = local_body(flat_loc, unravel, local_batch, v_loc)
             num = jax.lax.psum(hv, axis)
             den = jax.lax.psum(jnp.sum(local_batch.weight), axis)
             return num / jnp.maximum(den, 1.0) + cfg.cg_damping * v_rep
@@ -196,3 +177,63 @@ def make_sharded_fvp(
         return shard_fvp(flat0, batch, jnp.asarray(v, jnp.float32))
 
     return fvp_fn
+
+
+def make_sharded_fvp(
+    policy: Policy,
+    cfg: TRPOConfig,
+    mesh: Mesh,
+    axis: str = "data",
+):
+    """Explicit ``shard_map`` Fisher-vector product over a sharded batch.
+
+    Returns ``fvp_fn(params, batch, v) -> (F + λI)·v`` where ``batch`` is
+    sharded over ``axis`` and ``v``/``params`` are replicated. Math matches
+    ``trpo_tpu.ops.fvp.make_fvp`` over the full batch: per-shard weighted
+    KL-Hessian-vector products are combined as ``psum(local_sum)/psum(w)``
+    — the hand-written form of the collective GSPMD derives.
+    """
+
+    def local_body(flat_loc, unravel, local_batch: TRPOBatch, v_loc):
+        cur = jax.lax.stop_gradient(
+            policy.apply(unravel(flat_loc), local_batch.obs)
+        )
+
+        def kl_sum(flat):
+            dist = policy.apply(unravel(flat), local_batch.obs)
+            return jnp.sum(policy.dist.kl(cur, dist) * local_batch.weight)
+
+        return jax.jvp(jax.grad(kl_sum), (flat_loc,), (v_loc,))[1]
+
+    return _make_shard_map_fvp(cfg, mesh, axis, local_body)
+
+
+def make_sharded_ggn_fvp(
+    policy: Policy,
+    cfg: TRPOConfig,
+    mesh: Mesh,
+    axis: str = "data",
+):
+    """:func:`make_sharded_fvp` with the Gauss-Newton factorization — the
+    explicit ``shard_map`` spelling of the framework's DEFAULT FVP
+    (``ops.fvp.make_ggn_fvp``, ``cfg.fvp_mode="ggn"``): each shard runs
+    the forward tangent + dist-space KL Hessian + vjp on its local batch
+    slice in weighted-SUM form."""
+    fisher_weight = policy.dist.fisher_weight
+
+    def local_body(flat_loc, unravel, local_batch: TRPOBatch, v_loc):
+        def apply_fn(flat):
+            return policy.apply(unravel(flat), local_batch.obs)
+
+        d0, f_jvp = jax.linearize(apply_fn, flat_loc)
+        f_vjp = jax.linear_transpose(f_jvp, flat_loc)
+        d = f_jvp(v_loc)
+        m = fisher_weight(jax.lax.stop_gradient(d0), d)
+        m = jax.tree_util.tree_map(
+            lambda t: jnp.asarray(t, jnp.float32)
+            * jnp.expand_dims(local_batch.weight, -1),
+            m,
+        )
+        return jnp.asarray(f_vjp(m)[0], jnp.float32)
+
+    return _make_shard_map_fvp(cfg, mesh, axis, local_body)
