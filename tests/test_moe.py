@@ -188,3 +188,28 @@ def test_expert_sharded_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(
         float(st1["entropy"]), float(st2["entropy"]), rtol=1e-5
     )
+
+
+def test_moe_fvp_mode_parity():
+    """GGN and jvp_grad agree through the soft-MoE torso too — the
+    expert-stacked parameter leaves ride the same linearize/transpose."""
+    import numpy as np
+
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+
+    kwargs = dict(
+        env="cartpole", n_envs=4, batch_timesteps=64, policy_experts=3,
+        policy_hidden=(8,), vf_train_steps=3, cg_iters=3, seed=2,
+    )
+    a_ggn = TRPOAgent("cartpole", TRPOConfig(fvp_mode="ggn", **kwargs))
+    a_jg = TRPOAgent("cartpole", TRPOConfig(fvp_mode="jvp_grad", **kwargs))
+    s1, _ = a_ggn.run_iteration(a_ggn.init_state(seed=4))
+    s2, _ = a_jg.run_iteration(a_jg.init_state(seed=4))
+    import jax
+
+    f1 = jax.flatten_util.ravel_pytree(s1.policy_params)[0]
+    f2 = jax.flatten_util.ravel_pytree(s2.policy_params)[0]
+    np.testing.assert_allclose(
+        np.asarray(f1), np.asarray(f2), rtol=1e-4, atol=1e-5
+    )
