@@ -162,3 +162,33 @@ def test_ggn_fvp_weighted_padding_exact():
     np.testing.assert_allclose(
         np.asarray(full(v)), np.asarray(half(v)), rtol=1e-5, atol=1e-6
     )
+
+
+def test_ggn_fvp_matches_jvp_grad_conv_policy():
+    """The GGN factorization must agree with jvp∘grad through the conv
+    (Nature-torso) policy too — the pong-sim/Atari FVP path."""
+    policy = make_policy((12, 12, 2), DiscreteSpec(3), hidden=(16,))
+    params = policy.init(jax.random.key(0))
+    obs = jax.random.randint(
+        jax.random.key(1), (24, 12, 12, 2), 0, 255, jnp.uint8
+    )
+    weight = jnp.ones((24,))
+    flat0, unravel = flatten_params(params)
+    flat0 = jnp.asarray(flat0, jnp.float32)
+
+    def apply_fn(flat):
+        return policy.apply(unravel(flat), obs)
+
+    cur = jax.lax.stop_gradient(apply_fn(flat0))
+
+    def kl_fn(flat):
+        return jnp.mean(policy.dist.kl(cur, apply_fn(flat)))
+
+    v = jax.random.normal(jax.random.key(2), flat0.shape)
+    a = make_fvp(kl_fn, flat0, damping=0.1)(v)
+    b = make_ggn_fvp(
+        apply_fn, policy.dist.fisher_weight, flat0, weight, damping=0.1
+    )(v)
+    np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4
+    )
