@@ -301,3 +301,38 @@ def test_fvp_mode_validated():
 
     with pytest.raises(ValueError, match="fvp_mode"):
         TRPOConfig(fvp_mode="magic")
+
+
+def test_custom_dist_without_fisher_weight_falls_back():
+    """A user-supplied distribution lacking fisher_weight must silently
+    take the jvp_grad path even under the default fvp_mode='ggn'."""
+    policy = make_policy((4,), DiscreteSpec(3), hidden=(16,))
+
+    class StrippedDist:
+        logp = staticmethod(policy.dist.logp)
+        kl = staticmethod(policy.dist.kl)
+        entropy = staticmethod(policy.dist.entropy)
+        sample = staticmethod(policy.dist.sample)
+        mode = staticmethod(policy.dist.mode)
+        # no fisher_weight
+
+    stripped = policy._replace(dist=StrippedDist) if hasattr(
+        policy, "_replace"
+    ) else None
+    if stripped is None:
+        import dataclasses
+
+        stripped = dataclasses.replace(policy, dist=StrippedDist)
+    params = stripped.init(jax.random.key(0))
+    batch = make_batch(stripped, params, jax.random.key(1))
+    update = jax.jit(make_trpo_update(stripped, TRPOConfig(fvp_mode="ggn")))
+    p2, stats = update(params, batch)
+    assert float(stats.surrogate_after) < float(stats.surrogate_before)
+    # and the result matches the full dist's jvp_grad update exactly
+    upd_ref = jax.jit(
+        make_trpo_update(policy, TRPOConfig(fvp_mode="jvp_grad"))
+    )
+    p_ref, _ = upd_ref(params, batch)
+    f1 = jax.flatten_util.ravel_pytree(p2)[0]
+    f2 = jax.flatten_util.ravel_pytree(p_ref)[0]
+    np.testing.assert_allclose(np.asarray(f1), np.asarray(f2), rtol=1e-6)
