@@ -246,6 +246,43 @@ def test_damping_flip_abstract_template_seeds_positive(tmp_path):
     )
 
 
+def test_damping_flip_abstract_template_uses_configured_seed(tmp_path):
+    """ADVICE r3: a run with non-default cg_damping that restores through
+    an abstract template must seed the run's OWN damping (threaded via
+    Checkpointer(cg_damping_seed=...), as train.py does), not the class
+    default."""
+    import jax
+
+    kwargs = dict(
+        n_envs=4, batch_timesteps=64, cg_iters=4, vf_train_steps=5,
+        policy_hidden=(16,), vf_hidden=(16,), seed=7,
+    )
+    fixed = TRPOAgent("cartpole", TRPOConfig(cg_damping=0.25, **kwargs))
+    adaptive = TRPOAgent(
+        "cartpole",
+        TRPOConfig(adaptive_damping=True, cg_damping=0.25, **kwargs),
+    )
+    state_f = fixed.init_state()
+    state_f, _ = fixed.run_iteration(state_f)
+    ckpt = Checkpointer(str(tmp_path / "cfgseed"), cg_damping_seed=0.25)
+    try:
+        ckpt.save(int(state_f.iteration), state_f)
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, "shape")
+            else x,
+            adaptive.init_state(),
+        )
+        restored = ckpt.restore(abstract)
+    finally:
+        ckpt.close()
+    damping = float(np.asarray(restored.cg_damping))
+    assert damping == pytest.approx(0.25), (
+        f"abstract-template damping seed must be the run's configured "
+        f"cg_damping, got {damping}"
+    )
+
+
 @pytest.mark.parametrize("direction", ["data_to_tp", "tp_to_data"])
 def test_restore_across_mesh_topologies(tmp_path, direction):
     """A TrainState saved under one mesh topology must restore into a

@@ -245,12 +245,13 @@ def test_sidecar_codec_nested_and_bigints(tmp_path):
 
 
 def test_sidecar_prunes_stale_tmp_and_reads_legacy_pkl(tmp_path):
-    ck = Checkpointer(str(tmp_path / "ck"))
+    ck = Checkpointer(str(tmp_path / "ck"), allow_legacy_pickle=True)
     d = tmp_path / "ck"
     # a crash mid-save leaves a tmp: the next save must clean it up
     (d / "host_env_3.npz.tmp").write_bytes(b"partial")
     (d / "host_env_3.pkl.tmp").write_bytes(b"partial")
     # a legacy pickle sidecar from an older run must still restore
+    # (behind the explicit opt-in — pickle.load can execute code)
     import pickle
 
     with open(d / "host_env_2.pkl", "wb") as f:
@@ -266,6 +267,57 @@ def test_sidecar_prunes_stale_tmp_and_reads_legacy_pkl(tmp_path):
         assert not (d / "host_env_2.pkl").exists()
     finally:
         ck.close()
+
+
+def test_legacy_pkl_refused_without_opt_in(tmp_path, capsys, monkeypatch):
+    """ADVICE r3: pickle.load on a planted .pkl sidecar is an arbitrary-
+    code-execution surface — the default must refuse it (episodes restart)
+    and say so; the env-var opt-in re-enables it."""
+    import pickle
+
+    monkeypatch.delenv("TRPO_TPU_ALLOW_PICKLE_SIDECAR", raising=False)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    d = tmp_path / "ck"
+    with open(d / "host_env_4.pkl", "wb") as f:
+        pickle.dump({"obs": np.zeros(2)}, f)
+    try:
+        assert ck.restore_host_env(4) is None
+        err = capsys.readouterr().err
+        assert "legacy .pkl" in err and "Refusing" in err
+    finally:
+        ck.close()
+
+    # env-var opt-in (the constructor-flag path is covered above)
+    monkeypatch.setenv("TRPO_TPU_ALLOW_PICKLE_SIDECAR", "1")
+    ck2 = Checkpointer(str(tmp_path / "ck"))
+    try:
+        back = ck2.restore_host_env(4)
+        np.testing.assert_array_equal(back["obs"], np.zeros(2))
+    finally:
+        ck2.close()
+
+
+def test_sidecar_codec_preserves_tuples(tmp_path):
+    """ADVICE r3: an adapter whose env_state_restore distinguishes tuple
+    from list must see its tuples come back as tuples, not lists."""
+    ck = Checkpointer(str(tmp_path / "ck"))
+    snap = {
+        "pair": (1, 2),
+        "mixed": [(np.arange(3.0), "x"), [4, 5]],
+        "nested": {"t": ("a", ("b", None))},
+    }
+    try:
+        ck.save_host_env(1, snap)
+        back = ck.restore_host_env(1)
+    finally:
+        ck.close()
+    assert back["pair"] == (1, 2) and isinstance(back["pair"], tuple)
+    assert isinstance(back["mixed"], list)
+    assert isinstance(back["mixed"][0], tuple)
+    np.testing.assert_array_equal(back["mixed"][0][0], np.arange(3.0))
+    assert back["mixed"][1] == [4, 5] and isinstance(back["mixed"][1], list)
+    assert back["nested"]["t"] == ("a", ("b", None))
+    assert isinstance(back["nested"]["t"][1], tuple)
 
 
 def test_sidecar_corrupt_falls_back_to_none(tmp_path, capsys):

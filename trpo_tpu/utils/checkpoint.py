@@ -26,11 +26,37 @@ __all__ = ["Checkpointer"]
 
 
 class Checkpointer:
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        cg_damping_seed: Optional[float] = None,
+        allow_legacy_pickle: Optional[bool] = None,
+    ):
+        """``cg_damping_seed``: the run's configured ``cfg.cg_damping`` —
+        used only when a fixed→adaptive damping flip is restored through an
+        *abstract* template (the normal ``agent.init_state()`` path carries
+        the value itself); defaults to the ``TRPOConfig`` class default.
+
+        ``allow_legacy_pickle``: opt in to reading pre-round-3 ``.pkl``
+        host-env sidecars, which go through ``pickle.load`` and can execute
+        code from a hostile checkpoint directory. Default (None) reads the
+        ``TRPO_TPU_ALLOW_PICKLE_SIDECAR`` env var; unset means refuse with
+        a warning (episodes restart, nothing else is lost).
+        """
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
+        self.cg_damping_seed = cg_damping_seed
+        if allow_legacy_pickle is None:
+            # strict allowlist: only the documented "1" enables the
+            # pickle.load path — "false"/"no"/"off" must NOT enable an
+            # arbitrary-code-execution surface by accident
+            allow_legacy_pickle = (
+                os.environ.get("TRPO_TPU_ALLOW_PICKLE_SIDECAR") == "1"
+            )
+        self.allow_legacy_pickle = allow_legacy_pickle
         os.makedirs(self.directory, exist_ok=True)
         self.manager = ocp.CheckpointManager(
             self.directory,
@@ -98,19 +124,24 @@ class Checkpointer:
             seed = template.cg_damping
             if seed is not None and not hasattr(seed, "__array__"):
                 # abstract template leaf (ShapeDtypeStruct): materialize the
-                # TRPOConfig default damping, NOT zero — the first
-                # post-resume CG solve must not run undamped (damping exists
-                # for Fisher conditioning); the adaptive feedback re-adapts
-                # from there within an iteration. A concrete template (the
-                # normal agent.init_state() path) seeds cfg.cg_damping
-                # instead and never reaches this branch.
+                # run's configured damping (``cg_damping_seed``, threaded
+                # from TRPOConfig at construction; class default when the
+                # caller didn't) — NOT zero: the first post-resume CG solve
+                # must not run undamped (damping exists for Fisher
+                # conditioning); the adaptive feedback re-adapts from there
+                # within an iteration. A concrete template (the normal
+                # agent.init_state() path) seeds cfg.cg_damping itself and
+                # never reaches this branch.
                 import jax.numpy as jnp
 
                 from trpo_tpu.config import TRPOConfig
 
-                seed = jnp.full(
-                    seed.shape, TRPOConfig.cg_damping, seed.dtype
+                value = (
+                    self.cg_damping_seed
+                    if self.cg_damping_seed is not None
+                    else TRPOConfig.cg_damping
                 )
+                seed = jnp.full(seed.shape, value, seed.dtype)
             restored = restored._replace(cg_damping=seed)
         return restored
 
@@ -121,13 +152,15 @@ class Checkpointer:
     # in the device-resident TrainState pytree (which must keep a stable
     # jit template). It rides NEXT TO the Orbax step as a pickle-free
     # ``.npz`` sidecar (nested dict/list structure as JSON, arrays as npz
-    # entries, loaded with ``allow_pickle=False`` so an untrusted
-    # checkpoint dir can never execute code on restore): exact resume for
-    # native: envs, best-effort (MuJoCo qpos/qvel/time, classic-control
-    # state) for gym: envs, documented episode-restart for opaque
-    # backends. Legacy ``.pkl`` sidecars from older checkpoints are still
-    # read — those are trusted-by-assumption (they came from this user's
-    # own earlier run).
+    # entries, loaded with ``allow_pickle=False`` so the npz path never
+    # executes code on restore): exact resume for native: envs,
+    # best-effort (MuJoCo qpos/qvel/time, classic-control state) for
+    # gym: envs, documented episode-restart for opaque backends. Legacy
+    # ``.pkl`` sidecars from pre-round-3 checkpoints go through
+    # ``pickle.load`` — an arbitrary-code-execution surface — so they are
+    # only read behind the explicit ``allow_legacy_pickle`` opt-in
+    # (constructor flag or TRPO_TPU_ALLOW_PICKLE_SIDECAR=1); otherwise a
+    # warning is printed and episodes restart.
 
     def _aux_path(self, step: int) -> str:
         return os.path.join(self.directory, f"host_env_{step}.npz")
@@ -187,8 +220,27 @@ class Checkpointer:
                     )
             legacy = self._aux_path_legacy(step)
             if os.path.exists(legacy):
+                import sys
+
+                if not self.allow_legacy_pickle:
+                    print(
+                        f"checkpoint: step {step} has a legacy .pkl "
+                        "host-env sidecar, which requires pickle.load "
+                        "(can execute code from an untrusted checkpoint "
+                        "dir). Refusing without opt-in — pass "
+                        "allow_legacy_pickle=True or set "
+                        "TRPO_TPU_ALLOW_PICKLE_SIDECAR=1 if this "
+                        "checkpoint is your own; episodes will restart.",
+                        file=sys.stderr,
+                    )
+                    return None
                 import pickle
 
+                print(
+                    f"checkpoint: reading legacy pickle sidecar for step "
+                    f"{step} (explicitly allowed)",
+                    file=sys.stderr,
+                )
                 with open(legacy, "rb") as f:
                     return pickle.load(f)
             return None
@@ -212,8 +264,9 @@ class Checkpointer:
 
 # -- pickle-free snapshot codec -------------------------------------------
 #
-# Host-env snapshots are nested dict/list/None/scalar/ndarray structures
-# (see envs/*.env_state_snapshot). Arrays go into the npz as entries
+# Host-env snapshots are nested dict/list/tuple/None/scalar/ndarray
+# structures (see envs/*.env_state_snapshot); tuples round-trip as tuples
+# via a distinct __tuple__ tag. Arrays go into the npz as entries
 # "a0", "a1", ...; the containing structure serializes as JSON with
 # {"__npz__": key} placeholders. JSON carries arbitrary-precision ints
 # natively, which matters for np_random bit-generator state (PCG64 state
@@ -250,7 +303,12 @@ def _flatten_snapshot(obj):
             return {"__npz__": key}
         if isinstance(x, dict):
             return {"__dict__": {str(k): flatten(v) for k, v in x.items()}}
-        if isinstance(x, (list, tuple)):
+        if isinstance(x, tuple):
+            # distinct tag: an adapter whose env_state_restore distinguishes
+            # tuple from list must round-trip exactly (pre-round-4 sidecars
+            # collapsed both to __list__; reading those yields lists)
+            return {"__tuple__": [flatten(v) for v in x]}
+        if isinstance(x, list):
             return {"__list__": [flatten(v) for v in x]}
         raise TypeError(
             f"host-env snapshot holds a {type(x).__name__}; snapshots must "
@@ -273,6 +331,8 @@ def _unflatten_snapshot(structure_json: str, npz):
                 return {k: unflatten(v) for k, v in x["__dict__"].items()}
             if "__list__" in x:
                 return [unflatten(v) for v in x["__list__"]]
+            if "__tuple__" in x:
+                return tuple(unflatten(v) for v in x["__tuple__"])
         return x
 
     return unflatten(json.loads(structure_json))
