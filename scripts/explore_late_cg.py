@@ -1,0 +1,237 @@
+"""Late-training CG conditioning probe (VERDICT r3 items 2+8).
+
+The flagship evidence runs show the CG residual growing ~2000× over
+training at fixed iterations/damping (``humanoid_r03.jsonl``: 5e-3 → 11.8;
+``halfcheetah_r03.jsonl``: 6e-7 → 1.5) — the Fisher's conditioning worsens
+as the policy sharpens (Gaussian log_std shrinks → mean-head curvature
+grows ∝ 1/σ²) and the solver silently delivers a coarser direction. This
+script replays ONE update from a saved late checkpoint under
+{plain, Jacobi-preconditioned} × {damping, iteration budget} and reports
+residual / KL / surrogate, so solver changes can be judged against the
+REAL late-training Fisher without re-running hours of training.
+
+Usage (after a checkpointed run, e.g. scripts/ab_halfcheetah_r04.sh)::
+
+    python scripts/explore_late_cg.py \
+        --checkpoint-dir ab_r04/ckpts/hc_lam097_const \
+        --out scripts/late_cg_r04.json
+
+Writes one JSON object with a row per solver config; the BENCH_LADDER
+"late-training solver" section quotes it.
+
+Equal-cost comparison: a preconditioned solve costs ``probes`` extra FVPs,
+so its budget-matched plain opponent runs ``cg_iters + probes`` iterations
+(every row lists total FVP evaluations).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--step", type=int, default=None, help="default: latest")
+    p.add_argument("--preset", default="halfcheetah")
+    p.add_argument("--n-envs", type=int, default=25)
+    p.add_argument("--batch-timesteps", type=int, default=5000)
+    p.add_argument("--probes", type=int, default=8)
+    p.add_argument(
+        "--dampings", default="0.1,0.01",
+        help="comma-separated damping values to probe",
+    )
+    p.add_argument("--platform", choices=("tpu", "cpu"), default=None)
+    p.add_argument("--out", default=None, help="write the JSON here too")
+    args = p.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import get_preset
+    from trpo_tpu.ops import conjugate_gradient, flatten_params, make_ggn_fvp
+    from trpo_tpu.ops.linesearch import backtracking_linesearch
+    from trpo_tpu.ops.precond import hutchinson_diag_inv
+    from trpo_tpu.rollout import host_rollout
+    from trpo_tpu.trpo import (
+        TRPOBatch,
+        standardize_advantages,
+        surrogate_loss,
+    )
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    cfg = dataclasses.replace(
+        get_preset(args.preset),
+        n_envs=args.n_envs,
+        batch_timesteps=args.batch_timesteps,
+        normalize_obs=True,
+        host_inference="cpu",
+    )
+    agent = TRPOAgent(cfg.env, cfg)
+    ck = Checkpointer(args.checkpoint_dir, cg_damping_seed=cfg.cg_damping)
+    step = args.step if args.step is not None else ck.latest_step()
+    if step is None:
+        print(f"no checkpoints in {args.checkpoint_dir}", file=sys.stderr)
+        return 1
+    state = ck.restore(agent.init_state(), step=step)
+    agent.restore_host_env(ck.restore_host_env(step))
+    print(f"restored step {step} (iteration {int(state.iteration)})",
+          file=sys.stderr)
+
+    # -- one rollout with the restored (sharpened) policy -----------------
+    # (the feedforward host path of agent.run_iteration, without the update)
+    rng = jax.random.fold_in(state.rng, int(state.iteration))
+    if agent._obs_norm_host:
+        agent.env.set_obs_stats_state(
+            tuple(np.asarray(x) for x in state.obs_norm)
+        )
+    act_fn = getattr(agent, "_host_act_fn", None) or agent._make_host_act()
+    params_roll = state.policy_params
+    if agent._host_inference_cpu:
+        cpu = agent._host_cpu_device
+        params_roll = jax.device_put(params_roll, cpu)
+        rng = jax.device_put(rng, cpu)
+    traj = host_rollout(
+        agent.env, agent.policy, params_roll, rng, agent.n_steps,
+        act_fn=act_fn,
+    )
+    T, N = traj.rewards.shape
+    flat = lambda x: x.reshape((T * N,) + x.shape[2:])
+    adv, _vtarg, _values = agent._advantages(state.vf_state, traj)
+    weight = jnp.ones(T * N, jnp.float32)
+    batch = TRPOBatch(
+        obs=flat(traj.obs),
+        actions=flat(traj.actions),
+        advantages=standardize_advantages(flat(adv), weight),
+        old_dist=jax.tree_util.tree_map(flat, traj.old_dist),
+        weight=weight,
+    )
+    log_std = np.asarray(state.policy_params["log_std"])
+    print(
+        f"policy sharpness: mean log_std {log_std.mean():.3f} "
+        f"(σ ≈ {np.exp(log_std.mean()):.3f}; init was 0.0 → σ=1)",
+        file=sys.stderr,
+    )
+
+    # -- solver configs over the SAME gradient/Fisher ---------------------
+    policy = agent.policy
+    params = state.policy_params
+    flat0, unravel = flatten_params(params)
+    flat0 = jnp.asarray(flat0, jnp.float32)
+    dampings = [float(s) for s in args.dampings.split(",") if s.strip()]
+
+    def make_case(damping, iters, probes):
+        @jax.jit
+        def run(flat0, batch):
+            surr = lambda x: surrogate_loss(policy, unravel(x), batch)
+            g = jax.grad(surr)(flat0)
+            neg_g = -g
+            fvp = make_ggn_fvp(
+                lambda x: policy.apply(unravel(x), batch.obs),
+                policy.dist.fisher_weight,
+                flat0,
+                batch.weight,
+                damping=damping,
+            )
+            M_inv = None
+            if probes:
+                M_inv = hutchinson_diag_inv(
+                    fvp, neg_g, probes, jax.random.key(0), floor=damping
+                )
+            cg = conjugate_gradient(
+                fvp, neg_g, cg_iters=iters, residual_tol=0.0, M_inv=M_inv
+            )
+            shs = 0.5 * jnp.vdot(cg.x, fvp(cg.x))
+            lm = jnp.sqrt(jnp.maximum(shs, 1e-12) / cfg.max_kl)
+            fullstep = cg.x / lm
+            expected = jnp.vdot(neg_g, cg.x) / lm
+            ls = backtracking_linesearch(
+                surr, flat0, fullstep, expected,
+                max_backtracks=cfg.linesearch_backtracks,
+                accept_ratio=cfg.linesearch_accept_ratio,
+            )
+            dist_new = policy.apply(unravel(ls.x), batch.obs)
+            kl = jnp.sum(
+                policy.dist.kl(batch.old_dist, dist_new) * batch.weight
+            ) / jnp.sum(batch.weight)
+            return {
+                "residual_sq": cg.residual_norm_sq,
+                "rel_residual": jnp.sqrt(
+                    cg.residual_norm_sq / jnp.vdot(neg_g, neg_g)
+                ),
+                "grad_norm": jnp.linalg.norm(g),
+                "surr_before": surr(flat0),
+                "surr_after": surr(ls.x),
+                "kl": kl,
+                "ls_fraction": ls.step_fraction,
+                "ls_success": ls.success,
+            }
+
+        return run
+
+    rows = []
+    for damping in dampings:
+        for label, iters, probes in (
+            ("plain_10", cfg.cg_iters, 0),
+            (f"plain_{cfg.cg_iters + args.probes}_budget_matched",
+             cfg.cg_iters + args.probes, 0),
+            (f"jacobi_p{args.probes}_10", cfg.cg_iters, args.probes),
+        ):
+            run = make_case(damping, iters, probes)
+            out = run(flat0, batch)           # compile + warm
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            out = run(flat0, batch)
+            jax.block_until_ready(out)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            row = {
+                "config": label,
+                "damping": damping,
+                "cg_iters": iters,
+                "precond_probes": probes,
+                "total_fvp_evals": iters + probes + 1,  # +1: the shs FVP
+                "wall_ms": round(wall_ms, 2),
+                **{
+                    k: (bool(v) if k == "ls_success" else float(v))
+                    for k, v in out.items()
+                },
+            }
+            rows.append(row)
+            print(
+                f"damping {damping:<6} {label:<28} "
+                f"rel_residual {row['rel_residual']:.3e} "
+                f"kl {row['kl']:.4f} "
+                f"surr {row['surr_before']:.4f}→{row['surr_after']:.4f} "
+                f"frac {row['ls_fraction']:.3f}",
+                file=sys.stderr,
+            )
+
+    result = {
+        "checkpoint_dir": args.checkpoint_dir,
+        "step": int(step),
+        "iteration": int(state.iteration),
+        "preset": args.preset,
+        "batch": T * N,
+        "mean_log_std": float(log_std.mean()),
+        "backend": jax.devices()[0].platform,
+        "rows": rows,
+    }
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,266 @@
+"""Preconditioned / residual-aware CG (VERDICT r3 item 2).
+
+The reference's solver (``utils.py:185-201``) is plain CG at a fixed
+iteration count; the flagship Humanoid evidence showed its residual growing
+2000× late in training. These tests pin the beyond-reference levers:
+
+* ``M_inv=None`` leaves the solver BIT-identical to the r3 recurrence;
+* preconditioned and plain CG agree on well-conditioned systems;
+* a Jacobi preconditioner collapses the iteration count on systems whose
+  ill-conditioning is diagonal-scale (the late-training Fisher shape);
+* Hutchinson probes recover the diagonal (exactly, for diagonal A);
+* ``residual_rtol`` turns ``cg_iters`` into a cap;
+* the full TRPO update with ``cg_precondition=True`` matches the plain
+  update where both converge, and preconditioning is available through the
+  GSPMD sharded update.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trpo_tpu.ops import conjugate_gradient, hutchinson_diag
+from trpo_tpu.ops.precond import hutchinson_diag_inv
+
+
+def spd_matrix(rng, n, cond=10.0):
+    q, _ = np.linalg.qr(rng.normal(size=(n, n)))
+    eigs = np.linspace(1.0, cond, n)
+    return (q * eigs) @ q.T
+
+
+def test_no_preconditioner_bit_identical_to_plain():
+    """M_inv=None must not change a single bit of the r3 solver's output
+    (every pinned bench/parity artifact depends on it)."""
+    rng = np.random.default_rng(3)
+    a = jnp.asarray(spd_matrix(rng, 32, cond=1e3), jnp.float32)
+    b = jnp.asarray(rng.normal(size=32), jnp.float32)
+    f = lambda v: a @ v
+    plain = conjugate_gradient(f, b, cg_iters=10)
+    with_none = conjugate_gradient(f, b, cg_iters=10, M_inv=None)
+    np.testing.assert_array_equal(np.asarray(plain.x), np.asarray(with_none.x))
+    np.testing.assert_array_equal(
+        np.asarray(plain.residual_norm_sq),
+        np.asarray(with_none.residual_norm_sq),
+    )
+    assert int(plain.iterations) == int(with_none.iterations)
+
+
+def test_identity_preconditioner_matches_plain():
+    rng = np.random.default_rng(4)
+    a = jnp.asarray(spd_matrix(rng, 24, cond=100.0), jnp.float32)
+    b = jnp.asarray(rng.normal(size=24), jnp.float32)
+    f = lambda v: a @ v
+    plain = conjugate_gradient(f, b, cg_iters=8)
+    ident = conjugate_gradient(f, b, cg_iters=8, M_inv=jnp.ones(24))
+    np.testing.assert_allclose(
+        np.asarray(plain.x), np.asarray(ident.x), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_preconditioned_equals_plain_on_well_conditioned():
+    """VERDICT: 'pin preconditioned==plain solutions on well-conditioned
+    systems' — both run to convergence and meet np.linalg.solve."""
+    rng = np.random.default_rng(0)
+    a = spd_matrix(rng, 12, cond=5.0)
+    b = rng.normal(size=12)
+    f = lambda v: jnp.asarray(a, jnp.float32) @ v
+    want = np.linalg.solve(a, b)
+    m_inv = jnp.asarray(1.0 / np.diag(a), jnp.float32)
+    plain = conjugate_gradient(
+        f, jnp.asarray(b, jnp.float32), cg_iters=12, residual_tol=1e-12
+    )
+    pre = conjugate_gradient(
+        f,
+        jnp.asarray(b, jnp.float32),
+        cg_iters=12,
+        residual_tol=1e-12,
+        M_inv=m_inv,
+    )
+    np.testing.assert_allclose(np.asarray(plain.x), want, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(pre.x), want, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(pre.x), np.asarray(plain.x), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_jacobi_collapses_diagonal_ill_conditioning():
+    """The late-training Fisher failure shape: per-coordinate scales spread
+    over 6 orders of magnitude (1/σ² growth on the mean head). Exact-diag
+    Jacobi solves it in ~1 effective iteration; plain CG at the same budget
+    is orders of magnitude worse."""
+    rng = np.random.default_rng(7)
+    scales = jnp.asarray(
+        10.0 ** rng.uniform(-3, 3, size=64), jnp.float32
+    )
+    f = lambda v: scales * v
+    b = jnp.asarray(rng.normal(size=64), jnp.float32)
+    plain = conjugate_gradient(f, b, cg_iters=10)
+    pre = conjugate_gradient(f, b, cg_iters=10, M_inv=1.0 / scales)
+    r_plain = float(plain.residual_norm_sq)
+    r_pre = float(pre.residual_norm_sq)
+    assert int(pre.iterations) <= 2
+    assert r_pre < 1e-6 * max(r_plain, 1e-30), (r_pre, r_plain)
+    want = np.asarray(b) / np.asarray(scales)
+    np.testing.assert_allclose(np.asarray(pre.x), want, rtol=1e-4, atol=1e-6)
+
+
+def test_hutchinson_exact_for_diagonal_operator():
+    """v ⊙ Av = v² ⊙ diag = diag for ±1 probes: ONE probe is exact when A
+    is diagonal — the estimator adds zero noise exactly where the
+    preconditioner matters most."""
+    d = jnp.asarray([4.0, 0.5, 9.0, 1e3, 1e-2], jnp.float32)
+    est = hutchinson_diag(
+        lambda v: d * v, jnp.zeros(5), n_probes=1, key=jax.random.key(1)
+    )
+    np.testing.assert_allclose(np.asarray(est), np.asarray(d), rtol=1e-6)
+
+
+def test_hutchinson_converges_on_dense_matrix():
+    rng = np.random.default_rng(5)
+    a = spd_matrix(rng, 16, cond=50.0)
+    f = lambda v: jnp.asarray(a, jnp.float32) @ v
+    est = hutchinson_diag(
+        f, jnp.zeros(16), n_probes=512, key=jax.random.key(2)
+    )
+    np.testing.assert_allclose(
+        np.asarray(est), np.diag(a), rtol=0.35, atol=0.2
+    )
+
+
+def test_hutchinson_diag_inv_floor():
+    d = jnp.asarray([5.0, 1e-12], jnp.float32)
+    m_inv = hutchinson_diag_inv(
+        lambda v: d * v,
+        jnp.zeros(2),
+        n_probes=1,
+        key=jax.random.key(0),
+        floor=0.1,
+    )
+    np.testing.assert_allclose(np.asarray(m_inv), [0.2, 10.0], rtol=1e-5)
+
+
+def test_hutchinson_pytree_domain():
+    """Domain-polymorphic like the solver: params-pytree probes keep the
+    pytree structure (the tensor-parallel form)."""
+    like = {"w": jnp.zeros((3, 2)), "b": jnp.zeros(2)}
+    scale = {"w": jnp.full((3, 2), 2.0), "b": jnp.full(2, 7.0)}
+    f = lambda v: jax.tree_util.tree_map(lambda s, x: s * x, scale, v)
+    est = hutchinson_diag(f, like, n_probes=1, key=jax.random.key(3))
+    np.testing.assert_allclose(np.asarray(est["w"]), np.full((3, 2), 2.0))
+    np.testing.assert_allclose(np.asarray(est["b"]), np.full(2, 7.0))
+
+
+def test_residual_rtol_caps_iterations():
+    """rtol makes cg_iters a cap: a modest relative target exits in far
+    fewer than the budgeted iterations, and the exit honors ‖r‖ ≤ rtol‖b‖."""
+    rng = np.random.default_rng(9)
+    a = jnp.asarray(spd_matrix(rng, 48, cond=30.0), jnp.float32)
+    b = jnp.asarray(rng.normal(size=48), jnp.float32)
+    f = lambda v: a @ v
+    res = conjugate_gradient(f, b, cg_iters=48, residual_rtol=1e-2)
+    assert int(res.iterations) < 48
+    bb = float(jnp.vdot(b, b))
+    assert float(res.residual_norm_sq) <= 1e-4 * bb * 1.01
+
+
+def test_preconditioned_cg_is_jittable():
+    scales = jnp.asarray([1.0, 10.0, 100.0, 1000.0], jnp.float32)
+
+    @jax.jit
+    def solve(b):
+        return conjugate_gradient(
+            lambda v: scales * v, b, cg_iters=4, M_inv=1.0 / scales
+        ).x
+
+    np.testing.assert_allclose(
+        np.asarray(solve(scales)), np.ones(4), rtol=1e-5
+    )
+
+
+# -- update-level wiring ----------------------------------------------------
+
+
+def _update_setup(**cfg_kwargs):
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.models import BoxSpec, make_policy
+    from trpo_tpu.trpo import TRPOBatch, make_trpo_update
+
+    cfg = TRPOConfig(cg_iters=10, cg_damping=0.1, **cfg_kwargs)
+    policy = make_policy((5,), BoxSpec(2), hidden=(16,))
+    params = policy.init(jax.random.key(0))
+    obs = jax.random.normal(jax.random.key(1), (256, 5))
+    dp = policy.apply(params, obs)
+    actions = policy.dist.sample(jax.random.key(2), dp)
+    adv = jax.random.normal(jax.random.key(3), (256,))
+    batch = TRPOBatch(
+        obs=obs,
+        actions=actions,
+        advantages=adv,
+        old_dist=jax.lax.stop_gradient(dp),
+        weight=jnp.ones(256),
+    )
+    return policy, cfg, params, batch, make_trpo_update(policy, cfg)
+
+
+def test_update_with_preconditioner_matches_plain():
+    """On a benign (early-training-like) problem both solves converge, so
+    the preconditioned update must take the same step."""
+    policy, cfg, params, batch, update = _update_setup()
+    _, _, _, _, update_pre = _update_setup(
+        cg_precondition=True, cg_precond_probes=8
+    )
+    new_plain, stats_plain = jax.jit(update)(params, batch)
+    new_pre, stats_pre = jax.jit(update_pre)(params, batch)
+    f_plain = jax.flatten_util.ravel_pytree(new_plain)[0]
+    f_pre = jax.flatten_util.ravel_pytree(new_pre)[0]
+    np.testing.assert_allclose(
+        np.asarray(f_plain), np.asarray(f_pre), rtol=5e-3, atol=2e-3
+    )
+    # the trust-region quantities agree much tighter than the raw params
+    np.testing.assert_allclose(
+        float(stats_pre.kl), float(stats_plain.kl), rtol=1e-2
+    )
+    assert float(stats_pre.kl) < 2 * cfg.max_kl
+    assert bool(stats_pre.linesearch_success)
+
+
+def test_update_preconditioner_is_deterministic():
+    """Fixed probe key: two identical calls produce identical updates."""
+    policy, cfg, params, batch, update = _update_setup(
+        cg_precondition=True, cg_precond_probes=4
+    )
+    jitted = jax.jit(update)
+    a, _ = jitted(params, batch)
+    b, _ = jitted(params, batch)
+    fa = jax.flatten_util.ravel_pytree(a)[0]
+    fb = jax.flatten_util.ravel_pytree(b)[0]
+    np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+
+def test_sharded_update_with_preconditioner():
+    """cfg.cg_precondition flows through make_sharded_update (GSPMD): the
+    8-device solve equals the single-device one."""
+    from jax.sharding import Mesh
+
+    from trpo_tpu.parallel.sharded import make_sharded_update, shard_batch
+
+    policy, cfg, params, batch, update = _update_setup(
+        cg_precondition=True, cg_precond_probes=4
+    )
+    devs = np.array(jax.devices()[:8])
+    assert devs.size == 8, "conftest must force the 8-device CPU mesh"
+    mesh = Mesh(devs, ("data",))
+    sharded = make_sharded_update(policy, cfg, mesh)
+    sb = shard_batch(mesh, batch)
+    new_s, stats_s = sharded(params, sb)
+    new_1, stats_1 = jax.jit(update)(params, batch)
+    f_s = jax.flatten_util.ravel_pytree(new_s)[0]
+    f_1 = jax.flatten_util.ravel_pytree(new_1)[0]
+    np.testing.assert_allclose(
+        np.asarray(f_s), np.asarray(f_1), rtol=2e-4, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        float(stats_s.kl), float(stats_1.kl), rtol=1e-3, atol=1e-6
+    )

@@ -50,6 +50,20 @@ class TRPOConfig:
     damping_min: float = 1e-3
     damping_max: float = 10.0
     cg_residual_tol: float = 1e-10  # ref utils.py:185
+    cg_residual_rtol: float = 0.0  # RELATIVE exit ‖r‖ ≤ rtol·‖g‖ on top of
+    #                                the absolute tol: set >0 to make
+    #                                cg_iters a cap ("until solved, at most
+    #                                N") instead of a fixed count. 0 = off
+    #                                (reference semantics)
+    cg_precondition: bool = False  # diagonal (Jacobi) preconditioned CG:
+    #                                counteracts the per-coordinate Fisher
+    #                                scale spread of a sharpened policy
+    #                                (late-training residual growth — see
+    #                                ops/precond.py). Costs cg_precond_probes
+    #                                extra FVPs per update
+    cg_precond_probes: int = 8     # Hutchinson probes for the diagonal
+    #                                estimate (±1 vectors; K probes ≈
+    #                                1/√K off-diagonal noise)
     linesearch_backtracks: int = 10  # ref utils.py:171 (0.5**k, k<10)
     linesearch_accept_ratio: float = 0.1  # ref utils.py:170
     kl_rollback_factor: float = 2.0  # revert params if KL > factor·max_kl

@@ -14,6 +14,10 @@ from trpo_tpu.ops.returns import (  # noqa: F401
     gae_from_next_values,
 )
 from trpo_tpu.ops.cg import conjugate_gradient  # noqa: F401
+from trpo_tpu.ops.precond import (  # noqa: F401
+    hutchinson_diag,
+    hutchinson_diag_inv,
+)
 from trpo_tpu.ops.linesearch import backtracking_linesearch  # noqa: F401
 from trpo_tpu.ops.fvp import (  # noqa: F401
     make_fvp,

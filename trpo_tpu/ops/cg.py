@@ -8,6 +8,19 @@ textbook CG (same iteration count, same residual early-exit semantics) is a
 ``lax.while_loop`` that jits into the surrounding TRPO step: the FVP operator
 is inlined into one XLA program and no intermediate ever touches the host.
 
+Two beyond-reference solver levers (VERDICT r3 item 2 — the flagship
+Humanoid run's late-training residual grew 2000× at fixed iterations):
+
+* ``M_inv`` — a diagonal (Jacobi) preconditioner, given as a pytree of
+  inverse-diagonal entries matching ``b``. Preconditioned CG minimizes the
+  same A-norm error over the preconditioned Krylov space; with ``M_inv``
+  from :func:`trpo_tpu.ops.precond.hutchinson_diag_inv` it counteracts the
+  per-coordinate scale spread a sharpening policy induces on the Fisher.
+  ``M_inv=None`` is bit-identical to plain CG.
+* ``residual_rtol`` — a RELATIVE stopping rule ``‖r‖² ≤ rtol²·‖b‖²`` on top
+  of the reference's absolute ``residual_tol``, so ``cg_iters`` can be set
+  as a cap ("iterate until solved, at most N") instead of a fixed count.
+
 The solve is always fp32 regardless of the forward-pass compute dtype —
 Fisher conditioning at Humanoid-scale batches does not survive bf16
 accumulation (SURVEY §7 "hard parts").
@@ -15,7 +28,7 @@ accumulation (SURVEY §7 "hard parts").
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,11 +50,22 @@ class CGResult(NamedTuple):
     iterations: jax.Array   # iterations actually executed (early exit aware)
 
 
+def _apply_Minv(M_inv: Optional[Any], r: Any) -> Any:
+    """z = M⁻¹ r for a diagonal preconditioner; identity when None."""
+    if M_inv is None:
+        return r
+    return jax.tree_util.tree_map(
+        lambda m, x: jnp.asarray(m, jnp.float32) * x, M_inv, r
+    )
+
+
 def conjugate_gradient(
     f_Ax: Callable[[Any], Any],
     b: Any,
     cg_iters: int = 10,
     residual_tol: float = 1e-10,
+    M_inv: Optional[Any] = None,
+    residual_rtol: float = 0.0,
 ) -> CGResult:
     """Solve ``A x = b`` for SPD ``A`` given only the matvec ``f_Ax``.
 
@@ -51,6 +75,13 @@ def conjugate_gradient(
     ``lax.while_loop`` (data-dependent exit without leaving the device), and
     it returns diagnostics alongside the solution.
 
+    ``M_inv`` (optional) makes this preconditioned CG — a pytree of
+    inverse-diagonal entries shaped like ``b``; the search directions become
+    M-conjugate while the early-exit test stays on the TRUE residual
+    ``rᵀr``, so plain and preconditioned solves are directly comparable.
+    With ``M_inv=None`` the recurrence is bit-identical to unpreconditioned
+    CG. ``residual_rtol`` adds a relative exit ``rᵀr ≤ rtol²·bᵀb``.
+
     ``b`` may be a flat vector (the reference's contract) or ANY pytree —
     e.g. a parameter pytree whose leaves are tensor-sharded over a
     ``"model"`` mesh axis: the iterates keep ``b``'s structure/sharding and
@@ -59,24 +90,33 @@ def conjugate_gradient(
     b = tree_f32(b)
     x0 = tree_zeros_like(b)
     rdotr0 = tree_vdot(b, b)
+    z0 = _apply_Minv(M_inv, b)
+    rdotz0 = tree_vdot(b, z0) if M_inv is not None else rdotr0
+    # threshold on rᵀr: absolute tol OR relative to the RHS norm
+    stop = jnp.maximum(
+        jnp.asarray(residual_tol, jnp.float32),
+        jnp.asarray(residual_rtol, jnp.float32) ** 2 * rdotr0,
+    )
 
     def cond(state):
-        i, _, _, _, rdotr = state
-        return jnp.logical_and(i < cg_iters, rdotr > residual_tol)
+        i, _, _, _, _, rdotr = state
+        return jnp.logical_and(i < cg_iters, rdotr > stop)
 
     def body(state):
-        i, x, r, p, rdotr = state
-        z = tree_f32(f_Ax(p))
-        alpha = rdotr / tree_vdot(p, z)
+        i, x, r, p, rdotz, rdotr = state
+        w = tree_f32(f_Ax(p))
+        alpha = rdotz / tree_vdot(p, w)
         x = tree_add_scaled(x, alpha, p)
-        r = tree_add_scaled(r, -alpha, z)
+        r = tree_add_scaled(r, -alpha, w)
+        z = _apply_Minv(M_inv, r)
         new_rdotr = tree_vdot(r, r)
-        mu = new_rdotr / rdotr
-        p = tree_add_scaled(r, mu, p)
-        return i + 1, x, r, p, new_rdotr
+        new_rdotz = tree_vdot(r, z) if M_inv is not None else new_rdotr
+        mu = new_rdotz / rdotz
+        p = tree_add_scaled(z, mu, p)
+        return i + 1, x, r, p, new_rdotz, new_rdotr
 
-    i, x, r, _, rdotr = lax.while_loop(
-        cond, body, (jnp.asarray(0, jnp.int32), x0, b, b, rdotr0)
+    i, x, r, _, _, rdotr = lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), x0, b, z0, rdotz0, rdotr0)
     )
     del r
     return CGResult(x=x, residual_norm_sq=rdotr, iterations=i)

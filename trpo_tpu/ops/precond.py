@@ -1,0 +1,95 @@
+"""Diagonal (Jacobi) preconditioning for the natural-gradient solve.
+
+Why it exists (VERDICT r3 item 2): the reference runs CG at a fixed 10
+iterations with constant damping (``utils.py:185-201``,
+``trpo_inksci.py:124-126``), which is fine early in training — but the
+flagship Humanoid evidence run's CG residual grew from 5e-3 to 11.8 over
+2417 iterations as the policy sharpened. A shrinking Gaussian ``log_std``
+multiplies the mean-head rows of the Fisher by ``1/σ²`` while torso blocks
+stay O(1), so the ill-conditioning is dominated by per-coordinate SCALE
+spread — exactly what a diagonal preconditioner removes.
+
+The diagonal is estimated matrix-free with Hutchinson probes: for Rademacher
+``v`` (entries ±1), ``E[v ⊙ Av] = diag(A)``, so ``K`` probes cost ``K``
+extra Fisher-vector products per update (vs ``cg_iters+1`` for the solve
+itself) and reuse the same jitted FVP operator — sharded operators stay
+sharded; no new collectives. The estimate is clipped below at the damping
+λ (``diag(F + λI) ≥ λ`` exactly), which also absorbs probe noise on
+near-zero curvature coordinates.
+
+Probe keys are deterministic (a fixed fold of a caller-supplied key), so
+updates stay bit-reproducible run-to-run.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from trpo_tpu.ops.treemath import tree_f32, tree_zeros_like
+
+__all__ = ["hutchinson_diag", "hutchinson_diag_inv"]
+
+
+def _rademacher_like(key: jax.Array, like: Any) -> Any:
+    """A ±1 probe pytree shaped like ``like`` (f32), one subkey per leaf."""
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    keys = jax.random.split(key, len(leaves))
+    probes = [
+        jax.random.rademacher(k, jnp.shape(x), jnp.float32)
+        for k, x in zip(keys, leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, probes)
+
+
+def hutchinson_diag(
+    f_Av: Callable[[Any], Any],
+    like: Any,
+    n_probes: int,
+    key: jax.Array,
+) -> Any:
+    """Estimate ``diag(A)`` of the SPD operator ``f_Av`` matrix-free.
+
+    ``like`` fixes the domain pytree (a flat vector or a params pytree —
+    the operator is domain-polymorphic like everything in ``ops/``). For a
+    DIAGONAL ``A`` a single probe is already exact (``v ⊙ Av = v² ⊙ diag =
+    diag``); off-diagonal mass decays as ``1/√n_probes``. Runs as a
+    ``fori_loop`` so probe count does not multiply live memory.
+    """
+    if n_probes < 1:
+        raise ValueError(f"n_probes must be >= 1, got {n_probes}")
+    like = tree_f32(like)
+
+    def body(i, acc):
+        v = _rademacher_like(jax.random.fold_in(key, i), like)
+        av = tree_f32(f_Av(v))
+        return jax.tree_util.tree_map(
+            lambda a, vv, avv: a + vv * avv, acc, v, av
+        )
+
+    total = lax.fori_loop(0, n_probes, body, tree_zeros_like(like))
+    return jax.tree_util.tree_map(lambda t: t / n_probes, total)
+
+
+def hutchinson_diag_inv(
+    f_Av: Callable[[Any], Any],
+    like: Any,
+    n_probes: int,
+    key: jax.Array,
+    floor: jax.Array | float,
+) -> Any:
+    """``M⁻¹ = 1 / max(diag-estimate, floor)`` — the Jacobi preconditioner
+    pytree :func:`trpo_tpu.ops.cg.conjugate_gradient` takes as ``M_inv``.
+
+    ``floor`` must be positive; for the damped Fisher ``F + λI`` pass
+    ``λ`` (the true diagonal is ≥ λ, so flooring there only removes probe
+    noise, never information).
+    """
+    diag = hutchinson_diag(f_Av, like, n_probes, key)
+    floor = jnp.asarray(floor, jnp.float32)
+    return jax.tree_util.tree_map(
+        lambda d: 1.0 / jnp.maximum(d, floor), diag
+    )

@@ -54,6 +54,24 @@ def build_parser() -> argparse.ArgumentParser:
         help="Levenberg–Marquardt feedback on the CG damping: grow after "
         "failed line search / KL rollback, shrink after clean steps",
     )
+    p.add_argument(
+        "--cg-precondition",
+        action="store_true",
+        help="diagonal (Jacobi) preconditioned CG solve — Hutchinson-probe "
+        "diagonal estimate counteracts late-training Fisher conditioning "
+        "(ops/precond.py)",
+    )
+    p.add_argument(
+        "--cg-precond-probes",
+        type=_positive_int,
+        help="Hutchinson probes for the preconditioner diagonal (default 8)",
+    )
+    p.add_argument(
+        "--cg-residual-rtol",
+        type=float,
+        help="relative CG exit ‖r‖ <= rtol·‖g‖ — makes --cg-iters a cap "
+        "instead of a fixed count (0 = off, reference semantics)",
+    )
     p.add_argument("--gamma", type=float)
     p.add_argument("--lam", type=float)
     p.add_argument("--reward-target", type=float)
@@ -173,6 +191,9 @@ _OVERRIDES = {
     "cg_iters": "cg_iters",
     "cg_damping": "cg_damping",
     "adaptive_damping": "adaptive_damping",
+    "cg_precondition": "cg_precondition",
+    "cg_precond_probes": "cg_precond_probes",
+    "cg_residual_rtol": "cg_residual_rtol",
     "gamma": "gamma",
     "lam": "lam",
     "reward_target": "reward_target",
@@ -268,7 +289,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cfg.checkpoint_dir:
         from trpo_tpu.utils.checkpoint import Checkpointer
 
-        checkpointer = Checkpointer(cfg.checkpoint_dir)
+        checkpointer = Checkpointer(
+            cfg.checkpoint_dir, cg_damping_seed=cfg.cg_damping
+        )
         if args.resume and checkpointer.latest_step() is not None:
             state = checkpointer.restore(agent.init_state())
             # host-simulator sidecar: exact resume for native:, best-effort

@@ -222,8 +222,28 @@ def _natural_gradient_update(
             return _wmean(policy.dist.kl(cur_dist, dist_params), fb.weight)
 
         fvp = make_tree_fvp(kl_fixed_fn, x0, damping=damping)
+    M_inv = None
+    if cfg.cg_precondition:
+        # Jacobi preconditioner from Hutchinson probes against the SAME
+        # damped-Fisher operator CG iterates (ops/precond.py). Fixed probe
+        # key: updates stay bit-reproducible; the floor at λ is exact
+        # (diag(F + λI) ≥ λ).
+        from trpo_tpu.ops.precond import hutchinson_diag_inv
+
+        M_inv = hutchinson_diag_inv(
+            fvp,
+            neg_g,
+            n_probes=cfg.cg_precond_probes,
+            key=jax.random.key(0),
+            floor=damping,
+        )
     cg = conjugate_gradient(
-        fvp, neg_g, cg_iters=cfg.cg_iters, residual_tol=cfg.cg_residual_tol
+        fvp,
+        neg_g,
+        cg_iters=cfg.cg_iters,
+        residual_tol=cfg.cg_residual_tol,
+        M_inv=M_inv,
+        residual_rtol=cfg.cg_residual_rtol,
     )
     stepdir = cg.x
 
