@@ -1,0 +1,186 @@
+"""HLO collective hygiene of the sharded solve at the Humanoid shape
+(VERDICT r3 item 3).
+
+On real multi-chip hardware the #1 silent perf killer is GSPMD materializing
+an unintended collective — e.g. all-gathering the (50k, 376) batch or a
+(B, 256) activation every CG iteration. That regression is invisible to the
+numerical parity suite (values stay correct) and unmeasurable on this box
+(one chip) — but it IS checkable here: compile the GSPMD update for the
+8-device CPU mesh at the flagship Humanoid operating point and assert the
+compiled program's collective inventory.
+
+The invariant pinned here (documented in ARCHITECTURE.md §"Collective
+inventory of the data-parallel solve"):
+
+* NOWHERE in the program does a collective touch a batch-sized operand
+  (threshold: 1e6 elements ≈ 0.16× the 6250×256 per-shard activation; the
+  biggest legitimate collective operand is the ~166k-element flat parameter
+  vector).
+* The CG while-loop body contains EXACTLY ONE parameter-sized all-reduce —
+  the mathematically irreducible cross-shard combine of the per-shard
+  Fisher-vector partial sums (``Σ_shard JᵀMJv``, ~0.66 MB at f32) — plus
+  only scalar-sized reductions (CG's dot products). Data-parallel natural
+  gradient cannot do less communication than this; anything more is a
+  regression.
+
+The reference has no analogue (single-process CPU, ``utils.py:185-201``);
+this is the safety net for `parallel/sharded.py:make_sharded_update`
+trusting GSPMD sharding propagation.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.models import BoxSpec, make_policy
+from trpo_tpu.trpo import TRPOBatch, make_trpo_update
+
+BATCH = 50_000          # flagship Humanoid operating point (BASELINE.json)
+OBS_DIM, ACT_DIM = 376, 17
+HIDDEN = (256, 256)
+BIG = 1_000_000         # "batch-sized": smallest per-shard activation is
+#                         6250×256 = 1.6e6 elements; params are ~1.66e5
+
+_SHAPE_RE = re.compile(r"\b(?:f|s|u|pred|bf)\d*\[([\d,]*)\]")
+_COLLECTIVES = (
+    "all-gather(",
+    "all-reduce(",
+    "reduce-scatter(",
+    "all-to-all(",
+    "collective-permute(",
+)
+
+
+def _elem_counts(line: str):
+    """Element count of every shaped tensor mentioned on an HLO line."""
+    counts = []
+    for dims in _SHAPE_RE.findall(line):
+        if not dims:
+            counts.append(1)  # scalar f32[]
+        else:
+            n = 1
+            for d in dims.split(","):
+                n *= int(d)
+            counts.append(n)
+    return counts
+
+
+def _while_bodies(hlo: str):
+    """Map body-computation name -> its text block, for every while loop."""
+    names = set(re.findall(r"body=%?([\w.\-]+)", hlo))
+    blocks = {}
+    for m in re.finditer(
+        r"^%?([\w.\-]+) \(.*\) -> .* \{$", hlo, re.MULTILINE
+    ):
+        if m.group(1) in names:
+            end = hlo.index("\n}", m.start())
+            blocks[m.group(1)] = hlo[m.start(): end]
+    return blocks
+
+
+@pytest.fixture(scope="module")
+def compiled_hlo():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:8])
+    assert devs.size == 8, "conftest must force the 8-device CPU mesh"
+    mesh = Mesh(devs, ("data",))
+
+    policy = make_policy((OBS_DIM,), BoxSpec(ACT_DIM), hidden=HIDDEN)
+    params = policy.init(jax.random.key(0))
+    cfg = TRPOConfig(cg_iters=10, cg_damping=0.1)
+    update = make_trpo_update(policy, cfg)
+
+    batch = TRPOBatch(
+        obs=jax.ShapeDtypeStruct((BATCH, OBS_DIM), jnp.float32),
+        actions=jax.ShapeDtypeStruct((BATCH, ACT_DIM), jnp.float32),
+        advantages=jax.ShapeDtypeStruct((BATCH,), jnp.float32),
+        old_dist=jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            jax.eval_shape(policy.apply, params, jnp.zeros((BATCH, OBS_DIM))),
+        ),
+        weight=jax.ShapeDtypeStruct((BATCH,), jnp.float32),
+    )
+    repl = NamedSharding(mesh, P())
+    shard = lambda x: jax.ShapeDtypeStruct(
+        x.shape,
+        x.dtype,
+        sharding=NamedSharding(
+            mesh, P("data", *([None] * (len(x.shape) - 1)))
+        ),
+    )
+    batch = jax.tree_util.tree_map(shard, batch)
+    params_abs = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=repl),
+        params,
+    )
+    lowered = jax.jit(update).lower(params_abs, batch)
+    hlo = lowered.compile().as_text()
+    n_params = sum(
+        int(np.prod(x.shape))
+        for x in jax.tree_util.tree_leaves(params)
+    )
+    return hlo, n_params
+
+
+def test_no_batch_sized_collectives_anywhere(compiled_hlo):
+    hlo, _ = compiled_hlo
+    offenders = []
+    for line in hlo.splitlines():
+        if any(c in line for c in _COLLECTIVES):
+            counts = _elem_counts(line)
+            if counts and max(counts) >= BIG:
+                offenders.append(line.strip()[:200])
+    assert not offenders, (
+        "GSPMD materialized a batch-sized collective — multi-chip "
+        "perf regression:\n" + "\n".join(offenders)
+    )
+
+
+def test_cg_loop_body_collective_inventory(compiled_hlo):
+    """The CG body: exactly one param-sized all-reduce (the per-shard FVP
+    combine), everything else scalar-sized."""
+    hlo, n_params = compiled_hlo
+    bodies = _while_bodies(hlo)
+    assert bodies, "compiled module lost its while loops?"
+
+    # the CG body is the while body that all-reduces a ~param-sized vector
+    param_band = (int(n_params * 0.5), int(n_params * 1.5))
+    cg_bodies = []
+    for name, text in bodies.items():
+        param_ars, scalar_red, other = 0, 0, []
+        for line in text.splitlines():
+            if not any(c in line for c in _COLLECTIVES):
+                continue
+            counts = _elem_counts(line)
+            big = max(counts) if counts else 1
+            if param_band[0] <= big <= param_band[1]:
+                param_ars += 1
+            elif big <= 64:
+                scalar_red += 1  # CG dot products (possibly tuple-merged)
+            else:
+                other.append(line.strip()[:160])
+        if param_ars:
+            cg_bodies.append((name, param_ars, scalar_red, other))
+
+    assert cg_bodies, (
+        "no while body all-reduces a param-sized vector — either the CG "
+        "loop vanished or the FVP combine moved; inspect the HLO"
+    )
+    for name, param_ars, scalar_red, other in cg_bodies:
+        assert param_ars == 1, (
+            f"{name}: expected exactly 1 param-sized all-reduce per CG "
+            f"iteration (the FVP partial-sum combine), found {param_ars}"
+        )
+        assert not other, (
+            f"{name}: unexpected mid-sized collectives in the CG body:\n"
+            + "\n".join(other)
+        )
+        assert scalar_red <= 6, (
+            f"{name}: {scalar_red} scalar reductions per iteration — "
+            "more than CG's dot products should need"
+        )
