@@ -1,0 +1,236 @@
+"""ProcVecEnv: process-pool host envs (VERDICT r3 item 6).
+
+The pool must be a DROP-IN for GymVecEnv: bit-identical trajectories and
+normalization statistics for the same seed, interchangeable checkpoint
+snapshots, and the same error contracts. Perf cannot be validated on this
+1-core host (BENCH_LADDER note); correctness is pinned here.
+"""
+
+import numpy as np
+import pytest
+
+gym = pytest.importorskip("gymnasium")
+
+from trpo_tpu import envs
+from trpo_tpu.envs.gym_adapter import GymVecEnv
+from trpo_tpu.envs.proc_env import ProcVecEnv
+
+ENV = "CartPole-v1"
+
+
+def _drive(env, n_steps, seed=123):
+    """Deterministic action stream + full trace of everything returned."""
+    rng = np.random.default_rng(seed)
+    trace = []
+    for _ in range(n_steps):
+        if env._continuous:
+            acts = rng.normal(size=(env.n_envs, env.action_spec.dim))
+            acts = acts.astype(np.float32)
+        else:
+            acts = rng.integers(0, env.action_spec.n, size=env.n_envs)
+        trace.append(env.host_step(acts))
+    return trace
+
+
+def _assert_traces_equal(ta, tb):
+    assert len(ta) == len(tb)
+    for step_a, step_b in zip(ta, tb):
+        for xa, xb in zip(step_a, step_b):
+            np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def test_bit_identical_to_gym_vec_env():
+    """Same seed, same actions → the pool and the in-process adapter
+    produce byte-for-byte identical trajectories and episode stats."""
+    a = GymVecEnv(ENV, n_envs=4, seed=7)
+    b = ProcVecEnv(ENV, n_envs=4, seed=7, n_workers=2)
+    try:
+        np.testing.assert_array_equal(a.current_obs(), b.current_obs())
+        _assert_traces_equal(_drive(a, 30), _drive(b, 30))
+        np.testing.assert_array_equal(
+            a.last_episode_returns, b.last_episode_returns
+        )
+        np.testing.assert_array_equal(
+            a.last_episode_lengths, b.last_episode_lengths
+        )
+    finally:
+        a.close()
+        b.close()
+
+
+def test_bit_identical_with_obs_normalization():
+    """The centralized Welford fold must match the in-process adapter's
+    statistics exactly (same fold order: one full-batch fold per step)."""
+    a = GymVecEnv(ENV, n_envs=3, seed=5, normalize_obs=True)
+    b = ProcVecEnv(ENV, n_envs=3, seed=5, normalize_obs=True, n_workers=3)
+    try:
+        _assert_traces_equal(_drive(a, 20), _drive(b, 20))
+        for sa, sb in zip(a.obs_stats_state(), b.obs_stats_state()):
+            np.testing.assert_array_equal(np.asarray(sa), np.asarray(sb))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_reproducible_across_pool_shapes():
+    """Worker count is an execution detail: 1, 2, and 4 workers produce
+    identical trajectories (bit-reproducibility under fixed seeds)."""
+    traces = []
+    for w in (1, 2, 4):
+        env = ProcVecEnv(ENV, n_envs=4, seed=11, n_workers=w)
+        try:
+            traces.append(_drive(env, 15))
+        finally:
+            env.close()
+    _assert_traces_equal(traces[0], traces[1])
+    _assert_traces_equal(traces[0], traces[2])
+
+
+def test_host_step_slice_on_worker_boundaries():
+    """Group stepping at worker granularity (the pipelined-rollout path):
+    two half-slices == one full step of the in-process adapter."""
+    a = GymVecEnv(ENV, n_envs=4, seed=3)
+    b = ProcVecEnv(ENV, n_envs=4, seed=3, n_workers=2)
+    try:
+        rng = np.random.default_rng(0)
+        for _ in range(10):
+            acts = rng.integers(0, 2, size=4)
+            full = a.host_step(acts)
+            lo_half = b.host_step_slice(acts[:2], 0, 2)
+            hi_half = b.host_step_slice(acts[2:], 2, 4)
+            for xa, xl, xh in zip(full, lo_half, hi_half):
+                np.testing.assert_array_equal(
+                    np.asarray(xa),
+                    np.concatenate(
+                        [np.atleast_1d(xl), np.atleast_1d(xh)]
+                    ),
+                )
+    finally:
+        a.close()
+        b.close()
+
+
+def test_host_step_slice_rejects_split_worker():
+    env = ProcVecEnv(ENV, n_envs=4, seed=0, n_workers=2)
+    try:
+        with pytest.raises(ValueError, match="splits worker"):
+            env.host_step_slice(np.zeros(2, np.int64), 1, 3)
+        # the protocol survived the rejected call
+        env.host_step(np.zeros(4, np.int64))
+    finally:
+        env.close()
+
+
+def test_snapshots_interchangeable_with_gym_vec_env():
+    """A ProcVecEnv snapshot restores into GymVecEnv and vice versa —
+    same sidecar schema, so checkpoints survive switching adapters."""
+    proc = ProcVecEnv(ENV, n_envs=2, seed=9, n_workers=2)
+    gymv = GymVecEnv(ENV, n_envs=2, seed=1009)
+    try:
+        for _ in range(7):
+            proc.host_step(np.ones(2, np.int64))
+        snap = proc.env_state_snapshot()
+        gymv.env_state_restore(snap)
+        # both continue identically from the restored state
+        acts = np.zeros(2, np.int64)
+        sp = proc.host_step(acts)
+        sg = gymv.host_step(acts)
+        for xa, xb in zip(sp, sg):
+            np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+        # and the reverse direction
+        snap2 = gymv.env_state_snapshot()
+        proc2 = ProcVecEnv(ENV, n_envs=2, seed=77, n_workers=1)
+        try:
+            proc2.env_state_restore(snap2)
+            s2 = proc2.host_step(acts)
+            s1 = gymv.host_step(acts)
+            for xa, xb in zip(s1, s2):
+                np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+        finally:
+            proc2.close()
+    finally:
+        proc.close()
+        gymv.close()
+
+
+def test_snapshot_roundtrip_through_checkpointer(tmp_path):
+    """The pool's sidecar rides the pickle-free npz codec like every other
+    host adapter."""
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    env = ProcVecEnv(ENV, n_envs=2, seed=4, n_workers=2)
+    try:
+        for _ in range(5):
+            env.host_step(np.ones(2, np.int64))
+        snap = env.env_state_snapshot()
+        ck = Checkpointer(str(tmp_path / "ck"))
+        try:
+            ck.save_host_env(1, snap)
+            back = ck.restore_host_env(1)
+        finally:
+            ck.close()
+        env.env_state_restore(back)
+        np.testing.assert_array_equal(env.current_obs(), snap["obs"])
+    finally:
+        env.close()
+
+
+def test_make_routes_gymproc():
+    env = envs.make(f"gymproc:{ENV}", n_envs=2, seed=0, n_workers=1)
+    try:
+        assert isinstance(env, ProcVecEnv)
+        assert env.obs_shape == (4,)
+        out = env.host_step(np.zeros(2, np.int64))
+        assert out[0].shape == (2, 4)
+    finally:
+        env.close()
+
+
+def test_reset_all_matches_gym_vec_env():
+    a = GymVecEnv(ENV, n_envs=3, seed=2)
+    b = ProcVecEnv(ENV, n_envs=3, seed=2, n_workers=2)
+    try:
+        _drive(a, 5)
+        _drive(b, 5)
+        oa = a.reset_all(seed=42)
+        ob = b.reset_all(seed=42)
+        np.testing.assert_array_equal(oa, ob)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_worker_error_surfaces():
+    env = ProcVecEnv(ENV, n_envs=2, seed=0, n_workers=1)
+    try:
+        with pytest.raises(RuntimeError, match="worker 0"):
+            env.host_step(np.asarray(["bad", "acts"], dtype=object))
+    finally:
+        env.close()
+
+
+def test_worker_error_does_not_desync_protocol():
+    """With several workers, one worker's error must DRAIN the others'
+    replies before raising — a later command must not read a stale step
+    reply (code-review r4 finding)."""
+    env = ProcVecEnv(ENV, n_envs=4, seed=0, n_workers=2)
+    ref = ProcVecEnv(ENV, n_envs=4, seed=0, n_workers=2)
+    try:
+        # worker 0 gets unsteppable actions; worker 1 steps fine — its
+        # 'ok' reply must be consumed, not left queued
+        bad = np.asarray(["x", "y", 0, 1], dtype=object)
+        with pytest.raises(RuntimeError, match="worker 0"):
+            env.host_step(bad)
+        # the protocol survived: reset_all returns reset obs, not the
+        # stale step reply, and matches a clean adapter's reset
+        oa = env.reset_all(seed=99)
+        ob = ref.reset_all(seed=99)
+        np.testing.assert_array_equal(oa, ob)
+        # note: worker 1 DID step its envs during the failed call (the
+        # scatter is parallel by design); reset_all rewound that
+        out = env.host_step(np.zeros(4, np.int64))
+        assert out[0].shape == (4, 4)
+    finally:
+        env.close()
+        ref.close()

@@ -18,6 +18,9 @@ are supported through a vectorized adapter with batched device inference.
 - ``"native:cartpole"``, ``"native:pendulum"`` → C++ batched host stepper
   (``native/vec_env.cpp`` via ctypes; builds lazily with g++)
 - ``"gym:<EnvId>"`` → gymnasium adapter (requires gymnasium + the env's deps)
+- ``"gymproc:<EnvId>"`` → the same adapter surface over a worker-process
+  pool (``envs/proc_env.py`` — GIL-free parallel host stepping on
+  multicore hosts; bit-identical trajectories to ``gym:``)
 """
 
 from trpo_tpu.envs.cartpole import CartPole  # noqa: F401
@@ -75,6 +78,10 @@ def make(name: str, max_episode_steps=None, **kwargs):
         from trpo_tpu.envs.gym_adapter import GymVecEnv
 
         return GymVecEnv(name[4:], **kwargs)
+    if name.startswith("gymproc:"):
+        from trpo_tpu.envs.proc_env import ProcVecEnv
+
+        return ProcVecEnv(name[len("gymproc:"):], **kwargs)
     if name.startswith("native:"):
         from trpo_tpu.envs.native import NativeVecEnv
 

@@ -180,17 +180,9 @@ class GymVecEnv(EpisodeStatsMixin, ObsNormMixin):
         return self._obs.copy()
 
     # -- checkpoint fidelity (best-effort for external simulators) --------
-
-    @staticmethod
-    def _find_time_limit(env):
-        """The wrapper carrying TimeLimit's ``_elapsed_steps``, wherever
-        it sits in the chain; None when the env has no TimeLimit."""
-        e = env
-        while e is not None and e is not getattr(e, "unwrapped", None):
-            if hasattr(e, "_elapsed_steps"):
-                return e
-            e = getattr(e, "env", None)
-        return None
+    #
+    # Per-env capture/restore lives in envs/gym_state.py (shared with the
+    # process-based ProcVecEnv's jax-free workers).
 
     def env_state_snapshot(self) -> dict:
         """Best-effort mid-episode resume state (SURVEY §5 checkpoint
@@ -201,40 +193,9 @@ class GymVecEnv(EpisodeStatsMixin, ObsNormMixin):
         snapshot as ``None`` and restart episodes on restore (documented
         restart semantics; obs-norm statistics ride TrainState either
         way)."""
-        sims = []
-        for env in self.envs:
-            u = env.unwrapped
-            tl = self._find_time_limit(env)
-            elapsed = None if tl is None else tl._elapsed_steps
-            # episode-reset randomness rides along: without the
-            # bit-generator state a resumed run replays DIFFERENT resets
-            # than the uninterrupted run would have
-            rng_state = None
-            np_random = getattr(u, "np_random", None)
-            if np_random is not None and hasattr(np_random, "bit_generator"):
-                rng_state = np_random.bit_generator.state
-            if hasattr(u, "data") and hasattr(u, "set_state"):
-                sims.append({
-                    "backend": "mujoco",
-                    "qpos": np.asarray(u.data.qpos, np.float64).copy(),
-                    "qvel": np.asarray(u.data.qvel, np.float64).copy(),
-                    "ctrl": np.asarray(u.data.ctrl, np.float64).copy(),
-                    "qacc_warmstart": np.asarray(
-                        u.data.qacc_warmstart, np.float64
-                    ).copy(),
-                    "time": float(u.data.time),
-                    "elapsed": elapsed,
-                    "np_random": rng_state,
-                })
-            elif getattr(u, "state", None) is not None:
-                sims.append({
-                    "backend": "state",
-                    "state": np.asarray(u.state, np.float64).copy(),
-                    "elapsed": elapsed,
-                    "np_random": rng_state,
-                })
-            else:
-                sims.append(None)  # opaque simulator — restart on restore
+        from trpo_tpu.envs.gym_state import snapshot_one
+
+        sims = [snapshot_one(env) for env in self.envs]
         snap = {
             "env_id": self.env_id,
             "sims": sims,
@@ -264,34 +225,16 @@ class GymVecEnv(EpisodeStatsMixin, ObsNormMixin):
                 "snapshot was taken without normalize_obs; resume with "
                 "the same normalize_obs setting"
             )
+        from trpo_tpu.envs.gym_state import restore_one
+
         reset_obs = {}
         for i, (env, sim) in enumerate(zip(self.envs, snap["sims"])):
-            if sim is None:
-                # opaque backend: documented restart — this env begins a
-                # FRESH episode, so it must see the reset obs and zeroed
-                # counters, not the dead pre-checkpoint episode's
-                obs_i, _ = env.reset()
-                reset_obs[i] = np.asarray(obs_i)
-                continue
-            u = env.unwrapped
-            # reset first: wrappers (TimeLimit) and lazy backend state
-            # need a live episode to overwrite
-            env.reset()
-            if sim["backend"] == "mujoco":
-                u.set_state(sim["qpos"], sim["qvel"])
-                u.data.time = sim["time"]
-                if sim.get("ctrl") is not None:
-                    u.data.ctrl[:] = sim["ctrl"]
-                if sim.get("qacc_warmstart") is not None:
-                    u.data.qacc_warmstart[:] = sim["qacc_warmstart"]
-            else:
-                u.state = np.asarray(sim["state"], np.float64)
-            if sim.get("np_random") is not None:
-                u.np_random.bit_generator.state = sim["np_random"]
-            if sim.get("elapsed") is not None:
-                tl = self._find_time_limit(env)
-                if tl is not None:
-                    tl._elapsed_steps = sim["elapsed"]
+            # opaque backend (restore_one returns the fresh episode's raw
+            # obs): documented restart — this env must see the reset obs
+            # and zeroed counters, not the dead pre-checkpoint episode's
+            raw = restore_one(env, sim)
+            if raw is not None:
+                reset_obs[i] = raw
         self._obs = np.asarray(snap["obs"]).copy()
         if self.has_obs_norm and "raw_obs" in snap:
             self._raw_obs = np.asarray(snap["raw_obs"]).copy()

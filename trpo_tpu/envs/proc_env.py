@@ -1,0 +1,426 @@
+"""Process-based vectorized gymnasium adapter (VERDICT r3 item 6).
+
+Python-stepped simulators (MuJoCo, Atari) serialize on the GIL, so the
+threaded pipeline (``rollout.pipelined_host_rollout``) cannot overlap two
+env groups' *stepping* — only stepping against device transfers. The
+standard fix is a process pool: N envs split over W worker processes, each
+stepping its contiguous slice in parallel, with actions/observations
+crossing process boundaries over pipes.
+
+Drop-in: :class:`ProcVecEnv` speaks exactly the :class:`GymVecEnv` surface
+(``host_step`` / ``host_step_slice`` / ``reset_all`` / ``current_obs`` /
+``env_state_snapshot`` / ``env_state_restore`` / ``render_frame`` /
+episode stats / shared obs normalization), produces BIT-identical
+trajectories to ``GymVecEnv`` for the same seed (same per-env seeding
+``seed + i``, same auto-reset bookkeeping, same centralized normalization
+fold — asserted by ``tests/test_proc_env.py``), and its snapshots are
+interchangeable with ``GymVecEnv``'s (same schema, cross-restorable).
+
+Design constraints honored:
+
+* Workers never initialize a jax backend. The worker body calls only
+  numpy + gymnasium (via the jax-free ``envs.gym_state``); jax is imported
+  transitively by the package ``__init__`` in the spawned interpreter but
+  no jax API runs, so the single-tenant TPU tunnel is never touched. The
+  ``spawn`` start method guarantees a clean interpreter (no forked jax
+  state).
+* Normalization statistics stay **centralized in the parent** (one
+  Welford fold per (group) step over the gathered raw slice — the same
+  associative merge ``GymVecEnv`` does), so statistics are identical to
+  the in-process adapter and checkpointing is unchanged.
+* Workers own **contiguous env slices**, so ``host_step_slice`` group
+  boundaries that align with worker boundaries touch exactly one worker
+  (the pipelined rollout's ``host_pipeline_groups=W`` sweet spot).
+
+Perf note (BENCH_LADDER "host pipeline"): this host has ONE core, so the
+pool cannot show a speedup here — correctness is validated on 1 core;
+throughput validation awaits a multicore host. The reference steps one env
+serially in-process (``utils.py:18-45``).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+
+from trpo_tpu.envs.episode_stats import EpisodeStatsMixin
+from trpo_tpu.envs.obs_norm import ObsNormMixin
+
+__all__ = ["ProcVecEnv"]
+
+
+def _worker(conn, env_id: str, count: int, seed_base: int, kwargs: dict):
+    """Worker loop: owns ``count`` envs; steps/snapshots/restores them on
+    command. Runs in a spawned interpreter; calls numpy + gymnasium only
+    (never a jax API — see the module docstring's tunnel constraint)."""
+    try:
+        import gymnasium
+
+        from trpo_tpu.envs.gym_state import restore_one, snapshot_one
+
+        envs = [gymnasium.make(env_id, **kwargs) for _ in range(count)]
+        single = envs[0]
+        space = single.action_space
+        if hasattr(space, "n"):
+            spec = ("discrete", int(space.n))
+            clip = None
+        else:
+            lo = np.asarray(space.low, np.float32)
+            hi = np.asarray(space.high, np.float32)
+            spec = ("box", int(space.shape[0]))
+            clip = (lo, hi)
+        obs0 = np.stack(
+            [env.reset(seed=seed_base + j)[0] for j, env in enumerate(envs)]
+        )
+        conn.send(("ready", spec, tuple(single.observation_space.shape), obs0))
+    except Exception as e:  # pragma: no cover - construction failures
+        import traceback
+
+        conn.send(("err", f"{type(e).__name__}: {e}\n"
+                   f"{traceback.format_exc()}"))
+        return
+
+    while True:
+        try:
+            msg = conn.recv()
+        except EOFError:  # parent died — exit quietly
+            break
+        cmd = msg[0]
+        try:
+            if cmd == "step":
+                actions = msg[1]
+                m = len(envs)
+                next_obs = np.empty((m,) + obs0.shape[1:], obs0.dtype)
+                final_obs = np.empty_like(next_obs)
+                rewards = np.zeros(m, np.float32)
+                term = np.zeros(m, bool)
+                trunc = np.zeros(m, bool)
+                for j, env in enumerate(envs):
+                    a = actions[j]
+                    if clip is not None:
+                        a = np.clip(a, clip[0], clip[1])
+                    obs_j, r, tm, tr, _info = env.step(a)
+                    rewards[j] = r
+                    term[j] = tm
+                    trunc[j] = tr
+                    final_obs[j] = obs_j
+                    if tm or tr:
+                        obs_j, _ = env.reset()
+                    next_obs[j] = obs_j
+                conn.send(("ok", next_obs, rewards, term, trunc, final_obs))
+            elif cmd == "reset_all":
+                seed = msg[1]
+                obs = np.stack(
+                    [
+                        env.reset(
+                            seed=None if seed is None else seed + j
+                        )[0]
+                        for j, env in enumerate(envs)
+                    ]
+                )
+                conn.send(("ok", obs))
+            elif cmd == "snapshot":
+                conn.send(("ok", [snapshot_one(env) for env in envs]))
+            elif cmd == "restore":
+                sims = msg[1]
+                reset_obs = {}
+                for j, (env, sim) in enumerate(zip(envs, sims)):
+                    raw = restore_one(env, sim)
+                    if raw is not None:
+                        reset_obs[j] = raw
+                conn.send(("ok", reset_obs))
+            elif cmd == "render":
+                conn.send(("ok", envs[0].render()))
+            elif cmd == "close":
+                for env in envs:
+                    env.close()
+                conn.send(("ok",))
+                break
+            else:
+                conn.send(("err", f"unknown command {cmd!r}"))
+        except Exception as e:
+            import traceback
+
+            conn.send(("err", f"{type(e).__name__}: {e}\n"
+                       f"{traceback.format_exc()}"))
+
+
+class ProcVecEnv(EpisodeStatsMixin, ObsNormMixin):
+    """N gymnasium envs over W worker processes — GymVecEnv's surface."""
+
+    def __init__(self, env_id: str, n_envs: int = 8, seed: int = 0,
+                 normalize_obs: bool = False, n_workers=None, **kwargs):
+        self.env_id = env_id
+        self.n_envs = n_envs
+        if n_workers is None:
+            n_workers = max(1, min(n_envs, os.cpu_count() or 1))
+        if not 1 <= n_workers <= n_envs:
+            raise ValueError(
+                f"n_workers must be in [1, n_envs={n_envs}], got {n_workers}"
+            )
+        self.n_workers = n_workers
+        # contiguous balanced slices: first (n_envs % W) workers get one
+        # extra env — boundaries usable as host_step_slice groups
+        q, r = divmod(n_envs, n_workers)
+        self._slices = []
+        lo = 0
+        for w in range(n_workers):
+            hi = lo + q + (1 if w < r else 0)
+            self._slices.append((lo, hi))
+            lo = hi
+
+        ctx = mp.get_context("spawn")  # clean interpreters: no forked jax
+        self._conns, self._procs = [], []
+        # spawn re-runs __main__ from its __file__ in the child; a parent
+        # driven from stdin/REPL has __file__ == "<stdin>", which the
+        # child fails to re-open. The worker needs nothing from __main__,
+        # so hide a non-existent __file__ for the duration of the starts.
+        import sys
+
+        main_mod = sys.modules.get("__main__")
+        main_file = getattr(main_mod, "__file__", None)
+        hide_main = main_file is not None and not os.path.exists(main_file)
+        if hide_main:
+            del main_mod.__file__
+        try:
+            try:
+                for (lo, hi) in self._slices:
+                    parent, child = ctx.Pipe()
+                    p = ctx.Process(
+                        target=_worker,
+                        args=(
+                            child, env_id, hi - lo, seed + lo, dict(kwargs)
+                        ),
+                        daemon=True,
+                    )
+                    p.start()
+                    child.close()
+                    self._conns.append(parent)
+                    self._procs.append(p)
+            finally:
+                if hide_main:
+                    main_mod.__file__ = main_file
+            obs_parts = []
+            spec = obs_shape = None
+            for conn in self._conns:
+                msg = conn.recv()
+                if msg[0] != "ready":
+                    raise RuntimeError(
+                        f"ProcVecEnv worker failed to start:\n{msg[1]}"
+                    )
+                _, spec, obs_shape, obs0 = msg
+                obs_parts.append(obs0)
+        except Exception:
+            self.close()
+            raise
+
+        from trpo_tpu.models.policy import BoxSpec, DiscreteSpec
+
+        self.obs_shape = tuple(obs_shape)
+        if spec[0] == "discrete":
+            self.action_spec = DiscreteSpec(spec[1])
+            self._continuous = False
+        else:
+            self.action_spec = BoxSpec(spec[1])
+            self._continuous = True
+
+        self._init_obs_norm(self.obs_shape, normalize_obs)
+        self._obs = self._fold_and_normalize(np.concatenate(obs_parts))
+        self._init_episode_stats(n_envs)
+
+    # -- worker RPC --------------------------------------------------------
+
+    def _call(self, w: int, *msg):
+        self._conns[w].send(msg)
+
+    def _reply(self, w: int):
+        msg = self._conns[w].recv()
+        if msg[0] != "ok":
+            raise RuntimeError(
+                f"ProcVecEnv worker {w} ({self.env_id}):\n{msg[1]}"
+            )
+        return msg[1:]
+
+    def _reply_all(self, ws):
+        """Gather one reply from EVERY worker in ``ws`` before raising.
+
+        Raising on the first error reply would leave the later workers'
+        queued replies unconsumed, permanently desyncing the pipe protocol
+        — a caller that caught the error would then read a stale step
+        reply as the answer to its next command. Drain first, then report
+        every failure."""
+        replies, errors = {}, []
+        for w in ws:
+            msg = self._conns[w].recv()
+            if msg[0] != "ok":
+                errors.append(f"worker {w}:\n{msg[1]}")
+            else:
+                replies[w] = msg[1:]
+        if errors:
+            raise RuntimeError(
+                f"ProcVecEnv ({self.env_id}):\n" + "\n".join(errors)
+            )
+        return replies
+
+    def _overlapping(self, lo: int, hi: int):
+        """(worker, its-local-range, global-range) for workers ∩ [lo, hi)."""
+        out = []
+        for w, (wlo, whi) in enumerate(self._slices):
+            a, b = max(lo, wlo), min(hi, whi)
+            if a < b:
+                out.append((w, (a - wlo, b - wlo), (a, b)))
+        return out
+
+    # -- GymVecEnv surface -------------------------------------------------
+
+    def host_step(self, actions: np.ndarray):
+        """Step all envs in parallel across the workers; auto-reset
+        finished ones. Same contract as ``GymVecEnv.host_step``
+        (``(next_obs, rewards, terminated, truncated, final_obs)`` with
+        pre-reset truncation-bootstrap successors)."""
+        return self.host_step_slice(actions, 0, self.n_envs)
+
+    def host_step_slice(self, actions: np.ndarray, lo: int, hi: int):
+        """Step envs ``[lo, hi)`` — scatter action sub-slices to the
+        overlapping workers, step them CONCURRENTLY, gather, then fold
+        stats/normalization centrally exactly as ``GymVecEnv`` does."""
+        parts = self._overlapping(lo, hi)
+        # validate BEFORE any send: a mid-scatter error would desync the
+        # pipe protocol (a worker left with an unconsumed reply)
+        for w, (la, lb), _ in parts:
+            if la != 0 or lb != self._slices[w][1] - self._slices[w][0]:
+                raise ValueError(
+                    f"host_step_slice [{lo}, {hi}) splits worker {w}'s env "
+                    f"slice {self._slices[w]} — align groups to worker "
+                    "boundaries (host_pipeline_groups=n_workers), or use "
+                    "host_step"
+                )
+        # scatter everything first: workers step in parallel
+        for w, _, (ga, gb) in parts:
+            self._call(w, "step", actions[ga - lo: gb - lo])
+        m = hi - lo
+        next_obs = np.empty((m,) + self._obs.shape[1:], self._obs.dtype)
+        final_obs = np.empty_like(next_obs)
+        rewards = np.zeros(m, np.float32)
+        terminated = np.zeros(m, bool)
+        truncated = np.zeros(m, bool)
+        replies = self._reply_all([w for w, _, _ in parts])
+        for w, _, (ga, gb) in parts:
+            o, r, tm, tr, f = replies[w]
+            s = slice(ga - lo, gb - lo)
+            next_obs[s] = o
+            rewards[s] = r
+            terminated[s] = tm
+            truncated[s] = tr
+            final_obs[s] = f
+
+        self._update_episode_stats_slice(
+            rewards, np.logical_or(terminated, truncated), lo, hi
+        )
+        next_obs, final_obs = self._fold_and_normalize_slice(
+            next_obs, lo, hi, extra=final_obs
+        )
+        self._obs[lo:hi] = next_obs
+        return next_obs, rewards, terminated, truncated, final_obs
+
+    def reset_all(self, seed=None) -> np.ndarray:
+        for w, (wlo, _) in enumerate(self._slices):
+            self._call(
+                w, "reset_all", None if seed is None else seed + wlo
+            )
+        replies = self._reply_all(range(self.n_workers))
+        obs = np.concatenate(
+            [replies[w][0] for w in range(self.n_workers)]
+        )
+        self._obs = self._fold_and_normalize(obs)
+        self._running_returns[:] = 0.0
+        self._running_lengths[:] = 0
+        return self._obs.copy()
+
+    def current_obs(self) -> np.ndarray:
+        return self._obs.copy()
+
+    # -- checkpoint sidecar (same schema as GymVecEnv: cross-restorable) ---
+
+    def env_state_snapshot(self) -> dict:
+        for w in range(self.n_workers):
+            self._call(w, "snapshot")
+        replies = self._reply_all(range(self.n_workers))
+        sims = []
+        for w in range(self.n_workers):
+            sims.extend(replies[w][0])
+        snap = {
+            "env_id": self.env_id,
+            "sims": sims,
+            "obs": self._obs.copy(),
+            **self._episode_stats_snapshot(),
+        }
+        if self.has_obs_norm:
+            snap["raw_obs"] = self._raw_obs.copy()
+        return snap
+
+    def env_state_restore(self, snap: dict) -> None:
+        if snap.get("env_id") != self.env_id:
+            raise ValueError(
+                f"snapshot is for {snap.get('env_id')!r}, this adapter "
+                f"is {self.env_id!r}"
+            )
+        if len(snap["sims"]) != self.n_envs:
+            raise ValueError(
+                f"snapshot holds {len(snap['sims'])} envs, this adapter "
+                f"has {self.n_envs} — resume with the same n_envs"
+            )
+        if self.has_obs_norm and "raw_obs" not in snap:
+            raise ValueError(
+                "snapshot was taken without normalize_obs; resume with "
+                "the same normalize_obs setting"
+            )
+        for w, (wlo, whi) in enumerate(self._slices):
+            self._call(w, "restore", list(snap["sims"][wlo:whi]))
+        replies = self._reply_all(range(self.n_workers))
+        reset_obs = {}
+        for w, (wlo, _) in enumerate(self._slices):
+            for j, raw in replies[w][0].items():
+                reset_obs[wlo + j] = raw
+        self._obs = np.asarray(snap["obs"]).copy()
+        if self.has_obs_norm and "raw_obs" in snap:
+            self._raw_obs = np.asarray(snap["raw_obs"]).copy()
+        self._episode_stats_restore(snap)
+        for i, raw in reset_obs.items():
+            if self.has_obs_norm:
+                self._raw_obs[i] = raw
+                with self._norm_lock:
+                    self._obs[i] = self._apply_norm(raw)
+            else:
+                self._obs[i] = raw
+            self._running_returns[i] = 0.0
+            self._running_lengths[i] = 0
+
+    def render_frame(self) -> np.ndarray:
+        """RGB frame of env 0 (worker 0) — same contract as GymVecEnv."""
+        self._call(0, "render")
+        frame = self._reply(0)[0]
+        if frame is None:
+            raise RuntimeError(
+                "rendering returned None — construct ProcVecEnv with "
+                "render_mode='rgb_array'"
+            )
+        return np.asarray(frame)
+
+    def close(self):
+        for w, conn in enumerate(getattr(self, "_conns", [])):
+            try:
+                conn.send(("close",))
+            except (BrokenPipeError, OSError):
+                pass
+        for w, p in enumerate(getattr(self, "_procs", [])):
+            p.join(timeout=5)
+            if p.is_alive():  # pragma: no cover
+                p.terminate()
+        for conn in getattr(self, "_conns", []):
+            try:
+                conn.close()
+            except OSError:  # pragma: no cover
+                pass
