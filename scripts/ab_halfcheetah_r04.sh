@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-4 evidence: GAE(lambda) x adaptive-damping 2x2 A/B on REAL
+# HalfCheetah-v4 (VERDICT r3 item 4). Four sequential runs at the exact
+# r03 flagship settings (halfcheetah_r03.jsonl command) differing only in
+# --lam and --adaptive-damping. Runs execute from the .ab_snapshot
+# worktree (HEAD at launch) so concurrent dev edits cannot change the
+# code mid-experiment. One TPU process at a time: this script owns the
+# chip until it exits.
+#
+# Curves are compared PER-ITERATION at equal step budget (800 x 5000 =
+# 4M env steps each); wall-clock is reported but not a comparand (the
+# 1-core host also runs the dev loop during these).
+set -u
+cd /root/repo/.ab_snapshot
+OUT=/root/repo/ab_r04
+mkdir -p "$OUT"
+
+run () {
+  name=$1; shift
+  echo "=== $name start $(date -u +%H:%M:%S) ==="
+  python -m trpo_tpu.train --preset halfcheetah \
+    --batch-timesteps 5000 --n-envs 25 --host-inference cpu \
+    --normalize-obs --iterations 800 --seed 1 \
+    --checkpoint-dir "$OUT/ckpts/$name" --checkpoint-every 200 \
+    --log-jsonl "$OUT/$name.jsonl" "$@" \
+    > "$OUT/$name.out" 2>&1
+  echo "=== $name rc=$? end $(date -u +%H:%M:%S) ==="
+}
+
+run hc_lam097_const --lam 0.97
+run hc_lam100_const --lam 1.0
+run hc_lam097_adapt --lam 0.97 --adaptive-damping
+run hc_lam100_adapt --lam 1.0 --adaptive-damping
+echo "ALL DONE $(date -u +%H:%M:%S)"
