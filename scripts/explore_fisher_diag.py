@@ -1,0 +1,217 @@
+"""Why Jacobi preconditioning cannot repair the late-training Fisher
+(round-4 diagnostic behind the BENCH_LADDER "late-training solver"
+section's negative result).
+
+Computes the EXACT Gauss-Newton diagonal ``diag(F)_p = Σ_{n,k} w_n
+M_k(n) J_{n,k,p}²`` on a batch subsample (per-sample ``jacrev`` in dist
+space — tractable at the HalfCheetah policy's ~5.7k params; this is the
+oracle a matrix-free estimator can at best recover), then measures:
+
+1. how well Hutchinson probes recover it (correlation / relative error),
+2. what a Jacobi preconditioner built from the ORACLE diagonal does to
+   the 10-iteration CG residual on the real late-training Fisher,
+   vs plain CG and vs Hutchinson-built preconditioners.
+
+Round-4 result on the step-800 HalfCheetah checkpoint
+(``ab_r04/ckpts/hc_lam097_const``): exact diag spans 833× (so diagonal
+spread exists), but oracle-Jacobi only improves rel-residual 1.29 → 0.81
+— the dominant late-training pathology is OFF-diagonal — and Hutchinson
+at 8/64 probes (corr 0.32/0.62, median rel err 452%/170%) recovers none
+of it. The effective lever is the iteration budget: plain CG at 18 iters
+reaches 0.45. Hence ``cg_residual_rtol`` + ``cg_iters``-as-cap is the
+supported late-training mitigation, and ``cg_precondition`` is documented
+as a synthetic/diagonally-dominated-pathology tool.
+
+Usage::
+
+    python scripts/explore_fisher_diag.py \
+        --checkpoint-dir ab_r04/ckpts/hc_lam097_const --step 800
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--step", type=int, default=None)
+    p.add_argument("--preset", default="halfcheetah")
+    p.add_argument("--n-envs", type=int, default=25)
+    p.add_argument("--batch-timesteps", type=int, default=5000)
+    p.add_argument("--subsample", type=int, default=2000)
+    p.add_argument("--chunk", type=int, default=250)
+    p.add_argument("--damping", type=float, default=0.1)
+    p.add_argument("--platform", choices=("tpu", "cpu"), default="cpu")
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import get_preset
+    from trpo_tpu.ops import conjugate_gradient, flatten_params, make_ggn_fvp
+    from trpo_tpu.ops.precond import hutchinson_diag
+    from trpo_tpu.rollout import host_rollout
+    from trpo_tpu.trpo import (
+        TRPOBatch,
+        standardize_advantages,
+        surrogate_loss,
+    )
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    cfg = dataclasses.replace(
+        get_preset(args.preset),
+        n_envs=args.n_envs,
+        batch_timesteps=args.batch_timesteps,
+        normalize_obs=True,
+        host_inference="cpu",
+    )
+    agent = TRPOAgent(cfg.env, cfg)
+    ck = Checkpointer(args.checkpoint_dir)
+    step = args.step if args.step is not None else ck.latest_step()
+    state = ck.restore(agent.init_state(), step=step)
+    agent.restore_host_env(ck.restore_host_env(step))
+    print(f"restored step {step}", file=sys.stderr)
+
+    rng = jax.random.fold_in(state.rng, int(state.iteration))
+    agent.env.set_obs_stats_state(
+        tuple(np.asarray(x) for x in state.obs_norm)
+    )
+    act_fn = getattr(agent, "_host_act_fn", None) or agent._make_host_act()
+    cpu = agent._host_cpu_device
+    traj = host_rollout(
+        agent.env,
+        agent.policy,
+        jax.device_put(state.policy_params, cpu),
+        jax.device_put(rng, cpu),
+        agent.n_steps,
+        act_fn=act_fn,
+    )
+    T, N = traj.rewards.shape
+    flat_ = lambda x: x.reshape((T * N,) + x.shape[2:])
+    adv, _, _ = agent._advantages(state.vf_state, traj)
+    w = jnp.ones(T * N, jnp.float32)
+    batch = TRPOBatch(
+        flat_(traj.obs),
+        flat_(traj.actions),
+        standardize_advantages(flat_(adv), w),
+        jax.tree_util.tree_map(flat_, traj.old_dist),
+        w,
+    )
+    policy, params = agent.policy, state.policy_params
+    flat0, unravel = flatten_params(params)
+    flat0 = jnp.asarray(flat0, jnp.float32)
+    P = int(flat0.size)
+    print(f"P = {P}", file=sys.stderr)
+
+    damping = args.damping
+    fvp = make_ggn_fvp(
+        lambda x: policy.apply(unravel(x), batch.obs),
+        policy.dist.fisher_weight,
+        flat0,
+        batch.weight,
+        damping=damping,
+    )
+    b = -jax.grad(lambda x: surrogate_loss(policy, unravel(x), batch))(flat0)
+
+    # -- exact GGN diagonal on a strided subsample ------------------------
+    # M_k(n): the (diagonal) dist-space KL Hessian weights, extracted by
+    # feeding all-ones tangents through fisher_weight (linear in d).
+    dist0 = policy.apply(params, batch.obs)
+    M = policy.dist.fisher_weight(
+        dist0, jax.tree_util.tree_map(jnp.ones_like, dist0)
+    )
+    M_leaves = jax.tree_util.tree_leaves(M)
+    wn = batch.weight / jnp.sum(batch.weight)
+
+    @jax.jit
+    def chunk_diag(x, obs_c, M_c, w_c):
+        def per_sample(obs_n, M_n, w_n):
+            jacs = jax.jacrev(
+                lambda xx: jax.tree_util.tree_leaves(
+                    policy.apply(unravel(xx), obs_n[None])
+                )
+            )(x)
+            tot = jnp.zeros_like(x)
+            for j, m in zip(jacs, M_n):
+                tot = tot + jnp.sum(
+                    m.reshape(-1, 1) * j.reshape(-1, x.size) ** 2, axis=0
+                )
+            return w_n * tot
+
+        return jnp.sum(jax.vmap(per_sample)(obs_c, M_c, w_c), axis=0)
+
+    SUB = min(args.subsample, T * N)
+    idx = np.arange(0, T * N, (T * N) // SUB)[:SUB]
+    obs_s = batch.obs[idx]
+    w_s = wn[idx] * (T * N) / SUB      # rescale: subsample ≈ full batch
+    M_s = [l[idx] for l in M_leaves]
+    diag = jnp.zeros(P)
+    for i in range(0, SUB, args.chunk):
+        diag = diag + chunk_diag(
+            flat0,
+            obs_s[i: i + args.chunk],
+            [l[i: i + args.chunk] for l in M_s],
+            w_s[i: i + args.chunk],
+        )
+    diag = diag + damping
+    d = np.asarray(diag)
+    out = {
+        "step": int(step),
+        "n_params": P,
+        "diag_min": float(d.min()),
+        "diag_max": float(d.max()),
+        "diag_spread": float(d.max() / d.min()),
+        "rows": [],
+    }
+    print(
+        f"exact diag: min {d.min():.3g} max {d.max():.3g} "
+        f"spread {d.max() / d.min():.3g}x",
+        file=sys.stderr,
+    )
+
+    probes = {
+        "hutch8": hutchinson_diag(fvp, b, 8, jax.random.key(0)),
+        "hutch64": hutchinson_diag(fvp, b, 64, jax.random.key(0)),
+    }
+    for name, h in probes.items():
+        ha = np.asarray(h)
+        corr = float(np.corrcoef(ha, d)[0, 1])
+        rel = float(np.median(np.abs(ha - d) / d))
+        out[f"{name}_corr"] = corr
+        out[f"{name}_median_rel_err"] = rel
+        print(f"{name}: corr {corr:.4f} median rel err {rel:.3f}",
+              file=sys.stderr)
+
+    cases = [
+        ("plain", None),
+        ("jacobi_oracle_diag", 1.0 / diag),
+        ("jacobi_hutch8", 1.0 / jnp.maximum(probes["hutch8"], damping)),
+        ("jacobi_hutch64", 1.0 / jnp.maximum(probes["hutch64"], damping)),
+    ]
+    for name, m_inv in cases:
+        res = conjugate_gradient(
+            fvp, b, cg_iters=cfg.cg_iters, residual_tol=0.0, M_inv=m_inv
+        )
+        rel = float(jnp.sqrt(res.residual_norm_sq / jnp.vdot(b, b)))
+        out["rows"].append({"config": name, "rel_residual": rel})
+        print(f"{name}: rel_residual {rel:.4f}", file=sys.stderr)
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

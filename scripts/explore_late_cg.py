@@ -29,10 +29,13 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:
@@ -130,7 +133,7 @@ def main() -> int:
     flat0 = jnp.asarray(flat0, jnp.float32)
     dampings = [float(s) for s in args.dampings.split(",") if s.strip()]
 
-    def make_case(damping, iters, probes):
+    def make_case(damping, iters, probes, rtol=0.0):
         @jax.jit
         def run(flat0, batch):
             surr = lambda x: surrogate_loss(policy, unravel(x), batch)
@@ -149,7 +152,8 @@ def main() -> int:
                     fvp, neg_g, probes, jax.random.key(0), floor=damping
                 )
             cg = conjugate_gradient(
-                fvp, neg_g, cg_iters=iters, residual_tol=0.0, M_inv=M_inv
+                fvp, neg_g, cg_iters=iters, residual_tol=0.0, M_inv=M_inv,
+                residual_rtol=rtol,
             )
             shs = 0.5 * jnp.vdot(cg.x, fvp(cg.x))
             lm = jnp.sqrt(jnp.maximum(shs, 1e-12) / cfg.max_kl)
@@ -165,6 +169,7 @@ def main() -> int:
                 policy.dist.kl(batch.old_dist, dist_new) * batch.weight
             ) / jnp.sum(batch.weight)
             return {
+                "cg_iterations_used": cg.iterations,
                 "residual_sq": cg.residual_norm_sq,
                 "rel_residual": jnp.sqrt(
                     cg.residual_norm_sq / jnp.vdot(neg_g, neg_g)
@@ -181,13 +186,18 @@ def main() -> int:
 
     rows = []
     for damping in dampings:
-        for label, iters, probes in (
-            ("plain_10", cfg.cg_iters, 0),
+        for label, iters, probes, rtol in (
+            ("plain_10", cfg.cg_iters, 0, 0.0),
             (f"plain_{cfg.cg_iters + args.probes}_budget_matched",
-             cfg.cg_iters + args.probes, 0),
-            (f"jacobi_p{args.probes}_10", cfg.cg_iters, args.probes),
+             cfg.cg_iters + args.probes, 0, 0.0),
+            (f"jacobi_p{args.probes}_10", cfg.cg_iters, args.probes, 0.0),
+            # the residual-aware policy: cg_iters becomes a cap, the exit
+            # targets ‖r‖ ≤ rtol·‖g‖ — early-training solves exit in a few
+            # iterations, late-training solves spend what conditioning needs
+            ("plain_cap30_rtol0.5", 3 * cfg.cg_iters, 0, 0.5),
+            ("plain_cap60_rtol0.25", 6 * cfg.cg_iters, 0, 0.25),
         ):
-            run = make_case(damping, iters, probes)
+            run = make_case(damping, iters, probes, rtol)
             out = run(flat0, batch)           # compile + warm
             jax.block_until_ready(out)
             t0 = time.perf_counter()
@@ -197,18 +207,23 @@ def main() -> int:
             row = {
                 "config": label,
                 "damping": damping,
-                "cg_iters": iters,
+                "cg_iters_cap": iters,
+                "residual_rtol": rtol,
                 "precond_probes": probes,
-                "total_fvp_evals": iters + probes + 1,  # +1: the shs FVP
                 "wall_ms": round(wall_ms, 2),
                 **{
                     k: (bool(v) if k == "ls_success" else float(v))
                     for k, v in out.items()
                 },
             }
+            # +1: the step-scaling shs FVP
+            row["total_fvp_evals"] = (
+                int(row["cg_iterations_used"]) + probes + 1
+            )
             rows.append(row)
             print(
                 f"damping {damping:<6} {label:<28} "
+                f"iters {int(row['cg_iterations_used']):>2} "
                 f"rel_residual {row['rel_residual']:.3e} "
                 f"kl {row['kl']:.4f} "
                 f"surr {row['surr_before']:.4f}→{row['surr_after']:.4f} "

@@ -55,12 +55,17 @@ class TRPOConfig:
     #                                cg_iters a cap ("until solved, at most
     #                                N") instead of a fixed count. 0 = off
     #                                (reference semantics)
-    cg_precondition: bool = False  # diagonal (Jacobi) preconditioned CG:
-    #                                counteracts the per-coordinate Fisher
-    #                                scale spread of a sharpened policy
-    #                                (late-training residual growth — see
-    #                                ops/precond.py). Costs cg_precond_probes
-    #                                extra FVPs per update
+    cg_precondition: bool = False  # diagonal (Jacobi) preconditioned CG
+    #                                (ops/precond.py). Effective when the
+    #                                Fisher's pathology is diagonal-scale
+    #                                (collapses a 6-orders synthetic spread
+    #                                to 1 iteration). MEASURED INEFFECTIVE
+    #                                on the real late-training Fisher,
+    #                                whose ill-conditioning is mostly
+    #                                off-diagonal — see BENCH_LADDER
+    #                                "Late-training solver study"; prefer
+    #                                cg_residual_rtol there. Costs
+    #                                cg_precond_probes extra FVPs/update
     cg_precond_probes: int = 8     # Hutchinson probes for the diagonal
     #                                estimate (±1 vectors; K probes ≈
     #                                1/√K off-diagonal noise)
