@@ -1,0 +1,122 @@
+"""Population-training evidence row (VERDICT r3 item 7).
+
+An 8-member humanoid-sim population — eight independent seeds of the
+flagship-shaped rung (376-obs/17-act, 256×256 policy, batch 50k PER
+MEMBER) trained in lockstep as one vmapped device program
+(`trpo_tpu.population.Population`) — measured for BENCH_LADDER:
+member-updates/s, env-steps/s across the population, and the final
+reward spread across seeds (the quantity seed-replication exists to
+report; the reference trains one seed in one process,
+``trpo_inksci.py:179-181``).
+
+Timing uses the fused ``run_iterations`` chunk (one host sync per chunk,
+same RTT discipline as bench.py). Warmup chunk excluded; steady-state
+chunk timed.
+
+Usage (TPU; single-tenant — nothing else may hold the chip)::
+
+    python scripts/population_row_r04.py --out scripts/population_r04.json
+    python scripts/population_row_r04.py --preset cartpole --members 4 \
+        --iters 5 --platform cpu       # smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="humanoid-sim")
+    p.add_argument("--members", type=int, default=8)
+    p.add_argument("--iters", type=int, default=40, help="timed chunk size")
+    p.add_argument("--chunks", type=int, default=3,
+                   help="timed chunks (min reported, all listed)")
+    p.add_argument("--platform", choices=("tpu", "cpu"), default=None)
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import get_preset
+    from trpo_tpu.population import Population
+
+    cfg = get_preset(args.preset)
+    agent = TRPOAgent(cfg.env, cfg)
+    seeds = list(range(args.members))
+    t0 = time.perf_counter()
+    pop = Population(agent, seeds=seeds)
+    print(f"[{time.perf_counter()-t0:6.1f}s] population built "
+          f"({args.members} members, batch {cfg.batch_timesteps}/member)",
+          file=sys.stderr)
+
+    # compile + warm one chunk (also moves members off the cold-start
+    # policy so the timed chunk is steady-state training)
+    stats = pop.run_iterations(args.iters)
+    jax.block_until_ready(pop.state.policy_params)
+    print(f"[{time.perf_counter()-t0:6.1f}s] compiled + warm chunk done",
+          file=sys.stderr)
+
+    runs = []
+    for _ in range(args.chunks):
+        t1 = time.perf_counter()
+        stats = pop.run_iterations(args.iters)
+        jax.block_until_ready(pop.state.policy_params)
+        runs.append(time.perf_counter() - t1)
+    best = min(runs)
+    iters_per_s = args.iters / best
+    member_updates_per_s = iters_per_s * args.members
+    steps_per_iter = cfg.batch_timesteps * args.members
+    env_steps_per_s = iters_per_s * steps_per_iter
+
+    # reward spread across seeds at the end of the run (last iteration
+    # with any finished episode per member)
+    r = np.asarray(stats["mean_episode_reward"])  # (members, iters)
+    finals = []
+    for m in range(args.members):
+        vals = [v for v in r[m] if not math.isnan(v)]
+        finals.append(vals[-1] if vals else float("nan"))
+    finals = np.asarray(finals)
+    total_iters = int(np.asarray(pop.state.iteration)[0])
+
+    dev = jax.devices()[0]
+    out = {
+        "metric": f"population_{args.preset}_{args.members}x",
+        "members": args.members,
+        "batch_per_member": cfg.batch_timesteps,
+        "iters_timed": args.iters,
+        "population_iters_per_sec": round(iters_per_s, 3),
+        "member_updates_per_sec": round(member_updates_per_s, 2),
+        "env_steps_per_sec": round(env_steps_per_s, 0),
+        "chunk_runs_s": [round(x, 3) for x in runs],
+        "total_iterations_run": total_iters,
+        "final_rewards_per_seed": [round(float(x), 1) for x in finals],
+        "reward_mean": round(float(np.nanmean(finals)), 1),
+        "reward_min": round(float(np.nanmin(finals)), 1),
+        "reward_max": round(float(np.nanmax(finals)), 1),
+        "reward_std": round(float(np.nanstd(finals)), 1),
+        "backend": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+    }
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

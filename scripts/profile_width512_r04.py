@@ -1,0 +1,170 @@
+"""Root-cause the 512-wide MFU dip (VERDICT r3 item 5).
+
+The width study (BENCH_LADDER) shows the fused GGN solve at 54.7–69.5% /
+56.7–57.5% / 62.2% MFU for hidden 256/512/1024 — the 512 point dips
+below both neighbours, and round 3 attributed it to "tiling shape"
+without evidence. This script isolates the evidence two ways:
+
+1. **Per-orientation matmul microbench**: one CG iteration's FLOPs are
+   ~3 forward-equivalents per layer — the forward/tangent pass
+   (``x @ W``), the activation-gradient pass (``δ @ Wᵀ``), and the
+   weight-gradient pass (``xᵀ @ δ``, contracting the 50k batch). Each
+   orientation × width is timed standalone (chained-dependent, bf16,
+   RTT-corrected) and reported as achieved TFLOP/s — whichever
+   orientation sinks at 512 is the dip.
+2. Optionally (``--trace-dir``) a ``jax.profiler`` trace of the full
+   512 fused solve for TensorBoard/Perfetto inspection.
+
+TPU only (single-tenant chip — run nothing else concurrently).
+Results land in BENCH_LADDER's round-4 width note.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BATCH = int(os.environ.get("W512_BATCH", 50_000))   # shrink for smoke runs
+OBS, ACT = 376, 17
+CHAIN = int(os.environ.get("W512_CHAIN", 60))
+REPS = 5
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--widths", default="256,512,1024")
+    p.add_argument("--trace-dir", default=None,
+                   help="also write a jax.profiler trace of the fused "
+                   "512 solve here")
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"backend {dev.platform} ({getattr(dev, 'device_kind', '')})",
+          file=sys.stderr)
+
+    def rtt():
+        x = jnp.zeros(())
+        for _ in range(2):
+            np.asarray(x + 1)
+        t0 = time.perf_counter()
+        n = 5
+        for _ in range(n):
+            np.asarray(x + 1)
+        return (time.perf_counter() - t0) / n
+
+    def time_matmul(m, k, n, transpose):
+        """Chained dependent bf16 matmuls of logical shape (m,k)@(k,n);
+        ``transpose`` picks the orientation: 'nn' x@W, 'nt' δ@Wᵀ,
+        'tn' xᵀ@δ (batch contraction)."""
+        key = jax.random.key(0)
+        if transpose == "nn":
+            a = jax.random.normal(key, (m, k), jnp.bfloat16)
+            b = jax.random.normal(key, (k, n), jnp.bfloat16)
+            f = lambda a, b: a @ b
+            out_like = (m, n)
+        elif transpose == "nt":
+            a = jax.random.normal(key, (m, n), jnp.bfloat16)
+            b = jax.random.normal(key, (k, n), jnp.bfloat16)
+            f = lambda a, b: a @ b.T
+            out_like = (m, k)
+        else:  # "tn": contract the big batch dim
+            a = jax.random.normal(key, (m, k), jnp.bfloat16)
+            b = jax.random.normal(key, (m, n), jnp.bfloat16)
+            f = lambda a, b: a.T @ b
+            out_like = (k, n)
+
+        @jax.jit
+        def chained(a, b):
+            def body(carry, _):
+                out = f(a + carry[0, 0].astype(a.dtype) * 1e-8, b)
+                return out[:1, :1].astype(jnp.float32), ()
+
+            last, _ = jax.lax.scan(
+                body, jnp.zeros((1, 1), jnp.float32), None, length=CHAIN
+            )
+            return last.sum()
+
+        probe = chained(a, b)
+        np.asarray(probe)
+        r = rtt()
+        best = float("inf")
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            np.asarray(chained(a, b))
+            best = min(best, time.perf_counter() - t0)
+        per = max(best - r, 1e-9) / CHAIN
+        flops = 2.0 * m * k * n
+        del out_like
+        return per * 1e3, flops / per / 1e12
+
+    widths = [int(w) for w in args.widths.split(",") if w.strip()]
+    rows = []
+    for w in widths:
+        layer_shapes = [(OBS, w), (w, w), (w, ACT)]
+        for li, (k, n) in enumerate(layer_shapes):
+            for orient, desc in (
+                ("nn", "fwd/tangent x@W"),
+                ("nt", "dgrad d@W^T"),
+                ("tn", "wgrad x^T@d (batch contraction)"),
+            ):
+                ms, tf = time_matmul(BATCH, k, n, orient)
+                rows.append({
+                    "width": w, "layer": li, "k": k, "n": n,
+                    "orientation": orient, "desc": desc,
+                    "ms": round(ms, 4), "achieved_tflops": round(tf, 1),
+                })
+                print(f"w={w:<5} L{li} ({k:>4}x{n:<4}) {desc:<32} "
+                      f"{ms:7.3f} ms  {tf:6.1f} TF/s", file=sys.stderr)
+
+    if args.trace_dir:
+        from trpo_tpu.ops import conjugate_gradient, make_ggn_fvp
+        from trpo_tpu.models import BoxSpec, make_policy
+        from trpo_tpu.ops.flat import flatten_params
+
+        policy = make_policy((OBS,), BoxSpec(ACT), hidden=(512, 512),
+                             compute_dtype=jnp.bfloat16)
+        params = policy.init(jax.random.key(0))
+        flat0, unravel = flatten_params(params)
+        flat0 = jnp.asarray(flat0, jnp.float32)
+        obs = jax.random.normal(jax.random.key(1), (BATCH, OBS), jnp.bfloat16)
+        weight = jnp.ones(BATCH, jnp.float32)
+        g = jax.random.normal(jax.random.key(2), flat0.shape, jnp.float32)
+
+        @jax.jit
+        def solve(flat0, g):
+            fvp = make_ggn_fvp(
+                lambda x: policy.apply(unravel(x), obs),
+                policy.dist.fisher_weight, flat0, weight, 0.1,
+            )
+            return conjugate_gradient(fvp, -g, 10, residual_tol=0.0).x.sum()
+
+        np.asarray(solve(flat0, g))
+        with jax.profiler.trace(args.trace_dir):
+            for _ in range(5):
+                np.asarray(solve(flat0, g))
+        print(f"trace written to {args.trace_dir}", file=sys.stderr)
+
+    out = {"batch": BATCH, "rows": rows,
+           "backend": dev.platform,
+           "device_kind": getattr(dev, "device_kind", "")}
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
