@@ -1,0 +1,100 @@
+"""Summarize the round-4 HalfCheetah 2×2 A/B (GAE λ × adaptive damping).
+
+Reads the four per-iteration JSONL curves `scripts/ab_halfcheetah_r04.sh`
+produced and emits the BENCH_LADDER/README table: reward milestones at
+equal step budget, final/best reward, CG-residual growth, line-search
+acceptance, and the adaptive-damping trajectory where enabled.
+
+Usage::  python scripts/ab_summary_r04.py [--dir ab_r04] [--md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+
+RUNS = [
+    ("hc_lam097_const", "λ=0.97, damping 0.1 const (r03 flagship cfg)"),
+    ("hc_lam100_const", "λ=1.00, damping 0.1 const"),
+    ("hc_lam097_adapt", "λ=0.97, adaptive damping"),
+    ("hc_lam100_adapt", "λ=1.00, adaptive damping"),
+]
+MILESTONES = (100, 300, 500, 800)
+
+
+def load(path):
+    return [json.loads(l) for l in open(path)]
+
+
+def reward_at(rows, it):
+    """Last finite mean_episode_reward at or before iteration ``it``."""
+    best = float("nan")
+    for r in rows:
+        if r["iteration"] > it:
+            break
+        v = r["mean_episode_reward"]
+        if not math.isnan(v):
+            best = v
+    return best
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--dir", default="ab_r04")
+    p.add_argument("--md", action="store_true", help="markdown table")
+    args = p.parse_args()
+
+    out = []
+    for name, desc in RUNS:
+        path = os.path.join(args.dir, f"{name}.jsonl")
+        if not os.path.exists(path):
+            print(f"({name}: missing, skipped)")
+            continue
+        rows = load(path)
+        finite = [
+            r["mean_episode_reward"]
+            for r in rows
+            if not math.isnan(r["mean_episode_reward"])
+        ]
+        ls_fail = sum(1 for r in rows if not r["linesearch_success"])
+        rollbacks = sum(1 for r in rows if r["kl_rolled_back"])
+        summary = {
+            "run": name,
+            "desc": desc,
+            "iterations": rows[-1]["iteration"],
+            "milestones": {
+                str(m): round(reward_at(rows, m), 1) for m in MILESTONES
+            },
+            "final_reward": round(finite[-1], 1) if finite else None,
+            "best_reward": round(max(finite), 1) if finite else None,
+            "first_resid": rows[0]["cg_residual"],
+            "final_resid": round(rows[-1]["cg_residual"], 3),
+            "ls_failures": ls_fail,
+            "kl_rollbacks": rollbacks,
+            "damping_first": round(rows[0]["cg_damping"], 4),
+            "damping_final": round(rows[-1]["cg_damping"], 4),
+            "wall_min": round(rows[-1]["time_elapsed_min"], 1),
+        }
+        out.append(summary)
+
+    if args.md:
+        print("| config | @100 | @300 | @500 | final (800) | best | "
+              "final CG resid | λ_damp end | LS fails / rollbacks |")
+        print("|---|---|---|---|---|---|---|---|---|")
+        for s in out:
+            m = s["milestones"]
+            print(
+                f"| {s['desc']} | {m['100']} | {m['300']} | {m['500']} | "
+                f"**{s['final_reward']}** | {s['best_reward']} | "
+                f"{s['final_resid']} | {s['damping_final']} | "
+                f"{s['ls_failures']} / {s['kl_rollbacks']} |"
+            )
+    else:
+        print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
