@@ -78,12 +78,42 @@ def test_population_sharded_matches_unsharded():
     )
 
 
+def test_population_run_iterations_fused():
+    """The fused multi-iteration program (scan under the member vmap)
+    must match stepping one iteration at a time."""
+    pop_a = Population(_agent(), seeds=[2, 7])
+    pop_b = Population(_agent(), seeds=[2, 7])
+    stats_fused = pop_a.run_iterations(3)
+    assert stats_fused["entropy"].shape == (2, 3)
+    for _ in range(3):
+        stats_step = pop_b.run_iteration()
+    np.testing.assert_allclose(
+        np.asarray(stats_fused["entropy"][:, -1]),
+        np.asarray(stats_step["entropy"]),
+        rtol=1e-5, atol=1e-6,
+    )
+    f_a = jax.flatten_util.ravel_pytree(pop_a.member_state(1).policy_params)[0]
+    f_b = jax.flatten_util.ravel_pytree(pop_b.member_state(1).policy_params)[0]
+    np.testing.assert_allclose(
+        np.asarray(f_a), np.asarray(f_b), rtol=1e-4, atol=1e-5
+    )
+    with pytest.raises(ValueError, match=">= 1"):
+        pop_a.run_iterations(0)
+
+
 def test_population_best_member_ignores_nan():
     stats = {
         "mean_episode_reward": jnp.asarray([jnp.nan, 10.0, 5.0]),
     }
     pop = Population.__new__(Population)  # only best_member is exercised
     assert Population.best_member(pop, stats) == 1
+    # fused run_iterations stats: (member, n) — the LAST iteration decides
+    fused = {
+        "mean_episode_reward": jnp.asarray(
+            [[50.0, 1.0], [0.0, 30.0], [99.0, jnp.nan]]
+        ),
+    }
+    assert Population.best_member(pop, fused) == 1
 
 
 def test_population_validates_inputs():

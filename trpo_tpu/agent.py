@@ -715,14 +715,22 @@ class TRPOAgent:
             raise ValueError(f"n must be >= 1, got {n}")
         fn = self._multi_iter_fns.get(n)
         if fn is None:
-            def many(state):
-                # _device_iteration already has the (carry, _) scan-body
-                # signature
-                return jax.lax.scan(
-                    self._device_iteration, state, None, length=n
-                )
-            fn = self._multi_iter_fns[n] = jax.jit(many)
+            fn = self._multi_iter_fns[n] = jax.jit(self.make_scan_body(n))
         return fn(train_state)
+
+    def make_scan_body(self, n: int):
+        """``state -> (state, stats)`` running ``n`` fused iterations via
+        ``lax.scan`` — the shared chunk body behind :meth:`run_iterations`
+        and ``Population.run_iterations`` (which wraps it in the member
+        ``vmap``). ``_device_iteration`` already has the ``(carry, _)``
+        scan-body signature."""
+
+        def many(state):
+            return jax.lax.scan(
+                self._device_iteration, state, None, length=n
+            )
+
+        return many
 
     def run_iteration(self, train_state: TrainState):
         """One training iteration; returns ``(new_state, stats_pytree)``."""

@@ -71,6 +71,7 @@ class Population:
             state = shard_leading_axis(mesh, state, axis)
         self.state: TrainState = state
         self._step = jax.jit(jax.vmap(agent._device_iteration))
+        self._multi_fns = {}
 
     @property
     def size(self) -> int:
@@ -87,14 +88,35 @@ class Population:
         per-iteration stats pytrees (each with leading population axis)."""
         return [self.run_iteration() for _ in range(n_iterations)]
 
+    def run_iterations(self, n: int):
+        """``n`` iterations of the WHOLE population as one device program
+        (``lax.scan`` under the member ``vmap`` — the population analogue
+        of ``TRPOAgent.run_iterations``): one host sync per chunk instead
+        of one per iteration, which is what makes population throughput
+        measurable over a high-latency link. Returns the stats pytree
+        with leading axes ``(population, n)``."""
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        fn = self._multi_fns.get(n)
+        if fn is None:
+            fn = self._multi_fns[n] = jax.jit(
+                jax.vmap(self.agent.make_scan_body(n))
+            )
+        self.state, stats = fn(self.state)
+        return stats
+
     def member_state(self, i: int) -> TrainState:
         """Extract one member's TrainState (e.g. the selection winner)."""
         return jax.tree_util.tree_map(lambda x: x[i], self.state)
 
     def best_member(self, stats) -> int:
         """Index of the member with the highest mean episode reward in
-        ``stats`` (NaN — no finished episode — treated as worst)."""
-        r = jnp.nan_to_num(
-            jnp.asarray(stats["mean_episode_reward"]), nan=-jnp.inf
-        )
+        ``stats`` (NaN — no finished episode — treated as worst). Accepts
+        per-iteration stats (leading member axis) or a fused
+        ``run_iterations`` pytree (``(member, n)`` leaves — the last
+        iteration is compared)."""
+        r = jnp.asarray(stats["mean_episode_reward"])
+        if r.ndim > 1:
+            r = r[:, -1]
+        r = jnp.nan_to_num(r, nan=-jnp.inf)
         return int(jnp.argmax(r))
