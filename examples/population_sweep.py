@@ -64,13 +64,10 @@ def main() -> int:
         )
     dt = time.perf_counter() - t0
     total = args.chunks * args.iters_per_chunk
-    last_iter_stats = {
-        "mean_episode_reward": np.asarray(finals)  # last finite per member
-    }
     print(
         f"{args.members} seeds x {total} iterations in {dt:.1f}s "
         f"({args.members * total / dt:.1f} member-updates/s); "
-        f"best member: seed {pop.best_member(last_iter_stats)}"
+        f"best member: seed {pop.best_member(stats)}"
     )
     return 0
 

@@ -31,4 +31,9 @@ run hc_lam097_const --lam 0.97
 run hc_lam100_const --lam 1.0
 run hc_lam097_adapt --lam 0.97 --adaptive-damping
 run hc_lam100_adapt --lam 1.0 --adaptive-damping
+# Fifth arm: the residual-aware solve (VERDICT r3 item 2) in REAL
+# training, not checkpoint replay — same lam-0.97/const-damping base so
+# it reads directly against arm 1; per-iteration cg_iterations +
+# cg_residual land in the JSONL.
+run hc_lam097_rtol --lam 0.97 --cg-residual-rtol 0.25 --cg-iters 60
 echo "ALL DONE $(date -u +%H:%M:%S)"

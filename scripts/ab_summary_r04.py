@@ -20,6 +20,7 @@ RUNS = [
     ("hc_lam100_const", "λ=1.00, damping 0.1 const"),
     ("hc_lam097_adapt", "λ=0.97, adaptive damping"),
     ("hc_lam100_adapt", "λ=1.00, adaptive damping"),
+    ("hc_lam097_rtol", "λ=0.97, const damping, rtol 0.25 / cap 60"),
 ]
 MILESTONES = (100, 300, 500, 800)
 
@@ -71,6 +72,13 @@ def main() -> int:
             "best_reward": round(max(finite), 1) if finite else None,
             "first_resid": rows[0]["cg_residual"],
             "final_resid": round(rows[-1]["cg_residual"], 3),
+            "cg_iters_mean": round(
+                sum(r["cg_iterations"] for r in rows) / len(rows), 1
+            ),
+            "cg_iters_last100": round(
+                sum(r["cg_iterations"] for r in rows[-100:])
+                / len(rows[-100:]), 1
+            ),
             "ls_failures": ls_fail,
             "kl_rollbacks": rollbacks,
             "damping_first": round(rows[0]["cg_damping"], 4),

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-4 chip-evidence batch (run AFTER scripts/ab_halfcheetah_r04.sh
+# releases the chip — one TPU process at a time, single-tenant timing).
+#
+# 1. humanoid-sim solver pair: fixed-10 CG vs residual-aware
+#    (rtol 0.25 / cap 60) at the flagship on-device shape (batch 50k,
+#    256x256), 2000 fused iterations each — the real-training
+#    companion to the checkpoint-replay study in BENCH_LADDER
+#    (VERDICT r3 item 2: "show it on a re-run segment").
+# 2. population seed-sweep row (VERDICT r3 item 7).
+# 3. width-512 MFU-dip microbench (VERDICT r3 item 5).
+# 4. fresh variance-aware local bench -> BENCH_LOCAL_r04.json
+#    (VERDICT r3 item 1 — the artifact the docs cite alongside the
+#    driver's BENCH_r04.json).
+set -u
+cd /root/repo
+OUT=chip_r04
+mkdir -p "$OUT"
+
+echo "=== humanoid-sim fixed-10 $(date -u +%H:%M:%S) ==="
+python -m trpo_tpu.train --preset humanoid-sim --iterations 2000 \
+  --fuse-iterations 50 --seed 0 \
+  --log-jsonl "$OUT/hsim_fixed10.jsonl" > "$OUT/hsim_fixed10.out" 2>&1
+echo "rc=$?"
+
+echo "=== humanoid-sim rtol 0.25 / cap 60 $(date -u +%H:%M:%S) ==="
+python -m trpo_tpu.train --preset humanoid-sim --iterations 2000 \
+  --fuse-iterations 50 --seed 0 \
+  --cg-residual-rtol 0.25 --cg-iters 60 \
+  --log-jsonl "$OUT/hsim_rtol.jsonl" > "$OUT/hsim_rtol.out" 2>&1
+echo "rc=$?"
+
+echo "=== population row $(date -u +%H:%M:%S) ==="
+python scripts/population_row_r04.py --out scripts/population_r04.json \
+  > "$OUT/population.out" 2>&1
+echo "rc=$?"
+
+echo "=== width-512 microbench $(date -u +%H:%M:%S) ==="
+python scripts/profile_width512_r04.py --out scripts/width512_r04.json \
+  > "$OUT/width512.out" 2>&1
+echo "rc=$?"
+
+echo "=== local bench $(date -u +%H:%M:%S) ==="
+python bench.py > BENCH_LOCAL_r04.json 2> BENCH_LOCAL_r04.log
+echo "rc=$?"
+echo "ALL DONE $(date -u +%H:%M:%S)"

@@ -1,0 +1,89 @@
+"""Summarize the on-chip humanoid-sim solver pair (fixed-10 vs
+residual-aware CG) produced by ``scripts/chip_evidence_r04.sh``.
+
+The checkpoint-replay study (BENCH_LADDER "Late-training solver study")
+measured the levers against ONE late-training Fisher; this pair is the
+real-training companion at the flagship on-device shape (batch 50k,
+256×256): 2000 iterations each, same seed, differing only in the solver
+exit rule. Reports the residual trajectory, the CG-iteration spend, the
+reward curve, and wall-clock so the "bounded residual at proportionate
+cost" claim carries its own numbers.
+
+Usage::  python scripts/hsim_solver_summary_r04.py [--dir chip_r04] [--md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+
+RUNS = [
+    ("hsim_fixed10", "fixed 10 iters (reference semantics)"),
+    ("hsim_rtol", "rtol 0.25, cap 60"),
+]
+WINDOWS = ((1, 100), (901, 1000), (1901, 2000))
+
+
+def load(path):
+    return [json.loads(l) for l in open(path)]
+
+
+def window(rows, lo, hi, key):
+    vals = [r[key] for r in rows if lo <= r["iteration"] <= hi
+            and not (isinstance(r[key], float) and math.isnan(r[key]))]
+    return sum(vals) / len(vals) if vals else float("nan")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--dir", default="chip_r04")
+    p.add_argument("--md", action="store_true")
+    args = p.parse_args()
+
+    out = []
+    for name, desc in RUNS:
+        path = os.path.join(args.dir, f"{name}.jsonl")
+        if not os.path.exists(path):
+            print(f"({name}: missing, skipped)")
+            continue
+        rows = load(path)
+        s = {"run": name, "desc": desc, "iterations": rows[-1]["iteration"],
+             "wall_min": round(rows[-1]["time_elapsed_min"], 2)}
+        for lo, hi in WINDOWS:
+            tag = f"{lo}-{hi}"
+            s[f"resid@{tag}"] = round(window(rows, lo, hi, "cg_residual"), 4)
+            s[f"cgiters@{tag}"] = round(
+                window(rows, lo, hi, "cg_iterations"), 1)
+            s[f"reward@{tag}"] = round(
+                window(rows, lo, hi, "mean_episode_reward"), 1)
+        s["ls_failures"] = sum(
+            1 for r in rows if not r["linesearch_success"])
+        s["kl_rollbacks"] = sum(1 for r in rows if r["kl_rolled_back"])
+        out.append(s)
+
+    if args.md:
+        print("| solver | resid @1-100 / @901-1000 / @1901-2000 | "
+              "CG iters (same windows) | reward (same windows) | "
+              "wall | LS fails / rollbacks |")
+        print("|---|---|---|---|---|---|")
+        for s in out:
+            print(
+                f"| {s['desc']} "
+                f"| {s['resid@1-100']} / {s['resid@901-1000']} / "
+                f"{s['resid@1901-2000']} "
+                f"| {s['cgiters@1-100']} / {s['cgiters@901-1000']} / "
+                f"{s['cgiters@1901-2000']} "
+                f"| {s['reward@1-100']} / {s['reward@901-1000']} / "
+                f"{s['reward@1901-2000']} "
+                f"| {s['wall_min']} min "
+                f"| {s['ls_failures']} / {s['kl_rollbacks']} |"
+            )
+    else:
+        print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -107,13 +107,22 @@ def test_population_best_member_ignores_nan():
     }
     pop = Population.__new__(Population)  # only best_member is exercised
     assert Population.best_member(pop, stats) == 1
-    # fused run_iterations stats: (member, n) — the LAST iteration decides
+    # fused run_iterations stats: (member, n) — each member scored by its
+    # LAST FINITE reward (a trailing no-episodes-finished NaN says nothing
+    # about quality and must not disqualify the member)
     fused = {
         "mean_episode_reward": jnp.asarray(
             [[50.0, 1.0], [0.0, 30.0], [99.0, jnp.nan]]
         ),
     }
-    assert Population.best_member(pop, fused) == 1
+    assert Population.best_member(pop, fused) == 2
+    # a member with NO finite entry is worst, never the argmax-0 default
+    all_nan = {
+        "mean_episode_reward": jnp.asarray(
+            [[jnp.nan, jnp.nan], [jnp.nan, 2.0]]
+        ),
+    }
+    assert Population.best_member(pop, all_nan) == 1
 
 
 def test_population_validates_inputs():

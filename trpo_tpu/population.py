@@ -113,10 +113,25 @@ class Population:
         """Index of the member with the highest mean episode reward in
         ``stats`` (NaN — no finished episode — treated as worst). Accepts
         per-iteration stats (leading member axis) or a fused
-        ``run_iterations`` pytree (``(member, n)`` leaves — the last
-        iteration is compared)."""
+        ``run_iterations`` pytree (``(member, n)`` leaves — each member
+        is scored by its LAST FINITE reward in the chunk, since an
+        iteration in which none of a member's episodes finished logs
+        NaN and says nothing about quality)."""
         r = jnp.asarray(stats["mean_episode_reward"])
         if r.ndim > 1:
-            r = r[:, -1]
+            # last finite entry per member: index of the rightmost
+            # non-NaN column, or -inf if the member never finished one
+            finite = ~jnp.isnan(r)
+            idx = jnp.where(
+                finite, jnp.arange(r.shape[1])[None, :], -1
+            ).max(axis=1)
+            r = jnp.where(
+                idx >= 0,
+                jnp.take_along_axis(
+                    jnp.nan_to_num(r, nan=-jnp.inf),
+                    jnp.maximum(idx, 0)[:, None], axis=1
+                )[:, 0],
+                -jnp.inf,
+            )
         r = jnp.nan_to_num(r, nan=-jnp.inf)
         return int(jnp.argmax(r))
