@@ -54,6 +54,9 @@ def main() -> int:
             print(f"({name}: missing, skipped)")
             continue
         rows = load(path)
+        if not rows:
+            print(f"({name}: empty so far, skipped)")
+            continue
         finite = [
             r["mean_episode_reward"]
             for r in rows

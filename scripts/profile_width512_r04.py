@@ -34,8 +34,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 BATCH = int(os.environ.get("W512_BATCH", 50_000))   # shrink for smoke runs
 OBS, ACT = 376, 17
-CHAIN = int(os.environ.get("W512_CHAIN", 60))
+CHAIN = int(os.environ.get("W512_CHAIN", 60))   # calibration chain length
 REPS = 5
+TARGET_S = float(os.environ.get("W512_TARGET_S", 0.6))  # timed-chain device s
 
 
 def main() -> int:
@@ -45,9 +46,15 @@ def main() -> int:
                    help="also write a jax.profiler trace of the fused "
                    "512 solve here")
     p.add_argument("--out", default=None)
+    p.add_argument("--platform", choices=("tpu", "cpu"), default=None,
+                   help="force a jax platform (use cpu for smoke runs — "
+                   "the box default is the single-tenant TPU)")
     args = p.parse_args()
 
     import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
 
     dev = jax.devices()[0]
@@ -85,26 +92,47 @@ def main() -> int:
             f = lambda a, b: a.T @ b
             out_like = (k, n)
 
-        @jax.jit
-        def chained(a, b):
-            def body(carry, _):
-                out = f(a + carry[0, 0].astype(a.dtype) * 1e-8, b)
-                return out[:1, :1].astype(jnp.float32), ()
+        def make_chained(length):
+            @jax.jit
+            def chained(a, b):
+                # The carry must consume the FULL output: a corner slice
+                # lets XLA slice-propagate through the dot and dead-code-
+                # eliminate the matmul (measured: 0.000 ms rows). A full-
+                # output sum is ~1/n of the matmul's FLOPs — negligible,
+                # un-DCE-able.
+                def body(carry, _):
+                    out = f(a + (carry * 1e-12).astype(a.dtype), b)
+                    return out.sum().astype(jnp.float32), ()
 
-            last, _ = jax.lax.scan(
-                body, jnp.zeros((1, 1), jnp.float32), None, length=CHAIN
-            )
-            return last.sum()
+                last, _ = jax.lax.scan(
+                    body, jnp.zeros((), jnp.float32), None, length=length
+                )
+                return last
+            return chained
 
-        probe = chained(a, b)
-        np.asarray(probe)
+        chained = make_chained(CHAIN)
+
+        def best_of(fn, reps):
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                np.asarray(fn(a, b))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        # Two-phase timing: the tunnel RTT (~100 ms) dwarfs a short chain
+        # of sub-ms matmuls, so `best - rtt` on a fixed chain is noise
+        # (measured: 0.000 ms and over-peak rows). Calibrate with a short
+        # chain, then size the chain so device time >= TARGET_S and the
+        # RTT correction is a few % at most.
         r = rtt()
-        best = float("inf")
-        for _ in range(REPS):
-            t0 = time.perf_counter()
-            np.asarray(chained(a, b))
-            best = min(best, time.perf_counter() - t0)
-        per = max(best - r, 1e-9) / CHAIN
+        np.asarray(chained(a, b))           # compile
+        per_est = max(best_of(chained, 2) - r, 1e-7) / CHAIN
+        length = int(min(max(TARGET_S / per_est, CHAIN), 200_000))
+        timed = make_chained(length)
+        np.asarray(timed(a, b))             # compile
+        best = best_of(timed, REPS)
+        per = max(best - r, 1e-9) / length
         flops = 2.0 * m * k * n
         del out_like
         return per * 1e3, flops / per / 1e12
