@@ -1,0 +1,101 @@
+"""Compare the round-4 real-Humanoid re-run against the round-3 flagship.
+
+The two runs (`humanoid_r03.jsonl`, `humanoid_r04.jsonl`) share every
+setting and the seed; r04 changes ONLY the CG exit rule
+(``--cg-residual-rtol 0.25 --cg-iters 60`` vs the reference's fixed 10)
+— a single-variable at-scale test of the residual-aware solve on the
+run whose residual grew 2000× unmonitored in round 3 (VERDICT r3 item
+2). Comparison is per-iteration at equal iteration counts (both runs
+are host-bound, so CG spend barely moves wall-clock; reported anyway).
+
+Usage::  python scripts/humanoid_compare_r04.py [--md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+
+RUNS = [
+    ("humanoid_r03.jsonl", "fixed 10 (r03 flagship)"),
+    ("humanoid_r04.jsonl", "rtol 0.25, cap 60 (r04)"),
+]
+MILESTONES = (100, 600, 1000, 2000, 2400)
+
+
+def load(path):
+    return [json.loads(l) for l in open(path)]
+
+
+def reward_at(rows, it):
+    best = float("nan")
+    for r in rows:
+        if r["iteration"] > it:
+            break
+        v = r["mean_episode_reward"]
+        if not math.isnan(v):
+            best = v
+    return best
+
+
+def window_mean(rows, lo, hi, key):
+    vals = [r[key] for r in rows if lo <= r["iteration"] <= hi]
+    return sum(vals) / len(vals) if vals else float("nan")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--md", action="store_true")
+    args = p.parse_args()
+
+    out = []
+    for path, desc in RUNS:
+        rows = load(path)
+        n = rows[-1]["iteration"]
+        finite = [r["mean_episode_reward"] for r in rows
+                  if not math.isnan(r["mean_episode_reward"])]
+        lo, hi = max(1, n - 199), n
+        s = {
+            "run": path, "desc": desc, "iterations": n,
+            "milestones": {str(m): round(reward_at(rows, m), 0)
+                           for m in MILESTONES if m <= n},
+            "best": round(max(finite), 0),
+            "resid_first200": round(window_mean(rows, 1, 200,
+                                                "cg_residual"), 4),
+            "resid_last200": round(window_mean(rows, lo, hi,
+                                               "cg_residual"), 3),
+            "cg_first200": round(window_mean(rows, 1, 200,
+                                             "cg_iterations"), 1),
+            "cg_last200": round(window_mean(rows, lo, hi,
+                                            "cg_iterations"), 1),
+            "ls_failures": sum(1 for r in rows
+                               if not r["linesearch_success"]),
+            "kl_rollbacks": sum(1 for r in rows if r["kl_rolled_back"]),
+            "mean_kl": round(window_mean(rows, 1, n, "kl_old_new"), 5),
+            "wall_h": round(rows[-1]["time_elapsed_min"] / 60, 2),
+            "steps": rows[-1]["timesteps_total"],
+        }
+        out.append(s)
+
+    if args.md:
+        print("| run | reward @100/@600/@1000/@2000 | best | "
+              "resid first200/last200 | CG iters first200/last200 | "
+              "LS fails / rollbacks | wall |")
+        print("|---|---|---|---|---|---|---|")
+        for s in out:
+            m = s["milestones"]
+            mm = "/".join(str(m.get(str(k), "—"))
+                          for k in (100, 600, 1000, 2000))
+            print(f"| {s['desc']} | {mm} | {s['best']} "
+                  f"| {s['resid_first200']} / {s['resid_last200']} "
+                  f"| {s['cg_first200']} / {s['cg_last200']} "
+                  f"| {s['ls_failures']} / {s['kl_rollbacks']} "
+                  f"| {s['wall_h']} h |")
+    else:
+        print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
