@@ -127,7 +127,17 @@ def main() -> int:
         # RTT correction is a few % at most.
         r = rtt()
         np.asarray(chained(a, b))           # compile
-        per_est = max(best_of(chained, 2) - r, 1e-7) / CHAIN
+        # If the calibration chain comes in at or below the RTT (noise),
+        # retry with a longer chain instead of flooring per_est — the
+        # floor made the timed chain clamp to 200k steps (~10 s/rep).
+        cal_chain, cal_fn = CHAIN, chained
+        per_est = (best_of(cal_fn, 2) - r) / cal_chain
+        while per_est <= 0 and cal_chain < 64 * CHAIN:
+            cal_chain *= 4
+            cal_fn = make_chained(cal_chain)
+            np.asarray(cal_fn(a, b))        # compile
+            per_est = (best_of(cal_fn, 2) - r) / cal_chain
+        per_est = max(per_est, 1e-7)
         length = int(min(max(TARGET_S / per_est, CHAIN), 200_000))
         timed = make_chained(length)
         np.asarray(timed(a, b))             # compile
