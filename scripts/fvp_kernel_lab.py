@@ -1,0 +1,190 @@
+"""Lab for the fused Pallas FVP kernel (round 5, VERDICT item 1).
+
+Parity + per-CG-iteration timing of ``ops/fused_fvp`` against the XLA
+Gauss-Newton operator (``ops/fvp.make_ggn_fvp``) at the flagship
+Humanoid shape (376 -> 256 -> 256 -> 17, batch 50k, bf16 matmuls).
+
+Usage:  python scripts/fvp_kernel_lab.py [--block-rows 1024] [--chain 40]
+Writes: scripts/fvp_kernel_lab.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trpo_tpu.models import BoxSpec, make_policy
+from trpo_tpu.ops import conjugate_gradient, flatten_params, make_ggn_fvp
+from trpo_tpu.ops.fused_fvp import make_fused_gaussian_mlp_fvp
+
+OBS_DIM, ACT_DIM, HIDDEN = 376, 17, (256, 256)
+BATCH, CG_ITERS, DAMPING = 50_000, 10, 0.1
+
+
+def build(compute_dtype):
+    policy = make_policy(
+        (OBS_DIM,), BoxSpec(ACT_DIM), hidden=HIDDEN,
+        compute_dtype=compute_dtype,
+    )
+    params = policy.init(jax.random.key(0))
+    obs = jax.random.normal(jax.random.key(1), (BATCH, OBS_DIM), jnp.float32)
+    flat0, unravel = flatten_params(params)
+    flat0 = jnp.asarray(flat0, jnp.float32)
+    weight = jnp.ones((BATCH,), jnp.float32)
+    return policy, params, obs, flat0, unravel, weight
+
+
+def flat_ggn_fvp(policy, obs, flat0, unravel, weight):
+    def apply_fn(flat):
+        return policy.apply(unravel(flat), obs)
+
+    return make_ggn_fvp(
+        apply_fn, policy.dist.fisher_weight, flat0, weight, damping=DAMPING
+    )
+
+
+def flat_fused_fvp(params, obs, weight, unravel, block_rows, activation="tanh",
+                   compute_dtype=jnp.bfloat16):
+    tree_fvp = make_fused_gaussian_mlp_fvp(
+        params["net"], obs, weight, params["log_std"], DAMPING,
+        activation=activation, compute_dtype=compute_dtype,
+        block_rows=block_rows,
+    )
+
+    def fvp(v_flat):
+        out = tree_fvp(unravel(v_flat))
+        return flatten_params(out)[0]
+
+    return fvp
+
+
+def rtt():
+    trip = jax.jit(lambda c: c + 1.0)
+    np.asarray(trip(jnp.float32(0)))
+    s = []
+    for i in range(5):
+        t0 = time.perf_counter()
+        np.asarray(trip(jnp.float32(i)))
+        s.append(time.perf_counter() - t0)
+    return sorted(s)[2]
+
+
+def time_cg(make_fvp_closure, flat0, g, obs, chain, reps=5):
+    """Per-CG-iteration ms via a chained-scan CG timing (bench protocol)."""
+    noise = jax.random.normal(jax.random.key(7), (chain, g.shape[0]), jnp.float32)
+    G = g[None, :] + 1e-6 * noise
+
+    @jax.jit
+    def chained(flat0, G, obs):
+        fvp = make_fvp_closure(flat0, obs)
+
+        def body(carry, g_i):
+            rhs = -(g_i + jnp.float32(1e-30) * carry[0])
+            x = conjugate_gradient(fvp, rhs, CG_ITERS, residual_tol=0.0).x
+            return x, ()
+
+        x_last, _ = jax.lax.scan(body, jnp.zeros_like(flat0), G)
+        return x_last, x_last.sum()
+
+    x, probe = chained(flat0, G, obs)
+    np.asarray(probe)
+    r = rtt()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        x, probe = chained(flat0, G, obs)
+        np.asarray(probe)
+        best = min(best, time.perf_counter() - t0)
+    x_last = np.asarray(x)
+    per_iter_ms = max(best - r, 1e-9) / chain / CG_ITERS * 1e3
+    return per_iter_ms, x_last
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--block-rows", type=int, default=1024)
+    ap.add_argument("--chain", type=int, default=40)
+    ap.add_argument("--skip-timing", action="store_true")
+    args = ap.parse_args()
+
+    out = {"backend": jax.default_backend(),
+           "device_kind": jax.devices()[0].device_kind,
+           "block_rows": args.block_rows}
+
+    # ---- parity ----------------------------------------------------
+    policy, params, obs, flat0, unravel, weight = build(jnp.bfloat16)
+    g = jax.random.normal(jax.random.key(2), flat0.shape, jnp.float32)
+    g = g / jnp.linalg.norm(g)
+
+    # obs is a jit ARGUMENT everywhere (a closed-over obs becomes a
+    # 75 MB program constant — the tunnel's compile upload rejects it)
+    ggn = jax.jit(
+        lambda v, o: flat_ggn_fvp(policy, o, flat0, unravel, weight)(v)
+    )
+    fused = jax.jit(
+        lambda v, o: flat_fused_fvp(
+            params, o, weight, unravel, args.block_rows
+        )(v)
+    )
+    # f32 reference (exact-math yardstick)
+    pol32, params32, _, flat32, unravel32, _ = build(jnp.float32)
+    ggn32 = jax.jit(
+        lambda v, o: flat_ggn_fvp(pol32, o, flat32, unravel32, weight)(v)
+    )
+
+    y_ggn = np.asarray(ggn(g, obs), np.float64)
+    y_fused = np.asarray(fused(g, obs), np.float64)
+    y_ref = np.asarray(ggn32(g, obs), np.float64)
+
+    def rel(a, b):
+        return float(np.linalg.norm(a - b) / np.linalg.norm(b))
+
+    def cos(a, b):
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+
+    out["parity"] = {
+        "rel_fused_vs_ggn_bf16": rel(y_fused, y_ggn),
+        "rel_fused_vs_f32ref": rel(y_fused, y_ref),
+        "rel_ggn_bf16_vs_f32ref": rel(y_ggn, y_ref),
+        "cos_fused_vs_f32ref": cos(y_fused, y_ref),
+        "cos_ggn_bf16_vs_f32ref": cos(y_ggn, y_ref),
+    }
+    print(json.dumps(out["parity"], indent=1))
+
+    if not args.skip_timing:
+        ms_ggn, x_ggn = time_cg(
+            lambda f0, o: flat_ggn_fvp(policy, o, f0, unravel, weight),
+            flat0, g, obs, args.chain,
+        )
+        ms_fused, x_fused = time_cg(
+            lambda f0, o: flat_fused_fvp(
+                params, o, weight, unravel, args.block_rows
+            ),
+            flat0, g, obs, args.chain,
+        )
+        sol_cos = float(
+            np.dot(x_ggn, x_fused)
+            / (np.linalg.norm(x_ggn) * np.linalg.norm(x_fused))
+        )
+        out["timing"] = {
+            "ggn_ms_per_iter": round(ms_ggn, 4),
+            "fused_ms_per_iter": round(ms_fused, 4),
+            "speedup": round(ms_ggn / ms_fused, 3),
+            "solution_cosine_fused_vs_ggn": sol_cos,
+        }
+        print(json.dumps(out["timing"], indent=1))
+
+    with open("scripts/fvp_kernel_lab.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

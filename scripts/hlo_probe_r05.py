@@ -1,0 +1,179 @@
+"""Round-5 probe: dump per-layout collective inventories (VERDICT item 3).
+
+Compiles the full update (or the seq-parallel GAE) for the data x model,
+data x seq, and data x expert layouts on the forced 8-device CPU mesh and
+prints every collective line grouped by while-body membership — the raw
+data the hygiene assertions in tests/test_hlo_hygiene.py pin.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+     python scripts/hlo_probe_r05.py
+"""
+
+import os
+import re
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+
+# the TPU-tunnel sitecustomize overrides JAX_PLATFORMS at interpreter
+# start; re-assert the caller's choice (same dance as __graft_entry__.py)
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.models import BoxSpec, make_policy
+from trpo_tpu.models.moe import make_moe_policy
+from trpo_tpu.trpo import TRPOBatch, make_tree_trpo_update
+
+BATCH = 50_000
+OBS_DIM, ACT_DIM, HIDDEN = 376, 17, (256, 256)
+
+_SHAPE_RE = re.compile(r"\b(?:f|s|u|pred|bf)\d*\[([\d,]*)\]")
+_COLLECTIVES = (
+    "all-gather(", "all-reduce(", "reduce-scatter(", "all-to-all(",
+    "collective-permute(",
+)
+
+
+def _elem_counts(line):
+    counts = []
+    for dims in _SHAPE_RE.findall(line):
+        if not dims:
+            counts.append(1)
+        else:
+            n = 1
+            for d in dims.split(","):
+                n *= int(d)
+            counts.append(n)
+    return counts
+
+
+def _while_bodies(hlo):
+    names = set(re.findall(r"body=%?([\w.\-]+)", hlo))
+    blocks = {}
+    for m in re.finditer(r"^%?([\w.\-]+) \(.*\) -> .* \{$", hlo, re.MULTILINE):
+        if m.group(1) in names:
+            end = hlo.index("\n}", m.start())
+            blocks[m.group(1)] = hlo[m.start(): end]
+    return blocks
+
+
+def report(tag, hlo):
+    print(f"\n===== {tag} =====")
+    bodies = _while_bodies(hlo)
+    spans = {n: hlo.index(t) for n, t in bodies.items()}
+
+    def owner(pos):
+        for n, t in bodies.items():
+            s = spans[n]
+            if s <= pos < s + len(t):
+                return n
+        return "<toplevel>"
+
+    inv = {}
+    for m in re.finditer(".*", hlo):
+        line = m.group(0)
+        if not any(c in line for c in _COLLECTIVES):
+            continue
+        kind = next(c for c in _COLLECTIVES if c in line)[:-1]
+        big = max(_elem_counts(line) or [1])
+        key = (owner(m.start()), kind, big)
+        inv[key] = inv.get(key, 0) + 1
+    for (own, kind, big), n in sorted(inv.items()):
+        print(f"{own:40s} {kind:22s} max_elems={big:>10d}  x{n}")
+
+
+def abstract(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=x.sharding
+        )
+        if hasattr(x, "sharding")
+        else x,
+        tree,
+    )
+
+
+def batch_for(policy, params, mesh, data_axis="data"):
+    obs = jnp.zeros((BATCH, OBS_DIM), jnp.float32)
+    dist = jax.eval_shape(policy.apply, params, obs)
+    shard = lambda x: jax.ShapeDtypeStruct(
+        x.shape, x.dtype,
+        sharding=NamedSharding(
+            mesh, P(data_axis, *([None] * (len(x.shape) - 1)))
+        ),
+    )
+    return TRPOBatch(
+        obs=shard(obs),
+        actions=shard(jax.ShapeDtypeStruct((BATCH, ACT_DIM), jnp.float32)),
+        advantages=shard(jax.ShapeDtypeStruct((BATCH,), jnp.float32)),
+        old_dist=jax.tree_util.tree_map(
+            lambda x: shard(jax.ShapeDtypeStruct(x.shape, x.dtype)), dist
+        ),
+        weight=shard(jax.ShapeDtypeStruct((BATCH,), jnp.float32)),
+    )
+
+
+def tp_case():
+    from trpo_tpu.parallel.tp import policy_param_shardings
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("data", "model"))
+    policy = make_policy((OBS_DIM,), BoxSpec(ACT_DIM), hidden=HIDDEN)
+    params = policy.init(jax.random.key(0))
+    shardings = policy_param_shardings(params, mesh)
+    params_abs = jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        params, shardings,
+    )
+    update = make_tree_trpo_update(
+        policy, TRPOConfig(cg_iters=10, cg_damping=0.1)
+    )
+    hlo = jax.jit(update).lower(
+        params_abs, batch_for(policy, params, mesh)
+    ).compile().as_text()
+    report("data x model (tree update, flagship shape)", hlo)
+
+
+def expert_case():
+    from trpo_tpu.parallel.tp import policy_param_shardings
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("data", "expert"))
+    policy = make_moe_policy(
+        (OBS_DIM,), BoxSpec(ACT_DIM), n_experts=4, hidden=(128,),
+    )
+    params = policy.init(jax.random.key(0))
+    shardings = policy_param_shardings(params, mesh, model_axis="expert")
+    params_abs = jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        params, shardings,
+    )
+    update = make_tree_trpo_update(
+        policy, TRPOConfig(cg_iters=10, cg_damping=0.1)
+    )
+    hlo = jax.jit(update).lower(
+        params_abs, batch_for(policy, params, mesh)
+    ).compile().as_text()
+    report("data x expert (tree update, MoE)", hlo)
+
+
+def seq_case():
+    from trpo_tpu.parallel.seq import make_seq_gae
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("data", "seq"))
+    T, N = 512, 128
+    gae = make_seq_gae(mesh, 0.99, 0.97, seq_axis="seq", batch_axis="data")
+    sharding = NamedSharding(mesh, P("seq", "data"))
+    arg = jax.ShapeDtypeStruct((T, N), jnp.float32, sharding=sharding)
+    hlo = jax.jit(gae).lower(arg, arg, arg, arg, arg).compile().as_text()
+    report("data x seq (sequence-parallel GAE)", hlo)
+
+
+if __name__ == "__main__":
+    tp_case()
+    expert_case()
+    seq_case()

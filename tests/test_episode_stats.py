@@ -1,0 +1,99 @@
+"""Cross-batch running episode statistics (round-5, VERDICT r4 item 6).
+
+Long-horizon rungs complete zero episodes on most iterations, so the
+reference-style per-batch ``mean_episode_reward`` is honestly NaN there
+(the agent logs NaN rather than a fake 0).  ``reward_running``
+(``envs/episode_stats.RunningEpisodeMean``) is the windowed
+episode-weighted mean across batches — finite from the first finished
+episode onward, making the JSONLs directly plottable and retiring the
+"last finite value" workarounds from consumers.
+"""
+
+import json
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from trpo_tpu.envs.episode_stats import RunningEpisodeMean
+
+
+def test_nan_before_first_episode():
+    r = RunningEpisodeMean()
+    assert math.isnan(r.mean)
+    r.update(float("nan"), 0)     # batch with no finished episode: no-op
+    assert math.isnan(r.mean) and r.count == 0
+
+
+def test_episode_weighted_mean_and_nan_batches_ignored():
+    r = RunningEpisodeMean()
+    r.update(10.0, 2)             # two episodes at 10
+    r.update(float("nan"), 0)     # long-horizon batch, nothing finished
+    r.update(40.0, 1)             # one episode at 40
+    assert r.count == 3
+    assert abs(r.mean - 20.0) < 1e-12  # (10*2 + 40*1) / 3
+
+
+def test_windowing_drops_old_batches():
+    r = RunningEpisodeMean(window=2)
+    r.update(0.0, 5)
+    r.update(10.0, 1)
+    r.update(20.0, 1)             # evicts the 5-episode batch
+    assert r.count == 2
+    assert abs(r.mean - 15.0) < 1e-12
+
+
+def test_learn_logs_finite_reward_running(tmp_path):
+    """Integration: on a run where many iterations complete zero episodes
+    (tiny batches vs episode length), the logged per-batch reward is NaN
+    on those rows while reward_running stays finite once any episode has
+    finished."""
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+
+    path = tmp_path / "stats.jsonl"
+    cfg = TRPOConfig(
+        env="cartpole", n_envs=2, batch_timesteps=8, vf_train_steps=2,
+        cg_iters=2, fuse_iterations=1, log_jsonl=str(path),
+    )
+    agent = TRPOAgent("cartpole", cfg)
+    agent.learn(n_iterations=30)
+
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(rows) == 30
+    per_batch = np.array([r["mean_episode_reward"] for r in rows])
+    running = np.array([r["reward_running"] for r in rows])
+    # this configuration must actually exercise the empty-batch case
+    assert np.isnan(per_batch).any(), "config no longer starves batches"
+    first_finite = int(np.flatnonzero(~np.isnan(running))[0])
+    assert np.isfinite(running[first_finite:]).all(), (
+        "reward_running went NaN after the first finished episode"
+    )
+    # on rows with episodes, the window mean moves with the data; spot-
+    # check semantics on the first finite row: equals that batch's mean
+    i = int(np.flatnonzero(~np.isnan(per_batch))[0])
+    assert abs(running[i] - per_batch[i]) < 1e-5 or i > first_finite
+
+
+def test_population_best_member_episode_weighted():
+    from trpo_tpu.population import Population
+
+    stats = {
+        "mean_episode_reward": jnp.array(
+            [
+                [10.0, jnp.nan, 30.0],   # member 0: 4 eps -> mean 15
+                [jnp.nan, 50.0, jnp.nan],  # member 1: 1 ep  -> mean 50
+                [jnp.nan, jnp.nan, jnp.nan],  # member 2: none -> -inf
+            ]
+        ),
+        "episodes_in_batch": jnp.array(
+            [[3, 0, 1], [0, 1, 0], [0, 0, 0]]
+        ),
+    }
+    assert Population.best_member(None, stats) == 1
+    # single-iteration form (no chunk axis)
+    stats1 = {
+        "mean_episode_reward": jnp.array([jnp.nan, 5.0]),
+        "episodes_in_batch": jnp.array([0, 2]),
+    }
+    assert Population.best_member(None, stats1) == 1

@@ -1,0 +1,195 @@
+"""Fused single-Pallas-kernel Gauss-Newton FVP (``ops/fused_fvp.py``).
+
+The kernel replaces the XLA GGN matmul chain for plain-MLP Gaussian
+policies (SURVEY §3.4; the Fisher the reference builds by double
+backprop, ``trpo_inksci.py:56-70``).  These tests pin, in interpret mode
+on the CPU mesh:
+
+* operator parity against ``make_ggn_fvp`` (same math, same weighting,
+  same damping) across activations, depths, weighted/padded batches;
+* full-update equivalence: ``fvp_mode="fused"`` vs ``"ggn"`` produce the
+  same accepted step;
+* eligibility: explicit ``"fused"`` raises on unsupported architectures
+  instead of silently falling back, and the VMEM cost model rejects
+  shapes that cannot fit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.models import BoxSpec, DiscreteSpec, make_policy
+from trpo_tpu.ops import flatten_params, make_ggn_fvp
+from trpo_tpu.ops.fused_fvp import (
+    _auto_block_rows,
+    fused_fvp_supported,
+    make_fused_gaussian_mlp_fvp,
+)
+from trpo_tpu.trpo import TRPOBatch, make_trpo_update
+
+
+def _problem(hidden=(128, 128), activation="tanh", batch=300, obs_dim=11,
+             act_dim=5, pad_tail=50, seed=0):
+    policy = make_policy(
+        (obs_dim,), BoxSpec(act_dim), hidden=hidden, activation=activation,
+        compute_dtype=jnp.float32,
+    )
+    params = policy.init(jax.random.key(seed))
+    obs = jax.random.normal(jax.random.key(1), (batch, obs_dim), jnp.float32)
+    weight = jnp.concatenate(
+        [jnp.ones((batch - pad_tail,)), jnp.zeros((pad_tail,))]
+    )
+    return policy, params, obs, weight
+
+
+def _operators(policy, params, obs, weight, damping=0.1, **fused_kw):
+    flat0, unravel = flatten_params(params)
+    flat0 = jnp.asarray(flat0, jnp.float32)
+
+    ggn = make_ggn_fvp(
+        lambda f: policy.apply(unravel(f), obs),
+        policy.dist.fisher_weight, flat0, weight, damping=damping,
+    )
+    tree_fvp = make_fused_gaussian_mlp_fvp(
+        params["net"], obs, weight, params["log_std"], damping,
+        compute_dtype=jnp.float32, interpret=True, **fused_kw,
+    )
+    fused = lambda v: flatten_params(tree_fvp(unravel(v)))[0]
+    return flat0, jax.jit(ggn), jax.jit(fused)
+
+
+@pytest.mark.parametrize("activation", ["tanh", "relu", "elu"])
+def test_parity_vs_xla_ggn(activation):
+    policy, params, obs, weight = _problem(activation=activation)
+    flat0, ggn, fused = _operators(
+        policy, params, obs, weight,
+        activation=activation, block_rows=128,
+    )
+    v = jax.random.normal(jax.random.key(3), flat0.shape, jnp.float32)
+    a = np.asarray(ggn(v), np.float64)
+    b = np.asarray(fused(v), np.float64)
+    assert np.linalg.norm(a - b) / np.linalg.norm(a) < 1e-5
+
+
+def test_parity_three_hidden_layers_and_auto_block():
+    policy, params, obs, weight = _problem(hidden=(128, 256, 128))
+    flat0, ggn, fused = _operators(policy, params, obs, weight)
+    v = jax.random.normal(jax.random.key(4), flat0.shape, jnp.float32)
+    a = np.asarray(ggn(v), np.float64)
+    b = np.asarray(fused(v), np.float64)
+    assert np.linalg.norm(a - b) / np.linalg.norm(a) < 1e-5
+
+
+def test_zero_damping_and_zero_weight_rows_exact():
+    """Padding rows (weight 0) must contribute exactly nothing."""
+    policy, params, obs, weight = _problem(pad_tail=0)
+    obs2 = jnp.concatenate([obs, 100.0 * jnp.ones((64, obs.shape[1]))])
+    w2 = jnp.concatenate([jnp.ones((obs.shape[0],)), jnp.zeros((64,))])
+    flat0, _, fused_ref = _operators(policy, params, obs, jnp.ones(obs.shape[:1]), damping=0.0)
+    _, _, fused_padded = _operators(policy, params, obs2, w2, damping=0.0)
+    v = jax.random.normal(jax.random.key(5), flat0.shape, jnp.float32)
+    a = np.asarray(fused_ref(v), np.float64)
+    b = np.asarray(fused_padded(v), np.float64)
+    assert np.linalg.norm(a - b) / max(np.linalg.norm(a), 1e-12) < 1e-5
+
+
+def _batch_for(policy, params, obs, weight, seed=2):
+    dist = policy.apply(params, obs)
+    actions = policy.dist.sample(jax.random.key(seed), dist)
+    adv = jax.random.normal(jax.random.key(seed + 1), weight.shape)
+    return TRPOBatch(
+        obs=obs, actions=actions, advantages=adv * weight,
+        old_dist=dist, weight=weight,
+    )
+
+
+def test_full_update_fused_matches_ggn():
+    policy, params, obs, weight = _problem()
+    batch = _batch_for(policy, params, obs, weight)
+    up_ggn = jax.jit(make_trpo_update(policy, TRPOConfig(fvp_mode="ggn")))
+    up_fused = jax.jit(make_trpo_update(policy, TRPOConfig(fvp_mode="fused")))
+    p_g, s_g = up_ggn(params, batch)
+    p_f, s_f = up_fused(params, batch)
+    np.testing.assert_allclose(
+        np.asarray(s_f.kl), np.asarray(s_g.kl), rtol=1e-4, atol=1e-7
+    )
+    fg, _ = flatten_params(p_g)
+    ff, _ = flatten_params(p_f)
+    np.testing.assert_allclose(
+        np.asarray(ff), np.asarray(fg), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_full_update_fused_with_subsample_and_rtol():
+    """The fused operator composes with curvature subsampling and the
+    residual-aware exit (both act outside the kernel)."""
+    policy, params, obs, weight = _problem()
+    batch = _batch_for(policy, params, obs, weight)
+    cfg = TRPOConfig(
+        fvp_mode="fused", fvp_subsample=0.5, cg_residual_rtol=0.25,
+        cg_iters=30,
+    )
+    cfg_ref = TRPOConfig(
+        fvp_mode="ggn", fvp_subsample=0.5, cg_residual_rtol=0.25,
+        cg_iters=30,
+    )
+    p_f, s_f = jax.jit(make_trpo_update(policy, cfg))(params, batch)
+    p_g, s_g = jax.jit(make_trpo_update(policy, cfg_ref))(params, batch)
+    assert int(s_f.cg_iterations) == int(s_g.cg_iterations)
+    fg, _ = flatten_params(p_g)
+    ff, _ = flatten_params(p_f)
+    np.testing.assert_allclose(
+        np.asarray(ff), np.asarray(fg), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_explicit_fused_raises_on_categorical():
+    policy = make_policy((11,), DiscreteSpec(4), hidden=(128,),
+                         compute_dtype=jnp.float32)
+    params = policy.init(jax.random.key(0))
+    obs = jnp.zeros((8, 11))
+    batch = TRPOBatch(
+        obs=obs,
+        actions=jnp.zeros((8,), jnp.int32),
+        advantages=jnp.ones((8,)),
+        old_dist=policy.apply(params, obs),
+        weight=jnp.ones((8,)),
+    )
+    with pytest.raises(ValueError, match="diagonal-Gaussian"):
+        make_trpo_update(policy, TRPOConfig(fvp_mode="fused"))(params, batch)
+
+
+def test_explicit_fused_raises_on_non_lane_hidden():
+    policy, params, obs, weight = _problem(hidden=(64,))
+    batch = _batch_for(policy, params, obs, weight)
+    with pytest.raises(ValueError, match="lane"):
+        make_trpo_update(policy, TRPOConfig(fvp_mode="fused"))(params, batch)
+
+
+def test_auto_mode_falls_back_cleanly_off_tpu():
+    """fvp_mode='auto' (the default) must run fine for every policy on
+    the CPU mesh — identical to 'ggn' there."""
+    policy, params, obs, weight = _problem(hidden=(64,))
+    batch = _batch_for(policy, params, obs, weight)
+    p_a, s_a = jax.jit(make_trpo_update(policy, TRPOConfig()))(params, batch)
+    p_g, s_g = jax.jit(
+        make_trpo_update(policy, TRPOConfig(fvp_mode="ggn"))
+    )(params, batch)
+    fa, _ = flatten_params(p_a)
+    fg, _ = flatten_params(p_g)
+    np.testing.assert_array_equal(np.asarray(fa), np.asarray(fg))
+
+
+def test_vmem_cost_model_rejects_oversized():
+    with pytest.raises(ValueError, match="VMEM"):
+        _auto_block_rows(8192, (8192, 8192), 128)
+
+
+def test_supported_predicate():
+    policy, params, _, _ = _problem()
+    assert fused_fvp_supported("tanh", params["net"])
+    assert not fused_fvp_supported("gelu", params["net"])
+    assert not fused_fvp_supported("tanh", {"layers": []})
+    assert not fused_fvp_supported("tanh", {"wrong": 1})

@@ -141,6 +141,233 @@ def test_no_batch_sized_collectives_anywhere(compiled_hlo):
     )
 
 
+def _collective_lines(text):
+    for line in text.splitlines():
+        if any(c in line for c in _COLLECTIVES):
+            kind = next(c for c in _COLLECTIVES if c in line)[:-1]
+            yield kind, max(_elem_counts(line) or [1]), line.strip()[:160]
+
+
+@pytest.fixture(scope="module")
+def tp_hlo():
+    """data×model: the pytree-domain update at the flagship shape, params
+    Megatron-sharded over a (4, 2) mesh (VERDICT r4 item 3)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from trpo_tpu.parallel.tp import policy_param_shardings
+    from trpo_tpu.trpo import make_tree_trpo_update
+
+    mesh = Mesh(
+        np.array(jax.devices()[:8]).reshape(4, 2), ("data", "model")
+    )
+    policy = make_policy((OBS_DIM,), BoxSpec(ACT_DIM), hidden=HIDDEN)
+    params = policy.init(jax.random.key(0))
+    shardings = policy_param_shardings(params, mesh)
+    params_abs = jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        params, shardings,
+    )
+    obs = jnp.zeros((BATCH, OBS_DIM), jnp.float32)
+    dist = jax.eval_shape(policy.apply, params, obs)
+    shard = lambda x: jax.ShapeDtypeStruct(
+        x.shape, x.dtype,
+        sharding=NamedSharding(
+            mesh, P("data", *([None] * (len(x.shape) - 1)))
+        ),
+    )
+    batch = TRPOBatch(
+        obs=shard(obs),
+        actions=shard(jax.ShapeDtypeStruct((BATCH, ACT_DIM), jnp.float32)),
+        advantages=shard(jax.ShapeDtypeStruct((BATCH,), jnp.float32)),
+        old_dist=jax.tree_util.tree_map(
+            lambda x: shard(jax.ShapeDtypeStruct(x.shape, x.dtype)), dist
+        ),
+        weight=shard(jax.ShapeDtypeStruct((BATCH,), jnp.float32)),
+    )
+    update = make_tree_trpo_update(
+        policy, TRPOConfig(cg_iters=10, cg_damping=0.1)
+    )
+    return jax.jit(update).lower(params_abs, batch).compile().as_text()
+
+
+# Measured inventory constants for the TP layout (probed by
+# scripts/hlo_probe_r05.py; these thresholds encode what each number IS):
+_W0_FULL = OBS_DIM * HIDDEN[0]       # 96256: a full layer-0 weight leaf
+_TP_SHARD_ACT = (BATCH // 4) * HIDDEN[0]  # 3.2e6: per-shard activation —
+#   the Megatron row-parallel partial-sum combine operand
+
+
+def test_tp_no_batch_global_collectives(tp_hlo):
+    """data×model: nothing anywhere may collect a GLOBAL-batch-sized
+    tensor (≥ 4e6 elements ≈ 1.25× the per-shard activation; the full
+    50k×256 activation is 12.8e6). The per-shard Megatron combine
+    (3.2e6) is the largest legitimate operand."""
+    offenders = [
+        (k, n, l)
+        for k, n, l in _collective_lines(tp_hlo)
+        if n > int(1.25 * _TP_SHARD_ACT)
+    ]
+    assert not offenders, (
+        "batch-global collective in the TP program:\n"
+        + "\n".join(l for _, _, l in offenders)
+    )
+
+
+def test_tp_cg_body_inventory(tp_hlo):
+    """The TP solve's per-iteration communication, pinned at the compiled
+    level (README §Parallelism carries the same numbers):
+
+    * ≤ 1 activation-sized all-reduce — the Megatron row-parallel
+      partial-sum combine, inherent to tensor parallelism;
+    * small weight-shard all-gathers (≤ 4, each ≤ one weight leaf
+      ~0.4 MB) — GSPMD re-materializing a sharded weight where that is
+      cheaper than resharding the (12500, 256) activations;
+    * ≤ 2 mid-sized all-reduces (per-leaf gradient combines over the
+      data axis) and ≤ 6 scalar reductions (CG dot products);
+    * NO all-gather above one weight leaf: the model shards themselves
+      are never gathered (the pytree-domain solve's purpose).
+    """
+    bodies = _while_bodies(tp_hlo)
+    assert bodies, "TP program lost its while loops?"
+    saw_fvp_body = False
+    for name, text in bodies.items():
+        ag_big, ar_act, ar_mid, scalars = [], 0, 0, 0
+        for kind, n, line in _collective_lines(text):
+            if kind == "all-gather":
+                if n > int(1.25 * _W0_FULL):
+                    ag_big.append(line)
+            elif kind == "all-reduce":
+                if n > int(1.25 * _TP_SHARD_ACT):
+                    ag_big.append(line)
+                elif n > 4 * _W0_FULL:
+                    ar_act += 1
+                elif n > 64:
+                    ar_mid += 1
+                else:
+                    scalars += 1
+            else:
+                ag_big.append(line)
+        assert not ag_big, (
+            f"{name}: forbidden collective (model-shard gather, "
+            "batch-global reduce, or unexpected kind):\n"
+            + "\n".join(ag_big)
+        )
+        assert ar_act <= 1, (
+            f"{name}: {ar_act} activation-sized all-reduces per iteration "
+            "— more than the one Megatron partial-sum combine"
+        )
+        assert ar_mid <= 2 and scalars <= 6, (
+            f"{name}: unexpected reduce counts (mid {ar_mid}, "
+            f"scalar {scalars})"
+        )
+        if ar_mid or ar_act:
+            saw_fvp_body = True
+    assert saw_fvp_body, (
+        "no while body carries the FVP combine — the CG loop vanished "
+        "or moved; re-probe with scripts/hlo_probe_r05.py"
+    )
+
+
+@pytest.fixture(scope="module")
+def expert_hlo():
+    """data×expert: the pytree-domain update with the soft-MoE policy,
+    whole experts sharded over a (4, 2) mesh."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from trpo_tpu.models.moe import make_moe_policy
+    from trpo_tpu.parallel.tp import policy_param_shardings
+    from trpo_tpu.trpo import make_tree_trpo_update
+
+    mesh = Mesh(
+        np.array(jax.devices()[:8]).reshape(4, 2), ("data", "expert")
+    )
+    policy = make_moe_policy(
+        (OBS_DIM,), BoxSpec(ACT_DIM), n_experts=4, hidden=(128,)
+    )
+    params = policy.init(jax.random.key(0))
+    shardings = policy_param_shardings(params, mesh, model_axis="expert")
+    params_abs = jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        params, shardings,
+    )
+    obs = jnp.zeros((BATCH, OBS_DIM), jnp.float32)
+    dist = jax.eval_shape(policy.apply, params, obs)
+    shard = lambda x: jax.ShapeDtypeStruct(
+        x.shape, x.dtype,
+        sharding=NamedSharding(
+            mesh, P("data", *([None] * (len(x.shape) - 1)))
+        ),
+    )
+    batch = TRPOBatch(
+        obs=shard(obs),
+        actions=shard(jax.ShapeDtypeStruct((BATCH, ACT_DIM), jnp.float32)),
+        advantages=shard(jax.ShapeDtypeStruct((BATCH,), jnp.float32)),
+        old_dist=jax.tree_util.tree_map(
+            lambda x: shard(jax.ShapeDtypeStruct(x.shape, x.dtype)), dist
+        ),
+        weight=shard(jax.ShapeDtypeStruct((BATCH,), jnp.float32)),
+    )
+    update = make_tree_trpo_update(
+        policy, TRPOConfig(cg_iters=10, cg_damping=0.1)
+    )
+    return jax.jit(update).lower(params_abs, batch).compile().as_text()
+
+
+def test_expert_shards_never_gathered(expert_hlo):
+    """data×expert: expert-stacked weight tensors are never all-gathered
+    — each device keeps its whole experts; only the gate blend's
+    contraction over experts reduces (all-reduce), plus the data-axis
+    batch combines. Largest legitimate all-gather: the replicated gate's
+    (376, 4) weight (1504 elements)."""
+    offenders = []
+    for kind, n, line in _collective_lines(expert_hlo):
+        if kind == "all-gather" and n > 10_000:
+            offenders.append(line)
+        if n > int(1.25 * (BATCH // 4) * 128):  # batch-global anywhere
+            offenders.append(line)
+    assert not offenders, (
+        "expert-shard gather or batch-global collective:\n"
+        + "\n".join(offenders)
+    )
+
+
+def test_expert_cg_body_bounded(expert_hlo):
+    bodies = _while_bodies(expert_hlo)
+    assert bodies
+    for name, text in bodies.items():
+        ar_big = sum(
+            1
+            for kind, n, _ in _collective_lines(text)
+            if kind == "all-reduce" and n > 1_000_000
+        )
+        # per iteration: the expert-contraction combine + the data-axis
+        # activation/grad combine — bounded, not batch-scaling
+        assert ar_big <= 3, (
+            f"{name}: {ar_big} large all-reduces per iteration"
+        )
+
+
+def test_seq_gae_exchanges_only_block_summaries():
+    """data×seq: the sequence-parallel GAE's ONLY collectives are the
+    tiny per-block affine-summary all-gathers (the linear-recurrence
+    analogue of a ring exchange) — never a time-global tensor."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from trpo_tpu.parallel.seq import make_seq_gae
+
+    mesh = Mesh(
+        np.array(jax.devices()[:8]).reshape(4, 2), ("data", "seq")
+    )
+    T, N = 512, 128
+    gae = make_seq_gae(mesh, 0.99, 0.97, seq_axis="seq", batch_axis="data")
+    sharding = NamedSharding(mesh, P("seq", "data"))
+    arg = jax.ShapeDtypeStruct((T, N), jnp.float32, sharding=sharding)
+    hlo = jax.jit(gae).lower(arg, arg, arg, arg, arg).compile().as_text()
+    lines = list(_collective_lines(hlo))
+    assert lines, "seq GAE compiled away its collectives?"
+    for kind, n, line in lines:
+        assert kind == "all-gather" and n <= 2 * N, (
+            f"non-summary collective in seq GAE: {line}"
+        )
+
+
 def test_cg_loop_body_collective_inventory(compiled_hlo):
     """The CG body: exactly one param-sized all-reduce (the per-shard FVP
     combine), everything else scalar-sized."""

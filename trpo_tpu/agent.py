@@ -182,7 +182,12 @@ class TRPOAgent:
             train_steps=cfg.vf_train_steps,
             compute_dtype=compute_dtype,
         )
-        self.trpo_update = make_trpo_update(self.policy, cfg)
+        # Fused Pallas FVP only off-mesh: under a mesh the update body is
+        # GSPMD-partitioned over the batch sharding, which cannot split
+        # the kernel's custom call (trpo.make_trpo_update docstring).
+        self.trpo_update = make_trpo_update(
+            self.policy, cfg, allow_fused=cfg.mesh_shape is None
+        )
 
         # steps per env per iteration, so T·N ≥ batch_timesteps
         # (ref batch budget semantics, trpo_inksci.py:17 + utils.py:21).
@@ -662,6 +667,11 @@ class TRPOAgent:
             "cg_residual": trpo_stats.cg_residual,
             "linesearch_success": trpo_stats.linesearch_success,
             "linesearch_step_fraction": trpo_stats.step_fraction,
+            # what the quadratic step model PREDICTED for this step's KL
+            # (δ·frac²) — against kl_old_new it shows whether rollbacks
+            # come from model miscalibration (r05 rollback study)
+            "kl_quadratic_pred": self.cfg.max_kl
+            * trpo_stats.step_fraction**2,
             "kl_rolled_back": trpo_stats.rolled_back,
             "cg_damping": trpo_stats.damping,
         }
@@ -1044,6 +1054,13 @@ class TRPOAgent:
         chunk = max(1, cfg.fuse_iterations) if self.is_device_env else 1
         steps_per_iter = self.n_steps * cfg.n_envs
 
+        # cross-batch running episode-return mean (reward_running): finite
+        # from the first finished episode onward, even on rungs where most
+        # batches complete zero episodes (envs/episode_stats.py)
+        from trpo_tpu.envs.episode_stats import RunningEpisodeMean
+
+        reward_running = RunningEpisodeMean()
+
         def _stop(host_stats) -> bool:
             ent = host_stats["entropy"]
             if ent != ent:  # NaN check (ref trpo_inksci.py:172-173)
@@ -1088,6 +1105,11 @@ class TRPOAgent:
                     host_stats = {
                         key: stack[key][j].item() for key in stack
                     }
+                    reward_running.update(
+                        host_stats["mean_episode_reward"],
+                        host_stats["episodes_in_batch"],
+                    )
+                    host_stats["reward_running"] = reward_running.mean
                     host_stats["time_elapsed_min"] = logger.elapsed_minutes()
                     host_stats["iteration_ms"] = per_iter_ms
                     host_stats["timesteps_total"] = (

@@ -71,6 +71,19 @@ class TRPOConfig:
     #                                1/√K off-diagonal noise)
     linesearch_backtracks: int = 10  # ref utils.py:171 (0.5**k, k<10)
     linesearch_accept_ratio: float = 0.1  # ref utils.py:170
+    linesearch_kl_cap: bool = False  # KL-aware line search: also require
+    #                                each candidate's rollout KL to satisfy
+    #                                the rollback cap (kl_rollback_factor ×
+    #                                max_kl), so an over-long step backtracks
+    #                                to a usable one instead of being
+    #                                discovered post-hoc and thrown away
+    #                                whole (the r04 residual-aware solve
+    #                                tripled post-hoc rollbacks — BENCH_LADDER
+    #                                "rollback mechanism" section). One extra
+    #                                forward per linesearch trial; the
+    #                                reference checks the surrogate only
+    #                                (utils.py:170-182) and this defaults off
+    #                                for reference parity.
     kl_rollback_factor: float = 2.0  # revert params if KL > factor·max_kl
     #                                  (ref trpo_inksci.py:157-158)
     fvp_subsample: Optional[float] = None  # Fisher-vector products on this
@@ -79,17 +92,28 @@ class TRPOConfig:
     #                                full-batch. The curvature estimate
     #                                tolerates sampling noise — the classic
     #                                TRPO large-batch throughput lever.
-    fvp_mode: str = "ggn"          # Fisher-vector product factorization:
+    fvp_mode: str = "auto"         # Fisher-vector product factorization:
+    #                                "auto" (default) = "fused" when the
+    #                                policy/backend qualify (plain-MLP
+    #                                Gaussian policy, TPU backend, flat
+    #                                single-device solve), else "ggn";
+    #                                "fused" = the single-Pallas-kernel
+    #                                Gauss-Newton operator
+    #                                (ops/fused_fvp.py — ~1.3× "ggn" at the
+    #                                Humanoid shape on the v5e: the whole
+    #                                tangent+backward sweep in one VMEM
+    #                                pass; raises if unsupported);
     #                                "ggn" = Gauss-Newton Jᵀ·M·J (forward
     #                                tangent → dist-space KL Hessian →
     #                                vjp; exact Fisher for the built-in
     #                                exponential-family heads, 1.9× faster
-    #                                on the v5e at the Humanoid shape —
+    #                                than jvp_grad on the v5e at the
+    #                                Humanoid shape —
     #                                ops/fvp.make_ggn_fvp); "jvp_grad" =
     #                                jvp-of-grad of the stop-grad KL (the
     #                                reference's double-backprop semantics,
     #                                trpo_inksci.py:56-70, as jvp∘grad).
-    #                                Both solve the same system (tests
+    #                                All solve the same system (tests
     #                                assert solution agreement); custom
     #                                dists without fisher_weight fall back
     #                                to "jvp_grad" automatically.
@@ -205,10 +229,10 @@ class TRPOConfig:
                 'host_inference must be "device" or "cpu", got '
                 f"{self.host_inference!r}"
             )
-        if self.fvp_mode not in ("ggn", "jvp_grad"):
+        if self.fvp_mode not in ("auto", "fused", "ggn", "jvp_grad"):
             raise ValueError(
-                'fvp_mode must be "ggn" or "jvp_grad", got '
-                f"{self.fvp_mode!r}"
+                'fvp_mode must be "auto", "fused", "ggn" or "jvp_grad", '
+                f"got {self.fvp_mode!r}"
             )
         if self.adaptive_damping:
             if not self.damping_grow > 1.0:

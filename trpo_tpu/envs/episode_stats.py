@@ -11,9 +11,54 @@ than being re-implemented per adapter.
 
 from __future__ import annotations
 
+from collections import deque
+
 import numpy as np
 
-__all__ = ["EpisodeStatsMixin"]
+__all__ = ["EpisodeStatsMixin", "RunningEpisodeMean"]
+
+
+class RunningEpisodeMean:
+    """Cross-batch windowed running mean of completed-episode returns.
+
+    Long-horizon rungs (e.g. HalfCheetah: 1000-step episodes vs 200-step
+    per-env batches) complete zero episodes on most iterations, so the
+    per-batch ``mean_episode_reward`` is honestly NaN 80% of the time —
+    which pushed "last finite value" workarounds into every consumer
+    (round-4 verdict weakness 5).  This carries the episode-weighted mean
+    over the last ``window`` batches THAT COMPLETED EPISODES, so the
+    logged ``reward_running`` is finite from the first finished episode
+    onward and every consumer reads one field.
+
+    Host-side by design: it aggregates the per-iteration stats the learn
+    loop already fetched, works identically for the fused-device and
+    host-simulator paths, and adds zero device state (checkpoint resume
+    restarts the window, which re-warms within ``window`` batches).
+    """
+
+    def __init__(self, window: int = 100):
+        self._entries: deque = deque(maxlen=int(window))  # (sum, count)
+
+    def update(self, mean_reward: float, n_episodes: int) -> None:
+        """Fold one batch's (per-batch mean, episode count) in; batches
+        with no finished episode (count 0 / NaN mean) are no-ops."""
+        n = int(n_episodes)
+        if n > 0 and mean_reward == mean_reward:
+            self._entries.append((float(mean_reward) * n, n))
+
+    @property
+    def count(self) -> int:
+        """Episodes inside the current window."""
+        return sum(c for _, c in self._entries)
+
+    @property
+    def mean(self) -> float:
+        """Episode-weighted mean return over the window; NaN only before
+        any episode has ever finished."""
+        n = self.count
+        if n == 0:
+            return float("nan")
+        return sum(s for s, _ in self._entries) / n
 
 
 class EpisodeStatsMixin:

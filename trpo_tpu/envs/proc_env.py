@@ -32,10 +32,15 @@ Design constraints honored:
   boundaries that align with worker boundaries touch exactly one worker
   (the pipelined rollout's ``host_pipeline_groups=W`` sweet spot).
 
-Perf note (BENCH_LADDER "host pipeline"): this host has ONE core, so the
-pool cannot show a speedup here — correctness is validated on 1 core;
-throughput validation awaits a multicore host. The reference steps one env
-serially in-process (``utils.py:18-45``).
+Perf note (BENCH_LADDER "process-pool overlap"): this host has ONE core,
+so CPU-bound stepping cannot speed up here — but the pool's overlap IS
+measured on this box with a sleep-bound probe env (``envs/sleep_env.py``:
+``time.sleep`` releases the core): W=4 workers complete a fixed step
+budget 3.4× faster than serial (86% of ideal; ``scripts/
+proc_overlap_r05.json``, ``tests/test_proc_env.py::
+test_worker_pool_overlap_wallclock``). Real-simulator throughput gains
+still await a multicore host. The reference steps one env serially
+in-process (``utils.py:18-45``).
 """
 
 from __future__ import annotations
@@ -60,7 +65,18 @@ def _worker(conn, env_id: str, count: int, seed_base: int, kwargs: dict):
 
         from trpo_tpu.envs.gym_state import restore_one, snapshot_one
 
-        envs = [gymnasium.make(env_id, **kwargs) for _ in range(count)]
+        if ":" in env_id:
+            # "package.module:ClassName" — construct the class directly
+            # (no gymnasium registry needed in the spawned interpreter);
+            # used by the overlap probe (envs/sleep_env.py) and any
+            # unregistered custom env
+            import importlib
+
+            mod_name, attr = env_id.split(":", 1)
+            cls = getattr(importlib.import_module(mod_name), attr)
+            envs = [cls(**kwargs) for _ in range(count)]
+        else:
+            envs = [gymnasium.make(env_id, **kwargs) for _ in range(count)]
         single = envs[0]
         space = single.action_space
         if hasattr(space, "n"):

@@ -1,0 +1,55 @@
+"""A gymnasium env whose ``step`` blocks in ``time.sleep`` — the overlap
+probe for :class:`trpo_tpu.envs.proc_env.ProcVecEnv` (VERDICT r4 item 4).
+
+A process pool's reason to exist is overlap, but this box has one core,
+so CPU-bound stepping (real MuJoCo) cannot demonstrate it here.  A
+*blocking* step can: ``time.sleep`` releases the core, so W workers
+stepping sleep-bound envs complete a fixed step budget in ~serial/W
+wall-clock even on one core — the same concurrency structure real
+multicore stepping exploits, minus the arithmetic.  Used by
+``tests/test_proc_env.py::test_worker_pool_overlap_wallclock`` and
+``scripts/proc_overlap_r05.py`` (the BENCH_LADDER row).
+
+The reference steps ONE env serially in-process (``utils.py:18-45``).
+"""
+
+from __future__ import annotations
+
+import time
+
+import gymnasium
+import numpy as np
+
+__all__ = ["SleepEnv"]
+
+
+class SleepEnv(gymnasium.Env):
+    """4-dim Box obs, 2 discrete actions; ``step`` sleeps ``sleep_ms``."""
+
+    metadata = {"render_modes": []}
+
+    def __init__(self, sleep_ms: float = 2.0, episode_len: int = 1000):
+        self.observation_space = gymnasium.spaces.Box(
+            -1.0, 1.0, shape=(4,), dtype=np.float32
+        )
+        self.action_space = gymnasium.spaces.Discrete(2)
+        self._sleep_s = float(sleep_ms) * 1e-3
+        self._episode_len = int(episode_len)
+        self._t = 0
+        self._rng = np.random.default_rng(0)
+
+    def reset(self, *, seed=None, options=None):
+        super().reset(seed=seed)
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        return self._obs(), {}
+
+    def _obs(self):
+        return self._rng.standard_normal(4).astype(np.float32)
+
+    def step(self, action):
+        time.sleep(self._sleep_s)
+        self._t += 1
+        truncated = self._t >= self._episode_len
+        return self._obs(), float(action), False, truncated, {}

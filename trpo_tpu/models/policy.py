@@ -41,6 +41,10 @@ class Policy(NamedTuple):
     apply: Callable[[Any, jax.Array], Any]      # (params, obs) -> dist params
     dist: Any                                   # Categorical | DiagGaussian
     action_spec: Any
+    # Structural metadata for the plain-MLP fast path (None for conv /
+    # MoE / recurrent policies): lets the update layer choose the fused
+    # Pallas FVP kernel (ops/fused_fvp.py) when the architecture matches.
+    mlp_spec: Any = None
 
 
 def make_policy(
@@ -125,7 +129,20 @@ def make_policy(
         log_std = jnp.broadcast_to(params["log_std"], raw.shape)
         return {"mean": raw, "log_std": log_std}
 
-    return Policy(init=init, apply=apply, dist=dist, action_spec=action_spec)
+    mlp_spec = None
+    if not conv_torso:
+        mlp_spec = {
+            "activation": activation,
+            "compute_dtype": compute_dtype,
+            "hidden": tuple(hidden),
+        }
+    return Policy(
+        init=init,
+        apply=apply,
+        dist=dist,
+        action_spec=action_spec,
+        mlp_spec=mlp_spec,
+    )
 
 
 def spec_from_env(env) -> Tuple[Tuple[int, ...], Any]:

@@ -24,3 +24,7 @@ from trpo_tpu.ops.fvp import (  # noqa: F401
     make_ggn_fvp,
     materialize_fisher,
 )
+from trpo_tpu.ops.fused_fvp import (  # noqa: F401
+    fused_fvp_supported,
+    make_fused_gaussian_mlp_fvp,
+)

@@ -1,0 +1,386 @@
+"""Fused Gauss-Newton Fisher-vector product — one Pallas TPU kernel.
+
+The XLA Gauss-Newton FVP (``ops/fvp.make_ggn_fvp``) lowers to a chain of
+~10 separate matmul kernels per CG iteration (tangent forward, dist-space
+weighting, backward dgrads + wgrads).  At the flagship Humanoid shape
+(obs 376 → 256 → 256 → act 17, batch 50k) the round-4 orientation
+microbench (``scripts/width512_r04.json``) showed that chain is
+*HBM-bandwidth-bound*, not MXU-bound: every op re-reads a ``(B, 256)``
+activation or tangent from HBM (25.6 MB each at bf16), and the four
+17-wide action-head matmuls — 0.9% of the FLOPs — run at ~12-14 TF/s
+because each is a full HBM pass over a 25.6 MB operand to touch a
+``(B, 17)`` result (measured ~870 GB/s: at the bandwidth roofline).
+
+This module fuses the ENTIRE operator into one kernel: the batch streams
+through VMEM in row blocks; for each block the kernel runs the tangent
+forward sweep, the diagonal-Gaussian Fisher weighting, and the full
+backward sweep (dgrad + wgrad for every layer) without any intermediate
+ever touching HBM, accumulating the parameter-space cotangents in VMEM
+across the (sequential) grid.  Per CG iteration the only HBM traffic is
+one read of ``obs`` and of each stored activation (~89 MB at the
+flagship shape vs ~350 MB unfused) and a parameter-sized write — the
+operator flips from bandwidth-bound to MXU-bound.
+
+Scope (the fast path is *chosen*, never silently wrong): MLP torso with
+an activation whose derivative is expressible from its output (tanh,
+relu, elu — see ``_ACT_DERIV``), diagonal-Gaussian head with
+state-independent ``log_std``.  That is exactly the BASELINE.json MuJoCo
+family (the reference's own network shape, ``trpo_inksci.py:38-40``,
+generalized).  Everything else — conv/recurrent/MoE policies,
+categorical heads, tensor-sharded pytree solves — uses the XLA GGN path,
+which remains the general contract.
+
+Math (identical to ``make_ggn_fvp``; same Fisher the reference builds by
+double backprop, ``trpo_inksci.py:56-70``):
+
+    F·v = Jᵀ M J v + λv,   J = ∂(dist params)/∂θ at θ₀,
+    M   = diag(wᵢ/Σw) ⊗ [e^{-2σ} on the mean block, 2·I on log σ]
+
+The log-std block never enters the kernel: with state-independent
+``log_std`` its J is the identity broadcast, so its cotangent is the
+closed form ``2·(Σwₙ)·v_σ`` — zero matmuls.
+
+Layout notes: the action head is zero-padded to the 128-lane width
+inside the kernel (padding *columns* of ``W_head`` and of ``M`` — zero
+Fisher weight on pad lanes makes the padding exact, not approximate);
+the batch is zero-padded to the row-block size with zero sample weights
+(every padded row's Fisher weight is zero, so its contribution vanishes
+identically).  Accumulation is fp32 everywhere; matmul operands are the
+configured compute dtype (bf16 on TPU), matching the XLA path's
+precision contract (``models/mlp.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    "fused_fvp_supported",
+    "make_fused_gaussian_mlp_fvp",
+]
+
+_LANE = 128  # MXU/VPU lane width: minor-dim tile for every TPU generation
+
+
+def _ceil_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+# Activation derivatives expressed from the activation OUTPUT h = act(x):
+# the kernel only stores post-activation values (same arrays the forward
+# pass produces), so only output-expressible activations are eligible.
+_ACT_DERIV: Dict[str, Callable[[jax.Array], jax.Array]] = {
+    "tanh": lambda h: 1.0 - h * h,
+    "relu": lambda h: (h > 0.0).astype(jnp.float32),
+    "elu": lambda h: jnp.where(h > 0.0, 1.0, h + 1.0),
+}
+
+
+def fused_fvp_supported(activation: str, net_params: Any) -> bool:
+    """Whether the fused kernel covers this (activation, torso) pair."""
+    if activation not in _ACT_DERIV:
+        return False
+    try:
+        layers = net_params["layers"]
+    except (TypeError, KeyError):
+        return False
+    if not isinstance(layers, (list, tuple)) or len(layers) < 2:
+        return False
+    for layer in layers:
+        try:
+            w, _ = layer["w"], layer["b"]
+        except (TypeError, KeyError):
+            return False
+        if getattr(w, "ndim", None) != 2:
+            return False
+    return True
+
+
+def _pad2(x: jax.Array, rows: int, cols: int) -> jax.Array:
+    """Zero-pad a 2-D array up to (rows, cols)."""
+    return jnp.pad(x, ((0, rows - x.shape[0]), (0, cols - x.shape[1])))
+
+
+# VMEM budget for auto block sizing: ~16 MB/core scoped limit, with
+# headroom for the model's context-dependent underestimate — compiler-
+# reported flagship footprints: block 4096 → 28.0 MB (26.7 modeled);
+# 2048 → 14.2 modeled, fits standalone but hits 17.5 MB inside the full
+# fused update's nested while-loops (+23% vs model, the round-5 driver
+# OOM). 12 MB keeps the flagship at block 1024 (~7.9 modeled, ~10 real),
+# which measures within ~2% of 2048 anyway; pass block_rows explicitly
+# to override.
+_VMEM_BUDGET = 12 * 2**20
+
+
+def _block_cost_model(D0p: int, hidden, Ap: int):
+    """(fixed_bytes, per_row_bytes) VMEM estimate for the kernel."""
+    w_elems = (
+        D0p * hidden[0]
+        + sum(hidden[k - 1] * hidden[k] for k in range(1, len(hidden)))
+        + hidden[-1] * Ap
+    )
+    # weights + tangents at 2 B (bf16) + f32 cotangent outputs
+    fixed = w_elems * (2 + 2 + 4)
+    # double-buffered bf16 row blocks (obs + activations + wn) and the
+    # live f32 tangent/backward intermediates (~2 row arrays of max width)
+    per_row = 4.0 * (D0p + sum(hidden) + Ap) + 8.0 * max(hidden)
+    return fixed, per_row
+
+
+def _auto_block_rows(D0p: int, hidden, Ap: int) -> int:
+    fixed, per_row = _block_cost_model(D0p, hidden, Ap)
+    for blk in (2048, 1024, 512, 256, 128):
+        if fixed + blk * per_row <= _VMEM_BUDGET:
+            return blk
+    raise ValueError(
+        f"fused FVP does not fit VMEM at obs={D0p}, hidden={tuple(hidden)}, "
+        f"act={Ap} (estimated {fixed / 2**20:.1f} MB of weights/outputs "
+        "alone); use the XLA GGN path"
+    )
+
+
+def _fvp_kernel(n_hidden: int, activation: str, *refs):
+    """Kernel body; ``refs`` layout (inputs then outputs):
+
+    inputs:  obs, h_0..h_{L-1}, wn, m,
+             W_1..W_{L-1}, Wh,
+             V_0..V_{L-1}, Vh,
+             vb_0..vb_{L-1}, vbh
+    outputs: cW_0..cW_{L-1}, cWh, cb (stacked (L+1, lane-padded max width))
+    """
+    L = n_hidden
+    it = iter(refs)
+    obs_ref = next(it)
+    h_refs = [next(it) for _ in range(L)]
+    wn_ref = next(it)
+    m_ref = next(it)
+    w_refs = [next(it) for _ in range(L - 1)] + [next(it)]  # W_1..W_{L-1}, Wh
+    v_refs = [next(it) for _ in range(L + 1)]               # V_0..V_{L-1}, Vh
+    vb_refs = [next(it) for _ in range(L + 1)]              # vb_0.., vbh
+    cw_refs = [next(it) for _ in range(L + 1)]              # cW_0.., cWh
+    cb_ref = next(it)
+
+    deriv = _ACT_DERIV[activation]
+    f32 = jnp.float32
+    dot_kw = dict(preferred_element_type=f32)
+    cdtype = obs_ref.dtype
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        for ref in cw_refs:
+            ref[...] = jnp.zeros_like(ref)
+        cb_ref[...] = jnp.zeros_like(cb_ref)
+
+    obs = obs_ref[...]
+    hs = [r[...] for r in h_refs]
+    derivs = [deriv(h.astype(f32)) for h in hs]
+
+    # ---- tangent forward sweep -------------------------------------
+    dp = jnp.dot(obs, v_refs[0][...], **dot_kw) + vb_refs[0][...]
+    dh = (derivs[0] * dp).astype(cdtype)
+    for k in range(1, L):
+        dp = (
+            jnp.dot(hs[k - 1], v_refs[k][...], **dot_kw)
+            + jnp.dot(dh, w_refs[k - 1][...], **dot_kw)
+            + vb_refs[k][...]
+        )
+        dh = (derivs[k] * dp).astype(cdtype)
+    d_mean = (
+        jnp.dot(dh, w_refs[L - 1][...], **dot_kw)
+        + jnp.dot(hs[L - 1], v_refs[L][...], **dot_kw)
+        + vb_refs[L][...]
+    )
+
+    # ---- dist-space Fisher weighting (padded lanes carry m = 0) ----
+    c32 = d_mean * (wn_ref[...] * m_ref[...])
+    c = c32.astype(cdtype)
+
+    # ---- backward sweep: head, then torso layers top-down ----------
+    cw_refs[L][...] += lax.dot_general(
+        hs[L - 1], c, (((0,), (0,)), ((), ())), **dot_kw
+    )
+    cb_ref[0:1, : c32.shape[1]] += jnp.sum(c32, axis=0, keepdims=True)
+    ch = lax.dot_general(c, w_refs[L - 1][...], (((1,), (1,)), ((), ())), **dot_kw)
+    for k in range(L - 1, 0, -1):
+        g32 = derivs[k] * ch
+        g = g32.astype(cdtype)
+        cw_refs[k][...] += lax.dot_general(
+            hs[k - 1], g, (((0,), (0,)), ((), ())), **dot_kw
+        )
+        cb_ref[L - k : L - k + 1, : g32.shape[1]] += jnp.sum(
+            g32, axis=0, keepdims=True
+        )
+        ch = lax.dot_general(
+            g, w_refs[k - 1][...], (((1,), (1,)), ((), ())), **dot_kw
+        )
+    g32 = derivs[0] * ch
+    g = g32.astype(cdtype)
+    cw_refs[0][...] += lax.dot_general(
+        obs, g, (((0,), (0,)), ((), ())), **dot_kw
+    )
+    cb_ref[L : L + 1, : g32.shape[1]] += jnp.sum(g32, axis=0, keepdims=True)
+
+
+def make_fused_gaussian_mlp_fvp(
+    net_params: Any,
+    obs: jax.Array,
+    weight: jax.Array,
+    log_std: jax.Array,
+    damping,
+    *,
+    activation: str = "tanh",
+    compute_dtype=jnp.bfloat16,
+    block_rows: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Callable[[Any], Any]:
+    """Build ``v ↦ (F + λI)v`` as a fused Pallas kernel.
+
+    ``net_params`` is the MLP pytree (``{"layers": [{"w", "b"}, ...]}``);
+    the returned operator takes/returns the full policy-param pytree
+    structure ``{"net": ..., "log_std": ...}`` (what the flat-domain
+    update's ``unravel`` produces).  Setup — forward activations, padded
+    operands — runs once at trace time, so inside the fused CG
+    ``while_loop`` it is loop-invariant and hoisted, exactly like
+    ``make_ggn_fvp``'s ``jax.linearize``.
+    """
+    if activation not in _ACT_DERIV:
+        raise ValueError(
+            f"fused FVP supports activations {sorted(_ACT_DERIV)}, "
+            f"got {activation!r}"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    layers = net_params["layers"]
+    L = len(layers) - 1  # hidden layers
+    if L < 1:
+        raise ValueError("fused FVP needs at least one hidden layer")
+    obs = obs.reshape(obs.shape[0], -1)
+    B, D0 = obs.shape
+    act_dim = layers[-1]["w"].shape[1]
+    hidden = [layers[k]["w"].shape[1] for k in range(L)]
+    if any(h % _LANE for h in hidden):
+        raise ValueError(
+            f"fused FVP needs lane-multiple hidden widths, got {hidden}"
+        )
+
+    D0p = _ceil_to(D0, _LANE)
+    Ap = _ceil_to(act_dim, _LANE)
+    if block_rows is None:
+        block_rows = _auto_block_rows(D0p, hidden, Ap)
+    Bp = _ceil_to(B, block_rows)
+    cd = compute_dtype
+    f32 = jnp.float32
+    act_fn = {"tanh": jnp.tanh, "relu": jax.nn.relu, "elu": jax.nn.elu}[
+        activation
+    ]
+
+    # ---- once-per-update setup (loop-invariant under the CG loop) ----
+    obs_p = _pad2(obs.astype(cd), Bp, D0p)
+    h = obs.astype(cd)
+    acts: List[jax.Array] = []
+    for k in range(L):
+        w = layers[k]["w"].astype(cd)
+        b = layers[k]["b"].astype(cd)
+        h = act_fn(h @ w + b)
+        acts.append(_pad2(h, Bp, hidden[k]))
+    w_mid = [layers[k]["w"].astype(cd) for k in range(1, L)]
+    w_head = _pad2(layers[L]["w"].astype(cd), hidden[-1], Ap)
+
+    weight = weight.reshape(-1).astype(f32)
+    sum_w = jnp.sum(weight)
+    norm = jnp.maximum(sum_w, 1.0)
+    wn = jnp.pad(weight / norm, (0, Bp - B))[:, None]  # (Bp, 1)
+    inv_var = jnp.exp(-2.0 * log_std.astype(f32))
+    m_row = jnp.pad(inv_var, (0, Ap - act_dim))[None, :]  # (1, Ap)
+    sum_wn = sum_w / norm  # Σ of normalized weights (=1 for real batches)
+
+    damping = jnp.asarray(damping, f32)
+    cbw = max(max(hidden), Ap)  # stacked bias-cotangent row width
+
+    grid = (Bp // block_rows,)
+    row_spec = lambda width: pl.BlockSpec(
+        (block_rows, width), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    full_spec = lambda shape: pl.BlockSpec(
+        shape, lambda i: tuple(0 for _ in shape), memory_space=pltpu.VMEM
+    )
+
+    in_specs = (
+        [row_spec(D0p)]
+        + [row_spec(hk) for hk in hidden]
+        + [row_spec(1)]
+        + [full_spec((1, Ap))]
+        + [full_spec(w.shape) for w in w_mid]
+        + [full_spec(w_head.shape)]
+        + [full_spec((D0p, hidden[0]))]
+        + [full_spec((hidden[k - 1], hidden[k])) for k in range(1, L)]
+        + [full_spec((hidden[-1], Ap))]
+        + [full_spec((1, hk)) for hk in hidden]
+        + [full_spec((1, Ap))]
+    )
+    out_shapes = (
+        [jax.ShapeDtypeStruct((D0p, hidden[0]), f32)]
+        + [
+            jax.ShapeDtypeStruct((hidden[k - 1], hidden[k]), f32)
+            for k in range(1, L)
+        ]
+        + [jax.ShapeDtypeStruct((hidden[-1], Ap), f32)]
+        + [jax.ShapeDtypeStruct((L + 1, cbw), f32)]
+    )
+    out_specs = [full_spec(s.shape) for s in out_shapes]
+
+    kernel = pl.pallas_call(
+        functools.partial(_fvp_kernel, L, activation),
+        grid=grid,
+        in_specs=in_specs,
+        out_shape=out_shapes,
+        out_specs=out_specs,
+        interpret=interpret,
+    )
+
+    def fvp(v: Any) -> Any:
+        vl = v["net"]["layers"]
+        v0 = _pad2(vl[0]["w"].astype(cd), D0p, hidden[0])
+        v_mid = [vl[k]["w"].astype(cd) for k in range(1, L)]
+        v_head = _pad2(vl[L]["w"].astype(cd), hidden[-1], Ap)
+        vbs = [vl[k]["b"].astype(f32)[None, :] for k in range(L)]
+        vbh = jnp.pad(vl[L]["b"].astype(f32), (0, Ap - act_dim))[None, :]
+
+        outs = kernel(
+            obs_p, *acts, wn, m_row,
+            *w_mid, w_head,
+            v0, *v_mid, v_head,
+            *vbs, vbh,
+        )
+        cws, cb = list(outs[: L + 1]), outs[L + 1]
+
+        out_layers = []
+        for k in range(L + 1):
+            cw = cws[k]
+            if k == 0:
+                cw = cw[:D0, :]
+            elif k == L:
+                cw = cw[:, :act_dim]
+            row = L if k == 0 else (L - k if k < L else 0)
+            width = act_dim if k == L else hidden[k]
+            cb_k = cb[row, :width]
+            out_layers.append(
+                {
+                    "w": cw + damping * vl[k]["w"].astype(f32),
+                    "b": cb_k + damping * vl[k]["b"].astype(f32),
+                }
+            )
+        # log_std block: J is the identity broadcast (state-independent
+        # σ), dist-space Hessian 2·I — closed form, no kernel work.
+        c_sigma = (2.0 * sum_wn + damping) * v["log_std"].astype(f32)
+        return {"net": {"layers": out_layers}, "log_std": c_sigma}
+
+    return fvp

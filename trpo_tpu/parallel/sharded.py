@@ -111,7 +111,10 @@ def make_sharded_update(
     (use :func:`shard_batch`). The returned function is the drop-in
     mesh-parallel version of ``jax.jit(make_trpo_update(...))``.
     """
-    update = make_trpo_update(policy, cfg)
+    # allow_fused=False: GSPMD partitions the XLA update body over the
+    # batch sharding; the Pallas fused-FVP custom call is opaque to the
+    # partitioner, so the mesh path always uses the XLA GGN operator.
+    update = make_trpo_update(policy, cfg, allow_fused=False)
     replicated = NamedSharding(mesh, P())
 
     def batch_shardings(batch):

@@ -57,9 +57,22 @@ class Population:
                 f"population size {len(seeds)} must divide evenly over the "
                 f"{axis}={mesh.shape[axis]} mesh axis"
             )
-        self.agent = agent
         self.seeds = tuple(int(s) for s in seeds)
         self.mesh = mesh
+        # The fused Pallas FVP does not compose with the member vmap (its
+        # grid-accumulation init keys on grid axis 0, which vmap would
+        # repurpose as the member axis) — the population uses the XLA GGN
+        # operator. A shallow agent clone carries it so the CALLER's
+        # agent keeps its own (possibly fused) update untouched.
+        import copy
+
+        from trpo_tpu.trpo import make_trpo_update
+
+        agent = copy.copy(agent)
+        agent.trpo_update = make_trpo_update(
+            agent.policy, agent.cfg, allow_fused=False
+        )
+        self.agent = agent
 
         states = [agent.init_state(s) for s in self.seeds]
         state = jax.tree_util.tree_map(
@@ -110,28 +123,25 @@ class Population:
         return jax.tree_util.tree_map(lambda x: x[i], self.state)
 
     def best_member(self, stats) -> int:
-        """Index of the member with the highest mean episode reward in
-        ``stats`` (NaN — no finished episode — treated as worst). Accepts
+        """Index of the member with the highest episode-weighted mean
+        return (NaN batches — no finished episode — contribute nothing;
+        a member that never finished an episode scores ``-inf``). Accepts
         per-iteration stats (leading member axis) or a fused
-        ``run_iterations`` pytree (``(member, n)`` leaves — each member
-        is scored by its LAST FINITE reward in the chunk, since an
-        iteration in which none of a member's episodes finished logs
-        NaN and says nothing about quality)."""
-        r = jnp.asarray(stats["mean_episode_reward"])
+        ``run_iterations`` pytree (``(member, n)`` leaves): each member is
+        scored by the mean over ALL episodes it completed in the chunk —
+        the same cross-batch running-mean semantics as the agent's
+        ``reward_running`` (envs/episode_stats.RunningEpisodeMean)."""
+        r = jnp.asarray(stats["mean_episode_reward"], jnp.float32)
+        if "episodes_in_batch" in stats:
+            c = jnp.asarray(stats["episodes_in_batch"], jnp.float32)
+        else:  # partial stats dicts: weight each finite batch equally
+            c = jnp.where(jnp.isnan(r), 0.0, 1.0)
         if r.ndim > 1:
-            # last finite entry per member: index of the rightmost
-            # non-NaN column, or -inf if the member never finished one
-            finite = ~jnp.isnan(r)
-            idx = jnp.where(
-                finite, jnp.arange(r.shape[1])[None, :], -1
-            ).max(axis=1)
-            r = jnp.where(
-                idx >= 0,
-                jnp.take_along_axis(
-                    jnp.nan_to_num(r, nan=-jnp.inf),
-                    jnp.maximum(idx, 0)[:, None], axis=1
-                )[:, 0],
-                -jnp.inf,
+            c = jnp.where(jnp.isnan(r), 0.0, c)
+            total = jnp.sum(c, axis=1)
+            score = jnp.sum(jnp.nan_to_num(r) * c, axis=1) / jnp.maximum(
+                total, 1.0
             )
+            r = jnp.where(total > 0, score, -jnp.inf)
         r = jnp.nan_to_num(r, nan=-jnp.inf)
         return int(jnp.argmax(r))

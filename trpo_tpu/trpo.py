@@ -164,9 +164,83 @@ def _next_damping(cfg: TRPOConfig, damping, ls_success, rollback):
     return jnp.clip(damping * factor, cfg.damping_min, cfg.damping_max)
 
 
+def _maybe_fused_fvp(policy, cfg, to_params, x0, fb: TRPOBatch, damping):
+    """The fused single-Pallas-kernel GGN operator (``ops/fused_fvp.py``)
+    when the architecture qualifies, else None.
+
+    ``fvp_mode="auto"`` quietly falls back to the XLA GGN path on any
+    mismatch (non-MLP policy, categorical head, recurrent batch, widths
+    that don't tile the MXU lanes, VMEM-exceeding shapes, non-TPU
+    backend — interpret-mode Pallas is a test vehicle, not a fast path);
+    ``fvp_mode="fused"`` raises instead, so an explicit opt-in can never
+    silently measure the wrong operator.
+    """
+    explicit = cfg.fvp_mode == "fused"
+    if cfg.fvp_mode != "auto" and not explicit:
+        return None
+
+    def bail(reason):
+        if explicit:
+            raise ValueError(f'fvp_mode="fused" unsupported here: {reason}')
+        return None
+
+    if not explicit and jax.default_backend() != "tpu":
+        return None
+    spec = getattr(policy, "mlp_spec", None)
+    if spec is None:
+        return bail("policy has no plain-MLP spec (conv/MoE/recurrent)")
+    if getattr(policy.dist, "name", None) != "diag_gaussian":
+        return bail("fused FVP covers the diagonal-Gaussian head only")
+    from trpo_tpu.models.recurrent import SeqObs
+
+    if isinstance(fb.obs, SeqObs):
+        return bail("recurrent (SeqObs) batches use the XLA path")
+    params0 = to_params(x0)
+    if not (
+        isinstance(params0, dict) and set(params0) == {"net", "log_std"}
+    ):
+        return bail("unexpected params structure")
+
+    from trpo_tpu.ops.fused_fvp import (
+        fused_fvp_supported,
+        make_fused_gaussian_mlp_fvp,
+    )
+
+    if not fused_fvp_supported(spec["activation"], params0["net"]):
+        return bail(
+            f"activation {spec['activation']!r} / torso shape not "
+            "kernel-eligible"
+        )
+    if any(h % 128 for h in spec["hidden"]):
+        return bail(
+            f"hidden widths {spec['hidden']} are not 128-lane multiples"
+        )
+    try:
+        tree_fvp = make_fused_gaussian_mlp_fvp(
+            params0["net"],
+            fb.obs,
+            fb.weight,
+            params0["log_std"],
+            damping,
+            activation=spec["activation"],
+            compute_dtype=spec["compute_dtype"],
+        )
+    except ValueError:  # VMEM cost model rejected the shape
+        if explicit:
+            raise
+        return None
+    # flat-vector domain only: every pytree-domain entry point hard-codes
+    # allow_fused=False (its sharded leaves are exactly what the kernel
+    # cannot partition), so x0 here is always the flat f32 vector
+    def fvp(v):
+        return flatten_params(tree_fvp(to_params(v)))[0]
+
+    return fvp
+
+
 def _natural_gradient_update(
     policy: Policy, cfg: TRPOConfig, to_params: Callable[[Any], Any],
-    x0: Any, batch: TRPOBatch, damping=None,
+    x0: Any, batch: TRPOBatch, damping=None, allow_fused: bool = True,
 ) -> Tuple[Any, TRPOStats]:
     """The fused solve, generic over the parameter REPRESENTATION.
 
@@ -202,7 +276,25 @@ def _natural_gradient_update(
     if damping is None:
         damping = jnp.float32(cfg.cg_damping)
     damping = jnp.asarray(damping, jnp.float32)
-    if cfg.fvp_mode == "ggn" and hasattr(policy.dist, "fisher_weight"):
+    if not allow_fused and cfg.fvp_mode == "fused":
+        raise ValueError(
+            'fvp_mode="fused" is unavailable on this path (GSPMD mesh '
+            "sharding, vmapped population members, or the pytree-domain "
+            'solve) — use fvp_mode="auto" (falls back to "ggn" here) or '
+            '"ggn". An explicit "fused" must never silently time the '
+            "wrong operator."
+        )
+    fvp = None
+    if allow_fused:
+        # single-Pallas-kernel GGN operator when architecture + backend
+        # qualify (see _maybe_fused_fvp; ~1.3× the XLA GGN chain on the
+        # v5e at the flagship shape)
+        fvp = _maybe_fused_fvp(policy, cfg, to_params, x0, fb, damping)
+    if fvp is not None:
+        pass  # fused operator selected above
+    elif cfg.fvp_mode in ("auto", "fused", "ggn") and hasattr(
+        policy.dist, "fisher_weight"
+    ):
         # Gauss-Newton factorization (ops/fvp.make_ggn_fvp): same Fisher,
         # ~1.9× per CG iteration at the Humanoid shape on the v5e
         fvp = make_ggn_fvp(
@@ -254,6 +346,13 @@ def _natural_gradient_update(
     fullstep = tree_scale(1.0 / lm, stepdir)
     expected_improve_rate = tree_vdot(neg_g, stepdir) / lm
 
+    ls_constraint = None
+    if cfg.linesearch_kl_cap:
+        # KL-aware acceptance: backtrack past cap-violating candidates
+        # instead of rolling the whole update back post-hoc (the rollback
+        # guard below then ~never fires; it stays as the safety net)
+        kl_cap = jnp.float32(cfg.kl_rollback_factor * cfg.max_kl)
+        ls_constraint = lambda x: kl_to_old_fn(x) <= kl_cap
     ls = backtracking_linesearch(
         surr_fn,
         x0,
@@ -261,6 +360,7 @@ def _natural_gradient_update(
         expected_improve_rate,
         max_backtracks=cfg.linesearch_backtracks,
         accept_ratio=cfg.linesearch_accept_ratio,
+        constraint_fn=ls_constraint,
     )
 
     # KL rollback (ref trpo_inksci.py:157-158).
@@ -302,18 +402,27 @@ def _natural_gradient_update(
 
 
 def make_trpo_update(
-    policy: Policy, cfg: TRPOConfig
+    policy: Policy, cfg: TRPOConfig, allow_fused: bool = True
 ) -> Callable[[Any, TRPOBatch], Tuple[Any, TRPOStats]]:
     """Build the fused update in the FLAT-VECTOR domain — the reference's
     parameter contract (SURVEY §1: flat-vector in, flat-vector out). Jit the
     result (or pass it to ``trpo_tpu.parallel.make_sharded_update`` for a
-    mesh-sharded version)."""
+    mesh-sharded version).
+
+    ``allow_fused=False`` resolves ``fvp_mode="auto"``/``"fused"`` to the
+    XLA GGN operator — required wherever the update body is transformed
+    in ways the Pallas kernel does not compose with (GSPMD batch
+    sharding, ``vmap`` over population members: the kernel's
+    grid-accumulation pattern assumes ITS grid axis 0 is the batch-block
+    axis).
+    """
 
     def update(params, batch: TRPOBatch, damping=None):
         flat0, unravel = flatten_params(params)
         flat0 = jnp.asarray(flat0, jnp.float32)
         return _natural_gradient_update(
-            policy, cfg, unravel, flat0, batch, damping
+            policy, cfg, unravel, flat0, batch, damping,
+            allow_fused=allow_fused,
         )
 
     return update
@@ -338,8 +447,11 @@ def make_tree_trpo_update(
     """
 
     def update(params, batch: TRPOBatch, damping=None):
+        # allow_fused=False: the pytree domain exists for tensor-sharded
+        # leaves (GSPMD), which the Pallas kernel does not partition
         return _natural_gradient_update(
-            policy, cfg, lambda p: p, tree_f32(params), batch, damping
+            policy, cfg, lambda p: p, tree_f32(params), batch, damping,
+            allow_fused=False,
         )
 
     return update
