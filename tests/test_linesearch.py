@@ -97,3 +97,51 @@ def test_jittable():
         return backtracking_linesearch(f, x, jnp.asarray([2.0]), jnp.asarray(4.0)).x
 
     np.testing.assert_allclose(np.asarray(run(jnp.zeros(1))), [2.0], rtol=1e-6)
+
+
+def test_constraint_fn_backtracks_past_infeasible():
+    """KL-aware acceptance (cfg.linesearch_kl_cap): a candidate that
+    passes the surrogate test but violates the constraint must be
+    rejected, and the search must settle on the first feasible shrink."""
+    # loss improves monotonically along the step; constraint caps its size
+    loss = lambda x: jnp.sum(-x)
+    x0 = jnp.zeros((3,))
+    fullstep = jnp.ones((3,))
+    cap = lambda x: jnp.sum(x) <= 1.6  # full step (3.0) infeasible, half ok
+    res = backtracking_linesearch(
+        loss, x0, fullstep, expected_improve_rate=jnp.float32(3.0),
+        constraint_fn=cap,
+    )
+    assert bool(res.success)
+    assert float(res.step_fraction) == 0.5
+    # without the constraint the full step is accepted
+    res0 = backtracking_linesearch(
+        loss, x0, fullstep, expected_improve_rate=jnp.float32(3.0)
+    )
+    assert float(res0.step_fraction) == 1.0
+
+
+def test_kl_cap_update_never_rolls_back():
+    """With linesearch_kl_cap the post-hoc rollback guard is subsumed:
+    any accepted candidate already satisfies the cap."""
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.models import BoxSpec, make_policy
+    from trpo_tpu.trpo import TRPOBatch, make_trpo_update
+
+    policy = make_policy((6,), BoxSpec(3), hidden=(16,),
+                         compute_dtype=jnp.float32)
+    params = policy.init(jax.random.key(0))
+    obs = jax.random.normal(jax.random.key(1), (256, 6), jnp.float32)
+    dist = policy.apply(params, obs)
+    actions = policy.dist.sample(jax.random.key(2), dist)
+    batch = TRPOBatch(
+        obs=obs, actions=actions,
+        advantages=jax.random.normal(jax.random.key(3), (256,)),
+        old_dist=dist, weight=jnp.ones((256,)),
+    )
+    cfg = TRPOConfig(linesearch_kl_cap=True, max_kl=0.01, cg_iters=10)
+    p_new, stats = jax.jit(make_trpo_update(policy, cfg))(params, batch)
+    assert not bool(stats.rolled_back)
+    if bool(stats.linesearch_success):
+        cap = cfg.kl_rollback_factor * cfg.max_kl
+        assert float(stats.kl) <= cap * (1 + 1e-4)

@@ -65,16 +65,24 @@ def _worker(conn, env_id: str, count: int, seed_base: int, kwargs: dict):
 
         from trpo_tpu.envs.gym_state import restore_one, snapshot_one
 
+        # "package.module:ClassName" constructs the class directly (no
+        # registry needed in the spawned interpreter — the overlap probe
+        # envs/sleep_env.py uses this). Anything that does not resolve to
+        # a class falls through to gymnasium.make, which has its own
+        # documented "module:EnvId" import-then-registry semantics.
+        env_ctor = None
         if ":" in env_id:
-            # "package.module:ClassName" — construct the class directly
-            # (no gymnasium registry needed in the spawned interpreter);
-            # used by the overlap probe (envs/sleep_env.py) and any
-            # unregistered custom env
             import importlib
 
             mod_name, attr = env_id.split(":", 1)
-            cls = getattr(importlib.import_module(mod_name), attr)
-            envs = [cls(**kwargs) for _ in range(count)]
+            try:
+                obj = getattr(importlib.import_module(mod_name), attr)
+            except (ImportError, AttributeError):
+                obj = None
+            if isinstance(obj, type):
+                env_ctor = obj
+        if env_ctor is not None:
+            envs = [env_ctor(**kwargs) for _ in range(count)]
         else:
             envs = [gymnasium.make(env_id, **kwargs) for _ in range(count)]
         single = envs[0]

@@ -15,7 +15,7 @@ accepted, return the original parameters (``utils.py:182``).
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +41,7 @@ def backtracking_linesearch(
     max_backtracks: int = 10,
     accept_ratio: float = 0.1,
     backtrack_factor: float = 0.5,
+    constraint_fn: Optional[Callable[[Any], jax.Array]] = None,
 ) -> LinesearchResult:
     """Search along ``fullstep`` from ``x`` minimizing ``loss_fn``.
 
@@ -51,6 +52,15 @@ def backtracking_linesearch(
     ``x``/``fullstep`` may be flat vectors (the reference's contract) or any
     matching pytrees — candidate parameters are carried through the loop in
     whatever (possibly mesh-sharded) layout they arrive in.
+
+    ``constraint_fn`` (optional): a boolean feasibility predicate evaluated
+    at each candidate; acceptance then requires the surrogate criterion AND
+    the constraint. The TRPO update uses this for the KL-aware search
+    (``cfg.linesearch_kl_cap``): backtrack past candidates whose rollout KL
+    exceeds the rollback cap instead of discovering the violation post-hoc
+    and discarding the whole update. One extra ``loss_fn``-sized forward
+    per trial; beyond-reference lever (the reference's search checks the
+    surrogate only, ``utils.py:170-182``).
     """
     fval = loss_fn(x)
 
@@ -73,6 +83,8 @@ def backtracking_linesearch(
         expected_improve = expected_improve_rate * frac
         ratio = actual_improve / expected_improve
         ok = jnp.logical_and(ratio > accept_ratio, actual_improve > 0.0)
+        if constraint_fn is not None:
+            ok = jnp.logical_and(ok, constraint_fn(xnew))
         return k + 1, ok, xnew, newfval, frac
 
     k0 = jnp.asarray(0, jnp.int32)

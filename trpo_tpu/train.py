@@ -72,6 +72,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="relative CG exit ‖r‖ <= rtol·‖g‖ — makes --cg-iters a cap "
         "instead of a fixed count (0 = off, reference semantics)",
     )
+    p.add_argument(
+        "--linesearch-kl-cap",
+        action="store_true",
+        help="KL-aware line search: candidates must also satisfy the "
+        "rollback KL cap, so over-long steps backtrack instead of being "
+        "rolled back whole post-hoc",
+    )
     p.add_argument("--gamma", type=float)
     p.add_argument("--lam", type=float)
     p.add_argument("--reward-target", type=float)
@@ -89,10 +96,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--fvp-mode",
-        choices=("ggn", "jvp_grad"),
-        help="Fisher-vector-product factorization: Gauss-Newton (default; "
-        "~1.9× faster on TPU) or jvp-of-grad (the reference's "
-        "double-backprop semantics) — identical solutions either way",
+        choices=("auto", "fused", "ggn", "jvp_grad"),
+        help="Fisher-vector-product factorization: auto (default — the "
+        "fused single-Pallas-kernel operator where the architecture "
+        "qualifies, else Gauss-Newton), fused (require the Pallas "
+        "kernel), ggn (XLA Gauss-Newton; ~1.9× jvp_grad on TPU), or "
+        "jvp-of-grad (the reference's double-backprop semantics) — "
+        "identical solutions in all modes",
     )
     p.add_argument(
         "--policy-hidden",
@@ -194,6 +204,7 @@ _OVERRIDES = {
     "cg_precondition": "cg_precondition",
     "cg_precond_probes": "cg_precond_probes",
     "cg_residual_rtol": "cg_residual_rtol",
+    "linesearch_kl_cap": "linesearch_kl_cap",
     "gamma": "gamma",
     "lam": "lam",
     "reward_target": "reward_target",
