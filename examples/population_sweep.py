@@ -1,18 +1,26 @@
-"""Seed-sweep in one device program: the Population API.
+"""Seed (× hyperparameter) sweep in one device program: the Population API.
 
-The reference reports single-seed results from a single process
-(``trpo_inksci.py:179-181``); RL evidence standards want multi-seed
-spreads. ``trpo_tpu.population.Population`` trains N seeds in lockstep
-under one ``vmap`` — a seed sweep at roughly the cost of one batched run —
-and the fused ``run_iterations`` chunk keeps host syncs off the hot path
-(one per chunk, exactly like ``TRPOAgent.run_iterations``).
+The reference reports single-seed, single-config results from a single
+process (``trpo_inksci.py:179-181``); RL evidence standards want
+multi-seed spreads, and tuning wants a hyperparameter axis next to the
+seed axis. ``trpo_tpu.population.Population`` trains N members in
+lockstep under one ``vmap`` — and with ``--lam-grid`` each member also
+carries its own GAE λ, so a seeds×λ grid (every cell a full TRPO run:
+rollout → GAE(λ_member) → critic fit → natural-gradient update) costs
+ONE batched run. The fused ``run_iterations`` chunk keeps host syncs off
+the hot path (one per chunk).
 
-Run: ``python examples/population_sweep.py [--platform cpu]``
+Seed sweep:   python examples/population_sweep.py [--platform cpu]
+Seeds×λ grid: python examples/population_sweep.py --env humanoid-sim \
+                  --lam-grid 0.9,0.97,1.0 --seeds 2 \
+                  --chunks 4 --iters-per-chunk 50 \
+                  --out scripts/population_sweep_r05.json
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import pathlib
 import sys
 import time
@@ -22,12 +30,28 @@ import numpy as np
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 
+def _chunk_scores(pop, stats):
+    """Per-member episode-weighted mean reward over one chunk — the
+    library's own scoring (``Population.member_scores``), with -inf
+    (never finished an episode) mapped back to NaN for display."""
+    s = np.asarray(pop.member_scores(stats), np.float64)
+    return np.where(np.isinf(s), np.nan, s)
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--platform", choices=("tpu", "cpu"), default=None)
-    p.add_argument("--members", type=int, default=4)
+    p.add_argument("--env", default="cartpole")
+    p.add_argument("--members", type=int, default=4,
+                   help="seed count when no --lam-grid is given")
+    p.add_argument("--lam-grid", default=None,
+                   help="comma-separated GAE λ values — members become "
+                   "the seeds×λ product")
+    p.add_argument("--seeds", type=int, default=2,
+                   help="seeds per λ cell (with --lam-grid)")
     p.add_argument("--chunks", type=int, default=5)
     p.add_argument("--iters-per-chunk", type=int, default=20)
+    p.add_argument("--out", default=None, help="write a JSON evidence row")
     args = p.parse_args()
     if args.members < 1 or args.chunks < 1 or args.iters_per_chunk < 1:
         p.error("--members, --chunks, --iters-per-chunk must be >= 1")
@@ -38,37 +62,75 @@ def main() -> int:
         jax.config.update("jax_platforms", args.platform)
 
     from trpo_tpu.agent import TRPOAgent
-    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.config import get_preset
     from trpo_tpu.population import Population
 
-    cfg = TRPOConfig(env="cartpole", n_envs=8, batch_timesteps=1024,
-                     policy_hidden=(32,), vf_train_steps=20)
+    cfg = get_preset(args.env)
+    if args.env == "cartpole":
+        cfg = cfg.replace(n_envs=8, batch_timesteps=1024,
+                          policy_hidden=(32,), vf_train_steps=20)
     agent = TRPOAgent(cfg.env, cfg)
-    pop = Population(agent, seeds=list(range(args.members)))
+
+    lams = None
+    if args.lam_grid:
+        grid = [float(v) for v in args.lam_grid.split(",") if v.strip()]
+        if not grid:
+            p.error("--lam-grid must list at least one λ")
+        seeds = [s for _ in grid for s in range(args.seeds)]
+        lams = [l for l in grid for _ in range(args.seeds)]
+        pop = Population(agent, seeds=seeds, lam=lams)
+        labels = [f"λ={l:g}/s{s}" for l, s in zip(lams, seeds)]
+    else:
+        pop = Population(agent, seeds=list(range(args.members)))
+        labels = [f"s{s}" for s in pop.seeds]
 
     t0 = time.perf_counter()
+    history = []
     for chunk in range(args.chunks):
         stats = pop.run_iterations(args.iters_per_chunk)
-        # stats leaves are (members, iters-per-chunk); take each member's
-        # last finite reward in the chunk
-        r = np.asarray(stats["mean_episode_reward"])
-        finals = [
-            next((v for v in row[::-1] if not np.isnan(v)), float("nan"))
-            for row in r
-        ]
+        scores = _chunk_scores(pop, stats)
+        history.append(scores)
         print(
             f"iter {(chunk + 1) * args.iters_per_chunk:>4}  "
-            f"reward per seed: "
-            + "  ".join(f"{v:7.1f}" for v in finals)
-            + f"   (spread {np.nanmax(finals) - np.nanmin(finals):.1f})"
+            + "  ".join(
+                f"{lab}:{v:8.1f}" for lab, v in zip(labels, scores)
+            )
         )
     dt = time.perf_counter() - t0
     total = args.chunks * args.iters_per_chunk
+    n_members = len(pop.seeds)
     print(
-        f"{args.members} seeds x {total} iterations in {dt:.1f}s "
-        f"({args.members * total / dt:.1f} member-updates/s); "
-        f"best member: seed {pop.best_member(stats)}"
+        f"{n_members} members x {total} iterations in {dt:.1f}s "
+        f"({n_members * total / dt:.1f} member-updates/s); "
+        f"best member: {labels[pop.best_member(stats)]}"
     )
+
+    if args.lam_grid:
+        # per-λ summary over seeds, final chunk
+        final = history[-1]
+        print("final-chunk reward by λ (mean over seeds ± spread):")
+        for i, l in enumerate(grid):
+            cell = final[i * args.seeds:(i + 1) * args.seeds]
+            print(
+                f"  λ={l:g}: {np.nanmean(cell):8.1f} "
+                f"± {np.nanmax(cell) - np.nanmin(cell):6.1f}"
+            )
+
+    if args.out:
+        row = {
+            "env": args.env,
+            "members": n_members,
+            "labels": labels,
+            "iterations": total,
+            "wall_s": round(dt, 2),
+            "member_updates_per_sec": round(n_members * total / dt, 2),
+            "final_chunk_scores": [
+                None if np.isnan(v) else round(float(v), 2)
+                for v in history[-1]
+            ],
+        }
+        with open(args.out, "w") as f:
+            json.dump(row, f, indent=1)
     return 0
 
 

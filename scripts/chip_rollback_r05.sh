@@ -1,0 +1,24 @@
+#!/bin/bash
+# Round-5 rollback study (VERDICT r4 item 2): does KL-aware line search
+# (linesearch_kl_cap) absorb the residual-aware solve's rollback spike
+# at equal-or-better reward and wall-clock? Single-variable arms, same
+# seed/protocol as chip_r04; kl_quadratic_pred is logged for the
+# root-cause analysis. One TPU process at a time (single-tenant).
+set -u
+cd /root/repo
+OUT=chip_r05
+mkdir -p "$OUT"
+run () {
+  name=$1; shift
+  echo "=== $name $(date -u +%H:%M:%S) ==="
+  python -m trpo_tpu.train --preset humanoid-sim --iterations 2000 \
+    --fuse-iterations 50 --log-jsonl "$OUT/$name.jsonl" "$@" \
+    > "$OUT/$name.out" 2>&1
+  echo "rc=$?"
+}
+run hsim_fixed10_s0     --seed 0
+run hsim_rtol_s0        --seed 0 --cg-residual-rtol 0.25 --cg-iters 60
+run hsim_rtol_klcap_s0  --seed 0 --cg-residual-rtol 0.25 --cg-iters 60 --linesearch-kl-cap
+run hsim_rtol_s1        --seed 1 --cg-residual-rtol 0.25 --cg-iters 60
+run hsim_rtol_klcap_s1  --seed 1 --cg-residual-rtol 0.25 --cg-iters 60 --linesearch-kl-cap
+echo "ALL DONE $(date -u +%H:%M:%S)"

@@ -1,0 +1,192 @@
+"""Structured (Gaussian-head block) preconditioner vs the real late
+Fisher (round 5, VERDICT r4 item 7: "structured or sunset").
+
+Round 4 measured the Jacobi diagonal ineffective on the late TRPO
+Fisher (off-diagonal-dominated; ``scripts/late_cg_r04_cpu.json``). This
+probe evaluates the next structured rung: the EXACT inverse of the
+damped Fisher's Gaussian-head block (``ops/precond.
+make_gaussian_head_block_inv`` — the block whose curvature grows ∝ 1/σ²
+as the policy sharpens), identity on the torso, replayed against the
+same late HalfCheetah checkpoint protocol as the round-4 study.
+
+Budget accounting: the block preconditioner costs ZERO extra FVPs (one
+(H+1)² eigh + two small matmuls per iteration), so plain_k vs block_k at
+the same k IS the equal-cost comparison.
+
+Usage::
+
+    python scripts/explore_block_precond_r05.py \
+        --checkpoint-dir ab_r04/ckpts/hc_lam097_const \
+        --platform cpu --out scripts/block_precond_r05.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--step", type=int, default=None)
+    p.add_argument("--preset", default="halfcheetah")
+    p.add_argument("--n-envs", type=int, default=25)
+    p.add_argument("--batch-timesteps", type=int, default=5000)
+    p.add_argument("--dampings", default="0.1,0.01")
+    p.add_argument("--platform", choices=("tpu", "cpu"), default=None)
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import get_preset
+    from trpo_tpu.models.mlp import ACTIVATIONS
+    from trpo_tpu.ops import conjugate_gradient, flatten_params, make_ggn_fvp
+    from trpo_tpu.ops.precond import make_gaussian_head_block_inv
+    from trpo_tpu.rollout import host_rollout
+    from trpo_tpu.trpo import TRPOBatch, standardize_advantages, surrogate_loss
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    cfg = dataclasses.replace(
+        get_preset(args.preset),
+        n_envs=args.n_envs,
+        batch_timesteps=args.batch_timesteps,
+        normalize_obs=True,
+        host_inference="cpu",
+    )
+    agent = TRPOAgent(cfg.env, cfg)
+    ck = Checkpointer(args.checkpoint_dir, cg_damping_seed=cfg.cg_damping)
+    step = args.step if args.step is not None else ck.latest_step()
+    if step is None:
+        print(f"no checkpoints in {args.checkpoint_dir}", file=sys.stderr)
+        return 1
+    state = ck.restore(agent.init_state(), step=step)
+    agent.restore_host_env(ck.restore_host_env(step))
+    print(f"restored step {step}", file=sys.stderr)
+
+    rng = jax.random.fold_in(state.rng, int(state.iteration))
+    if agent._obs_norm_host:
+        agent.env.set_obs_stats_state(
+            tuple(np.asarray(x) for x in state.obs_norm)
+        )
+    act_fn = getattr(agent, "_host_act_fn", None) or agent._make_host_act()
+    params_roll = state.policy_params
+    if agent._host_inference_cpu:
+        cpu = agent._host_cpu_device
+        params_roll = jax.device_put(params_roll, cpu)
+        rng = jax.device_put(rng, cpu)
+    traj = host_rollout(
+        agent.env, agent.policy, params_roll, rng, agent.n_steps,
+        act_fn=act_fn,
+    )
+    T, N = traj.rewards.shape
+    flat = lambda x: x.reshape((T * N,) + x.shape[2:])
+    adv, _vt, _v = agent._advantages(state.vf_state, traj)
+    weight = jnp.ones(T * N, jnp.float32)
+    batch = TRPOBatch(
+        obs=flat(traj.obs),
+        actions=flat(traj.actions),
+        advantages=standardize_advantages(flat(adv), weight),
+        old_dist=jax.tree_util.tree_map(flat, traj.old_dist),
+        weight=weight,
+    )
+    log_std = np.asarray(state.policy_params["log_std"])
+    print(f"mean log_std {log_std.mean():.3f}", file=sys.stderr)
+
+    policy = agent.policy
+    params = state.policy_params
+    flat0, unravel = flatten_params(params)
+    flat0 = jnp.asarray(flat0, jnp.float32)
+    act = ACTIVATIONS[cfg.policy_activation]
+
+    def torso_apply(net, obs):
+        h = obs
+        for layer in net["layers"][:-1]:
+            h = act(h @ layer["w"] + layer["b"])
+        return h
+
+    def make_case(damping, iters, block):
+        @jax.jit
+        def run(flat0, batch):
+            surr = lambda x: surrogate_loss(policy, unravel(x), batch)
+            g = jax.grad(surr)(flat0)
+            neg_g = -g
+            fvp = make_ggn_fvp(
+                lambda x: policy.apply(unravel(x), batch.obs),
+                policy.dist.fisher_weight,
+                flat0, batch.weight, damping=damping,
+            )
+            M_inv = None
+            if block:
+                p0 = unravel(flat0)
+                M_inv = make_gaussian_head_block_inv(
+                    torso_apply, p0["net"],
+                    batch.obs.reshape(batch.obs.shape[0], -1),
+                    batch.weight, p0["log_std"], damping,
+                    unravel=unravel,
+                )
+            cg = conjugate_gradient(
+                fvp, neg_g, cg_iters=iters, residual_tol=0.0, M_inv=M_inv
+            )
+            return {
+                "cg_iterations_used": cg.iterations,
+                "residual_sq": cg.residual_norm_sq,
+                "rel_residual": jnp.sqrt(
+                    cg.residual_norm_sq / jnp.vdot(neg_g, neg_g)
+                ),
+            }
+
+        return run
+
+    rows = []
+    for damping in [float(s) for s in args.dampings.split(",") if s.strip()]:
+        for label, iters, block in (
+            ("plain_10", 10, False),
+            ("blockhead_10", 10, True),
+            ("plain_15", 15, False),
+            ("blockhead_15", 15, True),
+            ("plain_20", 20, False),
+            ("blockhead_20", 20, True),
+            ("plain_30", 30, False),
+            ("blockhead_30", 30, True),
+        ):
+            run = make_case(damping, iters, block)
+            t0 = time.perf_counter()
+            out = jax.device_get(run(flat0, batch))
+            wall = (time.perf_counter() - t0) * 1e3
+            row = {"config": label, "damping": damping,
+                   "wall_ms_incl_compile": round(wall, 1),
+                   **{k: float(v) for k, v in out.items()}}
+            rows.append(row)
+            print(json.dumps(row), file=sys.stderr)
+
+    result = {
+        "checkpoint_dir": args.checkpoint_dir,
+        "step": int(step),
+        "mean_log_std": float(log_std.mean()),
+        "backend": jax.default_backend(),
+        "rows": rows,
+    }
+    print(json.dumps(result, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -90,10 +90,11 @@ def test_population_best_member_episode_weighted():
             [[3, 0, 1], [0, 1, 0], [0, 0, 0]]
         ),
     }
-    assert Population.best_member(None, stats) == 1
+    pop = Population.__new__(Population)  # scoring is state-free
+    assert pop.best_member(stats) == 1
     # single-iteration form (no chunk axis)
     stats1 = {
         "mean_episode_reward": jnp.array([jnp.nan, 5.0]),
         "episodes_in_batch": jnp.array([0, 2]),
     }
-    assert Population.best_member(None, stats1) == 1
+    assert pop.best_member(stats1) == 1

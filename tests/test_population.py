@@ -171,3 +171,34 @@ def test_population_with_adaptive_damping():
     lam = np.asarray(pop.state.cg_damping)
     assert lam.shape == (3,)
     assert np.all((lam >= agent.cfg.damping_min) & (lam <= agent.cfg.damping_max))
+
+
+def test_population_lam_axis():
+    """Per-member GAE-λ (the hyperparameter axis of a sweep): members
+    with λ == cfg.lam reproduce the plain population bit-for-bit, and a
+    different λ actually changes the member's training path."""
+    from trpo_tpu.population import Population
+
+    agent = _agent()
+    cfg_lam = float(agent.cfg.lam)
+    plain = Population(agent, seeds=[0, 1])
+    swept = Population(agent, seeds=[0, 1], lam=[cfg_lam, 0.5])
+    s_plain = plain.run_iterations(3)
+    s_swept = swept.run_iterations(3)
+    # member 0 carries cfg.lam -> identical trajectory
+    np.testing.assert_array_equal(
+        np.asarray(s_plain["kl_old_new"])[0],
+        np.asarray(s_swept["kl_old_new"])[0],
+    )
+    # member 1 carries a different lambda -> different updates
+    assert not np.allclose(
+        np.asarray(s_plain["surrogate_loss"])[1],
+        np.asarray(s_swept["surrogate_loss"])[1],
+    )
+
+
+def test_population_lam_length_mismatch():
+    from trpo_tpu.population import Population
+
+    with pytest.raises(ValueError, match="parallel to seeds"):
+        Population(_agent(), seeds=[0, 1], lam=[0.9])

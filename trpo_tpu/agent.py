@@ -542,7 +542,12 @@ class TRPOAgent:
             join(traj.next_obs, traj.policy_h_next),
         )
 
-    def _advantages(self, vf_state: VFState, traj: Trajectory):
+    def _advantages(self, vf_state: VFState, traj: Trajectory, lam=None):
+        """``lam`` (optional traced scalar) overrides ``cfg.lam`` — the
+        per-member hyperparameter axis of ``Population`` sweeps (the
+        sequence-parallel GAE bakes λ into its shard_map and does not
+        take the override, but population agents are meshless by
+        contract)."""
         T, N = traj.rewards.shape
         vf_in, vf_next_in = self._vf_features(traj)
         values = self.vf.predict(vf_state, vf_in).reshape(T, N)
@@ -563,14 +568,18 @@ class TRPOAgent:
                 traj.terminated,
                 traj.done,
                 self.cfg.gamma,
-                self.cfg.lam,
+                self.cfg.lam if lam is None else lam,
                 backend=self.cfg.scan_backend,
             )
         return adv, vtarg, values
 
-    def _process_trajectory(self, train_state: TrainState, traj: Trajectory):
+    def _process_trajectory(
+        self, train_state: TrainState, traj: Trajectory, lam=None
+    ):
         """advantages → critic fit → TRPO update → stats. One jitted
-        program; shared by the device and host paths."""
+        program; shared by the device and host paths. ``lam`` threads a
+        per-member GAE-λ override into the advantages (Population
+        hyperparameter sweeps)."""
         cfg = self.cfg
         T, N = traj.rewards.shape
         flat = lambda x: x.reshape((T * N,) + x.shape[2:])
@@ -589,7 +598,7 @@ class TRPOAgent:
                 next_obs=normalize(stats, traj.next_obs),
             )
 
-        adv, vtarg, values = self._advantages(train_state.vf_state, traj)
+        adv, vtarg, values = self._advantages(train_state.vf_state, traj, lam)
         weight = jnp.ones(T * N, jnp.float32)
         adv_flat = flat(adv)
         if cfg.standardize_advantages:  # ref trpo_inksci.py:115-117
@@ -689,8 +698,9 @@ class TRPOAgent:
         )
         return new_state, stats
 
-    def _device_iteration(self, train_state: TrainState, _=None):
-        """rollout + process as ONE program (pure-JAX envs only)."""
+    def _device_iteration(self, train_state: TrainState, _=None, lam=None):
+        """rollout + process as ONE program (pure-JAX envs only).
+        ``lam``: optional traced GAE-λ override (Population sweeps)."""
         rng, k_roll = jax.random.split(train_state.rng)
         train_state = train_state._replace(rng=rng)
         new_carry, traj = device_rollout(
@@ -702,7 +712,7 @@ class TRPOAgent:
             self.n_steps,
         )
         train_state = train_state._replace(env_carry=new_carry)
-        return self._process_trajectory(train_state, traj)
+        return self._process_trajectory(train_state, traj, lam=lam)
 
     def run_iterations(self, train_state: TrainState, n: int):
         """``n`` full training iterations as ONE device program.
@@ -728,12 +738,22 @@ class TRPOAgent:
             fn = self._multi_iter_fns[n] = jax.jit(self.make_scan_body(n))
         return fn(train_state)
 
-    def make_scan_body(self, n: int):
+    def make_scan_body(self, n: int, with_lam: bool = False):
         """``state -> (state, stats)`` running ``n`` fused iterations via
         ``lax.scan`` — the shared chunk body behind :meth:`run_iterations`
         and ``Population.run_iterations`` (which wraps it in the member
-        ``vmap``). ``_device_iteration`` already has the ``(carry, _)``
-        scan-body signature."""
+        ``vmap``). With ``with_lam`` the returned body takes ``(state,
+        lam)`` and threads the per-member GAE-λ override into every
+        iteration (Population hyperparameter sweeps)."""
+
+        if with_lam:
+            def many_lam(state, lam):
+                def body(st, _):
+                    return self._device_iteration(st, lam=lam)
+
+                return jax.lax.scan(body, state, None, length=n)
+
+            return many_lam
 
         def many(state):
             return jax.lax.scan(

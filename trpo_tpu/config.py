@@ -10,7 +10,7 @@ Here every knob is an explicit dataclass field, and the benchmark ladder from
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 
 @dataclasses.dataclass
@@ -55,17 +55,30 @@ class TRPOConfig:
     #                                cg_iters a cap ("until solved, at most
     #                                N") instead of a fixed count. 0 = off
     #                                (reference semantics)
-    cg_precondition: bool = False  # diagonal (Jacobi) preconditioned CG
-    #                                (ops/precond.py). Effective when the
-    #                                Fisher's pathology is diagonal-scale
-    #                                (collapses a 6-orders synthetic spread
-    #                                to 1 iteration). MEASURED INEFFECTIVE
-    #                                on the real late-training Fisher,
-    #                                whose ill-conditioning is mostly
-    #                                off-diagonal — see BENCH_LADDER
-    #                                "Late-training solver study"; prefer
-    #                                cg_residual_rtol there. Costs
-    #                                cg_precond_probes extra FVPs/update
+    cg_precondition: Any = False   # preconditioned CG (ops/precond.py):
+    #                                False = off (reference semantics);
+    #                                "jacobi" (or True, back-compat) =
+    #                                Hutchinson-probe diagonal — effective
+    #                                for diagonal-scale pathologies
+    #                                (6-orders synthetic spread → 1 iter),
+    #                                MEASURED INEFFECTIVE on the real
+    #                                late-training Fisher (off-diagonal-
+    #                                dominated; BENCH_LADDER "Late-training
+    #                                solver study"); costs cg_precond_probes
+    #                                extra FVPs/update.
+    #                                "head_block" = EXACT inverse of the
+    #                                Gaussian action head's Fisher block
+    #                                (S̃⊗e^{-2σ} + λ)⁻¹, identity on the
+    #                                torso — zero extra FVPs, and the first
+    #                                preconditioner WIN on the real late
+    #                                Fisher: 1.9× lower residual at the
+    #                                reference's fixed-10 budget (plain
+    #                                needs ~15 iters to match); flips to a
+    #                                small loss beyond ~15 iterations, so
+    #                                pair it with short fixed budgets, not
+    #                                with cg_residual_rtol caps
+    #                                (scripts/block_precond_r05.json).
+    #                                Needs the plain-MLP Gaussian policy.
     cg_precond_probes: int = 8     # Hutchinson probes for the diagonal
     #                                estimate (±1 vectors; K probes ≈
     #                                1/√K off-diagonal noise)
@@ -233,6 +246,13 @@ class TRPOConfig:
             raise ValueError(
                 'fvp_mode must be "auto", "fused", "ggn" or "jvp_grad", '
                 f"got {self.fvp_mode!r}"
+            )
+        if self.cg_precondition not in (
+            False, True, "jacobi", "head_block"
+        ):
+            raise ValueError(
+                'cg_precondition must be False, "jacobi" (True), or '
+                f'"head_block", got {self.cg_precondition!r}'
             )
         if self.adaptive_damping:
             if not self.damping_grow > 1.0:

@@ -65,11 +65,12 @@ def _worker(conn, env_id: str, count: int, seed_base: int, kwargs: dict):
 
         from trpo_tpu.envs.gym_state import restore_one, snapshot_one
 
-        # "package.module:ClassName" constructs the class directly (no
-        # registry needed in the spawned interpreter — the overlap probe
-        # envs/sleep_env.py uses this). Anything that does not resolve to
-        # a class falls through to gymnasium.make, which has its own
-        # documented "module:EnvId" import-then-registry semantics.
+        # "package.module:attr" where attr is a class or factory callable
+        # constructs envs directly (no registry needed in the spawned
+        # interpreter — the overlap probe envs/sleep_env.py uses this).
+        # Anything that does not resolve to a callable falls through to
+        # gymnasium.make, which has its own documented "module:EnvId"
+        # import-then-registry semantics.
         env_ctor = None
         if ":" in env_id:
             import importlib
@@ -79,7 +80,7 @@ def _worker(conn, env_id: str, count: int, seed_base: int, kwargs: dict):
                 obj = getattr(importlib.import_module(mod_name), attr)
             except (ImportError, AttributeError):
                 obj = None
-            if isinstance(obj, type):
+            if callable(obj):
                 env_ctor = obj
         if env_ctor is not None:
             envs = [env_ctor(**kwargs) for _ in range(count)]

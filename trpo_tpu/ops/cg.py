@@ -51,9 +51,13 @@ class CGResult(NamedTuple):
 
 
 def _apply_Minv(M_inv: Optional[Any], r: Any) -> Any:
-    """z = M⁻¹ r for a diagonal preconditioner; identity when None."""
+    """z = M⁻¹ r. ``M_inv`` may be a pytree of inverse-diagonal entries
+    (Jacobi), a callable ``r ↦ M⁻¹r`` (structured/block preconditioners —
+    must be SPD and jit-traceable), or None (identity)."""
     if M_inv is None:
         return r
+    if callable(M_inv):
+        return M_inv(r)
     return jax.tree_util.tree_map(
         lambda m, x: jnp.asarray(m, jnp.float32) * x, M_inv, r
     )

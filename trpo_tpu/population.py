@@ -39,7 +39,14 @@ class Population:
         seeds: Sequence[int],
         mesh=None,
         axis: str = "data",
+        lam=None,
     ):
+        """``lam`` (optional): per-member GAE-λ, parallel to ``seeds`` —
+        the hyperparameter axis of a population sweep. A seeds×λ grid is
+        the product spelled out member-wise (``examples/
+        population_sweep.py --lam-grid``): every (seed, λ) cell trains
+        in the same single device program, so multi-seed × multi-λ
+        evidence costs one batched run."""
         if not agent.is_device_env:
             raise ValueError(
                 "Population needs a pure-JAX device env (host simulators "
@@ -52,6 +59,11 @@ class Population:
             )
         if len(seeds) == 0:
             raise ValueError("population needs at least one seed")
+        if lam is not None and len(lam) != len(seeds):
+            raise ValueError(
+                f"lam must be parallel to seeds: {len(lam)} λ values for "
+                f"{len(seeds)} members"
+            )
         if mesh is not None and len(seeds) % mesh.shape[axis] != 0:
             raise ValueError(
                 f"population size {len(seeds)} must divide evenly over the "
@@ -78,13 +90,35 @@ class Population:
         state = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *states
         )
+        self._lam = (
+            None if lam is None else jnp.asarray(lam, jnp.float32)
+        )
         if mesh is not None:
             from trpo_tpu.parallel import shard_leading_axis
 
             state = shard_leading_axis(mesh, state, axis)
+            if self._lam is not None:
+                self._lam = shard_leading_axis(mesh, self._lam, axis)
         self.state: TrainState = state
-        self._step = jax.jit(jax.vmap(agent._device_iteration))
+        self._step = jax.jit(
+            jax.vmap(
+                agent._device_iteration
+                if self._lam is None
+                else (
+                    lambda st, lam_i: agent._device_iteration(
+                        st, lam=lam_i
+                    )
+                )
+            )
+        )
         self._multi_fns = {}
+
+    def _member_args(self, _n=None):
+        return (
+            (self.state,)
+            if self._lam is None
+            else (self.state, self._lam)
+        )
 
     @property
     def size(self) -> int:
@@ -93,7 +127,7 @@ class Population:
     def run_iteration(self):
         """Advance every member one training iteration; returns the stats
         pytree with a leading population axis."""
-        self.state, stats = self._step(self.state)
+        self.state, stats = self._step(*self._member_args())
         return stats
 
     def run(self, n_iterations: int):
@@ -113,24 +147,30 @@ class Population:
         fn = self._multi_fns.get(n)
         if fn is None:
             fn = self._multi_fns[n] = jax.jit(
-                jax.vmap(self.agent.make_scan_body(n))
+                jax.vmap(
+                    self.agent.make_scan_body(
+                        n, with_lam=self._lam is not None
+                    )
+                )
             )
-        self.state, stats = fn(self.state)
+        self.state, stats = fn(*self._member_args(n))
         return stats
 
     def member_state(self, i: int) -> TrainState:
         """Extract one member's TrainState (e.g. the selection winner)."""
         return jax.tree_util.tree_map(lambda x: x[i], self.state)
 
-    def best_member(self, stats) -> int:
-        """Index of the member with the highest episode-weighted mean
-        return (NaN batches — no finished episode — contribute nothing;
-        a member that never finished an episode scores ``-inf``). Accepts
-        per-iteration stats (leading member axis) or a fused
-        ``run_iterations`` pytree (``(member, n)`` leaves): each member is
-        scored by the mean over ALL episodes it completed in the chunk —
-        the same cross-batch running-mean semantics as the agent's
-        ``reward_running`` (envs/episode_stats.RunningEpisodeMean)."""
+    def member_scores(self, stats) -> jnp.ndarray:
+        """Per-member episode-weighted mean return (NaN batches — no
+        finished episode — contribute nothing; a member that never
+        finished one scores ``-inf``). Accepts per-iteration stats
+        (leading member axis) or a fused ``run_iterations`` pytree
+        (``(member, n)`` leaves): each member is scored over ALL episodes
+        it completed in the chunk — the same cross-batch running-mean
+        semantics as the agent's ``reward_running``
+        (envs/episode_stats.RunningEpisodeMean). The single source of
+        truth for both :meth:`best_member` and sweep reporting
+        (``examples/population_sweep.py``)."""
         r = jnp.asarray(stats["mean_episode_reward"], jnp.float32)
         if "episodes_in_batch" in stats:
             c = jnp.asarray(stats["episodes_in_batch"], jnp.float32)
@@ -143,5 +183,8 @@ class Population:
                 total, 1.0
             )
             r = jnp.where(total > 0, score, -jnp.inf)
-        r = jnp.nan_to_num(r, nan=-jnp.inf)
-        return int(jnp.argmax(r))
+        return jnp.nan_to_num(r, nan=-jnp.inf)
+
+    def best_member(self, stats) -> int:
+        """Index of the member with the highest :meth:`member_scores`."""
+        return int(jnp.argmax(self.member_scores(stats)))

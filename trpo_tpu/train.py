@@ -56,10 +56,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--cg-precondition",
-        action="store_true",
-        help="diagonal (Jacobi) preconditioned CG solve — Hutchinson-probe "
-        "diagonal estimate counteracts late-training Fisher conditioning "
-        "(ops/precond.py)",
+        nargs="?",
+        const="jacobi",
+        choices=("jacobi", "head_block"),
+        default=None,
+        help="preconditioned CG solve (ops/precond.py): 'jacobi' "
+        "(default when the flag is given bare — Hutchinson diagonal; "
+        "measured ineffective on the real late Fisher) or 'head_block' "
+        "(exact Gaussian-head block inverse — zero extra FVPs, 1.9x "
+        "lower residual at fixed-10 budgets on the real late Fisher; "
+        "pair with short fixed budgets, not rtol caps)",
     )
     p.add_argument(
         "--cg-precond-probes",

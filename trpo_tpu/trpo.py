@@ -315,7 +315,49 @@ def _natural_gradient_update(
 
         fvp = make_tree_fvp(kl_fixed_fn, x0, damping=damping)
     M_inv = None
-    if cfg.cg_precondition:
+    if cfg.cg_precondition == "head_block":
+        # Exact inverse of the Gaussian head's Fisher block (identity on
+        # the torso) — zero extra FVPs; the late-training lever for SHORT
+        # fixed budgets (ops/precond.make_gaussian_head_block_inv).
+        from trpo_tpu.models.mlp import ACTIVATIONS
+        from trpo_tpu.ops.precond import make_gaussian_head_block_inv
+
+        spec = getattr(policy, "mlp_spec", None)
+        params0 = to_params(x0)
+        if (
+            spec is None
+            or getattr(policy.dist, "name", None) != "diag_gaussian"
+            or not (
+                isinstance(params0, dict)
+                and set(params0) == {"net", "log_std"}
+            )
+        ):
+            raise ValueError(
+                'cg_precondition="head_block" needs the plain-MLP '
+                "diagonal-Gaussian policy (it inverts that head's exact "
+                'Fisher block); use "jacobi" or False here'
+            )
+        act = ACTIVATIONS[spec["activation"]]
+
+        def torso_apply(net, obs):
+            h = obs.reshape(obs.shape[0], -1)
+            for layer in net["layers"][:-1]:
+                h = act(h @ layer["w"] + layer["b"])
+            return h
+
+        tree_M = make_gaussian_head_block_inv(
+            torso_apply,
+            params0["net"],
+            fb.obs,
+            fb.weight,
+            params0["log_std"],
+            damping,
+        )
+        if hasattr(x0, "shape"):  # flat domain: wrap the tree operator
+            M_inv = lambda r: flatten_params(tree_M(to_params(r)))[0]
+        else:
+            M_inv = tree_M
+    elif cfg.cg_precondition:
         # Jacobi preconditioner from Hutchinson probes against the SAME
         # damped-Fisher operator CG iterates (ops/precond.py). Fixed probe
         # key: updates stay bit-reproducible; the floor at λ is exact
