@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-5 final quiet-chip batch (one TPU process at a time; the box
+# must be otherwise idle for the timed sections).
+set -u
+cd /root/repo
+OUT=chip_r05
+mkdir -p "$OUT"
+
+echo "=== clean bench $(date -u +%H:%M:%S) ==="
+python bench.py > BENCH_LOCAL_r05b.json 2> BENCH_LOCAL_r05b.log
+echo "rc=$?"
+
+echo "=== ladder auto-table $(date -u +%H:%M:%S) ==="
+python bench_ladder.py --out BENCH_LADDER.md > "$OUT/ladder.out" 2>&1
+echo "rc=$?"
+
+echo "=== quiet rtol vs klcap wall pair $(date -u +%H:%M:%S) ==="
+python -m trpo_tpu.train --preset humanoid-sim --iterations 2000 \
+  --fuse-iterations 50 --seed 0 --cg-residual-rtol 0.25 --cg-iters 60 \
+  --log-jsonl "$OUT/hsim_rtol_s0_quiet.jsonl" > "$OUT/hsim_rtol_s0_quiet.out" 2>&1
+echo "rc=$?"
+python -m trpo_tpu.train --preset humanoid-sim --iterations 2000 \
+  --fuse-iterations 50 --seed 0 --cg-residual-rtol 0.25 --cg-iters 60 \
+  --linesearch-kl-cap \
+  --log-jsonl "$OUT/hsim_rtol_klcap_s0_quiet.jsonl" > "$OUT/hsim_rtol_klcap_s0_quiet.out" 2>&1
+echo "rc=$?"
+
+echo "=== population seeds x lambda grid (humanoid-sim) $(date -u +%H:%M:%S) ==="
+python examples/population_sweep.py --env humanoid-sim \
+  --lam-grid 0.9,0.97,1.0 --seeds 2 --chunks 4 --iters-per-chunk 50 \
+  --out scripts/population_sweep_r05.json > "$OUT/pop_sweep.out" 2>&1
+echo "rc=$?"
+echo "ALL DONE $(date -u +%H:%M:%S)"

@@ -29,9 +29,9 @@ OBS_DIM, ACT_DIM, HIDDEN = 376, 17, (256, 256)
 BATCH, CG_ITERS, DAMPING = 50_000, 10, 0.1
 
 
-def build(compute_dtype):
+def build(compute_dtype, hidden=None):
     policy = make_policy(
-        (OBS_DIM,), BoxSpec(ACT_DIM), hidden=HIDDEN,
+        (OBS_DIM,), BoxSpec(ACT_DIM), hidden=hidden or HIDDEN,
         compute_dtype=compute_dtype,
     )
     params = policy.init(jax.random.key(0))
@@ -110,17 +110,28 @@ def time_cg(make_fvp_closure, flat0, g, obs, chain, reps=5):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--block-rows", type=int, default=1024)
+    ap.add_argument("--block-rows", type=int, default=None,
+                    help="default: the kernel's VMEM-budget auto choice")
+    ap.add_argument("--hidden", default=None,
+                    help="comma-separated torso widths (default 256,256)")
     ap.add_argument("--chain", type=int, default=40)
     ap.add_argument("--skip-timing", action="store_true")
     args = ap.parse_args()
+    hidden = (
+        tuple(int(w) for w in args.hidden.split(",") if w.strip())
+        if args.hidden
+        else None
+    )
 
     out = {"backend": jax.default_backend(),
            "device_kind": jax.devices()[0].device_kind,
+           "hidden": list(hidden or HIDDEN),
            "block_rows": args.block_rows}
 
     # ---- parity ----------------------------------------------------
-    policy, params, obs, flat0, unravel, weight = build(jnp.bfloat16)
+    policy, params, obs, flat0, unravel, weight = build(
+        jnp.bfloat16, hidden
+    )
     g = jax.random.normal(jax.random.key(2), flat0.shape, jnp.float32)
     g = g / jnp.linalg.norm(g)
 
@@ -135,7 +146,7 @@ def main():
         )(v)
     )
     # f32 reference (exact-math yardstick)
-    pol32, params32, _, flat32, unravel32, _ = build(jnp.float32)
+    pol32, params32, _, flat32, unravel32, _ = build(jnp.float32, hidden)
     ggn32 = jax.jit(
         lambda v, o: flat_ggn_fvp(pol32, o, flat32, unravel32, weight)(v)
     )
@@ -182,7 +193,8 @@ def main():
         }
         print(json.dumps(out["timing"], indent=1))
 
-    with open("scripts/fvp_kernel_lab.json", "w") as f:
+    suffix = "" if hidden is None else "_" + "x".join(map(str, hidden))
+    with open(f"scripts/fvp_kernel_lab{suffix}.json", "w") as f:
         json.dump(out, f, indent=1)
 
 

@@ -34,7 +34,7 @@ def test_config_overrides():
 
 def test_config_fvp_mode_override():
     cfg = config_from_args(build_parser().parse_args([]))
-    assert cfg.fvp_mode == "ggn"  # the fast factorization is the default
+    assert cfg.fvp_mode == "auto"  # fused-where-eligible is the default
     cfg = config_from_args(
         build_parser().parse_args(["--fvp-mode", "jvp_grad"])
     )
