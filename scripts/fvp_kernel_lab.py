@@ -123,10 +123,20 @@ def main():
         else None
     )
 
+    if args.block_rows is None:
+        # record the tiling actually benchmarked, not null
+        from trpo_tpu.ops.fused_fvp import _LANE, _auto_block_rows, _ceil_to
+
+        h = hidden or HIDDEN
+        block_rows = _auto_block_rows(
+            _ceil_to(OBS_DIM, _LANE), h, _ceil_to(ACT_DIM, _LANE)
+        )
+    else:
+        block_rows = args.block_rows
     out = {"backend": jax.default_backend(),
            "device_kind": jax.devices()[0].device_kind,
            "hidden": list(hidden or HIDDEN),
-           "block_rows": args.block_rows}
+           "block_rows": block_rows}
 
     # ---- parity ----------------------------------------------------
     policy, params, obs, flat0, unravel, weight = build(

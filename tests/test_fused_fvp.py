@@ -193,3 +193,62 @@ def test_supported_predicate():
     assert not fused_fvp_supported("gelu", params["net"])
     assert not fused_fvp_supported("tanh", {"layers": []})
     assert not fused_fvp_supported("tanh", {"wrong": 1})
+
+
+def test_sharded_fused_fvp_parity():
+    """The fused kernel under shard_map (data-parallel): per-device
+    kernels on local batch shards + the psum combine must equal both the
+    sharded XLA GGN spelling and the single-device fused operator on the
+    full batch."""
+    import numpy as np
+    from jax.sharding import Mesh
+    from trpo_tpu.parallel.sharded import (
+        make_sharded_fused_fvp,
+        make_sharded_ggn_fvp,
+        shard_batch,
+    )
+
+    policy, params, obs, weight = _problem(batch=320, pad_tail=40)
+    batch = _batch_for(policy, params, obs, weight)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    cfg = TRPOConfig(cg_damping=0.1)
+    sharded = shard_batch(mesh, batch)
+    v = jax.random.normal(
+        jax.random.key(9), flatten_params(params)[0].shape, jnp.float32
+    )
+
+    y_fused = np.asarray(
+        make_sharded_fused_fvp(policy, cfg, mesh)(params, sharded, v),
+        np.float64,
+    )
+    y_ggn = np.asarray(
+        make_sharded_ggn_fvp(policy, cfg, mesh)(params, sharded, v),
+        np.float64,
+    )
+    # single-device fused on the full batch (same damping)
+    flat0, unravel = flatten_params(params)
+    single = make_fused_gaussian_mlp_fvp(
+        params["net"], obs, weight, params["log_std"], cfg.cg_damping,
+        compute_dtype=jnp.float32, interpret=True,
+    )
+    y_single = np.asarray(
+        flatten_params(jax.jit(lambda vv: single(unravel(vv)))(v))[0],
+        np.float64,
+    )
+    assert np.linalg.norm(y_fused - y_ggn) / np.linalg.norm(y_ggn) < 1e-5
+    assert (
+        np.linalg.norm(y_fused - y_single) / np.linalg.norm(y_single)
+        < 1e-5
+    )
+
+
+def test_sharded_fused_fvp_rejects_categorical():
+    import numpy as np
+    from jax.sharding import Mesh
+    from trpo_tpu.parallel.sharded import make_sharded_fused_fvp
+
+    policy = make_policy((11,), DiscreteSpec(4), hidden=(128,),
+                         compute_dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    with pytest.raises(ValueError, match="diagonal-Gaussian"):
+        make_sharded_fused_fvp(policy, TRPOConfig(), mesh)

@@ -41,6 +41,7 @@ __all__ = [
     "make_sharded_update",
     "make_sharded_fvp",
     "make_sharded_ggn_fvp",
+    "make_sharded_fused_fvp",
 ]
 
 
@@ -142,7 +143,9 @@ def make_sharded_update(
     return sharded
 
 
-def _make_shard_map_fvp(cfg: TRPOConfig, mesh: Mesh, axis: str, local_body):
+def _make_shard_map_fvp(
+    cfg: TRPOConfig, mesh: Mesh, axis: str, local_body, check_vma: bool = True
+):
     """Shared scaffold for the explicit-``shard_map`` FVP spellings.
 
     ``local_body(flat_loc, unravel, local_batch, v_loc)`` returns the
@@ -176,6 +179,10 @@ def _make_shard_map_fvp(cfg: TRPOConfig, mesh: Mesh, axis: str, local_body):
             mesh=mesh,
             in_specs=(P(), spec_batch, P()),
             out_specs=P(),
+            # the Pallas variant's custom-call outputs carry no
+            # varying-mesh-axes metadata; the explicit psum in local_fvp
+            # is the replication proof the checker would otherwise want
+            check_vma=check_vma,
         )
         return shard_fvp(flat0, batch, jnp.asarray(v, jnp.float32))
 
@@ -209,6 +216,73 @@ def make_sharded_fvp(
         return jax.jvp(jax.grad(kl_sum), (flat_loc,), (v_loc,))[1]
 
     return _make_shard_map_fvp(cfg, mesh, axis, local_body)
+
+
+def make_sharded_fused_fvp(
+    policy: Policy,
+    cfg: TRPOConfig,
+    mesh: Mesh,
+    axis: str = "data",
+):
+    """:func:`make_sharded_fvp` with the round-5 FUSED Pallas operator:
+    each shard runs the single-kernel Gauss-Newton sweep
+    (``ops/fused_fvp.py``) on its local batch slice — this is how the
+    fused kernel composes with multi-chip data parallelism. GSPMD cannot
+    partition the kernel's custom call (``make_sharded_update`` therefore
+    keeps the XLA chain), but ``shard_map`` hands each device its LOCAL
+    shapes, so the kernel runs per-device and only the parameter-sized
+    cotangent combine crosses the mesh — the same ``psum(num)/psum(w)``
+    contract as the XLA spellings (numerical parity asserted by
+    ``tests/test_fused_fvp.py::test_sharded_fused_fvp_parity``).
+
+    Requires the plain-MLP diagonal-Gaussian policy (raises otherwise,
+    same eligibility as ``fvp_mode="fused"``).
+    """
+    from trpo_tpu.ops.flat import flatten_params
+    from trpo_tpu.ops.fused_fvp import (
+        _ACT_DERIV,
+        make_fused_gaussian_mlp_fvp,
+    )
+
+    spec = getattr(policy, "mlp_spec", None)
+    if spec is None or getattr(policy.dist, "name", None) != "diag_gaussian":
+        raise ValueError(
+            "make_sharded_fused_fvp needs the plain-MLP diagonal-Gaussian "
+            "policy (fused-kernel eligibility); use make_sharded_ggn_fvp"
+        )
+    # full construct-time eligibility, same checks as fvp_mode="fused"
+    # (trpo._maybe_fused_fvp) — never defer an ineligibility error into
+    # the jitted shard_map trace
+    if spec["activation"] not in _ACT_DERIV:
+        raise ValueError(
+            f"fused FVP supports activations {sorted(_ACT_DERIV)}, got "
+            f"{spec['activation']!r}; use make_sharded_ggn_fvp"
+        )
+    if any(h % 128 for h in spec["hidden"]):
+        raise ValueError(
+            f"fused FVP needs 128-lane-multiple hidden widths, got "
+            f"{spec['hidden']}; use make_sharded_ggn_fvp"
+        )
+
+    def local_body(flat_loc, unravel, local_batch: TRPOBatch, v_loc):
+        params0 = unravel(flat_loc)
+        tree_fvp = make_fused_gaussian_mlp_fvp(
+            params0["net"],
+            local_batch.obs,
+            local_batch.weight,
+            params0["log_std"],
+            0.0,  # damping added by the scaffold, after the psum
+            activation=spec["activation"],
+            compute_dtype=spec["compute_dtype"],
+        )
+        hv = flatten_params(tree_fvp(unravel(v_loc)))[0]
+        # kernel computes the weighted MEAN over the local shard; the
+        # scaffold's psum(num)/psum(weight) contract wants the weighted
+        # SUM — scale back by the local normalizer
+        norm = jnp.maximum(jnp.sum(local_batch.weight), 1.0)
+        return jnp.asarray(hv, jnp.float32) * norm
+
+    return _make_shard_map_fvp(cfg, mesh, axis, local_body, check_vma=False)
 
 
 def make_sharded_ggn_fvp(
