@@ -1,0 +1,18 @@
+#!/bin/bash
+# head_block preconditioner in REAL training (round 5 follow-up): does
+# the fixed-10-budget residual win from the checkpoint-replay study
+# appear in live on-device training? Single-variable pair at the
+# flagship shape.
+set -u
+cd /root/repo
+OUT=chip_r05
+run () {
+  name=$1; shift
+  echo "=== $name $(date -u +%H:%M:%S) ==="
+  python -m trpo_tpu.train --preset humanoid-sim --iterations 2000 \
+    --fuse-iterations 50 --seed 0 --log-jsonl "$OUT/$name.jsonl" "$@" \
+    > "$OUT/$name.out" 2>&1
+  echo "rc=$?"
+}
+run hsim_fixed10_hb_s0 --cg-precondition head_block
+echo "ALL DONE $(date -u +%H:%M:%S)"
