@@ -410,7 +410,12 @@ def time_full_update(device=None, fvp_subsample=None):
         elif fvp_subsample and fvp_subsample < 1.0:
             n_chain = 3 * CHAIN
         else:
-            n_chain = CHAIN
+            # with the round-5 fused kernel a full update is ~3.5 ms, so
+            # CHAIN updates are only ~140 ms — barely above the ~110 ms
+            # tunnel RTT, whose ±20 ms jitter then moves updates/s by
+            # ~±12% (the r05 artifacts' 221–292 band). Double the chain
+            # so the timed window dominates the correction.
+            n_chain = 2 * CHAIN
         n_reps = TIMING_REPS if device is None else 1
 
         @jax.jit
