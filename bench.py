@@ -503,8 +503,11 @@ def time_fused_solve(problem: Problem, device=None, fvp_factory=None):
             g = jax.device_put(np.asarray(g), device)
         # Chaining+RTT-correction exists for the tunneled accelerator; on
         # the CPU paths (fallback or forced) each solve is seconds, RTT is
-        # microseconds — keep the chain short there.
-        n_chain = CHAIN if (_ACCEL and device is None) else 3
+        # microseconds — keep the chain short there. Like the full-update
+        # chain, the round-5 kernel made CHAIN solves (~130 ms) sit too
+        # close to the ~110 ms RTT — double the window so the correction's
+        # jitter stops moving the headline by a few percent.
+        n_chain = 2 * CHAIN if (_ACCEL and device is None) else 3
         n_reps = TIMING_REPS if (_ACCEL and device is None) else 1
         G = _chain_inputs(g, jax.random.key(7), n_chain)
         weight = jnp.ones((BATCH,), jnp.float32)
