@@ -8,6 +8,10 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== tier-1: async host-env pipeline (CPU backend) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_async_pipeline.py -q \
+    -m 'not slow'
+
 echo "== pytest (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q
 

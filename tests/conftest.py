@@ -27,3 +27,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# NOTE: do NOT enable jax's persistent compilation cache here. On this
+# image's jax 0.4.37, executables deserialized from the cache can drop
+# input-output aliasing for donated arguments, silently corrupting
+# results (observed: test_cpu_inference_recurrent bit-equality fails with
+# a warm cache, passes cold). The tier-1 wall-clock budget accounts for
+# full recompiles instead (ROADMAP.md).

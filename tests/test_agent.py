@@ -32,10 +32,12 @@ def small_cfg(**kw):
 def test_iteration_runs_and_updates_state():
     agent = TRPOAgent("cartpole", small_cfg())
     state = agent.init_state()
+    # capture BEFORE the update: run_iteration donates the input state
+    # (agent.py donation contract), so its buffers are dead afterwards
+    f0 = jax.flatten_util.ravel_pytree(state.policy_params)[0]
     state2, stats = agent.run_iteration(state)
     assert int(state2.iteration) == 1
     assert int(state2.total_timesteps) == agent.n_steps * 8
-    f0 = jax.flatten_util.ravel_pytree(state.policy_params)[0]
     f1 = jax.flatten_util.ravel_pytree(state2.policy_params)[0]
     assert float(jnp.linalg.norm(f1 - f0)) > 0.0
     assert np.isfinite(stats["entropy"])

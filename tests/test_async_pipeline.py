@@ -1,0 +1,285 @@
+"""The asynchronous host-env iteration pipeline (ISSUE 1 tentpole).
+
+Three contracts pinned here:
+
+* **Bit-exactness**: ``learn()`` with ``cfg.host_async_pipeline`` produces
+  the SAME final TrainState and the SAME logged stats as the serial
+  driver — same rng fold, same split-phase device programs, in-order
+  stats drain (``agent._learn_host_async`` docstring). Also pinned for
+  the grouped rollout with staged transfers (device-side concat of the
+  same bytes).
+* **Donation safety**: every TrainState-consuming jit donates its state
+  argument; the passed-in state is dead afterwards, the returned state
+  carries everything forward (checkpoint/eval paths included).
+* **Deferred-stats ordering**: every iteration's stats are consumed
+  exactly once, in order — including when a stop condition fires
+  mid-pipeline (``utils/async_pipe.StatsDrain``).
+"""
+
+import io
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.utils.async_pipe import StatsDrain
+from trpo_tpu.utils.metrics import StatsLogger
+
+pytest.importorskip("gymnasium")
+
+_TINY = dict(
+    env="gym:CartPole-v1",
+    n_envs=4,
+    batch_timesteps=48,
+    vf_train_steps=3,
+    policy_hidden=(16,),
+    seed=3,
+)
+
+# wall-clock fields legitimately differ between drivers
+_TIME_KEYS = {"time_elapsed_min", "iteration_ms"}
+
+
+def _leaf_np(x):
+    if hasattr(x, "dtype") and jax.dtypes.issubdtype(
+        x.dtype, jax.dtypes.prng_key
+    ):
+        return np.asarray(jax.random.key_data(x))
+    return np.asarray(x)
+
+
+def _learn_rows(cfg: TRPOConfig, n: int, tmp_path, tag: str):
+    path = str(tmp_path / f"{tag}.jsonl")
+    agent = TRPOAgent(cfg.env, cfg)
+    logger = StatsLogger(jsonl_path=path, stream=io.StringIO())
+    final = agent.learn(n_iterations=n, logger=logger)
+    logger.close()
+    with open(path) as f:
+        rows = [json.loads(line) for line in f]
+    return final, rows
+
+
+def _assert_states_equal(a, b):
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    ):
+        np.testing.assert_array_equal(_leaf_np(la), _leaf_np(lb))
+
+
+def _assert_rows_equal(rows_a, rows_b):
+    assert len(rows_a) == len(rows_b)
+    for ra, rb in zip(rows_a, rows_b):
+        assert set(ra) == set(rb)
+        for k in ra:
+            if k in _TIME_KEYS:
+                continue
+            same = ra[k] == rb[k] or (
+                ra[k] != ra[k] and rb[k] != rb[k]  # NaN == NaN
+            )
+            assert same, (k, ra[k], rb[k])
+
+
+def test_async_learn_bitwise_matches_serial(tmp_path):
+    """Serial and async drivers: identical final state, identical stats
+    rows — sampling policy and all (same rng fold per iteration)."""
+    f_ser, r_ser = _learn_rows(
+        TRPOConfig(**_TINY), 3, tmp_path, "serial"
+    )
+    f_asy, r_asy = _learn_rows(
+        TRPOConfig(**_TINY, host_async_pipeline=True), 3, tmp_path, "async"
+    )
+    _assert_states_equal(f_ser, f_asy)
+    _assert_rows_equal(r_ser, r_asy)
+    assert [r["iteration"] for r in r_asy] == [1, 2, 3]
+
+
+def test_async_grouped_staged_matches_serial_unstaged(tmp_path):
+    """Grouped pipeline + staged transfers (async) == grouped pipeline,
+    one end-of-rollout transfer (serial): staging groups the same bytes
+    differently, it must never change a value."""
+    f_a, r_a = _learn_rows(
+        TRPOConfig(
+            **_TINY, host_pipeline_groups=2, host_staged_transfers=False
+        ),
+        3, tmp_path, "grp_serial",
+    )
+    f_b, r_b = _learn_rows(
+        TRPOConfig(
+            **_TINY,
+            host_pipeline_groups=2,
+            host_staged_transfers=True,
+            host_async_pipeline=True,
+        ),
+        3, tmp_path, "grp_async",
+    )
+    _assert_states_equal(f_a, f_b)
+    _assert_rows_equal(r_a, r_b)
+
+
+def test_async_pipeline_validation():
+    with pytest.raises(ValueError, match="host-simulator"):
+        TRPOAgent(
+            "cartpole", TRPOConfig(env="cartpole", host_async_pipeline=True)
+        )
+    with pytest.raises(ValueError, match="feedforward"):
+        TRPOAgent(
+            "gym:CartPole-v1",
+            TRPOConfig(**{**_TINY, "policy_gru": 8},
+                       host_async_pipeline=True),
+        )
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+
+def test_run_iteration_donates_input_state():
+    """The donation contract: the input TrainState's buffers are consumed
+    (use-after-donate raises), the returned state carries on — through
+    another iteration AND the eval path that re-reads it."""
+    agent = TRPOAgent("gym:CartPole-v1", TRPOConfig(**_TINY))
+    s0 = agent.init_state()
+    s1, _ = agent.run_iteration(s0)
+    leaf0 = jax.tree_util.tree_leaves(s0.policy_params)[0]
+    assert leaf0.is_deleted()
+    with pytest.raises(RuntimeError):
+        np.asarray(leaf0)
+    # the RETURNED state is fully usable: eval re-reads it, the next
+    # iteration consumes it
+    mean_ret, _n = agent.evaluate(s1, n_steps=5)
+    assert np.isfinite(mean_ret)
+    s2, stats = agent.run_iteration(s1)
+    assert int(s2.iteration) == 2
+    assert np.isfinite(stats["entropy"])
+
+
+def test_device_env_iteration_donates_and_continues():
+    cfg = TRPOConfig(
+        env="cartpole", n_envs=4, batch_timesteps=40,
+        vf_train_steps=2, policy_hidden=(8,),
+    )
+    agent = TRPOAgent("cartpole", cfg)
+    s0 = agent.init_state()
+    s1, _ = agent.run_iteration(s0)
+    assert jax.tree_util.tree_leaves(s0.policy_params)[0].is_deleted()
+    s2, _ = agent.run_iterations(s1, 2)
+    assert jax.tree_util.tree_leaves(s1.policy_params)[0].is_deleted()
+    assert int(s2.iteration) == 3
+
+
+# ---------------------------------------------------------------------------
+# deferred stats ordering
+# ---------------------------------------------------------------------------
+
+
+def test_stats_drain_exactly_once_in_order():
+    seen = []
+    drain = StatsDrain(lambda tag, stats: seen.append((tag, stats["v"])))
+    for i in range(10):
+        drain.submit(i, {"v": jnp.asarray(float(i))})
+    drain.drain()
+    drain.close()
+    assert [t for t, _ in seen] == list(range(10))
+    assert [v for _, v in seen] == [float(i) for i in range(10)]
+
+
+def test_stats_drain_stop_still_delivers_submitted():
+    """A stop request must not drop already-submitted iterations — the
+    log has no holes on early stop."""
+    seen = []
+
+    def consume(tag, stats):
+        seen.append(tag)
+        return tag == 2  # request stop at the third item
+
+    drain = StatsDrain(consume)
+    for i in range(6):  # 3 more were already in flight when stop fired
+        drain.submit(i, {"v": jnp.asarray(float(i))})
+    drain.drain()
+    assert drain.stop_requested
+    drain.close()
+    assert seen == list(range(6))
+
+
+def test_stats_drain_propagates_consumer_error():
+    def consume(tag, stats):
+        raise FloatingPointError("boom")
+
+    drain = StatsDrain(consume)
+    drain.submit(0, {"v": jnp.asarray(0.0)})
+    with pytest.raises(FloatingPointError, match="boom"):
+        drain.drain()
+    with pytest.raises(FloatingPointError):
+        drain.close()
+
+
+def test_async_early_stop_logs_every_iteration_once(tmp_path):
+    """reward_target fires mid-pipeline: the run stops (bounded
+    overshoot), and the log holds exactly one row per dispatched
+    iteration, in order."""
+    cfg = TRPOConfig(
+        **_TINY, host_async_pipeline=True, reward_target=5.0
+    )
+    path = str(tmp_path / "stop.jsonl")
+    agent = TRPOAgent(cfg.env, cfg)
+    logger = StatsLogger(jsonl_path=path, stream=io.StringIO())
+    final = agent.learn(n_iterations=30, logger=logger)
+    logger.close()
+    with open(path) as f:
+        rows = [json.loads(line) for line in f]
+    n_done = int(final.iteration)
+    assert n_done < 30  # the stop fired
+    assert [r["iteration"] for r in rows] == list(range(1, n_done + 1))
+
+
+def test_cli_flags_map_to_config():
+    from trpo_tpu.train import build_parser, config_from_args
+
+    args = build_parser().parse_args(
+        ["--preset", "halfcheetah", "--host-async-pipeline",
+         "--no-host-staged-transfers"]
+    )
+    cfg = config_from_args(args)
+    assert cfg.host_async_pipeline is True
+    assert cfg.host_staged_transfers is False
+    # defaults: async off, staging on
+    cfg2 = config_from_args(
+        build_parser().parse_args(["--preset", "halfcheetah"])
+    )
+    assert cfg2.host_async_pipeline is False
+    assert cfg2.host_staged_transfers is True
+
+
+# ---------------------------------------------------------------------------
+# fused-FVP selection probe (ADVICE r5 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_probe_compile_reports_failure_not_raise():
+    from trpo_tpu.ops.fused_fvp import probe_compile_fused_fvp
+
+    bad_net = {  # 8-wide hidden: not a 128-lane multiple → kernel rejects
+        "layers": [
+            {"w": jnp.zeros((4, 8)), "b": jnp.zeros(8)},
+            {"w": jnp.zeros((8, 2)), "b": jnp.zeros(2)},
+        ]
+    }
+    reason = probe_compile_fused_fvp(
+        bad_net,
+        jnp.zeros((16, 4)),
+        jnp.ones(16),
+        jnp.zeros(2),
+        activation="tanh",
+        compute_dtype=jnp.float32,
+    )
+    assert reason is not None and "lane" in reason
+    # and the verdict is cached: same signature, same answer, no recompile
+    assert probe_compile_fused_fvp(
+        bad_net, jnp.zeros((16, 4)), jnp.ones(16), jnp.zeros(2),
+        activation="tanh", compute_dtype=jnp.float32,
+    ) == reason

@@ -22,6 +22,9 @@ def test_bench_cpu_smoke_json_contract():
     env["BENCH_FORCE_CPU"] = "1"
     env["BENCH_BATCH"] = "512"
     env["BENCH_WIDTHS"] = "16"  # exercise the width-study path cheaply
+    # the host-env pipeline section has its own dedicated smoke below —
+    # skipping it here keeps this run inside the timeout budget
+    env["BENCH_HOST_PIPELINE"] = "0"
     out = subprocess.run(
         [sys.executable, "bench.py"],
         capture_output=True,
@@ -78,6 +81,36 @@ def test_bench_cpu_smoke_json_contract():
     # width study ran with the overridden width
     assert [r["hidden"] for r in j["width_study"]] == [[16, 16]]
     assert all(r["ms_per_iter"] > 0 for r in j["width_study"])
+
+
+@pytest.mark.slow
+def test_bench_host_pipeline_overlap_smoke():
+    """The ISSUE 1 end-to-end host-env metric: the async-pipelined driver
+    must beat the serial one on the sleep-bound sim. The acceptance bar
+    on a quiet box is ≥1.5× (BENCH artifacts show ~1.7×); this smoke
+    asserts a contention-tolerant ≥1.2× plus the JSON schema, and is
+    slow-marked so tier-1 stays fast."""
+    os.environ["BENCH_FORCE_CPU"] = "1"  # never touch the TPU tunnel here
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    j = bench.host_pipeline_bench(n_iters=6, warmup_iters=2)
+    if j["pipelined_speedup"] < 1.2:
+        # one retry: a background process competing for this 2-core box
+        # during either timing window skews the ratio both ways
+        j = bench.host_pipeline_bench(n_iters=6, warmup_iters=2)
+    for key in (
+        "sleep_ms_per_step",
+        "host_step_ms_per_iter",
+        "serial_iterations_per_sec",
+        "pipelined_iterations_per_sec",
+        "pipelined_speedup",
+        "device_rtt_ms",
+    ):
+        assert key in j, key
+    assert j["serial_iterations_per_sec"] > 0
+    assert j["pipelined_speedup"] >= 1.2, j
 
 
 @pytest.mark.slow

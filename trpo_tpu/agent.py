@@ -16,6 +16,17 @@ Retained reference behaviors (citations in line): advantage
 standardization, KL rollback, NaN-entropy abort, reward-target and
 explained-variance stop heuristics (both made configurable — SURVEY §7
 quirks list), the seven printed stats.
+
+Donation contract: every TrainState-consuming jitted entry point
+(``run_iteration``, ``run_iterations``, the host-env phase programs, and
+``learn`` which drives them) DONATES the TrainState it is given — its
+buffers are reused in place for the new state, halving the update's HBM
+footprint (params + Adam moments + obs-norm never double-buffer). A
+``TrainState`` passed to any of these is dead afterwards: keep using the
+RETURNED state, and deep-copy first (``jax.tree_util.tree_map(jnp.copy,
+state)``) if the old one must stay readable (e.g. for a comparison).
+Checkpoint saves and ``evaluate`` read whatever state object you still
+hold — call them BEFORE handing that state to an update.
 """
 
 from __future__ import annotations
@@ -78,10 +89,12 @@ class TRPOAgent:
         if isinstance(env, str):
             kwargs = (
                 {"n_envs": cfg.n_envs}
-                if env.startswith(("gym:", "native:"))
+                if env.startswith(("gym:", "gymproc:", "native:"))
                 else {}
             )
-            if cfg.normalize_obs and env.startswith(("gym:", "native:")):
+            if cfg.normalize_obs and env.startswith(
+                ("gym:", "gymproc:", "native:")
+            ):
                 # host analogue of the device-side running normalization:
                 # ONE shared running-stats object inside the adapter
                 # (envs/obs_norm.py, shared by the gymnasium and native
@@ -193,6 +206,23 @@ class TRPOAgent:
         # (ref batch budget semantics, trpo_inksci.py:17 + utils.py:21).
         self.n_steps = max(1, -(-cfg.batch_timesteps // cfg.n_envs))
 
+        if cfg.host_async_pipeline:
+            # fail at construction, not mid-training (same policy as the
+            # pipelined-rollout checks below)
+            if self.is_device_env:
+                raise ValueError(
+                    "host_async_pipeline applies to host-simulator envs "
+                    "(gym:/native:); device envs fuse the whole iteration "
+                    "into one device program already (fuse_iterations "
+                    "chunks the host syncs instead)"
+                )
+            if self.is_recurrent:
+                raise ValueError(
+                    "host_async_pipeline supports feedforward policies "
+                    "only (the recurrent window-replay carry is threaded "
+                    "through the serial driver); set policy_gru=None or "
+                    "host_async_pipeline=False"
+                )
         if cfg.host_pipeline_groups > 1:
             # fail at construction, not mid-training: the pipelined rollout
             # (host/device overlap) has hard requirements
@@ -316,9 +346,29 @@ class TRPOAgent:
                     seq_axis="seq", batch_axis=cfg.mesh_axes[0],
                 )
 
-        self._process_fn = jax.jit(self._process_trajectory)
+        # Every TrainState-consuming jit donates its state argument
+        # (donate_argnums=0): the update writes the new params / Adam
+        # moments / obs-norm into the old state's buffers instead of
+        # double-buffering the full TrainState in HBM. See the module
+        # docstring's donation contract for what callers must not do.
         if self.is_device_env:
-            self._iter_fn = jax.jit(self._device_iteration)
+            self._iter_fn = jax.jit(self._device_iteration, donate_argnums=0)
+        else:
+            # Host-env processing runs as TWO programs (the async
+            # pipeline's split; the serial driver uses the same programs
+            # so both drivers are bit-identical): phase A (advantages →
+            # policy update — produces the params that gate the next
+            # on-policy rollout) and phase B (VF fit + stats assembly —
+            # nothing downstream needs it until the NEXT iteration's
+            # advantages, so it can execute behind host env stepping).
+            # A donates the TrainState (vf_state rides through untouched),
+            # B donates the VFState it consumes.
+            self._policy_phase_fn = jax.jit(
+                self._policy_phase, donate_argnums=0
+            )
+            self._vf_phase_fn = jax.jit(
+                self._vf_stats_phase, donate_argnums=0
+            )
         self._act_fn = jax.jit(self._act, static_argnames=("eval_mode",))
         self._eval_roll_fns: dict = {}   # n_steps -> jitted eval rollout
         self._multi_iter_fns: dict = {}  # n -> jitted n-iteration scan
@@ -573,11 +623,21 @@ class TRPOAgent:
             )
         return adv, vtarg, values
 
-    def _process_trajectory(
+    def _policy_phase(
         self, train_state: TrainState, traj: Trajectory, lam=None
     ):
-        """advantages → critic fit → TRPO update → stats. One jitted
-        program; shared by the device and host paths. ``lam`` threads a
+        """Phase A of iteration processing: obs-norm fold → advantages →
+        TRPO policy update → episode scalars.
+
+        Returns ``(state, fit_pack)``: the TrainState advanced in
+        everything except ``vf_state`` (which rides through untouched —
+        phase B owns it), and the pack phase B consumes (critic inputs and
+        targets, the TRPO stats, episode scalars). The split exists for
+        the async host pipeline: the new ``policy_params`` here are the
+        ONLY output the next on-policy rollout waits for, while phase B
+        (the critic fit — not needed until the NEXT iteration's
+        advantages, per the reference's fit-after-advantages ordering,
+        ``trpo_inksci.py:103,143``) executes behind it. ``lam`` threads a
         per-member GAE-λ override into the advantages (Population
         hyperparameter sweeps)."""
         cfg = self.cfg
@@ -604,12 +664,7 @@ class TRPOAgent:
         if cfg.standardize_advantages:  # ref trpo_inksci.py:115-117
             adv_flat = standardize_advantages(adv_flat, weight)
 
-        # Critic fit AFTER advantage computation — the reference's ordering
-        # (predict at trpo_inksci.py:103, fit at :143).
         vf_in, _ = self._vf_features(traj)
-        new_vf_state, vf_loss = self.vf.fit(
-            train_state.vf_state, vf_in, flat(vtarg), weight
-        )
 
         if self.is_recurrent:
             # Recurrent batch keeps the (T, N) axes: the policy's apply
@@ -653,21 +708,56 @@ class TRPOAgent:
             / ep_denom,
         )
 
+        new_state = train_state._replace(
+            policy_params=new_policy_params,
+            obs_norm=new_obs_norm,
+            iteration=train_state.iteration + 1,
+            total_episodes=train_state.total_episodes
+            + n_episodes.astype(jnp.int32),
+            total_timesteps=train_state.total_timesteps + T * N,
+            cg_damping=trpo_stats.damping_next
+            if self.cfg.adaptive_damping
+            else train_state.cg_damping,
+        )
+        fit_pack = {
+            "vf_in": vf_in,
+            "vtarg": flat(vtarg),
+            "values": flat(values),
+            "weight": weight,
+            "trpo_stats": trpo_stats,
+            "total_episodes": new_state.total_episodes,
+            "mean_episode_reward": mean_ep_reward,
+            "mean_episode_length": mean_ep_length,
+            "episodes_in_batch": n_episodes.astype(jnp.int32),
+        }
+        return new_state, fit_pack
+
+    def _vf_stats_phase(self, vf_state: VFState, fit_pack):
+        """Phase B of iteration processing: critic fit (AFTER advantage
+        computation — the reference's ordering, ``trpo_inksci.py:103,143``)
+        plus the full stats-pytree assembly. Donates ``vf_state`` when run
+        through its jit. Nothing on the next rollout's critical path reads
+        these outputs, which is what lets the async driver run this
+        program behind host env stepping."""
+        trpo_stats = fit_pack["trpo_stats"]
+        new_vf_state, vf_loss = self.vf.fit(
+            vf_state, fit_pack["vf_in"], fit_pack["vtarg"],
+            fit_pack["weight"],
+        )
         stats = {
             # --- the reference's seven stats (trpo_inksci.py:160-171) ---
-            "total_episodes": train_state.total_episodes
-            + n_episodes.astype(jnp.int32),
-            "mean_episode_reward": mean_ep_reward,
+            "total_episodes": fit_pack["total_episodes"],
+            "mean_episode_reward": fit_pack["mean_episode_reward"],
             "entropy": trpo_stats.entropy,
             "vf_explained_variance": explained_variance(
-                flat(values), flat(vtarg), weight
+                fit_pack["values"], fit_pack["vtarg"], fit_pack["weight"]
             ),
             "kl_old_new": trpo_stats.kl,
             "surrogate_loss": trpo_stats.surrogate_after,
             # (time elapsed is host-side, added by learn())
             # --- extended observability (SURVEY §5) ---
-            "mean_episode_length": mean_ep_length,
-            "episodes_in_batch": n_episodes.astype(jnp.int32),
+            "mean_episode_length": fit_pack["mean_episode_length"],
+            "episodes_in_batch": fit_pack["episodes_in_batch"],
             "vf_loss": vf_loss,
             "surrogate_before": trpo_stats.surrogate_before,
             "grad_norm": trpo_stats.grad_norm,
@@ -684,19 +774,20 @@ class TRPOAgent:
             "kl_rolled_back": trpo_stats.rolled_back,
             "cg_damping": trpo_stats.damping,
         }
+        return new_vf_state, stats
 
-        new_state = train_state._replace(
-            policy_params=new_policy_params,
-            vf_state=new_vf_state,
-            obs_norm=new_obs_norm,
-            iteration=train_state.iteration + 1,
-            total_episodes=stats["total_episodes"],
-            total_timesteps=train_state.total_timesteps + T * N,
-            cg_damping=trpo_stats.damping_next
-            if self.cfg.adaptive_damping
-            else train_state.cg_damping,
-        )
-        return new_state, stats
+    def _process_trajectory(
+        self, train_state: TrainState, traj: Trajectory, lam=None
+    ):
+        """advantages → TRPO update → critic fit → stats, composed from
+        the two phase bodies (identical dataflow to the historical single
+        body: the critic fit and the policy update are independent given
+        the OLD vf_state, so phase order cannot change any value). Traced
+        as ONE program by the device paths; the host paths run the phases
+        as two programs instead (see ``__init__``)."""
+        state, fit_pack = self._policy_phase(train_state, traj, lam)
+        new_vf_state, stats = self._vf_stats_phase(state.vf_state, fit_pack)
+        return state._replace(vf_state=new_vf_state), stats
 
     def _device_iteration(self, train_state: TrainState, _=None, lam=None):
         """rollout + process as ONE program (pure-JAX envs only).
@@ -725,6 +816,9 @@ class TRPOAgent:
         leading ``(n,)`` axis. Device envs only; stop conditions
         (reward target, NaN abort — ``learn``) cannot fire mid-scan, so use
         ``learn`` when those matter and this for throughput.
+
+        ``train_state`` is DONATED (module docstring's donation contract):
+        keep using the returned state only.
         """
         if not self.is_device_env:
             raise NotImplementedError(
@@ -735,7 +829,12 @@ class TRPOAgent:
             raise ValueError(f"n must be >= 1, got {n}")
         fn = self._multi_iter_fns.get(n)
         if fn is None:
-            fn = self._multi_iter_fns[n] = jax.jit(self.make_scan_body(n))
+            # donate the chunk's input state — the scan carry reuses its
+            # buffers for all n iterations (donation contract: module
+            # docstring)
+            fn = self._multi_iter_fns[n] = jax.jit(
+                self.make_scan_body(n), donate_argnums=0
+            )
         return fn(train_state)
 
     def make_scan_body(self, n: int, with_lam: bool = False):
@@ -763,7 +862,11 @@ class TRPOAgent:
         return many
 
     def run_iteration(self, train_state: TrainState):
-        """One training iteration; returns ``(new_state, stats_pytree)``."""
+        """One training iteration; returns ``(new_state, stats_pytree)``.
+
+        ``train_state`` is DONATED: its buffers are reused for the new
+        state, so the passed-in object must not be read again (module
+        docstring's donation contract)."""
         if self.is_device_env:
             return self._iter_fn(train_state)
         rng = jax.random.fold_in(train_state.rng, int(train_state.iteration))
@@ -799,7 +902,9 @@ class TRPOAgent:
                 policy_state = jax.device_put(policy_state, cpu)
         if self.cfg.host_pipeline_groups > 1:
             # overlap host env stepping with device inference (feedforward
-            # only — enforced at construction)
+            # only — enforced at construction); staged transfers stream
+            # each finished group's slice to the device behind the other
+            # groups' stepping
             out = pipelined_host_rollout(
                 self.env,
                 self.policy,
@@ -808,6 +913,7 @@ class TRPOAgent:
                 self.n_steps,
                 n_groups=self.cfg.host_pipeline_groups,
                 act_fn=act_fn,
+                stage_to_device=self.cfg.host_staged_transfers,
             )
         else:
             out = host_rollout(
@@ -850,27 +956,39 @@ class TRPOAgent:
             train_state = train_state._replace(env_carry=new_carry)
         else:
             traj = out
-        if self.mesh is not None:
-            # Shard the (T, N, ...) trajectory over its env axis — the same
-            # layout the device path's sharded rollout produces, so the
-            # jitted processing runs data-parallel for host sims too.
-            # (policy_h0 is (N, H): its env axis is dim 0, not 1.)
-            from trpo_tpu.parallel import shard_leading_axis
+        traj = self._shard_host_traj(traj)
+        # Split-phase processing (shared with the async driver, so both
+        # drivers run bit-identical programs): phase A donates the
+        # TrainState and passes vf_state through; phase B donates that
+        # vf_state for the critic fit.
+        state, fit_pack = self._policy_phase_fn(train_state, traj)
+        new_vf_state, stats = self._vf_phase_fn(state.vf_state, fit_pack)
+        return state._replace(vf_state=new_vf_state), stats
 
-            h0 = traj.policy_h0
-            traj = shard_leading_axis(
-                self.mesh,
-                traj._replace(policy_h0=None),
-                self.cfg.mesh_axes[0],
-                dim=1,
-            )
-            if h0 is not None:
-                traj = traj._replace(
-                    policy_h0=shard_leading_axis(
-                        self.mesh, h0, self.cfg.mesh_axes[0], dim=0
-                    )
+    def _shard_host_traj(self, traj: Trajectory) -> Trajectory:
+        """Shard a host-collected ``(T, N, ...)`` trajectory over its env
+        axis when a mesh is configured — the same layout the device path's
+        sharded rollout produces, so the jitted processing runs
+        data-parallel for host sims too. (``policy_h0`` is ``(N, H)``: its
+        env axis is dim 0, not 1.) Identity without a mesh."""
+        if self.mesh is None:
+            return traj
+        from trpo_tpu.parallel import shard_leading_axis
+
+        h0 = traj.policy_h0
+        traj = shard_leading_axis(
+            self.mesh,
+            traj._replace(policy_h0=None),
+            self.cfg.mesh_axes[0],
+            dim=1,
+        )
+        if h0 is not None:
+            traj = traj._replace(
+                policy_h0=shard_leading_axis(
+                    self.mesh, h0, self.cfg.mesh_axes[0], dim=0
                 )
-        return self._process_fn(train_state, traj)
+            )
+        return traj
 
     def _make_host_act(self):
         from trpo_tpu.rollout import make_host_act_fn
@@ -1058,6 +1176,17 @@ class TRPOAgent:
         configurable); opt-in ``cfg.stop_on_explained_variance`` (ref
         ``trpo_inksci.py:174-175``); raises on NaN entropy (ref ``exit(-1)``
         at ``trpo_inksci.py:172-173`` — an exception, not a process kill).
+
+        A passed-in ``state`` is DONATED to the first iteration (module
+        docstring's donation contract): keep using the RETURNED state.
+
+        With ``cfg.host_async_pipeline`` (host-simulator envs), the loop
+        runs the asynchronous pipeline instead (:meth:`_learn_host_async`):
+        same stats, same stop conditions — evaluated as the stats drain,
+        so a triggered stop can overshoot by the pipeline depth (≤ 2
+        iterations), the same granularity trade ``fuse_iterations`` makes.
+        ``callback`` then runs on the drain thread with the matched
+        ``(state, stats)`` of each iteration.
         """
         cfg = self.cfg
         n_iterations = n_iterations or cfg.n_iterations
@@ -1067,6 +1196,15 @@ class TRPOAgent:
         # with use_jax_profiler, phases appear as named TraceAnnotations in
         # jax.profiler traces (the CLI's --profile-dir wires this through)
         timer = PhaseTimer(use_jax_profiler=use_jax_profiler)
+        if cfg.host_async_pipeline and not self.is_device_env:
+            try:
+                return self._learn_host_async(
+                    n_iterations, state, logger, checkpointer, callback,
+                    timer,
+                )
+            finally:
+                if own_logger:
+                    logger.close()
         # fused chunks: one device program (and ONE host sync) per `chunk`
         # iterations — the sync is ~100ms RTT on a tunneled TPU, which
         # would otherwise dominate a ~10ms update. Host envs roll out on
@@ -1080,24 +1218,6 @@ class TRPOAgent:
         from trpo_tpu.envs.episode_stats import RunningEpisodeMean
 
         reward_running = RunningEpisodeMean()
-
-        def _stop(host_stats) -> bool:
-            ent = host_stats["entropy"]
-            if ent != ent:  # NaN check (ref trpo_inksci.py:172-173)
-                raise FloatingPointError(
-                    "policy entropy is NaN — aborting training"
-                )
-            if (
-                cfg.reward_target is not None
-                and host_stats["episodes_in_batch"] > 0
-                and host_stats["mean_episode_reward"] >= cfg.reward_target
-            ):
-                return True
-            return (
-                cfg.stop_on_explained_variance is not None
-                and host_stats["vf_explained_variance"]
-                > cfg.stop_on_explained_variance
-            )
 
         try:
             done = 0
@@ -1125,21 +1245,18 @@ class TRPOAgent:
                     host_stats = {
                         key: stack[key][j].item() for key in stack
                     }
-                    reward_running.update(
-                        host_stats["mean_episode_reward"],
-                        host_stats["episodes_in_batch"],
-                    )
-                    host_stats["reward_running"] = reward_running.mean
-                    host_stats["time_elapsed_min"] = logger.elapsed_minutes()
-                    host_stats["iteration_ms"] = per_iter_ms
-                    host_stats["timesteps_total"] = (
-                        ts_end - (k - 1 - j) * steps_per_iter
-                    )
-                    logger.log(it_end - k + 1 + j, host_stats)
                     # stop conditions are evaluated per iteration, but the
                     # returned state is end-of-chunk — with fuse_iterations
                     # > 1, training may overshoot the trigger by < chunk.
-                    stop = stop or _stop(host_stats)
+                    stop = self._finish_iteration_stats(
+                        host_stats,
+                        reward_running,
+                        logger,
+                        iteration=it_end - k + 1 + j,
+                        iteration_ms=per_iter_ms,
+                        timesteps_total=ts_end
+                        - (k - 1 - j) * steps_per_iter,
+                    ) or stop
                 if callback is not None:
                     # once per chunk, with MATCHED (state, stats): the
                     # end-of-chunk state and its own iteration's stats
@@ -1164,3 +1281,218 @@ class TRPOAgent:
             if own_logger:
                 logger.close()
         return state
+
+    def _finish_iteration_stats(
+        self, host_stats, reward_running, logger, *,
+        iteration: int, iteration_ms: float, timesteps_total: int,
+    ) -> bool:
+        """Decorate ONE iteration's host stats (running episode-return
+        mean, wall-clock fields, timestep total), log the row, then apply
+        the stop rules: raise on NaN entropy (ref ``trpo_inksci.py:
+        172-173`` — logged first, like the serial driver always did),
+        return True on ``cfg.reward_target`` / ``cfg.stop_on_explained_
+        variance``. The ONE copy of this per-row logic, shared by the
+        serial loop and the async drain consumer — the drivers' bit-exact
+        contract forbids letting them drift."""
+        cfg = self.cfg
+        reward_running.update(
+            host_stats["mean_episode_reward"],
+            host_stats["episodes_in_batch"],
+        )
+        host_stats["reward_running"] = reward_running.mean
+        host_stats["time_elapsed_min"] = logger.elapsed_minutes()
+        host_stats["iteration_ms"] = iteration_ms
+        host_stats["timesteps_total"] = timesteps_total
+        logger.log(iteration, host_stats)
+        ent = host_stats["entropy"]
+        if ent != ent:  # NaN check (ref trpo_inksci.py:172-173)
+            raise FloatingPointError(
+                "policy entropy is NaN — aborting training"
+            )
+        if (
+            cfg.reward_target is not None
+            and host_stats["episodes_in_batch"] > 0
+            and host_stats["mean_episode_reward"] >= cfg.reward_target
+        ):
+            return True
+        return (
+            cfg.stop_on_explained_variance is not None
+            and host_stats["vf_explained_variance"]
+            > cfg.stop_on_explained_variance
+        )
+
+    # ------------------------------------------------------------------
+    # the asynchronous host-env pipeline (cfg.host_async_pipeline)
+    # ------------------------------------------------------------------
+
+    def _learn_host_async(
+        self, n_iterations, state, logger, checkpointer, callback, timer,
+    ) -> TrainState:
+        """The async iteration driver for host-simulator envs.
+
+        Per iteration: host rollout (with ``host_pipeline_groups`` the
+        grouped pipeline, optionally staging each group's slice to the
+        device as it finishes) → dispatch phase A (policy update — its new
+        params are the only thing the NEXT rollout waits for) → dispatch
+        phase B (VF fit + stats) → hand the pending stats pytree to the
+        drain thread and immediately start the next rollout. Phase B's
+        device time and the stats' device→host transfer (a full round trip
+        — ~100 ms on a tunneled TPU) execute BEHIND the next iteration's
+        host env stepping instead of in front of it.
+
+        Bit-exact vs the serial driver: the same rng fold
+        (``fold_in(rng, iteration)``), the same split-phase programs
+        (``run_iteration`` uses them too), and an in-order exactly-once
+        stats drain (``utils/async_pipe.StatsDrain``) reproducing the
+        serial log — asserted by ``tests/test_async_pipeline.py``.
+
+        The main loop never blocks on a device scalar: iteration indices
+        and timestep totals are tracked host-side; only a checkpoint save
+        (cadence ``cfg.checkpoint_every``) synchronizes, by nature of
+        serializing the state. A provided ``callback`` receives the
+        matched ``(state, stats)`` on the drain thread; to keep that
+        state's buffers alive past the next iteration's donation, the
+        driver then waits for the drain to catch up before dispatching
+        the next update (rollouts still overlap phase B — only the
+        drain-lag slack is given up).
+        """
+        import time
+
+        from trpo_tpu.envs.episode_stats import RunningEpisodeMean
+        from trpo_tpu.utils.async_pipe import StatsDrain
+
+        cfg = self.cfg
+        steps_per_iter = self.n_steps * cfg.n_envs
+        reward_running = RunningEpisodeMean()
+        # the ONLY entry syncs; the loop itself never fetches device scalars
+        it0 = int(state.iteration)
+        ts0 = int(state.total_timesteps)
+
+        def _consume(tag, host_stats) -> bool:
+            i, iter_wall_ms, cb_state = tag
+            # the drain already bulk-fetched; unwrap 0-d arrays to Python
+            # scalars (the serial driver's .item() step)
+            host_stats = {
+                k: np.asarray(v).item() for k, v in host_stats.items()
+            }
+            stop = self._finish_iteration_stats(
+                host_stats,
+                reward_running,
+                logger,
+                iteration=i + 1,
+                iteration_ms=iter_wall_ms,
+                timesteps_total=ts0 + (i - it0 + 1) * steps_per_iter,
+            )
+            if callback is not None:
+                callback(cb_state, host_stats)
+            return stop
+
+        drain = StatsDrain(_consume, timer=timer)
+        cur = state
+        act_fn = getattr(self, "_host_act_fn", None) or self._make_host_act()
+        # Deferred phase-B dispatch. Device execution queues are FIFO: a
+        # phase-B program enqueued BEFORE the next rollout's first act
+        # would make that act (and so the whole host window) wait out the
+        # VF fit. Stashing B and dispatching it from the rollout's
+        # step_callback — after act #0 is already in the queue — lands it
+        # BEHIND the inference the window needs first, so it executes
+        # while the hosts step/sleep. (With a separate inference backend,
+        # host_inference="cpu", the queues are independent and the
+        # dispatch point only matters for the stats submit order.)
+        pending = None  # (state_a, fit_pack, iteration index)
+        prev_t = time.perf_counter()
+
+        def _flush_b() -> None:
+            nonlocal pending, cur, prev_t
+            if pending is None:
+                return
+            state_a, fit_pack, i_p = pending
+            pending = None
+            new_vf_state, stats = self._vf_phase_fn(
+                state_a.vf_state, fit_pack
+            )
+            cur = state_a._replace(vf_state=new_vf_state)
+            now = time.perf_counter()
+            iter_ms = (now - prev_t) * 1e3
+            prev_t = now
+            drain.submit(
+                (i_p, iter_ms, cur if callback is not None else None),
+                stats,
+            )
+
+        try:
+            for j in range(n_iterations):
+                i = it0 + j
+                with timer.phase("rollout"):
+                    # same derivation as the serial run_iteration — the
+                    # iteration index is host-tracked, so no device sync
+                    rng = jax.random.fold_in(cur.rng, i)
+                    if self._obs_norm_host:
+                        self.env.set_obs_stats_state(
+                            tuple(np.asarray(x) for x in cur.obs_norm)
+                        )
+                    params_roll = cur.policy_params
+                    if self._host_inference_cpu:
+                        cpu = self._host_cpu_device
+                        params_roll = jax.device_put(params_roll, cpu)
+                        rng = jax.device_put(rng, cpu)
+                    if cfg.host_pipeline_groups > 1:
+                        # the grouped rollout has no step hook; its first
+                        # acts race across threads anyway, so flush first
+                        _flush_b()
+                        traj = pipelined_host_rollout(
+                            self.env,
+                            self.policy,
+                            params_roll,
+                            rng,
+                            self.n_steps,
+                            n_groups=cfg.host_pipeline_groups,
+                            act_fn=act_fn,
+                            stage_to_device=cfg.host_staged_transfers,
+                        )
+                    else:
+                        traj = host_rollout(
+                            self.env, self.policy, params_roll, rng,
+                            self.n_steps, act_fn=act_fn,
+                            step_callback=lambda t: _flush_b(),
+                        )
+                    _flush_b()  # no-op when the callback already ran
+                    if self._obs_norm_host:
+                        from trpo_tpu.utils.normalize import RunningStats
+
+                        cur = cur._replace(
+                            obs_norm=RunningStats(
+                                *(
+                                    jnp.asarray(x)
+                                    for x in self.env.obs_stats_state()
+                                )
+                            )
+                        )
+                    traj = self._shard_host_traj(traj)
+                if callback is not None:
+                    # the drain thread still holds references into earlier
+                    # states for the callback; let it catch up before the
+                    # next dispatch donates them (see docstring)
+                    drain.drain()
+                with timer.phase("dispatch"):
+                    state_a, fit_pack = self._policy_phase_fn(cur, traj)
+                    pending = (state_a, fit_pack, i)
+                    cur = state_a  # params/rng source for the next rollout
+                if checkpointer is not None and (
+                    (i + 1) % cfg.checkpoint_every == 0
+                ):
+                    # an inherent sync point: serializing needs the values
+                    _flush_b()
+                    checkpointer.save(i + 1, cur)
+                    if hasattr(checkpointer, "save_host_env"):
+                        checkpointer.save_host_env(
+                            i + 1, self.snapshot_host_env()
+                        )
+                drain.raise_if_failed()
+                if drain.stop_requested:
+                    break
+            _flush_b()
+            drain.drain()
+        finally:
+            drain.close()
+        return cur

@@ -194,6 +194,39 @@ class TRPOConfig:
     #                                policies; needs an adapter with
     #                                host_step_slice (gym:/native: both have
     #                                it).
+    host_async_pipeline: bool = False  # host-simulator envs only: learn()
+    #                                runs the asynchronous iteration pipeline
+    #                                (agent._learn_host_async). The device
+    #                                update is split into a policy phase
+    #                                (advantages → TRPO step — its new params
+    #                                gate the next on-policy rollout and are
+    #                                the ONLY thing awaited) and a VF-fit +
+    #                                stats phase that executes while the next
+    #                                rollout steps host envs; the stats
+    #                                pytree drains on a background thread
+    #                                (utils/async_pipe.StatsDrain), so
+    #                                logging and stop-condition checks never
+    #                                sit on the critical path. Bit-exact vs
+    #                                the serial driver (same rng fold, same
+    #                                split-phase programs — asserted by
+    #                                tests/test_async_pipeline.py). Stop
+    #                                conditions are evaluated as stats drain,
+    #                                so a triggered stop can overshoot by the
+    #                                pipeline depth (≤ 2 iterations) — the
+    #                                same granularity trade fuse_iterations
+    #                                makes for device envs.
+    host_staged_transfers: bool = True  # pipelined host rollout
+    #                                (host_pipeline_groups > 1): stage each
+    #                                group's (T, m_g, ...) trajectory slice
+    #                                to the device the moment the group
+    #                                finishes stepping (async device_put
+    #                                overlapping the other groups' host
+    #                                stepping) instead of one blocking
+    #                                end-of-rollout transfer of the full
+    #                                (T, N, ...) batch. Value-identical
+    #                                either way (device-side concat of the
+    #                                same bytes); False restores the single
+    #                                end-of-rollout transfer.
     host_inference: str = "device"  # host-simulator envs only: where rollout
     #                                policy inference runs. "device" jits it
     #                                on the default (TPU) backend — right

@@ -68,9 +68,14 @@ def _worker(conn, env_id: str, count: int, seed_base: int, kwargs: dict):
         # "package.module:attr" where attr is a class or factory callable
         # constructs envs directly (no registry needed in the spawned
         # interpreter — the overlap probe envs/sleep_env.py uses this).
-        # Anything that does not resolve to a callable falls through to
-        # gymnasium.make, which has its own documented "module:EnvId"
-        # import-then-registry semantics.
+        # gymnasium's own documented "module:EnvId" form (import module,
+        # then make the REGISTERED id) takes precedence: the ctor path is
+        # only taken when, after importing the module, the id is absent
+        # from gymnasium's registry — otherwise a module-level callable
+        # that happens to share the registered id's name would silently
+        # bypass the registry's wrappers (TimeLimit, OrderEnforcing,
+        # spec-level kwargs). Anything that neither resolves to a callable
+        # nor registers falls through to gymnasium.make's own error.
         env_ctor = None
         if ":" in env_id:
             import importlib
@@ -80,7 +85,7 @@ def _worker(conn, env_id: str, count: int, seed_base: int, kwargs: dict):
                 obj = getattr(importlib.import_module(mod_name), attr)
             except (ImportError, AttributeError):
                 obj = None
-            if callable(obj):
+            if callable(obj) and attr not in gymnasium.registry:
                 env_ctor = obj
         if env_ctor is not None:
             envs = [env_ctor(**kwargs) for _ in range(count)]

@@ -64,6 +64,7 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = [
     "fused_fvp_supported",
     "make_fused_gaussian_mlp_fvp",
+    "probe_compile_fused_fvp",
 ]
 
 _LANE = 128  # MXU/VPU lane width: minor-dim tile for every TPU generation
@@ -226,6 +227,72 @@ def _fvp_kernel(n_hidden: int, activation: str, *refs):
         obs, g, (((0,), (0,)), ((), ())), **dot_kw
     )
     cb_ref[L : L + 1, : g32.shape[1]] += jnp.sum(g32, axis=0, keepdims=True)
+
+
+# shape-signature -> None (compiled fine) | failure reason string. One
+# probe compile per distinct (backend, activation, dtype, shapes) tuple
+# for the process lifetime — selection-time cost is paid once.
+_probe_cache: Dict[tuple, Optional[str]] = {}
+
+
+def probe_compile_fused_fvp(
+    net_params: Any,
+    obs,
+    weight,
+    log_std,
+    *,
+    activation: str,
+    compute_dtype,
+) -> Optional[str]:
+    """Compile the fused kernel for this problem's SHAPES, standalone and
+    cached — returns ``None`` when the backend accepts it, else the
+    failure reason.
+
+    The trace-time checks (``fused_fvp_supported``, the VMEM cost model)
+    cannot see backend-side failures: Mosaic lowering errors and real
+    VMEM OOMs surface only when the ENCLOSING jit compiles, long after
+    ``fvp_mode="auto"`` committed to the kernel — crashing the training
+    step instead of falling back (ADVICE r5). This probe runs
+    ``jit(...).lower(...).compile()`` on abstract ``ShapeDtypeStruct``
+    inputs (safe to call from inside another trace — nothing traced leaks
+    in), so auto mode can demote compile-time failures to an XLA fallback
+    at selection time. Any exception is reported, never raised."""
+    sds = lambda x: jax.ShapeDtypeStruct(tuple(x.shape), jnp.dtype(x.dtype))
+    abs_net = jax.tree_util.tree_map(sds, net_params)
+    abs_obs, abs_w, abs_ls = sds(obs), sds(weight), sds(log_std)
+    abs_v = {"net": abs_net, "log_std": abs_ls}
+    sig = jax.tree_util.tree_structure(abs_net)
+    key = (
+        jax.default_backend(),
+        activation,
+        str(jnp.dtype(compute_dtype)),
+        str(sig),
+        tuple(
+            (leaf.shape, str(leaf.dtype))
+            for leaf in jax.tree_util.tree_leaves(
+                (abs_net, abs_obs, abs_w, abs_ls)
+            )
+        ),
+    )
+    if key in _probe_cache:
+        return _probe_cache[key]
+
+    def _probe(net, o, w, ls, damping, v):
+        return make_fused_gaussian_mlp_fvp(
+            net, o, w, ls, damping,
+            activation=activation, compute_dtype=compute_dtype,
+        )(v)
+
+    try:
+        jax.jit(_probe).lower(
+            abs_net, abs_obs, abs_w, abs_ls,
+            jax.ShapeDtypeStruct((), jnp.float32), abs_v,
+        ).compile()
+        reason = None
+    except Exception as e:  # Mosaic lowering / VMEM OOM / anything else
+        reason = f"{type(e).__name__}: {e}"
+    _probe_cache[key] = reason
+    return reason
 
 
 def make_fused_gaussian_mlp_fvp(

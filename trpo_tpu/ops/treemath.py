@@ -20,13 +20,29 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import optax.tree_utils as _otu
 from optax.tree_utils import (  # noqa: F401  (re-exported)
-    tree_add_scale as tree_add_scaled,
-    tree_scale as _optax_tree_scale,
     tree_sub,
     tree_where,
     tree_zeros_like,
 )
+
+# optax renamed these across releases (0.2.x: tree_add_scalar_mul /
+# tree_scalar_mul; later: tree_add_scale / tree_scale). Resolve whichever
+# the installed version exports so the solver does not chase optax's API.
+_optax_add_scaled = getattr(
+    _otu, "tree_add_scale", getattr(_otu, "tree_add_scalar_mul", None)
+)
+_optax_tree_scale = getattr(
+    _otu, "tree_scale", getattr(_otu, "tree_scalar_mul", None)
+)
+
+
+def tree_add_scaled(x, alpha, y):
+    """``x + alpha · y`` leafwise (CG's axpy step)."""
+    if _optax_add_scaled is not None:
+        return _optax_add_scaled(x, alpha, y)
+    return jax.tree_util.tree_map(lambda a, b: a + alpha * b, x, y)
 
 __all__ = [
     "tree_f32",
@@ -48,7 +64,9 @@ def tree_f32(t):
 
 
 def tree_scale(alpha, t):
-    return _optax_tree_scale(alpha, t)
+    if _optax_tree_scale is not None:
+        return _optax_tree_scale(alpha, t)
+    return _map(lambda x: alpha * x, t)
 
 
 def tree_vdot(a, b) -> jax.Array:

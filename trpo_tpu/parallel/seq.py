@@ -34,7 +34,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+
+try:  # jax >= 0.5 exports shard_map at the top level
+    from jax import shard_map
+except ImportError:  # 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from trpo_tpu.ops.returns import _affine_combine
@@ -72,7 +76,10 @@ def sharded_reverse_affine_scan(gammas, x, axis_name: str):
     y_local, a_cum = _local_reverse_scan(gammas, x)
 
     idx = lax.axis_index(axis_name)
-    n_dev = lax.axis_size(axis_name)  # static mesh-axis size
+    if hasattr(lax, "axis_size"):
+        n_dev = lax.axis_size(axis_name)  # static mesh-axis size
+    else:  # 0.4.x: psum of 1 over the axis constant-folds to its size
+        n_dev = int(lax.psum(1, axis_name))
     # block summaries from every device: shapes (D, ...) — tiny
     a_all = lax.all_gather(a_cum[0], axis_name)
     b_all = lax.all_gather(y_local[0], axis_name)

@@ -143,6 +143,33 @@ def make_sharded_update(
     return sharded
 
 
+def _pcast_varying(x, axis):
+    """``lax.pcast(..., to="varying")`` where it exists (jax >= 0.5's
+    varying-mesh-axes checker needs the explicit cast); identity on 0.4.x,
+    whose shard_map hands replicated operands through directly."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis, to="varying")
+    return x
+
+
+def _shard_map_compat(f, *, mesh, in_specs, out_specs, check: bool):
+    """``jax.shard_map`` across the API rename: new jax spells the checker
+    flag ``check_vma``; 0.4.x has ``jax.experimental.shard_map.shard_map``
+    with ``check_rep`` — forced off there, because without ``pcast`` the
+    replication checker cannot be told the explicit-psum proof."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 def _make_shard_map_fvp(
     cfg: TRPOConfig, mesh: Mesh, axis: str, local_body, check_vma: bool = True
 ):
@@ -166,15 +193,15 @@ def _make_shard_map_fvp(
         flat0 = jnp.asarray(flat0, jnp.float32)
 
         def local_fvp(flat0_rep, local_batch: TRPOBatch, v_rep):
-            flat_loc = jax.lax.pcast(flat0_rep, axis, to="varying")
-            v_loc = jax.lax.pcast(v_rep, axis, to="varying")
+            flat_loc = _pcast_varying(flat0_rep, axis)
+            v_loc = _pcast_varying(v_rep, axis)
             hv = local_body(flat_loc, unravel, local_batch, v_loc)
             num = jax.lax.psum(hv, axis)
             den = jax.lax.psum(jnp.sum(local_batch.weight), axis)
             return num / jnp.maximum(den, 1.0) + cfg.cg_damping * v_rep
 
         spec_batch = _batch_spec(batch, axis)
-        shard_fvp = jax.shard_map(
+        shard_fvp = _shard_map_compat(
             local_fvp,
             mesh=mesh,
             in_specs=(P(), spec_batch, P()),
@@ -182,7 +209,7 @@ def _make_shard_map_fvp(
             # the Pallas variant's custom-call outputs carry no
             # varying-mesh-axes metadata; the explicit psum in local_fvp
             # is the replication proof the checker would otherwise want
-            check_vma=check_vma,
+            check=check_vma,
         )
         return shard_fvp(flat0, batch, jnp.asarray(v, jnp.float32))
 

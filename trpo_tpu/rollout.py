@@ -447,6 +447,7 @@ def pipelined_host_rollout(
     n_groups: int = 2,
     act_fn=None,
     deterministic: bool = False,
+    stage_to_device: bool = False,
 ):
     """Host rollout with device inference and host env stepping OVERLAPPED.
 
@@ -463,6 +464,15 @@ def pipelined_host_rollout(
     with device compute" obligation of SURVEY §7; the reference's rollout
     is the degenerate fully-serial case (one env, one ``sess.run`` per step,
     ``utils.py:18-45``).
+
+    ``stage_to_device=True`` additionally overlaps the trajectory's
+    host→device transfer with env stepping: the moment a group finishes its
+    window, its stacked ``(T, m_g, ...)`` buffers are handed to
+    ``jax.device_put`` (async dispatch — the transfer streams while the
+    OTHER groups are still stepping), and the final assembly is a
+    device-side concatenation instead of one big blocking end-of-rollout
+    ``device_put`` of the full ``(T, N, ...)`` batch. Value-identical to
+    the unstaged path — the same bytes arrive, grouped differently.
 
     Semantics match :func:`host_rollout` per group and per timestep (every
     group advances exactly once per ``t``; the trajectory is the env-axis
@@ -554,6 +564,20 @@ def pipelined_host_rollout(
             b["ret"].append(vec_env.last_episode_returns[lo:hi].copy())
             b["len"].append(vec_env.last_episode_lengths[lo:hi].copy())
             obs = next_obs
+        if stage_to_device:
+            # Stage THIS group's slice now, on the group's own thread:
+            # device_put dispatches asynchronously, so the transfer of
+            # group g streams to the device while the later-finishing
+            # groups are still stepping their envs — by the time the last
+            # group completes, most of the batch is already resident.
+            for k in ("obs", "actions", "rewards", "terminated", "done",
+                      "next_obs", "ret", "len"):
+                b[k] = jax.device_put(np.stack(b[k]))
+            b["dist"] = jax.device_put(
+                jax.tree_util.tree_map(
+                    lambda *xs: np.stack(xs), *b["dist"]
+                )
+            )
 
     import concurrent.futures
 
@@ -572,17 +596,30 @@ def pipelined_host_rollout(
         if deferred:
             vec_env.end_deferred_fold()
 
-    # (T, m_g, ...) per group → (T, N, ...) by env-axis concatenation
-    cat = lambda k: jnp.asarray(
-        np.concatenate([np.stack(buf[g][k]) for g in range(n_groups)], axis=1)
-    )
-    dist_groups = [
-        jax.tree_util.tree_map(lambda *xs: np.stack(xs), *buf[g]["dist"])
-        for g in range(n_groups)
-    ]
-    old_dist = jax.tree_util.tree_map(
-        lambda *xs: jnp.asarray(np.concatenate(xs, axis=1)), *dist_groups
-    )
+    # (T, m_g, ...) per group → (T, N, ...) by env-axis concatenation —
+    # on device when the groups were staged (their arrays already live
+    # there), host-side with one transfer per field otherwise
+    if stage_to_device:
+        cat = lambda k: jnp.concatenate(
+            [buf[g][k] for g in range(n_groups)], axis=1
+        )
+        old_dist = jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=1),
+            *[buf[g]["dist"] for g in range(n_groups)],
+        )
+    else:
+        cat = lambda k: jnp.asarray(
+            np.concatenate(
+                [np.stack(buf[g][k]) for g in range(n_groups)], axis=1
+            )
+        )
+        dist_groups = [
+            jax.tree_util.tree_map(lambda *xs: np.stack(xs), *buf[g]["dist"])
+            for g in range(n_groups)
+        ]
+        old_dist = jax.tree_util.tree_map(
+            lambda *xs: jnp.asarray(np.concatenate(xs, axis=1)), *dist_groups
+        )
     return Trajectory(
         obs=cat("obs"),
         actions=cat("actions"),

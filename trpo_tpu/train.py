@@ -151,6 +151,24 @@ def build_parser() -> argparse.ArgumentParser:
         "overlap one group's host stepping with the others' device "
         "inference (rollout.pipelined_host_rollout); 1 = serial",
     )
+    p.add_argument(
+        "--host-async-pipeline",
+        action="store_true",
+        help="host-simulator envs: run the asynchronous iteration pipeline "
+        "— the device update is dispatched async (only the new policy "
+        "params gate the next on-policy rollout), the VF fit + stats "
+        "program overlaps the next rollout's env stepping, and the "
+        "stats pytree drains on a background thread; bit-exact vs the "
+        "serial driver",
+    )
+    p.add_argument(
+        "--no-host-staged-transfers",
+        action="store_true",
+        help="disable staged trajectory transfers in the pipelined host "
+        "rollout (with --host-pipeline-groups): groups then assemble "
+        "on the host and ship as one blocking end-of-rollout transfer "
+        "instead of streaming each finished group's slice to the device",
+    )
     p.add_argument("--log-jsonl", help="append per-iteration stats here")
     p.add_argument("--checkpoint-dir")
     p.add_argument("--checkpoint-every", type=int)
@@ -221,6 +239,7 @@ _OVERRIDES = {
     "policy_cell": "policy_cell",
     "policy_experts": "policy_experts",
     "host_pipeline_groups": "host_pipeline_groups",
+    "host_async_pipeline": "host_async_pipeline",
     "host_inference": "host_inference",
     "compute_dtype": "compute_dtype",
     "log_jsonl": "log_jsonl",
@@ -251,6 +270,10 @@ def config_from_args(args: argparse.Namespace) -> TRPOConfig:
         val = getattr(args, arg_name, None)
         if val is not None and val is not False:
             updates[cfg_name] = val
+    if getattr(args, "no_host_staged_transfers", False):
+        # default-True toggle: the generic override loop only forwards
+        # truthy values, so the "off" direction is explicit
+        updates["host_staged_transfers"] = False
     if getattr(args, "policy_hidden", None):
         updates["policy_hidden"] = _csv_positive_ints(
             "--policy-hidden", args.policy_hidden

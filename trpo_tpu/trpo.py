@@ -174,6 +174,15 @@ def _maybe_fused_fvp(policy, cfg, to_params, x0, fb: TRPOBatch, damping):
     backend — interpret-mode Pallas is a test vehicle, not a fast path);
     ``fvp_mode="fused"`` raises instead, so an explicit opt-in can never
     silently measure the wrong operator.
+
+    Backend-side failures the trace-time checks cannot see — Mosaic
+    lowering errors, a real VMEM OOM where the cost model under-estimated
+    — would otherwise surface only when the ENCLOSING jit compiles and
+    crash the training step. So after the cheap checks pass, the kernel
+    is probe-compiled ONCE per shape signature at selection time
+    (``ops.fused_fvp.probe_compile_fused_fvp``, cached for the process):
+    auto mode demotes a probe failure to the XLA fallback; explicit
+    ``"fused"`` raises with the compiler's reason.
     """
     explicit = cfg.fvp_mode == "fused"
     if cfg.fvp_mode != "auto" and not explicit:
@@ -204,6 +213,7 @@ def _maybe_fused_fvp(policy, cfg, to_params, x0, fb: TRPOBatch, damping):
     from trpo_tpu.ops.fused_fvp import (
         fused_fvp_supported,
         make_fused_gaussian_mlp_fvp,
+        probe_compile_fused_fvp,
     )
 
     if not fused_fvp_supported(spec["activation"], params0["net"]):
@@ -215,6 +225,16 @@ def _maybe_fused_fvp(policy, cfg, to_params, x0, fb: TRPOBatch, damping):
         return bail(
             f"hidden widths {spec['hidden']} are not 128-lane multiples"
         )
+    # Compile-probe the kernel at selection time (cached per shape): a
+    # Mosaic failure or real VMEM OOM falls back here instead of crashing
+    # the training step when the enclosing jit compiles (ADVICE r5).
+    probe_fail = probe_compile_fused_fvp(
+        params0["net"], fb.obs, fb.weight, params0["log_std"],
+        activation=spec["activation"],
+        compute_dtype=spec["compute_dtype"],
+    )
+    if probe_fail is not None:
+        return bail(f"kernel failed to compile on this backend: {probe_fail}")
     try:
         tree_fvp = make_fused_gaussian_mlp_fvp(
             params0["net"],

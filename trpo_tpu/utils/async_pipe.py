@@ -1,0 +1,125 @@
+"""Dispatch/drain machinery for the asynchronous host-env iteration
+pipeline (``agent.TRPOAgent.learn`` with ``cfg.host_async_pipeline``).
+
+The serial host-env loop pays a full host↔device round trip per iteration
+just to FETCH the stats pytree it logs (~100 ms on a tunneled TPU,
+ARCHITECTURE.md's measurement) — on the critical path, after the update
+and before the next rollout. The async pipeline dispatches the device
+update and hands the (still-pending) stats pytree to a :class:`StatsDrain`
+instead: a background thread blocks on the transfer, so logging,
+stop-condition evaluation and user callbacks ride behind the NEXT
+iteration's host env stepping rather than in front of it.
+
+Ordering contract (pinned by ``tests/test_async_pipeline.py``): stats are
+delivered to the consumer strictly in submission order, exactly once each
+— a FIFO queue serviced by one thread gives this for free — and an early
+stop still delivers every iteration submitted before the stop, so the log
+never has holes. Consumer exceptions (e.g. the NaN-entropy abort) are
+captured and re-raised on the main thread at the next ``raise_if_failed``
+/ ``drain`` / ``close`` call, preserving the exception type the serial
+driver would have raised.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+
+__all__ = ["StatsDrain"]
+
+_SENTINEL = object()
+
+
+class StatsDrain:
+    """Background fetch-and-consume of device stats pytrees, in order.
+
+    ``consume(tag, host_stats)`` runs on the drain thread with the
+    device→host transfer already done; return a truthy value to request a
+    stop (the main loop polls :attr:`stop_requested`). After an error the
+    drain stops consuming (remaining items are discarded so ``drain``
+    cannot deadlock) and the first exception is re-raised on the main
+    thread.
+    """
+
+    def __init__(
+        self,
+        consume: Callable[[Any, Any], Any],
+        timer=None,
+        span_name: str = "stats_drain",
+    ):
+        self._consume = consume
+        self._timer = timer
+        self._span_name = span_name
+        self._q: queue.Queue = queue.Queue()
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="trpo-stats-drain", daemon=True
+        )
+        self._thread.start()
+
+    # -- main-thread surface ----------------------------------------------
+
+    def submit(self, tag, device_stats) -> None:
+        """Enqueue one iteration's (still-pending) stats pytree.
+        Non-blocking; the drain thread does the device_get."""
+        if self._closed:
+            raise RuntimeError("StatsDrain is closed")
+        self._q.put((tag, device_stats))
+
+    @property
+    def stop_requested(self) -> bool:
+        """True once ``consume`` returned truthy (or errored)."""
+        return self._stop.is_set()
+
+    def raise_if_failed(self) -> None:
+        """Re-raise the first drain-thread exception on the caller."""
+        if self._error is not None:
+            raise self._error
+
+    def drain(self) -> None:
+        """Block until everything submitted so far is consumed, then
+        surface any drain-thread error."""
+        self._q.join()
+        self.raise_if_failed()
+
+    def close(self) -> None:
+        """Drain, stop the thread, and surface any error. Idempotent."""
+        if not self._closed:
+            self._closed = True
+            self._q.put(_SENTINEL)
+            self._thread.join()
+        self.raise_if_failed()
+
+    # -- drain thread ------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is _SENTINEL:
+                    return
+                if self._error is not None:
+                    continue  # post-error: discard, but keep join() live
+                tag, stats = item
+                span = (
+                    self._timer.span(self._span_name)
+                    if self._timer is not None
+                    else None
+                )
+                try:
+                    host_stats = jax.device_get(stats)
+                    if self._consume(tag, host_stats):
+                        self._stop.set()
+                except BaseException as e:  # noqa: BLE001 — re-raised
+                    self._error = e
+                    self._stop.set()
+                finally:
+                    if span is not None:
+                        span.end()
+            finally:
+                self._q.task_done()

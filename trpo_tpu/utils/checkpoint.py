@@ -25,6 +25,24 @@ import jax
 __all__ = ["Checkpointer"]
 
 
+def _is_typed_key(x) -> bool:
+    return hasattr(x, "dtype") and jax.dtypes.issubdtype(
+        x.dtype, jax.dtypes.prng_key
+    )
+
+
+def _keys_to_data(tree):
+    """Typed PRNG-key leaves → raw uint32 key data, in place in the tree.
+
+    The installed orbax serializes ndarray dtypes only — a typed key array
+    (``jax.random.key``) raises at save time. Storing ``key_data`` keeps
+    the checkpoint a plain-ndarray pytree; :meth:`Checkpointer.restore`
+    re-wraps from the template's key leaves."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.random.key_data(x) if _is_typed_key(x) else x, tree
+    )
+
+
 class Checkpointer:
     def __init__(
         self,
@@ -65,7 +83,7 @@ class Checkpointer:
 
     def save(self, step: int, state) -> None:
         self.manager.save(
-            step, args=self._ocp.args.StandardSave(state)
+            step, args=self._ocp.args.StandardSave(_keys_to_data(state))
         )
         self.manager.wait_until_finished()
 
@@ -82,10 +100,24 @@ class Checkpointer:
         def as_abstract(x):
             if not hasattr(x, "shape"):
                 return x
+            if _is_typed_key(x):
+                # checkpoints hold raw key DATA (see _keys_to_data);
+                # restore its (..., impl) uint32 shape, re-wrap below
+                sds = jax.eval_shape(jax.random.key_data, x)
+                return jax.ShapeDtypeStruct(sds.shape, sds.dtype)
             # Preserve sharding so a mesh run resumes sharded, not
             # collapsed onto the default device.
             sharding = getattr(x, "sharding", None)
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+        def rewrap_keys(tmpl, restored_tree):
+            return jax.tree_util.tree_map(
+                lambda t, r: jax.random.wrap_key_data(r)
+                if _is_typed_key(t)
+                else r,
+                tmpl,
+                restored_tree,
+            )
 
         # TrainState.cg_damping is a f32 scalar iff cfg.adaptive_damping,
         # so flipping the flag between save and restore changes the pytree
@@ -97,8 +129,11 @@ class Checkpointer:
         )
         abstract = jax.tree_util.tree_map(as_abstract, template)
         try:
-            restored = self.manager.restore(
-                step, args=self._ocp.args.StandardRestore(abstract)
+            restored = rewrap_keys(
+                template,
+                self.manager.restore(
+                    step, args=self._ocp.args.StandardRestore(abstract)
+                ),
             )
         except Exception as first_err:
             if not flippable:
@@ -110,8 +145,12 @@ class Checkpointer:
             )
             abstract_alt = jax.tree_util.tree_map(as_abstract, alt)
             try:
-                restored = self.manager.restore(
-                    step, args=self._ocp.args.StandardRestore(abstract_alt)
+                restored = rewrap_keys(
+                    alt,
+                    self.manager.restore(
+                        step,
+                        args=self._ocp.args.StandardRestore(abstract_alt),
+                    ),
                 )
             except Exception:
                 # the failure was not a damping flip — surface the

@@ -4,11 +4,19 @@ The reference records only total wall-clock ("Time elapsed",
 ``trpo_inksci.py:89,167``). ``PhaseTimer`` gives per-phase cumulative and
 per-call timings around rollout / CG-solve / update, and can emit
 ``jax.profiler`` trace annotations so phases show up named in TPU profiles.
+
+The async host-env pipeline (``agent.TRPOAgent.learn`` with
+``cfg.host_async_pipeline``) times stages from more than one thread — the
+main loop's rollout/dispatch spans and the drain thread's stats fetches —
+so all accounting is lock-protected, and :meth:`span` offers an explicit
+begin/end handle for stages whose start and finish live in different
+scopes (a context manager cannot straddle a thread boundary).
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
 
@@ -17,12 +25,49 @@ import jax
 __all__ = ["PhaseTimer"]
 
 
+class _Span:
+    """An open timing span — ``end()`` records it (idempotent)."""
+
+    __slots__ = ("_timer", "name", "_start", "_done")
+
+    def __init__(self, timer: "PhaseTimer", name: str):
+        self._timer = timer
+        self.name = name
+        self._start = time.perf_counter()
+        self._done = False
+
+    def end(self) -> float:
+        """Close the span; returns its duration in seconds. Safe to call
+        more than once (only the first call records)."""
+        dt = time.perf_counter() - self._start
+        if not self._done:
+            self._done = True
+            self._timer.record(self.name, dt)
+        return dt
+
+
 class PhaseTimer:
     def __init__(self, use_jax_profiler: bool = False):
         self.totals = defaultdict(float)
         self.counts = defaultdict(int)
         self.last = {}
         self.use_jax_profiler = use_jax_profiler
+        self._lock = threading.Lock()
+
+    def record(self, name: str, seconds: float) -> None:
+        """Fold one completed measurement in (thread-safe — the drain
+        thread of the async pipeline records here concurrently with the
+        main loop's ``phase`` contexts)."""
+        with self._lock:
+            self.totals[name] += seconds
+            self.counts[name] += 1
+            self.last[name] = seconds
+
+    def span(self, name: str) -> _Span:
+        """Begin a pipeline-stage span; call ``.end()`` on the returned
+        handle where the stage actually finishes — possibly on another
+        thread (the dispatch/drain split of ``utils/async_pipe.py``)."""
+        return _Span(self, name)
 
     @contextlib.contextmanager
     def phase(self, name: str, block_on=None):
@@ -39,25 +84,25 @@ class PhaseTimer:
             yield
             if block_on is not None:
                 jax.block_until_ready(block_on)
-        dt = time.perf_counter() - start
-        self.totals[name] += dt
-        self.counts[name] += 1
-        self.last[name] = dt
+        self.record(name, time.perf_counter() - start)
 
     def last_ms(self, name: str) -> float:
-        return self.last.get(name, 0.0) * 1e3
+        with self._lock:
+            return self.last.get(name, 0.0) * 1e3
 
     def mean_ms(self, name: str) -> float:
-        if not self.counts[name]:
-            return 0.0
-        return self.totals[name] / self.counts[name] * 1e3
+        with self._lock:
+            if not self.counts[name]:
+                return 0.0
+            return self.totals[name] / self.counts[name] * 1e3
 
     def summary(self) -> dict:
-        return {
-            name: {
-                "mean_ms": self.mean_ms(name),
-                "total_s": self.totals[name],
-                "calls": self.counts[name],
+        with self._lock:
+            return {
+                name: {
+                    "mean_ms": self.totals[name] / self.counts[name] * 1e3,
+                    "total_s": self.totals[name],
+                    "calls": self.counts[name],
+                }
+                for name in self.totals
             }
-            for name in self.totals
-        }

@@ -15,6 +15,12 @@ _vf_features``, the honest analogue of the reference's extra inputs).
 Zeros-before-first-fit is preserved behaviorally via an ``initialized`` flag
 folded into the prediction, so iteration-0 advantages equal raw returns just
 like the reference (``utils.py:88-89``).
+
+``fit`` consumes its ``VFState`` functionally; when the agent jits it (the
+host-env phase-B program, ``agent._vf_stats_phase``) the state argument is
+DONATED — params and Adam moments update in place, and a caller must not
+reuse a ``VFState`` after handing it to a donating entry point (the
+``agent.py`` donation contract).
 """
 
 from __future__ import annotations
