@@ -353,6 +353,44 @@ def build_problem(compute_dtype=None, hidden=None) -> Problem:
     )
 
 
+def _update_bench_setup(device=None, fvp_subsample=None):
+    """Policy/batch/update builder at the Humanoid operating point —
+    shared by :func:`time_full_update` and
+    :func:`update_tail_breakdown` so the phase programs time EXACTLY the
+    shapes/dtypes the full-update metric runs (bf16 matmuls on the
+    accelerator, fp32 on the CPU paths)."""
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.models import make_policy, BoxSpec
+    from trpo_tpu.trpo import TRPOBatch, make_trpo_update
+
+    policy = make_policy(
+        (OBS_DIM,),
+        BoxSpec(ACT_DIM),
+        hidden=HIDDEN,
+        compute_dtype=jnp.bfloat16 if device is None else jnp.float32,
+    )
+    params = policy.init(jax.random.key(0))
+    obs = jax.random.normal(
+        jax.random.key(1), (BATCH, OBS_DIM), jnp.float32
+    )
+    dist = policy.apply(params, obs)
+    actions = policy.dist.sample(jax.random.key(2), dist)
+    batch = TRPOBatch(
+        obs=obs,
+        actions=actions,
+        advantages=jax.random.normal(
+            jax.random.key(3), (BATCH,), jnp.float32
+        ),
+        old_dist=dist,
+        weight=jnp.ones((BATCH,), jnp.float32),
+    )
+    cfg = TRPOConfig(
+        cg_iters=CG_ITERS, cg_damping=DAMPING, cg_residual_tol=0.0,
+        fvp_subsample=fvp_subsample,
+    )
+    return policy, params, batch, cfg, make_trpo_update(policy, cfg)
+
+
 def time_full_update(device=None, fvp_subsample=None):
     """Secondary tracked metric (BASELINE.json): policy-updates/sec — the
     ENTIRE fused natural-gradient update (surrogate grad → 10-iter CG over
@@ -364,42 +402,15 @@ def time_full_update(device=None, fvp_subsample=None):
     number; the headline stays full-batch (reference semantics)."""
     import contextlib
 
-    from trpo_tpu.config import TRPOConfig
-    from trpo_tpu.models import make_policy, BoxSpec
-    from trpo_tpu.trpo import TRPOBatch, make_trpo_update
-
     ctx = (
         jax.default_device(device)
         if device is not None
         else contextlib.nullcontext()
     )
     with ctx:
-        policy = make_policy(
-            (OBS_DIM,),
-            BoxSpec(ACT_DIM),
-            hidden=HIDDEN,
-            compute_dtype=jnp.bfloat16 if device is None else jnp.float32,
+        policy, params, batch, cfg, update = _update_bench_setup(
+            device, fvp_subsample
         )
-        params = policy.init(jax.random.key(0))
-        obs = jax.random.normal(
-            jax.random.key(1), (BATCH, OBS_DIM), jnp.float32
-        )
-        dist = policy.apply(params, obs)
-        actions = policy.dist.sample(jax.random.key(2), dist)
-        batch = TRPOBatch(
-            obs=obs,
-            actions=actions,
-            advantages=jax.random.normal(
-                jax.random.key(3), (BATCH,), jnp.float32
-            ),
-            old_dist=dist,
-            weight=jnp.ones((BATCH,), jnp.float32),
-        )
-        cfg = TRPOConfig(
-            cg_iters=CG_ITERS, cg_damping=DAMPING, cg_residual_tol=0.0,
-            fvp_subsample=fvp_subsample,
-        )
-        update = make_trpo_update(policy, cfg)
         # full updates are ~4× a bare solve; CPU path: see time_fused_solve.
         # The subsampled update is ~5× cheaper — chain proportionally more
         # so the timed window stays SEVERAL× the tunnel-RTT jitter (a
@@ -416,7 +427,9 @@ def time_full_update(device=None, fvp_subsample=None):
             # ~±12% (the r05 artifacts' 221–292 band). Double the chain
             # so the timed window dominates the correction.
             n_chain = 2 * CHAIN
-        n_reps = TIMING_REPS if device is None else 1
+        # explicit-device (CPU) runs: a single ~15 s rep swung ±25% on a
+        # loaded 2-core host (round-6 tail study) — take best of 3
+        n_reps = TIMING_REPS if device is None else 3
 
         @jax.jit
         def chained_updates(params, batch):
@@ -446,6 +459,275 @@ def time_full_update(device=None, fvp_subsample=None):
         _progress("full update: done")
     per_update = max(best - rtt, 1e-9) / n_chain
     return 1.0 / per_update, per_update * 1e3
+
+
+def update_tail_breakdown(full_update_ms=None, device=None):
+    """Phase-level attribution of the full fused update (round 6
+    tentpole: the non-solve tail had grown to ~25% of the update budget
+    and had never been itemized).
+
+    Each phase is timed as its OWN chained-dependent jitted program at the
+    exact full-update shapes/dtypes (``_update_bench_setup``), RTT-
+    corrected like every other device timing here, then summed against
+    the measured ``full_update_ms`` — ``coverage_of_full_update`` says
+    how much of the update the named phases account for (acceptance bar:
+    ≥90%; the remainder is while-loop/select scheduling the phase
+    programs cannot see). Phases reflect the round-6 FUSED tail (see
+    ``trpo._natural_gradient_update``): ``grad`` includes the
+    surrogate-before fold (``value_and_grad``), and the single
+    ``linesearch_forward`` trial IS the KL-rollback/stats forward — the
+    pre-fusion program ran three more full-batch forwards here (the
+    search's loss-at-x, the post-hoc KL eval, and the final stats pass).
+    """
+    import contextlib
+
+    from jax import lax
+
+    from trpo_tpu.ops import conjugate_gradient, flatten_params, make_ggn_fvp
+    from trpo_tpu.ops.treemath import tree_where
+
+    ctx = (
+        jax.default_device(device)
+        if device is not None
+        else contextlib.nullcontext()
+    )
+    on_accel = _ACCEL and device is None
+    with ctx:
+        if full_update_ms is None:
+            _, full_update_ms = time_full_update(device=device)
+        policy, params, batch, cfg, _ = _update_bench_setup(device)
+        flat0, unravel = flatten_params(params)
+        flat0 = jnp.asarray(flat0, jnp.float32)
+
+        # the update's OWN fused surrogate+dist body and weighted mean —
+        # imported, not re-implemented, so the phase attribution tracks
+        # any future change to the surrogate automatically
+        from trpo_tpu.trpo import _wmean as wmean
+        from trpo_tpu.trpo import surrogate_and_dist
+
+        def surr_dist(flat, b):
+            return surrogate_and_dist(policy, unravel(flat), b)
+
+        u_dir = flat0 / jnp.maximum(jnp.linalg.norm(flat0), 1.0)
+        g0 = jax.jit(
+            lambda f, b: jax.grad(lambda ff: surr_dist(ff, b)[0])(f)
+        )(flat0, batch)
+        dist0 = jax.jit(lambda p, b: policy.apply(p, b.obs))(params, batch)
+
+        # Every phase program takes (carry0, flat0, batch, dist0, g0) as
+        # jit ARGUMENTS — exactly how the real update receives them. A
+        # first cut closed over them instead, and the 100MB of embedded
+        # constants (batch + linearization residuals) made the phase
+        # programs ~1.5× slower than the same work inside the update.
+        def _time_phase(name, body, carry0, n_chain, reps,
+                        wrap_scan=True):
+            """Per-call ms of ``body(carry, flat0, batch, dist0, g0)``
+            (carry → same-structure carry), chained ``n_chain``× in one
+            jitted scan, best of ``reps``, RTT-corrected. With
+            ``wrap_scan=False``, ``body`` IS the full program
+            ``(c0, flat0, batch, dist0, g0) -> (out, probe)`` (phases
+            that hoist setup outside their chain, like the CG solve)."""
+            if wrap_scan:
+                @jax.jit
+                def prog(c, f, b, d, g):
+                    out, _ = lax.scan(
+                        lambda cc, _: (body(cc, f, b, d, g), ()),
+                        c, None, length=n_chain,
+                    )
+                    leaves = jax.tree_util.tree_leaves(out)
+                    return out, sum(
+                        jnp.sum(jnp.asarray(l, jnp.float32))
+                        for l in leaves
+                    )
+            else:
+                prog = jax.jit(body)
+
+            _progress(f"update tail: {name} (chain {n_chain})")
+            out, probe = prog(carry0, flat0, batch, dist0, g0)
+            np.asarray(probe)
+            rtt = _device_rtt()
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                out, probe = prog(carry0, flat0, batch, dist0, g0)
+                np.asarray(probe)
+                best = min(best, time.perf_counter() - t0)
+            return max(best - rtt, 1e-9) / n_chain * 1e3
+
+        if on_accel:
+            chains = {"grad": 100, "cg": 10, "lin": 200, "ls": 200,
+                      "stats": 200, "select": 400}
+            reps = 3
+        else:
+            chains = {"grad": 3, "cg": 2, "lin": 4, "ls": 4,
+                      "stats": 6, "select": 16}
+            reps = 3
+
+        # grad (+ the folded surrogate_before / f0 value and the dist0
+        # aux, exactly as trpo.py computes them): one value_and_grad pass
+        # at a carry-perturbed linearization point
+        def grad_body(c, f, b, d0, g):
+            (v, d), grad = jax.value_and_grad(
+                lambda ff: surr_dist(ff, b), has_aux=True
+            )(f + jnp.float32(1e-30) * c)
+            d_probe = jnp.sum(
+                jnp.asarray(jax.tree_util.tree_leaves(d)[0], jnp.float32)
+            )
+            return grad * (
+                1.0 + jnp.float32(1e-30) * (v + jnp.float32(1e-30) * d_probe)
+            )
+
+        grad_ms = _time_phase(
+            "grad", grad_body, jnp.zeros_like(flat0),
+            chains["grad"], reps,
+        )
+
+        # the solve: 10 CG iterations over the GGN FVP plus the +1
+        # step-scale FVP (sᵀFs). The FVP is built once outside the
+        # chain's scan (its primal linearization hoisted, exactly as the
+        # update's jit hoists it out of the CG while_loop; the chain
+        # amortizes it further — the linearization is its own phase
+        # below), mirroring time_fused_solve's program structure.
+        n_cg_chain = chains["cg"]
+
+        def cg_prog(c, f, b, d0, g):
+            fvp = make_ggn_fvp(
+                lambda ff: policy.apply(unravel(ff), b.obs),
+                policy.dist.fisher_weight, f, b.weight, DAMPING,
+            )
+
+            def step(cc, _):
+                x = conjugate_gradient(
+                    fvp, -(g + jnp.float32(1e-30) * cc), CG_ITERS,
+                    residual_tol=0.0,
+                ).x
+                shs = 0.5 * jnp.vdot(x, fvp(x))
+                return x * (1.0 + jnp.float32(1e-30) * shs), ()
+
+            out, _ = lax.scan(step, c, None, length=n_cg_chain)
+            return out, out.sum()
+
+        cg_ms = _time_phase(
+            "cg_solve_plus_step_scale", cg_prog, jnp.zeros_like(flat0),
+            n_cg_chain, reps, wrap_scan=False,
+        )
+
+        # the once-per-update primal linearization the solve above
+        # amortizes away (jax.linearize at a moving point + one probe
+        # tangent so the residuals cannot be dead-code-eliminated; the
+        # probe slightly overcounts — noted in the emitted dict)
+        def lin_body(c, f, b, d0, g):
+            _, f_jvp = jax.linearize(
+                lambda ff: policy.apply(unravel(ff), b.obs),
+                f + (jnp.float32(1e-30) * c) * u_dir,
+            )
+            d = f_jvp(u_dir)
+            return sum(
+                jnp.sum(jnp.asarray(l, jnp.float32))
+                for l in jax.tree_util.tree_leaves(d)
+            )
+
+        lin_ms = _time_phase(
+            "fvp_linearization", lin_body, jnp.float32(0.0),
+            chains["lin"], reps,
+        )
+
+        # one backtracking trial: a full-batch surrogate forward (shared
+        # with the KL-cap constraint and, when accepted, with the
+        # KL-rollback check and the stats pass)
+        def ls_body(c, f, b, d0, g):
+            s, d = surr_dist(f + (jnp.float32(1e-30) * c) * u_dir, b)
+            d_probe = jnp.sum(
+                jnp.asarray(jax.tree_util.tree_leaves(d)[0], jnp.float32)
+            )
+            return s + jnp.float32(1e-30) * d_probe
+
+        ls_ms = _time_phase(
+            "linesearch_forward", ls_body, jnp.float32(0.0),
+            chains["ls"], reps,
+        )
+
+        # elementwise stats reductions on the (already-paid-for) final
+        # dist: logp, surrogate-after, KL, entropy weighted means
+        def stats_body(c, f, b, d0, g):
+            d = jax.tree_util.tree_map(
+                lambda x: x + jnp.asarray(1e-30 * c, x.dtype), d0
+            )
+            logp_new = policy.dist.logp(d, b.actions)
+            logp_old = policy.dist.logp(b.old_dist, b.actions)
+            sa = -wmean(
+                jnp.exp(logp_new - logp_old) * b.advantages, b.weight
+            )
+            kl = wmean(policy.dist.kl(b.old_dist, d), b.weight)
+            ent = wmean(policy.dist.entropy(d), b.weight)
+            return sa + kl + ent
+
+        stats_ms = _time_phase(
+            "kl_and_stats_reductions", stats_body, jnp.float32(0.0),
+            chains["stats"], reps,
+        )
+
+        # the rollback parameter select (tree_where over the flat vector)
+        def select_body(c, f, b, d0, g):
+            pred = c[0] > jnp.float32(-1e30)
+            return tree_where(pred, c + jnp.float32(1e-30), f)
+
+        select_ms = _time_phase(
+            "rollback_select", select_body, jnp.zeros_like(flat0),
+            chains["select"], reps,
+        )
+
+    n_trials = 1  # accepted-first-try: the overwhelmingly common case
+    phases = {
+        "cg_solve_plus_step_scale": round(cg_ms, 4),
+        "fvp_linearization": round(lin_ms, 4),
+        "grad_and_surrogate_before": round(grad_ms, 4),
+        "linesearch_forward_per_trial": round(ls_ms, 4),
+        "kl_and_stats_reductions": round(stats_ms, 4),
+        "rollback_select": round(select_ms, 4),
+    }
+    phases_sum = (
+        cg_ms + lin_ms + grad_ms + ls_ms * n_trials + stats_ms + select_ms
+    )
+    solve_ms = cg_ms + lin_ms
+    # the tail as directly MEASURED (its own phase programs) — robust
+    # even when the standalone solve phase over-counts its in-situ cost
+    tail_measured = grad_ms + ls_ms * n_trials + stats_ms + select_ms
+    coverage = phases_sum / full_update_ms
+    notes = [
+        "cg_solve_plus_step_scale times 11 FVP tangents with the "
+        "primal linearization hoisted (as the update's jit hoists "
+        "it); fvp_linearization is that once-per-update primal, "
+        "measured with one probe tangent (small overcount)",
+    ]
+    if coverage > 1.05:
+        notes.append(
+            "coverage > 1: standalone phase programs over-count their "
+            "in-situ cost (XLA optimizes the composed update program "
+            "beyond the sum of its parts — observed ~15-25% on the "
+            "CPU backend's solve phase); the attribution is an upper "
+            "bound per phase"
+        )
+    return {
+        "full_update_ms": round(full_update_ms, 4),
+        "phases_ms": phases,
+        "expected_linesearch_trials": n_trials,
+        "phases_sum_ms": round(phases_sum, 4),
+        "coverage_of_full_update": round(coverage, 4),
+        "tail_ms_measured_components": round(tail_measured, 4),
+        "tail_fraction_of_phases": round(tail_measured / phases_sum, 4),
+        "tail_ms_residual_vs_full": round(full_update_ms - solve_ms, 4),
+        "notes": notes,
+        "fusions": [
+            "surrogate_before folded into the gradient's value_and_grad",
+            "linesearch skips re-evaluating the loss at current params "
+            "(f0)",
+            "accepted trial's forward shared with KL-rollback check and "
+            "stats pass (linesearch aux)",
+            "linesearch_kl_cap constraint reads the trial's forward — "
+            "zero extra forwards per trial",
+        ],
+    }
 
 
 def _pallas_fvp_factory(problem: Problem):
@@ -571,6 +853,15 @@ def width_study(widths, device=None):
     use the analytic tangent FLOP model (tagged as such in the JSON; the
     model is the same one the headline falls back to).
 
+    Each width runs through the SAME ``fvp_factory`` selection as the
+    headline (VERDICT r5 item 2: the r05 artifact of record quoted the
+    XLA chain's 56.7% at width 512 while the shipping Pallas kernel does
+    ~76% there): the single-kernel Pallas GGN operator wherever it is
+    eligible (TPU backend, 128-multiple hidden width) and validated by a
+    one-FVP cosine check against the XLA operator; every row carries an
+    explicit ``solve_path`` tag, with ``fallback_reason`` on the rows
+    that kept the XLA chain.
+
     ``device`` pins the whole study (build included) — after a TPU→CPU
     fallback the default backend is the wedged tunnel, which HANGS on
     compile rather than raising; every step here must stay guarded and
@@ -589,19 +880,76 @@ def width_study(widths, device=None):
                 prob = build_problem(
                     jnp.bfloat16 if _ACCEL else jnp.float32, hidden=hidden
                 )
-            ms, _x, _runs = time_fused_solve(prob, device=device)
         except Exception as e:
-            _progress(f"width {w} failed ({type(e).__name__}: {e})")
+            _progress(f"width {w} build failed ({type(e).__name__}: {e})")
             continue
+        solve_path, fallback_reason, factory = "pallas_fused", None, None
+        if not (_ACCEL and device is None):
+            solve_path, fallback_reason = "xla_ggn", "non-TPU backend"
+        elif w % 128:
+            solve_path, fallback_reason = (
+                "xla_ggn", f"hidden width {w} is not a 128-lane multiple"
+            )
+        else:
+            try:
+                factory = _pallas_fvp_factory(prob)
+                # one-FVP validation: same operator product as XLA GGN
+                # (cosine), so a kernel row can never quote a timing for
+                # a wrong operator
+                from trpo_tpu.ops import make_ggn_fvp
+
+                weight = jnp.ones((BATCH,), jnp.float32)
+                hv_k = np.asarray(factory(prob.flat0)(prob.g))
+                hv_x = np.asarray(
+                    make_ggn_fvp(
+                        prob.apply_fn, prob.fisher_weight, prob.flat0,
+                        weight, DAMPING,
+                    )(prob.g)
+                )
+                cos = float(
+                    np.dot(hv_k, hv_x)
+                    / (np.linalg.norm(hv_k) * np.linalg.norm(hv_x))
+                )
+                if not cos > 0.99:
+                    solve_path, fallback_reason, factory = (
+                        "xla_ggn", f"kernel FVP cosine {cos:.4f}", None
+                    )
+            except Exception as e:
+                solve_path, fallback_reason, factory = (
+                    "xla_ggn", f"{type(e).__name__}: {e}", None
+                )
+        try:
+            ms, _x, _runs = time_fused_solve(
+                prob, device=device, fvp_factory=factory
+            )
+        except Exception as e:
+            if factory is None:
+                _progress(f"width {w} failed ({type(e).__name__}: {e})")
+                continue
+            # kernel path died mid-timing — retry once on the XLA chain
+            _progress(
+                f"width {w} kernel solve failed ({type(e).__name__}: {e})"
+                " — retrying on the XLA chain"
+            )
+            solve_path, fallback_reason, factory = (
+                "xla_ggn", f"{type(e).__name__}: {e}", None
+            )
+            try:
+                ms, _x, _runs = time_fused_solve(prob, device=device)
+            except Exception as e2:
+                _progress(f"width {w} failed ({type(e2).__name__}: {e2})")
+                continue
         tangent = _analytic_fvp_tangent_flops(hidden)
-        rows.append(
-            {
-                "hidden": list(hidden),
-                "ms_per_iter": round(ms, 4),
-                "analytic_flops_per_cg_iter": round(tangent, 0),
-                "achieved_tflops": round(tangent / (ms * 1e-3) / 1e12, 2),
-            }
-        )
+        row = {
+            "hidden": list(hidden),
+            "solve_path": solve_path,
+            "ms_per_iter": round(ms, 4),
+            "analytic_flops_per_cg_iter": round(tangent, 0),
+            "achieved_tflops": round(tangent / (ms * 1e-3) / 1e12, 2),
+        }
+        if fallback_reason is not None:
+            row["fallback_reason"] = fallback_reason
+        rows.append(row)
     return rows
 
 
@@ -932,6 +1280,57 @@ def host_pipeline_bench(
     }
 
 
+def _spread_pct(runs):
+    if runs and len(runs) > 1 and min(runs) > 0:
+        return (max(runs) - min(runs)) / min(runs) * 100
+    return None
+
+
+def _phase_contended(runs, load=None):
+    """The contention test applied WHILE the bench runs (VERDICT r5 item
+    3): wide spread across the phase's timed runs, or a hot host loadavg.
+    ``load`` must be a sample taken BEFORE the phase ran — sampling here
+    would read the bench's own just-finished compute as contention and
+    fire retries on idle machines."""
+    sp = _spread_pct(runs)
+    return (sp is not None and sp > 10.0) or (
+        load is not None and load > 1.8
+    )
+
+
+def _retry_phase_if_contended(label, first, rerun, load=None):
+    """Self-defending timing (VERDICT r5 item 3: the r05 driver artifact
+    shipped with 14.4% spread and needed local sidecars to interpret).
+    When a phase's first attempt looks contended, re-run it ONCE and
+    record both attempts: the retry becomes the published run list, the
+    first attempt is preserved in ``runs_first_attempt``, and the value
+    is the min over both (the min-estimator's sample set just grew).
+
+    Returns ``(ms, x, runs, retried, runs_first_attempt)``.
+    """
+    ms, x, runs = first
+    if not _phase_contended(runs, load):
+        return ms, x, runs, False, None
+    sp = _spread_pct(runs)
+    _progress(
+        f"{label}: contention suspected during timing (spread "
+        f"{'n/a' if sp is None else f'{sp:.1f}%'}) — re-running the "
+        "phase once"
+    )
+    try:
+        ms2, x2, runs2 = rerun()
+    except Exception as e:
+        # the retry itself failed: the contended first attempt stands,
+        # but the artifact must still SAY a retry was attempted —
+        # runs_first_attempt == runs marks this case (schema_notes)
+        _progress(
+            f"{label}: retry failed ({type(e).__name__}: {e}) — keeping "
+            "the (contended) first attempt, flagged as retried"
+        )
+        return ms, x, runs, True, runs
+    return min(ms, ms2), x2, runs2, True, runs
+
+
 def main():
     global _ACCEL
     # Fused path at the TPU operating point (bf16 matmuls, fp32 solve);
@@ -965,16 +1364,39 @@ def main():
             ours_ms, x_ours, ours_runs = time_fused_solve(
                 problem, device=cpu
             )
+    # self-defending timing (VERDICT r5 item 3): a contended first
+    # attempt is re-run once, both attempts recorded
+    xla_solve_rerun = lambda: time_fused_solve(
+        problem, device=None if _ACCEL else jax.devices("cpu")[0]
+    )
+    ours_ms, x_ours, ours_runs, xla_retried, xla_runs_first = (
+        _retry_phase_if_contended(
+            "fused solve", (ours_ms, x_ours, ours_runs), xla_solve_rerun,
+            load=load_before,
+        )
+    )
     # Fused single-Pallas-kernel solve — the framework's DEFAULT operator
     # on TPU (cfg.fvp_mode="auto" resolves to it at this shape). Becomes
     # the headline if it runs and matches the baseline solution; the XLA
     # chain above is kept as the comparison row either way.
     pallas_ms = pallas_runs = x_pallas = None
+    pallas_retried, pallas_runs_first = False, None
     if _ACCEL:
         try:
             _progress("pallas fused-kernel solve")
             pallas_ms, x_pallas, pallas_runs = time_fused_solve(
                 problem, fvp_factory=_pallas_fvp_factory(problem)
+            )
+            (
+                pallas_ms, x_pallas, pallas_runs,
+                pallas_retried, pallas_runs_first,
+            ) = _retry_phase_if_contended(
+                "pallas solve",
+                (pallas_ms, x_pallas, pallas_runs),
+                lambda: time_fused_solve(
+                    problem, fvp_factory=_pallas_fvp_factory(problem)
+                ),
+                load=load_before,
             )
         except Exception as e:
             _progress(
@@ -1067,6 +1489,19 @@ def main():
     except Exception as e:  # secondary metric must not sink the headline
         _progress(f"full-update timing failed ({type(e).__name__}: {e})")
         updates_per_sec = update_ms = None
+    # phase-level attribution of the full update (round-6 tentpole);
+    # BENCH_TAIL=0 skips (smoke runs that only need the solve headline)
+    tail_breakdown = None
+    if update_ms is not None and os.environ.get("BENCH_TAIL", "1") != "0":
+        try:
+            _progress("update-tail breakdown")
+            tail_breakdown = update_tail_breakdown(
+                full_update_ms=update_ms, device=upd_dev
+            )
+        except Exception as e:
+            _progress(
+                f"update-tail breakdown failed ({type(e).__name__}: {e})"
+            )
     # Framework operating point: curvature on every 1/FVP_SUB-th sample
     # (TRPOConfig.fvp_subsample) — skipped on the slow CPU fallback, and
     # skipped if the full-batch timing already failed (same problem shape).
@@ -1159,6 +1594,7 @@ def main():
     # TPU, so it carries the headline — but ONLY if its solution matches
     # the reference-semantics baseline (same gate as the XLA path above).
     solve_path, xla_ms, xla_runs = "xla_ggn", ours_ms, ours_runs
+    retried, runs_first = xla_retried, xla_runs_first
     if pallas_ms is not None:
         cos_p = float(
             np.dot(np.asarray(x_pallas), x_base)
@@ -1169,6 +1605,7 @@ def main():
             ours_ms, ours_runs, x_ours, cos = (
                 pallas_ms, pallas_runs, x_pallas, cos_p,
             )
+            retried, runs_first = pallas_retried, pallas_runs_first
         else:
             _progress(
                 f"pallas solve solution mismatch (cosine {cos_p:.4f}) — "
@@ -1214,9 +1651,11 @@ def main():
     #    here), or a wide spread, means another process competed for the
     #    host or the single-tenant chip during timing — flagged, never
     #    hidden.
-    spread_pct = None
-    if len(ours_runs) > 1 and min(ours_runs) > 0:
-        spread_pct = (max(ours_runs) - min(ours_runs)) / min(ours_runs) * 100
+    spread_pct = _spread_pct(ours_runs)
+    # same thresholds as _phase_contended (the retry trigger), but on the
+    # loadavg SAMPLED RIGHT AFTER the headline window (load_after) rather
+    # than a fresh sample — by now the bench's own later phases have
+    # loaded the host, which must not contaminate this verdict
     contention = bool(
         (spread_pct is not None and spread_pct > 10.0)
         or (load_after is not None and load_after > 1.8)
@@ -1271,6 +1710,15 @@ def main():
                 "loadavg_before": _r(load_before, 2),
                 "loadavg_after": _r(load_after, 2),
                 "contention_suspected": contention,
+                # -- self-defending retry (VERDICT r5 item 3): when the
+                #    headline phase's first attempt looked contended it
+                #    was re-run once — runs_ms_per_iter is then the
+                #    retry, the first attempt is preserved here, and
+                #    value is the min over both attempts --
+                "retried": retried,
+                "runs_first_attempt": None
+                if runs_first is None
+                else [round(r, 4) for r in runs_first],
                 "vs_baseline": round(base_ms / ours_ms, 2),
                 "baseline_ms_per_iter": round(base_ms, 3),
                 "backend": dev.platform,
@@ -1282,6 +1730,10 @@ def main():
                     updates_per_sec_sub, 2
                 ),
                 "fvp_subsample": FVP_SUB,
+                # -- phase-level attribution of the full update (round-6
+                #    tentpole): each phase its own chained-dependent
+                #    program; coverage = sum(phases)/full_update_ms --
+                "update_tail_breakdown": tail_breakdown,
                 # -- FLOP / MFU accounting. flops_source says where the
                 #    FLOP counts came from: "xla_cost_analysis" (lowered
                 #    loop-free programs, composed per flop_accounting) or
@@ -1343,7 +1795,18 @@ def main():
                 # linearization point) — the zero-transport lower bound on
                 # any host-driven loop's per-iteration device cost
                 "standalone_fvp_ms": _r(standalone_fvp_ms, 3),
+                # NOT a kernel speedup: standalone-XLA-FVP ÷ in-chain
+                # per-iter — a dispatch/loop-overhead ratio (~1.0 means
+                # the fused CG loop's per-iter cost equals a bare FVP).
+                # Kept under its historical name for artifact-lineage
+                # comparability; dispatch_overhead_ratio is the same
+                # number under the name that says what it is, and
+                # schema_notes carries the in-artifact explanation
+                # (VERDICT r5 item 6).
                 "fusion_speedup_kernel_level": None
+                if standalone_fvp_ms is None
+                else round(standalone_fvp_ms / xla_ms, 2),
+                "dispatch_overhead_ratio": None
                 if standalone_fvp_ms is None
                 else round(standalone_fvp_ms / xla_ms, 2),
                 # -- end-to-end host-env driver: iterations/s with a
@@ -1362,6 +1825,33 @@ def main():
                     }
                     for row in width_rows
                 ],
+                # in-artifact schema notes (VERDICT r5 item 6): the
+                # fields a reader without the source would misread
+                "schema_notes": {
+                    "fusion_speedup_kernel_level": (
+                        "standalone-XLA-FVP ms ÷ in-chain per-iter ms — "
+                        "a dispatch/loop-overhead ratio (~1.0 = the "
+                        "fused CG loop adds no kernel-level win over a "
+                        "bare FVP), NOT a kernel speedup; see "
+                        "pallas_kernel_speedup_vs_xla for the kernel "
+                        "win. dispatch_overhead_ratio is the same value "
+                        "under its descriptive name."
+                    ),
+                    "retried": (
+                        "true = the headline phase's first attempt "
+                        "looked contended (spread >10% or loadavg >1.8) "
+                        "and a re-run was attempted; runs_first_attempt "
+                        "keeps the first attempt, value = min over both. "
+                        "runs_ms_per_iter == runs_first_attempt means "
+                        "the retry itself failed and the contended "
+                        "first attempt stands"
+                    ),
+                    "width_study.solve_path": (
+                        "the operator that produced the row: "
+                        "pallas_fused (the shipping TPU default) or "
+                        "xla_ggn (fallback_reason says why)"
+                    ),
+                },
             }
         )
     )

@@ -12,6 +12,10 @@ echo "== tier-1: async host-env pipeline (CPU backend) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_async_pipeline.py -q \
     -m 'not slow'
 
+echo "== tier-1: update-tail profile smoke + precond amortization =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_update_tail.py \
+    tests/test_precond.py -q -m 'not slow'
+
 echo "== pytest (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q
 

@@ -1,0 +1,327 @@
+"""head_block amortization pair (round 6, VERDICT r5 item 4).
+
+Round 5 measured the Gaussian-head block preconditioner's wins in live
+training (2000-iter humanoid-sim fixed-10 pair: rollbacks 43→1, late
+residual 27% lower) at +19% wall from a per-update eigh. This protocol
+re-runs the pair with the round-6 amortized refresh
+(``precond_refresh_every``) and emits one JSON artifact with, per arm:
+wall-clock, KL-rollback count, late-window mean CG residual, and final /
+running reward — so the acceptance claim (overhead ≤5% at preserved
+rollback/residual wins) is a measured row, not an argument.
+
+Arms (single-variable, shared seed):
+  * ``plain``      — no preconditioner (reference solver semantics)
+  * ``hb_every1``  — head_block, per-update refresh (round-5 behavior)
+  * ``hb_amortN``  — head_block, refresh every N (the preset default)
+
+Defaults are sized for THIS repo's CPU-only container (the flagship
+2000-iter × 50k-batch pair needs the TPU): humanoid-sim shapes at a
+reduced batch/iteration budget. On a real accelerator run the flagship
+protocol with::
+
+    python scripts/headblock_amort_r06.py --preset humanoid-sim \
+        --iterations 2000 --fuse-iterations 50 \
+        --out scripts/headblock_amort_r06_tpu.json
+
+which reproduces ``scripts/chip_headblock_r05.sh``'s arms plus the
+amortized one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def run_arm(name, cfg, iterations, out):
+    import io
+    import tempfile
+
+    import jax
+
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.utils.metrics import StatsLogger
+
+    agent = TRPOAgent(cfg.env, cfg)
+    state = agent.init_state()
+    warm_logger = StatsLogger(stream=io.StringIO())
+    # warm the compile caches OUTSIDE the timed window: learn() runs the
+    # fuse_iterations-chunk scan program, so the warmup must run one
+    # FULL chunk (n_iterations=1 would compile only the k=1 program and
+    # leave the multi-minute chunk compile inside the timed window)
+    agent.learn(n_iterations=cfg.fuse_iterations, state=state,
+                logger=warm_logger)
+    warm_logger.close()
+    state = agent.init_state()
+    # per-iteration stats via the JSONL log (learn()'s callback fires
+    # once per fused CHUNK — it would undercount rollbacks 1:k)
+    jsonl = tempfile.NamedTemporaryFile(
+        mode="w", suffix=".jsonl", delete=False
+    ).name
+    logger = StatsLogger(jsonl_path=jsonl)
+    t0 = time.perf_counter()
+    state = agent.learn(n_iterations=iterations, state=state,
+                        logger=logger)
+    jax.block_until_ready(state.policy_params)
+    wall_s = time.perf_counter() - t0
+    logger.close()
+    if hasattr(agent.env, "close"):
+        agent.env.close()
+    with open(jsonl) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    os.unlink(jsonl)
+    late = rows[-max(1, len(rows) // 5):]  # last 20% of iterations
+    summary = {
+        "arm": name,
+        "iterations": len(rows),
+        "wall_s": round(wall_s, 2),
+        "ms_per_iter": round(wall_s / max(1, len(rows)) * 1e3, 2),
+        "rollbacks": int(sum(r["kl_rolled_back"] for r in rows)),
+        "late_mean_cg_residual": float(
+            sum(r["cg_residual"] for r in late) / len(late)
+        ),
+        "final_reward_running": rows[-1]["reward_running"],
+    }
+    out.append(summary)
+    print(json.dumps(summary))
+    return summary
+
+
+def micro(args):
+    """UPDATE-ONLY cost of the three arms (chained updates, best of
+    ``--reps``): the controlled measurement behind the ≤5% overhead
+    claim. The whole-training arms above also pay rollout/VF/driver
+    wall, whose run-to-run noise on a 2-core host (±4-5%) swamps a
+    single-digit-% eigh delta; chaining ``--chain`` updates into one
+    jitted scan and carrying the PrecondState through the chain isolates
+    exactly the cost the amortization targets."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.models import BoxSpec, make_policy
+    from trpo_tpu.ops.precond import init_gaussian_head_precond
+    from trpo_tpu.trpo import TRPOBatch, make_trpo_update
+
+    B, OBS, ACT, HID = args.batch_timesteps or 2048, 376, 17, (256, 256)
+    policy = make_policy(
+        (OBS,), BoxSpec(ACT), hidden=HID, compute_dtype=jnp.float32
+    )
+    params = policy.init(jax.random.key(0))
+    obs = jax.random.normal(jax.random.key(1), (B, OBS), jnp.float32)
+    dist = policy.apply(params, obs)
+    batch = TRPOBatch(
+        obs=obs,
+        actions=policy.dist.sample(jax.random.key(2), dist),
+        advantages=jax.random.normal(jax.random.key(3), (B,), jnp.float32),
+        old_dist=dist,
+        weight=jnp.ones((B,), jnp.float32),
+    )
+    n, reps = args.chain, args.reps
+
+    def timed(update, stateful):
+        pc0 = init_gaussian_head_precond(params) if stateful else None
+
+        @jax.jit
+        def chain(p, pc):
+            def body(carry, _):
+                p, pc = carry
+                new_p, stats = update(p, batch, None, pc)
+                return (
+                    new_p, stats.precond_next if stateful else None
+                ), stats.kl
+
+            (p_last, _), kls = jax.lax.scan(
+                body, (p, pc), None, length=n
+            )
+            return p_last, kls
+
+        _, kls = chain(params, pc0)
+        np.asarray(kls)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _, kls = chain(params, pc0)
+            np.asarray(kls)
+            best = min(best, time.perf_counter() - t0)
+        assert np.all(np.isfinite(np.asarray(kls)))
+        return best / n * 1e3
+
+    # equal-work rows force all 10 CG iterations (residual_tol=0) so the
+    # arm deltas are EXACTLY the preconditioner's own cost (apply + Gram
+    # + eigh, the eigh amortized in the refresh-k arm). The default-tol
+    # rows keep the reference's early exit: there head_block can WIN
+    # outright (the preconditioned residual crosses the tol sooner and
+    # CG exits with fewer FVPs — observed −34% on this well-conditioned
+    # fresh-policy batch).
+    base = dict(cg_iters=10, cg_damping=0.1, cg_residual_tol=0.0)
+    res = {
+        "protocol": {
+            "mode": "micro (update-only, chained, equal-work "
+            "residual_tol=0)",
+            "batch": B, "chain": n, "reps": reps,
+            "refresh": args.refresh,
+            "backend": jax.default_backend(),
+        },
+        "plain_update_ms": timed(
+            make_trpo_update(policy, TRPOConfig(**base)), False
+        ),
+        "hb_every1_update_ms": timed(
+            make_trpo_update(
+                policy,
+                TRPOConfig(cg_precondition="head_block", **base),
+            ),
+            False,
+        ),
+        f"hb_amort{args.refresh}_update_ms": timed(
+            make_trpo_update(
+                policy,
+                TRPOConfig(
+                    cg_precondition="head_block",
+                    precond_refresh_every=args.refresh,
+                    **base,
+                ),
+            ),
+            True,
+        ),
+        # the reference-semantics (default residual_tol) pair: early
+        # exit allowed, so this row shows the preconditioner's net
+        # effect rather than its isolated cost
+        "default_tol_plain_update_ms": timed(
+            make_trpo_update(
+                policy, TRPOConfig(cg_iters=10, cg_damping=0.1)
+            ),
+            False,
+        ),
+        "default_tol_hb_amort_update_ms": timed(
+            make_trpo_update(
+                policy,
+                TRPOConfig(
+                    cg_iters=10, cg_damping=0.1,
+                    cg_precondition="head_block",
+                    precond_refresh_every=args.refresh,
+                ),
+            ),
+            True,
+        ),
+    }
+    res["overhead_every1"] = round(
+        res["hb_every1_update_ms"] / res["plain_update_ms"] - 1, 4
+    )
+    res[f"overhead_amort{args.refresh}"] = round(
+        res[f"hb_amort{args.refresh}_update_ms"]
+        / res["plain_update_ms"] - 1,
+        4,
+    )
+    res["default_tol_net_effect"] = round(
+        res["default_tol_hb_amort_update_ms"]
+        / res["default_tol_plain_update_ms"] - 1,
+        4,
+    )
+    with open(args.out, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res))
+    print(f"wrote {args.out}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="humanoid-sim")
+    ap.add_argument("--iterations", type=int, default=200)
+    ap.add_argument("--batch-timesteps", type=int, default=None,
+                    help="override the preset batch (CPU-scale default "
+                    "picked in main)")
+    ap.add_argument("--n-envs", type=int, default=None)
+    ap.add_argument("--fuse-iterations", type=int, default=10)
+    ap.add_argument("--refresh", type=int, default=25)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--platform", default=None, choices=(None, "cpu", "tpu"))
+    ap.add_argument("--out", default="scripts/headblock_amort_r06.json")
+    ap.add_argument(
+        "--micro", action="store_true",
+        help="update-only chained micro-benchmark of the three arms "
+        "(isolates the eigh amortization from rollout/VF wall noise)",
+    )
+    ap.add_argument("--chain", type=int, default=50,
+                    help="--micro: updates per timed jitted chain")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="--micro: timed repetitions (best-of)")
+    args = ap.parse_args()
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    if args.micro:
+        micro(args)
+        return
+
+    from trpo_tpu.config import get_preset
+
+    base = get_preset(args.preset).replace(
+        seed=args.seed,
+        n_iterations=args.iterations,
+        fuse_iterations=args.fuse_iterations,
+        cg_precondition=False,
+        precond_refresh_every=1,
+    )
+    import jax
+
+    on_cpu = jax.default_backend() == "cpu"
+    if args.batch_timesteps is not None:
+        base = base.replace(batch_timesteps=args.batch_timesteps)
+    elif on_cpu:
+        base = base.replace(batch_timesteps=2048)  # CPU-feasible scale
+    if args.n_envs is not None:
+        base = base.replace(n_envs=args.n_envs)
+    elif on_cpu:
+        base = base.replace(n_envs=32)
+
+    arms = {
+        "plain": base,
+        "hb_every1": base.replace(cg_precondition="head_block"),
+        f"hb_amort{args.refresh}": base.replace(
+            cg_precondition="head_block",
+            precond_refresh_every=args.refresh,
+        ),
+    }
+    out = []
+    for name, cfg in arms.items():
+        print(f"=== arm {name} ===", flush=True)
+        run_arm(name, cfg, args.iterations, out)
+
+    plain = out[0]
+    result = {
+        "protocol": {
+            "preset": args.preset,
+            "iterations": args.iterations,
+            "batch_timesteps": arms["plain"].batch_timesteps,
+            "n_envs": arms["plain"].n_envs,
+            "cg_iters": arms["plain"].cg_iters,
+            "refresh": args.refresh,
+            "seed": args.seed,
+            "backend": jax.default_backend(),
+        },
+        "arms": out,
+        "overhead_vs_plain": {
+            a["arm"]: round(a["wall_s"] / plain["wall_s"] - 1.0, 4)
+            for a in out[1:]
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result["overhead_vs_plain"]))
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""Update-tail profiler smoke (round 6 tentpole; ISSUE 2 satellite 5).
+
+``bench.update_tail_breakdown`` attributes the full fused update into
+named phase programs; on the real device the acceptance bar is phases
+covering ≥90% of ``full_update_ms``. This smoke pins the machinery on
+the CPU backend at a tiny batch: every phase present and positive, the
+sum self-consistent, and the coverage inside a contention-tolerant band
+(a loaded 2-core CI box can skew ms-scale windows both ways — the tight
+bound belongs to the quiet-box artifact, not the suite).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench_mod():
+    os.environ["BENCH_FORCE_CPU"] = "1"  # never probe the TPU tunnel here
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import bench
+
+    return bench
+
+
+def test_update_tail_breakdown_smoke(bench_mod):
+    import jax
+
+    bench = bench_mod
+    old_batch, old_accel = bench.BATCH, bench._ACCEL
+    bench.BATCH, bench._ACCEL = 256, False
+    try:
+        cpu = jax.devices("cpu")[0]
+        bd = bench.update_tail_breakdown(device=cpu)
+    finally:
+        bench.BATCH, bench._ACCEL = old_batch, old_accel
+    assert bd["full_update_ms"] > 0
+    expected = {
+        "cg_solve_plus_step_scale",
+        "fvp_linearization",
+        "grad_and_surrogate_before",
+        "linesearch_forward_per_trial",
+        "kl_and_stats_reductions",
+        "rollback_select",
+    }
+    assert set(bd["phases_ms"]) == expected
+    assert all(v > 0 for v in bd["phases_ms"].values())
+    # the solve dominates; the tail fields are internally consistent
+    s = sum(bd["phases_ms"].values())
+    assert abs(s - bd["phases_sum_ms"]) < 0.05 * max(s, 1e-6) + 1e-3
+    np.testing.assert_allclose(
+        bd["coverage_of_full_update"],
+        bd["phases_sum_ms"] / bd["full_update_ms"],
+        rtol=0.02,
+    )
+    tail = (
+        bd["phases_ms"]["grad_and_surrogate_before"]
+        + bd["phases_ms"]["linesearch_forward_per_trial"]
+        * bd["expected_linesearch_trials"]
+        + bd["phases_ms"]["kl_and_stats_reductions"]
+        + bd["phases_ms"]["rollback_select"]
+    )
+    assert bd["tail_ms_measured_components"] == pytest.approx(
+        tail, rel=0.02, abs=1e-3
+    )
+    # phase programs must account for the update within a loose CI band
+    # (the ≥0.9 acceptance bar is asserted against the quiet-box
+    # artifact, not a shared CI machine)
+    assert 0.3 < bd["coverage_of_full_update"] < 3.0, bd
+    assert bd["fusions"]
+
+
+def test_contention_retry_mechanism(bench_mod):
+    """The self-defending retry (VERDICT r5 item 3): a wide-spread first
+    attempt re-runs once — both attempts recorded, value = min over
+    both; a quiet first attempt never re-runs. Deterministic: the load
+    leg only reads the PRE-phase sample passed in (never a fresh
+    loadavg, which would count the bench's own compute as contention),
+    so a busy CI host cannot flip the no-retry case."""
+    bench = bench_mod
+    calls = []
+
+    def rerun():
+        calls.append(1)
+        return 9.0, "x2", [9.0, 9.1, 9.2]
+
+    # quiet first attempt (spread ~2%): no retry
+    ms, x, runs, retried, first = bench._retry_phase_if_contended(
+        "t", (10.0, "x1", [10.0, 10.2, 10.1]), rerun
+    )
+    assert not retried and first is None and not calls
+    assert (ms, x, runs) == (10.0, "x1", [10.0, 10.2, 10.1])
+
+    # contended first attempt (spread 50%): retried once, first attempt
+    # preserved, value = min over both attempts
+    first_runs = [10.0, 15.0, 12.0]
+    ms, x, runs, retried, first = bench._retry_phase_if_contended(
+        "t", (10.0, "x1", first_runs), rerun
+    )
+    assert retried and calls == [1]
+    assert first == first_runs
+    assert runs == [9.0, 9.1, 9.2]
+    assert ms == 9.0 and x == "x2"
+
+    # retry that itself fails: the contended first attempt stands but
+    # the attempt is still flagged (runs == runs_first_attempt marks the
+    # failed-retry case in the artifact — schema_notes)
+    def rerun_fail():
+        raise RuntimeError("boom")
+
+    ms, x, runs, retried, first = bench._retry_phase_if_contended(
+        "t", (10.0, "x1", first_runs), rerun_fail
+    )
+    assert retried and first == first_runs and runs == first_runs
+    assert ms == 10.0
+
+    # spread helper corner cases
+    assert bench._spread_pct([1.0]) is None
+    assert bench._spread_pct([]) is None
+    assert bench._spread_pct([1.0, 1.5]) == pytest.approx(50.0)
+
+    # the load leg fires only from the caller-provided pre-phase sample
+    assert bench._phase_contended([1.0], load=2.0)
+    assert not bench._phase_contended([1.0], load=1.0)
+    assert not bench._phase_contended([1.0])  # no sample, no spread

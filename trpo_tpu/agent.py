@@ -77,6 +77,11 @@ class TrainState(NamedTuple):
     #                           cfg.normalize_obs, else None
     cg_damping: Any = None    # f32 scalar when cfg.adaptive_damping
     #                           (trpo._next_damping feedback), else None
+    precond: Any = None       # ops/precond.PrecondState when the
+    #                           amortized head-block preconditioner is
+    #                           active (cg_precondition="head_block" with
+    #                           precond_refresh_every > 1), else None.
+    #                           Donated with the rest of the state.
 
 
 class TRPOAgent:
@@ -200,6 +205,15 @@ class TRPOAgent:
         # the kernel's custom call (trpo.make_trpo_update docstring).
         self.trpo_update = make_trpo_update(
             self.policy, cfg, allow_fused=cfg.mesh_shape is None
+        )
+        # Amortized head-block preconditioner: the Gram/eigh factors ride
+        # TrainState.precond between updates (refresh every
+        # cfg.precond_refresh_every under a lax.cond — trpo.py). With
+        # refresh 1 the stateless per-update path is kept (bit-exact
+        # round-5 behavior, nothing to carry).
+        self._precond_stateful = (
+            cfg.cg_precondition == "head_block"
+            and cfg.precond_refresh_every > 1
         )
 
         # steps per env per iteration, so T·N ≥ batch_timesteps
@@ -440,6 +454,20 @@ class TRPOAgent:
             obs_norm = RunningStats(
                 *(jnp.asarray(x) for x in self.env.obs_stats_state())
             )
+        precond = None
+        if self._precond_stateful and (
+            getattr(self.policy, "mlp_spec", None) is not None
+            and getattr(self.policy.dist, "name", None) == "diag_gaussian"
+            and isinstance(policy_params, dict)
+            and set(policy_params) == {"net", "log_std"}
+        ):
+            # same eligibility gate as trpo.py's head_block branch: zero
+            # factors, age 0 → the first update refreshes before use; an
+            # incompatible policy is left None and rejected with the
+            # actionable head_block error at the first update instead
+            from trpo_tpu.ops.precond import init_gaussian_head_precond
+
+            precond = init_gaussian_head_precond(policy_params)
         state = TrainState(
             policy_params=policy_params,
             vf_state=self.vf.init(k_vf),
@@ -454,6 +482,7 @@ class TRPOAgent:
             cg_damping=jnp.float32(self.cfg.cg_damping)
             if self.cfg.adaptive_damping
             else None,
+            precond=precond,
         )
         if self.mesh is not None:
             # Annotate EVERY remaining leaf replicated over the mesh. This
@@ -689,7 +718,8 @@ class TRPOAgent:
                 weight=weight,
             )
         new_policy_params, trpo_stats = self.trpo_update(
-            train_state.policy_params, batch, train_state.cg_damping
+            train_state.policy_params, batch, train_state.cg_damping,
+            train_state.precond,
         )
 
         done_f = traj.done.astype(jnp.float32)
@@ -718,7 +748,14 @@ class TRPOAgent:
             cg_damping=trpo_stats.damping_next
             if self.cfg.adaptive_damping
             else train_state.cg_damping,
+            precond=trpo_stats.precond_next
+            if trpo_stats.precond_next is not None
+            else train_state.precond,
         )
+        # the (H+1)² factor matrices belong in TrainState, not in the
+        # per-iteration stats pytree (run_iterations would stack them
+        # n times over)
+        trpo_stats = trpo_stats._replace(precond_next=None)
         fit_pack = {
             "vf_in": vf_in,
             "vtarg": flat(vtarg),
@@ -1483,6 +1520,12 @@ class TRPOAgent:
                 ):
                     # an inherent sync point: serializing needs the values
                     _flush_b()
+                    # let the drain catch up BEFORE persisting (drain()
+                    # re-raises any drain-thread error): the serial
+                    # driver's NaN-entropy abort fires before its save
+                    # ever runs, and a checkpoint of a diverged state
+                    # would silently poison a later resume
+                    drain.drain()
                     checkpointer.save(i + 1, cur)
                     if hasattr(checkpointer, "save_host_env"):
                         checkpointer.save_host_env(

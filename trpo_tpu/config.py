@@ -82,6 +82,30 @@ class TRPOConfig:
     cg_precond_probes: int = 8     # Hutchinson probes for the diagonal
     #                                estimate (±1 vectors; K probes ≈
     #                                1/√K off-diagonal noise)
+    precond_refresh_every: int = 1  # head_block only: refresh the
+    #                                Gram/eigh factors every k updates
+    #                                (K-FAC-style amortization — the torso
+    #                                activation Gram drifts slowly), with
+    #                                staleness carried in TrainState
+    #                                (ops/precond.PrecondState) and the
+    #                                refresh under a lax.cond so stale
+    #                                updates pay neither the torso forward
+    #                                nor the (H+1)² eigh. 1 = refresh
+    #                                every update (round-5 behavior,
+    #                                bit-exact). The log-std/damping parts
+    #                                of the inverse are closed-form and
+    #                                always fresh; a stale SPD map only
+    #                                moves CG's convergence rate, never
+    #                                the solution. The MuJoCo presets pair
+    #                                head_block with refresh 25: the
+    #                                Gram+eigh drops out of 24/25 updates
+    #                                (equal-work overhead 31%→9.7% on CPU,
+    #                                ~0.8% bound on the v5e where r05
+    #                                measured the eigh as the entire +19%;
+    #                                net NEGATIVE at the default
+    #                                residual_tol via early exit) at
+    #                                preserved rollback wins — BENCH_LADDER
+    #                                "Amortized head-block" section.
     linesearch_backtracks: int = 10  # ref utils.py:171 (0.5**k, k<10)
     linesearch_accept_ratio: float = 0.1  # ref utils.py:170
     linesearch_kl_cap: bool = False  # KL-aware line search: also require
@@ -287,6 +311,11 @@ class TRPOConfig:
                 'cg_precondition must be False, "jacobi" (True), or '
                 f'"head_block", got {self.cg_precondition!r}'
             )
+        if self.precond_refresh_every < 1:
+            raise ValueError(
+                "precond_refresh_every must be >= 1, got "
+                f"{self.precond_refresh_every}"
+            )
         if self.adaptive_damping:
             if not self.damping_grow > 1.0:
                 raise ValueError(
@@ -324,7 +353,18 @@ PRESETS = {
         n_envs=16,
         policy_hidden=(64, 64),
     ),
-    # "HalfCheetah-v2 MuJoCo (Gaussian MLP, batch 5k, damping=0.1)"
+    # "HalfCheetah-v2 MuJoCo (Gaussian MLP, batch 5k, damping=0.1)".
+    # The MuJoCo presets (and their -sim stand-ins) default the amortized
+    # Gaussian-head-block preconditioner ON: at their short fixed CG
+    # budgets it held the late-training residual 27% lower and cut KL
+    # rollbacks 43→1 on the 2000-iter hsim pair, and refresh-25
+    # amortization drops the Gram+eigh from 24/25 updates (the r05
+    # per-update refresh was +19% wall, all eigh, on the v5e → ~0.8%
+    # amortized; net negative at the default residual_tol via the
+    # preconditioned early exit — BENCH_LADDER "Amortized head-block").
+    # Overriding a preset with a conv/MoE/recurrent policy requires
+    # cg_precondition=False (head_block inverts the plain-MLP Gaussian
+    # head's exact Fisher block).
     "halfcheetah": TRPOConfig(
         env="gym:HalfCheetah-v4",
         gamma=0.99,
@@ -334,6 +374,8 @@ PRESETS = {
         n_envs=8,
         policy_hidden=(64, 64),
         cg_damping=0.1,
+        cg_precondition="head_block",
+        precond_refresh_every=25,
     ),
     # "Humanoid-v2 MuJoCo (376-dim obs, batch 50k — large FVP matvec)"
     "humanoid": TRPOConfig(
@@ -345,6 +387,8 @@ PRESETS = {
         n_envs=64,
         policy_hidden=(256, 256),
         cg_damping=0.1,
+        cg_precondition="head_block",
+        precond_refresh_every=25,
     ),
     # On-device stand-ins for the MuJoCo/Atari rungs (same obs/act dims,
     # pure-JAX dynamics — see trpo_tpu.envs.locomotion / .catch): these run
@@ -358,6 +402,8 @@ PRESETS = {
         n_envs=32,
         policy_hidden=(64, 64),
         cg_damping=0.1,
+        cg_precondition="head_block",
+        precond_refresh_every=25,
     ),
     "humanoid-sim": TRPOConfig(
         env="humanoid-sim",
@@ -368,6 +414,8 @@ PRESETS = {
         n_envs=128,
         policy_hidden=(256, 256),
         cg_damping=0.1,
+        cg_precondition="head_block",
+        precond_refresh_every=25,
     ),
     # Partially observable CartPole (velocities masked) + GRU policy — the
     # recurrent-model-family rung; no reference analogue (SURVEY §2.1: the

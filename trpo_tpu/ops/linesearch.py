@@ -11,6 +11,20 @@ Acceptance rule is the reference's exactly: accept the first step with
 ``actual_improve > 0`` and ``actual_improve / expected_improve > accept_ratio``
 (expected improvement scaled by the current step fraction); if no step is
 accepted, return the original parameters (``utils.py:182``).
+
+Two tail-harvest levers (round 6 — the non-solve ~25% of the update):
+
+* ``f0`` lets the caller pass the already-computed loss at ``x`` so the
+  search does not re-pay that full-batch forward (the TRPO update computes
+  the surrogate at the current params for its ``surrogate_before`` stat
+  anyway — evaluating it again here was a pure duplicate);
+* ``has_aux`` makes ``loss_fn`` return ``(loss, aux)`` and carries the
+  accepted candidate's ``aux`` through the loop, so downstream consumers
+  (the KL-rollback check and the post-update stats pass in ``trpo.py``)
+  reuse the accepted trial's forward instead of re-running it — and a
+  ``constraint_fn`` receives that same ``aux``, so the KL-aware acceptance
+  test costs ZERO extra forwards per trial (it was one full KL forward per
+  trial before).
 """
 
 from __future__ import annotations
@@ -31,17 +45,22 @@ class LinesearchResult(NamedTuple):
     success: jax.Array        # bool: did any step pass the acceptance test
     step_fraction: jax.Array  # accepted 0.5**k (0.0 on failure)
     loss: jax.Array           # loss at the returned params
+    aux: Any = None           # loss_fn's aux at the returned params
+    #                           (has_aux=True only, else None)
 
 
 def backtracking_linesearch(
-    loss_fn: Callable[[Any], jax.Array],
+    loss_fn: Callable[[Any], Any],
     x: Any,
     fullstep: Any,
     expected_improve_rate: jax.Array,
     max_backtracks: int = 10,
     accept_ratio: float = 0.1,
     backtrack_factor: float = 0.5,
-    constraint_fn: Optional[Callable[[Any], jax.Array]] = None,
+    constraint_fn: Optional[Callable[..., jax.Array]] = None,
+    has_aux: bool = False,
+    f0: Optional[jax.Array] = None,
+    aux0: Any = None,
 ) -> LinesearchResult:
     """Search along ``fullstep`` from ``x`` minimizing ``loss_fn``.
 
@@ -58,18 +77,35 @@ def backtracking_linesearch(
     the constraint. The TRPO update uses this for the KL-aware search
     (``cfg.linesearch_kl_cap``): backtrack past candidates whose rollout KL
     exceeds the rollback cap instead of discovering the violation post-hoc
-    and discarding the whole update. One extra ``loss_fn``-sized forward
-    per trial; beyond-reference lever (the reference's search checks the
-    surrogate only, ``utils.py:170-182``).
+    and discarding the whole update. Beyond-reference lever (the
+    reference's search checks the surrogate only, ``utils.py:170-182``).
+
+    ``has_aux=True``: ``loss_fn`` returns ``(loss, aux)`` and the aux of
+    the returned point comes back in ``LinesearchResult.aux`` (carried in
+    the loop — any fixed-structure pytree). ``constraint_fn`` is then
+    called as ``constraint_fn(xnew, aux)`` so it can reuse the trial's
+    forward instead of running its own.
+
+    ``f0`` (optional): the known loss at ``x`` — skips the search's own
+    full-batch evaluation of it. With ``has_aux``, ``aux0`` (the aux at
+    ``x``) is required alongside, since it seeds the loop carry and is the
+    returned aux when no step is accepted.
     """
-    fval = loss_fn(x)
+    if f0 is not None:
+        if has_aux and aux0 is None:
+            raise ValueError("f0 with has_aux=True also needs aux0")
+        fval, aux_x = f0, aux0
+    elif has_aux:
+        fval, aux_x = loss_fn(x)
+    else:
+        fval, aux_x = loss_fn(x), None
 
     def cond(state):
-        k, accepted, _, _, _ = state
+        k, accepted = state[0], state[1]
         return jnp.logical_and(k < max_backtracks, jnp.logical_not(accepted))
 
     def body(state):
-        k, _, _, _, _ = state
+        k = state[0]
         frac = jnp.asarray(backtrack_factor, jnp.float32) ** k.astype(
             jnp.float32
         )
@@ -78,25 +114,36 @@ def backtracking_linesearch(
         xnew = jax.tree_util.tree_map(
             lambda a, s: a + jnp.asarray(frac, a.dtype) * s, x, fullstep
         )
-        newfval = loss_fn(xnew)
+        if has_aux:
+            newfval, aux = loss_fn(xnew)
+        else:
+            newfval, aux = loss_fn(xnew), None
         actual_improve = fval - newfval
         expected_improve = expected_improve_rate * frac
         ratio = actual_improve / expected_improve
         ok = jnp.logical_and(ratio > accept_ratio, actual_improve > 0.0)
         if constraint_fn is not None:
-            ok = jnp.logical_and(ok, constraint_fn(xnew))
-        return k + 1, ok, xnew, newfval, frac
+            ok = jnp.logical_and(
+                ok,
+                constraint_fn(xnew, aux) if has_aux else constraint_fn(xnew),
+            )
+        out = (k + 1, ok, xnew, newfval, frac)
+        return out + (aux,) if has_aux else out
 
     k0 = jnp.asarray(0, jnp.int32)
-    _, accepted, xcand, fcand, frac = lax.while_loop(
-        cond,
-        body,
-        (k0, jnp.asarray(False), x, fval, jnp.asarray(0.0, jnp.float32)),
-    )
+    init = (k0, jnp.asarray(False), x, fval, jnp.asarray(0.0, jnp.float32))
+    if has_aux:
+        init = init + (aux_x,)
+    final = lax.while_loop(cond, body, init)
+    accepted, xcand, fcand, frac = final[1], final[2], final[3], final[4]
     x_out = tree_where(accepted, xcand, x)
+    aux_out = None
+    if has_aux:
+        aux_out = tree_where(accepted, final[5], aux_x)
     return LinesearchResult(
         x=x_out,
         success=accepted,
         step_fraction=jnp.where(accepted, frac, 0.0),
         loss=jnp.where(accepted, fcand, fval),
+        aux=aux_out,
     )

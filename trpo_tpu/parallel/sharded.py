@@ -126,19 +126,32 @@ def make_sharded_update(
             batch,
         )
 
-    def sharded(params, batch: TRPOBatch, damping=None):
+    def sharded(params, batch: TRPOBatch, damping=None, precond=None):
         in_shardings = [
             jax.tree_util.tree_map(lambda _: replicated, params),
             batch_shardings(batch),
         ]
-        if damping is None:
-            fn = jax.jit(update, in_shardings=tuple(in_shardings))
-            return fn(params, batch)
-        # adaptive damping: the λ scalar rides along, replicated
-        fn = jax.jit(
-            update, in_shardings=tuple(in_shardings + [replicated])
-        )
-        return fn(params, batch, damping)
+        extra = []
+        if damping is not None or precond is not None:
+            # adaptive damping: the λ scalar rides along, replicated.
+            # A None damping still occupies its positional slot when a
+            # precond follows (an empty pytree — no leaves to shard).
+            in_shardings.append(
+                jax.tree_util.tree_map(lambda _: replicated, damping)
+            )
+            extra.append(damping)
+        if precond is not None:
+            # amortized head-block factors (ops/precond.PrecondState):
+            # replicated like the params, and stats.precond_next comes
+            # back replicated for the caller to carry — without this
+            # slot the mesh path would silently recompute the eigh every
+            # update, ignoring cfg.precond_refresh_every
+            in_shardings.append(
+                jax.tree_util.tree_map(lambda _: replicated, precond)
+            )
+            extra.append(precond)
+        fn = jax.jit(update, in_shardings=tuple(in_shardings))
+        return fn(params, batch, *extra)
 
     return sharded
 

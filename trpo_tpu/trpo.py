@@ -26,6 +26,15 @@ Batch elements carry an explicit ``weight`` column (1 for real steps, 0 for
 padding), so fixed-shape padded trajectory tensors — the XLA-friendly
 layout — give exactly the same means the reference computes over ragged
 concatenated paths.
+
+Round 6 fused the update's non-solve TAIL (grad → linesearch → rollback →
+stats had grown to ~25% of the budget): one ``value_and_grad`` yields the
+gradient, ``surrogate_before``, and the current dist; the line search
+reuses that loss (``f0``) and carries each trial's dist as ``aux``; the
+accepted trial's forward is shared by the KL-rollback check, the stats
+pass, and the KL-cap constraint — one full-batch forward beyond
+grad + FVPs on the accepted-first-try path, where there were four
+(BENCH_LADDER "Update-tail harvest").
 """
 
 from __future__ import annotations
@@ -56,6 +65,7 @@ __all__ = [
     "TRPOStats",
     "make_trpo_update",
     "make_tree_trpo_update",
+    "surrogate_and_dist",
     "surrogate_loss",
 ]
 
@@ -95,6 +105,10 @@ class TRPOStats(NamedTuple):
     damping: Any = 0.0       # λ used this update
     damping_next: Any = 0.0  # λ for the NEXT update
     #   (== damping unless cfg.adaptive_damping — see _next_damping)
+    precond_next: Any = None  # ops.precond.PrecondState for the NEXT
+    #   update when the amortized head-block preconditioner is active
+    #   (a ``precond`` state was passed in), else None. The agent moves
+    #   it into TrainState and strips it from the logged stats.
 
 
 def _wmean(x: jax.Array, w: jax.Array) -> jax.Array:
@@ -104,13 +118,31 @@ def _wmean(x: jax.Array, w: jax.Array) -> jax.Array:
     return jnp.sum(x * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
-def surrogate_loss(policy: Policy, params, batch: TRPOBatch) -> jax.Array:
-    """``-E[ratio · advantage]`` (ref ``trpo_inksci.py:44-48``)."""
+def surrogate_and_dist(
+    policy: Policy, params, batch: TRPOBatch, logp_old=None
+) -> Tuple[jax.Array, Any]:
+    """``(surrogate, dist_params)`` from ONE forward — the fused body the
+    update's grad and line search evaluate (ref ``trpo_inksci.py:44-48``
+    for the loss; the dist rides along as the aux every tail consumer
+    reuses). ``logp_old`` (the parameter-independent rollout log-probs)
+    may be precomputed and shared across evaluation points.
+
+    ``bench.update_tail_breakdown`` times this exact function, so the
+    published phase attribution tracks any future change to the
+    surrogate automatically."""
+    if logp_old is None:
+        logp_old = policy.dist.logp(batch.old_dist, batch.actions)
     dist_params = policy.apply(params, batch.obs)
     logp = policy.dist.logp(dist_params, batch.actions)
-    old_logp = policy.dist.logp(batch.old_dist, batch.actions)
-    ratio = jnp.exp(logp - old_logp)
-    return -_wmean(ratio * batch.advantages, batch.weight)
+    surr = -_wmean(
+        jnp.exp(logp - logp_old) * batch.advantages, batch.weight
+    )
+    return surr, dist_params
+
+
+def surrogate_loss(policy: Policy, params, batch: TRPOBatch) -> jax.Array:
+    """``-E[ratio · advantage]`` (ref ``trpo_inksci.py:44-48``)."""
+    return surrogate_and_dist(policy, params, batch)[0]
 
 
 def _fvp_batch(batch: TRPOBatch, fraction) -> TRPOBatch:
@@ -261,6 +293,7 @@ def _maybe_fused_fvp(policy, cfg, to_params, x0, fb: TRPOBatch, damping):
 def _natural_gradient_update(
     policy: Policy, cfg: TRPOConfig, to_params: Callable[[Any], Any],
     x0: Any, batch: TRPOBatch, damping=None, allow_fused: bool = True,
+    precond=None,
 ) -> Tuple[Any, TRPOStats]:
     """The fused solve, generic over the parameter REPRESENTATION.
 
@@ -271,25 +304,41 @@ def _natural_gradient_update(
     is pytree-polymorphic, so both representations share this one body.
 
     ``damping`` overrides ``cfg.cg_damping`` when given (a traced scalar —
-    the adaptive-damping state carried between iterations).
+    the adaptive-damping state carried between iterations). ``precond``
+    (an ``ops.precond.PrecondState``, head_block only) switches the
+    preconditioner to the amortized path: the Gram/eigh factors refresh
+    only when ``age % cfg.precond_refresh_every == 0`` and ride back out
+    via ``stats.precond_next``.
+
+    The post-solve TAIL is fused (round 6 — it had grown to ~25% of the
+    update): ``surrogate_before`` folds into the gradient's
+    ``value_and_grad`` pass; the line search skips re-evaluating the loss
+    at the current params (``f0``); the accepted trial's forward is
+    SHARED (via the search's ``aux``) with the KL-rollback check and the
+    final stats pass, and the KL-aware acceptance constraint
+    (``cfg.linesearch_kl_cap``) reads the same forward instead of running
+    its own — so a first-try-accepted update runs exactly ONE full-batch
+    forward beyond grad + FVPs, where the pre-fusion program ran four.
     """
 
-    def surr_fn(x):
-        return surrogate_loss(policy, to_params(x), batch)
+    # logp under the ROLLOUT distributions is parameter-independent —
+    # computed once, shared by the surrogate at every evaluation point
+    logp_old = policy.dist.logp(batch.old_dist, batch.actions)
 
-    def kl_to_old_fn(x):
-        dist_params = policy.apply(to_params(x), batch.obs)
-        return _wmean(
-            policy.dist.kl(batch.old_dist, dist_params), batch.weight
-        )
+    def surr_with_dist(x):
+        return surrogate_and_dist(policy, to_params(x), batch, logp_old)
 
     # Fisher metric at the current params: KL(stop_grad(π_θ) ‖ π_x)
     # — the reference's `kl_firstfixed` (trpo_inksci.py:56) — evaluated on
     # the (optionally subsampled, see _fvp_batch) curvature batch.
     fb = _fvp_batch(batch, cfg.fvp_subsample)
 
-    surr_before = surr_fn(x0)
-    g = jax.grad(surr_fn)(x0)
+    # one traced pass: surrogate value (the surrogate_before stat, and the
+    # line search's f0), the current dist (dist0), and the gradient
+    (surr_before, dist0), g = jax.value_and_grad(
+        surr_with_dist, has_aux=True
+    )(x0)
+    dist0 = jax.lax.stop_gradient(dist0)
     grad_norm = tree_norm(g)
     neg_g = tree_scale(-1.0, g)
 
@@ -335,12 +384,21 @@ def _natural_gradient_update(
 
         fvp = make_tree_fvp(kl_fixed_fn, x0, damping=damping)
     M_inv = None
+    precond_next = None
     if cfg.cg_precondition == "head_block":
         # Exact inverse of the Gaussian head's Fisher block (identity on
         # the torso) — zero extra FVPs; the late-training lever for SHORT
-        # fixed budgets (ops/precond.make_gaussian_head_block_inv).
+        # fixed budgets (ops/precond.py). With a ``precond`` state the
+        # expensive part (torso forward → Gram → eigh) refreshes every
+        # cfg.precond_refresh_every updates under a lax.cond; the
+        # log-std/damping-dependent closed forms stay per-update.
         from trpo_tpu.models.mlp import ACTIVATIONS
-        from trpo_tpu.ops.precond import make_gaussian_head_block_inv
+        from trpo_tpu.ops.precond import (
+            PrecondState,
+            apply_gaussian_head_block_inv,
+            gaussian_head_gram,
+            head_gram_eigh,
+        )
 
         spec = getattr(policy, "mlp_spec", None)
         params0 = to_params(x0)
@@ -355,7 +413,10 @@ def _natural_gradient_update(
             raise ValueError(
                 'cg_precondition="head_block" needs the plain-MLP '
                 "diagonal-Gaussian policy (it inverts that head's exact "
-                'Fisher block); use "jacobi" or False here'
+                'Fisher block); use "jacobi" or False here — note the '
+                "MuJoCo presets default head_block ON, so pass "
+                "cg_precondition=False when overriding them with a "
+                "conv/MoE/recurrent policy"
             )
         act = ACTIVATIONS[spec["activation"]]
 
@@ -365,13 +426,29 @@ def _natural_gradient_update(
                 h = act(h @ layer["w"] + layer["b"])
             return h
 
-        tree_M = make_gaussian_head_block_inv(
-            torso_apply,
-            params0["net"],
-            fb.obs,
-            fb.weight,
-            params0["log_std"],
-            damping,
+        def _fresh_factors(_):
+            S = gaussian_head_gram(
+                torso_apply, params0["net"], fb.obs, fb.weight
+            )
+            return head_gram_eigh(S)
+
+        if precond is None:
+            # stateless (per-update refresh) path — callers that do not
+            # thread TrainState (bench, sharded update, direct API use)
+            s_eig, U = _fresh_factors(None)
+        else:
+            refresh_every = max(int(cfg.precond_refresh_every), 1)
+            s_eig, U = jax.lax.cond(
+                precond.age % refresh_every == 0,
+                _fresh_factors,
+                lambda _: (precond.s_eig, precond.u),
+                None,
+            )
+            precond_next = PrecondState(
+                u=U, s_eig=s_eig, age=precond.age + 1
+            )
+        tree_M = apply_gaussian_head_block_inv(
+            s_eig, U, fb.weight, params0["log_std"], damping
         )
         if hasattr(x0, "shape"):  # flat domain: wrap the tree operator
             M_inv = lambda r: flatten_params(tree_M(to_params(r)))[0]
@@ -412,31 +489,42 @@ def _natural_gradient_update(
     if cfg.linesearch_kl_cap:
         # KL-aware acceptance: backtrack past cap-violating candidates
         # instead of rolling the whole update back post-hoc (the rollback
-        # guard below then ~never fires; it stays as the safety net)
+        # guard below then ~never fires; it stays as the safety net).
+        # The constraint reads the trial's own dist (the search's aux) —
+        # zero extra forwards per trial.
         kl_cap = jnp.float32(cfg.kl_rollback_factor * cfg.max_kl)
-        ls_constraint = lambda x: kl_to_old_fn(x) <= kl_cap
+        ls_constraint = lambda x, dist: (
+            _wmean(policy.dist.kl(batch.old_dist, dist), batch.weight)
+            <= kl_cap
+        )
     ls = backtracking_linesearch(
-        surr_fn,
+        surr_with_dist,
         x0,
         fullstep,
         expected_improve_rate,
         max_backtracks=cfg.linesearch_backtracks,
         accept_ratio=cfg.linesearch_accept_ratio,
         constraint_fn=ls_constraint,
+        has_aux=True,
+        f0=surr_before,   # the search's loss-at-x is the stat above
+        aux0=dist0,
     )
+    dist_ls = ls.aux  # dist at ls.x (== dist0 when nothing was accepted)
 
-    # KL rollback (ref trpo_inksci.py:157-158).
-    kl_after = kl_to_old_fn(ls.x)
+    # KL rollback (ref trpo_inksci.py:157-158) — evaluated on the
+    # accepted trial's SHARED forward instead of a fresh one.
+    kl_after = _wmean(policy.dist.kl(batch.old_dist, dist_ls), batch.weight)
     rollback = kl_after > cfg.kl_rollback_factor * cfg.max_kl
     x_new = tree_where(rollback, x0, ls.x)
 
     new_params = to_params(x_new)
-    # All post-update stats from ONE forward pass at the final params
-    # (the reference re-runs the graph per fetched loss,
-    # trpo_inksci.py:156).
-    final_dist = policy.apply(new_params, batch.obs)
+    # All post-update stats from the dist at the final params — selected
+    # from forwards already paid for (dist0 / the accepted trial), where
+    # the reference re-runs the graph per fetched loss
+    # (trpo_inksci.py:156) and the pre-fusion program ran one more full
+    # forward here.
+    final_dist = tree_where(rollback, dist0, dist_ls)
     logp_new = policy.dist.logp(final_dist, batch.actions)
-    logp_old = policy.dist.logp(batch.old_dist, batch.actions)
     surr_after = -_wmean(
         jnp.exp(logp_new - logp_old) * batch.advantages, batch.weight
     )
@@ -459,6 +547,7 @@ def _natural_gradient_update(
         rolled_back=rollback,
         damping=damping,
         damping_next=damping_next,
+        precond_next=precond_next,
     )
     return new_params, stats
 
@@ -479,12 +568,12 @@ def make_trpo_update(
     axis).
     """
 
-    def update(params, batch: TRPOBatch, damping=None):
+    def update(params, batch: TRPOBatch, damping=None, precond=None):
         flat0, unravel = flatten_params(params)
         flat0 = jnp.asarray(flat0, jnp.float32)
         return _natural_gradient_update(
             policy, cfg, unravel, flat0, batch, damping,
-            allow_fused=allow_fused,
+            allow_fused=allow_fused, precond=precond,
         )
 
     return update
@@ -508,12 +597,12 @@ def make_tree_trpo_update(
     contract (SURVEY §1) and bit-stable against ``compat``/bench baselines.
     """
 
-    def update(params, batch: TRPOBatch, damping=None):
+    def update(params, batch: TRPOBatch, damping=None, precond=None):
         # allow_fused=False: the pytree domain exists for tensor-sharded
         # leaves (GSPMD), which the Pallas kernel does not partition
         return _natural_gradient_update(
             policy, cfg, lambda p: p, tree_f32(params), batch, damping,
-            allow_fused=False,
+            allow_fused=False, precond=precond,
         )
 
     return update

@@ -119,14 +119,48 @@ class Checkpointer:
                 restored_tree,
             )
 
-        # TrainState.cg_damping is a f32 scalar iff cfg.adaptive_damping,
-        # so flipping the flag between save and restore changes the pytree
-        # structure. Tolerate both directions: adaptive→fixed drops the
-        # saved scalar, fixed→adaptive seeds the scalar from the template
-        # (agent.init_state puts cfg.cg_damping there).
+        # Two TrainState fields exist only under a config flag, so
+        # flipping the flag between save and restore changes the pytree
+        # structure: cg_damping (f32 scalar iff cfg.adaptive_damping) and
+        # precond (ops/precond.PrecondState iff the amortized head-block
+        # preconditioner is on — default for the MuJoCo presets since
+        # round 6, so pre-r06 checkpoints lack it). Tolerate every
+        # presence combination: a dropped field's saved value is
+        # discarded, a gained field is seeded from the template below
+        # (the precond factors are safely reconstructible — age 0
+        # refreshes on the first update).
         flippable = hasattr(template, "_replace") and hasattr(
             template, "cg_damping"
         )
+
+        def damping_alt(t):
+            return t._replace(
+                cg_damping=None
+                if t.cg_damping is not None
+                else jax.ShapeDtypeStruct((), "float32")
+            )
+
+        def precond_alt(t):
+            """Template with the precond presence flipped, or None when
+            the flipped form cannot be derived (no plain-MLP params)."""
+            if not hasattr(t, "precond"):
+                return None
+            if t.precond is not None:
+                return t._replace(precond=None)
+            try:
+                H = t.policy_params["net"]["layers"][-1]["w"].shape[0]
+            except Exception:
+                return None
+            from trpo_tpu.ops.precond import PrecondState
+
+            return t._replace(
+                precond=PrecondState(
+                    u=jax.ShapeDtypeStruct((H + 1, H + 1), "float32"),
+                    s_eig=jax.ShapeDtypeStruct((H + 1,), "float32"),
+                    age=jax.ShapeDtypeStruct((), "int32"),
+                )
+            )
+
         abstract = jax.tree_util.tree_map(as_abstract, template)
         try:
             restored = rewrap_keys(
@@ -138,23 +172,30 @@ class Checkpointer:
         except Exception as first_err:
             if not flippable:
                 raise
-            alt = template._replace(
-                cg_damping=None
-                if template.cg_damping is not None
-                else jax.ShapeDtypeStruct((), "float32")
-            )
-            abstract_alt = jax.tree_util.tree_map(as_abstract, alt)
-            try:
-                restored = rewrap_keys(
-                    alt,
-                    self.manager.restore(
-                        step,
-                        args=self._ocp.args.StandardRestore(abstract_alt),
-                    ),
-                )
-            except Exception:
-                # the failure was not a damping flip — surface the
-                # original error, not the retry's
+            candidates = [damping_alt(template)]
+            p_alt = precond_alt(template)
+            if p_alt is not None:
+                candidates.append(p_alt)
+                candidates.append(damping_alt(p_alt))
+            restored = None
+            for alt in candidates:
+                abstract_alt = jax.tree_util.tree_map(as_abstract, alt)
+                try:
+                    restored = rewrap_keys(
+                        alt,
+                        self.manager.restore(
+                            step,
+                            args=self._ocp.args.StandardRestore(
+                                abstract_alt
+                            ),
+                        ),
+                    )
+                    break
+                except Exception:
+                    continue
+            if restored is None:
+                # the failure was not a known structure flip — surface
+                # the original error, not a retry's
                 raise first_err
         if flippable and (
             (template.cg_damping is None)
@@ -182,6 +223,29 @@ class Checkpointer:
                 )
                 seed = jnp.full(seed.shape, value, seed.dtype)
             restored = restored._replace(cg_damping=seed)
+        if flippable and hasattr(template, "precond"):
+            t_has = template.precond is not None
+            r_has = getattr(restored, "precond", None) is not None
+            if t_has and not r_has:
+                # checkpoint predates the amortized preconditioner (or
+                # was saved with it off): seed the template's age-0 state
+                # — zero factors are never applied, the first update
+                # refreshes. Abstract templates materialize the zeros.
+                seed = template.precond
+                if any(
+                    not hasattr(leaf, "__array__")
+                    for leaf in jax.tree_util.tree_leaves(seed)
+                ):
+                    import jax.numpy as jnp
+
+                    seed = jax.tree_util.tree_map(
+                        lambda s: jnp.zeros(s.shape, s.dtype), seed
+                    )
+                restored = restored._replace(precond=seed)
+            elif r_has and not t_has:
+                # preconditioner turned off since the save: drop the
+                # stored factors (pure cache — nothing is lost)
+                restored = restored._replace(precond=None)
         return restored
 
     # -- host-env sidecar --------------------------------------------------
