@@ -1674,9 +1674,7 @@ def main():
             return None
         return round(achieved / peak, 4)
 
-    print(
-        json.dumps(
-            {
+    artifact = {
                 # label tracks the actual batch (BENCH_BATCH smoke runs
                 # must not masquerade as the full-size benchmark)
                 "metric": (
@@ -1852,9 +1850,65 @@ def main():
                         "xla_ggn (fallback_reason says why)"
                     ),
                 },
-            }
+    }
+    print(json.dumps(artifact))
+    _emit_bench_events(artifact, tail_breakdown, host_pipe)
+
+
+def _emit_bench_events(artifact, tail_breakdown, host_pipe) -> None:
+    """Re-emit the bench timings through the run-event bus
+    (``BENCH_EVENTS_JSONL=<path>``): a manifest + one ``phase`` record per
+    timed phase, in the SAME schema the training drivers log — so
+    ``scripts/validate_events.py`` checks bench artifacts and training
+    telemetry with one validator, and downstream tooling reads one format
+    (the ISSUE 3 one-schema contract)."""
+    path = os.environ.get("BENCH_EVENTS_JSONL")
+    if not path:
+        return
+    from trpo_tpu.obs.events import EventBus, JsonlSink, manifest_fields
+
+    bus = EventBus(JsonlSink(path))
+    try:
+        bus.emit(
+            "run_manifest",
+            **manifest_fields(
+                config={
+                    "bench": "north_star",
+                    "batch": BATCH,
+                    "obs_dim": OBS_DIM,
+                    "act_dim": ACT_DIM,
+                    "hidden": list(HIDDEN),
+                    "cg_iters": CG_ITERS,
+                    "damping": DAMPING,
+                },
+                extra={
+                    "metric": artifact["metric"],
+                    "solve_path": artifact["solve_path"],
+                    "device_kind": artifact["device_kind"],
+                },
+            ),
         )
-    )
+        bus.emit(
+            "phase", name="solve/cg_iter", ms=artifact["value"],
+            solve_path=artifact["solve_path"],
+        )
+        if artifact.get("full_update_ms"):
+            bus.emit(
+                "phase", name="update/full", ms=artifact["full_update_ms"]
+            )
+        if tail_breakdown:
+            for name, ms in tail_breakdown["phases_ms"].items():
+                bus.emit("phase", name=f"update_tail/{name}", ms=ms)
+        if host_pipe:
+            for key in ("host_step_ms_per_iter", "device_rtt_ms"):
+                if host_pipe.get(key) is not None:
+                    bus.emit(
+                        "phase",
+                        name=f"host_pipeline/{key}",
+                        ms=host_pipe[key],
+                    )
+    finally:
+        bus.close()
 
 
 if __name__ == "__main__":

@@ -16,6 +16,22 @@ echo "== tier-1: update-tail profile smoke + precond amortization =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_update_tail.py \
     tests/test_precond.py -q -m 'not slow'
 
+echo "== tier-1: observability (event bus, device metrics, monitors) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py -q \
+    -m 'not slow'
+
+echo "== event-stream smoke: train + bench emit schema-valid JSONL =="
+OBS_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu python -m trpo_tpu.train --preset cartpole \
+    --iterations 2 --batch-timesteps 64 --n-envs 4 --platform cpu \
+    --metrics-jsonl "$OBS_TMP/train_events.jsonl" --health-checks \
+    > /dev/null
+BENCH_FORCE_CPU=1 BENCH_BATCH=256 BENCH_WIDTHS= BENCH_HOST_PIPELINE=0 \
+    BENCH_TAIL=0 BENCH_EVENTS_JSONL="$OBS_TMP/bench_events.jsonl" \
+    python bench.py > "$OBS_TMP/bench.json"
+python scripts/validate_events.py "$OBS_TMP/train_events.jsonl" \
+    "$OBS_TMP/bench_events.jsonl"
+
 echo "== pytest (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q
 

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Schema-check JSONL run-event files (``trpo_tpu.obs.events`` schema).
+
+    python scripts/validate_events.py FILE [FILE ...]
+
+For each file: every line must parse as JSON and pass
+``trpo_tpu.obs.events.validate_event``; the first record must be a
+``run_manifest`` (files are self-describing); and when per-iteration
+records are present, each must carry the device-accumulated solver
+counters (``cg_iters_total``, ``linesearch_trials_total``) — the ISSUE 3
+acceptance contract. Exits non-zero with per-line diagnostics on any
+failure; prints a per-kind count summary on success. Used by
+``scripts/check.sh`` against both a training run's ``--metrics-jsonl``
+output and ``bench.py``'s ``BENCH_EVENTS_JSONL`` output (one validator,
+one schema).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import Counter
+
+# runnable from anywhere: `python scripts/validate_events.py …` puts
+# scripts/ (not the repo root) on sys.path
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+_REQUIRED_ITERATION_COUNTERS = ("cg_iters_total", "linesearch_trials_total")
+
+
+def validate_file(path: str) -> list:
+    """Returns a list of error strings (empty = valid)."""
+    from trpo_tpu.obs.events import validate_event
+
+    errs = []
+    records = []
+    try:
+        with open(path) as f:
+            for n, line in enumerate(f, 1):
+                if not line.strip():
+                    errs.append(f"{path}:{n}: blank line")
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError as e:
+                    errs.append(f"{path}:{n}: not JSON ({e})")
+                    continue
+                for e in validate_event(rec):
+                    errs.append(f"{path}:{n}: {e}")
+                records.append((n, rec))
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    if not records:
+        errs.append(f"{path}: no records")
+        return errs
+    if records[0][1].get("kind") != "run_manifest":
+        errs.append(
+            f"{path}:1: first record must be a run_manifest "
+            f"(got {records[0][1].get('kind')!r})"
+        )
+    for n, rec in records:
+        if rec.get("kind") != "iteration":
+            continue
+        stats = rec.get("stats") or {}
+        for key in _REQUIRED_ITERATION_COUNTERS:
+            if key not in stats:
+                errs.append(
+                    f"{path}:{n}: iteration event missing "
+                    f"device-accumulated counter {key!r}"
+                )
+    return errs
+
+
+def main(argv) -> int:
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    failed = False
+    for path in argv:
+        errs = validate_file(path)
+        if errs:
+            failed = True
+            for e in errs[:50]:
+                print(f"INVALID  {e}", file=sys.stderr)
+            if len(errs) > 50:
+                print(f"... and {len(errs) - 50} more", file=sys.stderr)
+        else:
+            with open(path) as f:
+                kinds = Counter(
+                    json.loads(line).get("kind") for line in f if line.strip()
+                )
+            summary = ", ".join(f"{k}={v}" for k, v in sorted(kinds.items()))
+            print(f"OK       {path} ({summary})")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

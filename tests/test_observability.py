@@ -1,10 +1,25 @@
-"""Observability: phase timers, profiler traces, NaN debug mode.
+"""Observability: event bus + schema, device-side metric accumulation,
+recompile/health monitors, bounded stats drain, phase timers, profiler
+traces, NaN debug mode.
 
 SURVEY §5's tracing/profiling obligations — the reference has only a
-wall-clock print (``trpo_inksci.py:89,167``).
+wall-clock print (``trpo_inksci.py:89,167``). PR 3 consolidates the
+scattered PR-1/2 instrumentation into ``trpo_tpu/obs``; the contracts
+pinned here: event records round-trip through JSONL and the one validator
+(``scripts/validate_events.py``); device metrics survive donation and ride
+the stats pytree (no extra transfers); the recompile monitor counts a
+deliberate shape-change retrace and ZERO retraces in a steady-state run;
+the bounded ``StatsDrain`` backpressures at its bound.
 """
 
+import json
+import threading
+import time
+
 import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
 
 from trpo_tpu.utils.timers import PhaseTimer
 
@@ -14,10 +29,30 @@ def test_phase_timer_records_and_nests():
     with t.phase("outer"):
         with t.phase("inner"):
             sum(range(1000))
-    assert t.last_ms("outer") >= t.last_ms("inner") >= 0.0
+    # nested phases record under the slash-joined path (PR 3)
+    assert t.last_ms("outer") >= t.last_ms("outer/inner") >= 0.0
+    assert t.counts["outer/inner"] == 1
     # unknown phases read as 0, not an error (callers print summaries
     # unconditionally)
     assert t.last_ms("never-ran") == 0.0
+
+
+def test_phase_timer_span_context_crosses_threads():
+    """A span created with a captured context records under the capturing
+    thread's open phase — the async pipeline's dispatch/drain split."""
+    t = PhaseTimer()
+    done = threading.Event()
+    with t.phase("rollout"):
+        ctx = t.current_context()
+
+        def off_thread():
+            span = t.span("stats_drain", context=ctx)
+            span.end()
+            done.set()
+
+        threading.Thread(target=off_thread).start()
+        assert done.wait(5.0)
+    assert t.counts["rollout/stats_drain"] == 1
 
 
 def test_phase_timer_jax_profiler_annotations():
@@ -63,3 +98,456 @@ def test_debug_nans_flag_enables_jax_checking():
         assert jax.config.jax_debug_nans is True
     finally:
         jax.config.update("jax_debug_nans", before)
+
+
+# ---------------------------------------------------------------------------
+# event bus + schema
+# ---------------------------------------------------------------------------
+
+
+def test_event_schema_roundtrip_jsonl(tmp_path):
+    """Every kind emitted through the bus parses back from JSONL and
+    passes the one validator — including via scripts/validate_events.py."""
+    from trpo_tpu.obs.events import EventBus, JsonlSink, manifest_fields, \
+        validate_event
+
+    path = tmp_path / "events.jsonl"
+    seen = []
+    bus = EventBus(JsonlSink(str(path)), seen.append)
+    bus.emit(
+        "run_manifest",
+        **manifest_fields({"env": "cartpole", "hidden": (64,)}),
+    )
+    bus.emit(
+        "iteration",
+        iteration=1,
+        # numpy/jax scalars must sanitize, NaN must survive the round trip
+        stats={
+            "entropy": np.float64(1.5),
+            "cg_iterations": jnp.asarray(7, jnp.int32),
+            "cg_iters_total": jnp.asarray(7, jnp.int32),
+            "linesearch_trials_total": 1,
+            "mean_episode_reward": float("nan"),
+            "kl_rolled_back": False,
+        },
+    )
+    bus.emit("phase", name="rollout", ms=12.5, calls=3)
+    bus.emit("health", check="ev_collapse", level="warn", message="m")
+    bus.emit("recompile", program="jit_f", count=2, unexpected=True)
+    bus.close()
+
+    rows = [json.loads(line) for line in open(path)]
+    assert [r["kind"] for r in rows] == [
+        "run_manifest", "iteration", "phase", "health", "recompile",
+    ]
+    for r in rows:
+        assert validate_event(r) == [], r
+    assert rows[0]["config_hash"] and rows[0]["jax_version"]
+    assert rows[1]["stats"]["cg_iterations"] == 7
+    nan_back = rows[1]["stats"]["mean_episode_reward"]
+    assert nan_back != nan_back
+    # the callback sink saw the same (sanitized) records
+    assert len(seen) == 5 and seen[1]["stats"]["entropy"] == 1.5
+
+    import sys
+    sys.path.insert(0, "scripts")
+    try:
+        import validate_events
+        assert validate_events.main([str(path)]) == 0
+    finally:
+        sys.path.remove("scripts")
+
+
+def test_event_bus_rejects_invalid_and_unknown():
+    from trpo_tpu.obs.events import EventBus, validate_event
+
+    bus = EventBus()
+    with pytest.raises(ValueError, match="unknown kind"):
+        bus.emit("nonsense", foo=1)
+    with pytest.raises(ValueError, match="missing required"):
+        bus.emit("phase", name="x")  # no ms
+    assert validate_event({"v": 99}) != []
+    assert validate_event("not a dict") == ["record is not a JSON object"]
+
+
+def test_jsonl_crash_safety_repairs_partial_tail(tmp_path):
+    """A killed run's half-written final line is truncated away on the
+    next append — for the StatsLogger JSONL stream AND the event sink."""
+    from trpo_tpu.obs.events import EventBus, JsonlSink
+    from trpo_tpu.utils.metrics import StatsLogger, repair_jsonl_tail
+
+    path = tmp_path / "stats.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"iteration": 1, "ok": True}) + "\n")
+        f.write('{"iteration": 2, "trunc')  # the mid-line kill
+    import io
+    logger = StatsLogger(jsonl_path=str(path), stream=io.StringIO())
+    logger.log(2, {"ok": True})
+    logger.close()
+    rows = [json.loads(line) for line in open(path)]
+    assert [r["iteration"] for r in rows] == [1, 2]
+
+    epath = tmp_path / "events.jsonl"
+    with open(epath, "w") as f:
+        f.write('{"v": 1, "kind": "pha')
+    bus = EventBus(JsonlSink(str(epath)))
+    bus.emit("phase", name="p", ms=1.0)
+    bus.close()
+    rows = [json.loads(line) for line in open(epath)]
+    assert len(rows) == 1 and rows[0]["name"] == "p"
+    # idempotent on a clean file
+    assert repair_jsonl_tail(str(epath)) == 0
+
+
+# ---------------------------------------------------------------------------
+# device-side metric accumulation
+# ---------------------------------------------------------------------------
+
+
+def _tiny_agent():
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+
+    return TRPOAgent(
+        "cartpole",
+        TRPOConfig(
+            env="cartpole", n_envs=4, batch_timesteps=40,
+            vf_train_steps=2, policy_hidden=(8,), cg_iters=4,
+        ),
+    )
+
+
+def test_device_metrics_survive_donated_updates():
+    """The counters ride TrainState through donated updates: the old
+    state's metric buffers die with the donation, the returned state's
+    totals accumulate monotonically, and the SAME values arrive in the
+    stats pytree (no separate fetch path that could diverge)."""
+    agent = _tiny_agent()
+    s0 = agent.init_state()
+    s1, st1 = agent.run_iteration(s0)
+    assert jax.tree_util.tree_leaves(s0.metrics)[0].is_deleted()
+    m1 = jax.device_get(s1.metrics)  # read BEFORE donating s1 (contract)
+    s2, st2 = agent.run_iteration(s1)
+    m2 = jax.device_get(s2.metrics)
+    assert int(st1["cg_iters_total"]) == int(m1.cg_iters_total)
+    assert int(st2["cg_iters_total"]) == int(m2.cg_iters_total)
+    # monotone accumulation, consistent with the per-iteration stats
+    assert int(m2.cg_iters_total) == int(m1.cg_iters_total) + int(
+        st2["cg_iterations"]
+    )
+    assert int(m2.linesearch_trials_total) == int(
+        m1.linesearch_trials_total
+    ) + int(st2["linesearch_trials"])
+    assert int(st2["linesearch_trials"]) >= 1
+    assert int(m2.nan_guard_total) == 0 and int(m2.rollback_total) >= 0
+
+
+def test_device_metrics_in_fused_multi_iteration_scan():
+    """run_iterations (the n-iteration device scan) stacks per-iteration
+    counter snapshots; the final state's totals equal the last snapshot."""
+    agent = _tiny_agent()
+    state = agent.init_state()
+    state, stats = agent.run_iterations(state, 3)
+    totals = np.asarray(stats["cg_iters_total"])
+    assert totals.shape == (3,)
+    assert np.all(np.diff(totals) > 0)  # every iteration ran CG
+    assert int(jax.device_get(state.metrics).cg_iters_total) == totals[-1]
+
+
+# ---------------------------------------------------------------------------
+# bounded stats drain
+# ---------------------------------------------------------------------------
+
+
+def test_stats_drain_bounded_backpressure():
+    """With maxsize=1 a slow consumer throttles submit: the queue never
+    exceeds the bound, yet every item is consumed exactly once, in order
+    (the overlap contract survives bounding)."""
+    from trpo_tpu.utils.async_pipe import StatsDrain
+
+    seen = []
+
+    def slow_consume(tag, stats):
+        time.sleep(0.02)
+        seen.append(tag)
+
+    drain = StatsDrain(slow_consume, maxsize=1)
+    for i in range(5):
+        drain.submit(i, {"v": jnp.asarray(float(i))})
+        assert drain.depth <= 1
+    drain.drain()
+    drain.close()
+    assert seen == list(range(5))
+    assert drain.high_water <= 1
+
+
+def test_stats_drain_bounded_submit_unblocks_after_error():
+    """A dead consumer must not deadlock a bounded submit: post-error the
+    drain keeps discarding, so the queue keeps moving and the error still
+    surfaces on the main thread."""
+    from trpo_tpu.utils.async_pipe import StatsDrain
+
+    def boom(tag, stats):
+        raise FloatingPointError("boom")
+
+    drain = StatsDrain(boom, maxsize=1)
+    for i in range(4):  # > maxsize: would hang if discard ever stopped
+        drain.submit(i, {"v": jnp.asarray(0.0)})
+    with pytest.raises(FloatingPointError):
+        drain.drain()
+    with pytest.raises(FloatingPointError):
+        drain.close()
+
+
+# ---------------------------------------------------------------------------
+# recompile monitor
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_monitor_counts_shape_change_retrace():
+    from trpo_tpu.obs.events import EventBus
+    from trpo_tpu.obs.recompile import RecompileMonitor
+
+    events = []
+    mon = RecompileMonitor(bus=EventBus(events.append))
+    # build the operands OUTSIDE the monitored window: jnp.ones itself
+    # jit-compiles tiny helper programs (broadcast_in_dim, …) that would
+    # otherwise count as compiles of their own
+    x4, x8 = jnp.ones(4), jnp.ones(8)
+    with mon:
+        f = jax.jit(lambda x: x * 2 + 1)
+        jax.block_until_ready(f(x4))
+        jax.block_until_ready(f(x4))  # cache hit: no compile
+        mon.mark_steady()
+        jax.block_until_ready(f(x4))  # still steady
+        assert sum(mon.unexpected_retraces().values()) == 0
+        jax.block_until_ready(f(x8))  # deliberate shape change
+    assert mon.total_compiles() == {"jit(<lambda>)": 2}
+    assert mon.unexpected_retraces() == {"jit(<lambda>)": 1}
+    unexpected = [e for e in events if e["unexpected"]]
+    assert len(unexpected) == 1 and unexpected[0]["kind"] == "recompile"
+    # config restored on stop
+    assert jax.config.jax_log_compiles is False
+
+
+# ---------------------------------------------------------------------------
+# health monitor
+# ---------------------------------------------------------------------------
+
+
+def test_health_monitor_rules():
+    from trpo_tpu.obs.events import EventBus
+    from trpo_tpu.obs.health import HealthConfig, HealthMonitor
+
+    events = []
+    mon = HealthMonitor(
+        bus=EventBus(events.append),
+        config=HealthConfig(rollback_streak=2, ev_collapse=-0.5,
+                            ev_warmup_iterations=0),
+    )
+    base = {"entropy": 1.0, "vf_explained_variance": 0.5,
+            "kl_rolled_back": False, "nan_guard": False}
+    assert mon.observe_iteration(1, base) == []
+    # rollback streak: warn once at the crossing, not per iteration
+    mon.observe_iteration(2, {**base, "kl_rolled_back": True})
+    f = mon.observe_iteration(3, {**base, "kl_rolled_back": True})
+    assert [x["check"] for x in f] == ["kl_rollback_streak"]
+    assert mon.observe_iteration(4, {**base, "kl_rolled_back": True}) == []
+    # EV collapse warns below threshold, re-arms on recovery
+    f = mon.observe_iteration(5, {**base, "vf_explained_variance": -2.0})
+    assert [x["check"] for x in f] == ["ev_collapse"]
+    mon.observe_iteration(6, {**base, "vf_explained_variance": 0.9})
+    f = mon.observe_iteration(7, {**base, "vf_explained_variance": -2.0})
+    assert [x["check"] for x in f] == ["ev_collapse"]
+    # NaN entropy and the device nan_guard are errors
+    f = mon.observe_iteration(
+        8, {**base, "entropy": float("nan"), "nan_guard": True}
+    )
+    assert {x["check"] for x in f} == {"nan_entropy", "nan_guard"}
+    # drain gauge: the HIGH-WATER mark (not the racy instantaneous
+    # depth) trips the warning, once per run
+    assert mon.observe_drain(1, 1, 2) == []
+    assert mon.observe_drain(0, 2, 2)[0]["check"] == (
+        "stats_drain_backpressure"
+    )
+    assert mon.observe_drain(2, 2, 2) == []
+    assert all(e["kind"] == "health" for e in events)
+
+
+# ---------------------------------------------------------------------------
+# end to end: CLI --metrics-jsonl + steady-state retrace count
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_jsonl_training_smoke_and_zero_retraces(tmp_path):
+    """The ISSUE 3 acceptance run: a CPU smoke training run with
+    --metrics-jsonl emits schema-valid per-iteration events carrying the
+    device-accumulated CG-iteration and linesearch-trial counters, and
+    the recompile monitor reports ZERO unexpected retraces across a
+    5-iteration steady-state run."""
+    from trpo_tpu.obs.events import validate_event
+    from trpo_tpu.train import main
+
+    events = tmp_path / "events.jsonl"
+    rc = main([
+        "--preset", "cartpole", "--iterations", "5",
+        "--batch-timesteps", "48", "--n-envs", "4", "--cg-iters", "4",
+        "--platform", "cpu",
+        "--metrics-jsonl", str(events), "--health-checks",
+    ])
+    assert rc == 0
+    recs = [json.loads(line) for line in open(events)]
+    for r in recs:
+        assert validate_event(r) == [], r
+    assert recs[0]["kind"] == "run_manifest"
+    assert recs[0]["config"]["env"] == "cartpole"
+    iters = [r for r in recs if r["kind"] == "iteration"]
+    assert [r["iteration"] for r in iters] == [1, 2, 3, 4, 5]
+    last = iters[-1]["stats"]
+    assert last["cg_iters_total"] >= last["cg_iterations"] * 1
+    assert last["linesearch_trials_total"] >= 5  # ≥1 trial per iteration
+    assert last["nan_guard_total"] == 0
+    # steady-state contract: zero unexpected retraces after warmup
+    retraces = [r for r in recs if r["kind"] == "recompile"
+                and r["unexpected"]]
+    assert retraces == [], retraces
+    # phase summaries re-emitted through the same bus/schema
+    assert any(r["kind"] == "phase" and r["name"] == "iteration"
+               for r in recs)
+
+
+def test_async_driver_emits_same_iteration_events(tmp_path):
+    """The async host-env driver routes its drained rows through the same
+    bus (from the drain thread): one iteration event per iteration, with
+    the device counters — and zero extra hot-path transfers is already
+    pinned by the bit-exactness suite."""
+    pytest.importorskip("gymnasium")
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.obs import Telemetry
+
+    events = tmp_path / "events.jsonl"
+    cfg = TRPOConfig(
+        env="gym:CartPole-v1", n_envs=4, batch_timesteps=48,
+        vf_train_steps=3, policy_hidden=(16,), seed=3,
+        host_async_pipeline=True,
+    )
+    telemetry = Telemetry(events_jsonl=str(events), health_checks=True)
+    agent = TRPOAgent(cfg.env, cfg)
+    import io
+    from trpo_tpu.utils.metrics import StatsLogger
+
+    logger = StatsLogger(stream=io.StringIO())
+    agent.learn(n_iterations=3, logger=logger, telemetry=telemetry)
+    telemetry.close()
+    recs = [json.loads(line) for line in open(events)]
+    iters = [r for r in recs if r["kind"] == "iteration"]
+    assert [r["iteration"] for r in iters] == [1, 2, 3]
+    assert all("cg_iters_total" in r["stats"] for r in iters)
+    assert recs[0]["kind"] == "run_manifest"
+    assert recs[0]["driver"] == "async"
+
+
+def test_profile_iteration_window_writes_trace(tmp_path):
+    """--profile-dir + --profile-iteration captures a windowed trace
+    around the requested iteration (not the whole run)."""
+    from trpo_tpu.train import main
+
+    out = tmp_path / "trace"
+    rc = main([
+        "--preset", "cartpole", "--iterations", "3",
+        "--batch-timesteps", "32", "--platform", "cpu",
+        "--profile-dir", str(out), "--profile-iteration", "2",
+    ])
+    assert rc == 0
+    produced = list(out.rglob("*.xplane.pb")) + list(
+        out.rglob("*.trace.json.gz")
+    )
+    assert produced, f"no windowed trace files under {out}"
+
+
+def test_repair_jsonl_tail_scans_past_window_sized_partials(tmp_path):
+    """A partial tail LONGER than the scan window must not take the valid
+    records before it down with it (backward scan, not one fixed window)."""
+    from trpo_tpu.utils.metrics import repair_jsonl_tail
+
+    path = tmp_path / "big.jsonl"
+    good = json.dumps({"iteration": 1, "ok": True}) + "\n"
+    with open(path, "w") as f:
+        f.write(good)
+        f.write('{"blob": "' + "x" * (2 << 20))  # 2 MiB, no newline
+    removed = repair_jsonl_tail(str(path))
+    assert removed > 2 << 20 - 1
+    assert open(path).read() == good
+    # a file that is ONE giant partial line truncates to empty
+    with open(path, "w") as f:
+        f.write("y" * (2 << 20))
+    repair_jsonl_tail(str(path))
+    assert open(path).read() == ""
+
+
+def test_restore_checkpoint_predating_device_metrics(tmp_path):
+    """A checkpoint saved before TrainState.metrics existed restores into
+    the current template with the counters reset to zero (same tolerance
+    class as the cg_damping/precond structure flips)."""
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    agent = _tiny_agent()
+    state = agent.init_state()
+    pre_pr3 = state._replace(metrics=None)  # the old pytree structure
+    ck = Checkpointer(str(tmp_path / "ck"))
+    ck.save(1, pre_pr3)
+    restored = ck.restore(agent.init_state())
+    m = jax.device_get(restored.metrics)
+    assert int(m.cg_iters_total) == 0 and int(m.rollback_total) == 0
+    # and the restored state trains (the donation/jit template matches)
+    s1, stats = agent.run_iteration(restored)
+    assert int(stats["cg_iters_total"]) == int(stats["cg_iterations"])
+
+
+def test_fused_tail_chunk_is_not_flagged_as_retrace(tmp_path):
+    """fuse_iterations with a shorter final chunk compiles a second
+    n-iteration program late in the run — steady-state marking must wait
+    for it (a legitimate late compile is not a retrace)."""
+    from trpo_tpu.obs import Telemetry
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.agent import TRPOAgent
+    import io
+    from trpo_tpu.utils.metrics import StatsLogger
+
+    events = tmp_path / "events.jsonl"
+    cfg = TRPOConfig(
+        env="cartpole", n_envs=4, batch_timesteps=40,
+        vf_train_steps=2, policy_hidden=(8,), cg_iters=4,
+        fuse_iterations=3,
+    )
+    agent = TRPOAgent("cartpole", cfg)
+    telemetry = Telemetry(events_jsonl=str(events))
+    agent.learn(
+        n_iterations=7,  # chunks 3 + 3 + 1: the k=1 tail compiles last
+        logger=StatsLogger(stream=io.StringIO()),
+        telemetry=telemetry,
+    )
+    telemetry.close()
+    recs = [json.loads(line) for line in open(events)]
+    retraces = [r for r in recs if r["kind"] == "recompile"
+                and r["unexpected"]]
+    assert retraces == [], retraces
+    # both chunk programs did compile (counted, just not as retraces)
+    compiles = [r for r in recs if r["kind"] == "recompile"]
+    assert len(compiles) >= 2
+
+
+def test_linesearch_result_exposes_trial_count():
+    from trpo_tpu.ops.linesearch import backtracking_linesearch
+
+    # f(x) = x² from x=2 along -4: full step overshoots to -2 (no
+    # improvement), first backtrack lands at 0 — two trials executed
+    res = backtracking_linesearch(
+        lambda x: jnp.sum(x * x),
+        jnp.asarray([2.0]),
+        jnp.asarray([-4.0]),
+        expected_improve_rate=jnp.asarray(8.0),
+    )
+    assert bool(res.success)
+    assert int(res.trials) == 2

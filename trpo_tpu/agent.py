@@ -56,6 +56,11 @@ from trpo_tpu.trpo import (
     make_trpo_update,
     standardize_advantages,
 )
+from trpo_tpu.obs.device_metrics import (
+    accumulate_update,
+    init_device_metrics,
+    metrics_stats,
+)
 from trpo_tpu.utils.metrics import StatsLogger, explained_variance
 from trpo_tpu.utils.timers import PhaseTimer
 from trpo_tpu.vf import VFState, create_value_function
@@ -82,6 +87,14 @@ class TrainState(NamedTuple):
     #                           active (cg_precondition="head_block" with
     #                           precond_refresh_every > 1), else None.
     #                           Donated with the rest of the state.
+    metrics: Any = None       # obs/device_metrics.DeviceMetrics — run-
+    #                           cumulative solver counters (CG iterations
+    #                           executed, early exits, linesearch trials,
+    #                           rollbacks, NaN-guard trips) accumulated ON
+    #                           DEVICE inside phase A and snapshotted into
+    #                           the stats pytree, so they ride the
+    #                           deferred stats drain with zero extra
+    #                           device→host syncs. Donated like the rest.
 
 
 class TRPOAgent:
@@ -483,6 +496,7 @@ class TRPOAgent:
             if self.cfg.adaptive_damping
             else None,
             precond=precond,
+            metrics=init_device_metrics(),
         )
         if self.mesh is not None:
             # Annotate EVERY remaining leaf replicated over the mesh. This
@@ -738,6 +752,14 @@ class TRPOAgent:
             / ep_denom,
         )
 
+        # device-side metric accumulation (obs/device_metrics.py): a few
+        # int32 scalar adds fused into this program; the totals ride the
+        # TrainState (donated) and snapshot into phase B's stats pytree
+        new_metrics = train_state.metrics
+        if new_metrics is not None:
+            new_metrics = accumulate_update(
+                new_metrics, trpo_stats, self.cfg.cg_iters
+            )
         new_state = train_state._replace(
             policy_params=new_policy_params,
             obs_norm=new_obs_norm,
@@ -751,6 +773,7 @@ class TRPOAgent:
             precond=trpo_stats.precond_next
             if trpo_stats.precond_next is not None
             else train_state.precond,
+            metrics=new_metrics,
         )
         # the (H+1)² factor matrices belong in TrainState, not in the
         # per-iteration stats pytree (run_iterations would stack them
@@ -766,6 +789,10 @@ class TRPOAgent:
             "mean_episode_reward": mean_ep_reward,
             "mean_episode_length": mean_ep_length,
             "episodes_in_batch": n_episodes.astype(jnp.int32),
+            # snapshot of the run-cumulative device counters for phase B's
+            # stats assembly (same buffers as new_state.metrics — phase B
+            # is always dispatched before the next phase A donates them)
+            "device_metrics": new_metrics,
         }
         return new_state, fit_pack
 
@@ -810,7 +837,15 @@ class TRPOAgent:
             * trpo_stats.step_fraction**2,
             "kl_rolled_back": trpo_stats.rolled_back,
             "cg_damping": trpo_stats.damping,
+            # --- per-iteration solver observability (PR 3) ---
+            "linesearch_trials": trpo_stats.linesearch_trials,
+            "cg_early_exit": trpo_stats.cg_iterations < self.cfg.cg_iters,
+            "nan_guard": trpo_stats.nan_guard,
         }
+        if fit_pack.get("device_metrics") is not None:
+            # run-cumulative device counters — part of the SAME stats
+            # pytree, so they drain/log/emit with zero extra transfers
+            stats.update(metrics_stats(fit_pack["device_metrics"]))
         return new_vf_state, stats
 
     def _process_trajectory(
@@ -1205,6 +1240,7 @@ class TRPOAgent:
         checkpointer=None,
         callback=None,
         use_jax_profiler: bool = False,
+        telemetry=None,
     ) -> TrainState:
         """Outer training loop.
 
@@ -1224,6 +1260,14 @@ class TRPOAgent:
         iterations), the same granularity trade ``fuse_iterations`` makes.
         ``callback`` then runs on the drain thread with the matched
         ``(state, stats)`` of each iteration.
+
+        ``telemetry`` (an ``obs.Telemetry``, optional) routes the run
+        through the unified event bus: run manifest at start, iteration
+        events via the logger, health checks on each drained row, the
+        recompile monitor armed after warmup, phase summaries + an
+        iteration-windowed profiler capture. ``learn`` drives its
+        lifecycle (``start_run``/``mark_steady``/``finish_run``); the
+        creator closes the sinks.
         """
         cfg = self.cfg
         n_iterations = n_iterations or cfg.n_iterations
@@ -1232,14 +1276,32 @@ class TRPOAgent:
         logger = logger or StatsLogger(jsonl_path=cfg.log_jsonl)
         # with use_jax_profiler, phases appear as named TraceAnnotations in
         # jax.profiler traces (the CLI's --profile-dir wires this through)
-        timer = PhaseTimer(use_jax_profiler=use_jax_profiler)
+        timer = PhaseTimer(
+            use_jax_profiler=use_jax_profiler
+            or (telemetry is not None
+                and telemetry.profile_dir is not None)
+        )
+        if telemetry is not None:
+            if getattr(logger, "bus", None) is None:
+                # the logger re-emits each row as an iteration event —
+                # ONE schema for the JSONL log and the telemetry stream
+                logger.bus = telemetry.bus
+            telemetry.start_run(
+                cfg,
+                driver="async"
+                if cfg.host_async_pipeline and not self.is_device_env
+                else "serial",
+                n_iterations=n_iterations,
+            )
         if cfg.host_async_pipeline and not self.is_device_env:
             try:
                 return self._learn_host_async(
                     n_iterations, state, logger, checkpointer, callback,
-                    timer,
+                    timer, telemetry,
                 )
             finally:
+                if telemetry is not None:
+                    telemetry.finish_run(timer)
                 if own_logger:
                     logger.close()
         # fused chunks: one device program (and ONE host sync) per `chunk`
@@ -1256,10 +1318,20 @@ class TRPOAgent:
 
         reward_running = RunningEpisodeMean()
 
+        # absolute iteration base for the profiler window, so
+        # --profile-iteration N names the same iteration in both drivers
+        # and across resumes (one entry sync, like the async driver's)
+        it0 = int(state.iteration) if telemetry is not None else 0
+
         try:
             done = 0
+            seen_chunk_sizes: set = set()
             while done < n_iterations:
                 k = min(chunk, n_iterations - done)
+                if telemetry is not None:
+                    # span=k: a fused chunk is one indivisible program —
+                    # the window opens for the chunk CONTAINING N
+                    telemetry.profile_tick(it0 + done + 1, span=k)
                 with timer.phase("iteration"):
                     if k == 1:
                         state, stats = self.run_iteration(state)
@@ -1273,6 +1345,20 @@ class TRPOAgent:
                         state, stats = self.run_iterations(state, k)
                         stack = jax.device_get(stats)
                 done += k
+                seen_chunk_sizes.add(k)
+                if telemetry is not None and done >= 2:
+                    # warmup over ONLY once every chunk size this run
+                    # will still use has compiled: run_iterations jits
+                    # per n, so a shorter TAIL chunk legitimately
+                    # compiles late and must not read as a retrace
+                    rem = n_iterations - done
+                    future = set()
+                    if rem > 0:
+                        future.add(min(chunk, rem))
+                        if rem > chunk and rem % chunk:
+                            future.add(rem % chunk)
+                    if future <= seen_chunk_sizes:
+                        telemetry.mark_steady()
                 it_end = int(state.iteration)
                 per_iter_ms = timer.last_ms("iteration") / k
                 ts_end = int(state.total_timesteps)
@@ -1293,6 +1379,7 @@ class TRPOAgent:
                         iteration_ms=per_iter_ms,
                         timesteps_total=ts_end
                         - (k - 1 - j) * steps_per_iter,
+                        telemetry=telemetry,
                     ) or stop
                 if callback is not None:
                     # once per chunk, with MATCHED (state, stats): the
@@ -1315,6 +1402,8 @@ class TRPOAgent:
                 if stop:
                     break
         finally:
+            if telemetry is not None:
+                telemetry.finish_run(timer)
             if own_logger:
                 logger.close()
         return state
@@ -1322,6 +1411,7 @@ class TRPOAgent:
     def _finish_iteration_stats(
         self, host_stats, reward_running, logger, *,
         iteration: int, iteration_ms: float, timesteps_total: int,
+        telemetry=None,
     ) -> bool:
         """Decorate ONE iteration's host stats (running episode-return
         mean, wall-clock fields, timestep total), log the row, then apply
@@ -1341,6 +1431,11 @@ class TRPOAgent:
         host_stats["iteration_ms"] = iteration_ms
         host_stats["timesteps_total"] = timesteps_total
         logger.log(iteration, host_stats)
+        if telemetry is not None:
+            # health rules see the row BEFORE the NaN abort below can
+            # raise, so the finding reaches the sinks even on the abort
+            # path (runs on the drain thread under the async driver)
+            telemetry.on_iteration(iteration, host_stats)
         ent = host_stats["entropy"]
         if ent != ent:  # NaN check (ref trpo_inksci.py:172-173)
             raise FloatingPointError(
@@ -1364,6 +1459,7 @@ class TRPOAgent:
 
     def _learn_host_async(
         self, n_iterations, state, logger, checkpointer, callback, timer,
+        telemetry=None,
     ) -> TrainState:
         """The async iteration driver for host-simulator envs.
 
@@ -1419,12 +1515,19 @@ class TRPOAgent:
                 iteration=i + 1,
                 iteration_ms=iter_wall_ms,
                 timesteps_total=ts0 + (i - it0 + 1) * steps_per_iter,
+                telemetry=telemetry,
             )
             if callback is not None:
                 callback(cb_state, host_stats)
             return stop
 
-        drain = StatsDrain(_consume, timer=timer)
+        # bounded queue (cfg.stats_drain_maxsize, default 2): on a link
+        # where the stats fetch outpaces the iteration, submit blocks at
+        # the bound instead of letting the stop-condition lag grow — the
+        # ROADMAP r06-review fix; depth/high-water feed the health monitor
+        drain = StatsDrain(
+            _consume, timer=timer, maxsize=self.cfg.stats_drain_maxsize
+        )
         cur = state
         act_fn = getattr(self, "_host_act_fn", None) or self._make_host_act()
         # Deferred phase-B dispatch. Device execution queues are FIFO: a
@@ -1460,6 +1563,13 @@ class TRPOAgent:
         try:
             for j in range(n_iterations):
                 i = it0 + j
+                if telemetry is not None:
+                    telemetry.profile_tick(i + 1)
+                    if j >= 2:
+                        # by now both phase programs and the act fn have
+                        # compiled (phase B first runs during iteration
+                        # 2's rollout) — later compiles are retraces
+                        telemetry.mark_steady()
                 with timer.phase("rollout"):
                     # same derivation as the serial run_iteration — the
                     # iteration index is host-tracked, so no device sync
@@ -1532,6 +1642,12 @@ class TRPOAgent:
                             i + 1, self.snapshot_host_env()
                         )
                 drain.raise_if_failed()
+                if telemetry is not None:
+                    # host-side gauges only — never a device sync; the
+                    # health monitor warns when the bound is reached
+                    telemetry.observe_drain(
+                        drain.depth, drain.high_water, drain.maxsize
+                    )
                 if drain.stop_requested:
                     break
             _flush_b()

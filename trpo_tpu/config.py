@@ -239,6 +239,19 @@ class TRPOConfig:
     #                                pipeline depth (≤ 2 iterations) — the
     #                                same granularity trade fuse_iterations
     #                                makes for device envs.
+    stats_drain_maxsize: int = 2   # async pipeline only: bound on the
+    #                                deferred-stats queue
+    #                                (utils/async_pipe.StatsDrain). When the
+    #                                per-iteration stats fetch is slower
+    #                                than the iteration itself, submit
+    #                                blocks at the bound — backpressure that
+    #                                caps the stop-condition lag at exactly
+    #                                this many iterations (2 matches the
+    #                                documented pipeline-depth overshoot)
+    #                                instead of letting it grow without
+    #                                limit. 0 = unbounded (PR-1 behavior).
+    #                                Queue depth/high-water ride the
+    #                                telemetry bus as health gauges.
     host_staged_transfers: bool = True  # pipelined host rollout
     #                                (host_pipeline_groups > 1): stage each
     #                                group's (T, m_g, ...) trajectory slice
@@ -310,6 +323,11 @@ class TRPOConfig:
             raise ValueError(
                 'cg_precondition must be False, "jacobi" (True), or '
                 f'"head_block", got {self.cg_precondition!r}'
+            )
+        if self.stats_drain_maxsize < 0:
+            raise ValueError(
+                "stats_drain_maxsize must be >= 0 (0 = unbounded), got "
+                f"{self.stats_drain_maxsize}"
             )
         if self.precond_refresh_every < 1:
             raise ValueError(

@@ -1,0 +1,40 @@
+"""Unified telemetry: run-event bus, device-side metric accumulation,
+recompile/health monitors, and the ``Telemetry`` bundle drivers thread
+through a run (ISSUE 3 tentpole). See ``ARCHITECTURE.md`` "Telemetry"."""
+
+from trpo_tpu.obs.device_metrics import (  # noqa: F401
+    DeviceMetrics,
+    accumulate_update,
+    init_device_metrics,
+    metrics_stats,
+)
+from trpo_tpu.obs.events import (  # noqa: F401
+    EVENT_KINDS,
+    SCHEMA_VERSION,
+    ConsoleSink,
+    EventBus,
+    JsonlSink,
+    manifest_fields,
+    validate_event,
+)
+from trpo_tpu.obs.health import HealthConfig, HealthMonitor  # noqa: F401
+from trpo_tpu.obs.recompile import RecompileMonitor  # noqa: F401
+from trpo_tpu.obs.telemetry import Telemetry  # noqa: F401
+
+__all__ = [
+    "DeviceMetrics",
+    "accumulate_update",
+    "init_device_metrics",
+    "metrics_stats",
+    "EVENT_KINDS",
+    "SCHEMA_VERSION",
+    "ConsoleSink",
+    "EventBus",
+    "JsonlSink",
+    "manifest_fields",
+    "validate_event",
+    "HealthConfig",
+    "HealthMonitor",
+    "RecompileMonitor",
+    "Telemetry",
+]

@@ -1,0 +1,74 @@
+"""Device-side metric accumulation: counters that ride ``TrainState``.
+
+The solver's per-iteration diagnostics (CG iterations executed, linesearch
+trials, rollbacks) already come back in the stats pytree — but CUMULATIVE
+counters previously had to be folded on the host, which either puts a
+blocking device→host fetch back on the hot path (exactly what the async
+pipeline removed) or forgets the counts entirely. Here the counters are a
+tiny pytree of int32 scalars carried in ``TrainState.metrics``: the
+accumulation is a handful of scalar adds fused into phase A of the update
+program, the snapshot rides the SAME deferred stats drain every other stat
+uses, and the pytree is donated with the rest of the state — zero extra
+transfers, zero extra HBM (``tests/test_observability.py`` pins donation
+safety and monotone accumulation).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "DeviceMetrics",
+    "init_device_metrics",
+    "accumulate_update",
+    "metrics_stats",
+]
+
+
+class DeviceMetrics(NamedTuple):
+    """Run-cumulative solver counters (all int32 scalars)."""
+
+    cg_iters_total: jax.Array         # CG iterations actually executed
+    cg_early_exit_total: jax.Array    # updates whose CG exited before cap
+    linesearch_trials_total: jax.Array  # backtracking trials evaluated
+    rollback_total: jax.Array         # KL rollbacks fired
+    nan_guard_total: jax.Array        # updates with a nonfinite guard trip
+
+
+def init_device_metrics() -> DeviceMetrics:
+    z = lambda: jnp.asarray(0, jnp.int32)
+    return DeviceMetrics(z(), z(), z(), z(), z())
+
+
+def accumulate_update(
+    metrics: DeviceMetrics, trpo_stats, cg_iter_cap: int
+) -> DeviceMetrics:
+    """Fold one TRPO update's ``TRPOStats`` into the counters (traced into
+    the update program — ``cg_iter_cap`` is the static ``cfg.cg_iters``
+    budget, so "early exit" means the residual rule fired first)."""
+    i32 = lambda x: jnp.asarray(x, jnp.int32)
+    return DeviceMetrics(
+        cg_iters_total=metrics.cg_iters_total
+        + i32(trpo_stats.cg_iterations),
+        cg_early_exit_total=metrics.cg_early_exit_total
+        + i32(trpo_stats.cg_iterations < cg_iter_cap),
+        linesearch_trials_total=metrics.linesearch_trials_total
+        + i32(trpo_stats.linesearch_trials),
+        rollback_total=metrics.rollback_total + i32(trpo_stats.rolled_back),
+        nan_guard_total=metrics.nan_guard_total + i32(trpo_stats.nan_guard),
+    )
+
+
+def metrics_stats(metrics: DeviceMetrics) -> dict:
+    """The counters as stats-pytree entries — merged into the phase-B
+    stats dict so they drain/log/emit exactly like every other stat."""
+    return {
+        "cg_iters_total": metrics.cg_iters_total,
+        "cg_early_exit_total": metrics.cg_early_exit_total,
+        "linesearch_trials_total": metrics.linesearch_trials_total,
+        "rollback_total": metrics.rollback_total,
+        "nan_guard_total": metrics.nan_guard_total,
+    }

@@ -1,0 +1,294 @@
+"""The run-event bus: typed, versioned JSONL records with pluggable sinks.
+
+PRs 1-2 grew observability ad hoc — PhaseTimer spans, the StatsDrain,
+``bench.py``'s ``update_tail_breakdown`` — each with its own output shape,
+none visible during a real training run. This module is the ONE schema all
+of them emit through: every record is a flat JSON object with a versioned
+envelope (``v``, ``kind``, ``t``), and :func:`validate_event` is the single
+source of truth for what each kind requires — used by the bus itself (an
+invalid emit is a programming error and raises), by
+``scripts/validate_events.py`` (artifact checking in ``check.sh``), and by
+``tests/test_observability.py`` (schema round-trip).
+
+Kinds:
+
+* ``run_manifest`` — once per run, first record: config + config hash,
+  jax/backend versions, device count, git sha. A JSONL file is
+  self-describing: a reader never has to guess which code produced it.
+* ``iteration`` — one per training iteration (``StatsLogger`` re-emits its
+  JSONL row through the bus): the reference's seven stats plus the
+  extended set, including the device-accumulated counters from
+  ``obs/device_metrics.py``.
+* ``phase`` — a named timing (PhaseTimer summaries, ``bench.py``'s
+  update-tail phases): same schema for bench artifacts and training logs.
+* ``health`` — a monitor finding (``obs/health.py``): check name, level,
+  message, optional data.
+* ``recompile`` — one XLA compilation observed by the recompile monitor
+  (``obs/recompile.py``), flagged ``unexpected`` when it happened after
+  the run was marked steady.
+
+Sinks are append-only and flush-on-write; the JSONL sink repairs a
+crash-truncated final line on open (``utils/metrics.repair_jsonl_tail``),
+so a killed run never poisons the next append. ``EventBus.emit`` is
+thread-safe — the async pipeline's drain thread emits iteration events
+while the main thread emits phase/recompile events.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import IO, Any, Callable, Iterable, Optional
+
+from trpo_tpu.utils.metrics import repair_jsonl_tail
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "EVENT_KINDS",
+    "EventBus",
+    "JsonlSink",
+    "ConsoleSink",
+    "validate_event",
+    "manifest_fields",
+]
+
+SCHEMA_VERSION = 1
+
+_SCALAR = (bool, int, float, str, type(None))
+
+# kind -> {field: predicate}; extra fields are always allowed (the schema
+# is versioned and additive — readers must tolerate fields they don't know)
+_REQUIRED = {
+    "run_manifest": {
+        "schema": lambda v: v == "trpo-tpu-events",
+        "jax_version": lambda v: isinstance(v, str),
+        "backend": lambda v: isinstance(v, str),
+        "config_hash": lambda v: isinstance(v, str) and len(v) >= 8,
+        "config": lambda v: v is None or isinstance(v, dict),
+    },
+    "iteration": {
+        "iteration": lambda v: isinstance(v, int) and not isinstance(v, bool),
+        "stats": lambda v: isinstance(v, dict)
+        and all(isinstance(x, _SCALAR) for x in v.values()),
+    },
+    "phase": {
+        "name": lambda v: isinstance(v, str) and v,
+        "ms": lambda v: isinstance(v, (int, float))
+        and not isinstance(v, bool),
+    },
+    "health": {
+        "check": lambda v: isinstance(v, str) and v,
+        "level": lambda v: v in ("info", "warn", "error"),
+        "message": lambda v: isinstance(v, str),
+    },
+    "recompile": {
+        "program": lambda v: isinstance(v, str) and v,
+        "count": lambda v: isinstance(v, int) and not isinstance(v, bool),
+        "unexpected": lambda v: isinstance(v, bool),
+    },
+}
+
+EVENT_KINDS = tuple(sorted(_REQUIRED))
+
+
+def validate_event(rec: Any) -> list:
+    """Schema-check one event record; returns a list of error strings
+    (empty = valid). Works on freshly built records and on records parsed
+    back from JSONL — the round-trip invariant the tests pin."""
+    if not isinstance(rec, dict):
+        return ["record is not a JSON object"]
+    errs = []
+    if rec.get("v") != SCHEMA_VERSION:
+        errs.append(f"v must be {SCHEMA_VERSION}, got {rec.get('v')!r}")
+    if not isinstance(rec.get("t"), (int, float)) or isinstance(
+        rec.get("t"), bool
+    ):
+        errs.append("t (unix seconds) missing or non-numeric")
+    kind = rec.get("kind")
+    required = _REQUIRED.get(kind)
+    if required is None:
+        errs.append(f"unknown kind {kind!r} (have {list(EVENT_KINDS)})")
+        return errs
+    for field, ok in required.items():
+        if field not in rec:
+            errs.append(f"{kind}: missing required field {field!r}")
+        elif not ok(rec[field]):
+            errs.append(f"{kind}: field {field!r} failed its check "
+                        f"(got {rec[field]!r})")
+    return errs
+
+
+def _json_safe(x):
+    """Recursively coerce numpy/jax scalars, tuples, and unknown objects
+    into JSON-representable values (the bus sanitizes every record before
+    validating/writing, so callers may pass device scalars directly)."""
+    if isinstance(x, dict):
+        return {str(k): _json_safe(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_json_safe(v) for v in x]
+    if isinstance(x, _SCALAR):
+        return x
+    if hasattr(x, "item"):
+        try:
+            return _json_safe(x.item())
+        except Exception:
+            return str(x)
+    return str(x)
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+
+class JsonlSink:
+    """Append events to a JSONL file: crash-safe open (a partial final
+    line from a killed previous run is truncated away first), one
+    ``write`` call per record, flush-on-write."""
+
+    def __init__(self, path: str):
+        self.path = path
+        repair_jsonl_tail(path)
+        self._f: Optional[IO] = open(path, "a")
+
+    def write(self, rec: dict) -> None:
+        if self._f is None:
+            raise RuntimeError(f"JsonlSink({self.path}) is closed")
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class ConsoleSink:
+    """One-line console rendering, optionally restricted to a set of
+    kinds (the CLI's ``--health-checks`` prints health/recompile findings
+    without drowning stdout in per-iteration records)."""
+
+    def __init__(self, stream: Optional[IO] = None,
+                 kinds: Optional[Iterable[str]] = None):
+        self.stream = stream
+        self.kinds = None if kinds is None else frozenset(kinds)
+
+    def write(self, rec: dict) -> None:
+        if self.kinds is not None and rec.get("kind") not in self.kinds:
+            return
+        stream = self.stream if self.stream is not None else sys.stderr
+        body = {k: v for k, v in rec.items() if k not in ("v", "kind", "t")}
+        print(f"[obs:{rec.get('kind')}] {json.dumps(body)}", file=stream)
+
+    def close(self) -> None:
+        pass
+
+
+class _CallbackSink:
+    def __init__(self, fn: Callable[[dict], Any]):
+        self._fn = fn
+
+    def write(self, rec: dict) -> None:
+        self._fn(rec)
+
+    def close(self) -> None:
+        pass
+
+
+class EventBus:
+    """Validated, thread-safe fan-out of event records to sinks.
+
+    Sinks are objects with ``write(rec)``/``close()`` or bare callables
+    (wrapped). ``emit`` sanitizes the record (numpy/jax scalars → Python),
+    validates it against the schema (raising on failure — an invalid
+    event is a bug in the emitter, never data), then writes to every sink
+    under one lock so concurrent emitters (main loop, drain thread,
+    logging handlers) interleave whole records, not bytes."""
+
+    def __init__(self, *sinks):
+        self._sinks = [self._wrap(s) for s in sinks]
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _wrap(sink):
+        return sink if hasattr(sink, "write") else _CallbackSink(sink)
+
+    def add_sink(self, sink) -> None:
+        with self._lock:
+            self._sinks.append(self._wrap(sink))
+
+    def emit(self, kind: str, **fields) -> dict:
+        rec = _json_safe(
+            {"v": SCHEMA_VERSION, "kind": kind, "t": time.time(), **fields}
+        )
+        errs = validate_event(rec)
+        if errs:
+            raise ValueError(f"invalid {kind!r} event: {errs}")
+        with self._lock:
+            for s in self._sinks:
+                s.write(rec)
+        return rec
+
+    def close(self) -> None:
+        with self._lock:
+            for s in self._sinks:
+                s.close()
+            self._sinks = []
+
+
+# ---------------------------------------------------------------------------
+# run manifest
+# ---------------------------------------------------------------------------
+
+
+def _git_sha() -> Optional[str]:
+    """Repo HEAD sha, or None (not a checkout, no git binary, …) — the
+    manifest must never fail a run over provenance lookup."""
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=5, cwd=root,
+        )
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else None
+    except Exception:
+        return None
+
+
+def manifest_fields(config: Any = None, extra: Optional[dict] = None) -> dict:
+    """The ``run_manifest`` payload: config (dataclass or dict) + a stable
+    hash of it, jax/backend/device info, git sha. ``extra`` merges on top
+    (driver name, env id, bench parameters, …)."""
+    import dataclasses
+
+    import jax
+
+    cfg_dict = None
+    if config is not None:
+        cfg_dict = (
+            dataclasses.asdict(config)
+            if dataclasses.is_dataclass(config)
+            else dict(config)
+        )
+        cfg_dict = _json_safe(cfg_dict)
+    payload = json.dumps(cfg_dict, sort_keys=True, default=str)
+    fields = {
+        "schema": "trpo-tpu-events",
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "config": cfg_dict,
+        "config_hash": hashlib.sha256(payload.encode()).hexdigest()[:16],
+        "git_sha": _git_sha(),
+    }
+    if extra:
+        fields.update(_json_safe(extra))
+    return fields

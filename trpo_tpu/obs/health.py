@@ -1,0 +1,135 @@
+"""Health monitor: watch the iteration-event stream for known failure
+signatures and surface them as ``health`` events.
+
+The reference's only health check is the NaN-entropy ``exit(-1)``
+(``trpo_inksci.py:172-173``). The r04/r05 solver studies surfaced richer
+signatures worth watching continuously: KL-cap rollback STREAKS (the
+residual-aware solve tripled rollbacks before ``linesearch_kl_cap``
+landed), explained-variance collapse (a critic gone bad poisons every
+subsequent advantage estimate), nonfinite-guard trips inside the update
+(caught on device before they reach the entropy stat), and — async driver
+only — the StatsDrain queue hitting its bound (stop conditions are
+lagging; the backpressure documented in ``utils/async_pipe.py`` is
+engaged). Findings go through the event bus, so the pluggable sinks
+(console, JSONL, callback) all see one schema.
+
+Warnings are transition-gated: a streak emits when it CROSSES the
+threshold, not once per iteration while it persists — a 2000-iteration
+run with a bad phase produces a handful of findings, not a flood.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = ["HealthConfig", "HealthMonitor"]
+
+
+@dataclasses.dataclass
+class HealthConfig:
+    rollback_streak: int = 3       # consecutive KL rollbacks → warn
+    ev_collapse: float = -0.5      # explained variance below this → warn
+    ev_warmup_iterations: int = 10  # EV is legitimately garbage early on
+
+
+class HealthMonitor:
+    """Evaluate health rules against each iteration's host stats.
+
+    ``observe_iteration`` returns the findings it emitted (empty list =
+    healthy), so callers without a bus can still branch on them."""
+
+    def __init__(self, bus=None, config: Optional[HealthConfig] = None):
+        self.bus = bus
+        self.cfg = config or HealthConfig()
+        self._rollback_streak = 0
+        self._streak_reported = False
+        self._ev_reported = False
+        self._drain_reported = False
+        self.findings: list = []
+
+    def _emit(self, check: str, level: str, message: str,
+              iteration: Optional[int] = None, **data) -> dict:
+        finding = {"check": check, "level": level, "message": message}
+        if iteration is not None:
+            finding["iteration"] = iteration
+        if data:
+            finding["data"] = data
+        self.findings.append(finding)
+        if self.bus is not None:
+            self.bus.emit("health", **finding)
+        return finding
+
+    def observe_iteration(self, iteration: int, stats: dict) -> list:
+        out = []
+        ent = stats.get("entropy")
+        if ent is not None and ent != ent:  # NaN
+            out.append(self._emit(
+                "nan_entropy", "error",
+                "policy entropy is NaN — the NaN abort will fire",
+                iteration,
+            ))
+        if stats.get("nan_guard"):
+            out.append(self._emit(
+                "nan_guard", "error",
+                "nonfinite gradient/surrogate/entropy inside the update",
+                iteration,
+            ))
+        if stats.get("kl_rolled_back"):
+            self._rollback_streak += 1
+            if (
+                self._rollback_streak >= self.cfg.rollback_streak
+                and not self._streak_reported
+            ):
+                self._streak_reported = True
+                out.append(self._emit(
+                    "kl_rollback_streak", "warn",
+                    f"{self._rollback_streak} consecutive KL rollbacks — "
+                    "the quadratic step model is miscalibrated (consider "
+                    "linesearch_kl_cap / adaptive_damping)",
+                    iteration,
+                    streak=self._rollback_streak,
+                ))
+        else:
+            self._rollback_streak = 0
+            self._streak_reported = False
+        ev = stats.get("vf_explained_variance")
+        if (
+            ev is not None
+            and ev == ev  # EV is NaN when Var(y)=0 — not a collapse
+            and iteration > self.cfg.ev_warmup_iterations
+        ):
+            if ev < self.cfg.ev_collapse and not self._ev_reported:
+                self._ev_reported = True
+                out.append(self._emit(
+                    "ev_collapse", "warn",
+                    f"critic explained variance collapsed to {ev:.3g} — "
+                    "advantage estimates are worse than a zero baseline",
+                    iteration,
+                    explained_variance=ev,
+                ))
+            elif ev >= self.cfg.ev_collapse:
+                self._ev_reported = False  # recovered: re-arm the check
+        return out
+
+    def observe_drain(self, depth: int, high_water: int,
+                      maxsize: int) -> list:
+        """Async-driver gauge hook: called once per iteration with the
+        StatsDrain queue's depth/high-water/bound (host ints — no device
+        sync). Warns on the HIGH-WATER gauge reaching the bound — the
+        instantaneous depth races the drain thread's pops (a blocked
+        submit can have drained below the bound by the time this polls),
+        while high-water latches the event deterministically. Reported
+        once per run (high-water never recedes)."""
+        out = []
+        if maxsize and high_water >= maxsize and not self._drain_reported:
+            self._drain_reported = True
+            out.append(self._emit(
+                "stats_drain_backpressure", "warn",
+                f"stats drain queue hit its bound "
+                f"({high_water}/{maxsize}) — the per-iteration stats "
+                "fetch is slower than the iteration; stop conditions lag "
+                "by the full bound",
+                depth=depth, high_water=high_water, maxsize=maxsize,
+            ))
+        return out

@@ -1,0 +1,161 @@
+"""Recompile monitor: count and attribute unexpected XLA retraces.
+
+This codebase leans hard on buffer donation and stable jit templates
+(``agent.py``'s donation contract, the checkpoint-restore placement
+rules) — and the failure mode of getting one of those wrong is SILENT: a
+drifting shape/dtype/sharding retraces the program every iteration and
+training quietly runs at compile speed. jax already logs every
+trace/compile when ``jax_log_compiles`` is on; this monitor turns that
+into a counter: a ``logging.Handler`` attached to the ``jax`` logger
+parses the per-program "Finished XLA compilation of <name> …" records,
+counts compilations per jitted program, and — after the caller marks the
+run steady (warmup compiles are expected) — flags every further
+compilation as an unexpected retrace, optionally emitting a ``recompile``
+event through the bus as it happens.
+
+Scope: counts only while started (the handler is attached per instance,
+so concurrent test runs don't bleed into each other); ``jax_log_compiles``
+is saved/restored on stop, and while active a filter on the jax logger's
+PRE-EXISTING handlers (jax installs its own StreamHandler on ``jax``)
+drops the "Finished …" records we consume, so enabling the monitor does
+not spray compile logs over stderr while every other jax warning still
+prints.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = ["RecompileMonitor"]
+
+_COMPILE_RE = re.compile(
+    r"Finished XLA compilation of (.+?) in ([0-9.eE+~-]+) sec"
+)
+
+# every record shape jax emits under jax_log_compiles (tracing,
+# jaxpr→MLIR, XLA compilation, pxla's "Compiling <fn> with global
+# shapes") — consumed by us, muted on jax's own handlers while the
+# monitor is attached
+_VERBOSE_RE = re.compile(
+    r"^(Finished (tracing|jaxpr|XLA compilation)|Compiling )"
+)
+
+
+class _MuteCompileRecords(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        return _VERBOSE_RE.search(record.getMessage()) is None
+
+
+class RecompileMonitor(logging.Handler):
+    """Attachable counter of per-program XLA compilations.
+
+    Usage::
+
+        mon = RecompileMonitor()
+        with mon:                      # or mon.start() / mon.stop()
+            warmup()
+            mon.mark_steady()
+            train()                    # retraces here are unexpected
+        mon.unexpected_retraces()      # {program_name: count}
+    """
+
+    def __init__(self, bus=None):
+        super().__init__(level=logging.DEBUG)
+        self._bus = bus
+        self._lock2 = threading.Lock()  # logging.Handler owns self.lock
+        self.compiles: dict = {}
+        self.unexpected: dict = {}
+        self._steady = False
+        self._active = False
+        self._saved: Optional[tuple] = None
+        self._mute: Optional[logging.Filter] = None
+        self._muted_handlers: list = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._active:
+            return
+        jax_logger = logging.getLogger("jax")
+        self._saved = (jax.config.jax_log_compiles, jax_logger.level)
+        jax.config.update("jax_log_compiles", True)
+        if jax_logger.getEffectiveLevel() > logging.WARNING:
+            # the compile records are WARNING-level (that's how
+            # jax_log_compiles surfaces them); make sure they reach us
+            jax_logger.setLevel(logging.WARNING)
+        # mute the records we consume on jax's own handlers (jax installs
+        # a StreamHandler directly on "jax", so propagation flags cannot
+        # silence it); other jax warnings keep printing
+        self._mute = _MuteCompileRecords()
+        self._muted_handlers = list(jax_logger.handlers)
+        for h in self._muted_handlers:
+            h.addFilter(self._mute)
+        jax_logger.addHandler(self)
+        self._active = True
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        jax_logger = logging.getLogger("jax")
+        jax_logger.removeHandler(self)
+        for h in self._muted_handlers:
+            h.removeFilter(self._mute)
+        self._muted_handlers = []
+        log_compiles, level = self._saved
+        jax.config.update("jax_log_compiles", log_compiles)
+        jax_logger.setLevel(level)
+        self._active = False
+
+    def __enter__(self) -> "RecompileMonitor":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- accounting --------------------------------------------------------
+
+    def mark_steady(self) -> None:
+        """Declare warmup over: every compilation from here on is an
+        unexpected retrace. Idempotent."""
+        with self._lock2:
+            self._steady = True
+
+    def emit(self, record: logging.LogRecord) -> None:  # logging.Handler
+        m = _COMPILE_RE.search(record.getMessage())
+        if m is None:
+            return
+        name = m.group(1)
+        try:
+            elapsed_s = float(m.group(2))
+        except ValueError:
+            elapsed_s = None
+        with self._lock2:
+            self.compiles[name] = self.compiles.get(name, 0) + 1
+            count = self.compiles[name]
+            unexpected = self._steady
+            if unexpected:
+                self.unexpected[name] = self.unexpected.get(name, 0) + 1
+        if self._bus is not None:
+            self._bus.emit(
+                "recompile",
+                program=name,
+                count=count,
+                unexpected=unexpected,
+                elapsed_s=elapsed_s,
+            )
+
+    def total_compiles(self) -> dict:
+        with self._lock2:
+            return dict(self.compiles)
+
+    def unexpected_retraces(self) -> dict:
+        """Per-program compilations observed AFTER :meth:`mark_steady` —
+        each one is a silent perf killer worth attributing."""
+        with self._lock2:
+            return dict(self.unexpected)

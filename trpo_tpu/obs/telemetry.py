@@ -1,0 +1,152 @@
+"""``Telemetry`` — the one object a driver threads through a run.
+
+Bundles the event bus (sinks from CLI flags), the health monitor, the
+recompile monitor, and the iteration-windowed ``jax.profiler`` capture, so
+``agent.learn`` takes ONE optional argument instead of four and the CLI
+wiring lives in one place:
+
+* ``--metrics-jsonl PATH``  → JSONL sink on the bus (manifest + iteration
+  + phase + health + recompile records, ``scripts/validate_events.py``
+  schema);
+* ``--health-checks``       → health monitor + console sink for
+  health/recompile findings;
+* ``--profile-dir D --profile-iteration N`` → a ``jax.profiler`` trace
+  window around iteration N only (PhaseTimer names annotate the
+  timeline), instead of tracing the entire run.
+
+Lifecycle (driven by ``agent.learn``): ``start_run(cfg, ...)`` emits the
+run manifest and attaches the recompile monitor; ``mark_steady()`` after
+warmup flips further compilations to "unexpected"; ``on_iteration`` runs
+the health rules on each drained stats row (thread-safe — the async
+driver calls it from the drain thread); ``finish_run(timer)`` closes the
+profile window, emits PhaseTimer summaries as ``phase`` events, and
+detaches the recompile monitor. The creator (CLI, test) calls ``close()``
+to flush/close the sinks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from trpo_tpu.obs.events import ConsoleSink, EventBus, JsonlSink, manifest_fields
+from trpo_tpu.obs.health import HealthConfig, HealthMonitor
+from trpo_tpu.obs.recompile import RecompileMonitor
+
+__all__ = ["Telemetry"]
+
+
+class Telemetry:
+    def __init__(
+        self,
+        events_jsonl: Optional[str] = None,
+        health_checks: bool = False,
+        recompile_monitor: bool = True,
+        profile_dir: Optional[str] = None,
+        profile_iteration: Optional[int] = None,
+        health_config: Optional[HealthConfig] = None,
+        sinks=(),
+    ):
+        bus_sinks = list(sinks)
+        if events_jsonl:
+            bus_sinks.append(JsonlSink(events_jsonl))
+        if health_checks:
+            # findings must be visible even without a JSONL file
+            bus_sinks.append(ConsoleSink(kinds=("health", "recompile")))
+        self.bus = EventBus(*bus_sinks)
+        self.health = (
+            HealthMonitor(bus=self.bus, config=health_config)
+            if health_checks
+            else None
+        )
+        self.recompile = (
+            RecompileMonitor(bus=self.bus) if recompile_monitor else None
+        )
+        self.profile_dir = profile_dir
+        self.profile_iteration = profile_iteration
+        self._profiling = False
+        self._profiled = False
+        self._closed = False
+
+    # -- run lifecycle -----------------------------------------------------
+
+    def start_run(self, config: Any = None, **extra) -> None:
+        self.bus.emit("run_manifest", **manifest_fields(config, extra))
+        if self.recompile is not None:
+            self.recompile.start()
+
+    def mark_steady(self) -> None:
+        if self.recompile is not None:
+            self.recompile.mark_steady()
+
+    def on_iteration(self, iteration: int, stats: dict) -> None:
+        """Health rules on one drained stats row. Iteration EVENTS are
+        emitted by ``StatsLogger`` (which re-logs through the bus), so
+        this hook never double-emits them."""
+        if self.health is not None:
+            self.health.observe_iteration(iteration, stats)
+
+    def observe_drain(self, depth: int, high_water: int,
+                      maxsize: int) -> None:
+        if self.health is not None:
+            self.health.observe_drain(depth, high_water, maxsize)
+
+    # -- iteration-windowed profiler capture -------------------------------
+
+    def profile_tick(self, next_iteration: int, span: int = 1) -> None:
+        """Called at the top of each iteration/chunk with the ABSOLUTE
+        1-based iteration number about to run and the number of
+        iterations the upcoming program covers (``fuse_iterations``
+        chunks): opens the ``jax.profiler`` trace when the chunk CONTAINS
+        the requested iteration, closes it once the window has passed.
+        A target already behind the run (a resume past N) still captures
+        the first chunk rather than nothing."""
+        if self.profile_dir is None or self.profile_iteration is None:
+            return
+        import jax
+
+        if (
+            not self._profiling
+            and not self._profiled
+            and next_iteration + span > self.profile_iteration
+        ):
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+        elif self._profiling and next_iteration > self.profile_iteration:
+            jax.profiler.stop_trace()
+            self._profiling = False
+            self._profiled = True
+
+    def _stop_profile(self) -> None:
+        if self._profiling:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._profiling = False
+            self._profiled = True
+
+    # -- teardown ----------------------------------------------------------
+
+    def finish_run(self, timer=None) -> None:
+        """End-of-``learn`` hook: close an open profile window, emit the
+        PhaseTimer's per-phase summaries as ``phase`` events, and detach
+        the recompile monitor (post-run compiles — greedy eval, user code
+        — are not retraces). Safe to call more than once."""
+        self._stop_profile()
+        if timer is not None:
+            for name, row in timer.summary().items():
+                self.bus.emit(
+                    "phase",
+                    name=name,
+                    ms=row["mean_ms"],
+                    calls=row["calls"],
+                    total_s=row["total_s"],
+                )
+        if self.recompile is not None:
+            self.recompile.stop()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.finish_run()
+        self.bus.close()

@@ -47,6 +47,10 @@ class LinesearchResult(NamedTuple):
     loss: jax.Array           # loss at the returned params
     aux: Any = None           # loss_fn's aux at the returned params
     #                           (has_aux=True only, else None)
+    trials: Any = 0           # int32: trial evaluations actually executed
+    #                           (1 = accepted first try; max_backtracks =
+    #                           exhausted) — the device-side observability
+    #                           counter behind stats.linesearch_trials
 
 
 def backtracking_linesearch(
@@ -146,4 +150,7 @@ def backtracking_linesearch(
         step_fraction=jnp.where(accepted, frac, 0.0),
         loss=jnp.where(accepted, fcand, fval),
         aux=aux_out,
+        # the loop counter at exit IS the number of trials evaluated —
+        # free observability, no extra computation
+        trials=final[0],
     )

@@ -206,12 +206,17 @@ def _make_shard_map_fvp(
         flat0 = jnp.asarray(flat0, jnp.float32)
 
         def local_fvp(flat0_rep, local_batch: TRPOBatch, v_rep):
-            flat_loc = _pcast_varying(flat0_rep, axis)
-            v_loc = _pcast_varying(v_rep, axis)
-            hv = local_body(flat_loc, unravel, local_batch, v_loc)
-            num = jax.lax.psum(hv, axis)
-            den = jax.lax.psum(jnp.sum(local_batch.weight), axis)
-            return num / jnp.maximum(den, 1.0) + cfg.cg_damping * v_rep
+            # named scopes mark the compute/collective split in HLO
+            # metadata, so a TPU profile attributes shard-local matvec
+            # time separately from the ICI psum combine
+            with jax.named_scope("sharded_fvp/local_matvec"):
+                flat_loc = _pcast_varying(flat0_rep, axis)
+                v_loc = _pcast_varying(v_rep, axis)
+                hv = local_body(flat_loc, unravel, local_batch, v_loc)
+            with jax.named_scope("sharded_fvp/psum_combine"):
+                num = jax.lax.psum(hv, axis)
+                den = jax.lax.psum(jnp.sum(local_batch.weight), axis)
+                return num / jnp.maximum(den, 1.0) + cfg.cg_damping * v_rep
 
         spec_batch = _batch_spec(batch, axis)
         shard_fvp = _shard_map_compat(

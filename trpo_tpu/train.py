@@ -210,7 +210,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--profile-dir",
         help="write a jax.profiler (TensorBoard/Perfetto) trace of the run "
-        "here; phase names from PhaseTimer annotate the timeline",
+        "here; phase names from PhaseTimer annotate the timeline. Alone "
+        "it traces the WHOLE run; with --profile-iteration N it captures "
+        "a window around iteration N only",
+    )
+    p.add_argument(
+        "--profile-iteration",
+        type=_positive_int,
+        metavar="N",
+        help="with --profile-dir: capture the profiler trace around "
+        "absolute iteration N only (counts across --resume; with "
+        "--fuse-iterations the window covers N's whole fused chunk) "
+        "instead of the whole run — full-run traces of long jobs are "
+        "unloadably large",
+    )
+    p.add_argument(
+        "--metrics-jsonl",
+        help="append typed run events here (trpo_tpu.obs.events schema, "
+        "validated by scripts/validate_events.py): run manifest, "
+        "per-iteration stats incl. device-accumulated solver counters, "
+        "phase timings, health findings, recompile records",
+    )
+    p.add_argument(
+        "--health-checks",
+        action="store_true",
+        help="watch the run for NaN/nonfinite trips, KL-rollback streaks, "
+        "explained-variance collapse and stats-drain backpressure "
+        "(trpo_tpu.obs.health); findings print to stderr and go to "
+        "--metrics-jsonl when set",
     )
     p.add_argument(
         "--evaluate",
@@ -353,24 +380,49 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             agent.restore_host_env(checkpointer.restore_host_env())
             print(f"resumed from step {checkpointer.latest_step()}")
 
-    logger = StatsLogger(jsonl_path=cfg.log_jsonl)
+    if args.profile_iteration and not args.profile_dir:
+        raise SystemExit("--profile-iteration requires --profile-dir")
+
+    telemetry = None
+    if args.metrics_jsonl or args.health_checks or args.profile_iteration:
+        from trpo_tpu.obs import Telemetry
+
+        telemetry = Telemetry(
+            events_jsonl=args.metrics_jsonl,
+            health_checks=args.health_checks,
+            recompile_monitor=True,
+            profile_dir=args.profile_dir if args.profile_iteration else None,
+            profile_iteration=args.profile_iteration,
+        )
+
+    logger = StatsLogger(
+        jsonl_path=cfg.log_jsonl,
+        bus=telemetry.bus if telemetry is not None else None,
+    )
 
     import contextlib
 
     import jax
 
+    # whole-run trace only WITHOUT a window request — the windowed capture
+    # (telemetry.profile_tick) opens/closes the trace around iteration N
     profile_ctx = (
         jax.profiler.trace(args.profile_dir)
-        if args.profile_dir
+        if args.profile_dir and not args.profile_iteration
         else contextlib.nullcontext()
     )
-    with profile_ctx:
-        final = agent.learn(
-            state=state,
-            logger=logger,
-            checkpointer=checkpointer,
-            use_jax_profiler=bool(args.profile_dir),
-        )
+    try:
+        with profile_ctx:
+            final = agent.learn(
+                state=state,
+                logger=logger,
+                checkpointer=checkpointer,
+                use_jax_profiler=bool(args.profile_dir),
+                telemetry=telemetry,
+            )
+    finally:
+        if telemetry is not None:
+            telemetry.close()
     print(
         f"done: {int(final.iteration)} iterations, "
         f"{int(final.total_timesteps)} timesteps, "

@@ -109,6 +109,12 @@ class TRPOStats(NamedTuple):
     #   update when the amortized head-block preconditioner is active
     #   (a ``precond`` state was passed in), else None. The agent moves
     #   it into TrainState and strips it from the logged stats.
+    linesearch_trials: Any = 0  # int32: backtracking trials evaluated
+    #   (LinesearchResult.trials) — feeds the device-accumulated
+    #   linesearch_trials_total counter (obs/device_metrics.py)
+    nan_guard: Any = False   # bool: nonfinite gradient/surrogate/entropy
+    #   detected this update — computed from scalars already paid for,
+    #   so watching for divergence costs nothing
 
 
 def _wmean(x: jax.Array, w: jax.Array) -> jax.Array:
@@ -334,11 +340,15 @@ def _natural_gradient_update(
     fb = _fvp_batch(batch, cfg.fvp_subsample)
 
     # one traced pass: surrogate value (the surrogate_before stat, and the
-    # line search's f0), the current dist (dist0), and the gradient
-    (surr_before, dist0), g = jax.value_and_grad(
-        surr_with_dist, has_aux=True
-    )(x0)
-    dist0 = jax.lax.stop_gradient(dist0)
+    # line search's f0), the current dist (dist0), and the gradient.
+    # named_scopes throughout this body label the phases in HLO metadata,
+    # so a --profile-dir trace attributes device time to grad / solve /
+    # linesearch / stats without guessing from fusion names.
+    with jax.named_scope("trpo/grad_and_surrogate"):
+        (surr_before, dist0), g = jax.value_and_grad(
+            surr_with_dist, has_aux=True
+        )(x0)
+        dist0 = jax.lax.stop_gradient(dist0)
     grad_norm = tree_norm(g)
     neg_g = tree_scale(-1.0, g)
 
@@ -468,22 +478,23 @@ def _natural_gradient_update(
             key=jax.random.key(0),
             floor=damping,
         )
-    cg = conjugate_gradient(
-        fvp,
-        neg_g,
-        cg_iters=cfg.cg_iters,
-        residual_tol=cfg.cg_residual_tol,
-        M_inv=M_inv,
-        residual_rtol=cfg.cg_residual_rtol,
-    )
-    stepdir = cg.x
+    with jax.named_scope("trpo/cg_solve"):
+        cg = conjugate_gradient(
+            fvp,
+            neg_g,
+            cg_iters=cfg.cg_iters,
+            residual_tol=cfg.cg_residual_tol,
+            M_inv=M_inv,
+            residual_rtol=cfg.cg_residual_rtol,
+        )
+        stepdir = cg.x
 
-    # Step scaling to the KL radius (ref trpo_inksci.py:148-150).
-    shs = 0.5 * tree_vdot(stepdir, fvp(stepdir))
-    shs = jnp.maximum(shs, 1e-12)  # guard degenerate/zero-gradient solves
-    lm = jnp.sqrt(shs / cfg.max_kl)
-    fullstep = tree_scale(1.0 / lm, stepdir)
-    expected_improve_rate = tree_vdot(neg_g, stepdir) / lm
+        # Step scaling to the KL radius (ref trpo_inksci.py:148-150).
+        shs = 0.5 * tree_vdot(stepdir, fvp(stepdir))
+        shs = jnp.maximum(shs, 1e-12)  # guard degenerate/zero-grad solves
+        lm = jnp.sqrt(shs / cfg.max_kl)
+        fullstep = tree_scale(1.0 / lm, stepdir)
+        expected_improve_rate = tree_vdot(neg_g, stepdir) / lm
 
     ls_constraint = None
     if cfg.linesearch_kl_cap:
@@ -497,47 +508,62 @@ def _natural_gradient_update(
             _wmean(policy.dist.kl(batch.old_dist, dist), batch.weight)
             <= kl_cap
         )
-    ls = backtracking_linesearch(
-        surr_with_dist,
-        x0,
-        fullstep,
-        expected_improve_rate,
-        max_backtracks=cfg.linesearch_backtracks,
-        accept_ratio=cfg.linesearch_accept_ratio,
-        constraint_fn=ls_constraint,
-        has_aux=True,
-        f0=surr_before,   # the search's loss-at-x is the stat above
-        aux0=dist0,
-    )
+    with jax.named_scope("trpo/linesearch"):
+        ls = backtracking_linesearch(
+            surr_with_dist,
+            x0,
+            fullstep,
+            expected_improve_rate,
+            max_backtracks=cfg.linesearch_backtracks,
+            accept_ratio=cfg.linesearch_accept_ratio,
+            constraint_fn=ls_constraint,
+            has_aux=True,
+            f0=surr_before,   # the search's loss-at-x is the stat above
+            aux0=dist0,
+        )
     dist_ls = ls.aux  # dist at ls.x (== dist0 when nothing was accepted)
 
-    # KL rollback (ref trpo_inksci.py:157-158) — evaluated on the
-    # accepted trial's SHARED forward instead of a fresh one.
-    kl_after = _wmean(policy.dist.kl(batch.old_dist, dist_ls), batch.weight)
-    rollback = kl_after > cfg.kl_rollback_factor * cfg.max_kl
-    x_new = tree_where(rollback, x0, ls.x)
+    with jax.named_scope("trpo/kl_rollback_and_stats"):
+        # KL rollback (ref trpo_inksci.py:157-158) — evaluated on the
+        # accepted trial's SHARED forward instead of a fresh one.
+        kl_after = _wmean(
+            policy.dist.kl(batch.old_dist, dist_ls), batch.weight
+        )
+        rollback = kl_after > cfg.kl_rollback_factor * cfg.max_kl
+        x_new = tree_where(rollback, x0, ls.x)
 
-    new_params = to_params(x_new)
-    # All post-update stats from the dist at the final params — selected
-    # from forwards already paid for (dist0 / the accepted trial), where
-    # the reference re-runs the graph per fetched loss
-    # (trpo_inksci.py:156) and the pre-fusion program ran one more full
-    # forward here.
-    final_dist = tree_where(rollback, dist0, dist_ls)
-    logp_new = policy.dist.logp(final_dist, batch.actions)
-    surr_after = -_wmean(
-        jnp.exp(logp_new - logp_old) * batch.advantages, batch.weight
-    )
-    damping_next = (
-        _next_damping(cfg, damping, ls.success, rollback)
-        if cfg.adaptive_damping
-        else damping
-    )
+        new_params = to_params(x_new)
+        # All post-update stats from the dist at the final params —
+        # selected from forwards already paid for (dist0 / the accepted
+        # trial), where the reference re-runs the graph per fetched loss
+        # (trpo_inksci.py:156) and the pre-fusion program ran one more
+        # full forward here.
+        final_dist = tree_where(rollback, dist0, dist_ls)
+        logp_new = policy.dist.logp(final_dist, batch.actions)
+        surr_after = -_wmean(
+            jnp.exp(logp_new - logp_old) * batch.advantages, batch.weight
+        )
+        damping_next = (
+            _next_damping(cfg, damping, ls.success, rollback)
+            if cfg.adaptive_damping
+            else damping
+        )
+        entropy = _wmean(policy.dist.entropy(final_dist), batch.weight)
+        # nonfinite guard: the scalars every divergence flows through are
+        # already computed — flagging them here lets the health monitor
+        # (obs/health.py) see the trip a full drain-latency earlier than
+        # the host-side NaN-entropy abort, and the device counter
+        # (obs/device_metrics.py) count trips with no extra transfers
+        nan_guard = jnp.logical_not(
+            jnp.isfinite(grad_norm)
+            & jnp.isfinite(surr_after)
+            & jnp.isfinite(entropy)
+        )
     stats = TRPOStats(
         surrogate_before=surr_before,
         surrogate_after=surr_after,
         kl=_wmean(policy.dist.kl(batch.old_dist, final_dist), batch.weight),
-        entropy=_wmean(policy.dist.entropy(final_dist), batch.weight),
+        entropy=entropy,
         grad_norm=grad_norm,
         step_norm=tree_norm(tree_sub(x_new, x0)),
         cg_iterations=cg.iterations,
@@ -548,6 +574,8 @@ def _natural_gradient_update(
         damping=damping,
         damping_next=damping_next,
         precond_next=precond_next,
+        linesearch_trials=ls.trials,
+        nan_guard=nan_guard,
     )
     return new_params, stats
 

@@ -18,6 +18,17 @@ never has holes. Consumer exceptions (e.g. the NaN-entropy abort) are
 captured and re-raised on the main thread at the next ``raise_if_failed``
 / ``drain`` / ``close`` call, preserving the exception type the serial
 driver would have raised.
+
+Boundedness (PR 3, closing the PR-1 review's open item): ``maxsize``
+bounds the queue. On a link where the per-item stats fetch exceeds the
+iteration time, an unbounded queue let stop conditions lag arbitrarily
+and undrained device buffers pile up; with a bound, ``submit`` BLOCKS
+once ``maxsize`` items are in flight — natural backpressure that caps the
+stop-condition lag at the bound (the agent passes
+``cfg.stats_drain_maxsize``, default 2 — the documented ≤2-iteration
+overshoot) while costing nothing when the drain keeps up. ``depth`` and
+``high_water`` are observable gauges; the health monitor
+(``trpo_tpu.obs.health``) warns when the bound is hit.
 """
 
 from __future__ import annotations
@@ -40,8 +51,13 @@ class StatsDrain:
     device→host transfer already done; return a truthy value to request a
     stop (the main loop polls :attr:`stop_requested`). After an error the
     drain stops consuming (remaining items are discarded so ``drain``
-    cannot deadlock) and the first exception is re-raised on the main
-    thread.
+    cannot deadlock — and so a bounded ``submit`` can never block forever
+    behind a dead consumer) and the first exception is re-raised on the
+    main thread.
+
+    ``maxsize > 0`` bounds the queue: ``submit`` blocks while ``maxsize``
+    items are pending (see module docstring). 0 = unbounded (the PR-1
+    behavior, kept for direct users of this class).
     """
 
     def __init__(
@@ -49,11 +65,21 @@ class StatsDrain:
         consume: Callable[[Any, Any], Any],
         timer=None,
         span_name: str = "stats_drain",
+        maxsize: int = 0,
+        span_context: tuple = (),
     ):
         self._consume = consume
         self._timer = timer
         self._span_name = span_name
-        self._q: queue.Queue = queue.Queue()
+        # ONE fixed context for every drain span (a PhaseTimer
+        # current_context() capture): per-submit capture would split the
+        # stage's timing across summary keys depending on which call site
+        # happened to submit (inside vs outside the rollout phase)
+        self._span_context = tuple(span_context)
+        self.maxsize = maxsize
+        self._q: queue.Queue = queue.Queue(maxsize)
+        self._gauge_lock = threading.Lock()
+        self._high_water = 0
         self._error: Optional[BaseException] = None
         self._stop = threading.Event()
         self._closed = False
@@ -65,11 +91,27 @@ class StatsDrain:
     # -- main-thread surface ----------------------------------------------
 
     def submit(self, tag, device_stats) -> None:
-        """Enqueue one iteration's (still-pending) stats pytree.
-        Non-blocking; the drain thread does the device_get."""
+        """Enqueue one iteration's (still-pending) stats pytree; the drain
+        thread does the device_get. Non-blocking while the queue is below
+        ``maxsize``; at the bound it blocks until the drain catches up
+        (backpressure — the documented stop-condition lag cap)."""
         if self._closed:
             raise RuntimeError("StatsDrain is closed")
         self._q.put((tag, device_stats))
+        with self._gauge_lock:
+            self._high_water = max(self._high_water, self._q.qsize())
+
+    @property
+    def depth(self) -> int:
+        """Items currently pending (approximate, by nature of a live
+        queue) — a host-side gauge, no device sync."""
+        return self._q.qsize()
+
+    @property
+    def high_water(self) -> int:
+        """Deepest the queue has been at any submit."""
+        with self._gauge_lock:
+            return self._high_water
 
     @property
     def stop_requested(self) -> bool:
@@ -107,7 +149,9 @@ class StatsDrain:
                     continue  # post-error: discard, but keep join() live
                 tag, stats = item
                 span = (
-                    self._timer.span(self._span_name)
+                    self._timer.span(
+                        self._span_name, context=self._span_context
+                    )
                     if self._timer is not None
                     else None
                 )

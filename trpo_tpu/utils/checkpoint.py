@@ -119,16 +119,17 @@ class Checkpointer:
                 restored_tree,
             )
 
-        # Two TrainState fields exist only under a config flag, so
-        # flipping the flag between save and restore changes the pytree
-        # structure: cg_damping (f32 scalar iff cfg.adaptive_damping) and
-        # precond (ops/precond.PrecondState iff the amortized head-block
-        # preconditioner is on — default for the MuJoCo presets since
-        # round 6, so pre-r06 checkpoints lack it). Tolerate every
-        # presence combination: a dropped field's saved value is
-        # discarded, a gained field is seeded from the template below
-        # (the precond factors are safely reconstructible — age 0
-        # refreshes on the first update).
+        # Three TrainState fields can differ in presence between save and
+        # restore, changing the pytree structure: cg_damping (f32 scalar
+        # iff cfg.adaptive_damping), precond (ops/precond.PrecondState iff
+        # the amortized head-block preconditioner is on — default for the
+        # MuJoCo presets since round 6, so pre-r06 checkpoints lack it),
+        # and metrics (obs/device_metrics.DeviceMetrics — added in round
+        # 7, so pre-r07 checkpoints lack it). Tolerate every presence
+        # combination: a dropped field's saved value is discarded, a
+        # gained field is seeded from the template below (precond factors
+        # and observability counters are both safely reconstructible —
+        # age 0 refreshes on the first update, counters restart at 0).
         flippable = hasattr(template, "_replace") and hasattr(
             template, "cg_damping"
         )
@@ -161,6 +162,13 @@ class Checkpointer:
                 )
             )
 
+        def metrics_alt(t):
+            """Template with the metrics subtree absent (pre-round-7
+            checkpoints), or None when it already is."""
+            if getattr(t, "metrics", None) is None:
+                return None
+            return t._replace(metrics=None)
+
         abstract = jax.tree_util.tree_map(as_abstract, template)
         try:
             restored = rewrap_keys(
@@ -177,6 +185,12 @@ class Checkpointer:
             if p_alt is not None:
                 candidates.append(p_alt)
                 candidates.append(damping_alt(p_alt))
+            # every combination may additionally need the metrics subtree
+            # stripped (checkpoint predates TrainState.metrics)
+            for alt in [template] + list(candidates):
+                m_alt = metrics_alt(alt)
+                if m_alt is not None:
+                    candidates.append(m_alt)
             restored = None
             for alt in candidates:
                 abstract_alt = jax.tree_util.tree_map(as_abstract, alt)
@@ -246,6 +260,25 @@ class Checkpointer:
                 # preconditioner turned off since the save: drop the
                 # stored factors (pure cache — nothing is lost)
                 restored = restored._replace(precond=None)
+        if (
+            flippable
+            and getattr(template, "metrics", None) is not None
+            and getattr(restored, "metrics", None) is None
+        ):
+            # checkpoint predates the device metric counters: restart
+            # them at zero (observability-only state — nothing numeric
+            # depends on it). Abstract templates materialize the zeros.
+            seed = template.metrics
+            if any(
+                not hasattr(leaf, "__array__")
+                for leaf in jax.tree_util.tree_leaves(seed)
+            ):
+                import jax.numpy as jnp
+
+                seed = jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), seed
+                )
+            restored = restored._replace(metrics=seed)
         return restored
 
     # -- host-env sidecar --------------------------------------------------

@@ -8,18 +8,58 @@ module keeps those seven stats (parity), adds the SURVEY §5 obligations
 (CG-solve timing as a first-class stat, JSONL structured output), and
 implements ``explained_variance`` (ref ``utils.py:208-211``) as a
 jit-friendly function.
+
+Since PR 3 the JSONL stream is crash-safe (a killed run's truncated final
+line is repaired on the next append — :func:`repair_jsonl_tail`, shared
+with the event bus's JSONL sink) and every logged row can re-emit through
+the run-event bus (``trpo_tpu.obs.events``) as an ``iteration`` event, so
+the per-iteration log and the telemetry stream carry ONE schema.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 from typing import IO, Optional
 
 import jax.numpy as jnp
 
-__all__ = ["explained_variance", "StatsLogger"]
+__all__ = ["explained_variance", "StatsLogger", "repair_jsonl_tail"]
+
+
+def repair_jsonl_tail(path: str) -> int:
+    """Truncate a partial (crash-cut) final line so the file ends at a
+    record boundary; returns the number of bytes removed (0 when the file
+    is absent, empty, or already ends in a newline). Append-mode writers
+    call this before opening — a record is then either fully present or
+    absent, never half a line that corrupts the next append's first row."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return 0
+    if size == 0:
+        return 0
+    with open(path, "rb+") as f:
+        f.seek(size - 1)
+        if f.read(1) == b"\n":
+            return 0
+        # scan BACKWARD in windows for the last record boundary — a
+        # single fixed window would truncate the whole file when the
+        # partial tail alone exceeds it
+        pos, window = size, 1 << 20
+        keep = 0  # no newline anywhere: the file IS one partial line
+        while pos > 0:
+            start = max(0, pos - window)
+            f.seek(start)
+            nl = f.read(pos - start).rfind(b"\n")
+            if nl >= 0:
+                keep = start + nl + 1
+                break
+            pos = start
+        f.truncate(keep)
+        return size - keep
 
 
 def explained_variance(ypred, y, weight=None):
@@ -49,21 +89,30 @@ class StatsLogger:
     Console format mirrors the reference's padded two-column print
     (``trpo_inksci.py:168-171``); every ``log`` call also appends one JSON
     object per iteration to ``jsonl_path`` when configured (SURVEY §5
-    "structured metrics to stdout + JSONL").
+    "structured metrics to stdout + JSONL") — written as ONE ``write``
+    call then flushed, after repairing any crash-truncated tail at open.
+
+    ``bus`` (a ``trpo_tpu.obs.events.EventBus``, optional — also
+    assignable after construction, which is how ``agent.learn`` attaches a
+    Telemetry's bus to a caller-provided logger) re-emits each row as an
+    ``iteration`` event, so training logs and telemetry share one schema.
     """
 
     def __init__(
         self,
         jsonl_path: Optional[str] = None,
         stream: Optional[IO] = None,
+        bus=None,
     ):
         # None → resolve sys.stdout at each log() call, not here: binding
         # the stream at construction breaks when stdout is swapped later
         # (pytest capture, CLI redirection).
         self.stream = stream
-        self._jsonl: Optional[IO] = (
-            open(jsonl_path, "a") if jsonl_path else None
-        )
+        self.bus = bus
+        self._jsonl: Optional[IO] = None
+        if jsonl_path:
+            repair_jsonl_tail(jsonl_path)
+            self._jsonl = open(jsonl_path, "a")
         self.start_time = time.time()
 
     def log(self, iteration: int, stats: dict):
@@ -82,6 +131,12 @@ class StatsLogger:
                 rec[k] = v
             self._jsonl.write(json.dumps(rec) + "\n")
             self._jsonl.flush()
+        if self.bus is not None:
+            # the bus sanitizes numpy/jax scalars itself; one schema for
+            # the training log and every other telemetry consumer
+            self.bus.emit(
+                "iteration", iteration=int(iteration), stats=dict(stats)
+            )
 
     def elapsed_minutes(self) -> float:
         """"Time elapsed" stat, in minutes like the reference
@@ -89,6 +144,9 @@ class StatsLogger:
         return (time.time() - self.start_time) / 60.0
 
     def close(self):
+        """Flush and close the JSONL stream. Idempotent; both drivers
+        (and the CLI) call it explicitly, so the final record is always
+        fully on disk even when the process exits right after."""
         if self._jsonl is not None:
             self._jsonl.close()
             self._jsonl = None

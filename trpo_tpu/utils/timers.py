@@ -5,12 +5,17 @@ The reference records only total wall-clock ("Time elapsed",
 per-call timings around rollout / CG-solve / update, and can emit
 ``jax.profiler`` trace annotations so phases show up named in TPU profiles.
 
-The async host-env pipeline (``agent.TRPOAgent.learn`` with
-``cfg.host_async_pipeline``) times stages from more than one thread — the
-main loop's rollout/dispatch spans and the drain thread's stats fetches —
-so all accounting is lock-protected, and :meth:`span` offers an explicit
+Phases NEST (PR 3): each thread carries a stack of open phase names, and a
+phase entered inside another records under the slash-joined path
+("rollout/stats_drain"), so summaries attribute time hierarchically. The
+async host-env pipeline times stages from more than one thread — the main
+loop's rollout/dispatch spans and the drain thread's stats fetches — so
+all accounting is lock-protected, :meth:`span` offers an explicit
 begin/end handle for stages whose start and finish live in different
-scopes (a context manager cannot straddle a thread boundary).
+scopes, and :meth:`current_context` captures one thread's open-phase stack
+so a span created (or recorded) on ANOTHER thread still lands under the
+right parent — ``utils/async_pipe.StatsDrain`` takes such a capture as its
+fixed ``span_context`` so its drain-thread spans nest deterministically.
 """
 
 from __future__ import annotations
@@ -19,6 +24,7 @@ import contextlib
 import threading
 import time
 from collections import defaultdict
+from typing import Tuple
 
 import jax
 
@@ -53,6 +59,19 @@ class PhaseTimer:
         self.last = {}
         self.use_jax_profiler = use_jax_profiler
         self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def current_context(self) -> Tuple[str, ...]:
+        """THIS thread's open-phase path — pass it to :meth:`span` from
+        another thread so cross-thread stages nest under the phase that
+        launched them (the dispatch/drain split of ``utils/async_pipe``)."""
+        return tuple(self._stack())
 
     def record(self, name: str, seconds: float) -> None:
         """Fold one completed measurement in (thread-safe — the drain
@@ -63,28 +82,36 @@ class PhaseTimer:
             self.counts[name] += 1
             self.last[name] = seconds
 
-    def span(self, name: str) -> _Span:
+    def span(self, name: str, context: Tuple[str, ...] = ()) -> _Span:
         """Begin a pipeline-stage span; call ``.end()`` on the returned
         handle where the stage actually finishes — possibly on another
-        thread (the dispatch/drain split of ``utils/async_pipe.py``)."""
-        return _Span(self, name)
+        thread. ``context`` (a :meth:`current_context` capture) prefixes
+        the recorded name so the span nests under its launching phase."""
+        return _Span(self, "/".join(tuple(context) + (name,)))
 
     @contextlib.contextmanager
     def phase(self, name: str, block_on=None):
         """Time a phase. Pass ``block_on`` (any jax pytree) to block until
         its computation is done — without it, async dispatch makes device
-        phases look free."""
+        phases look free. Nested phases record under the joined path
+        ("outer/inner") per thread."""
+        stack = self._stack()
+        full = "/".join(stack + [name]) if stack else name
         ctx = (
-            jax.profiler.TraceAnnotation(name)
+            jax.profiler.TraceAnnotation(full)
             if self.use_jax_profiler
             else contextlib.nullcontext()
         )
+        stack.append(name)
         start = time.perf_counter()
-        with ctx:
-            yield
-            if block_on is not None:
-                jax.block_until_ready(block_on)
-        self.record(name, time.perf_counter() - start)
+        try:
+            with ctx:
+                yield
+                if block_on is not None:
+                    jax.block_until_ready(block_on)
+        finally:
+            stack.pop()
+            self.record(full, time.perf_counter() - start)
 
     def last_ms(self, name: str) -> float:
         with self._lock:
