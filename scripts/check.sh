@@ -20,6 +20,10 @@ echo "== tier-1: observability (event bus, device metrics, monitors) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py -q \
     -m 'not slow'
 
+echo "== tier-1: resilience chaos suite (fault injection, CPU backend) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+    -m 'not slow'
+
 echo "== event-stream smoke: train + bench emit schema-valid JSONL =="
 OBS_TMP=$(mktemp -d)
 JAX_PLATFORMS=cpu python -m trpo_tpu.train --preset cartpole \
@@ -31,6 +35,35 @@ BENCH_FORCE_CPU=1 BENCH_BATCH=256 BENCH_WIDTHS= BENCH_HOST_PIPELINE=0 \
     python bench.py > "$OBS_TMP/bench.json"
 python scripts/validate_events.py "$OBS_TMP/train_events.jsonl" \
     "$OBS_TMP/bench_events.jsonl"
+
+echo "== chaos smoke: worker-kill + NaN iteration + SIGTERM, then resume =="
+# one tiny gymproc cartpole run with an injected worker kill, a NaN-
+# poisoned iteration and a preemption SIGTERM: must exit with the requeue
+# code (75), leave a resumable checkpoint, and emit an event log in which
+# every injected fault has a matching detection/recovery record
+# (validate_events.py's ISSUE 4 contract)
+CHAOS_TMP=$(mktemp -d)
+set +e
+JAX_PLATFORMS=cpu python -m trpo_tpu.train --env "gymproc:CartPole-v1" \
+    --iterations 6 --batch-timesteps 32 --n-envs 2 --platform cpu \
+    --checkpoint-dir "$CHAOS_TMP/ck" --checkpoint-every 2 \
+    --recover-on-nan restore --env-step-timeout 30 \
+    --inject-faults \
+    "kill_worker@step=3:worker=0;nan_update@iter=2;sigterm@iter=4" \
+    --metrics-jsonl "$CHAOS_TMP/chaos_events.jsonl" --health-checks \
+    > /dev/null
+CHAOS_CODE=$?
+set -e
+if [[ "$CHAOS_CODE" != 75 ]]; then
+    echo "chaos smoke: expected requeue exit code 75, got $CHAOS_CODE"
+    exit 1
+fi
+JAX_PLATFORMS=cpu python -m trpo_tpu.train --env "gymproc:CartPole-v1" \
+    --iterations 2 --batch-timesteps 32 --n-envs 2 --platform cpu \
+    --checkpoint-dir "$CHAOS_TMP/ck" --resume \
+    --metrics-jsonl "$CHAOS_TMP/resume_events.jsonl" > /dev/null
+python scripts/validate_events.py "$CHAOS_TMP/chaos_events.jsonl" \
+    "$CHAOS_TMP/resume_events.jsonl"
 
 echo "== pytest (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q

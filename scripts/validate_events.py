@@ -5,10 +5,15 @@
 
 For each file: every line must parse as JSON and pass
 ``trpo_tpu.obs.events.validate_event``; the first record must be a
-``run_manifest`` (files are self-describing); and when per-iteration
+``run_manifest`` (files are self-describing); when per-iteration
 records are present, each must carry the device-accumulated solver
 counters (``cg_iters_total``, ``linesearch_trials_total``) — the ISSUE 3
-acceptance contract. Exits non-zero with per-line diagnostics on any
+acceptance contract; and every ``fault_injected`` record must be
+FOLLOWED by a matching detection/recovery record (the ISSUE 4 chaos
+contract: worker kill/hang → a ``worker_*`` health event, NaN poison →
+a ``recovery`` event or nan health finding, SIGTERM → a ``preempted``
+health event — an injected fault nothing reacted to means the
+detect→recover loop is broken). Exits non-zero with per-line diagnostics on any
 failure; prints a per-kind count summary on success. Used by
 ``scripts/check.sh`` against both a training run's ``--metrics-jsonl``
 output and ``bench.py``'s ``BENCH_EVENTS_JSONL`` output (one validator,
@@ -29,6 +34,26 @@ sys.path.insert(
 )
 
 _REQUIRED_ITERATION_COUNTERS = ("cg_iters_total", "linesearch_trials_total")
+
+
+def _fault_matcher(fault_kind: str):
+    """Predicate over later records that counts as the detection/recovery
+    response to one injected fault — or None when the fault is a pure
+    perturbation (``delay_step``) that nothing is required to react to."""
+    if fault_kind in ("kill_worker", "hang_worker"):
+        return lambda rec: rec.get("kind") == "health" and str(
+            rec.get("check", "")
+        ).startswith("worker")
+    if fault_kind == "nan_update":
+        return lambda rec: rec.get("kind") == "recovery" or (
+            rec.get("kind") == "health"
+            and rec.get("check") in ("nan_guard", "nan_entropy")
+        )
+    if fault_kind == "sigterm":
+        return lambda rec: (
+            rec.get("kind") == "health" and rec.get("check") == "preempted"
+        )
+    return None
 
 
 def validate_file(path: str) -> list:
@@ -71,6 +96,19 @@ def validate_file(path: str) -> list:
                     f"{path}:{n}: iteration event missing "
                     f"device-accumulated counter {key!r}"
                 )
+    # ISSUE 4 chaos contract: every injected fault must have produced a
+    # matching detection/recovery record later in the stream
+    for idx, (n, rec) in enumerate(records):
+        if rec.get("kind") != "fault_injected":
+            continue
+        matcher = _fault_matcher(rec.get("fault"))
+        if matcher is None:
+            continue
+        if not any(matcher(later) for _, later in records[idx + 1:]):
+            errs.append(
+                f"{path}:{n}: fault_injected ({rec.get('spec')}) has no "
+                "matching detection/recovery record after it"
+            )
     return errs
 
 

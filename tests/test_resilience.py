@@ -1,0 +1,858 @@
+"""Resilience subsystem (ISSUE 4): deterministic chaos suite.
+
+Every failure mode the subsystem claims to survive is INJECTED here and
+the recovery pinned: worker kill/hang → supervised restart → degraded
+in-process fallback; NaN-poisoned iteration → last-good restore →
+bit-exact continuation vs a clean run; SIGTERM mid-run → drained
+shutdown, final checkpoint, requeue exit code, lossless resume;
+``kill -9`` mid-save → the integrity gate never selects the torn step.
+Faults come from ``resilience/inject.py`` specs (each fires once), so
+the whole suite is reproducible — no sleeps-and-hope scheduling.
+"""
+
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.obs import EventBus
+from trpo_tpu.resilience import (
+    FaultInjector,
+    Preempted,
+    RecoveryPolicy,
+    TrainingDiverged,
+    parse_fault_specs,
+)
+
+
+def _recording_bus():
+    events = []
+    return EventBus(lambda rec: events.append(rec)), events
+
+
+def _tree_equal(a, b):
+    def raw(x):
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(
+            x.dtype, jax.dtypes.prng_key
+        ):
+            x = jax.random.key_data(x)
+        return np.asarray(x)
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for xa, xb in zip(la, lb):
+        np.testing.assert_array_equal(raw(xa), raw(xb))
+
+
+class _BusTelemetry:
+    """Minimal stand-in threading only a bus through learn()."""
+
+    profile_dir = None
+
+    def __init__(self, bus):
+        self.bus = bus
+
+    def start_run(self, *a, **k):
+        pass
+
+    def mark_steady(self):
+        pass
+
+    def on_iteration(self, i, stats):
+        pass
+
+    def observe_drain(self, *a):
+        pass
+
+    def profile_tick(self, *a, **k):
+        pass
+
+    def finish_run(self, timer=None):
+        pass
+
+
+def _row_recorder(logger):
+    rows = []
+    orig = logger.log
+    logger.log = lambda i, s: (rows.append((i, dict(s))), orig(i, s))[0]
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# fault-spec parsing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_fault_specs_roundtrip():
+    specs = parse_fault_specs(
+        "kill_worker@step=3:worker=1; hang_worker@step=5;"
+        "delay_step@step=2:seconds=0.5; nan_update@iter=4; sigterm@iter=9"
+    )
+    kinds = [s.kind for s in specs]
+    assert kinds == [
+        "kill_worker", "hang_worker", "delay_step", "nan_update", "sigterm"
+    ]
+    assert specs[0].worker == 1 and specs[0].at == 3
+    assert specs[2].seconds == 0.5
+    # str() round-trips through the parser
+    again = parse_fault_specs(";".join(str(s) for s in specs))
+    assert again == specs
+
+
+@pytest.mark.parametrize("bad", [
+    "explode@iter=1",          # unknown kind
+    "nan_update@step=1",       # wrong trigger key
+    "kill_worker@worker=0",    # missing trigger
+    "nan_update@iter=0",       # out of range
+    "kill_worker@step=2:pid=9",  # unknown key
+    "",                        # empty
+])
+def test_parse_fault_specs_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_fault_specs(bad)
+
+
+def test_config_validates_fault_spec_at_construction():
+    with pytest.raises(ValueError):
+        TRPOConfig(inject_faults="explode@iter=1")
+    with pytest.raises(ValueError):
+        TRPOConfig(recover_on_nan="maybe")
+    with pytest.raises(ValueError):
+        TRPOConfig(on_preempt="pray")
+
+
+def test_config_rejects_negative_timeout_and_backoff():
+    """A negative env_step_timeout would make every reply gather 'time
+    out' instantly and silently degrade the whole pool — reject it at
+    construction like the other resilience knobs. 0/None stay valid
+    (= wait forever)."""
+    with pytest.raises(ValueError):
+        TRPOConfig(env_step_timeout=-1.0)
+    with pytest.raises(ValueError):
+        TRPOConfig(worker_backoff=-0.5)
+    TRPOConfig(env_step_timeout=0.0)
+    TRPOConfig(env_step_timeout=None)
+
+
+# ---------------------------------------------------------------------------
+# worker death detection + supervision (needs gymnasium worker pools)
+# ---------------------------------------------------------------------------
+
+gym = pytest.importorskip("gymnasium")
+
+from trpo_tpu.envs.proc_env import ProcVecEnv, WorkerDiedError  # noqa: E402
+from trpo_tpu.resilience.supervisor import (  # noqa: E402
+    SupervisedEnv,
+    SupervisionConfig,
+)
+
+ENV = "CartPole-v1"
+
+
+def _actions(env, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, env.action_spec.n, size=env.n_envs)
+
+
+@pytest.mark.slow
+def test_killed_worker_raises_worker_died_not_hang():
+    """Satellite 1: a worker killed mid-episode must surface as a
+    WorkerDiedError naming the worker (not hang host_step forever)."""
+    env = ProcVecEnv(ENV, n_envs=2, seed=3, n_workers=2, step_timeout=30)
+    try:
+        env.host_step(_actions(env))
+        os.kill(env._procs[0].pid, signal.SIGKILL)
+        env._procs[0].join(timeout=10)
+        with pytest.raises(WorkerDiedError) as ei:
+            env.host_step(_actions(env, seed=1))
+        assert ei.value.workers == [0]
+        assert ei.value.last_action is not None
+        assert "worker" in str(ei.value).lower()
+    finally:
+        env.close()
+
+
+@pytest.mark.slow
+def test_hung_worker_times_out():
+    """SIGSTOP (alive but silent) trips the step_timeout path."""
+    env = ProcVecEnv(ENV, n_envs=2, seed=3, n_workers=2, step_timeout=1.0)
+    try:
+        env.host_step(_actions(env))
+        os.kill(env._procs[1].pid, signal.SIGSTOP)
+        with pytest.raises(WorkerDiedError) as ei:
+            env.host_step(_actions(env, seed=1))
+        assert ei.value.kind == "timeout"
+        assert 1 in ei.value.workers
+    finally:
+        env.close()
+
+
+@pytest.mark.slow
+def test_supervised_restart_continues_stepping():
+    """Supervision revives a killed worker and the step RETRIES: the
+    restarted slice restarts its episodes (running stats zeroed), the
+    surviving slice keeps stepping, and a worker_restart health event
+    lands on the bus."""
+    bus, events = _recording_bus()
+    raw = ProcVecEnv(ENV, n_envs=2, seed=3, n_workers=2, step_timeout=30)
+    env = SupervisedEnv(
+        raw, SupervisionConfig(max_worker_restarts=2, backoff_base=0.01),
+        bus=bus,
+    )
+    try:
+        for _ in range(3):
+            env.host_step(_actions(env))
+        os.kill(raw._procs[0].pid, signal.SIGKILL)
+        raw._procs[0].join(timeout=10)
+        out = env.host_step(_actions(env, seed=1))
+        assert out[0].shape == (2,) + raw.obs_shape
+        assert np.all(np.isfinite(out[0]))
+        # episode-restart semantics for the revived slice only
+        assert raw._running_lengths[0] <= 1
+        assert raw._running_lengths[1] >= 4
+        assert env.restarts == {0: 1}
+        checks = [e["check"] for e in events if e["kind"] == "health"]
+        assert "worker_restart" in checks
+        # and the pool keeps working afterwards
+        for _ in range(3):
+            env.host_step(_actions(env, seed=2))
+    finally:
+        env.close()
+
+
+@pytest.mark.slow
+def test_supervised_degrades_to_in_process_slice():
+    """Past max_worker_restarts the slice re-hosts IN-PROCESS: stepping
+    continues (correct data, no process parallelism), worker_degraded is
+    emitted, and snapshots still cover all envs."""
+    bus, events = _recording_bus()
+    raw = ProcVecEnv(ENV, n_envs=2, seed=3, n_workers=2, step_timeout=30)
+    env = SupervisedEnv(
+        raw, SupervisionConfig(max_worker_restarts=0, backoff_base=0.01),
+        bus=bus,
+    )
+    try:
+        env.host_step(_actions(env))
+        os.kill(raw._procs[1].pid, signal.SIGKILL)
+        raw._procs[1].join(timeout=10)
+        out = env.host_step(_actions(env, seed=1))
+        assert np.all(np.isfinite(out[0]))
+        assert env.degraded_workers == (1,)
+        assert raw.is_local_worker(1)
+        checks = [e["check"] for e in events if e["kind"] == "health"]
+        assert "worker_degraded" in checks
+        # full surface still works over the mixed proc/local pool
+        snap = env.env_state_snapshot()
+        assert len(snap["sims"]) == 2
+        env.reset_all(seed=11)
+        for _ in range(3):
+            env.host_step(_actions(env, seed=2))
+    finally:
+        env.close()
+
+
+def test_restart_budget_resets_after_heal_window():
+    """A revival that holds past heal_window is not a FAILED revival:
+    the worker's budget resets on its next death, so rare isolated
+    crashes over a long run never accumulate into degradation — only a
+    crash-looping worker (deaths inside the window) degrades."""
+
+    class _FakePool:
+        env_id = "fake"
+        n_workers = 2
+
+        def __init__(self):
+            self.restarted = []
+
+        def restart_worker(self, w, local=False):
+            self.restarted.append((w, local))
+
+    pool = _FakePool()
+    env = SupervisedEnv(
+        pool,
+        SupervisionConfig(
+            max_worker_restarts=1, backoff_base=0.0, heal_window=60.0
+        ),
+    )
+    err = WorkerDiedError(0, "fake")
+    env._revive(err)
+    assert env.restarts == {0: 1}
+    # death long after the revival: budget resets, restarts again
+    env._last_restart[0] -= 120.0
+    env._revive(err)
+    assert env.restarts == {0: 1}
+    assert pool.restarted == [(0, False), (0, False)]
+    assert env.degraded_workers == ()
+    # death INSIDE the window: the revival failed — budget burns
+    # through and the slice degrades
+    env._revive(err)
+    assert pool.restarted[-1] == (0, True)
+    assert env.degraded_workers == (0,)
+
+
+@pytest.mark.slow
+def test_injected_kill_through_agent_rollout():
+    """End-to-end: a kill_worker fault injected mid-rollout through the
+    agent's supervised env — training completes, the fault and the
+    restart both land on the bus."""
+    bus, events = _recording_bus()
+    cfg = TRPOConfig(
+        env="gymproc:" + ENV,
+        n_iterations=2,
+        batch_timesteps=32,
+        n_envs=2,
+        env_step_timeout=30,
+        worker_backoff=0.01,
+        inject_faults="kill_worker@step=5:worker=0",
+    )
+    agent = TRPOAgent(cfg.env, cfg)
+    try:
+        final = agent.learn(telemetry=_BusTelemetry(bus))
+        assert int(final.iteration) == 2
+        kinds = [(e["kind"], e.get("check") or e.get("fault"))
+                 for e in events]
+        assert ("fault_injected", "kill_worker") in kinds
+        assert ("health", "worker_restart") in kinds
+    finally:
+        agent.env.close()
+
+
+# ---------------------------------------------------------------------------
+# NaN recovery (device env — no gymnasium needed, but grouped here)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cfg(**kw):
+    base = dict(n_iterations=4, batch_timesteps=64, n_envs=4, seed=7)
+    base.update(kw)
+    return TRPOConfig(**base)
+
+
+def test_nan_recovery_bit_exact_continuation():
+    """The acceptance pin: a NaN-poisoned iteration is detected, the
+    last-good state restored, the batch skipped — and the continuation is
+    BIT-EXACT vs a run that was never faulted (device env: the retried
+    iteration re-runs the same program on the restored state)."""
+    from trpo_tpu.utils.metrics import StatsLogger
+
+    def run(fault):
+        cfg = _tiny_cfg(
+            recover_on_nan="restore",
+            inject_faults=fault,
+        ) if fault else _tiny_cfg()
+        agent = TRPOAgent("cartpole", cfg)
+        logger = StatsLogger()
+        rows = _row_recorder(logger)
+        final = agent.learn(logger=logger)
+        return final, rows
+
+    clean_final, clean_rows = run(None)
+    fault_final, fault_rows = run("nan_update@iter=2")
+
+    # the poisoned row is logged (iteration 2, NaN entropy), then 2 re-runs
+    assert [i for i, _ in fault_rows] == [1, 2, 2, 3, 4]
+    poisoned = fault_rows[1][1]
+    assert poisoned["entropy"] != poisoned["entropy"]  # NaN
+    finite = [(i, r) for i, r in fault_rows
+              if r["entropy"] == r["entropy"]]
+    assert [i for i, _ in finite] == [1, 2, 3, 4]
+    numeric = (
+        "entropy", "surrogate_loss", "kl_old_new", "grad_norm",
+        "step_norm", "mean_episode_reward", "vf_loss",
+    )
+    for (ic, rc), (irf, rf) in zip(clean_rows, finite):
+        assert ic == irf
+        for key in numeric:
+            vc, vf = rc[key], rf[key]
+            assert (vc == vf) or (vc != vc and vf != vf), (
+                f"iteration {ic} field {key}: clean {vc} != faulted {vf}"
+            )
+    _tree_equal(clean_final, fault_final)
+
+
+def test_nan_recovery_fused_chunk_no_duplicate_rows():
+    """NaN inside a FUSED device chunk: only the first nonfinite row of
+    the failed chunk is logged — the re-run's rows are the canonical
+    ones, and logging the failed attempt's other rows would double-fold
+    their episodes into reward_running (and let a clean prefix reset
+    the consecutive-recovery counter). Continuation stays bit-exact vs
+    a clean fused run."""
+    from trpo_tpu.utils.metrics import StatsLogger
+
+    def run(fault):
+        kw = dict(fuse_iterations=2)
+        if fault:
+            kw.update(recover_on_nan="restore", inject_faults=fault)
+        cfg = _tiny_cfg(**kw)
+        agent = TRPOAgent("cartpole", cfg)
+        logger = StatsLogger()
+        rows = _row_recorder(logger)
+        final = agent.learn(logger=logger)
+        return final, rows
+
+    clean_final, clean_rows = run(None)
+    fault_final, fault_rows = run("nan_update@iter=3")
+    assert [i for i, _ in clean_rows] == [1, 2, 3, 4]
+    # the poison lands at the [3,4] chunk boundary, so BOTH its rows
+    # are nonfinite — exactly one (iteration 3) is logged, then the
+    # chunk re-runs clean from its snapshot
+    assert [i for i, _ in fault_rows] == [1, 2, 3, 3, 4]
+    poisoned = fault_rows[2][1]
+    assert poisoned["entropy"] != poisoned["entropy"]  # NaN
+    finite = [(i, r) for i, r in fault_rows
+              if r["entropy"] == r["entropy"]]
+    assert [i for i, _ in finite] == [1, 2, 3, 4]
+    for (ic, rc), (irf, rf) in zip(clean_rows, finite):
+        assert ic == irf
+        for key in ("entropy", "surrogate_loss", "kl_old_new",
+                    "grad_norm", "step_norm", "vf_loss"):
+            vc, vf = rc[key], rf[key]
+            assert (vc == vf) or (vc != vc and vf != vf), (
+                f"iteration {ic} field {key}: clean {vc} != faulted {vf}"
+            )
+    _tree_equal(clean_final, fault_final)
+
+
+def test_recovery_emits_events_and_counts():
+    bus, events = _recording_bus()
+    cfg = _tiny_cfg(
+        recover_on_nan="restore", inject_faults="nan_update@iter=3"
+    )
+    agent = TRPOAgent("cartpole", cfg)
+    final = agent.learn(telemetry=_BusTelemetry(bus))
+    assert int(final.iteration) == 4
+    recs = [e for e in events if e["kind"] == "recovery"]
+    assert len(recs) == 1
+    assert recs[0]["reason"] in ("nan_entropy", "nan_guard")
+    assert recs[0]["iteration"] == 3
+    faults = [e for e in events if e["kind"] == "fault_injected"]
+    assert len(faults) == 1 and faults[0]["fault"] == "nan_update"
+
+
+def test_unfired_fault_warns_at_completion():
+    """A chaos spec that never triggers (here: nan_update far past the
+    iteration budget) must not let the run green-light silently — a
+    fault_unfired health warning lands on the bus at completion."""
+    bus, events = _recording_bus()
+    cfg = _tiny_cfg(inject_faults="nan_update@iter=50")
+    agent = TRPOAgent("cartpole", cfg)
+    final = agent.learn(telemetry=_BusTelemetry(bus))
+    assert int(final.iteration) == 4
+    warns = [e for e in events
+             if e["kind"] == "health" and e["check"] == "fault_unfired"]
+    assert len(warns) == 1
+    assert warns[0]["data"]["unfired"] == ["nan_update@iter=50"]
+
+
+def test_default_abort_path_unchanged():
+    """recover_on_nan='off' (default): an injected NaN still raises the
+    historical FloatingPointError — the opt-in leaves the abort path
+    alone."""
+    cfg = _tiny_cfg(inject_faults="nan_update@iter=2")
+    agent = TRPOAgent("cartpole", cfg)
+    with pytest.raises(FloatingPointError):
+        agent.learn()
+
+
+def test_recovery_policy_aborts_after_max_consecutive():
+    cfg = _tiny_cfg(recover_on_nan="restore", max_recoveries=2)
+    policy = RecoveryPolicy(cfg)
+    state = TRPOAgent("cartpole", cfg).init_state()
+    for n in range(2):
+        policy.snapshot(n + 1, state)
+        policy.flag(n + 1, "nan_entropy")
+        _, state = policy.recover()
+    policy.snapshot(3, state)
+    policy.flag(3, "nan_entropy")
+    with pytest.raises(TrainingDiverged):
+        policy.recover()
+    # a clean row AT the recovered iteration resets the counter...
+    policy2 = RecoveryPolicy(cfg)
+    policy2.snapshot(1, state)
+    policy2.snapshot(3, state)
+    policy2.flag(3, "nan_guard")
+    policy2.recover()
+    # ...but a clean row BEFORE it does not: a fused chunk's re-run
+    # reproduces its clean prefix bit-exactly, and letting that prefix
+    # reset the counter would turn a deterministic mid-chunk NaN into
+    # an infinite restore loop instead of TrainingDiverged
+    policy2.mark_clean(2)
+    assert policy2.consecutive == 1
+    policy2.mark_clean(3)
+    assert policy2.consecutive == 0
+
+
+def test_descendant_rows_while_flag_pending_do_not_reset_counter():
+    """A finite row drained between flag() and recover() descends from
+    the state being rewound (the async driver's detection lag): letting
+    it reset the consecutive counter would keep a state-deterministic
+    NaN restoring forever instead of reaching TrainingDiverged."""
+    cfg = _tiny_cfg(recover_on_nan="restore", max_recoveries=2)
+    policy = RecoveryPolicy(cfg)
+    state = TRPOAgent("cartpole", cfg).init_state()
+    policy.snapshot(1, state)
+    for _ in range(2):
+        policy.flag(1, "nan_guard")
+        policy.mark_clean(2)  # descendant drains before the driver acts
+        _, state = policy.recover()
+        policy.snapshot(1, state)
+    assert policy.consecutive == 2
+    policy.flag(1, "nan_guard")
+    with pytest.raises(TrainingDiverged):
+        policy.recover()
+
+
+def test_injector_skips_degraded_worker_and_reports_unfired():
+    """An env-level fault aimed at a worker already degraded to the
+    in-process fallback has nothing to signal: the spec must stay
+    UNFIRED (so the end-of-run warning reports it) rather than be
+    silently swallowed as exercised."""
+
+    class _DegradedPool:
+        _procs = [None]  # slice 0 re-hosted in-process
+
+    inj = FaultInjector.from_spec("kill_worker@step=3:worker=0")
+    inj.on_env_step(3, _DegradedPool())
+    assert not inj.all_fired
+    assert inj.unfired == ("kill_worker@step=3:worker=0",)
+
+
+def test_recovery_escalates_adaptive_damping():
+    cfg = _tiny_cfg(
+        recover_on_nan="restore", adaptive_damping=True, cg_damping=0.1
+    )
+    policy = RecoveryPolicy(cfg)
+    state = TRPOAgent("cartpole", cfg).init_state()
+    assert state.cg_damping is not None
+    policy.snapshot(1, state)
+    policy.flag(1, "nan_guard")
+    _, restored = policy.recover()
+    assert float(restored.cg_damping) == pytest.approx(
+        0.1 * cfg.damping_grow
+    )
+
+
+# ---------------------------------------------------------------------------
+# preemption-safe shutdown
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_checkpoints_and_resumes(tmp_path):
+    """SIGTERM mid-run: orderly shutdown writes a final checkpoint +
+    raises Preempted with the requeue exit code; a resume loses NOTHING
+    (≤ checkpoint_every was the bound, 0 is the actual)."""
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    cfg = _tiny_cfg(
+        n_iterations=6,
+        checkpoint_every=2,
+        inject_faults="sigterm@iter=3",
+    )
+    agent = TRPOAgent("cartpole", cfg)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    with pytest.raises(Preempted) as ei:
+        agent.learn(checkpointer=ck)
+    # the signal lands before iteration 3 runs; the guard notices at the
+    # top of iteration 4 — the final save covers everything completed
+    assert ei.value.step == 3
+    assert ei.value.exit_code == cfg.requeue_exit_code == 75
+    assert ck.latest_step() == 3
+
+    agent2 = TRPOAgent("cartpole", _tiny_cfg(n_iterations=6))
+    state = ck.restore(agent2.init_state())
+    assert int(state.iteration) == 3
+    final = agent2.learn(n_iterations=1, state=state)
+    assert int(final.iteration) == 4
+    ck.close()
+
+
+def test_cli_exits_with_requeue_code(tmp_path):
+    from trpo_tpu.train import main
+
+    code = main([
+        "--preset", "cartpole", "--iterations", "6",
+        "--batch-timesteps", "64", "--n-envs", "4", "--platform", "cpu",
+        "--checkpoint-dir", str(tmp_path / "ck"), "--checkpoint-every", "2",
+        "--inject-faults", "sigterm@iter=2",
+    ])
+    assert code == 75
+
+
+def test_on_preempt_ignore_keeps_abort_semantics():
+    """cfg.on_preempt='ignore': the guard is inert — SIGTERM keeps its
+    default disposition (kills the process), so we only check the guard
+    never installs handlers."""
+    from trpo_tpu.resilience import PreemptionGuard
+
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard(enabled=False) as g:
+        assert signal.getsignal(signal.SIGTERM) is prev
+        assert not g.triggered
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+# ---------------------------------------------------------------------------
+# checkpoint save-integrity gate (kill -9 mid-save)
+# ---------------------------------------------------------------------------
+
+
+def test_torn_save_never_selected_and_pruned(tmp_path):
+    """A step whose completion marker is missing (= the save was torn by
+    kill -9) must never be latest_step(); restore prunes it and reads the
+    previous complete step."""
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    bus, events = _recording_bus()
+    cfg = _tiny_cfg()
+    agent = TRPOAgent("cartpole", cfg)
+    state = agent.init_state()
+    ck = Checkpointer(str(tmp_path / "ck"), bus=bus)
+    ck.save(2, state)
+    state2, _ = agent.run_iteration(state)
+    ck.save(4, state2)
+    assert ck.latest_step() == 4
+    # simulate the kill -9: the orbax step exists, the marker does not
+    os.remove(ck._marker_path(4))
+    assert ck.latest_step() == 2
+    restored = ck.restore(agent.init_state())
+    assert int(restored.iteration) == 0  # step 2 held the initial state
+    assert 4 not in list(ck.manager.all_steps())
+    checks = [e["check"] for e in events if e["kind"] == "health"]
+    assert "checkpoint_incomplete" in checks
+    ck.close()
+
+
+def test_marker_files_written_and_pruned_with_steps(tmp_path):
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    cfg = _tiny_cfg()
+    agent = TRPOAgent("cartpole", cfg)
+    state = agent.init_state()
+    ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=2)
+    for step in (1, 2, 3):
+        ck.save(step, state)
+        state, _ = agent.run_iteration(state)
+    # max_to_keep=2 garbage-collected step 1 — its marker too
+    assert not os.path.exists(ck._marker_path(1))
+    assert os.path.exists(ck._marker_path(2))
+    assert os.path.exists(ck._marker_path(3))
+    assert ck.latest_step() == 3
+    ck.close()
+
+
+def test_legacy_directory_without_markers_still_restores(tmp_path):
+    """Pre-round-7 checkpoints have no markers at all: trust them (the
+    gate only distrusts unmarked steps NEWER than the newest marker)."""
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    cfg = _tiny_cfg()
+    agent = TRPOAgent("cartpole", cfg)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    ck.save(5, agent.init_state())
+    # a real legacy directory predates BOTH the marker and the
+    # markers-enabled sentinel — remove both to simulate one
+    os.remove(ck._marker_path(5))
+    os.remove(ck._sentinel_path())
+    assert ck.latest_step() == 5
+    restored = ck.restore(agent.init_state())
+    assert int(restored.iteration) == 0
+    ck.close()
+
+
+# ---------------------------------------------------------------------------
+# corrupt vs missing host-env sidecar (satellite 6)
+# ---------------------------------------------------------------------------
+
+
+def test_torn_first_save_in_fresh_directory_not_trusted(tmp_path):
+    """kill -9 through the very FIRST save of a fresh directory leaves
+    zero markers — which must read as "every save here tore", not as a
+    trusted legacy directory (the sentinel written at init is what
+    distinguishes the two)."""
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    cfg = _tiny_cfg()
+    agent = TRPOAgent("cartpole", cfg)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    ck.save(2, agent.init_state())
+    os.remove(ck._marker_path(2))  # the tear: orbax step, no marker
+    assert ck.latest_step() is None
+    with pytest.raises(FileNotFoundError):
+        ck.restore(agent.init_state())
+    assert 2 not in list(ck.manager.all_steps())  # pruned, not shadowed
+    ck.close()
+
+
+def test_corrupt_sidecar_surfaces_health_event(tmp_path):
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    bus, events = _recording_bus()
+    cfg = _tiny_cfg()
+    agent = TRPOAgent("cartpole", cfg)
+    ck = Checkpointer(str(tmp_path / "ck"), bus=bus)
+    ck.save(1, agent.init_state())
+
+    # missing sidecar: silent None (the documented fallback)
+    assert ck.restore_host_env(1) is None
+    assert not [e for e in events if e["kind"] == "health"]
+
+    # corrupt sidecar: still None, but LOUD
+    with open(ck._aux_path(1), "wb") as f:
+        f.write(b"this is not an npz archive")
+    assert ck.restore_host_env(1) is None
+    checks = [e["check"] for e in events if e["kind"] == "health"]
+    assert checks == ["host_env_sidecar_corrupt"]
+    ck.close()
+
+
+# ---------------------------------------------------------------------------
+# event-log chaos contract (validate_events fault matching)
+# ---------------------------------------------------------------------------
+
+
+def _write_events(path, records):
+    from trpo_tpu.obs.events import manifest_fields
+
+    base = {"v": 1, "t": 0.0}
+    rows = [
+        {**base, "kind": "run_manifest", **manifest_fields()},
+    ] + [{**base, **r} for r in records]
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_validator_requires_matching_recovery(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "validate_events",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "validate_events.py"),
+    )
+    ve = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ve)
+
+    fault = {"kind": "fault_injected", "fault": "nan_update", "at": 2,
+             "spec": "nan_update@iter=2"}
+    recovery = {"kind": "recovery", "action": "restore",
+                "reason": "nan_entropy", "iteration": 2}
+    perturb = {"kind": "fault_injected", "fault": "delay_step", "at": 1,
+               "spec": "delay_step@step=1:seconds=0.5"}
+
+    unmatched = tmp_path / "unmatched.jsonl"
+    _write_events(unmatched, [fault])
+    errs = ve.validate_file(str(unmatched))
+    assert any("no matching detection/recovery" in e for e in errs)
+
+    matched = tmp_path / "matched.jsonl"
+    _write_events(matched, [fault, recovery, perturb])
+    assert ve.validate_file(str(matched)) == []
+
+    killfault = {"kind": "fault_injected", "fault": "kill_worker", "at": 3,
+                 "spec": "kill_worker@step=3"}
+    restart = {"kind": "health", "check": "worker_restart",
+               "level": "warn", "message": "restarted"}
+    kill_ok = tmp_path / "kill.jsonl"
+    _write_events(kill_ok, [killfault, restart])
+    assert ve.validate_file(str(kill_ok)) == []
+    kill_bad = tmp_path / "kill_bad.jsonl"
+    _write_events(kill_bad, [killfault])
+    assert ve.validate_file(str(kill_bad)) != []
+
+
+# ---------------------------------------------------------------------------
+# async driver: recovery without racing the checkpoint (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_async_driver_retries_nan_in_final_iteration():
+    """A NaN that only surfaces in the FINAL drain (poison at the last
+    iteration) must still be retried: the run completes its full
+    iteration budget — the serial driver's semantics — instead of
+    restoring and returning one update short."""
+    bus, events = _recording_bus()
+    cfg = TRPOConfig(
+        env="gym:" + ENV,
+        n_iterations=3,
+        batch_timesteps=32,
+        n_envs=2,
+        seed=5,
+        host_async_pipeline=True,
+        recover_on_nan="restore",
+        inject_faults="nan_update@iter=3",
+    )
+    agent = TRPOAgent(cfg.env, cfg)
+    try:
+        final = agent.learn(telemetry=_BusTelemetry(bus))
+        assert int(final.iteration) == 3
+        recs = [e for e in events if e["kind"] == "recovery"]
+        assert len(recs) == 1 and recs[0]["iteration"] == 3
+    finally:
+        agent.env.close()
+
+
+@pytest.mark.slow
+def test_async_driver_nan_recovery(tmp_path):
+    """The async pipeline detects the poisoned row on the DRAIN thread —
+    after the next iteration's phase A may have been dispatched. Recovery
+    must still rewind to the flagged iteration, never checkpoint the
+    poisoned state, and finish the full budget."""
+    bus, events = _recording_bus()
+    cfg = TRPOConfig(
+        env="gym:" + ENV,
+        n_iterations=4,
+        batch_timesteps=32,
+        n_envs=2,
+        seed=5,
+        host_async_pipeline=True,
+        recover_on_nan="restore",
+        checkpoint_every=2,
+        inject_faults="nan_update@iter=2",
+    )
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    agent = TRPOAgent(cfg.env, cfg)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    cb_finite = []
+
+    def _cb(st, _stats):
+        # inspect at delivery time: the driver only keeps the state's
+        # buffers alive for the duration of the callback (the next
+        # update donates them afterwards)
+        cb_finite.append(
+            all(
+                bool(jnp.all(jnp.isfinite(leaf)))
+                for leaf in jax.tree_util.tree_leaves(st.policy_params)
+            )
+        )
+
+    try:
+        final = agent.learn(
+            checkpointer=ck, telemetry=_BusTelemetry(bus), callback=_cb
+        )
+        assert int(final.iteration) == 4
+        recs = [e for e in events if e["kind"] == "recovery"]
+        assert len(recs) == 1 and recs[0]["iteration"] == 2
+        # the user callback never saw the poisoned state (or any
+        # descendant of it): every delivered state was finite
+        assert cb_finite and all(cb_finite)
+        # every persisted step restores finite params (the poisoned
+        # state never reached a save)
+        for step in ck.manager.all_steps():
+            restored = ck.restore(agent.init_state(), step=step)
+            for leaf in jax.tree_util.tree_leaves(
+                restored.policy_params
+            ):
+                assert bool(jnp.all(jnp.isfinite(leaf)))
+    finally:
+        ck.close()
+        agent.env.close()

@@ -119,11 +119,38 @@ class TRPOAgent:
                 # adapters), mirrored into TrainState below
                 kwargs["normalize_obs"] = True
                 host_normalized = True
+            if env.startswith("gymproc:") and cfg.env_step_timeout:
+                # worker-pool resilience: bound every reply gather so a
+                # dead/hung worker raises WorkerDiedError instead of
+                # hanging host_step forever (0/None = wait forever)
+                kwargs["step_timeout"] = cfg.env_step_timeout
             # cfg.max_pathlength=None keeps the env's default horizon;
             # a value overrides it for every env family (envs.make).
             env = envs_lib.make(
                 env, max_episode_steps=cfg.max_pathlength, **kwargs
             )
+        # Worker-pool envs (gymproc:, or a pre-constructed ProcVecEnv) get
+        # the supervision wrapper: dead/hung workers are restarted with
+        # backoff, degraded to an in-process slice after
+        # cfg.max_worker_restarts, aborted below cfg.min_env_workers
+        # (resilience/supervisor.py). Transparent delegation — every
+        # adapter surface passes through. learn() attaches the telemetry
+        # bus and fault injector at run time.
+        if hasattr(env, "restart_worker"):
+            from trpo_tpu.resilience.supervisor import (
+                SupervisedEnv,
+                SupervisionConfig,
+            )
+
+            if not isinstance(env, SupervisedEnv):
+                env = SupervisedEnv(
+                    env,
+                    SupervisionConfig(
+                        max_worker_restarts=cfg.max_worker_restarts,
+                        min_proc_workers=cfg.min_env_workers,
+                        backoff_base=cfg.worker_backoff,
+                    ),
+                )
         self.env = env
         self.cfg = cfg
         self.is_device_env = envs_lib.is_device_env(env)
@@ -1268,6 +1295,17 @@ class TRPOAgent:
         iteration-windowed profiler capture. ``learn`` drives its
         lifecycle (``start_run``/``mark_steady``/``finish_run``); the
         creator closes the sinks.
+
+        Resilience (``trpo_tpu/resilience``, all config-driven):
+        ``cfg.inject_faults`` arms the chaos injector;
+        ``cfg.recover_on_nan="restore"`` replaces the NaN abort below
+        with restore-last-good-and-skip (``TrainingDiverged`` after
+        ``cfg.max_recoveries`` consecutive failures — the default
+        ``"off"`` keeps the abort byte-identical);
+        ``cfg.on_preempt="checkpoint"`` (default) turns SIGTERM/SIGINT
+        into drain → final checkpoint → ``Preempted`` (callers that want
+        the state must read it off the exception). See ARCHITECTURE.md
+        "Resilience".
         """
         cfg = self.cfg
         n_iterations = n_iterations or cfg.n_iterations
@@ -1293,12 +1331,47 @@ class TRPOAgent:
                 else "serial",
                 n_iterations=n_iterations,
             )
+
+        # -- resilience wiring (trpo_tpu/resilience, ISSUE 4) ------------
+        # injector: config-driven chaos (cfg.inject_faults); recovery:
+        # last-good snapshot/restore on nonfinite updates
+        # (cfg.recover_on_nan="restore" — "off" keeps the PR 3 abort path
+        # byte-identical); guard: cooperative SIGTERM/SIGINT →
+        # drain → final checkpoint → Preempted (cfg.on_preempt).
+        from trpo_tpu.resilience import (
+            FaultInjector,
+            PreemptionGuard,
+            RecoveryPolicy,
+        )
+
+        bus = telemetry.bus if telemetry is not None else None
+        injector = (
+            FaultInjector.from_spec(cfg.inject_faults, bus=bus)
+            if cfg.inject_faults
+            else None
+        )
+        recovery = (
+            RecoveryPolicy(cfg, bus=bus)
+            if cfg.recover_on_nan == "restore"
+            else None
+        )
+        guard = PreemptionGuard(enabled=cfg.on_preempt == "checkpoint")
+        # the supervised worker pool reports restarts/degradation on the
+        # same bus, and hosts the env-level faults (kill/hang/delay)
+        if hasattr(self.env, "restart_worker"):
+            if bus is not None and getattr(self.env, "bus", None) is None:
+                self.env.bus = bus
+            if injector is not None:
+                self.env.injector = injector
+
         if cfg.host_async_pipeline and not self.is_device_env:
             try:
-                return self._learn_host_async(
-                    n_iterations, state, logger, checkpointer, callback,
-                    timer, telemetry,
-                )
+                with guard:
+                    return self._learn_host_async(
+                        n_iterations, state, logger, checkpointer,
+                        callback, timer, telemetry,
+                        injector=injector, recovery=recovery, guard=guard,
+                    )
             finally:
                 if telemetry is not None:
                     telemetry.finish_run(timer)
@@ -1318,15 +1391,31 @@ class TRPOAgent:
 
         reward_running = RunningEpisodeMean()
 
-        # absolute iteration base for the profiler window, so
-        # --profile-iteration N names the same iteration in both drivers
-        # and across resumes (one entry sync, like the async driver's)
-        it0 = int(state.iteration) if telemetry is not None else 0
+        # absolute iteration base: the profiler window, the fault
+        # injector's iter= triggers and the recovery rewind all count in
+        # absolute iterations (one entry sync, like the async driver's)
+        it0 = int(state.iteration)
 
+        guard.__enter__()
         try:
             done = 0
             seen_chunk_sizes: set = set()
             while done < n_iterations:
+                if guard.triggered:
+                    # orderly preemption: rows of every finished chunk
+                    # are already processed (the serial driver is
+                    # synchronous), so `state` is clean to persist
+                    self._preempt_shutdown(state, checkpointer, bus, guard)
+                if recovery is not None:
+                    # last-good restore point — parked BEFORE the
+                    # injector can poison the state and before the
+                    # donated update consumes its buffers
+                    recovery.snapshot(it0 + done + 1, state)
+                if injector is not None:
+                    state = injector.before_iteration(
+                        it0 + done + 1, state,
+                        span=min(chunk, n_iterations - done),
+                    )
                 k = min(chunk, n_iterations - done)
                 if telemetry is not None:
                     # span=k: a fused chunk is one indivisible program —
@@ -1364,7 +1453,26 @@ class TRPOAgent:
                 ts_end = int(state.total_timesteps)
                 stop = False
                 host_stats = None
+                flagged_j = None
+                if recovery is not None:
+                    # find the chunk's FIRST nonfinite row before
+                    # processing any: the whole chunk re-runs from its
+                    # snapshot, so folding/logging the other rows here
+                    # would double-count the clean prefix on the re-run
+                    # (and let it reset the consecutive-recovery
+                    # counter) and publish the poisoned row's
+                    # descendants
+                    ng = stack.get("nan_guard")
+                    for j in range(k):
+                        ent = stack["entropy"][j].item()
+                        if ent != ent or (
+                            ng is not None and bool(ng[j].item())
+                        ):
+                            flagged_j = j
+                            break
                 for j in range(k):
+                    if flagged_j is not None and j != flagged_j:
+                        continue
                     host_stats = {
                         key: stack[key][j].item() for key in stack
                     }
@@ -1380,7 +1488,19 @@ class TRPOAgent:
                         timesteps_total=ts_end
                         - (k - 1 - j) * steps_per_iter,
                         telemetry=telemetry,
+                        recovery=recovery,
                     ) or stop
+                if recovery is not None and recovery.pending is not None:
+                    # a row in this chunk was nonfinite: restore the
+                    # last-good state and re-run from its iteration —
+                    # BEFORE the callback and checkpoint blocks below, so
+                    # neither ever sees the poisoned state (the recovery
+                    # extension of the "drain before checkpoint"
+                    # guarantee). Raises TrainingDiverged after
+                    # cfg.max_recoveries consecutive failures.
+                    restored_at, state = recovery.recover()
+                    done = restored_at - 1 - it0
+                    continue
                 if callback is not None:
                     # once per chunk, with MATCHED (state, stats): the
                     # end-of-chunk state and its own iteration's stats
@@ -1401,7 +1521,10 @@ class TRPOAgent:
                         )
                 if stop:
                     break
+            if injector is not None:
+                self._warn_unfired_faults(injector, bus)
         finally:
+            guard.__exit__(None, None, None)
             if telemetry is not None:
                 telemetry.finish_run(timer)
             if own_logger:
@@ -1411,7 +1534,7 @@ class TRPOAgent:
     def _finish_iteration_stats(
         self, host_stats, reward_running, logger, *,
         iteration: int, iteration_ms: float, timesteps_total: int,
-        telemetry=None,
+        telemetry=None, recovery=None,
     ) -> bool:
         """Decorate ONE iteration's host stats (running episode-return
         mean, wall-clock fields, timestep total), log the row, then apply
@@ -1420,8 +1543,39 @@ class TRPOAgent:
         return True on ``cfg.reward_target`` / ``cfg.stop_on_explained_
         variance``. The ONE copy of this per-row logic, shared by the
         serial loop and the async drain consumer — the drivers' bit-exact
-        contract forbids letting them drift."""
+        contract forbids letting them drift.
+
+        ``recovery`` (a ``resilience.RecoveryPolicy``, when
+        ``cfg.recover_on_nan="restore"``) replaces the hard abort: a
+        nonfinite row (NaN entropy, or the device-side ``nan_guard``
+        trip) is logged — flagged so the health rules still see it —
+        then FLAGGED for the driver to restore the last-good state,
+        without folding the poisoned row into the running episode mean.
+        With ``recovery=None`` (the default) this method is byte-
+        identical to its PR 3 form."""
         cfg = self.cfg
+        if recovery is not None:
+            pend = recovery.pending
+            if pend is not None and iteration > pend[0]:
+                # a row drained AFTER a flagged one descends from the
+                # state the driver is about to rewind: folding it would
+                # double-count the re-run, logging it would duplicate
+                # the canonical row the re-run emits
+                return False
+            ent = host_stats["entropy"]
+            if ent != ent or host_stats.get("nan_guard"):
+                host_stats["reward_running"] = reward_running.mean
+                host_stats["time_elapsed_min"] = logger.elapsed_minutes()
+                host_stats["iteration_ms"] = iteration_ms
+                host_stats["timesteps_total"] = timesteps_total
+                logger.log(iteration, host_stats)
+                if telemetry is not None:
+                    telemetry.on_iteration(iteration, host_stats)
+                recovery.flag(
+                    iteration,
+                    "nan_entropy" if ent != ent else "nan_guard",
+                )
+                return False
         reward_running.update(
             host_stats["mean_episode_reward"],
             host_stats["episodes_in_batch"],
@@ -1436,6 +1590,8 @@ class TRPOAgent:
             # raise, so the finding reaches the sinks even on the abort
             # path (runs on the drain thread under the async driver)
             telemetry.on_iteration(iteration, host_stats)
+        if recovery is not None:
+            recovery.mark_clean(iteration)
         ent = host_stats["entropy"]
         if ent != ent:  # NaN check (ref trpo_inksci.py:172-173)
             raise FloatingPointError(
@@ -1453,13 +1609,85 @@ class TRPOAgent:
             > cfg.stop_on_explained_variance
         )
 
+    @staticmethod
+    def _warn_unfired_faults(injector, bus) -> None:
+        """A completed run with chaos specs that never fired exercised
+        nothing for them — the same contract that makes the spec parser
+        reject malformed fragments loudly ('a chaos run with a silently
+        dropped fault would pass by testing nothing'). Warn on the bus
+        (or ``warnings`` without one) at the end of a completed run."""
+        unfired = injector.unfired
+        if not unfired:
+            return
+        msg = (
+            "fault spec(s) never fired: " + "; ".join(unfired) + " — the "
+            "run completed without exercising them (trigger beyond the "
+            "run's steps/iterations, or an env without the targeted "
+            "workers)"
+        )
+        if bus is not None:
+            bus.emit(
+                "health", check="fault_unfired", level="warn",
+                message=msg, data={"unfired": list(unfired)},
+            )
+        else:
+            import warnings
+
+            warnings.warn(msg)
+
+    def _preempt_shutdown(self, state, checkpointer, bus, guard):
+        """The orderly preemption exit, shared by both drivers (the async
+        one drains its pipeline FIRST — its call sites guarantee the
+        passed state is fully materialized and its rows consumed): write
+        a final checkpoint + host-env sidecar, emit the ``preempted``
+        health event, and raise ``Preempted`` carrying the requeue exit
+        code for the CLI."""
+        from trpo_tpu.resilience import Preempted
+
+        step = int(state.iteration)
+        saved = False
+        if checkpointer is not None and step > 0:
+            # the cadence may have just saved this very step — Orbax
+            # rejects duplicate steps, and there is nothing newer to add
+            if checkpointer.latest_step() != step:
+                checkpointer.save(step, state)
+                if hasattr(checkpointer, "save_host_env"):
+                    checkpointer.save_host_env(
+                        step, self.snapshot_host_env()
+                    )
+            saved = True
+        if bus is not None:
+            bus.emit(
+                "health",
+                check="preempted",
+                level="warn",
+                message=(
+                    f"signal {guard.signum}: pipeline drained, "
+                    + (
+                        f"final checkpoint at step {step}, "
+                        if saved
+                        else "no checkpointer configured, "
+                    )
+                    + "exiting for requeue"
+                ),
+                data={"signum": guard.signum, "step": step,
+                      "saved": saved},
+            )
+        raise Preempted(
+            f"preempted by signal {guard.signum} after iteration {step}",
+            state=state,
+            step=step if saved else 0,
+            signum=guard.signum,
+            exit_code=self.cfg.requeue_exit_code,
+        )
+
     # ------------------------------------------------------------------
     # the asynchronous host-env pipeline (cfg.host_async_pipeline)
     # ------------------------------------------------------------------
 
     def _learn_host_async(
         self, n_iterations, state, logger, checkpointer, callback, timer,
-        telemetry=None,
+        telemetry=None, injector=None, recovery=None, guard=None,
     ) -> TrainState:
         """The async iteration driver for host-simulator envs.
 
@@ -1497,6 +1725,7 @@ class TRPOAgent:
         cfg = self.cfg
         steps_per_iter = self.n_steps * cfg.n_envs
         reward_running = RunningEpisodeMean()
+        bus = telemetry.bus if telemetry is not None else None
         # the ONLY entry syncs; the loop itself never fetches device scalars
         it0 = int(state.iteration)
         ts0 = int(state.total_timesteps)
@@ -1516,8 +1745,15 @@ class TRPOAgent:
                 iteration_ms=iter_wall_ms,
                 timesteps_total=ts0 + (i - it0 + 1) * steps_per_iter,
                 telemetry=telemetry,
+                recovery=recovery,
             )
-            if callback is not None:
+            if callback is not None and (
+                recovery is None or recovery.pending is None
+            ):
+                # a flagged row — or any row drained after one (a
+                # descendant of the poisoned state) — must never reach
+                # the user callback: same guarantee the serial driver
+                # gives by restoring before its callback block
                 callback(cb_state, host_stats)
             return stop
 
@@ -1561,8 +1797,51 @@ class TRPOAgent:
             )
 
         try:
-            for j in range(n_iterations):
+            j = 0
+            while True:
+                if j >= n_iterations or drain.stop_requested:
+                    # pipeline epilogue: flush phase B and drain every
+                    # pending row before returning
+                    _flush_b()
+                    drain.drain()
+                    if recovery is not None and recovery.pending is not None:
+                        # a nonfinite row surfaced in the FINAL drain:
+                        # restore, and — unless a stop rule already
+                        # fired — rewind to RE-RUN the trailing
+                        # iterations (the serial driver's retry
+                        # semantics; returning without the retry would
+                        # silently complete the run short of its
+                        # budget). On a stop, restoring alone suffices:
+                        # never return (or let a caller checkpoint) the
+                        # poisoned state.
+                        restored_at, cur = recovery.recover()
+                        if not drain.stop_requested:
+                            j = restored_at - 1 - it0
+                            continue
+                    break
                 i = it0 + j
+                if guard is not None and guard.triggered:
+                    # orderly preemption: drain the whole pipeline first
+                    # (phase B + every pending stats row), resolve any
+                    # nonfinite row the drain surfaced (never persist a
+                    # poisoned state), then checkpoint and requeue
+                    _flush_b()
+                    drain.drain()
+                    if recovery is not None and recovery.pending is not None:
+                        _, cur = recovery.recover()
+                    self._preempt_shutdown(cur, checkpointer, bus, guard)
+                if recovery is not None:
+                    # pre-rollout restore point: parked before the
+                    # injector can poison this iteration and before
+                    # phase A donates the buffers. The previous
+                    # iteration's deferred vf fit must land first —
+                    # snapshotting around it would silently drop that
+                    # fit on a restore (the deferred-B latency hiding
+                    # is given up only while recovery is active)
+                    _flush_b()
+                    recovery.snapshot(i + 1, cur)
+                if injector is not None:
+                    cur = injector.before_iteration(i + 1, cur)
                 if telemetry is not None:
                     telemetry.profile_tick(i + 1)
                     if j >= 2:
@@ -1634,14 +1913,31 @@ class TRPOAgent:
                     # re-raises any drain-thread error): the serial
                     # driver's NaN-entropy abort fires before its save
                     # ever runs, and a checkpoint of a diverged state
-                    # would silently poison a later resume
+                    # would silently poison a later resume. The recovery
+                    # path extends the same guarantee: a drained row that
+                    # FLAGGED a nonfinite update (instead of raising)
+                    # vetoes the save — the restore below rewinds first.
                     drain.drain()
-                    checkpointer.save(i + 1, cur)
-                    if hasattr(checkpointer, "save_host_env"):
-                        checkpointer.save_host_env(
-                            i + 1, self.snapshot_host_env()
-                        )
+                    if recovery is None or recovery.pending is None:
+                        checkpointer.save(i + 1, cur)
+                        if hasattr(checkpointer, "save_host_env"):
+                            checkpointer.save_host_env(
+                                i + 1, self.snapshot_host_env()
+                            )
                 drain.raise_if_failed()
+                if recovery is not None and recovery.pending is not None:
+                    # a drained row was nonfinite. Everything dispatched
+                    # since (the next phase A may already be in flight —
+                    # the async analogue of the serial driver's
+                    # abort-after-dispatch race) descends from the
+                    # poisoned state: flush and drain it all, restore the
+                    # flagged iteration's pre-rollout snapshot, and
+                    # re-run from there.
+                    _flush_b()
+                    drain.drain()
+                    restored_at, cur = recovery.recover()
+                    j = restored_at - 1 - it0
+                    continue
                 if telemetry is not None:
                     # host-side gauges only — never a device sync; the
                     # health monitor warns when the bound is reached
@@ -1649,9 +1945,10 @@ class TRPOAgent:
                         drain.depth, drain.high_water, drain.maxsize
                     )
                 if drain.stop_requested:
-                    break
-            _flush_b()
-            drain.drain()
+                    continue  # the top-of-loop epilogue flushes first
+                j += 1
+            if injector is not None:
+                self._warn_unfired_faults(injector, bus)
         finally:
             drain.close()
         return cur

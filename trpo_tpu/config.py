@@ -299,6 +299,56 @@ class TRPOConfig:
     #    expert parallelism — whole MoE experts per shard (models/moe.py),
     #    same pytree-domain solve.
 
+    # --- resilience (trpo_tpu/resilience — ISSUE 4) ----------------------
+    env_step_timeout: Optional[float] = 60.0  # gymproc: pools: seconds any
+    #                                reply gather waits on a worker before
+    #                                declaring it dead (WorkerDiedError —
+    #                                a killed worker otherwise hangs
+    #                                host_step forever). Applied when the
+    #                                agent constructs the pool from a
+    #                                "gymproc:" name; 0/None = wait
+    #                                forever (pre-round-7 behavior).
+    max_worker_restarts: int = 2   # supervision: process restarts (with
+    #                                exponential backoff) per env worker
+    #                                before its slice degrades to the
+    #                                in-process fallback (correct data,
+    #                                no process parallelism)
+    min_env_workers: int = 0       # abort (WorkerPoolError) when fewer
+    #                                process-backed workers than this
+    #                                remain healthy; 0 = degrade all the
+    #                                way, never abort on degradation alone
+    worker_backoff: float = 0.5    # base seconds for the restart backoff
+    #                                (base·2^(attempt-1), capped at 5s)
+    recover_on_nan: str = "off"    # "off" = the reference-semantics abort
+    #                                (FloatingPointError on NaN entropy —
+    #                                byte-identical to PR 3); "restore" =
+    #                                keep a last-good TrainState snapshot
+    #                                per iteration (donation-aware copy),
+    #                                on a nonfinite update restore it,
+    #                                skip the poisoned batch, escalate
+    #                                cg_damping through the
+    #                                adaptive_damping state when active,
+    #                                and abort only after max_recoveries
+    #                                consecutive failures
+    #                                (resilience/recovery.py)
+    max_recoveries: int = 3        # consecutive NaN recoveries before
+    #                                TrainingDiverged aborts the run
+    on_preempt: str = "checkpoint"  # "checkpoint" = SIGTERM/SIGINT drain
+    #                                the pipeline, write a final
+    #                                checkpoint + host-env sidecar, and
+    #                                raise Preempted (the CLI exits with
+    #                                requeue_exit_code); "ignore" = keep
+    #                                default signal behavior
+    requeue_exit_code: int = 75    # CLI exit code after a preemption
+    #                                shutdown (75 = BSD EX_TEMPFAIL) —
+    #                                distinct from success/crash so
+    #                                schedulers requeue exactly these
+    inject_faults: Optional[str] = None  # chaos injection spec
+    #                                (resilience/inject.py grammar, e.g.
+    #                                "kill_worker@step=3:worker=0;
+    #                                nan_update@iter=2"); every fired
+    #                                fault emits a fault_injected event
+
     # --- io --------------------------------------------------------------
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 10
@@ -334,6 +384,52 @@ class TRPOConfig:
                 "precond_refresh_every must be >= 1, got "
                 f"{self.precond_refresh_every}"
             )
+        if self.recover_on_nan not in ("off", "restore"):
+            raise ValueError(
+                'recover_on_nan must be "off" or "restore", got '
+                f"{self.recover_on_nan!r}"
+            )
+        if self.on_preempt not in ("checkpoint", "ignore"):
+            raise ValueError(
+                'on_preempt must be "checkpoint" or "ignore", got '
+                f"{self.on_preempt!r}"
+            )
+        if self.max_recoveries < 1:
+            raise ValueError(
+                f"max_recoveries must be >= 1, got {self.max_recoveries}"
+            )
+        if self.max_worker_restarts < 0:
+            raise ValueError(
+                "max_worker_restarts must be >= 0, got "
+                f"{self.max_worker_restarts}"
+            )
+        if self.min_env_workers < 0:
+            raise ValueError(
+                f"min_env_workers must be >= 0, got {self.min_env_workers}"
+            )
+        if self.env_step_timeout is not None and self.env_step_timeout < 0:
+            # 0/None = wait forever; a negative value would make every
+            # reply gather "time out" instantly and burn the whole
+            # restart budget into silent pool degradation
+            raise ValueError(
+                "env_step_timeout must be >= 0 (0 or None = no timeout), "
+                f"got {self.env_step_timeout}"
+            )
+        if self.worker_backoff < 0:
+            raise ValueError(
+                f"worker_backoff must be >= 0, got {self.worker_backoff}"
+            )
+        if not 0 < self.requeue_exit_code < 256:
+            raise ValueError(
+                "requeue_exit_code must be in (0, 255], got "
+                f"{self.requeue_exit_code}"
+            )
+        if self.inject_faults:
+            # fail at construction: a chaos run with an unparseable spec
+            # would otherwise "pass" by injecting nothing
+            from trpo_tpu.resilience.inject import parse_fault_specs
+
+            parse_fault_specs(self.inject_faults)
         if self.adaptive_damping:
             if not self.damping_grow > 1.0:
                 raise ValueError(

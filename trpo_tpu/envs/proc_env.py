@@ -47,13 +47,151 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+from collections import deque
 
 import numpy as np
 
 from trpo_tpu.envs.episode_stats import EpisodeStatsMixin
 from trpo_tpu.envs.obs_norm import ObsNormMixin
 
-__all__ = ["ProcVecEnv"]
+__all__ = ["ProcVecEnv", "WorkerDiedError"]
+
+
+class WorkerDiedError(RuntimeError):
+    """A ``proc_env`` worker stopped answering: its process exited/was
+    killed (pipe EOF) or it exceeded the per-command ``step_timeout``
+    (hung). Carries everything supervision (``resilience/supervisor.py``)
+    needs to revive it: the worker index (``worker``, plus ``workers``
+    when one gather found several casualties), the failure ``kind``
+    (``"died"`` / ``"timeout"``), and the last action batch the parent
+    sent it (``last_action`` — None before the first step)."""
+
+    def __init__(self, worker: int, env_id: str, kind: str = "died",
+                 last_action=None, workers=None):
+        self.worker = worker
+        self.workers = sorted(workers) if workers else [worker]
+        self.kind = kind
+        self.env_id = env_id
+        self.last_action = last_action
+        act = (
+            "no action sent yet"
+            if last_action is None
+            else f"last action {np.array_str(np.asarray(last_action))}"
+        )
+        super().__init__(
+            f"ProcVecEnv worker {self.workers} ({env_id}) "
+            f"{'timed out' if kind == 'timeout' else 'died'} "
+            f"mid-command ({act})"
+        )
+
+
+def _construct_envs(env_id: str, count: int, seed_base: int, kwargs: dict):
+    """Build ``count`` envs + the metadata the parent handshake needs.
+
+    Shared by the spawned worker body (:func:`_worker`) and the parent's
+    in-process degraded-mode fallback (:class:`_LocalConn`), so both
+    construct IDENTICAL envs. Returns
+    ``(envs, spec, clip, obs_shape, obs0)``."""
+    import gymnasium
+
+    # "package.module:attr" where attr is a class or factory callable
+    # constructs envs directly (no registry needed in the spawned
+    # interpreter — the overlap probe envs/sleep_env.py uses this).
+    # gymnasium's own documented "module:EnvId" form (import module,
+    # then make the REGISTERED id) takes precedence: the ctor path is
+    # only taken when, after importing the module, the id is absent
+    # from gymnasium's registry — otherwise a module-level callable
+    # that happens to share the registered id's name would silently
+    # bypass the registry's wrappers (TimeLimit, OrderEnforcing,
+    # spec-level kwargs). Anything that neither resolves to a callable
+    # nor registers falls through to gymnasium.make's own error.
+    env_ctor = None
+    if ":" in env_id:
+        import importlib
+
+        mod_name, attr = env_id.split(":", 1)
+        try:
+            obj = getattr(importlib.import_module(mod_name), attr)
+        except (ImportError, AttributeError):
+            obj = None
+        if callable(obj) and attr not in gymnasium.registry:
+            env_ctor = obj
+    if env_ctor is not None:
+        envs = [env_ctor(**kwargs) for _ in range(count)]
+    else:
+        envs = [gymnasium.make(env_id, **kwargs) for _ in range(count)]
+    single = envs[0]
+    space = single.action_space
+    if hasattr(space, "n"):
+        spec = ("discrete", int(space.n))
+        clip = None
+    else:
+        lo = np.asarray(space.low, np.float32)
+        hi = np.asarray(space.high, np.float32)
+        spec = ("box", int(space.shape[0]))
+        clip = (lo, hi)
+    obs0 = np.stack(
+        [env.reset(seed=seed_base + j)[0] for j, env in enumerate(envs)]
+    )
+    return envs, spec, clip, tuple(single.observation_space.shape), obs0
+
+
+def _serve(envs: list, clip, obs0: np.ndarray, msg: tuple):
+    """Execute ONE worker command against ``envs``; returns
+    ``(reply, close)``. The single copy of the command semantics, shared
+    by the worker loop and the in-process fallback — errors are the
+    caller's to wrap (the worker sends an ``err`` reply, the fallback
+    raises in place)."""
+    from trpo_tpu.envs.gym_state import restore_one, snapshot_one
+
+    cmd = msg[0]
+    if cmd == "step":
+        actions = msg[1]
+        m = len(envs)
+        next_obs = np.empty((m,) + obs0.shape[1:], obs0.dtype)
+        final_obs = np.empty_like(next_obs)
+        rewards = np.zeros(m, np.float32)
+        term = np.zeros(m, bool)
+        trunc = np.zeros(m, bool)
+        for j, env in enumerate(envs):
+            a = actions[j]
+            if clip is not None:
+                a = np.clip(a, clip[0], clip[1])
+            obs_j, r, tm, tr, _info = env.step(a)
+            rewards[j] = r
+            term[j] = tm
+            trunc[j] = tr
+            final_obs[j] = obs_j
+            if tm or tr:
+                obs_j, _ = env.reset()
+            next_obs[j] = obs_j
+        return ("ok", next_obs, rewards, term, trunc, final_obs), False
+    if cmd == "reset_all":
+        seed = msg[1]
+        obs = np.stack(
+            [
+                env.reset(seed=None if seed is None else seed + j)[0]
+                for j, env in enumerate(envs)
+            ]
+        )
+        return ("ok", obs), False
+    if cmd == "snapshot":
+        return ("ok", [snapshot_one(env) for env in envs]), False
+    if cmd == "restore":
+        sims = msg[1]
+        reset_obs = {}
+        for j, (env, sim) in enumerate(zip(envs, sims)):
+            raw = restore_one(env, sim)
+            if raw is not None:
+                reset_obs[j] = raw
+        return ("ok", reset_obs), False
+    if cmd == "render":
+        return ("ok", envs[0].render()), False
+    if cmd == "close":
+        for env in envs:
+            env.close()
+        return ("ok",), True
+    return ("err", f"unknown command {cmd!r}"), False
 
 
 def _worker(conn, env_id: str, count: int, seed_base: int, kwargs: dict):
@@ -61,50 +199,10 @@ def _worker(conn, env_id: str, count: int, seed_base: int, kwargs: dict):
     command. Runs in a spawned interpreter; calls numpy + gymnasium only
     (never a jax API — see the module docstring's tunnel constraint)."""
     try:
-        import gymnasium
-
-        from trpo_tpu.envs.gym_state import restore_one, snapshot_one
-
-        # "package.module:attr" where attr is a class or factory callable
-        # constructs envs directly (no registry needed in the spawned
-        # interpreter — the overlap probe envs/sleep_env.py uses this).
-        # gymnasium's own documented "module:EnvId" form (import module,
-        # then make the REGISTERED id) takes precedence: the ctor path is
-        # only taken when, after importing the module, the id is absent
-        # from gymnasium's registry — otherwise a module-level callable
-        # that happens to share the registered id's name would silently
-        # bypass the registry's wrappers (TimeLimit, OrderEnforcing,
-        # spec-level kwargs). Anything that neither resolves to a callable
-        # nor registers falls through to gymnasium.make's own error.
-        env_ctor = None
-        if ":" in env_id:
-            import importlib
-
-            mod_name, attr = env_id.split(":", 1)
-            try:
-                obj = getattr(importlib.import_module(mod_name), attr)
-            except (ImportError, AttributeError):
-                obj = None
-            if callable(obj) and attr not in gymnasium.registry:
-                env_ctor = obj
-        if env_ctor is not None:
-            envs = [env_ctor(**kwargs) for _ in range(count)]
-        else:
-            envs = [gymnasium.make(env_id, **kwargs) for _ in range(count)]
-        single = envs[0]
-        space = single.action_space
-        if hasattr(space, "n"):
-            spec = ("discrete", int(space.n))
-            clip = None
-        else:
-            lo = np.asarray(space.low, np.float32)
-            hi = np.asarray(space.high, np.float32)
-            spec = ("box", int(space.shape[0]))
-            clip = (lo, hi)
-        obs0 = np.stack(
-            [env.reset(seed=seed_base + j)[0] for j, env in enumerate(envs)]
+        envs, spec, clip, obs_shape, obs0 = _construct_envs(
+            env_id, count, seed_base, kwargs
         )
-        conn.send(("ready", spec, tuple(single.observation_space.shape), obs0))
+        conn.send(("ready", spec, obs_shape, obs0))
     except Exception as e:  # pragma: no cover - construction failures
         import traceback
 
@@ -117,73 +215,90 @@ def _worker(conn, env_id: str, count: int, seed_base: int, kwargs: dict):
             msg = conn.recv()
         except EOFError:  # parent died — exit quietly
             break
-        cmd = msg[0]
         try:
-            if cmd == "step":
-                actions = msg[1]
-                m = len(envs)
-                next_obs = np.empty((m,) + obs0.shape[1:], obs0.dtype)
-                final_obs = np.empty_like(next_obs)
-                rewards = np.zeros(m, np.float32)
-                term = np.zeros(m, bool)
-                trunc = np.zeros(m, bool)
-                for j, env in enumerate(envs):
-                    a = actions[j]
-                    if clip is not None:
-                        a = np.clip(a, clip[0], clip[1])
-                    obs_j, r, tm, tr, _info = env.step(a)
-                    rewards[j] = r
-                    term[j] = tm
-                    trunc[j] = tr
-                    final_obs[j] = obs_j
-                    if tm or tr:
-                        obs_j, _ = env.reset()
-                    next_obs[j] = obs_j
-                conn.send(("ok", next_obs, rewards, term, trunc, final_obs))
-            elif cmd == "reset_all":
-                seed = msg[1]
-                obs = np.stack(
-                    [
-                        env.reset(
-                            seed=None if seed is None else seed + j
-                        )[0]
-                        for j, env in enumerate(envs)
-                    ]
-                )
-                conn.send(("ok", obs))
-            elif cmd == "snapshot":
-                conn.send(("ok", [snapshot_one(env) for env in envs]))
-            elif cmd == "restore":
-                sims = msg[1]
-                reset_obs = {}
-                for j, (env, sim) in enumerate(zip(envs, sims)):
-                    raw = restore_one(env, sim)
-                    if raw is not None:
-                        reset_obs[j] = raw
-                conn.send(("ok", reset_obs))
-            elif cmd == "render":
-                conn.send(("ok", envs[0].render()))
-            elif cmd == "close":
-                for env in envs:
-                    env.close()
-                conn.send(("ok",))
-                break
-            else:
-                conn.send(("err", f"unknown command {cmd!r}"))
+            reply, close = _serve(envs, clip, obs0, msg)
         except Exception as e:
             import traceback
 
-            conn.send(("err", f"{type(e).__name__}: {e}\n"
-                       f"{traceback.format_exc()}"))
+            reply, close = (
+                ("err", f"{type(e).__name__}: {e}\n"
+                 f"{traceback.format_exc()}"),
+                False,
+            )
+        conn.send(reply)
+        if close:
+            break
+
+
+class _LocalConn:
+    """In-process stand-in for a worker's pipe endpoint — the degraded
+    mode supervision falls back to once a worker slice has exhausted
+    ``max_worker_restarts`` (``resilience/supervisor.py``).
+
+    Speaks the exact connection surface the parent uses (``send`` /
+    ``poll`` / ``recv`` / ``close``), executing each command synchronously
+    in the parent via the SAME :func:`_construct_envs`/:func:`_serve` the
+    worker body runs — data stays correct, the slice merely loses process
+    parallelism. Construction mirrors the worker handshake: the first
+    ``recv`` returns the ``ready`` message."""
+
+    def __init__(self, env_id: str, count: int, seed_base: int,
+                 kwargs: dict):
+        self._envs, spec, self._clip, obs_shape, self._obs0 = (
+            _construct_envs(env_id, count, seed_base, kwargs)
+        )
+        self._pending: deque = deque(
+            [("ready", spec, obs_shape, self._obs0)]
+        )
+        self._closed = False
+
+    def send(self, msg) -> None:
+        if self._closed:
+            raise BrokenPipeError("local env slice is closed")
+        try:
+            reply, close = _serve(self._envs, self._clip, self._obs0, msg)
+        except Exception as e:
+            import traceback
+
+            reply, close = (
+                ("err", f"{type(e).__name__}: {e}\n"
+                 f"{traceback.format_exc()}"),
+                False,
+            )
+        self._pending.append(reply)
+        if close:
+            self._closed = True
+
+    def poll(self, timeout=None) -> bool:
+        return bool(self._pending)
+
+    def recv(self):
+        if not self._pending:
+            raise EOFError("no pending reply on local env slice")
+        return self._pending.popleft()
+
+    def close(self) -> None:
+        if not self._closed:
+            for env in self._envs:
+                env.close()
+            self._closed = True
 
 
 class ProcVecEnv(EpisodeStatsMixin, ObsNormMixin):
     """N gymnasium envs over W worker processes — GymVecEnv's surface."""
 
     def __init__(self, env_id: str, n_envs: int = 8, seed: int = 0,
-                 normalize_obs: bool = False, n_workers=None, **kwargs):
+                 normalize_obs: bool = False, n_workers=None,
+                 step_timeout=None, **kwargs):
+        """``step_timeout`` (seconds, None = wait forever — the
+        pre-round-7 behavior): how long any reply gather waits on a
+        worker before declaring it dead with :class:`WorkerDiedError`.
+        Without it a worker killed mid-episode hangs ``host_step``
+        forever; with it the error names the worker and the last action
+        so supervision (``resilience/supervisor.py``) can restart it."""
         self.env_id = env_id
         self.n_envs = n_envs
+        self.step_timeout = step_timeout
         if n_workers is None:
             n_workers = max(1, min(n_envs, os.cpu_count() or 1))
         if not 1 <= n_workers <= n_envs:
@@ -201,46 +316,23 @@ class ProcVecEnv(EpisodeStatsMixin, ObsNormMixin):
             self._slices.append((lo, hi))
             lo = hi
 
-        ctx = mp.get_context("spawn")  # clean interpreters: no forked jax
-        self._conns, self._procs = [], []
-        # spawn re-runs __main__ from its __file__ in the child; a parent
-        # driven from stdin/REPL has __file__ == "<stdin>", which the
-        # child fails to re-open. The worker needs nothing from __main__,
-        # so hide a non-existent __file__ for the duration of the starts.
-        import sys
+        # restart_worker respawns a slice with exactly its construction-
+        # time arguments (seed + lo reseeds the fresh episodes the way the
+        # initial start did — deterministic, test-pinnable)
+        self._seed = seed
+        self._kwargs = dict(kwargs)
+        self._last_actions: dict = {}
 
-        main_mod = sys.modules.get("__main__")
-        main_file = getattr(main_mod, "__file__", None)
-        hide_main = main_file is not None and not os.path.exists(main_file)
-        if hide_main:
-            del main_mod.__file__
+        self._conns, self._procs = [], []
         try:
-            try:
-                for (lo, hi) in self._slices:
-                    parent, child = ctx.Pipe()
-                    p = ctx.Process(
-                        target=_worker,
-                        args=(
-                            child, env_id, hi - lo, seed + lo, dict(kwargs)
-                        ),
-                        daemon=True,
-                    )
-                    p.start()
-                    child.close()
-                    self._conns.append(parent)
-                    self._procs.append(p)
-            finally:
-                if hide_main:
-                    main_mod.__file__ = main_file
+            for w in range(n_workers):
+                conn, p = self._spawn_worker(w)
+                self._conns.append(conn)
+                self._procs.append(p)
             obs_parts = []
             spec = obs_shape = None
-            for conn in self._conns:
-                msg = conn.recv()
-                if msg[0] != "ready":
-                    raise RuntimeError(
-                        f"ProcVecEnv worker failed to start:\n{msg[1]}"
-                    )
-                _, spec, obs_shape, obs0 = msg
+            for w, conn in enumerate(self._conns):
+                _, spec, obs_shape, obs0 = self._recv_ready(conn, w)
                 obs_parts.append(obs0)
         except Exception:
             self.close()
@@ -260,18 +352,122 @@ class ProcVecEnv(EpisodeStatsMixin, ObsNormMixin):
         self._obs = self._fold_and_normalize(np.concatenate(obs_parts))
         self._init_episode_stats(n_envs)
 
+    # -- worker lifecycle --------------------------------------------------
+
+    def _spawn_worker(self, w: int):
+        """Start a fresh worker process for slice ``w``; returns
+        ``(parent_conn, process)``. The ready handshake is the caller's
+        (``_recv_ready``) so construction can overlap across workers."""
+        lo, hi = self._slices[w]
+        ctx = mp.get_context("spawn")  # clean interpreters: no forked jax
+        # spawn re-runs __main__ from its __file__ in the child; a parent
+        # driven from stdin/REPL has __file__ == "<stdin>", which the
+        # child fails to re-open. The worker needs nothing from __main__,
+        # so hide a non-existent __file__ for the duration of the start.
+        import sys
+
+        main_mod = sys.modules.get("__main__")
+        main_file = getattr(main_mod, "__file__", None)
+        hide_main = main_file is not None and not os.path.exists(main_file)
+        if hide_main:
+            del main_mod.__file__
+        try:
+            parent, child = ctx.Pipe()
+            p = ctx.Process(
+                target=_worker,
+                args=(
+                    child, self.env_id, hi - lo, self._seed + lo,
+                    dict(self._kwargs),
+                ),
+                daemon=True,
+            )
+            p.start()
+            child.close()
+        finally:
+            if hide_main:
+                main_mod.__file__ = main_file
+        return parent, p
+
+    def _recv_ready(self, conn, w: int):
+        """Consume a worker's ``ready`` handshake (no step_timeout here:
+        construction legitimately takes longer than a step — interpreter
+        spawn + imports)."""
+        try:
+            msg = conn.recv()
+        except (EOFError, ConnectionError, OSError) as e:
+            raise WorkerDiedError(w, self.env_id) from e
+        if msg[0] != "ready":
+            raise RuntimeError(
+                f"ProcVecEnv worker failed to start:\n{msg[1]}"
+            )
+        return msg
+
+    def restart_worker(self, w: int, local: bool = False) -> None:
+        """Replace worker ``w`` with a fresh process (``local=True``: an
+        in-process :class:`_LocalConn` slice — supervision's degraded
+        mode) after killing whatever is left of the old one.
+
+        Episode-restart semantics — the same contract as a ``gym:``
+        resume without a usable sidecar (``utils/checkpoint.py``): the
+        slice's envs are reconstructed and reseeded exactly as at
+        construction (``seed + lo``), their fresh reset observations fold
+        into the shared normalization statistics (a reset does), and the
+        slice's running episode accumulators zero. Whatever the old
+        worker was mid-episode on is lost — that is the fault model, not
+        a bug."""
+        lo, hi = self._slices[w]
+        p = self._procs[w]
+        if p is not None:
+            try:
+                p.kill()  # SIGKILL: also takes down a SIGSTOPped hang
+                p.join(timeout=5)
+            except (OSError, ValueError):  # pragma: no cover
+                pass
+        try:
+            self._conns[w].close()
+        except OSError:  # pragma: no cover
+            pass
+        self._last_actions.pop(w, None)
+        if local:
+            conn = _LocalConn(
+                self.env_id, hi - lo, self._seed + lo, dict(self._kwargs)
+            )
+            proc = None
+            msg = conn.recv()
+        else:
+            conn, proc = self._spawn_worker(w)
+            msg = self._recv_ready(conn, w)
+        self._conns[w] = conn
+        self._procs[w] = proc
+        obs0 = msg[3]
+        self._obs[lo:hi] = self._fold_and_normalize_slice(obs0, lo, hi)
+        self._running_returns[lo:hi] = 0.0
+        self._running_lengths[lo:hi] = 0
+
+    def is_local_worker(self, w: int) -> bool:
+        """True when slice ``w`` runs in-process (degraded mode)."""
+        return isinstance(self._conns[w], _LocalConn)
+
     # -- worker RPC --------------------------------------------------------
 
-    def _call(self, w: int, *msg):
-        self._conns[w].send(msg)
-
-    def _reply(self, w: int):
-        msg = self._conns[w].recv()
-        if msg[0] != "ok":
-            raise RuntimeError(
-                f"ProcVecEnv worker {w} ({self.env_id}):\n{msg[1]}"
-            )
-        return msg[1:]
+    def _recv(self, w: int):
+        """One reply from worker ``w``, honoring ``step_timeout``. EOF or
+        a timeout becomes :class:`WorkerDiedError` naming the worker and
+        the last action batch it was sent."""
+        conn = self._conns[w]
+        try:
+            if self.step_timeout is not None and not conn.poll(
+                self.step_timeout
+            ):
+                raise WorkerDiedError(
+                    w, self.env_id, kind="timeout",
+                    last_action=self._last_actions.get(w),
+                )
+            return conn.recv()
+        except (EOFError, ConnectionError, OSError) as e:
+            raise WorkerDiedError(
+                w, self.env_id, last_action=self._last_actions.get(w)
+            ) from e
 
     def _reply_all(self, ws):
         """Gather one reply from EVERY worker in ``ws`` before raising.
@@ -280,17 +476,67 @@ class ProcVecEnv(EpisodeStatsMixin, ObsNormMixin):
         queued replies unconsumed, permanently desyncing the pipe protocol
         — a caller that caught the error would then read a stale step
         reply as the answer to its next command. Drain first, then report
-        every failure."""
-        replies, errors = {}, []
+        every failure. Dead/hung workers outrank error replies: they
+        surface as one :class:`WorkerDiedError` carrying every casualty,
+        so supervision can revive them all in one pass."""
+        replies, errors, dead = {}, [], []
+        first_died = None
         for w in ws:
-            msg = self._conns[w].recv()
+            try:
+                msg = self._recv(w)
+            except WorkerDiedError as e:
+                dead.append(w)
+                first_died = first_died or e
+                continue
             if msg[0] != "ok":
                 errors.append(f"worker {w}:\n{msg[1]}")
             else:
                 replies[w] = msg[1:]
+        if dead:
+            raise WorkerDiedError(
+                dead[0], self.env_id, kind=first_died.kind,
+                last_action=first_died.last_action, workers=dead,
+            )
         if errors:
             raise RuntimeError(
                 f"ProcVecEnv ({self.env_id}):\n" + "\n".join(errors)
+            )
+        return replies
+
+    def _scatter_gather(self, msgs: dict):
+        """Send every command in ``msgs`` (worker → message tuple), then
+        gather every reply, converting send failures into the same
+        :class:`WorkerDiedError` the gather raises.
+
+        Send failures must NOT abort mid-scatter: workers already sent to
+        would be left with unconsumed replies, desyncing the protocol for
+        a caller (supervision) that revives the casualty and retries.
+        Every live worker is therefore sent to and drained first; only
+        then do the casualties surface — together."""
+        dead, sent = [], []
+        first_died = None
+        for w, msg in msgs.items():
+            try:
+                self._conns[w].send(msg)
+                if msg[0] == "step":
+                    self._last_actions[w] = msg[1]
+                sent.append(w)
+            except (BrokenPipeError, ConnectionError, OSError):
+                dead.append(w)
+        try:
+            replies = self._reply_all(sent)
+        except WorkerDiedError as e:
+            if dead:
+                raise WorkerDiedError(
+                    min(e.workers + dead), self.env_id, kind=e.kind,
+                    last_action=e.last_action,
+                    workers=sorted(set(e.workers) | set(dead)),
+                ) from e
+            raise
+        if dead:
+            raise WorkerDiedError(
+                dead[0], self.env_id,
+                last_action=self._last_actions.get(dead[0]), workers=dead,
             )
         return replies
 
@@ -328,15 +574,16 @@ class ProcVecEnv(EpisodeStatsMixin, ObsNormMixin):
                     "host_step"
                 )
         # scatter everything first: workers step in parallel
-        for w, _, (ga, gb) in parts:
-            self._call(w, "step", actions[ga - lo: gb - lo])
         m = hi - lo
         next_obs = np.empty((m,) + self._obs.shape[1:], self._obs.dtype)
         final_obs = np.empty_like(next_obs)
         rewards = np.zeros(m, np.float32)
         terminated = np.zeros(m, bool)
         truncated = np.zeros(m, bool)
-        replies = self._reply_all([w for w, _, _ in parts])
+        replies = self._scatter_gather({
+            w: ("step", actions[ga - lo: gb - lo])
+            for w, _, (ga, gb) in parts
+        })
         for w, _, (ga, gb) in parts:
             o, r, tm, tr, f = replies[w]
             s = slice(ga - lo, gb - lo)
@@ -356,11 +603,10 @@ class ProcVecEnv(EpisodeStatsMixin, ObsNormMixin):
         return next_obs, rewards, terminated, truncated, final_obs
 
     def reset_all(self, seed=None) -> np.ndarray:
-        for w, (wlo, _) in enumerate(self._slices):
-            self._call(
-                w, "reset_all", None if seed is None else seed + wlo
-            )
-        replies = self._reply_all(range(self.n_workers))
+        replies = self._scatter_gather({
+            w: ("reset_all", None if seed is None else seed + wlo)
+            for w, (wlo, _) in enumerate(self._slices)
+        })
         obs = np.concatenate(
             [replies[w][0] for w in range(self.n_workers)]
         )
@@ -375,9 +621,9 @@ class ProcVecEnv(EpisodeStatsMixin, ObsNormMixin):
     # -- checkpoint sidecar (same schema as GymVecEnv: cross-restorable) ---
 
     def env_state_snapshot(self) -> dict:
-        for w in range(self.n_workers):
-            self._call(w, "snapshot")
-        replies = self._reply_all(range(self.n_workers))
+        replies = self._scatter_gather({
+            w: ("snapshot",) for w in range(self.n_workers)
+        })
         sims = []
         for w in range(self.n_workers):
             sims.extend(replies[w][0])
@@ -407,9 +653,10 @@ class ProcVecEnv(EpisodeStatsMixin, ObsNormMixin):
                 "snapshot was taken without normalize_obs; resume with "
                 "the same normalize_obs setting"
             )
-        for w, (wlo, whi) in enumerate(self._slices):
-            self._call(w, "restore", list(snap["sims"][wlo:whi]))
-        replies = self._reply_all(range(self.n_workers))
+        replies = self._scatter_gather({
+            w: ("restore", list(snap["sims"][wlo:whi]))
+            for w, (wlo, whi) in enumerate(self._slices)
+        })
         reset_obs = {}
         for w, (wlo, _) in enumerate(self._slices):
             for j, raw in replies[w][0].items():
@@ -430,8 +677,7 @@ class ProcVecEnv(EpisodeStatsMixin, ObsNormMixin):
 
     def render_frame(self) -> np.ndarray:
         """RGB frame of env 0 (worker 0) — same contract as GymVecEnv."""
-        self._call(0, "render")
-        frame = self._reply(0)[0]
+        frame = self._scatter_gather({0: ("render",)})[0][0]
         if frame is None:
             raise RuntimeError(
                 "rendering returned None — construct ProcVecEnv with "
@@ -446,9 +692,13 @@ class ProcVecEnv(EpisodeStatsMixin, ObsNormMixin):
             except (BrokenPipeError, OSError):
                 pass
         for w, p in enumerate(getattr(self, "_procs", [])):
+            if p is None:  # in-process degraded slice: nothing to join
+                continue
             p.join(timeout=5)
             if p.is_alive():  # pragma: no cover
-                p.terminate()
+                # SIGKILL, not SIGTERM: a SIGSTOPped (hung) worker leaves
+                # SIGTERM pending forever and would outlive the parent
+                p.kill()
         for conn in getattr(self, "_conns", []):
             try:
                 conn.close()

@@ -26,6 +26,14 @@ Kinds:
 * ``recompile`` — one XLA compilation observed by the recompile monitor
   (``obs/recompile.py``), flagged ``unexpected`` when it happened after
   the run was marked steady.
+* ``fault_injected`` — one fault fired by the chaos injector
+  (``resilience/inject.py``): kind, trigger point, the exact spec. A
+  chaos run's event log is self-auditing — ``scripts/validate_events.py``
+  checks every injected fault produced a matching detection/recovery
+  record downstream.
+* ``recovery`` — one recovery action taken by the resilience subsystem
+  (``resilience/recovery.py``): what was done (``action``), why
+  (``reason``), at which iteration.
 
 Sinks are append-only and flush-on-write; the JSONL sink repairs a
 crash-truncated final line on open (``utils/metrics.repair_jsonl_tail``),
@@ -90,6 +98,17 @@ _REQUIRED = {
         "program": lambda v: isinstance(v, str) and v,
         "count": lambda v: isinstance(v, int) and not isinstance(v, bool),
         "unexpected": lambda v: isinstance(v, bool),
+    },
+    "fault_injected": {
+        "fault": lambda v: isinstance(v, str) and v,
+        "at": lambda v: isinstance(v, int) and not isinstance(v, bool),
+        "spec": lambda v: isinstance(v, str) and v,
+    },
+    "recovery": {
+        "action": lambda v: isinstance(v, str) and v,
+        "reason": lambda v: isinstance(v, str) and v,
+        "iteration": lambda v: isinstance(v, int)
+        and not isinstance(v, bool),
     },
 }
 

@@ -1,0 +1,55 @@
+"""Resilience subsystem (ISSUE 4): make every production failure mode
+either survivable or cleanly resumable, and make each one INJECTABLE so
+the recovery paths are test-pinned rather than faith-based.
+
+Four pieces, spanning the env layer, both training drivers, and the
+checkpoint path (see ``ARCHITECTURE.md`` "Resilience" for the fault
+model table):
+
+* ``inject``     — seeded, typed fault injection (worker kill/hang, step
+  delay, NaN-poisoned update, SIGTERM), every firing a ``fault_injected``
+  event.
+* ``supervisor`` — env-worker supervision: recv timeouts →
+  ``WorkerDiedError`` → restart with backoff → in-process degradation →
+  configurable abort floor.
+* ``recovery``   — update-level recovery: last-good TrainState snapshot
+  (donation-aware), restore + skip the poisoned batch + damping
+  escalation, abort after ``max_recoveries`` consecutive failures.
+* ``preempt``    — SIGTERM/SIGINT → drain → final checkpoint + sidecar →
+  distinct requeue exit code; the save-integrity gate lives in
+  ``utils/checkpoint.py``.
+"""
+
+from trpo_tpu.envs.proc_env import WorkerDiedError  # noqa: F401
+from trpo_tpu.resilience.inject import (  # noqa: F401
+    FaultInjector,
+    FaultSpec,
+    parse_fault_specs,
+)
+from trpo_tpu.resilience.preempt import (  # noqa: F401
+    Preempted,
+    PreemptionGuard,
+)
+from trpo_tpu.resilience.recovery import (  # noqa: F401
+    RecoveryPolicy,
+    TrainingDiverged,
+)
+from trpo_tpu.resilience.supervisor import (  # noqa: F401
+    SupervisedEnv,
+    SupervisionConfig,
+    WorkerPoolError,
+)
+
+__all__ = [
+    "FaultInjector",
+    "FaultSpec",
+    "parse_fault_specs",
+    "Preempted",
+    "PreemptionGuard",
+    "RecoveryPolicy",
+    "TrainingDiverged",
+    "SupervisedEnv",
+    "SupervisionConfig",
+    "WorkerPoolError",
+    "WorkerDiedError",
+]

@@ -1,0 +1,260 @@
+"""Deterministic fault injection — the chaos harness the resilience
+subsystem is exercised by (ISSUE 4 tentpole piece 1).
+
+Production failures are rare and irreproducible; a recovery path only
+exercised by real failures is a recovery path that has never been tested.
+This module makes every failure mode the subsystem handles INJECTABLE on
+demand, from a typed, config-driven spec string, so chaos runs are
+reproducible and test-pinnable:
+
+* ``kill_worker@step=K:worker=W``  — SIGKILL a ``proc_env`` worker just
+  before the K-th host env step (the pipe EOFs; supervision restarts it).
+* ``hang_worker@step=K:worker=W``  — SIGSTOP the worker instead: it stays
+  alive but silent, exercising the ``step_timeout`` detection path.
+* ``delay_step@step=K:seconds=S``  — sleep S seconds before the K-th host
+  step (latency spike; nothing should break, pipelines should absorb it).
+* ``nan_update@iter=N``            — poison the policy parameters with NaN
+  just before iteration N runs, so the update's nonfinite guard trips and
+  the recovery policy (``resilience/recovery.py``) has something to
+  recover from.
+* ``sigterm@iter=N``               — deliver SIGTERM to the training
+  process just before iteration N runs (a preemption notice), exercising
+  the drain → checkpoint → requeue-exit path.
+
+Specs are ``;``-separated; each fires EXACTLY ONCE (a recovery that
+re-runs the target iteration re-runs it clean — which is what lets the
+chaos suite pin bit-exact continuation against an unfaulted run). Every
+fired fault is emitted on the PR 3 event bus as a ``fault_injected``
+record, so ``scripts/validate_events.py`` can check that each injected
+fault produced a matching detection/recovery record downstream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import time
+from typing import Optional, Tuple
+
+__all__ = ["FaultSpec", "FaultInjector", "parse_fault_specs"]
+
+# fault kind -> (trigger key, is_env_level)
+_KINDS = {
+    "kill_worker": ("step", True),
+    "hang_worker": ("step", True),
+    "delay_step": ("step", True),
+    "nan_update": ("iter", False),
+    "sigterm": ("iter", False),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One injectable fault: what (``kind``), when (``at`` — a 1-based
+    host env step for env-level faults, a 1-based absolute training
+    iteration for update-level ones), and the kind-specific parameters."""
+
+    kind: str
+    at: int
+    worker: int = 0
+    seconds: float = 0.25
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; have {sorted(_KINDS)}"
+            )
+        if self.at < 1:
+            raise ValueError(
+                f"{self.kind}: trigger point must be >= 1, got {self.at}"
+            )
+        if self.worker < 0:
+            raise ValueError(f"{self.kind}: worker must be >= 0")
+        if self.seconds < 0:
+            raise ValueError(f"{self.kind}: seconds must be >= 0")
+
+    @property
+    def env_level(self) -> bool:
+        return _KINDS[self.kind][1]
+
+    def __str__(self) -> str:
+        key = _KINDS[self.kind][0]
+        extra = ""
+        if self.kind in ("kill_worker", "hang_worker"):
+            extra = f":worker={self.worker}"
+        elif self.kind == "delay_step":
+            extra = f":seconds={self.seconds:g}"
+        return f"{self.kind}@{key}={self.at}{extra}"
+
+
+def parse_fault_specs(spec: str) -> Tuple[FaultSpec, ...]:
+    """Parse a ``;``-separated fault-spec string (grammar above). Raises
+    ``ValueError`` with the offending fragment on any mistake — a chaos
+    run with a silently dropped fault would "pass" by testing nothing."""
+    out = []
+    for frag in spec.split(";"):
+        frag = frag.strip()
+        if not frag:
+            continue
+        if "@" not in frag:
+            raise ValueError(
+                f"fault spec {frag!r}: expected kind@key=value[:key=value]"
+            )
+        kind, _, rest = frag.partition("@")
+        kind = kind.strip()
+        if kind not in _KINDS:
+            raise ValueError(
+                f"fault spec {frag!r}: unknown kind {kind!r} "
+                f"(have {sorted(_KINDS)})"
+            )
+        trigger_key = _KINDS[kind][0]
+        fields = {}
+        for pair in rest.split(":"):
+            key, eq, value = pair.partition("=")
+            key = key.strip()
+            if not eq:
+                raise ValueError(
+                    f"fault spec {frag!r}: expected key=value, got {pair!r}"
+                )
+            fields[key] = value.strip()
+        if trigger_key not in fields:
+            raise ValueError(
+                f"fault spec {frag!r}: {kind} needs {trigger_key}=N "
+                f"({'host env step' if trigger_key == 'step' else 'iteration'})"
+            )
+        try:
+            at = int(fields.pop(trigger_key))
+            worker = int(fields.pop("worker", 0))
+            seconds = float(fields.pop("seconds", 0.25))
+        except ValueError as e:
+            raise ValueError(f"fault spec {frag!r}: {e}") from None
+        if fields:
+            raise ValueError(
+                f"fault spec {frag!r}: unknown keys {sorted(fields)}"
+            )
+        out.append(FaultSpec(kind=kind, at=at, worker=worker,
+                             seconds=seconds))
+    if not out:
+        raise ValueError(f"fault spec {spec!r} contains no faults")
+    return tuple(out)
+
+
+class FaultInjector:
+    """Fires :class:`FaultSpec` s at their trigger points.
+
+    Two hook sites, matching the two fault levels:
+
+    * :meth:`on_env_step` — called by the supervised env wrapper
+      (``resilience/supervisor.py``) with the running host-step count and
+      the RAW ``ProcVecEnv`` (whose worker processes the kill/hang specs
+      signal).
+    * :meth:`before_iteration` — called by both training drivers with the
+      absolute 1-based iteration about to run (and, for fused device
+      chunks, the chunk ``span``); returns the — possibly NaN-poisoned —
+      TrainState to use.
+
+    Each spec fires once (see module docstring); every firing emits a
+    ``fault_injected`` event when a bus is attached.
+    """
+
+    def __init__(self, specs, bus=None):
+        self.specs = tuple(specs)
+        self.bus = bus
+        self._fired: set = set()
+
+    @classmethod
+    def from_spec(cls, spec: str, bus=None) -> "FaultInjector":
+        return cls(parse_fault_specs(spec), bus=bus)
+
+    @property
+    def all_fired(self) -> bool:
+        return len(self._fired) == len(self.specs)
+
+    @property
+    def unfired(self) -> Tuple[str, ...]:
+        """Spec strings that never fired — a completed chaos run with
+        any of these tested nothing and should say so loudly."""
+        return tuple(
+            str(s) for i, s in enumerate(self.specs) if i not in self._fired
+        )
+
+    def _emit(self, spec: FaultSpec, **data) -> None:
+        if self.bus is not None:
+            self.bus.emit(
+                "fault_injected", fault=spec.kind, at=spec.at,
+                spec=str(spec), **data,
+            )
+
+    # -- env level ---------------------------------------------------------
+
+    def on_env_step(self, step_idx: int, env) -> None:
+        """Fire env-level faults due at host step ``step_idx`` (1-based,
+        counted by the supervised wrapper). ``env`` is the raw
+        ``ProcVecEnv``; kill/hang specs signal its worker processes
+        directly — exactly what a crashed/hung simulator looks like from
+        the parent."""
+        for i, s in enumerate(self.specs):
+            if i in self._fired or not s.env_level or s.at != step_idx:
+                continue
+            if s.kind == "delay_step":
+                self._fired.add(i)
+                time.sleep(s.seconds)
+                self._emit(s, seconds=s.seconds)
+                continue
+            procs = getattr(env, "_procs", None)
+            if procs is None or s.worker >= len(procs):
+                raise ValueError(
+                    f"fault {s}: env has no worker {s.worker} to target"
+                )
+            proc = procs[s.worker]
+            if proc is None:
+                # already degraded to in-process: nothing to signal.
+                # NOT marked fired — the end-of-run unfired warning must
+                # report the spec instead of the run passing silently
+                continue
+            self._fired.add(i)
+            sig = (
+                signal.SIGKILL
+                if s.kind == "kill_worker"
+                else signal.SIGSTOP
+            )
+            os.kill(proc.pid, sig)
+            self._emit(s, worker=s.worker, pid=proc.pid)
+
+    # -- update level ------------------------------------------------------
+
+    def before_iteration(self, iteration: int, state, span: int = 1):
+        """Fire update-level faults due inside iterations
+        ``[iteration, iteration + span)`` (``span`` > 1 = a fused device
+        chunk: the fault lands at the chunk boundary, the finest
+        granularity the one-program design exposes). Returns the state —
+        with every floating-point policy-parameter leaf NaN-poisoned when
+        a ``nan_update`` fired."""
+        for i, s in enumerate(self.specs):
+            if (
+                i in self._fired
+                or s.env_level
+                or not iteration <= s.at < iteration + span
+            ):
+                continue
+            self._fired.add(i)
+            if s.kind == "sigterm":
+                self._emit(s, pid=os.getpid())
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif s.kind == "nan_update":
+                import jax
+                import jax.numpy as jnp
+
+                def poison(x):
+                    if jnp.issubdtype(x.dtype, jnp.floating):
+                        return jnp.full_like(x, jnp.nan)
+                    return x
+
+                state = state._replace(
+                    policy_params=jax.tree_util.tree_map(
+                        poison, state.policy_params
+                    )
+                )
+                self._emit(s, iteration=s.at)
+        return state

@@ -1,0 +1,178 @@
+"""Update-level recovery policy (ISSUE 4 tentpole piece 4).
+
+The reference's answer to a numerically poisoned update is ``exit(-1)``
+(``trpo_inksci.py:172-173``); ours until now was a raised
+``FloatingPointError`` (``agent._finish_iteration_stats``) — better
+manners, same outcome: hours of training die to one bad batch. PR 3's
+telemetry already *detects* the poisoning a full drain-latency early
+(the device-side ``nan_guard`` in ``TRPOStats``, the NaN-entropy health
+rule); this module closes the loop with ``cfg.recover_on_nan="restore"``:
+
+* Each iteration, the driver parks a **last-good snapshot** of the
+  TrainState with :meth:`RecoveryPolicy.snapshot` — a donation-aware
+  ``jnp.copy`` of every leaf, taken BEFORE the donated update consumes
+  the buffers (the donation contract in ``agent.py`` means the passed
+  state is dead after dispatch; the copy is the only thing that can be
+  restored). Device-side copies off the host path; a bounded window of
+  snapshots is kept so the async driver can rewind past its pipeline
+  depth.
+* When a drained stats row shows a nonfinite update (NaN entropy or the
+  device ``nan_guard``), the detection site :meth:`flag` s the iteration
+  (thread-safe — the async driver detects on the drain thread) and the
+  driver :meth:`recover` s on its own thread: restore the snapshot, skip
+  the poisoned batch (host envs march on, so the retried iteration sees
+  fresh data; device envs re-run the same program — which is what lets
+  the chaos suite pin bit-exact continuation when the poison was
+  injected), and escalate ``cg_damping`` through the existing
+  ``adaptive_damping`` state when it is active (a genuinely
+  ill-conditioned Fisher is the most common organic cause).
+* After ``cfg.max_recoveries`` CONSECUTIVE failures the policy raises
+  :class:`TrainingDiverged` (a ``FloatingPointError``, so existing abort
+  handling catches it unchanged): a state that cannot produce one clean
+  update is diverged, not unlucky.
+
+Every recovery emits a ``recovery`` event on the PR 3 bus.
+``recover_on_nan="off"`` (default) never constructs this object — the
+abort path stays byte-identical to PR 3.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+__all__ = ["RecoveryPolicy", "TrainingDiverged"]
+
+
+class TrainingDiverged(FloatingPointError):
+    """Consecutive recoveries exhausted — the run is numerically dead.
+    Subclasses ``FloatingPointError`` so callers of the historical
+    NaN-entropy abort catch this identically."""
+
+
+class RecoveryPolicy:
+    def __init__(self, cfg, bus=None):
+        self.cfg = cfg
+        self.bus = bus
+        # a bounded window of (iteration -> pre-update snapshot): the
+        # async driver detects up to pipeline-depth iterations late, so
+        # the snapshot the flagged iteration needs may not be the newest
+        self._keep = max(2, int(getattr(cfg, "stats_drain_maxsize", 2)) + 2)
+        self._snaps: dict = {}
+        self._lock = threading.Lock()
+        self._pending: Optional[Tuple[int, str]] = None
+        # iteration of the last recover()ed flag: only a clean row AT or
+        # PAST it proves the recovery produced a clean update (a fused
+        # chunk's re-run reproduces its clean PREFIX rows bit-exactly —
+        # letting those reset the counter would make a deterministic
+        # mid-chunk NaN restore forever instead of diverging)
+        self._last_flagged: Optional[int] = None
+        self.consecutive = 0
+        self.total_recoveries = 0
+
+    # -- driver side -------------------------------------------------------
+
+    def snapshot(self, iteration: int, state) -> None:
+        """Park a copy of ``state`` as the last-good restore point for
+        ``iteration`` (the 1-based iteration about to run). MUST be
+        called before the state is handed to a donating update — and
+        before the fault injector gets a chance to poison it."""
+        import jax
+        import jax.numpy as jnp
+
+        snap = jax.tree_util.tree_map(jnp.copy, state)
+        with self._lock:
+            self._snaps[iteration] = snap
+            while len(self._snaps) > self._keep:
+                del self._snaps[min(self._snaps)]
+
+    def mark_clean(self, iteration: int) -> None:
+        """A healthy stats row for ``iteration`` drained: reset the
+        consecutive counter — but only when no flag is pending (a finite
+        row drained between :meth:`flag` and :meth:`recover` descends
+        from the state being rewound — it proves nothing) and the row is
+        at or past the last flagged iteration (see ``_last_flagged``)."""
+        with self._lock:
+            if self._pending is not None:
+                return
+            if self._last_flagged is None or iteration >= self._last_flagged:
+                self.consecutive = 0
+
+    @property
+    def pending(self) -> Optional[Tuple[int, str]]:
+        """(iteration, reason) awaiting :meth:`recover`, or None."""
+        with self._lock:
+            return self._pending
+
+    # -- detection side (may run on the drain thread) ----------------------
+
+    def flag(self, iteration: int, reason: str) -> None:
+        """Record that ``iteration``'s stats row showed a nonfinite
+        update. First flag wins: rows drained AFTER a poisoned one are
+        its descendants (computed from the poisoned state) — recovery
+        rewinds past all of them at once."""
+        with self._lock:
+            if self._pending is None:
+                self._pending = (iteration, reason)
+                # recorded HERE, not in recover(): rows drained between
+                # the flag and the recovery must already be gated
+                self._last_flagged = iteration
+
+    # -- the recovery itself (driver thread only) --------------------------
+
+    def recover(self):
+        """Restore the newest snapshot at or before the flagged
+        iteration. Returns ``(snapshot_iteration, restored_state)`` —
+        the driver rewinds its counters to re-run from there. Raises
+        :class:`TrainingDiverged` once ``max_recoveries`` consecutive
+        recoveries have not produced a clean row."""
+        with self._lock:
+            iteration, reason = self._pending
+            self._pending = None
+            keys = [k for k in self._snaps if k <= iteration]
+            snap = self._snaps[max(keys)] if keys else None
+            at = max(keys) if keys else None
+        self.consecutive += 1
+        self.total_recoveries += 1
+        if self.consecutive > self.cfg.max_recoveries:
+            raise TrainingDiverged(
+                f"nonfinite update at iteration {iteration} ({reason}) — "
+                f"{self.cfg.max_recoveries} consecutive recoveries "
+                "exhausted; aborting training"
+            )
+        if snap is None:  # pragma: no cover — driver always snapshots
+            raise TrainingDiverged(
+                f"nonfinite update at iteration {iteration} ({reason}) "
+                "with no snapshot to restore"
+            )
+        import jax
+        import jax.numpy as jnp
+
+        # hand out a COPY: the stored snapshot must survive the restored
+        # state being donated to the retried update (which may fail too)
+        state = jax.tree_util.tree_map(jnp.copy, snap)
+        escalated = None
+        if state.cg_damping is not None:
+            # reuse the adaptive-damping state: a recovery is the
+            # strongest possible "this step was bad" feedback signal
+            escalated = float(
+                min(
+                    float(state.cg_damping) * self.cfg.damping_grow,
+                    self.cfg.damping_max,
+                )
+            )
+            state = state._replace(
+                cg_damping=jnp.float32(escalated)
+            )
+        if self.bus is not None:
+            self.bus.emit(
+                "recovery",
+                action="restore",
+                reason=reason,
+                iteration=iteration,
+                restored_to=at,
+                consecutive=self.consecutive,
+                total=self.total_recoveries,
+                cg_damping=escalated,
+            )
+        return at, state

@@ -1,0 +1,237 @@
+"""Env-worker supervision (ISSUE 4 tentpole piece 2).
+
+``ProcVecEnv`` (PR 4's satellite fix) now *detects* a dead or hung worker
+— ``WorkerDiedError`` instead of an eternal ``recv`` — but detection
+alone still kills the run. :class:`SupervisedEnv` closes that loop: it
+wraps the pool, catches :class:`~trpo_tpu.envs.proc_env.WorkerDiedError`
+from any env operation, and revives the casualty:
+
+1. **Restart with backoff** — kill whatever is left of the worker, wait
+   ``backoff_base · 2^(attempt-1)`` (capped at ``backoff_max``), respawn
+   the slice (``ProcVecEnv.restart_worker``: fresh envs, construction
+   seeding, episode-restart semantics — the same contract as a ``gym:``
+   resume without a sidecar), then RETRY the interrupted operation.
+2. **Graceful degradation** — after ``max_worker_restarts`` failed
+   revivals of the same worker, stop burning restarts and re-host the
+   slice in-process (``proc_env._LocalConn``): data stays bit-correct,
+   the slice merely loses process parallelism. The pool drops to the
+   remaining workers. A revival only counts as FAILED when the worker
+   dies again within ``heal_window`` seconds; one that holds past the
+   window resets the budget, so rare isolated crashes over a long run
+   never accumulate into degradation.
+3. **Floor** — when fewer than ``min_proc_workers`` process-backed
+   workers remain healthy (or a slice cannot be revived at all), raise
+   :class:`WorkerPoolError`: below the floor the operator asked for, a
+   degraded run is worse than a dead one.
+
+Every transition emits a ``health`` event on the PR 3 bus (when one is
+attached), so chaos runs are auditable: ``worker_restart`` →
+``worker_degraded`` → abort.
+
+Retry semantics under faults: a retried step re-steps the SURVIVING
+workers with the same actions (their first replies were gathered and
+discarded to keep the pipe protocol in sync), so one fault costs at most
+one duplicated transition per surviving env and the restarted slice's
+in-flight episodes — the documented fault model, pinned by
+``tests/test_resilience.py``.
+
+The wrapper is transparent: every attribute it does not override
+delegates to the wrapped pool, so ``rollout``/``agent`` code (including
+``host_step_slice`` feature probes and checkpoint sidecars) sees the
+``GymVecEnv`` surface unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from trpo_tpu.envs.proc_env import WorkerDiedError
+
+__all__ = ["SupervisedEnv", "SupervisionConfig", "WorkerPoolError"]
+
+
+class WorkerPoolError(RuntimeError):
+    """The pool degraded below the configured floor (or a slice could not
+    be revived at all) — training cannot continue on correct data."""
+
+
+@dataclasses.dataclass
+class SupervisionConfig:
+    max_worker_restarts: int = 2   # per-worker process restarts before
+    #                                degrading the slice to in-process
+    min_proc_workers: int = 0      # abort when fewer process-backed
+    #                                workers than this remain healthy
+    #                                (0 = degrade all the way, never
+    #                                abort on degradation alone)
+    backoff_base: float = 0.5      # seconds; restart n waits
+    #                                base·2^(n-1), capped below
+    backoff_max: float = 5.0
+    heal_window: float = 60.0      # seconds a revived worker must run
+    #                                healthily for its restart budget to
+    #                                reset — a death within the window
+    #                                counts the revival as FAILED; one
+    #                                beyond it is a fresh, unrelated
+    #                                fault (so a long run is never
+    #                                degraded by rare isolated crashes)
+
+
+class SupervisedEnv:
+    """``ProcVecEnv`` with the detect→revive loop wrapped around every
+    worker-touching operation. See the module docstring for semantics."""
+
+    def __init__(self, env, config: Optional[SupervisionConfig] = None,
+                 bus=None, injector=None):
+        self._env = env
+        self.cfg = config or SupervisionConfig()
+        self.bus = bus
+        self.injector = injector
+        self.restarts: dict = {}    # worker -> revival attempts so far
+        self._last_restart: dict = {}  # worker -> monotonic restart time
+        self._degraded: set = set()  # workers re-hosted in-process
+        self._step_count = 0
+        # pipelined rollouts step groups from multiple threads; revival
+        # must not race itself (double-restart of one casualty), and the
+        # injector's step counter must tick once per step call
+        self._lock = threading.Lock()
+
+    # -- delegation --------------------------------------------------------
+
+    def __getattr__(self, name):
+        # only called for names NOT found on SupervisedEnv itself: the
+        # wrapped pool's full surface (n_envs, action_spec, episode
+        # stats, obs-norm hooks, restart_worker, ...) passes through
+        return getattr(self._env, name)
+
+    @property
+    def env(self):
+        """The wrapped (raw) pool."""
+        return self._env
+
+    @property
+    def degraded_workers(self) -> tuple:
+        return tuple(sorted(self._degraded))
+
+    # -- supervised operations ---------------------------------------------
+
+    def host_step(self, actions):
+        return self._supervised(
+            lambda: self._env.host_step(actions), count_step=True
+        )
+
+    def host_step_slice(self, actions, lo, hi):
+        return self._supervised(
+            lambda: self._env.host_step_slice(actions, lo, hi),
+            count_step=True,
+        )
+
+    def reset_all(self, seed=None):
+        return self._supervised(lambda: self._env.reset_all(seed=seed))
+
+    def env_state_snapshot(self):
+        return self._supervised(lambda: self._env.env_state_snapshot())
+
+    def env_state_restore(self, snap):
+        return self._supervised(lambda: self._env.env_state_restore(snap))
+
+    def render_frame(self):
+        return self._supervised(lambda: self._env.render_frame())
+
+    def close(self):
+        self._env.close()
+
+    # -- the detect→revive loop --------------------------------------------
+
+    def _supervised(self, fn, count_step: bool = False):
+        if count_step:
+            with self._lock:
+                self._step_count += 1
+                idx = self._step_count
+            if self.injector is not None:
+                self.injector.on_env_step(idx, self._env)
+        while True:
+            try:
+                return fn()
+            except WorkerDiedError as e:
+                with self._lock:
+                    self._revive(e)
+
+    def _emit(self, check: str, level: str, message: str, **data) -> None:
+        if self.bus is not None:
+            self.bus.emit(
+                "health", check=check, level=level, message=message,
+                data=data or None,
+            )
+
+    def _revive(self, err: WorkerDiedError) -> None:
+        for w in err.workers:
+            last = self._last_restart.get(w)
+            if (
+                last is not None
+                and time.monotonic() - last > self.cfg.heal_window
+            ):
+                # the previous revival held for the full heal window:
+                # this death is a fresh fault, not a failed revival —
+                # the budget restarts (module docstring, point 2)
+                self.restarts[w] = 0
+            n = self.restarts.get(w, 0) + 1
+            self.restarts[w] = n
+            if w in self._degraded:
+                # the in-process fallback itself failed: nothing left to
+                # degrade to — the slice is unrevivable
+                raise WorkerPoolError(
+                    f"in-process fallback for worker {w} "
+                    f"({self._env.env_id}) failed — slice is unrevivable"
+                ) from err
+            if n <= self.cfg.max_worker_restarts:
+                delay = min(
+                    self.cfg.backoff_base * 2 ** (n - 1),
+                    self.cfg.backoff_max,
+                )
+                self._emit(
+                    "worker_restart", "warn",
+                    f"env worker {w} {err.kind} "
+                    f"(attempt {n}/{self.cfg.max_worker_restarts}); "
+                    f"restarting after {delay:.2g}s backoff — its "
+                    "episodes restart",
+                    worker=w, attempt=n, kind=err.kind, backoff_s=delay,
+                )
+                time.sleep(delay)
+                try:
+                    self._env.restart_worker(w)
+                    self._last_restart[w] = time.monotonic()
+                    continue
+                except Exception:
+                    # the respawn itself failed (e.g. construction
+                    # crash): fall through to degradation immediately
+                    pass
+            self._emit(
+                "worker_degraded", "warn",
+                f"env worker {w} exceeded "
+                f"{self.cfg.max_worker_restarts} restarts; re-hosting "
+                "its slice in-process (degraded: correct data, no "
+                "process parallelism)",
+                worker=w, restarts=n,
+            )
+            try:
+                self._env.restart_worker(w, local=True)
+            except Exception as e:
+                raise WorkerPoolError(
+                    f"worker {w} ({self._env.env_id}) could not be "
+                    f"revived in-process: {type(e).__name__}: {e}"
+                ) from e
+            self._degraded.add(w)
+            live = self._env.n_workers - len(self._degraded)
+            if live < self.cfg.min_proc_workers:
+                self._emit(
+                    "worker_pool_floor", "error",
+                    f"only {live} process-backed env workers remain "
+                    f"(< floor {self.cfg.min_proc_workers}) — aborting",
+                    live=live, floor=self.cfg.min_proc_workers,
+                )
+                raise WorkerPoolError(
+                    f"process-backed env workers ({live}) fell below "
+                    f"the configured floor ({self.cfg.min_proc_workers})"
+                )

@@ -248,6 +248,54 @@ def build_parser() -> argparse.ArgumentParser:
         "of N_STEPS per env and print its mean episode reward (the "
         "reference's post-stop eval phase)",
     )
+    p.add_argument(
+        "--recover-on-nan",
+        choices=("off", "restore"),
+        help="nonfinite-update policy (trpo_tpu.resilience.recovery): "
+        "'off' (default) aborts like the reference; 'restore' rewinds to "
+        "a last-good TrainState snapshot, skips the poisoned batch, "
+        "escalates cg_damping when --adaptive-damping is on, and aborts "
+        "only after --max-recoveries consecutive failures",
+    )
+    p.add_argument(
+        "--max-recoveries",
+        type=_positive_int,
+        help="with --recover-on-nan restore: consecutive recoveries "
+        "before the run is declared diverged and aborts (default 3)",
+    )
+    p.add_argument(
+        "--max-worker-restarts",
+        type=int,
+        help="gymproc: pools: process restarts per env worker before its "
+        "slice degrades to an in-process fallback (default 2; see "
+        "--env-step-timeout for detection)",
+    )
+    p.add_argument(
+        "--env-step-timeout",
+        type=float,
+        help="gymproc: pools: seconds to wait on a worker reply before "
+        "declaring it dead and restarting it (default 60; 0 = wait "
+        "forever)",
+    )
+    p.add_argument(
+        "--on-preempt",
+        choices=("checkpoint", "ignore"),
+        help="SIGTERM/SIGINT behavior: 'checkpoint' (default) drains the "
+        "pipeline, writes a final checkpoint + host-env sidecar and "
+        "exits with the requeue exit code (75 = EX_TEMPFAIL — resubmit "
+        "on exactly this code: `... || [ $? -eq 75 ] && resubmit`); "
+        "'ignore' keeps default signal behavior (die mid-iteration)",
+    )
+    p.add_argument(
+        "--inject-faults",
+        metavar="SPEC",
+        help="deterministic chaos injection (trpo_tpu.resilience.inject), "
+        "';'-separated, each firing once: kill_worker@step=K:worker=W, "
+        "hang_worker@step=K:worker=W, delay_step@step=K:seconds=S, "
+        "nan_update@iter=N, sigterm@iter=N — every firing emits a "
+        "fault_injected event (--metrics-jsonl logs are then checked by "
+        "scripts/validate_events.py for matching recovery records)",
+    )
     return p
 
 
@@ -284,6 +332,12 @@ _OVERRIDES = {
     "checkpoint_every": "checkpoint_every",
     "debug_nans": "debug_nans",
     "normalize_obs": "normalize_obs",
+    "recover_on_nan": "recover_on_nan",
+    "max_recoveries": "max_recoveries",
+    "max_worker_restarts": "max_worker_restarts",
+    "env_step_timeout": "env_step_timeout",
+    "on_preempt": "on_preempt",
+    "inject_faults": "inject_faults",
 }
 
 
@@ -365,24 +419,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     cfg = config_from_args(args)
     agent = TRPOAgent(cfg.env, cfg)
 
-    checkpointer = None
-    state = None
-    if cfg.checkpoint_dir:
-        from trpo_tpu.utils.checkpoint import Checkpointer
-
-        checkpointer = Checkpointer(
-            cfg.checkpoint_dir, cg_damping_seed=cfg.cg_damping
-        )
-        if args.resume and checkpointer.latest_step() is not None:
-            state = checkpointer.restore(agent.init_state())
-            # host-simulator sidecar: exact resume for native:, best-effort
-            # for gym: (None → documented episode-restart semantics)
-            agent.restore_host_env(checkpointer.restore_host_env())
-            print(f"resumed from step {checkpointer.latest_step()}")
-
     if args.profile_iteration and not args.profile_dir:
         raise SystemExit("--profile-iteration requires --profile-dir")
 
+    # telemetry before the checkpointer: a corrupt host-env sidecar found
+    # during --resume surfaces as a health event on the same bus
     telemetry = None
     if args.metrics_jsonl or args.health_checks or args.profile_iteration:
         from trpo_tpu.obs import Telemetry
@@ -395,6 +436,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             profile_iteration=args.profile_iteration,
         )
 
+    checkpointer = None
+    state = None
+    if cfg.checkpoint_dir:
+        from trpo_tpu.utils.checkpoint import Checkpointer
+
+        checkpointer = Checkpointer(
+            cfg.checkpoint_dir,
+            cg_damping_seed=cfg.cg_damping,
+            bus=telemetry.bus if telemetry is not None else None,
+        )
+        if args.resume and checkpointer.latest_step() is not None:
+            state = checkpointer.restore(agent.init_state())
+            # host-simulator sidecar: exact resume for native:, best-effort
+            # for gym: (None → documented episode-restart semantics; a
+            # CORRUPT sidecar additionally emits a health event)
+            agent.restore_host_env(checkpointer.restore_host_env())
+            print(f"resumed from step {checkpointer.latest_step()}")
+
     logger = StatsLogger(
         jsonl_path=cfg.log_jsonl,
         bus=telemetry.bus if telemetry is not None else None,
@@ -404,6 +463,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     import jax
 
+    from trpo_tpu.resilience import Preempted
+
     # whole-run trace only WITHOUT a window request — the windowed capture
     # (telemetry.profile_tick) opens/closes the trace around iteration N
     profile_ctx = (
@@ -412,17 +473,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         else contextlib.nullcontext()
     )
     try:
-        with profile_ctx:
-            final = agent.learn(
-                state=state,
-                logger=logger,
-                checkpointer=checkpointer,
-                use_jax_profiler=bool(args.profile_dir),
-                telemetry=telemetry,
-            )
+        try:
+            with profile_ctx:
+                final = agent.learn(
+                    state=state,
+                    logger=logger,
+                    checkpointer=checkpointer,
+                    use_jax_profiler=bool(args.profile_dir),
+                    telemetry=telemetry,
+                )
+        except Preempted as p:
+            # the orderly preemption exit (resilience/preempt.py): the
+            # pipeline is drained and the final checkpoint written —
+            # exit with the DISTINCT requeue code so a scheduler/wrapper
+            # resubmits exactly this run
+            if p.step:
+                print(
+                    f"preempted (signal {p.signum}): final checkpoint at "
+                    f"step {p.step}; exiting {p.exit_code} for requeue"
+                )
+            else:
+                print(
+                    f"preempted (signal {p.signum}): no checkpoint "
+                    f"configured; exiting {p.exit_code} for requeue"
+                )
+            return p.exit_code
     finally:
         if telemetry is not None:
             telemetry.close()
+        logger.close()
     print(
         f"done: {int(final.iteration)} iterations, "
         f"{int(final.total_timesteps)} timesteps, "
@@ -440,7 +519,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 f"greedy eval: no episode finished in {args.evaluate} steps; "
                 f"partial-episode reward ≥ {mean_ret:.1f}"
             )
-    logger.close()
     return 0
 
 

@@ -50,6 +50,7 @@ class Checkpointer:
         max_to_keep: int = 3,
         cg_damping_seed: Optional[float] = None,
         allow_legacy_pickle: Optional[bool] = None,
+        bus=None,
     ):
         """``cg_damping_seed``: the run's configured ``cfg.cg_damping`` —
         used only when a fixed→adaptive damping flip is restored through an
@@ -61,12 +62,18 @@ class Checkpointer:
         code from a hostile checkpoint directory. Default (None) reads the
         ``TRPO_TPU_ALLOW_PICKLE_SIDECAR`` env var; unset means refuse with
         a warning (episodes restart, nothing else is lost).
+
+        ``bus``: an optional ``trpo_tpu.obs.EventBus`` — checkpoint-layer
+        findings that would otherwise only reach stderr (a CORRUPT
+        host-env sidecar, a pruned partial save) are emitted as
+        ``health`` events on it.
         """
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
         self.cg_damping_seed = cg_damping_seed
+        self.bus = bus
         if allow_legacy_pickle is None:
             # strict allowlist: only the documented "1" enables the
             # pickle.load path — "false"/"no"/"off" must NOT enable an
@@ -80,19 +87,128 @@ class Checkpointer:
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
         )
+        # a FRESH directory gets the markers-enabled sentinel before any
+        # save: "no markers at all" then means "the only saves ever
+        # attempted here were torn", not "legacy pre-marker checkpoint"
+        # — without it a kill -9 through the very FIRST save would leave
+        # a marker-less directory indistinguishable from a trusted
+        # legacy one, and the gate would hand the torn step to resume
+        if not self.manager.all_steps():
+            with open(self._sentinel_path(), "w") as f:
+                f.write("")
+
+    def _health(self, check: str, message: str, **data) -> None:
+        """Surface a checkpoint-layer finding: stderr always, plus a
+        ``health`` event when a bus is attached — silent degradation at
+        restore time is how a fleet quietly loses training state."""
+        import sys
+
+        print(f"checkpoint: {message}", file=sys.stderr)
+        if self.bus is not None:
+            self.bus.emit(
+                "health", check=check, level="warn", message=message,
+                data=data or None,
+            )
+
+    # -- save-integrity gate ------------------------------------------------
+    #
+    # Orbax's save is atomic per step only up to its own finalize; a
+    # ``kill -9`` (a preemption grace window running out) mid-save can
+    # leave a step directory that lists in ``all_steps()`` but restores
+    # garbage — and a naive ``latest_step()`` would hand exactly that to
+    # the next resume. The gate: ``save`` drops a ``step_<n>.complete``
+    # marker AFTER ``wait_until_finished``; a step newer than the newest
+    # marker without its own marker is a torn save — never selected, and
+    # pruned on restore. Steps older than the newest marker are trusted
+    # without one (pre-round-7 checkpoints predate markers); a fresh
+    # directory is stamped ``.markers_enabled`` at init so a tear during
+    # its very FIRST save cannot masquerade as a legacy directory.
+
+    def _marker_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}.complete")
+
+    def _sentinel_path(self) -> str:
+        return os.path.join(self.directory, ".markers_enabled")
+
+    def _marked_steps(self) -> set:
+        import re
+
+        out = set()
+        try:
+            names = os.listdir(self.directory)
+        except OSError:  # pragma: no cover
+            return out
+        for name in names:
+            m = re.fullmatch(r"step_(\d+)\.complete", name)
+            if m:
+                out.add(int(m.group(1)))
+        return out
+
+    def _complete_steps(self):
+        """Steps safe to restore: all of them when no markers exist in a
+        LEGACY directory (pre-marker checkpoints), none of them when no
+        markers exist in a marker-enabled one (every save ever attempted
+        tore), else everything except unmarked steps NEWER than the
+        newest marker (= saves a kill -9 tore mid-write)."""
+        steps = list(self.manager.all_steps())
+        marked = self._marked_steps()
+        if not marked:
+            if steps and os.path.exists(self._sentinel_path()):
+                return []
+            return steps
+        newest_marked = max(marked)
+        return [s for s in steps if s in marked or s < newest_marked]
 
     def save(self, step: int, state) -> None:
         self.manager.save(
             step, args=self._ocp.args.StandardSave(_keys_to_data(state))
         )
         self.manager.wait_until_finished()
+        # marker LAST: its existence asserts the orbax step is finalized
+        with open(self._marker_path(step), "w") as f:
+            f.write("")
+        # prune markers whose step was garbage-collected (max_to_keep)
+        live = set(self.manager.all_steps())
+        for s in self._marked_steps() - live:
+            try:
+                os.remove(self._marker_path(s))
+            except OSError:  # pragma: no cover
+                pass
 
     def latest_step(self) -> Optional[int]:
-        return self.manager.latest_step()
+        """Newest COMPLETE step (see the save-integrity gate above) —
+        never a save torn by ``kill -9``."""
+        steps = self._complete_steps()
+        return max(steps) if steps else None
+
+    def prune_incomplete(self) -> list:
+        """Delete torn saves (steps the integrity gate rejects) so they
+        never shadow a good step again; returns the pruned step numbers.
+        Called by :meth:`restore`; safe to call any time."""
+        torn = sorted(
+            set(self.manager.all_steps()) - set(self._complete_steps())
+        )
+        for s in torn:
+            try:
+                self.manager.delete(s)
+            except Exception:  # pragma: no cover — best-effort cleanup
+                pass
+            self._health(
+                "checkpoint_incomplete",
+                f"step {s} was interrupted mid-save (no completion "
+                "marker) — pruned; restore uses the previous complete "
+                "step",
+                step=s,
+            )
+        return torn
 
     def restore(self, template, step: Optional[int] = None):
         """Restore into the structure of ``template`` (an abstract or
-        concrete TrainState from ``agent.init_state()``)."""
+        concrete TrainState from ``agent.init_state()``). Torn saves
+        (kill -9 mid-write — see the save-integrity gate) are pruned
+        first, so the default ``step`` is always the newest COMPLETE
+        one."""
+        self.prune_incomplete()
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
@@ -341,58 +457,76 @@ class Checkpointer:
 
     def restore_host_env(self, step: Optional[int] = None):
         """The sidecar for ``step`` (default: latest), or None if that
-        checkpoint predates sidecars / the env needed none."""
+        checkpoint predates sidecars / the env needed none.
+
+        "No sidecar" and "CORRUPT sidecar" are different findings: the
+        former is the documented episode-restart fallback and stays
+        silent; the latter means state that WAS saved has been lost
+        (truncation, bit rot, a hostile edit) — it still falls back to
+        episode restart (training survives) but surfaces loudly: stderr
+        plus a ``health`` event when a bus is attached, so the loss is
+        auditable instead of silent."""
         import numpy as np
 
         step = self.latest_step() if step is None else step
         if step is None:
             return None
-        try:
-            path = self._aux_path(step)
-            if os.path.exists(path):
+        path = self._aux_path(step)
+        if os.path.exists(path):
+            try:
                 with np.load(path, allow_pickle=False) as z:
                     return _unflatten_snapshot(
                         str(z["__structure__"]), z
                     )
-            legacy = self._aux_path_legacy(step)
-            if os.path.exists(legacy):
-                import sys
-
-                if not self.allow_legacy_pickle:
-                    print(
-                        f"checkpoint: step {step} has a legacy .pkl "
-                        "host-env sidecar, which requires pickle.load "
-                        "(can execute code from an untrusted checkpoint "
-                        "dir). Refusing without opt-in — pass "
-                        "allow_legacy_pickle=True or set "
-                        "TRPO_TPU_ALLOW_PICKLE_SIDECAR=1 if this "
-                        "checkpoint is your own; episodes will restart.",
-                        file=sys.stderr,
-                    )
-                    return None
-                import pickle
-
-                print(
-                    f"checkpoint: reading legacy pickle sidecar for step "
-                    f"{step} (explicitly allowed)",
-                    file=sys.stderr,
+            except Exception as e:
+                # the sidecar EXISTS but cannot be read back — whatever
+                # it raises (zip errors, JSON errors, construction-time
+                # surprises): fall back to episode restart, but report
+                self._health(
+                    "host_env_sidecar_corrupt",
+                    f"host-env sidecar for step {step} exists but is "
+                    f"unreadable ({type(e).__name__}: {e}) — episodes "
+                    "will restart",
+                    step=step, error=type(e).__name__,
                 )
-                with open(legacy, "rb") as f:
-                    return pickle.load(f)
-            return None
-        except Exception as e:
-            # unreadable/corrupt/garbled sidecar — whatever it raises
-            # (zip errors, JSON errors, unpickling, construction-time
-            # surprises): fall back to the documented episode-restart
-            # semantics rather than sinking the resume
+                return None
+        legacy = self._aux_path_legacy(step)
+        if os.path.exists(legacy):
             import sys
 
+            if not self.allow_legacy_pickle:
+                print(
+                    f"checkpoint: step {step} has a legacy .pkl "
+                    "host-env sidecar, which requires pickle.load "
+                    "(can execute code from an untrusted checkpoint "
+                    "dir). Refusing without opt-in — pass "
+                    "allow_legacy_pickle=True or set "
+                    "TRPO_TPU_ALLOW_PICKLE_SIDECAR=1 if this "
+                    "checkpoint is your own; episodes will restart.",
+                    file=sys.stderr,
+                )
+                return None
+            import pickle
+
             print(
-                f"checkpoint: host-env sidecar for step {step} unreadable "
-                f"({type(e).__name__}) — episodes will restart",
+                f"checkpoint: reading legacy pickle sidecar for step "
+                f"{step} (explicitly allowed)",
                 file=sys.stderr,
             )
-            return None
+            try:
+                with open(legacy, "rb") as f:
+                    return pickle.load(f)
+            except Exception as e:
+                self._health(
+                    "host_env_sidecar_corrupt",
+                    f"legacy host-env sidecar for step {step} exists "
+                    f"but is unreadable ({type(e).__name__}: {e}) — "
+                    "episodes will restart",
+                    step=step, error=type(e).__name__,
+                )
+                return None
+        # genuinely absent: the documented episode-restart fallback
+        return None
 
     def close(self):
         self.manager.close()
