@@ -730,6 +730,88 @@ def update_tail_breakdown(full_update_ms=None, device=None):
     }
 
 
+def bench_program_memory(problem: Problem, device=None, fvp_factory=None):
+    """Compiled ``memory_analysis()`` bytes for the headline programs
+    (ISSUE 5 satellite: the bench JSON carries a memory column next to
+    every time column — HBM is the binding constraint at the flagship
+    shapes, and a program whose temp bytes regressed will OOM a shape the
+    previous round handled even when its timing held).
+
+    Two programs, at the exact headline shapes from the timing phases:
+
+    * ``fused_solve``  — ONE CG solve (CG_ITERS iterations, GGN FVP; with
+      ``fvp_factory`` also a ``fused_solve_pallas`` row for the kernel
+      that carried the headline);
+    * ``full_update``  — one complete natural-gradient update
+      (``_update_bench_setup``'s program: grad → solve → linesearch →
+      rollback).
+
+    Unlike the timing phases these are UNchained: the scan reuses its
+    carry buffers, so a chained program's temp bytes describe one link
+    anyway, while its argument bytes would scale with the chain — the
+    single-shot program is the number a capacity planner wants. Cost: one
+    XLA compile per analyzed program, nothing executed
+    (``obs/memory.program_memory_analysis`` lowers against the real
+    operands). ``BENCH_MEMORY=0`` skips. Failures null the field, never
+    the bench."""
+    import contextlib
+
+    from trpo_tpu.obs.memory import program_memory_analysis
+    from trpo_tpu.ops import conjugate_gradient, make_ggn_fvp
+
+    ctx = (
+        jax.default_device(device)
+        if device is not None
+        else contextlib.nullcontext()
+    )
+    out = {}
+    with ctx:
+        flat0, g = problem.flat0, problem.g
+        if device is not None:
+            flat0 = jax.device_put(np.asarray(flat0), device)
+            g = jax.device_put(np.asarray(g), device)
+        weight = jnp.ones((BATCH,), jnp.float32)
+
+        def one_solve_prog(factory):
+            @jax.jit
+            def one_solve(flat0, g):
+                if factory is not None:
+                    fvp = factory(flat0)
+                else:
+                    fvp = make_ggn_fvp(
+                        problem.apply_fn,
+                        problem.fisher_weight,
+                        flat0,
+                        weight,
+                        damping=DAMPING,
+                    )
+                return conjugate_gradient(
+                    fvp, -g, CG_ITERS, residual_tol=0.0
+                ).x
+
+            return one_solve
+
+        fields = program_memory_analysis(
+            one_solve_prog(None), (flat0, g)
+        )
+        if fields:
+            out["fused_solve"] = fields
+        if fvp_factory is not None:
+            fields = program_memory_analysis(
+                one_solve_prog(fvp_factory), (flat0, g)
+            )
+            if fields:
+                out["fused_solve_pallas"] = fields
+
+        _policy, params, batch, _cfg, update = _update_bench_setup(device)
+        fields = program_memory_analysis(
+            jax.jit(update), (params, batch)
+        )
+        if fields:
+            out["full_update"] = fields
+    return out
+
+
 def _pallas_fvp_factory(problem: Problem):
     """``flat0 -> fvp`` building the fused single-kernel Pallas GGN
     operator (``ops/fused_fvp.py``) in the flat-vector domain — the
@@ -1502,6 +1584,25 @@ def main():
             _progress(
                 f"update-tail breakdown failed ({type(e).__name__}: {e})"
             )
+    # Per-headline-program compiled memory accounting (ISSUE 5 satellite):
+    # args/temp/output/peak bytes next to every time column. One extra
+    # compile per program, nothing executed; BENCH_MEMORY=0 skips.
+    program_memory = None
+    if os.environ.get("BENCH_MEMORY", "1") != "0":
+        try:
+            _progress("program memory accounting (compiled memory_analysis)")
+            program_memory = bench_program_memory(
+                problem,
+                device=None if _ACCEL else jax.devices("cpu")[0],
+                fvp_factory=_pallas_fvp_factory(problem)
+                if pallas_ms is not None
+                else None,
+            ) or None
+        except Exception as e:
+            _progress(
+                f"program memory accounting failed "
+                f"({type(e).__name__}: {e})"
+            )
     # Framework operating point: curvature on every 1/FVP_SUB-th sample
     # (TRPOConfig.fvp_subsample) — skipped on the slow CPU fallback, and
     # skipped if the full-batch timing already failed (same problem shape).
@@ -1732,6 +1833,13 @@ def main():
                 #    tentpole): each phase its own chained-dependent
                 #    program; coverage = sum(phases)/full_update_ms --
                 "update_tail_breakdown": tail_breakdown,
+                # -- compiled memory_analysis per headline program
+                #    (ISSUE 5): argument/output/temp/alias bytes + peak
+                #    estimate for ONE solve and ONE full update at the
+                #    headline shapes; BENCH_LADDER rows carry the same
+                #    accounting per rung. None = skipped (BENCH_MEMORY=0)
+                #    or the backend reported nothing --
+                "program_memory": program_memory,
                 # -- FLOP / MFU accounting. flops_source says where the
                 #    FLOP counts came from: "xla_cost_analysis" (lowered
                 #    loop-free programs, composed per flop_accounting) or
@@ -1907,6 +2015,12 @@ def _emit_bench_events(artifact, tail_breakdown, host_pipe) -> None:
                         name=f"host_pipeline/{key}",
                         ms=host_pipe[key],
                     )
+        # one memory record per analyzed headline program — the same
+        # scope="program" schema the training drivers emit under
+        # --memory-accounting, so analyze_run.py --compare gates bench
+        # artifacts' memory columns exactly like training logs'
+        for pname, fields in (artifact.get("program_memory") or {}).items():
+            bus.emit("memory", scope="program", program=pname, **fields)
     finally:
         bus.close()
 

@@ -15,6 +15,7 @@ Results table: ``BENCH_LADDER.md``.
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -90,10 +91,41 @@ def _missing(module: str) -> bool:
     return importlib.util.find_spec(module) is None
 
 
+def _rung_program_memory(agent):
+    """Compiled ``memory_analysis()`` per jitted program the rung ran
+    (ISSUE 5 satellite: the ladder's memory column). The agent's
+    ``--memory-accounting`` capture hook recorded each program's abstract
+    argument shapes before donation; analyzing costs one extra compile
+    per program, after the timed window. ``BENCH_MEMORY=0`` skips; a
+    backend that reports nothing yields None."""
+    if os.environ.get("BENCH_MEMORY", "1") == "0" or not agent._program_args:
+        return None
+    from trpo_tpu.obs.memory import program_memory_analysis
+
+    out = {}
+    for pname, (fn, pargs) in agent._program_args.items():
+        fields = program_memory_analysis(fn, pargs)
+        if fields:
+            out[pname] = fields
+    return out or None
+
+
+def _peak_mem_mib(mem):
+    """Resident-set headline for the table: the largest single program's
+    peak estimate (the rung's programs run sequentially, so the max — not
+    the sum — bounds the transient footprint)."""
+    if not mem:
+        return None
+    return round(
+        max(f["peak_estimate_bytes"] for f in mem.values()) / 2**20, 1
+    )
+
+
 def bench_rung(name: str, k: int, overrides: dict, reps: int = 3,
                preset: str = None):
     cfg = get_preset(preset or name).replace(**overrides)
     agent = TRPOAgent(cfg.env, cfg)
+    agent._capture_program_args = True
     state = agent.init_state(seed=0)
     steps_per_iter = agent.n_steps * cfg.n_envs
 
@@ -105,6 +137,10 @@ def bench_rung(name: str, k: int, overrides: dict, reps: int = 3,
 
     best = float("inf")
     for _ in range(reps):
+        # run_iterations DONATES its state (the PR 1 donation contract) —
+        # each rep rebuilds the identical seed-0 state outside the timed
+        # window instead of re-passing consumed buffers
+        state = agent.init_state(seed=0)
         t0 = time.perf_counter()
         _, stats = agent.run_iterations(state, k)
         np.asarray(stats["entropy"])                    # small sync probe
@@ -113,6 +149,7 @@ def bench_rung(name: str, k: int, overrides: dict, reps: int = 3,
     assert np.all(np.isfinite(ent)), f"{name}: non-finite entropy"
 
     per_iter = max(best - rtt, 1e-9) / k
+    mem = _rung_program_memory(agent)
     return {
         "rung": name,
         "n_envs": cfg.n_envs,
@@ -122,12 +159,15 @@ def bench_rung(name: str, k: int, overrides: dict, reps: int = 3,
         "iter_ms": per_iter * 1e3,
         "compile_s": compile_s,
         "backend": jax.devices()[0].platform,
+        "program_memory": mem,
+        "peak_mem_mib": _peak_mem_mib(mem),
     }
 
 
 def bench_host_rung(name: str, preset: str, iters: int, overrides: dict):
     cfg = get_preset(preset).replace(**overrides)
     agent = TRPOAgent(cfg.env, cfg)
+    agent._capture_program_args = True
     state = agent.init_state(seed=0)
     steps_per_iter = agent.n_steps * cfg.n_envs
 
@@ -142,6 +182,7 @@ def bench_host_rung(name: str, preset: str, iters: int, overrides: dict):
         float(np.asarray(stats["entropy"]))
     per_iter = (time.perf_counter() - t0) / iters
     assert np.isfinite(float(np.asarray(stats["entropy"])))
+    mem = _rung_program_memory(agent)
     return {
         "rung": name,
         "n_envs": cfg.n_envs,
@@ -151,6 +192,8 @@ def bench_host_rung(name: str, preset: str, iters: int, overrides: dict):
         "iter_ms": per_iter * 1e3,
         "compile_s": compile_s,
         "backend": jax.devices()[0].platform + "+host-sim",
+        "program_memory": mem,
+        "peak_mem_mib": _peak_mem_mib(mem),
     }
 
 
@@ -299,14 +342,17 @@ def _write_out(path: str, rows) -> None:
     ablations, Pallas shootout) survive regeneration. A fresh file gets
     the markers so future runs behave the same."""
     lines = [
-        "| rung | envs | batch | iter ms | updates/s | env steps/s |",
-        "|---|---|---|---|---|---|",
+        "| rung | envs | batch | iter ms | updates/s | env steps/s "
+        "| peak mem |",
+        "|---|---|---|---|---|---|---|",
     ]
     for r in rows:
+        peak = r.get("peak_mem_mib")
+        peak_str = "-" if peak is None else f"{peak:,.1f} MiB"
         lines.append(
             f"| {r['rung']} | {r['n_envs']} | {r['batch_timesteps']} "
             f"| {r['iter_ms']:.1f} | {r['updates_per_sec']:.2f} "
-            f"| {r['env_steps_per_sec']:,.0f} |"
+            f"| {r['env_steps_per_sec']:,.0f} | {peak_str} |"
         )
     note = ""
     if any(r["backend"].endswith("host-sim") for r in rows):
@@ -324,7 +370,10 @@ def _write_out(path: str, rows) -> None:
         "One iteration = rollout + GAE + critic fit + TRPO "
         "natural-gradient update, K iterations scanned into one device "
         "program (`TRPOAgent.run_iterations`); RTT-corrected timing (see "
-        "`bench.py`).\n\n" + "\n".join(lines) + "\n" + note
+        "`bench.py`). `peak mem` = the rung's largest jitted program by "
+        "compiled `memory_analysis()` peak estimate (args + outputs + "
+        "temps − donation aliasing, for ONE iteration/program — "
+        "`BENCH_MEMORY=0` skips).\n\n" + "\n".join(lines) + "\n" + note
     )
     header = (
         "# Ladder throughput — full fused training iterations "

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Summarize one event-JSONL run, or gate a new run against a baseline.
+
+    python scripts/analyze_run.py RUN.jsonl
+    python scripts/analyze_run.py RUN.jsonl --compare BASE.jsonl \\
+        [--threshold-pct 20] [--min-ms 1.0] [--json]
+
+Single file: a run report — per-phase time table, throughput (steady
+iteration ms + timesteps/s), health/recompile/fault summary, peak-memory
+report (compiled program footprints + live-buffer peak). With
+``--compare``, the per-phase and per-metric regression verdicts of
+``trpo_tpu.obs.analyze.compare_runs``: time-like metrics regress when
+they grow past the threshold, rate-like when they shrink past it,
+byte-like when they grow past it; sub-``--min-ms`` phases and metrics a
+run did not measure are skipped, never silently judged.
+
+Exit codes (the contract ``scripts/check.sh``'s regression gate relies
+on): **0** = summarized / compared clean, **1** = at least one metric
+REGRESSED past the threshold, **2** = usage or unreadable/empty input.
+
+``--json`` prints the machine-readable summary (or comparison) instead
+of the text report. The reader is tolerant (corrupt mid-file records are
+skipped with a warning); run ``scripts/validate_events.py`` first when
+strictness matters.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import warnings
+
+# runnable from anywhere: `python scripts/analyze_run.py …` puts
+# scripts/ (not the repo root) on sys.path
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="analyze_run.py",
+        description="summarize / regression-gate trpo-tpu event logs",
+    )
+    p.add_argument("run", help="event JSONL of the run to analyze")
+    p.add_argument(
+        "--compare", metavar="BASELINE",
+        help="baseline event JSONL; exit 1 if RUN regressed past the "
+        "threshold on any phase/metric",
+    )
+    p.add_argument(
+        "--threshold-pct", type=float, default=20.0,
+        help="regression threshold in percent (default 20)",
+    )
+    p.add_argument(
+        "--min-ms", type=float, default=1.0,
+        help="ignore phases whose mean is below this in both runs "
+        "(default 1.0 — sub-millisecond phases are scheduler noise)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="emit the machine-readable summary/comparison JSON",
+    )
+    return p
+
+
+def _load_summary(path: str):
+    from trpo_tpu.obs.analyze import load_events, summarize_run
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        records = load_events(path)
+    for w in caught:
+        print(f"WARN     {w.message}", file=sys.stderr)
+    if not records:
+        print(f"ERROR    {path}: no readable records", file=sys.stderr)
+        return None
+    return summarize_run(records)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from trpo_tpu.obs.analyze import (
+        compare_runs,
+        render_comparison,
+        render_summary,
+    )
+
+    try:
+        run = _load_summary(args.run)
+    except OSError as e:
+        print(f"ERROR    {args.run}: unreadable ({e})", file=sys.stderr)
+        return 2
+    if run is None:
+        return 2
+
+    if not args.compare:
+        if args.json:
+            print(json.dumps(run))
+        else:
+            print(render_summary(run))
+        return 0
+
+    try:
+        base = _load_summary(args.compare)
+    except OSError as e:
+        print(f"ERROR    {args.compare}: unreadable ({e})",
+              file=sys.stderr)
+        return 2
+    if base is None:
+        return 2
+    result = compare_runs(
+        base, run,
+        threshold_pct=args.threshold_pct,
+        min_ms=args.min_ms,
+    )
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(render_comparison(result))
+    return 1 if result["regressed"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,6 +20,10 @@ echo "== tier-1: observability (event bus, device metrics, monitors) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py -q \
     -m 'not slow'
 
+echo "== tier-1: introspection (status endpoint, memory, analyze CLI) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_introspection.py -q \
+    -m 'not slow'
+
 echo "== tier-1: resilience chaos suite (fault injection, CPU backend) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
     -m 'not slow'
@@ -29,12 +33,46 @@ OBS_TMP=$(mktemp -d)
 JAX_PLATFORMS=cpu python -m trpo_tpu.train --preset cartpole \
     --iterations 2 --batch-timesteps 64 --n-envs 4 --platform cpu \
     --metrics-jsonl "$OBS_TMP/train_events.jsonl" --health-checks \
+    --status-port 0 --memory-accounting \
     > /dev/null
 BENCH_FORCE_CPU=1 BENCH_BATCH=256 BENCH_WIDTHS= BENCH_HOST_PIPELINE=0 \
     BENCH_TAIL=0 BENCH_EVENTS_JSONL="$OBS_TMP/bench_events.jsonl" \
     python bench.py > "$OBS_TMP/bench.json"
 python scripts/validate_events.py "$OBS_TMP/train_events.jsonl" \
     "$OBS_TMP/bench_events.jsonl"
+
+echo "== regression gate: clean re-run compares OK, injected slowdown fails =="
+# the repo's first automated perf gate (ISSUE 5): two identical tiny
+# gymproc runs must compare clean at the gate threshold, and a third run
+# with a delay_step chaos fault (PR 4's injector) stretching one host
+# step by 3 s must make analyze_run.py --compare exit nonzero. Threshold
+# 200% swallows CPU scheduler noise between the clean legs while the
+# injected delay (+3 s over ~57 ms steady iterations) overshoots it
+# >6x on steady_iteration_ms and timesteps/s.
+GATE_TMP=$(mktemp -d)
+for leg in base clean; do
+    JAX_PLATFORMS=cpu python -m trpo_tpu.train --env "gymproc:CartPole-v1" \
+        --iterations 5 --batch-timesteps 32 --n-envs 2 --platform cpu \
+        --metrics-jsonl "$GATE_TMP/$leg.jsonl" > /dev/null
+done
+python scripts/validate_events.py "$GATE_TMP/base.jsonl" \
+    "$GATE_TMP/clean.jsonl"
+python scripts/analyze_run.py "$GATE_TMP/clean.jsonl" \
+    --compare "$GATE_TMP/base.jsonl" --threshold-pct 200 --min-ms 5
+JAX_PLATFORMS=cpu python -m trpo_tpu.train --env "gymproc:CartPole-v1" \
+    --iterations 5 --batch-timesteps 32 --n-envs 2 --platform cpu \
+    --inject-faults "delay_step@step=20:seconds=3" \
+    --metrics-jsonl "$GATE_TMP/slow.jsonl" > /dev/null
+set +e
+python scripts/analyze_run.py "$GATE_TMP/slow.jsonl" \
+    --compare "$GATE_TMP/base.jsonl" --threshold-pct 200 --min-ms 5
+GATE_CODE=$?
+set -e
+if [[ "$GATE_CODE" != 1 ]]; then
+    echo "regression gate: expected exit 1 on injected slowdown," \
+        "got $GATE_CODE"
+    exit 1
+fi
 
 echo "== chaos smoke: worker-kill + NaN iteration + SIGTERM, then resume =="
 # one tiny gymproc cartpole run with an injected worker kill, a NaN-

@@ -4,20 +4,29 @@
     python scripts/validate_events.py FILE [FILE ...]
 
 For each file: every line must parse as JSON and pass
-``trpo_tpu.obs.events.validate_event``; the first record must be a
-``run_manifest`` (files are self-describing); when per-iteration
-records are present, each must carry the device-accumulated solver
-counters (``cg_iters_total``, ``linesearch_trials_total``) — the ISSUE 3
-acceptance contract; and every ``fault_injected`` record must be
-FOLLOWED by a matching detection/recovery record (the ISSUE 4 chaos
-contract: worker kill/hang → a ``worker_*`` health event, NaN poison →
-a ``recovery`` event or nan health finding, SIGTERM → a ``preempted``
-health event — an injected fault nothing reacted to means the
-detect→recover loop is broken). Exits non-zero with per-line diagnostics on any
-failure; prints a per-kind count summary on success. Used by
-``scripts/check.sh`` against both a training run's ``--metrics-jsonl``
-output and ``bench.py``'s ``BENCH_EVENTS_JSONL`` output (one validator,
-one schema).
+``trpo_tpu.obs.events.validate_event`` — including the ISSUE 5 record
+types (``memory`` scope=program/live accounting, the ``status`` endpoint
+announcement); the first record must be a ``run_manifest`` (files are
+self-describing); when per-iteration records are present, each must
+carry the device-accumulated solver counters (``cg_iters_total``,
+``linesearch_trials_total``) — the ISSUE 3 acceptance contract; and
+every ``fault_injected`` record must be FOLLOWED by a matching
+detection/recovery record (the ISSUE 4 chaos contract: worker kill/hang
+→ a ``worker_*`` health event, NaN poison → a ``recovery`` event or nan
+health finding, SIGTERM → a ``preempted`` health event — an injected
+fault nothing reacted to means the detect→recover loop is broken).
+Exits non-zero with per-line diagnostics on any failure; prints a
+per-kind count summary on success. Used by ``scripts/check.sh`` against
+both a training run's ``--metrics-jsonl`` output and ``bench.py``'s
+``BENCH_EVENTS_JSONL`` output (one validator, one schema).
+
+Strictness contract (ISSUE 5): this validator FAILS on unknown event
+kinds and on records stamped with a NEWER schema version — with a
+distinct "upgrade the validator" diagnostic for the latter, since a
+future writer's log is not corrupt, just unreadable here. READERS go
+the other way and warn-and-tolerate (``obs/analyze.load_events`` skips
+corrupt records, ``obs/server.StatusSink`` counts unknown kinds): a
+pipeline that wants both guarantees runs the validator first.
 """
 
 from __future__ import annotations
@@ -58,7 +67,7 @@ def _fault_matcher(fault_kind: str):
 
 def validate_file(path: str) -> list:
     """Returns a list of error strings (empty = valid)."""
-    from trpo_tpu.obs.events import validate_event
+    from trpo_tpu.obs.events import SCHEMA_VERSION, validate_event
 
     errs = []
     records = []
@@ -72,6 +81,21 @@ def validate_file(path: str) -> list:
                     rec = json.loads(line)
                 except ValueError as e:
                     errs.append(f"{path}:{n}: not JSON ({e})")
+                    continue
+                v = rec.get("v") if isinstance(rec, dict) else None
+                if (
+                    isinstance(v, int)
+                    and not isinstance(v, bool)
+                    and v > SCHEMA_VERSION
+                ):
+                    # a future writer's log: distinct diagnostic (not
+                    # corrupt data — THIS validator is too old), and no
+                    # per-field pile-on from a schema we cannot know
+                    errs.append(
+                        f"{path}:{n}: newer schema version v={v} (this "
+                        f"validator knows v{SCHEMA_VERSION}) — upgrade "
+                        "the validator, do not trust partial checks"
+                    )
                     continue
                 for e in validate_event(rec):
                     errs.append(f"{path}:{n}: {e}")

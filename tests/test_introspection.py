@@ -1,0 +1,843 @@
+"""Run introspection (ISSUE 5): live status/metrics endpoint,
+device-memory accounting, cross-run analysis + the regression gate.
+
+The contracts pinned here: the status snapshot folds every event kind and
+swaps immutably (a handler serializing an old snapshot never races a
+newer write); ``/status`` and ``/metrics`` are served DURING a live
+training run and agree with the event log's last iteration; with
+``--status-port`` unset no server thread exists and the event stream is
+unchanged; ``memory`` events carry compiled ``memory_analysis`` for the
+update program(s); the leak detector fires ``health:memory_leak`` on a
+pinned synthetic buffer leak; ``analyze_run.py --compare`` exits nonzero
+on a ≥threshold regression and zero on a clean pair; the validator is
+strict (unknown kinds, newer schema versions) where the readers are
+tolerant (corrupt mid-file records skipped with a warning); and
+``repair_jsonl_tail`` handles the empty/torn/boundary edge cases.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# StatusSink snapshot model
+# ---------------------------------------------------------------------------
+
+
+def _feed(sink, *recs):
+    for r in recs:
+        sink.write(dict(r))
+
+
+def test_status_sink_folds_all_event_kinds():
+    from trpo_tpu.obs.server import StatusSink
+
+    sink = StatusSink()
+    _feed(
+        sink,
+        {"kind": "run_manifest", "config_hash": "abc123def", "backend":
+         "cpu", "jax_version": "0.4.37", "device_count": 1,
+         "driver": "serial", "n_iterations": 5, "config": {"env": "x"}},
+        {"kind": "iteration", "iteration": 3, "t": 123.0,
+         "stats": {"reward_running": 10.5, "entropy": 0.6}},
+        {"kind": "phase", "name": "iteration", "ms": 9.5, "calls": 3,
+         "total_s": 0.03},
+        {"kind": "health", "check": "kl_rollback_streak", "level": "warn",
+         "message": "streak", "iteration": 2, "t": 122.0},
+        {"kind": "recompile", "program": "f", "count": 1,
+         "unexpected": False},
+        {"kind": "recompile", "program": "f", "count": 2,
+         "unexpected": True},
+        {"kind": "fault_injected", "fault": "delay_step", "at": 1,
+         "spec": "delay_step@step=1"},
+        {"kind": "memory", "scope": "program", "program": "update",
+         "argument_bytes": 100, "output_bytes": 50, "temp_bytes": 30,
+         "peak_estimate_bytes": 120},
+        {"kind": "memory", "scope": "live", "iteration": 3,
+         "live_buffer_bytes": 4096, "live_buffer_count": 7},
+        {"kind": "from_the_future", "x": 1},  # readers tolerate
+    )
+    snap = sink.snapshot
+    assert snap["manifest"]["config_hash"] == "abc123def"
+    assert "config" not in (snap["manifest"] or {})  # identity card only
+    assert snap["iteration"] == 3
+    assert snap["stats"]["reward_running"] == 10.5
+    assert snap["phases"]["iteration"]["ms"] == 9.5
+    assert snap["health"]["counts"] == {"kl_rollback_streak:warn": 1}
+    assert snap["health"]["last"][0]["check"] == "kl_rollback_streak"
+    assert snap["recompiles"] == {"total": 2, "unexpected": 1}
+    assert snap["faults_injected"] == 1
+    assert snap["memory"]["programs"]["update"]["temp_bytes"] == 30
+    assert snap["memory"]["live"]["live_buffer_bytes"] == 4096
+    assert snap["events_total"]["from_the_future"] == 1
+    assert not snap["finished"]
+    sink.mark_finished()
+    assert sink.snapshot["finished"]
+    # the whole snapshot must be JSON-serializable as-is (the handler
+    # json.dumps's it outside any lock)
+    json.dumps(sink.snapshot)
+
+
+def test_status_snapshot_is_immutable_under_later_writes():
+    """A reference taken before a write never changes — the swap
+    contract that lets handlers serialize without holding the lock."""
+    from trpo_tpu.obs.server import StatusSink
+
+    sink = StatusSink()
+    _feed(sink, {"kind": "iteration", "iteration": 1, "t": 1.0,
+                 "stats": {"entropy": 0.5}})
+    old = sink.snapshot
+    _feed(sink, {"kind": "iteration", "iteration": 2, "t": 2.0,
+                 "stats": {"entropy": 0.4}})
+    assert old["iteration"] == 1
+    assert old["stats"] == {"entropy": 0.5}
+    assert sink.snapshot["iteration"] == 2
+
+
+def test_render_prometheus_families_and_nan():
+    from trpo_tpu.obs.server import StatusSink, render_prometheus
+
+    sink = StatusSink()
+    _feed(
+        sink,
+        {"kind": "iteration", "iteration": 2, "t": 5.0,
+         "stats": {"reward_running": float("nan"), "entropy": 0.25,
+                   "overflowed": float("inf"),
+                   "note": "strings are skipped"}},
+        {"kind": "phase", "name": "iteration", "ms": 12.0, "calls": 2,
+         "total_s": 0.024},
+    )
+    _feed(sink, {"kind": "memory", "scope": "live", "iteration": 2,
+                 "live_buffer_bytes": 512, "live_buffer_count": 3})
+    sink.set_gauges(depth=1, high_water=2, maxsize=2)
+    text = render_prometheus(sink.snapshot)
+    lines = text.splitlines()
+    assert "trpo_iteration 2" in lines
+    assert 'trpo_iteration_stat{stat="entropy"} 0.25' in lines
+    # NaN/±Inf are legal Prometheus sample values and pass through
+    # (a crashed render here would kill /metrics exactly when a
+    # diverging run most needs inspection)
+    assert 'trpo_iteration_stat{stat="reward_running"} NaN' in lines
+    assert 'trpo_iteration_stat{stat="overflowed"} +Inf' in lines
+    # non-numeric stats are skipped, not stringified
+    assert 'stat="note"' not in text
+    assert 'trpo_phase_ms{phase="iteration"} 12' in lines
+    assert 'trpo_stats_drain{gauge="depth"} 1' in lines
+    assert 'trpo_memory_live{gauge="live_buffer_bytes"} 512' in lines
+    # the event's iteration number is NOT a memory gauge (it has its
+    # own trpo_iteration family)
+    assert 'trpo_memory_live{gauge="iteration"}' not in text
+    assert "trpo_run_finished 0" in lines
+    # every non-comment line is `name{labels} value` with a float value
+    for ln in lines:
+        if ln.startswith("#") or not ln:
+            continue
+        float(ln.rsplit(" ", 1)[1])  # must parse (NaN included)
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+def test_status_server_serves_status_metrics_and_404():
+    from trpo_tpu.obs.server import StatusServer, StatusSink
+
+    sink = StatusSink()
+    _feed(sink, {"kind": "iteration", "iteration": 7, "t": 1.0,
+                 "stats": {"entropy": 0.5,
+                           "reward_running": float("nan")}})
+    srv = StatusServer(sink, port=0)  # ephemeral: OS picks
+    try:
+        assert 0 < srv.port < 65536
+        code, ctype, body = _get(f"{srv.url}/status")
+        assert code == 200 and ctype.startswith("application/json")
+
+        def no_bare_constants(s):  # jq/JS reject NaN/Infinity tokens
+            raise AssertionError(f"non-RFC JSON constant {s!r} served")
+
+        snap = json.loads(body, parse_constant=no_bare_constants)
+        assert snap["iteration"] == 7
+        # nonfinite stats serve as null (reward IS NaN pre-first-episode)
+        assert snap["stats"]["reward_running"] is None
+        code, ctype, body = _get(f"{srv.url}/metrics")
+        assert code == 200 and ctype.startswith("text/plain")
+        assert b"trpo_iteration 7" in body
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{srv.url}/nope")
+        assert e.value.code == 404
+    finally:
+        srv.close()
+    # closed: the socket must actually be gone
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        _get(f"{srv.url}/status", timeout=0.5)
+
+
+def test_status_server_silent_on_client_disconnect(capfd):
+    """A scraper dropping the connection mid-response (timeout,
+    `curl | head`) must not traceback onto the training console —
+    neither via log_message nor via socketserver's handle_error."""
+    import socket
+    import struct
+
+    from trpo_tpu.obs.server import StatusServer, StatusSink
+
+    sink = StatusSink()
+    _feed(sink, {"kind": "iteration", "iteration": 1, "t": 1.0,
+                 "stats": {"blob": "x" * 4_000_000}})  # ~4MB body
+    srv = StatusServer(sink, port=0)
+    try:
+        for _ in range(3):
+            s = socket.create_connection(("127.0.0.1", srv.port))
+            s.sendall(b"GET /status HTTP/1.1\r\nHost: x\r\n\r\n")
+            s.recv(1024)  # read a little, then RST the rest
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         struct.pack("ii", 1, 0))
+            s.close()
+        time.sleep(0.3)  # let the handler thread hit the broken pipe
+        # the server must still serve after the aborted requests
+        _, _, body = _get(f"{srv.url}/status")
+        assert json.loads(body)["iteration"] == 1
+    finally:
+        srv.close()
+    out, err = capfd.readouterr()
+    assert "Traceback" not in err and "Traceback" not in out
+
+
+def test_telemetry_without_status_port_is_zero_overhead(tmp_path):
+    """Unset port → no sink, no thread, and the emitted event stream is
+    unchanged (same kinds in the same order; a run WITH the port differs
+    only by the single `status` announcement)."""
+    from trpo_tpu.obs import Telemetry
+
+    def run(status_port):
+        path = tmp_path / f"ev_{status_port}.jsonl"
+        t = Telemetry(events_jsonl=str(path), status_port=status_port)
+        t.start_run(None, driver="serial", n_iterations=1)
+        t.bus.emit("iteration", iteration=1, stats={"entropy": 0.5})
+        t.close()
+        return [json.loads(l)["kind"] for l in open(path)]
+
+    without = run(None)
+    assert "status" not in without
+    assert not any(
+        th.name == "obs-status-server" for th in threading.enumerate()
+    )
+    with_port = run(0)
+    assert [k for k in with_port if k != "status"] == without
+    # the server thread is gone after close() too
+    time.sleep(0.05)
+    assert not any(
+        th.name == "obs-status-server" for th in threading.enumerate()
+    )
+
+
+def test_live_phase_timings_via_attached_timer(tmp_path):
+    """The status snapshot carries phase timings DURING the run: the
+    driver attaches its PhaseTimer and every on_iteration refreshes the
+    live phases — not just the finish_run phase events."""
+    from trpo_tpu.obs import Telemetry
+    from trpo_tpu.utils.timers import PhaseTimer
+
+    t = Telemetry(status_port=0)
+    try:
+        timer = PhaseTimer()
+        t.attach_timer(timer)
+        with timer.phase("rollout"):
+            sum(range(1000))
+        t.on_iteration(1, {"entropy": 0.5})
+        phases = t.status.snapshot["phases"]
+        assert "rollout" in phases and phases["rollout"]["calls"] == 1
+        assert phases["rollout"]["ms"] >= 0.0
+    finally:
+        t.close()
+
+
+def test_memory_accounting_alone_gets_a_visible_sink(capsys):
+    """--memory-accounting with no other telemetry flag must not emit
+    the leak finding into a sinkless bus: health findings fall back to
+    the console."""
+    from trpo_tpu.obs import Telemetry
+
+    t = Telemetry(memory_accounting=True)
+    t.bus.emit("health", check="memory_leak", level="error", message="m")
+    t.bus.emit("memory", scope="live", iteration=1, live_buffer_bytes=1)
+    t.close()
+    err = capsys.readouterr().err
+    assert "memory_leak" in err            # the finding is visible
+    assert "live_buffer_bytes" not in err  # gauges don't spam the console
+
+
+@pytest.mark.slow
+def test_cli_status_endpoint_live_smoke(tmp_path):
+    """The acceptance smoke: a real `python -m trpo_tpu.train` run with
+    --status-port 0 serves /status and /metrics WHILE training; the last
+    in-flight snapshot agrees with the event log's matching iteration
+    row."""
+    events = tmp_path / "events.jsonl"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "trpo_tpu.train",
+            "--preset", "cartpole", "--iterations", "200",
+            "--batch-timesteps", "32", "--n-envs", "4",
+            "--platform", "cpu",
+            "--metrics-jsonl", str(events), "--status-port", "0",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    url = None
+    snapshots = []
+    metrics_seen = False
+    try:
+        for line in proc.stdout:  # the CLI prints the bound URL
+            if line.startswith("status endpoint:"):
+                url = line.split()[2].rsplit("/status", 1)[0]
+                break
+        assert url, "CLI never printed the status endpoint line"
+        deadline = time.time() + 120
+        while proc.poll() is None and time.time() < deadline:
+            try:
+                _, _, body = _get(f"{url}/status", timeout=1.0)
+                snap = json.loads(body)
+                if snap.get("iteration") is not None:
+                    snapshots.append(snap)
+                _, _, mbody = _get(f"{url}/metrics", timeout=1.0)
+                metrics_seen = metrics_seen or b"trpo_iteration" in mbody
+            except (urllib.error.URLError, ConnectionError, OSError):
+                pass  # run already over, or server mid-teardown
+            time.sleep(0.01)
+        proc.stdout.read()
+        assert proc.wait(timeout=120) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert snapshots, "no in-flight /status snapshot with an iteration"
+    assert metrics_seen, "no in-flight /metrics scrape"
+    last = snapshots[-1]
+    # phase timings must be live DURING the run, not only at finish
+    assert last["phases"], "no live phase timings in the snapshot"
+    recs = [json.loads(l) for l in open(events)]
+    assert any(r["kind"] == "status" for r in recs)
+    rows = {
+        r["iteration"]: r["stats"]
+        for r in recs if r["kind"] == "iteration"
+    }
+    # the snapshot is some iteration's row, verbatim — except nonfinite
+    # stats, which /status serves as null (RFC-valid JSON) while the
+    # JSONL keeps python-style NaN
+    assert last["iteration"] in rows
+    row = rows[last["iteration"]]
+    for k, v in last["stats"].items():
+        rv = row[k]
+        if v is None:
+            assert rv is None or (
+                isinstance(rv, float) and not math.isfinite(rv)
+            ), (k, rv)
+        else:
+            assert rv == v, (k, rv, v)
+
+
+# ---------------------------------------------------------------------------
+# device-memory accounting
+# ---------------------------------------------------------------------------
+
+
+def test_compiled_memory_fields_of_simple_program():
+    import jax
+
+    from trpo_tpu.obs.memory import (
+        abstract_args,
+        program_memory_analysis,
+    )
+
+    @jax.jit
+    def f(x):
+        return (x @ x.T).sum(axis=0)
+
+    x = jnp.ones((64, 64), jnp.float32)
+    fields = program_memory_analysis(f, abstract_args((x,)))
+    if fields is None:
+        pytest.skip("backend reports no memory_analysis")
+    assert fields["argument_bytes"] >= 64 * 64 * 4
+    assert fields["output_bytes"] >= 64 * 4
+    assert fields["temp_bytes"] >= 0
+    assert fields["peak_estimate_bytes"] >= fields["output_bytes"]
+
+
+def test_program_memory_analysis_failure_is_none_with_warning():
+    from trpo_tpu.obs.memory import program_memory_analysis
+
+    class Broken:
+        def lower(self, *a):
+            raise RuntimeError("no lowering today")
+
+    with pytest.warns(UserWarning, match="memory analysis failed"):
+        assert program_memory_analysis(Broken(), ()) is None
+
+
+def test_training_emits_program_and_live_memory_events(tmp_path):
+    """The acceptance contract: --memory-accounting emits a
+    scope=program `memory` event carrying compiled memory_analysis for
+    the update program, plus per-iteration scope=live gauges — all
+    schema-valid, with zero unexpected retraces (the analysis compile
+    lands before mark_steady)."""
+    from trpo_tpu.obs.events import validate_event
+    from trpo_tpu.train import main
+
+    events = tmp_path / "events.jsonl"
+    rc = main([
+        "--preset", "cartpole", "--iterations", "3",
+        "--batch-timesteps", "48", "--n-envs", "4",
+        "--platform", "cpu",
+        "--metrics-jsonl", str(events), "--memory-accounting",
+    ])
+    assert rc == 0
+    recs = [json.loads(l) for l in open(events)]
+    for r in recs:
+        assert validate_event(r) == [], r
+    progs = [r for r in recs if r["kind"] == "memory"
+             and r["scope"] == "program"]
+    assert progs, "no compiled-program memory event"
+    assert any("iteration" in r["program"] for r in progs)
+    for r in progs:
+        assert r["argument_bytes"] > 0
+        assert r["peak_estimate_bytes"] > 0
+    live = [r for r in recs if r["kind"] == "memory"
+            and r["scope"] == "live"]
+    assert [r["iteration"] for r in live] == [1, 2, 3]
+    assert all(r["live_buffer_bytes"] > 0 for r in live)
+    assert not any(r["kind"] == "recompile" and r["unexpected"]
+                   for r in recs)
+
+
+def test_async_driver_memory_accounting_and_status(tmp_path):
+    """The async host-env driver's introspection path: phase A/B program
+    memory captured around donation, live gauges from the drain thread,
+    drain-depth gauges in the live snapshot — schema-valid, zero
+    unexpected retraces."""
+    pytest.importorskip("gymnasium")
+    import io
+
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.obs import Telemetry
+    from trpo_tpu.obs.events import validate_event
+    from trpo_tpu.utils.metrics import StatsLogger
+
+    events = tmp_path / "events.jsonl"
+    cfg = TRPOConfig(
+        env="gym:CartPole-v1", n_envs=4, batch_timesteps=48,
+        vf_train_steps=3, policy_hidden=(16,), seed=3,
+        host_async_pipeline=True,
+    )
+    t = Telemetry(events_jsonl=str(events), memory_accounting=True,
+                  status_port=0)
+    agent = TRPOAgent(cfg.env, cfg)
+    agent.learn(n_iterations=3, logger=StatsLogger(stream=io.StringIO()),
+                telemetry=t)
+    # learn() is over but the endpoint outlives it until close(): the
+    # final snapshot carries the last iteration and the drain gauges
+    _, _, body = _get(f"{t.status_server.url}/status")
+    snap = json.loads(body)
+    assert snap["iteration"] == 3
+    assert snap["drain"] is not None and snap["drain"]["maxsize"] >= 1
+    t.close()
+    recs = [json.loads(l) for l in open(events)]
+    for r in recs:
+        assert validate_event(r) == [], r
+    progs = [r["program"] for r in recs if r["kind"] == "memory"
+             and r["scope"] == "program"]
+    assert "policy_phase" in progs and "vf_stats_phase" in progs
+    live = [r["iteration"] for r in recs if r["kind"] == "memory"
+            and r["scope"] == "live"]
+    assert live == [1, 2, 3]
+    assert not any(r["kind"] == "recompile" and r["unexpected"]
+                   for r in recs)
+
+
+def test_leak_detector_window_rule():
+    """Monotone growth over a full window past warmup → exactly one
+    health:memory_leak; an EQUAL sample is skipped (same observation —
+    a fused chunk drains k identical samples at one instant); a SHRINK
+    resets the window (freed memory is not a leak)."""
+    from trpo_tpu.obs.health import HealthConfig, HealthMonitor
+
+    cfg = HealthConfig(
+        memory_leak_window=4, memory_leak_min_growth=1000,
+        memory_leak_warmup=1,
+    )
+    mon = HealthMonitor(config=cfg)
+    base = 10_000
+    # warmup sample, then 3 growth steps (the equal sample is skipped,
+    # not a reset) cut short by a shrink: the window reseeds at the
+    # shrunk value before it can fill — no finding
+    assert mon.observe_memory(1, base) == []
+    for i, b in enumerate([1, 501, 501, 901, 0]):
+        assert mon.observe_memory(2 + i, base + b) == [], i
+    # now strict growth fills a 4-sample window from the shrink point
+    # (10000 → 12800 over 3 steps ≥ min_growth): fires exactly once
+    out = []
+    for i, b in enumerate([2000, 2400, 2800, 3200]):
+        out += mon.observe_memory(10 + i, base + b)
+    assert len(out) == 1
+    f = out[0]
+    assert (f["check"], f["level"]) == ("memory_leak", "error")
+    assert f["data"]["growth_bytes"] == 2800
+    # reported once per run, not once per further sample
+    assert mon.observe_memory(20, base + 99_000) == []
+
+
+def test_leak_detector_fires_through_fused_chunk_duplicates():
+    """The fused device driver drains k stats rows per chunk, so the
+    gauges are sampled k times back-to-back with identical values —
+    those duplicates must not blind the window: chunk-to-chunk growth
+    still fires."""
+    from trpo_tpu.obs.health import HealthConfig, HealthMonitor
+
+    cfg = HealthConfig(
+        memory_leak_window=4, memory_leak_min_growth=1000,
+        memory_leak_warmup=1,
+    )
+    mon = HealthMonitor(config=cfg)
+    out, it = [], 0
+    for chunk in range(5):  # one leaked buffer per chunk, k=3 rows each
+        for _ in range(3):
+            it += 1
+            out += mon.observe_memory(it, 10_000 + 2000 * chunk)
+    assert [f["check"] for f in out] == ["memory_leak"]
+
+
+def test_leak_detector_fires_on_synthetic_buffer_leak():
+    """The acceptance pin: an actual leaked device buffer per iteration
+    (a host list retaining arrays) trips health:memory_leak through the
+    real MemoryMonitor → live_memory_gauges → HealthMonitor path."""
+    from trpo_tpu.obs.events import EventBus
+    from trpo_tpu.obs.health import HealthConfig, HealthMonitor
+    from trpo_tpu.obs.memory import MemoryMonitor
+
+    seen = []
+    bus = EventBus(type("S", (), {
+        "write": staticmethod(seen.append),
+        "close": staticmethod(lambda: None),
+    })())
+    cfg = HealthConfig(memory_leak_window=4, memory_leak_warmup=1)
+    mon = MemoryMonitor(bus=bus, health=HealthMonitor(bus=bus, config=cfg))
+    leak = []  # the bug under test: someone retains a buffer per iteration
+    for i in range(1, 9):
+        leak.append(jnp.ones((256, 1024), jnp.float32).block_until_ready())
+        mon.on_iteration(i)
+        if any(r["kind"] == "health" for r in seen):
+            break
+    findings = [r for r in seen if r["kind"] == "health"]
+    assert findings and findings[0]["check"] == "memory_leak"
+    lives = [r for r in seen if r["kind"] == "memory"
+             and r["scope"] == "live"]
+    assert len(lives) >= 5  # warmup + a full window of growth
+    del leak
+
+
+# ---------------------------------------------------------------------------
+# cross-run analysis + the regression gate
+# ---------------------------------------------------------------------------
+
+
+def _write_events(path, phase_ms, iter_ms=10.0, n_iters=4, extra=()):
+    """A minimal schema-valid run log with controlled timings."""
+    recs = [{
+        "v": 1, "kind": "run_manifest", "t": 0.0,
+        "schema": "trpo-tpu-events", "jax_version": "0", "backend": "cpu",
+        "config_hash": "cafecafecafe", "config": None,
+    }]
+    for i in range(1, n_iters + 1):
+        recs.append({
+            "v": 1, "kind": "iteration", "iteration": i, "t": float(i),
+            "stats": {"iteration_ms": iter_ms, "timesteps_total": 100 * i,
+                      "reward_running": 5.0, "cg_iters_total": 10,
+                      "linesearch_trials_total": i},
+        })
+    for name, ms in phase_ms.items():
+        recs.append({"v": 1, "kind": "phase", "t": 99.0, "name": name,
+                     "ms": ms, "calls": n_iters})
+    recs.extend(extra)
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return path
+
+
+def test_load_events_skips_corrupt_midfile_record_with_warning(tmp_path):
+    from trpo_tpu.obs.analyze import load_events
+
+    p = tmp_path / "ev.jsonl"
+    good1 = {"kind": "iteration", "iteration": 1, "stats": {}}
+    good2 = {"kind": "iteration", "iteration": 2, "stats": {}}
+    p.write_bytes(
+        json.dumps(good1).encode() + b"\n"
+        + b'{"kind": "iteration", "iter\n'     # crash-torn mid-file
+        + b"[1, 2]\n"                          # JSON but not an object
+        + b"\xff\xfe{binary garbage}\n"        # non-UTF8: that LINE skips
+        + json.dumps(good2).encode() + b"\n"
+    )
+    with pytest.warns(UserWarning, match="skipping"):
+        recs = load_events(str(p))
+    assert [r["iteration"] for r in recs] == [1, 2]
+
+
+def test_summarize_run_report(tmp_path):
+    from trpo_tpu.obs.analyze import load_events, summarize_run
+
+    p = _write_events(
+        tmp_path / "run.jsonl", {"iteration": 10.0}, iter_ms=20.0,
+        extra=[
+            {"v": 1, "kind": "memory", "t": 1.0, "scope": "program",
+             "program": "upd", "argument_bytes": 10, "output_bytes": 5,
+             "temp_bytes": 3, "peak_estimate_bytes": 12},
+            {"v": 1, "kind": "memory", "t": 1.5, "scope": "live",
+             "iteration": 1, "live_buffer_bytes": 100},
+            {"v": 1, "kind": "memory", "t": 2.5, "scope": "live",
+             "iteration": 2, "live_buffer_bytes": 300},
+            {"v": 1, "kind": "health", "t": 3.0, "check": "nan_guard",
+             "level": "warn", "message": "m"},
+        ],
+    )
+    s = summarize_run(load_events(str(p)))
+    assert s["iterations"] == 4 and s["last_iteration"] == 4
+    assert s["steady_iteration_ms"] == 20.0
+    # throughput from first→last iteration timestamps and timesteps
+    assert s["timesteps_per_sec"] == pytest.approx(300 / 3.0)
+    assert s["phases"]["iteration"]["mean_ms"] == 10.0
+    assert s["health"] == {"nan_guard:warn": 1}
+    assert s["memory"]["programs"]["upd"]["peak_estimate_bytes"] == 12
+    assert s["memory"]["peak_live_buffer_bytes"] == 300
+    # the steady mean drops the first (compile-loaded) row when >2 exist
+    recs = load_events(str(p))
+    for r in recs:
+        if r.get("kind") == "iteration" and r["iteration"] == 1:
+            r["stats"]["iteration_ms"] = 5000.0
+    assert summarize_run(recs)["steady_iteration_ms"] == 20.0
+
+
+def test_compare_runs_directions_and_floors():
+    from trpo_tpu.obs.analyze import compare_runs
+
+    base = {
+        "phases": {"update": {"mean_ms": 100.0, "calls": 4},
+                   "tiny": {"mean_ms": 0.2, "calls": 4}},
+        "steady_iteration_ms": 50.0,
+        "timesteps_per_sec": 1000.0,
+        "memory": {"peak_live_buffer_bytes": 1000,
+                   "programs": {"upd": {"temp_bytes": 100,
+                                        "peak_estimate_bytes": 200}}},
+    }
+    new = {
+        "phases": {"update": {"mean_ms": 150.0, "calls": 4},     # +50%
+                   "tiny": {"mean_ms": 0.6, "calls": 4}},        # sub-floor
+        "steady_iteration_ms": 49.0,                             # ok
+        "timesteps_per_sec": 600.0,                              # -40%
+        "memory": {"peak_live_buffer_bytes": 990,
+                   "programs": {"upd": {"temp_bytes": 180,       # +80%
+                                        "peak_estimate_bytes": 201},
+                                "brand_new": {"temp_bytes": 9999,
+                                              "peak_estimate_bytes": 9999}}},
+    }
+    res = compare_runs(base, new, threshold_pct=20.0, min_ms=1.0)
+    v = {row["metric"]: row["verdict"] for row in res["verdicts"]}
+    assert v["phase/update"] == "regressed"
+    assert "phase/tiny" not in v          # below min_ms in both: skipped
+    assert v["steady_iteration_ms"] == "ok"
+    assert v["timesteps_per_sec"] == "regressed"   # rate: lower is worse
+    assert v["memory/upd/temp_bytes"] == "regressed"
+    assert v["memory/upd/peak_estimate_bytes"] == "ok"
+    # a program only one run measured surfaces as skipped, never vanishes
+    assert v["memory/brand_new/temp_bytes"] == "skipped"
+    assert res["regressed"]
+    # growth from a ZERO baseline (no ratio) is reported skipped, never
+    # silently "ok" — and zero→zero really is ok
+    res3 = compare_runs(
+        {"phases": {}, "memory": {"programs": {
+            "p": {"temp_bytes": 0, "peak_estimate_bytes": 0}}}},
+        {"phases": {}, "memory": {"programs": {
+            "p": {"temp_bytes": 1 << 31, "peak_estimate_bytes": 0}}}},
+        threshold_pct=20,
+    )
+    v3 = {row["metric"]: row["verdict"] for row in res3["verdicts"]}
+    assert v3["memory/p/temp_bytes"] == "skipped"
+    assert v3["memory/p/peak_estimate_bytes"] == "ok"
+    # a metric only one side measured is reported skipped, never judged
+    res2 = compare_runs({"phases": {}}, {"phases": {}}, threshold_pct=20)
+    assert all(row["verdict"] in ("skipped", "ok")
+               for row in res2["verdicts"])
+    assert not res2["regressed"]
+
+
+def _analyze(args):
+    return subprocess.run(
+        [sys.executable, "scripts/analyze_run.py", *args],
+        capture_output=True, text=True,
+    )
+
+
+def test_analyze_cli_exit_codes(tmp_path):
+    """Exit contract the check.sh gate relies on: 0 clean, 1 regressed,
+    2 unreadable/empty input."""
+    base = _write_events(tmp_path / "base.jsonl", {"update": 100.0})
+    same = _write_events(tmp_path / "same.jsonl", {"update": 104.0})
+    slow = _write_events(tmp_path / "slow.jsonl", {"update": 170.0})
+
+    r = _analyze([str(base)])
+    assert r.returncode == 0 and "phase" in r.stdout
+
+    r = _analyze([str(same), "--compare", str(base),
+                  "--threshold-pct", "20"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+    r = _analyze([str(slow), "--compare", str(base),
+                  "--threshold-pct", "20", "--json"])
+    assert r.returncode == 1
+    verdicts = json.loads(r.stdout)["verdicts"]
+    assert any(v["metric"] == "phase/update"
+               and v["verdict"] == "regressed" for v in verdicts)
+
+    assert _analyze([str(tmp_path / "missing.jsonl")]).returncode == 2
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert _analyze([str(empty)]).returncode == 2
+    # undecodable input is exit 2 (unreadable), never exit 1 (regressed)
+    binary = tmp_path / "binary.jsonl"
+    binary.write_bytes(b"\xff\xfe\x00garbage\x00" * 10)
+    assert _analyze([str(binary), "--compare", str(base)]).returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# validator strictness (satellite: readers tolerate, the validator rejects)
+# ---------------------------------------------------------------------------
+
+
+def _validate(path):
+    return subprocess.run(
+        [sys.executable, "scripts/validate_events.py", str(path)],
+        capture_output=True, text=True,
+    )
+
+
+def test_validator_rejects_unknown_kind_and_newer_schema(tmp_path):
+    base = _write_events(tmp_path / "ok.jsonl", {})
+    assert _validate(base).returncode == 0
+
+    unknown = tmp_path / "unknown.jsonl"
+    unknown.write_text(
+        base.read_text()
+        + json.dumps({"v": 1, "kind": "wormhole", "t": 1.0}) + "\n"
+    )
+    r = _validate(unknown)
+    assert r.returncode != 0 and "unknown kind" in r.stdout + r.stderr
+
+    future = tmp_path / "future.jsonl"
+    future.write_text(
+        base.read_text()
+        + json.dumps({"v": 99, "kind": "iteration", "t": 1.0,
+                      "iteration": 9, "stats": {}}) + "\n"
+    )
+    r = _validate(future)
+    assert r.returncode != 0
+    assert "newer schema version" in r.stdout + r.stderr
+    assert "upgrade the validator" in r.stdout + r.stderr
+
+
+def test_memory_and_status_records_validate():
+    from trpo_tpu.obs.events import validate_event
+
+    ok_prog = {"v": 1, "kind": "memory", "t": 1.0, "scope": "program",
+               "program": "upd", "argument_bytes": 1, "output_bytes": 2,
+               "temp_bytes": 3}
+    assert validate_event(ok_prog) == []
+    ok_live = {"v": 1, "kind": "memory", "t": 1.0, "scope": "live",
+               "iteration": 1, "live_buffer_bytes": 10}
+    assert validate_event(ok_live) == []
+    assert validate_event({"v": 1, "kind": "memory", "t": 1.0,
+                           "scope": "nope"})
+    # scope=program requires its byte fields; negatives rejected
+    bad = dict(ok_prog, temp_bytes=-1)
+    assert any("temp_bytes" in e for e in validate_event(bad))
+    missing = {k: v for k, v in ok_live.items()
+               if k != "live_buffer_bytes"}
+    assert any("live_buffer_bytes" in e for e in validate_event(missing))
+    assert validate_event({"v": 1, "kind": "status", "t": 1.0,
+                           "port": 8080}) == []
+    assert validate_event({"v": 1, "kind": "status", "t": 1.0,
+                           "port": 0})  # 0 is never a *bound* port
+
+
+# ---------------------------------------------------------------------------
+# repair_jsonl_tail edge cases (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+def test_repair_tail_empty_and_missing_file(tmp_path):
+    from trpo_tpu.utils.metrics import repair_jsonl_tail
+
+    p = tmp_path / "empty.jsonl"
+    p.write_text("")
+    assert repair_jsonl_tail(str(p)) == 0
+    assert p.read_bytes() == b""
+    assert repair_jsonl_tail(str(tmp_path / "never_existed.jsonl")) == 0
+
+
+def test_repair_tail_whole_file_is_one_partial_line(tmp_path):
+    from trpo_tpu.utils.metrics import repair_jsonl_tail
+
+    p = tmp_path / "torn.jsonl"
+    p.write_bytes(b'{"kind": "iteration", "iter')  # no newline anywhere
+    removed = repair_jsonl_tail(str(p))
+    assert removed == 27
+    assert p.read_bytes() == b""
+
+
+def test_repair_tail_torn_multi_record_tail(tmp_path):
+    """A crash can tear mid-WRITE of a buffered multi-record chunk: the
+    intact prefix keeps every complete line, the partial goes."""
+    from trpo_tpu.utils.metrics import repair_jsonl_tail
+
+    p = tmp_path / "multi.jsonl"
+    keep = b'{"kind": "a"}\n{"kind": "b"}\n'
+    p.write_bytes(keep + b'{"kind": "c"}\n{"kind": "d"')
+    assert repair_jsonl_tail(str(p)) == len(b'{"kind": "d"')
+    assert p.read_bytes() == keep + b'{"kind": "c"}\n'
+    # idempotent: a repaired file loses nothing more
+    assert repair_jsonl_tail(str(p)) == 0
+
+
+def test_repair_tail_newline_exactly_at_window_boundary(tmp_path):
+    """The backward scan reads [pos-window, pos); a last newline landing
+    exactly at a window edge must be found, not stepped over."""
+    from trpo_tpu.utils.metrics import repair_jsonl_tail
+
+    window = 1 << 20
+    p = tmp_path / "boundary.jsonl"
+    # complete region ends with '\n' as byte (window-1): the FIRST
+    # backward window over a (window + partial)-sized file starts exactly
+    # at the newline
+    complete = b"x" * (window - 1) + b"\n"
+    partial = b"y" * 100
+    p.write_bytes(complete + partial)
+    assert repair_jsonl_tail(str(p)) == len(partial)
+    assert p.read_bytes() == complete
+
+    # and a newline as the LAST byte of a window-sized file: no repair
+    p2 = tmp_path / "exact.jsonl"
+    p2.write_bytes(b"x" * (window - 1) + b"\n")
+    assert repair_jsonl_tail(str(p2)) == 0
+    assert p2.stat().st_size == window

@@ -427,6 +427,16 @@ class TRPOAgent:
         self._eval_roll_fns: dict = {}   # n_steps -> jitted eval rollout
         self._multi_iter_fns: dict = {}  # n -> jitted n-iteration scan
         self._host_eval_act_fn = None
+        # --memory-accounting support (obs/memory.py): when a Telemetry
+        # with a MemoryMonitor drives the run, learn() flips this flag and
+        # each jitted-program call site records its (jitted_fn, abstract
+        # argument shapes) here ONCE — captured BEFORE the call, since the
+        # donated arguments no longer exist after. The driver then feeds
+        # the map to telemetry.emit_program_memory, which AOT-compiles
+        # each program against the abstract shapes and emits its
+        # memory_analysis() as a `memory` event.
+        self._capture_program_args = False
+        self._program_args: dict = {}   # name -> (jitted_fn, abstract args)
 
     # ------------------------------------------------------------------
     # state
@@ -934,7 +944,23 @@ class TRPOAgent:
             fn = self._multi_iter_fns[n] = jax.jit(
                 self.make_scan_body(n), donate_argnums=0
             )
+        self._record_program_args(
+            f"device_iterations[{n}]", fn, train_state
+        )
         return fn(train_state)
+
+    def _record_program_args(self, name: str, fn, *args) -> None:
+        """Stash one jitted program's abstract argument shapes for
+        ``--memory-accounting`` (``obs/memory.py``) — once per name, and
+        only while a driver has flipped ``_capture_program_args``. Must
+        run BEFORE the call: the programs donate their state argument, so
+        afterwards the buffers (and their shardings) are gone.
+        ``ShapeDtypeStruct`` keeps no data alive."""
+        if not self._capture_program_args or name in self._program_args:
+            return
+        from trpo_tpu.obs.memory import abstract_args
+
+        self._program_args[name] = (fn, abstract_args(args))
 
     def make_scan_body(self, n: int, with_lam: bool = False):
         """``state -> (state, stats)`` running ``n`` fused iterations via
@@ -967,6 +993,9 @@ class TRPOAgent:
         state, so the passed-in object must not be read again (module
         docstring's donation contract)."""
         if self.is_device_env:
+            self._record_program_args(
+                "device_iteration", self._iter_fn, train_state
+            )
             return self._iter_fn(train_state)
         rng = jax.random.fold_in(train_state.rng, int(train_state.iteration))
         if self._obs_norm_host:
@@ -1060,7 +1089,13 @@ class TRPOAgent:
         # drivers run bit-identical programs): phase A donates the
         # TrainState and passes vf_state through; phase B donates that
         # vf_state for the critic fit.
+        self._record_program_args(
+            "policy_phase", self._policy_phase_fn, train_state, traj
+        )
         state, fit_pack = self._policy_phase_fn(train_state, traj)
+        self._record_program_args(
+            "vf_stats_phase", self._vf_phase_fn, state.vf_state, fit_pack
+        )
         new_vf_state, stats = self._vf_phase_fn(state.vf_state, fit_pack)
         return state._replace(vf_state=new_vf_state), stats
 
@@ -1320,6 +1355,14 @@ class TRPOAgent:
                 and telemetry.profile_dir is not None)
         )
         if telemetry is not None:
+            # live phase timings for the status endpoint; getattr — tests
+            # thread minimal telemetry stand-ins through learn()
+            getattr(telemetry, "attach_timer", lambda t: None)(timer)
+        # re-armed per run below; captures cleared so a second learn()
+        # (possibly at new shapes) never feeds a stale program analysis
+        self._capture_program_args = False
+        self._program_args = {}
+        if telemetry is not None:
             if getattr(logger, "bus", None) is None:
                 # the logger re-emits each row as an iteration event —
                 # ONE schema for the JSONL log and the telemetry stream
@@ -1330,6 +1373,15 @@ class TRPOAgent:
                 if cfg.host_async_pipeline and not self.is_device_env
                 else "serial",
                 n_iterations=n_iterations,
+            )
+            # --memory-accounting: have the jitted-program call sites
+            # stash their abstract argument shapes (they must be captured
+            # before donation consumes the buffers); the drivers feed the
+            # captures to telemetry.emit_program_memory after each chunk.
+            # getattr: tests thread minimal telemetry stand-ins through
+            # learn() that only carry a bus
+            self._capture_program_args = getattr(
+                telemetry, "wants_program_memory", False
             )
 
         # -- resilience wiring (trpo_tpu/resilience, ISSUE 4) ------------
@@ -1435,6 +1487,12 @@ class TRPOAgent:
                         stack = jax.device_get(stats)
                 done += k
                 seen_chunk_sizes.add(k)
+                if telemetry is not None and self._capture_program_args:
+                    # compiled-program memory: emitted BEFORE mark_steady
+                    # below, so the analysis's extra AOT compile never
+                    # counts as a post-steady retrace (idempotent per
+                    # program — repeats are free)
+                    telemetry.emit_program_memory(self._program_args)
                 if telemetry is not None and done >= 2:
                     # warmup over ONLY once every chunk size this run
                     # will still use has compiled: run_iterations jits
@@ -1784,6 +1842,10 @@ class TRPOAgent:
                 return
             state_a, fit_pack, i_p = pending
             pending = None
+            self._record_program_args(
+                "vf_stats_phase", self._vf_phase_fn,
+                state_a.vf_state, fit_pack,
+            )
             new_vf_state, stats = self._vf_phase_fn(
                 state_a.vf_state, fit_pack
             )
@@ -1901,6 +1963,9 @@ class TRPOAgent:
                     # next dispatch donates them (see docstring)
                     drain.drain()
                 with timer.phase("dispatch"):
+                    self._record_program_args(
+                        "policy_phase", self._policy_phase_fn, cur, traj
+                    )
                     state_a, fit_pack = self._policy_phase_fn(cur, traj)
                     pending = (state_a, fit_pack, i)
                     cur = state_a  # params/rng source for the next rollout
@@ -1944,6 +2009,13 @@ class TRPOAgent:
                     telemetry.observe_drain(
                         drain.depth, drain.high_water, drain.maxsize
                     )
+                    # compiled-program memory: phase A's args are captured
+                    # at j=0, phase B's once the first deferred flush runs
+                    # (during j=1's rollout) — both emitted here before
+                    # mark_steady fires at the top of j=2, so the extra
+                    # AOT compile never reads as a retrace
+                    if self._capture_program_args:
+                        telemetry.emit_program_memory(self._program_args)
                 if drain.stop_requested:
                     continue  # the top-of-loop epilogue flushes first
                 j += 1

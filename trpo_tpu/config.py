@@ -349,6 +349,33 @@ class TRPOConfig:
     #                                nan_update@iter=2"); every fired
     #                                fault emits a fault_injected event
 
+    # --- introspection (trpo_tpu/obs — ISSUE 5) --------------------------
+    status_port: Optional[int] = None  # live introspection endpoint
+    #                                (obs/server.py): a stdlib HTTP server
+    #                                on 127.0.0.1:<port> serving GET
+    #                                /status (JSON snapshot of the run —
+    #                                manifest, current iteration row,
+    #                                phase timings, drain depth, health
+    #                                findings, recompile/memory gauges)
+    #                                and GET /metrics (the same numbers in
+    #                                Prometheus text format). 0 = let the
+    #                                OS pick (the bound port is printed
+    #                                and emitted as a `status` event).
+    #                                None = no sink, no server thread, and
+    #                                emitted event bytes identical to a
+    #                                run without the flag.
+    memory_accounting: bool = False  # device-memory accounting
+    #                                (obs/memory.py): compiled
+    #                                memory_analysis() per core jitted
+    #                                program emitted as `memory` events
+    #                                (one extra XLA compile each, once,
+    #                                before steady state), per-iteration
+    #                                live-buffer/device.memory_stats()
+    #                                gauges, and the monotonic-growth
+    #                                leak detector (health:memory_leak).
+    #                                Off by default: the extra compile is
+    #                                real money at the flagship shapes.
+
     # --- io --------------------------------------------------------------
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 10
@@ -418,6 +445,13 @@ class TRPOConfig:
         if self.worker_backoff < 0:
             raise ValueError(
                 f"worker_backoff must be >= 0, got {self.worker_backoff}"
+            )
+        if self.status_port is not None and not (
+            0 <= self.status_port < 65536
+        ):
+            raise ValueError(
+                "status_port must be in [0, 65535] (0 = OS-assigned) or "
+                f"None, got {self.status_port}"
             )
         if not 0 < self.requeue_exit_code < 256:
             raise ValueError(

@@ -1,6 +1,9 @@
-"""Unified telemetry: run-event bus, device-side metric accumulation,
-recompile/health monitors, and the ``Telemetry`` bundle drivers thread
-through a run (ISSUE 3 tentpole). See ``ARCHITECTURE.md`` "Telemetry"."""
+"""Unified telemetry + run introspection: run-event bus, device-side
+metric accumulation, recompile/health monitors, the ``Telemetry`` bundle
+drivers thread through a run (ISSUE 3 tentpole), and — ISSUE 5 — the
+live status/metrics endpoint (``obs/server``), device-memory accounting
+(``obs/memory``) and cross-run analysis (``obs/analyze``). See
+``ARCHITECTURE.md`` "Telemetry" and "Introspection"."""
 
 from trpo_tpu.obs.device_metrics import (  # noqa: F401
     DeviceMetrics,
@@ -18,7 +21,14 @@ from trpo_tpu.obs.events import (  # noqa: F401
     validate_event,
 )
 from trpo_tpu.obs.health import HealthConfig, HealthMonitor  # noqa: F401
+from trpo_tpu.obs.memory import (  # noqa: F401
+    MemoryMonitor,
+    compiled_memory_fields,
+    live_memory_gauges,
+    program_memory_analysis,
+)
 from trpo_tpu.obs.recompile import RecompileMonitor  # noqa: F401
+from trpo_tpu.obs.server import StatusServer, StatusSink  # noqa: F401
 from trpo_tpu.obs.telemetry import Telemetry  # noqa: F401
 
 __all__ = [
@@ -35,6 +45,12 @@ __all__ = [
     "validate_event",
     "HealthConfig",
     "HealthMonitor",
+    "MemoryMonitor",
+    "compiled_memory_fields",
+    "live_memory_gauges",
+    "program_memory_analysis",
     "RecompileMonitor",
+    "StatusServer",
+    "StatusSink",
     "Telemetry",
 ]

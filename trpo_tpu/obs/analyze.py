@@ -1,0 +1,445 @@
+"""Cross-run analysis over event JSONL: summarize one run, diff two.
+
+The event stream (``obs/events.py``) made every run self-describing;
+this module makes it machine-checkable. One file → a run report:
+per-phase time table, throughput, health/recompile summary, peak-memory
+report. Two files → per-phase and per-metric regression verdicts with a
+threshold — the engine behind ``scripts/analyze_run.py --compare``, the
+repo's first automated perf-regression gate (``check.sh`` trains two
+short runs and gates a PR on the comparison).
+
+Reader tolerance vs validator strictness: :func:`load_events` is a
+READER — it skips a mid-file corrupt record (crash-torn, disk bit rot)
+with a ``warnings.warn`` and keeps going, and it tolerates record kinds
+it does not know (a newer writer's log still summarizes). The STRICT
+side is ``scripts/validate_events.py``, which fails on unknown kinds and
+newer schema versions; a pipeline that wants both runs the validator
+first.
+
+Comparison semantics (:func:`compare_runs`):
+
+* time-like metrics (phase mean ms, steady iteration ms) regress when
+  ``new > base × (1 + threshold_pct/100)``;
+* rate-like metrics (timesteps/s) regress when
+  ``new < base ÷ (1 + threshold_pct/100)``;
+* byte-like metrics (program temp/peak bytes, live-buffer peak) regress
+  when they GROW past the threshold — an HBM regression OOMs the
+  flagship shape as surely as a slowdown misses the deadline;
+* phases below ``min_ms`` in BOTH runs are skipped (a 0.1 ms phase
+  doubling is scheduler noise, not a regression), as are metrics absent
+  from either run (no silent verdict about unmeasured things — they are
+  reported as ``skipped``).
+
+The steady iteration time drops each run's FIRST iteration row when
+more than two exist: iteration 1 carries XLA compilation, which would
+otherwise dominate short gate runs and hide real regressions.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import warnings
+from collections import Counter
+from typing import Optional
+
+__all__ = ["load_events", "summarize_run", "compare_runs", "format_table"]
+
+
+def load_events(path: str) -> list:
+    """Parse one event-JSONL file, tolerantly: corrupt lines are skipped
+    with a ``UserWarning`` naming the line, blank lines are ignored,
+    unknown kinds pass through. Raises ``OSError`` for an unreadable
+    file — no events at all is the caller's verdict to make."""
+    records = []
+    # errors="replace": a non-UTF8 byte (binary garbage, torn gzip) must
+    # corrupt THAT line's parse, not abort the whole read — the mangled
+    # line then warns-and-skips like any other corrupt record
+    with open(path, errors="replace") as f:
+        for n, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                warnings.warn(
+                    f"{path}:{n}: skipping corrupt record ({e})"
+                )
+                continue
+            if not isinstance(rec, dict):
+                warnings.warn(
+                    f"{path}:{n}: skipping non-object record"
+                )
+                continue
+            records.append(rec)
+    return records
+
+
+def _finite(v) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v) if math.isfinite(v) else None
+
+
+def _mean(vals: list) -> Optional[float]:
+    vals = [v for v in (_finite(v) for v in vals) if v is not None]
+    return sum(vals) / len(vals) if vals else None
+
+
+def summarize_run(records: list) -> dict:
+    """One run's report, computed from its event records alone."""
+    manifest = next(
+        (r for r in records if r.get("kind") == "run_manifest"), None
+    )
+    iters = [r for r in records if r.get("kind") == "iteration"]
+    iters.sort(key=lambda r: r.get("iteration", 0))
+
+    # -- iteration metrics -------------------------------------------------
+    last_stats = dict(iters[-1].get("stats") or {}) if iters else {}
+    iter_ms = [
+        (r.get("stats") or {}).get("iteration_ms") for r in iters
+    ]
+    steady_ms = _mean(iter_ms[1:] if len(iter_ms) > 2 else iter_ms)
+    throughput = None
+    if len(iters) >= 2:
+        ts0 = (iters[0].get("stats") or {}).get("timesteps_total")
+        ts1 = (iters[-1].get("stats") or {}).get("timesteps_total")
+        t0, t1 = iters[0].get("t"), iters[-1].get("t")
+        if None not in (ts0, ts1, t0, t1) and t1 > t0:
+            throughput = (ts1 - ts0) / (t1 - t0)
+    rewards = [
+        (r.get("stats") or {}).get("reward_running") for r in iters
+    ]
+    rewards = [v for v in (_finite(v) for v in rewards) if v is not None]
+
+    # -- phase table (mean ms weighted by calls when present) --------------
+    phases: dict = {}
+    for r in records:
+        if r.get("kind") != "phase":
+            continue
+        name, ms = r.get("name"), _finite(r.get("ms"))
+        if name is None or ms is None:
+            continue
+        calls = r.get("calls")
+        calls = calls if isinstance(calls, int) and calls > 0 else 1
+        row = phases.setdefault(
+            name, {"ms_sum": 0.0, "calls": 0, "events": 0}
+        )
+        row["ms_sum"] += ms * calls
+        row["calls"] += calls
+        row["events"] += 1
+    phase_table = {
+        name: {
+            "mean_ms": row["ms_sum"] / row["calls"],
+            "calls": row["calls"],
+        }
+        for name, row in phases.items()
+    }
+
+    # -- health / recompile / faults --------------------------------------
+    health = Counter(
+        f"{r.get('check')}:{r.get('level')}"
+        for r in records
+        if r.get("kind") == "health"
+    )
+    recompiles = [r for r in records if r.get("kind") == "recompile"]
+    faults = sum(1 for r in records if r.get("kind") == "fault_injected")
+    recoveries = sum(1 for r in records if r.get("kind") == "recovery")
+
+    # -- memory ------------------------------------------------------------
+    programs: dict = {}
+    live_peak = None
+    for r in records:
+        if r.get("kind") != "memory":
+            continue
+        if r.get("scope") == "program":
+            programs[r.get("program")] = {
+                k: v for k, v in r.items() if k.endswith("_bytes")
+            }
+        elif r.get("scope") == "live":
+            b = _finite(r.get("live_buffer_bytes"))
+            if b is not None:
+                live_peak = b if live_peak is None else max(live_peak, b)
+
+    return {
+        "manifest": {
+            k: manifest.get(k)
+            for k in (
+                "config_hash", "backend", "jax_version", "device_count",
+                "git_sha", "driver", "n_iterations",
+            )
+        }
+        if manifest
+        else None,
+        "iterations": len(iters),
+        "last_iteration": iters[-1].get("iteration") if iters else None,
+        "last_stats": last_stats,
+        "final_reward_running": rewards[-1] if rewards else None,
+        "steady_iteration_ms": steady_ms,
+        "timesteps_per_sec": throughput,
+        "phases": phase_table,
+        "health": dict(sorted(health.items())),
+        "recompiles": {
+            "total": len(recompiles),
+            "unexpected": sum(
+                1 for r in recompiles if r.get("unexpected")
+            ),
+        },
+        "faults_injected": faults,
+        "recoveries": recoveries,
+        "memory": {
+            "programs": programs,
+            "peak_live_buffer_bytes": live_peak,
+        },
+        "events_total": dict(
+            Counter(r.get("kind") for r in records)
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+
+# direction: "time" (higher is worse), "rate" (lower is worse),
+# "bytes" (higher is worse)
+_METRIC_DIRECTIONS = {
+    "steady_iteration_ms": "time",
+    "timesteps_per_sec": "rate",
+}
+
+
+def _verdict(metric, base, new, threshold_pct, direction) -> dict:
+    row = {
+        "metric": metric,
+        "base": base,
+        "new": new,
+        "direction": direction,
+    }
+    if base is None or new is None:
+        row["verdict"] = "skipped"
+        row["delta_pct"] = None
+        return row
+    if base <= 0:
+        # a zero/negative baseline has no meaningful ratio. Growth from
+        # zero (e.g. a fully-fused program's temp_bytes going 0 → 2 GiB)
+        # must NOT auto-pass as "ok" — report it as skipped so a human
+        # sees the row; only a still-zero value is genuinely fine
+        row["delta_pct"] = None
+        row["verdict"] = "ok" if new <= max(base, 0) else "skipped"
+        return row
+    delta_pct = (new - base) / base * 100.0
+    row["delta_pct"] = delta_pct
+    factor = 1.0 + threshold_pct / 100.0
+    if direction == "rate":
+        regressed = new < base / factor
+        improved = new > base * factor
+    else:
+        regressed = new > base * factor
+        improved = new < base / factor
+    row["verdict"] = (
+        "regressed" if regressed else "improved" if improved else "ok"
+    )
+    return row
+
+
+def compare_runs(
+    base: dict,
+    new: dict,
+    threshold_pct: float = 20.0,
+    min_ms: float = 1.0,
+) -> dict:
+    """Regression verdicts between two :func:`summarize_run` outputs.
+
+    Returns ``{"verdicts": [...], "regressed": bool, "threshold_pct",
+    "min_ms"}`` — ``regressed`` is True when ANY verdict row regressed
+    (the CLI turns it into a nonzero exit)."""
+    verdicts = []
+
+    # per-phase mean ms — only phases both runs measured, above the floor
+    base_ph = base.get("phases") or {}
+    new_ph = new.get("phases") or {}
+    for name in sorted(set(base_ph) | set(new_ph)):
+        b = (base_ph.get(name) or {}).get("mean_ms")
+        n = (new_ph.get(name) or {}).get("mean_ms")
+        if b is not None and n is not None and max(b, n) < min_ms:
+            continue  # sub-floor phases are scheduler noise
+        verdicts.append(
+            _verdict(f"phase/{name}", b, n, threshold_pct, "time")
+        )
+
+    # scalar run metrics
+    for metric, direction in _METRIC_DIRECTIONS.items():
+        verdicts.append(
+            _verdict(
+                metric, base.get(metric), new.get(metric),
+                threshold_pct, direction,
+            )
+        )
+
+    # memory: live peak + per-program compiled footprints
+    b_mem = (base.get("memory") or {})
+    n_mem = (new.get("memory") or {})
+    verdicts.append(
+        _verdict(
+            "memory/peak_live_buffer_bytes",
+            b_mem.get("peak_live_buffer_bytes"),
+            n_mem.get("peak_live_buffer_bytes"),
+            threshold_pct, "bytes",
+        )
+    )
+    b_prog = b_mem.get("programs") or {}
+    n_prog = n_mem.get("programs") or {}
+    # union, not intersection: a program only one run measured (added,
+    # renamed, or dropped by a PR) must surface as a `skipped` row — an
+    # HBM-critical new program escaping the report entirely would
+    # violate the no-silent-verdict contract above
+    for pname in sorted(set(b_prog) | set(n_prog)):
+        for field in ("temp_bytes", "peak_estimate_bytes"):
+            verdicts.append(
+                _verdict(
+                    f"memory/{pname}/{field}",
+                    (b_prog.get(pname) or {}).get(field),
+                    (n_prog.get(pname) or {}).get(field),
+                    threshold_pct, "bytes",
+                )
+            )
+
+    return {
+        "verdicts": verdicts,
+        "regressed": any(v["verdict"] == "regressed" for v in verdicts),
+        "threshold_pct": threshold_pct,
+        "min_ms": min_ms,
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def _fmt_bytes(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024
+    return f"{v:.1f}TiB"
+
+
+def _fmt(v, nd=2) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def format_table(rows: list, headers: list) -> str:
+    """Plain-text column alignment (no deps — this renders over ssh on
+    the TPU host)."""
+    rows = [[str(c) for c in row] for row in rows]
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    def line(cells):
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([line(headers), sep] + [line(r) for r in rows])
+
+
+def render_summary(summary: dict) -> str:
+    """The single-run report as text: identity, throughput, phase table,
+    health/recompile/memory sections."""
+    out = []
+    man = summary.get("manifest") or {}
+    out.append(
+        "run: "
+        + " ".join(
+            f"{k}={man.get(k)}"
+            for k in ("config_hash", "backend", "driver", "git_sha")
+            if man.get(k) is not None
+        )
+    )
+    out.append(
+        f"iterations: {summary['iterations']}"
+        f" (last={summary['last_iteration']})"
+        f"  steady_iteration_ms={_fmt(summary['steady_iteration_ms'])}"
+        f"  timesteps/s={_fmt(summary['timesteps_per_sec'], 1)}"
+        f"  final_reward_running={_fmt(summary['final_reward_running'])}"
+    )
+    phases = summary.get("phases") or {}
+    if phases:
+        out.append("")
+        out.append(format_table(
+            [
+                [name, _fmt(row["mean_ms"]), row["calls"]]
+                for name, row in sorted(phases.items())
+            ],
+            ["phase", "mean_ms", "calls"],
+        ))
+    health = summary.get("health") or {}
+    rc = summary.get("recompiles") or {}
+    out.append("")
+    out.append(
+        "health: "
+        + (
+            ", ".join(f"{k}×{v}" for k, v in health.items())
+            if health
+            else "clean"
+        )
+        + f"  recompiles: {rc.get('total', 0)} "
+        f"({rc.get('unexpected', 0)} unexpected)"
+        + f"  faults: {summary.get('faults_injected', 0)}"
+        f"  recoveries: {summary.get('recoveries', 0)}"
+    )
+    mem = summary.get("memory") or {}
+    progs = mem.get("programs") or {}
+    if progs or mem.get("peak_live_buffer_bytes") is not None:
+        out.append(
+            "memory: peak_live="
+            + _fmt_bytes(mem.get("peak_live_buffer_bytes"))
+        )
+        if progs:
+            out.append(format_table(
+                [
+                    [
+                        name,
+                        _fmt_bytes(f.get("argument_bytes")),
+                        _fmt_bytes(f.get("temp_bytes")),
+                        _fmt_bytes(f.get("output_bytes")),
+                        _fmt_bytes(f.get("peak_estimate_bytes")),
+                    ]
+                    for name, f in sorted(progs.items())
+                ],
+                ["program", "args", "temp", "output", "peak_est"],
+            ))
+    return "\n".join(out)
+
+
+def render_comparison(result: dict) -> str:
+    rows = []
+    for v in result["verdicts"]:
+        base, new = v["base"], v["new"]
+        is_bytes = v["metric"].startswith("memory/")
+        fmt = _fmt_bytes if is_bytes else _fmt
+        rows.append([
+            v["metric"],
+            fmt(base),
+            fmt(new),
+            "-" if v["delta_pct"] is None else f"{v['delta_pct']:+.1f}%",
+            v["verdict"].upper() if v["verdict"] == "regressed"
+            else v["verdict"],
+        ])
+    table = format_table(
+        rows, ["metric", "base", "new", "delta", "verdict"]
+    )
+    tail = (
+        f"\nREGRESSED (threshold {result['threshold_pct']:g}%)"
+        if result["regressed"]
+        else f"\nOK (threshold {result['threshold_pct']:g}%)"
+    )
+    return table + tail

@@ -34,6 +34,18 @@ Kinds:
 * ``recovery`` — one recovery action taken by the resilience subsystem
   (``resilience/recovery.py``): what was done (``action``), why
   (``reason``), at which iteration.
+* ``memory`` — device-memory accounting (``obs/memory.py``).
+  ``scope="program"``: one jitted program's compiled
+  ``memory_analysis()`` — argument/temp/output bytes plus a peak
+  estimate — emitted once at first compile (HBM is the binding
+  constraint at the flagship shapes; this is where an OOM is predicted
+  instead of discovered). ``scope="live"``: per-iteration live-buffer
+  and ``device.memory_stats()`` gauges, feeding the steady-state leak
+  detector (``health:memory_leak``).
+* ``status`` — the live introspection endpoint announcing itself
+  (``obs/server.py``): the bound port and the paths served, so a log
+  reader (or a human tailing the JSONL) knows where to ``curl`` while
+  the run is in flight.
 
 Sinks are append-only and flush-on-write; the JSONL sink repairs a
 crash-truncated final line on open (``utils/metrics.repair_jsonl_tail``),
@@ -110,6 +122,32 @@ _REQUIRED = {
         "iteration": lambda v: isinstance(v, int)
         and not isinstance(v, bool),
     },
+    "memory": {
+        "scope": lambda v: v in ("program", "live"),
+    },
+    "status": {
+        "port": lambda v: isinstance(v, int)
+        and not isinstance(v, bool)
+        and 0 < v < 65536,
+    },
+}
+
+_BYTES = lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 0
+
+# memory events are scope-discriminated: the per-scope required fields
+# (checked by validate_event after the flat table above passes)
+_MEMORY_SCOPED = {
+    "program": {
+        "program": lambda v: isinstance(v, str) and v,
+        "argument_bytes": _BYTES,
+        "output_bytes": _BYTES,
+        "temp_bytes": _BYTES,
+    },
+    "live": {
+        "iteration": lambda v: isinstance(v, int)
+        and not isinstance(v, bool),
+        "live_buffer_bytes": _BYTES,
+    },
 }
 
 EVENT_KINDS = tuple(sorted(_REQUIRED))
@@ -139,6 +177,19 @@ def validate_event(rec: Any) -> list:
         elif not ok(rec[field]):
             errs.append(f"{kind}: field {field!r} failed its check "
                         f"(got {rec[field]!r})")
+    if kind == "memory":
+        # scope-discriminated record: each scope has its own required set
+        for field, ok in _MEMORY_SCOPED.get(rec.get("scope"), {}).items():
+            if field not in rec:
+                errs.append(
+                    f"memory[{rec.get('scope')}]: missing required "
+                    f"field {field!r}"
+                )
+            elif not ok(rec[field]):
+                errs.append(
+                    f"memory[{rec.get('scope')}]: field {field!r} failed "
+                    f"its check (got {rec[field]!r})"
+                )
     return errs
 
 

@@ -7,10 +7,13 @@ signatures worth watching continuously: KL-cap rollback STREAKS (the
 residual-aware solve tripled rollbacks before ``linesearch_kl_cap``
 landed), explained-variance collapse (a critic gone bad poisons every
 subsequent advantage estimate), nonfinite-guard trips inside the update
-(caught on device before they reach the entropy stat), and — async driver
+(caught on device before they reach the entropy stat), — async driver
 only — the StatsDrain queue hitting its bound (stop conditions are
 lagging; the backpressure documented in ``utils/async_pipe.py`` is
-engaged). Findings go through the event bus, so the pluggable sinks
+engaged), and — with ``--memory-accounting`` — live device bytes growing
+monotonically across a steady-state window (``observe_memory``, fed by
+``obs/memory.MemoryMonitor``: a leaked buffer per iteration kills a
+multi-hour run at an hour no log explains). Findings go through the event bus, so the pluggable sinks
 (console, JSONL, callback) all see one schema.
 
 Warnings are transition-gated: a streak emits when it CROSSES the
@@ -31,6 +34,17 @@ class HealthConfig:
     rollback_streak: int = 3       # consecutive KL rollbacks → warn
     ev_collapse: float = -0.5      # explained variance below this → warn
     ev_warmup_iterations: int = 10  # EV is legitimately garbage early on
+    memory_leak_window: int = 8    # consecutive iterations of strictly
+    #                                growing live bytes → warn (a steady-
+    #                                state training loop reuses donated
+    #                                buffers; sustained monotone growth
+    #                                means something retains a reference
+    #                                per iteration)
+    memory_leak_min_growth: int = 1 << 20  # total growth over the window
+    #                                must exceed this (bytes) — jitter in
+    #                                small host-side arrays is not a leak
+    memory_leak_warmup: int = 2    # first iterations allocate legitimately
+    #                                (compiles, carry buffers): skipped
 
 
 class HealthMonitor:
@@ -46,6 +60,9 @@ class HealthMonitor:
         self._streak_reported = False
         self._ev_reported = False
         self._drain_reported = False
+        self._mem_samples: list = []   # live-bytes window (leak rule)
+        self._mem_seen = 0
+        self._leak_reported = False
         self.findings: list = []
 
     def _emit(self, check: str, level: str, message: str,
@@ -110,6 +127,50 @@ class HealthMonitor:
                 ))
             elif ev >= self.cfg.ev_collapse:
                 self._ev_reported = False  # recovered: re-arm the check
+        return out
+
+    def observe_memory(self, iteration: int, live_bytes: int) -> list:
+        """The steady-state leak rule (fed by ``obs/memory.MemoryMonitor``
+        once per iteration): live device bytes growing STRICTLY at every
+        step of a ``memory_leak_window``-long window, by at least
+        ``memory_leak_min_growth`` in total, after the warmup iterations
+        → one ``health:memory_leak`` error for the run. An EQUAL sample
+        is skipped, not treated as a plateau: a fused k-iteration chunk
+        drains k rows at one host instant, so its k identical samples
+        are one observation — resetting on them would make the window
+        structurally unfillable on the fused driver. A SHRINK resets
+        the window: freed memory is not a leak."""
+        out = []
+        self._mem_seen += 1
+        if self._mem_seen <= self.cfg.memory_leak_warmup:
+            return out
+        w = self._mem_samples
+        if w and live_bytes == w[-1]:
+            return out
+        if w and live_bytes < w[-1]:
+            self._mem_samples = [live_bytes]
+            return out
+        w.append(live_bytes)
+        if len(w) > self.cfg.memory_leak_window:
+            del w[0]
+        if (
+            not self._leak_reported
+            and len(w) == self.cfg.memory_leak_window
+            and w[-1] - w[0] >= self.cfg.memory_leak_min_growth
+        ):
+            self._leak_reported = True
+            grown = w[-1] - w[0]
+            out.append(self._emit(
+                "memory_leak", "error",
+                f"live device bytes grew monotonically for "
+                f"{len(w)} consecutive iterations "
+                f"(+{grown} bytes, ~{grown // max(1, len(w) - 1)} "
+                "bytes/iteration) — something retains a buffer per "
+                "iteration (an unbounded snapshot window, a stats row "
+                "kept alive, a host list of device arrays)",
+                iteration,
+                live_bytes=live_bytes, window=len(w), growth_bytes=grown,
+            ))
         return out
 
     def observe_drain(self, depth: int, high_water: int,

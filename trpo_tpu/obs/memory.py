@@ -1,0 +1,202 @@
+"""Device-memory accounting: predict HBM, watch HBM, catch leaks.
+
+Device memory is the binding constraint for the ROADMAP's flagship
+shapes (a 2000×50k Humanoid batch plus donated update buffers): a run
+that OOMs three hours in wasted three hours, and a run that leaks a
+buffer per iteration dies at an hour no log explains. Three surfaces,
+all riding the PR 3 event bus as ``memory`` records:
+
+* **Compiled-program accounting** (``scope="program"``). XLA's
+  ``Compiled.memory_analysis()`` knows, at compile time, exactly how
+  many bytes a program needs for arguments, outputs and temporaries —
+  :func:`program_memory_analysis` lowers a jitted function against
+  ABSTRACT argument shapes (``jax.ShapeDtypeStruct``, shardings
+  preserved — no data materialized) and returns those numbers. The
+  drivers emit one event per core program (the fused iteration, the
+  host phase programs) right after warmup; ``bench.py`` embeds the same
+  fields next to each headline phase's timing. Cost: one extra XLA
+  compile per analyzed program (the AOT path cannot reuse the jit
+  cache's executable), which is why this is opt-in
+  (``--memory-accounting``) and happens once, before the run is marked
+  steady (so the recompile monitor does not count it as a retrace).
+* **Live gauges** (``scope="live"``). Per iteration:
+  ``jax.live_arrays()`` count/bytes and, where the backend reports it
+  (TPU/GPU — CPU returns None), ``device.memory_stats()``
+  bytes-in-use/peak. Sampled from ``Telemetry.on_iteration`` — i.e. on
+  the async driver's drain thread, off the critical path.
+* **Leak detection.** The gauges feed
+  ``HealthMonitor.observe_memory``: live bytes growing monotonically
+  across a full window of iterations in steady state is a retained
+  reference (a stats pytree kept alive, a snapshot window that forgot
+  its bound) — surfaced once as a ``health:memory_leak`` event.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Optional
+
+__all__ = [
+    "abstract_args",
+    "compiled_memory_fields",
+    "program_memory_analysis",
+    "live_memory_gauges",
+    "MemoryMonitor",
+]
+
+
+def abstract_args(tree: Any):
+    """A pytree of ``jax.ShapeDtypeStruct`` mirroring ``tree``'s arrays
+    (shape, dtype and — for committed jax arrays — sharding), suitable
+    for ``jitted.lower(*abstract)``: the lowering sees exactly the
+    specialization the real call compiled, without keeping any data
+    alive. Non-array leaves pass through untouched."""
+    import jax
+
+    def conv(x):
+        if isinstance(x, jax.Array):
+            try:
+                return jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=x.sharding
+                )
+            except Exception:
+                return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def compiled_memory_fields(compiled) -> Optional[dict]:
+    """The byte fields of one ``jax.stages.Compiled``'s
+    ``memory_analysis()``, or None when the backend reports nothing.
+    ``peak_estimate_bytes`` is the resident-set upper bound while the
+    program runs: arguments + outputs + temporaries − donation-aliased
+    bytes (aliased buffers are counted in both arguments and outputs
+    but exist once)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    fields = {}
+    for key, attr in (
+        ("argument_bytes", "argument_size_in_bytes"),
+        ("output_bytes", "output_size_in_bytes"),
+        ("temp_bytes", "temp_size_in_bytes"),
+        ("alias_bytes", "alias_size_in_bytes"),
+        ("generated_code_bytes", "generated_code_size_in_bytes"),
+    ):
+        v = getattr(ma, attr, None)
+        fields[key] = int(v) if v is not None else 0
+    fields["peak_estimate_bytes"] = max(
+        0,
+        fields["argument_bytes"]
+        + fields["output_bytes"]
+        + fields["temp_bytes"]
+        - fields["alias_bytes"],
+    )
+    return fields
+
+
+def program_memory_analysis(jitted_fn, args: tuple) -> Optional[dict]:
+    """Lower + compile ``jitted_fn`` against (abstract) ``args`` and
+    return :func:`compiled_memory_fields`. Failures come back as None
+    with a warning — memory accounting must never take down a run it
+    was meant to protect."""
+    try:
+        with warnings.catch_warnings():
+            # lowering a donating program against abstract args re-emits
+            # jax's "donated buffers were not usable" warning on backends
+            # without donation (CPU) — the real call already surfaced it
+            warnings.simplefilter("ignore")
+            compiled = jitted_fn.lower(*args).compile()
+        return compiled_memory_fields(compiled)
+    except Exception as e:
+        warnings.warn(
+            f"program memory analysis failed ({type(e).__name__}: {e})"
+        )
+        return None
+
+
+def live_memory_gauges() -> dict:
+    """Host-visible device-memory gauges: live jax array count/bytes,
+    plus the backend allocator's bytes-in-use/peak where reported
+    (``device.memory_stats()`` — TPU/GPU; CPU has no allocator stats
+    and contributes nothing)."""
+    import jax
+
+    arrs = jax.live_arrays()
+    gauges = {
+        "live_buffer_count": len(arrs),
+        "live_buffer_bytes": int(
+            sum(getattr(a, "nbytes", 0) or 0 for a in arrs)
+        ),
+    }
+    in_use = peak = None
+    for d in jax.local_devices():
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        in_use = (in_use or 0) + int(ms.get("bytes_in_use", 0))
+        peak = (peak or 0) + int(
+            ms.get("peak_bytes_in_use", ms.get("bytes_in_use", 0))
+        )
+    if in_use is not None:
+        gauges["device_bytes_in_use"] = in_use
+        gauges["device_peak_bytes"] = peak
+    return gauges
+
+
+class MemoryMonitor:
+    """The run-attached accountant: program events once, live gauges per
+    iteration, leak rule via the health monitor.
+
+    ``health`` is a ``HealthMonitor`` (shared with ``--health-checks``
+    when both are on, private otherwise) — the leak rule and its
+    windowed state live there, next to the other health rules."""
+
+    def __init__(self, bus=None, health=None):
+        self.bus = bus
+        self.health = health
+        self._programs_emitted: set = set()
+        self.program_fields: dict = {}
+
+    # -- compiled-program accounting ---------------------------------------
+
+    def emit_program(self, name: str, jitted_fn, args: tuple) -> None:
+        """Analyze + emit one program's compiled memory, once per name
+        (the drivers call this every chunk with whatever has compiled so
+        far; repeats are free)."""
+        if name in self._programs_emitted:
+            return
+        self._programs_emitted.add(name)
+        fields = program_memory_analysis(jitted_fn, args)
+        if fields is None:
+            return
+        self.program_fields[name] = fields
+        if self.bus is not None:
+            self.bus.emit("memory", scope="program", program=name,
+                          **fields)
+
+    # -- live gauges + leak detection --------------------------------------
+
+    def on_iteration(self, iteration: int) -> dict:
+        """Sample gauges, emit the ``scope="live"`` event, feed the leak
+        detector. Runs on whatever thread drains stats — never on the
+        device's critical path."""
+        gauges = live_memory_gauges()
+        if self.bus is not None:
+            self.bus.emit(
+                "memory", scope="live", iteration=int(iteration), **gauges
+            )
+        if self.health is not None:
+            self.health.observe_memory(
+                int(iteration), gauges["live_buffer_bytes"]
+            )
+        return gauges

@@ -1,27 +1,38 @@
 """``Telemetry`` — the one object a driver threads through a run.
 
 Bundles the event bus (sinks from CLI flags), the health monitor, the
-recompile monitor, and the iteration-windowed ``jax.profiler`` capture, so
-``agent.learn`` takes ONE optional argument instead of four and the CLI
-wiring lives in one place:
+recompile monitor, the iteration-windowed ``jax.profiler`` capture, and
+(PR 5) the live status endpoint + device-memory accountant, so
+``agent.learn`` takes ONE optional argument and the CLI wiring lives in
+one place:
 
 * ``--metrics-jsonl PATH``  → JSONL sink on the bus (manifest + iteration
-  + phase + health + recompile records, ``scripts/validate_events.py``
-  schema);
+  + phase + health + recompile + memory records,
+  ``scripts/validate_events.py`` schema);
 * ``--health-checks``       → health monitor + console sink for
   health/recompile findings;
+* ``--status-port P``       → ``obs/server.StatusSink`` on the bus + a
+  background HTTP server: ``GET /status`` (JSON snapshot of the run) and
+  ``GET /metrics`` (Prometheus text). ``P=0`` = ephemeral; the bound
+  port is announced as a ``status`` event right after the manifest.
+  Unset → no sink, no thread, event bytes untouched;
+* ``--memory-accounting``   → ``obs/memory.MemoryMonitor``: compiled
+  ``memory_analysis()`` per core jitted program (one extra compile each,
+  pre-steady), per-iteration live-buffer gauges, and the
+  ``health:memory_leak`` window rule;
 * ``--profile-dir D --profile-iteration N`` → a ``jax.profiler`` trace
   window around iteration N only (PhaseTimer names annotate the
   timeline), instead of tracing the entire run.
 
 Lifecycle (driven by ``agent.learn``): ``start_run(cfg, ...)`` emits the
-run manifest and attaches the recompile monitor; ``mark_steady()`` after
-warmup flips further compilations to "unexpected"; ``on_iteration`` runs
-the health rules on each drained stats row (thread-safe — the async
-driver calls it from the drain thread); ``finish_run(timer)`` closes the
-profile window, emits PhaseTimer summaries as ``phase`` events, and
-detaches the recompile monitor. The creator (CLI, test) calls ``close()``
-to flush/close the sinks.
+run manifest (and the ``status`` announcement) and attaches the recompile
+monitor; ``mark_steady()`` after warmup flips further compilations to
+"unexpected"; ``on_iteration`` runs the health rules and memory gauges on
+each drained stats row (thread-safe — the async driver calls it from the
+drain thread); ``finish_run(timer)`` closes the profile window, emits
+PhaseTimer summaries as ``phase`` events, marks the status snapshot
+finished, and detaches the recompile monitor. The creator (CLI, test)
+calls ``close()`` to flush/close the sinks and stop the status server.
 """
 
 from __future__ import annotations
@@ -44,6 +55,8 @@ class Telemetry:
         profile_dir: Optional[str] = None,
         profile_iteration: Optional[int] = None,
         health_config: Optional[HealthConfig] = None,
+        status_port: Optional[int] = None,
+        memory_accounting: bool = False,
         sinks=(),
     ):
         bus_sinks = list(sinks)
@@ -52,12 +65,44 @@ class Telemetry:
         if health_checks:
             # findings must be visible even without a JSONL file
             bus_sinks.append(ConsoleSink(kinds=("health", "recompile")))
+        elif memory_accounting and not events_jsonl and not sinks:
+            # --memory-accounting alone must not emit into a SINKLESS
+            # bus: the leak detector's health:memory_leak would vanish
+            # while the run still paid for the accounting — surface
+            # health findings on the console at minimum
+            bus_sinks.append(ConsoleSink(kinds=("health",)))
+        self.status = None
+        self.status_server = None
+        if status_port is not None:
+            # sink first (it must see every record from the manifest on),
+            # server below once the bus exists
+            from trpo_tpu.obs.server import StatusSink
+
+            self.status = StatusSink()
+            bus_sinks.append(self.status)
         self.bus = EventBus(*bus_sinks)
         self.health = (
             HealthMonitor(bus=self.bus, config=health_config)
             if health_checks
             else None
         )
+        self.memory = None
+        if memory_accounting:
+            from trpo_tpu.obs.memory import MemoryMonitor
+
+            # the leak rule lives in a HealthMonitor; share the
+            # --health-checks one when present so its findings list sees
+            # the leak too, otherwise a private instance (only the
+            # memory rule will ever fire on it)
+            self.memory = MemoryMonitor(
+                bus=self.bus,
+                health=self.health
+                or HealthMonitor(bus=self.bus, config=health_config),
+            )
+        if self.status is not None:
+            from trpo_tpu.obs.server import StatusServer
+
+            self.status_server = StatusServer(self.status, status_port)
         self.recompile = (
             RecompileMonitor(bus=self.bus) if recompile_monitor else None
         )
@@ -65,12 +110,22 @@ class Telemetry:
         self.profile_iteration = profile_iteration
         self._profiling = False
         self._profiled = False
+        self._timer = None   # attach_timer: live phase timings source
         self._closed = False
 
     # -- run lifecycle -----------------------------------------------------
 
     def start_run(self, config: Any = None, **extra) -> None:
         self.bus.emit("run_manifest", **manifest_fields(config, extra))
+        if self.status_server is not None:
+            # after the manifest: validators require the manifest first,
+            # and the log should say where the endpoint lives
+            self.bus.emit(
+                "status",
+                port=self.status_server.port,
+                url=self.status_server.url,
+                endpoints=list(self.status_server.ENDPOINTS),
+            )
         if self.recompile is not None:
             self.recompile.start()
 
@@ -78,17 +133,51 @@ class Telemetry:
         if self.recompile is not None:
             self.recompile.mark_steady()
 
+    def attach_timer(self, timer) -> None:
+        """The driver's PhaseTimer, so the live snapshot can carry
+        per-phase timings DURING the run (the bus only gets ``phase``
+        events at ``finish_run``, when a mid-run scrape can no longer
+        use them). ``summary()`` is lock-protected — safe to read from
+        the async driver's drain thread."""
+        self._timer = timer
+
     def on_iteration(self, iteration: int, stats: dict) -> None:
-        """Health rules on one drained stats row. Iteration EVENTS are
-        emitted by ``StatsLogger`` (which re-logs through the bus), so
-        this hook never double-emits them."""
+        """Health rules + memory gauges on one drained stats row.
+        Iteration EVENTS are emitted by ``StatsLogger`` (which re-logs
+        through the bus), so this hook never double-emits them."""
         if self.health is not None:
             self.health.observe_iteration(iteration, stats)
+        if self.memory is not None:
+            self.memory.on_iteration(iteration)
+        if self.status is not None and self._timer is not None:
+            self.status.set_phases(self._timer.summary())
 
     def observe_drain(self, depth: int, high_water: int,
                       maxsize: int) -> None:
         if self.health is not None:
             self.health.observe_drain(depth, high_water, maxsize)
+        if self.status is not None:
+            self.status.set_gauges(
+                depth=depth, high_water=high_water, maxsize=maxsize
+            )
+
+    # -- compiled-program memory accounting --------------------------------
+
+    @property
+    def wants_program_memory(self) -> bool:
+        """True when the drivers should capture abstract argument shapes
+        for their jitted programs (``--memory-accounting``)."""
+        return self.memory is not None
+
+    def emit_program_memory(self, programs: dict) -> None:
+        """``{name: (jitted_fn, abstract_args)}`` → one ``memory``
+        event per not-yet-analyzed program. Idempotent per name; the
+        drivers call it each chunk with whatever has compiled so far
+        (a fused tail chunk's program appears late)."""
+        if self.memory is None:
+            return
+        for name, (fn, args) in programs.items():
+            self.memory.emit_program(name, fn, args)
 
     # -- iteration-windowed profiler capture -------------------------------
 
@@ -128,9 +217,10 @@ class Telemetry:
 
     def finish_run(self, timer=None) -> None:
         """End-of-``learn`` hook: close an open profile window, emit the
-        PhaseTimer's per-phase summaries as ``phase`` events, and detach
-        the recompile monitor (post-run compiles — greedy eval, user code
-        — are not retraces). Safe to call more than once."""
+        PhaseTimer's per-phase summaries as ``phase`` events, mark the
+        status snapshot finished, and detach the recompile monitor
+        (post-run compiles — greedy eval, user code — are not retraces).
+        Safe to call more than once."""
         self._stop_profile()
         if timer is not None:
             for name, row in timer.summary().items():
@@ -141,6 +231,8 @@ class Telemetry:
                     calls=row["calls"],
                     total_s=row["total_s"],
                 )
+        if self.status is not None:
+            self.status.mark_finished()
         if self.recompile is not None:
             self.recompile.stop()
 
@@ -149,4 +241,6 @@ class Telemetry:
             return
         self._closed = True
         self.finish_run()
+        if self.status_server is not None:
+            self.status_server.close()
         self.bus.close()

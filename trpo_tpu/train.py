@@ -240,6 +240,28 @@ def build_parser() -> argparse.ArgumentParser:
         "--metrics-jsonl when set",
     )
     p.add_argument(
+        "--status-port",
+        type=int,
+        metavar="PORT",
+        help="serve a live introspection endpoint on 127.0.0.1:PORT while "
+        "training (trpo_tpu.obs.server): GET /status = JSON snapshot "
+        "(manifest, current iteration, reward stats, phase timings, "
+        "drain depth, health findings, recompile/memory gauges), GET "
+        "/metrics = the same in Prometheus text format. 0 = ephemeral "
+        "(the bound port is printed and logged as a `status` event); "
+        "unset = no server thread, event stream unchanged",
+    )
+    p.add_argument(
+        "--memory-accounting",
+        action="store_true",
+        help="device-memory accounting (trpo_tpu.obs.memory): emit each "
+        "core jitted program's compiled memory_analysis (args/temp/"
+        "output/peak bytes — one extra XLA compile per program, once, "
+        "during warmup) plus per-iteration live-buffer gauges as "
+        "`memory` events, and watch for monotonic live-bytes growth "
+        "(health:memory_leak)",
+    )
+    p.add_argument(
         "--evaluate",
         type=_positive_int,
         metavar="N_STEPS",
@@ -338,6 +360,10 @@ _OVERRIDES = {
     "env_step_timeout": "env_step_timeout",
     "on_preempt": "on_preempt",
     "inject_faults": "inject_faults",
+    # note: --status-port 0 (ephemeral) passes the truthy filter below
+    # because the generic loop tests `is not False`, not falsiness
+    "status_port": "status_port",
+    "memory_accounting": "memory_accounting",
 }
 
 
@@ -425,7 +451,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # telemetry before the checkpointer: a corrupt host-env sidecar found
     # during --resume surfaces as a health event on the same bus
     telemetry = None
-    if args.metrics_jsonl or args.health_checks or args.profile_iteration:
+    if (
+        args.metrics_jsonl
+        or args.health_checks
+        or args.profile_iteration
+        or cfg.status_port is not None
+        or cfg.memory_accounting
+    ):
         from trpo_tpu.obs import Telemetry
 
         telemetry = Telemetry(
@@ -434,7 +466,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             recompile_monitor=True,
             profile_dir=args.profile_dir if args.profile_iteration else None,
             profile_iteration=args.profile_iteration,
+            status_port=cfg.status_port,
+            memory_accounting=cfg.memory_accounting,
         )
+        if telemetry.status_server is not None:
+            # the one line a human needs to look at the run in flight
+            # (an ephemeral --status-port 0 is only knowable from here
+            # or from the `status` event in --metrics-jsonl)
+            print(
+                f"status endpoint: {telemetry.status_server.url}/status "
+                f"(and /metrics)",
+                flush=True,
+            )
 
     checkpointer = None
     state = None
