@@ -1362,6 +1362,118 @@ def host_pipeline_bench(
     }
 
 
+def serving_bench(
+    batch_shapes=(1, 8, 64),
+    closed_reps: int = 30,
+    open_requests: int = 120,
+    max_concurrency: int = 16,
+    deadline_ms: float = 5.0,
+):
+    """Latency SLOs for the policy-serving tier (ISSUE 6): p50/p99 and
+    actions/s per AOT batch rung, closed-loop and open-loop.
+
+    Closed loop: back-to-back ``engine.infer`` calls at EXACTLY the rung
+    size — the engine's intrinsic per-dispatch latency with zero queueing
+    (the executable is AOT-compiled, so no call ever traces). Open loop:
+    independent single-obs clients hammering the micro-batcher
+    concurrently — what an HTTP front end actually sees, queueing and
+    coalescing included (``mean_batch`` says how well the batcher filled
+    the rung; concurrency is capped at ``max_concurrency`` so the probe
+    measures the data plane, not this host's thread scheduler).
+    """
+    import threading as _threading
+
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.serve import MicroBatcher
+    from trpo_tpu.utils.metrics import quantile_nearest_rank as _q
+
+    agent = TRPOAgent(
+        "cartpole",
+        TRPOConfig(
+            n_envs=4, batch_timesteps=32, policy_hidden=(16,),
+            vf_hidden=(16,), seed=0,
+            serve_batch_shapes=tuple(batch_shapes),
+        ),
+    )
+    state = agent.init_state(seed=0)
+    engine = agent.serve_engine()
+    engine.load(state.policy_params, state.obs_norm, step=0)
+    rng = np.random.RandomState(0)
+    obs_shape = agent.obs_shape
+
+    rows = []
+    for rung in engine.batch_shapes:
+        obs = rng.randn(rung, *obs_shape).astype(np.float32)
+        for _ in range(3):  # prime host-side caches; compiles are done
+            engine.infer(obs)
+        lats = []
+        for _ in range(closed_reps):
+            t0 = time.perf_counter()
+            engine.infer(obs)
+            lats.append((time.perf_counter() - t0) * 1e3)
+        mean_s = (sum(lats) / len(lats)) / 1e3
+        closed = {
+            "p50_ms": round(_q(lats, 0.5), 4),
+            "p99_ms": round(_q(lats, 0.99), 4),
+            "actions_per_sec": round(rung / mean_s, 1),
+        }
+
+        batcher = MicroBatcher(engine, deadline_ms=deadline_ms)
+        conc = min(rung, max_concurrency)
+        per_client = max(1, open_requests // conc)
+        open_lats: list = []
+        lat_lock = _threading.Lock()
+
+        def _client(seed: int) -> None:
+            r = np.random.RandomState(seed)
+            mine = []
+            for _ in range(per_client):
+                one = r.randn(*obs_shape).astype(np.float32)
+                t0 = time.perf_counter()
+                batcher.submit(one).result(timeout=60.0)
+                mine.append((time.perf_counter() - t0) * 1e3)
+            with lat_lock:
+                open_lats.extend(mine)
+
+        threads = [
+            _threading.Thread(target=_client, args=(i,), daemon=True)
+            for i in range(conc)
+        ]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_s = time.perf_counter() - t_start
+        n_served = conc * per_client
+        open_loop = {
+            "concurrency": conc,
+            "requests": n_served,
+            "p50_ms": round(_q(open_lats, 0.5), 4),
+            "p99_ms": round(_q(open_lats, 0.99), 4),
+            "actions_per_sec": round(n_served / wall_s, 1),
+            "mean_batch": round(
+                batcher.requests_total / max(batcher.batches_total, 1), 2
+            ),
+        }
+        batcher.close()
+        rows.append({
+            "batch_shape": rung,
+            "closed_loop": closed,
+            "open_loop": open_loop,
+        })
+
+    dev = jax.devices()[0]
+    return {
+        "metric": "serving_slo_cartpole_mlp16",
+        "batch_shapes": list(engine.batch_shapes),
+        "deadline_ms": deadline_ms,
+        "backend": dev.platform,
+        "rows": rows,
+    }
+
+
 def _spread_pct(runs):
     if runs and len(runs) > 1 and min(runs) > 0:
         return (max(runs) - min(runs)) / min(runs) * 100
@@ -1684,6 +1796,16 @@ def main():
                 f"({type(e).__name__}: {e})"
             )
 
+    # Serving SLOs (ISSUE 6): p50/p99 + actions/s per AOT batch rung,
+    # closed- and open-loop — BENCH_SERVING=0 skips.
+    serving = None
+    if os.environ.get("BENCH_SERVING", "1") != "0":
+        try:
+            _progress("serving SLO bench (AOT act ladder, micro-batcher)")
+            serving = serving_bench()
+        except Exception as e:
+            _progress(f"serving bench failed ({type(e).__name__}: {e})")
+
     # Both solvers must agree — a fast wrong solve is worthless.
     cos = float(
         np.dot(np.asarray(x_ours), x_base)
@@ -1920,6 +2042,11 @@ def main():
                 #    (--host-async-pipeline); device_rtt_ms published
                 #    alongside so the hidden-latency claim is measurable --
                 "host_env_pipeline": host_pipe,
+                # -- serving SLOs (ISSUE 6): per AOT batch rung, p50/p99
+                #    latency + actions/s, closed-loop (bare engine) and
+                #    open-loop (concurrent clients through the
+                #    micro-batcher, queueing + coalescing included) --
+                "serving": serving,
                 # -- MFU-vs-width scaling study (VERDICT r2 item 2);
                 #    analytic FLOP model per width --
                 "width_study": [
@@ -2015,6 +2142,21 @@ def _emit_bench_events(artifact, tail_breakdown, host_pipe) -> None:
                         name=f"host_pipeline/{key}",
                         ms=host_pipe[key],
                     )
+        # serving SLO rows as phase records: one closed-loop p50 and one
+        # open-loop p99 per AOT batch rung — the latency pair the
+        # analyze gate judges (time-like: growth = regression)
+        for row in (artifact.get("serving") or {}).get("rows", []):
+            rung = row["batch_shape"]
+            bus.emit(
+                "phase",
+                name=f"serving/b{rung}_closed_p50",
+                ms=row["closed_loop"]["p50_ms"],
+            )
+            bus.emit(
+                "phase",
+                name=f"serving/b{rung}_open_p99",
+                ms=row["open_loop"]["p99_ms"],
+            )
         # one memory record per analyzed headline program — the same
         # scope="program" schema the training drivers emit under
         # --memory-accounting, so analyze_run.py --compare gates bench

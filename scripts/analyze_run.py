@@ -7,12 +7,17 @@
 
 Single file: a run report — per-phase time table, throughput (steady
 iteration ms + timesteps/s), health/recompile/fault summary, peak-memory
-report (compiled program footprints + live-buffer peak). With
-``--compare``, the per-phase and per-metric regression verdicts of
-``trpo_tpu.obs.analyze.compare_runs``: time-like metrics regress when
-they grow past the threshold, rate-like when they shrink past it,
-byte-like when they grow past it; sub-``--min-ms`` phases and metrics a
-run did not measure are skipped, never silently judged.
+report (compiled program footprints + live-buffer peak), and — for
+serving runs (``serve`` events from ``trpo_tpu/serve``) — the serving
+SLO block (requests/batches, actions/s, latency p50/p99, per-rung
+table). With ``--compare``, the per-phase and per-metric regression
+verdicts of ``trpo_tpu.obs.analyze.compare_runs``: time-like metrics
+(including serving latency p50/p99, overall and per padded rung)
+regress when they grow past the threshold, rate-like (timesteps/s,
+serving actions/s) when they shrink past it, byte-like when they grow
+past it; sub-``--min-ms`` phases and metrics a run did not measure are
+skipped, never silently judged — and serve rows appear only when at
+least one run actually served.
 
 Exit codes (the contract ``scripts/check.sh``'s regression gate relies
 on): **0** = summarized / compared clean, **1** = at least one metric

@@ -103,6 +103,24 @@ JAX_PLATFORMS=cpu python -m trpo_tpu.train --env "gymproc:CartPole-v1" \
 python scripts/validate_events.py "$CHAOS_TMP/chaos_events.jsonl" \
     "$CHAOS_TMP/resume_events.jsonl"
 
+echo "== serving smoke: hot-swap under concurrent load + SLO gate =="
+# ISSUE 6 acceptance: train a short CartPole checkpoint, serve it, fire
+# concurrent POST /act clients WHILE saving a newer checkpoint into the
+# watched directory — zero request errors, the hot reload must land
+# (healthz step flips), and the steady-state retrace count must be 0.
+# Two runs' serve event logs then validate and regression-compare:
+# latency rows judge time-like (grow = regress), actions/s rate-like
+# (shrink = regress). Threshold 500% swallows 2-core scheduler noise —
+# the latencies are deadline-dominated (~ms), so a real regression
+# (e.g. a retrace on the request path) overshoots it by orders.
+SERVE_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu python scripts/serve_smoke.py --tmp "$SERVE_TMP/base"
+JAX_PLATFORMS=cpu python scripts/serve_smoke.py --tmp "$SERVE_TMP/new"
+python scripts/validate_events.py "$SERVE_TMP/base/serve_events.jsonl" \
+    "$SERVE_TMP/new/serve_events.jsonl"
+python scripts/analyze_run.py "$SERVE_TMP/new/serve_events.jsonl" \
+    --compare "$SERVE_TMP/base/serve_events.jsonl" --threshold-pct 500
+
 echo "== pytest (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q
 

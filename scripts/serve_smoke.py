@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Serving smoke (``check.sh``): hot-swap under concurrent load.
+
+    python scripts/serve_smoke.py --tmp DIR
+
+The ISSUE 6 acceptance scenario, end to end in one process:
+
+1. train a short CartPole run and checkpoint it (step 2);
+2. stand up the serving tier (AOT engine at a 1/4/8 ladder,
+   micro-batcher, HTTP front end with the hot-reload watcher) against
+   that checkpoint directory, with the PR 3 recompile monitor armed;
+3. mark steady after the warmup request, then fire concurrent
+   ``POST /act`` clients WHILE training one more iteration and saving a
+   newer checkpoint (step 3) into the watched directory;
+4. assert: every request answered 200 with a well-formed action (zero
+   dropped/errored), the watcher hot-loaded step 3 (observed via
+   ``/healthz``), post-swap requests serve the new step, and the
+   steady-state retrace count is ZERO;
+5. leave ``DIR/serve_events.jsonl`` (manifest + status + serve +
+   reload-health records) for ``scripts/validate_events.py``.
+
+Exit 0 on success; any assertion failure exits nonzero with the reason.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _post_act(url: str, obs, timeout: float = 30.0):
+    req = urllib.request.Request(
+        url + "/act",
+        data=json.dumps({"obs": obs}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get_json(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="serve_smoke.py")
+    p.add_argument("--tmp", required=True, help="scratch directory")
+    p.add_argument("--clients", type=int, default=6)
+    p.add_argument("--requests-per-client", type=int, default=20)
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.obs.events import EventBus, JsonlSink, manifest_fields
+    from trpo_tpu.obs.recompile import RecompileMonitor
+    from trpo_tpu.serve import MicroBatcher, PolicyServer
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    os.makedirs(args.tmp, exist_ok=True)
+    ck_dir = os.path.join(args.tmp, "ck")
+    events_path = os.path.join(args.tmp, "serve_events.jsonl")
+
+    cfg = TRPOConfig(
+        n_envs=4, batch_timesteps=64, cg_iters=3, vf_train_steps=3,
+        policy_hidden=(16,), vf_hidden=(16,), seed=3,
+        serve_batch_shapes=(1, 4, 8), serve_deadline_ms=10.0,
+        serve_poll_interval=0.1,
+    )
+    agent = TRPOAgent("cartpole", cfg)
+
+    # -- 1. train a 3-iteration checkpoint (2 now, 1 more mid-serving) --
+    trainer_ck = Checkpointer(ck_dir)
+    state = agent.init_state()
+    for _ in range(2):
+        state, _stats = agent.run_iteration(state)
+    trainer_ck.save(2, state)
+
+    # -- 2. serving tier + event log + recompile monitor --
+    bus = EventBus(JsonlSink(events_path))
+    bus.emit(
+        "run_manifest",
+        **manifest_fields(cfg, extra={"driver": "serve_smoke"}),
+    )
+    engine = agent.serve_engine()
+    monitor = RecompileMonitor(bus=bus)
+    monitor.start()
+    # the monitor mutes compile records on the jax logger's OWN handlers;
+    # here absl has installed a root handler too (via orbax), which would
+    # spray every compile record over the smoke output — stop propagation
+    # while the monitor (a handler on the jax logger itself) consumes them
+    import logging
+
+    jax_logger = logging.getLogger("jax")
+    prev_propagate = jax_logger.propagate
+    jax_logger.propagate = False
+    errors: list = []
+    try:
+        batcher = MicroBatcher(
+            engine, deadline_ms=cfg.serve_deadline_ms, bus=bus
+        )
+        server = PolicyServer(
+            engine, batcher, port=0,
+            checkpointer=Checkpointer(ck_dir),
+            template=agent.init_state(),
+            poll_interval=cfg.serve_poll_interval,
+            bus=bus,
+        )
+        bus.emit(
+            "status", port=server.port, url=server.url,
+            endpoints=list(server.ENDPOINTS),
+        )
+        assert engine.loaded_step == 2, (
+            f"initial load should serve step 2, got {engine.loaded_step}"
+        )
+
+        # warmup request, then steady: every compilation from here on is
+        # an unexpected retrace (the AOT ladder compiled at load)
+        rng = np.random.RandomState(0)
+        status, out = _post_act(server.url, rng.randn(4).tolist())
+        assert status == 200 and "action" in out, out
+        monitor.mark_steady()
+
+        # -- 3. concurrent clients across a live checkpoint swap --
+        def client(seed: int) -> None:
+            r = np.random.RandomState(seed)
+            for _ in range(args.requests_per_client):
+                try:
+                    status, out = _post_act(
+                        server.url, (r.randn(4) * 2).tolist()
+                    )
+                    if status != 200 or not isinstance(
+                        out.get("action"), int
+                    ):
+                        errors.append(f"bad response: {status} {out}")
+                except Exception as e:
+                    errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(args.clients)
+        ]
+        for t in threads:
+            t.start()
+
+        # train one more iteration and save a NEWER checkpoint while the
+        # clients hammer the endpoint
+        state, _stats = agent.run_iteration(state)
+        trainer_ck.save(3, state)
+
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            _, health = _get_json(server.url + "/healthz")
+            if health.get("step") == 3:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"hot reload never picked up step 3 (healthz: {health})"
+            )
+
+        for t in threads:
+            t.join(timeout=60.0)
+            assert not t.is_alive(), "client thread hung"
+
+        # post-swap requests serve the new step
+        status, out = _post_act(server.url, rng.randn(4).tolist())
+        assert status == 200 and out["step"] == 3, out
+
+        # -- 4. the acceptance asserts --
+        assert not errors, f"{len(errors)} request errors: {errors[:5]}"
+        assert batcher.errors_total == 0, batcher.errors_total
+        assert server.reloads_total >= 1, server.reloads_total
+        retraces = monitor.unexpected_retraces()
+        assert not retraces, (
+            f"steady-state retraces during serving: {retraces}"
+        )
+        with urllib.request.urlopen(
+            server.url + "/metrics", timeout=10
+        ) as r:
+            metrics = r.read().decode()
+        assert "trpo_serve_requests_total" in metrics
+        total = args.clients * args.requests_per_client + 2
+        print(
+            f"serving smoke OK: {total} requests, "
+            f"{batcher.batches_total} batches, 0 errors, "
+            f"hot-reloaded step 2 -> 3 under load, 0 retraces"
+        )
+    finally:
+        jax_logger.propagate = prev_propagate
+        monitor.stop()
+        try:
+            server.close()
+            batcher.close()
+        except NameError:
+            pass
+        bus.close()
+        trainer_ck.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

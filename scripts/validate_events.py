@@ -6,7 +6,10 @@
 For each file: every line must parse as JSON and pass
 ``trpo_tpu.obs.events.validate_event`` — including the ISSUE 5 record
 types (``memory`` scope=program/live accounting, the ``status`` endpoint
-announcement); the first record must be a ``run_manifest`` (files are
+announcement) and the ISSUE 6 ``serve`` records (the serving tier's
+per-micro-batch requests/padded/queue_depth/latency_ms rows — a
+malformed serve record FAILS here, while readers stay warn-and-
+tolerate); the first record must be a ``run_manifest`` (files are
 self-describing); when per-iteration records are present, each must
 carry the device-accumulated solver counters (``cg_iters_total``,
 ``linesearch_trials_total``) — the ISSUE 3 acceptance contract; and

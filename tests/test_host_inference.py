@@ -134,3 +134,75 @@ def test_bad_host_inference_value_rejected():
     with pytest.raises(ValueError, match="host_inference"):
         TRPOConfig(host_inference="gpu")
 
+
+# ---------------------------------------------------------------------------
+# eval-mode act(): the serving-tier determinism contract (ISSUE 6)
+# ---------------------------------------------------------------------------
+#
+# agent.act(..., eval_mode=True) is the program the serving tier compiles
+# AOT (serve/engine.py). Its contract was never test-pinned before:
+# same obs -> same action with NO PRNG key consumed (the reference's
+# argmax at trpo_inksci.py:83), actions independent of the batch rung
+# the request padded to, and zero retraces once each ladder shape has
+# compiled.
+
+
+def test_eval_act_deterministic_and_keyless():
+    import jax
+
+    agent = TRPOAgent("native:cartpole", TRPOConfig(**_BASE))
+    state = agent.init_state(seed=0)
+    obs = np.asarray([0.02, -0.1, 0.03, 0.2], np.float32)
+    a_nokey, _ = agent.act(state, obs, eval_mode=True)
+    a_key1, _ = agent.act(
+        state, obs, key=jax.random.key(1), eval_mode=True
+    )
+    a_key2, _ = agent.act(
+        state, obs, key=jax.random.key(999), eval_mode=True
+    )
+    # argmax/mode: the key is never consumed, so WHICH key (or none at
+    # all) cannot change the action
+    np.testing.assert_array_equal(np.asarray(a_nokey), np.asarray(a_key1))
+    np.testing.assert_array_equal(np.asarray(a_key1), np.asarray(a_key2))
+    a_again, _ = agent.act(state, obs, eval_mode=True)
+    np.testing.assert_array_equal(np.asarray(a_nokey), np.asarray(a_again))
+
+
+def test_eval_act_shape_stable_across_batch_ladder():
+    agent = TRPOAgent("native:cartpole", TRPOConfig(**_BASE))
+    state = agent.init_state(seed=0)
+    rng = np.random.RandomState(0)
+    obs8 = rng.randn(8, 4).astype(np.float32)
+    per_rung = {}
+    for n in (1, 4, 8):
+        a, _ = agent.act(state, obs8[:n], eval_mode=True)
+        a = np.asarray(a)
+        assert a.shape == (n,)
+        per_rung[n] = a
+    # row i's action is independent of the batch it rode in — the
+    # padding-independence the serving ladder relies on
+    np.testing.assert_array_equal(per_rung[1], per_rung[8][:1])
+    np.testing.assert_array_equal(per_rung[4], per_rung[8][:4])
+
+
+def test_eval_act_zero_retrace_across_ladder():
+    from trpo_tpu.obs.recompile import RecompileMonitor
+
+    agent = TRPOAgent("native:cartpole", TRPOConfig(**_BASE))
+    state = agent.init_state(seed=0)
+    rng = np.random.RandomState(1)
+    shapes = (1, 4, 8)
+    for n in shapes:  # warmup: one compile per ladder shape
+        agent.act(state, rng.randn(n, 4).astype(np.float32),
+                  eval_mode=True)
+    mon = RecompileMonitor()
+    with mon:
+        mon.mark_steady()
+        for _ in range(3):
+            for n in shapes:
+                agent.act(
+                    state, rng.randn(n, 4).astype(np.float32),
+                    eval_mode=True,
+                )
+    assert mon.unexpected_retraces() == {}
+

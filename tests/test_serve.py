@@ -1,0 +1,659 @@
+"""Serving tier (ISSUE 6): AOT engine, micro-batcher, HTTP front end,
+checkpoint hot-reload, serve events, and the serving analyze gate.
+
+Contracts pinned here:
+
+* the engine pads requests to the AOT ladder and the action for a row is
+  independent of the rung it padded to; steady-state serving performs
+  ZERO retraces (recompile monitor);
+* the batcher coalesces to a full rung, flushes on the half-deadline,
+  survives engine failures (failing only that batch's requests), and
+  emits schema-valid ``serve`` events;
+* the HTTP front end scopes errors per request (400/503/500), serves
+  Prometheus ``trpo_serve_*``, and hot-reloads a newer marker-gated
+  checkpoint with zero dropped requests under concurrent load;
+* ``obs/analyze`` summarizes serving logs and ``compare_runs`` judges
+  latency time-like and actions/s rate-like, with the analyze CLI's
+  0/1/2 exit contract.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.obs.events import EventBus, validate_event
+from trpo_tpu.serve import InferenceEngine, MicroBatcher, PolicyServer
+
+_CFG = dict(
+    n_envs=4, batch_timesteps=32, cg_iters=2, vf_train_steps=2,
+    policy_hidden=(8,), vf_hidden=(8,), seed=7,
+    serve_batch_shapes=(1, 4, 8),
+)
+
+
+def _agent(**kw):
+    return TRPOAgent("cartpole", TRPOConfig(**{**_CFG, **kw}))
+
+
+@pytest.fixture(scope="module")
+def loaded_engine():
+    agent = _agent()
+    state = agent.init_state(seed=0)
+    engine = agent.serve_engine()
+    engine.load(state.policy_params, state.obs_norm, step=0)
+    return agent, engine
+
+
+def _post(url, payload, timeout=30.0):
+    data = payload if isinstance(payload, bytes) else json.dumps(
+        payload
+    ).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+def test_engine_ladder_padding_and_chunking(loaded_engine):
+    _, engine = loaded_engine
+    assert engine.batch_shapes == (1, 4, 8)
+    assert engine.padded_shape(1) == 1
+    assert engine.padded_shape(2) == 4
+    assert engine.padded_shape(5) == 8
+    assert engine.padded_shape(64) == 8  # over-sized batches chunk
+    rng = np.random.RandomState(0)
+    for n in (1, 3, 8, 20):  # 20 > top rung: chunked at 8
+        actions = engine.infer(rng.randn(n, 4).astype(np.float32))
+        assert actions.shape == (n,)
+
+
+def test_engine_actions_independent_of_padding_rung(loaded_engine):
+    _, engine = loaded_engine
+    rng = np.random.RandomState(1)
+    obs = rng.randn(8, 4).astype(np.float32)
+    a8 = engine.infer(obs)
+    a1 = np.stack([engine.infer(obs[i : i + 1])[0] for i in range(8)])
+    a4 = np.concatenate([engine.infer(obs[:4]), engine.infer(obs[4:])])
+    np.testing.assert_array_equal(a8, a1)
+    np.testing.assert_array_equal(a8, a4)
+
+
+def test_engine_is_deterministic(loaded_engine):
+    _, engine = loaded_engine
+    obs = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+    np.testing.assert_array_equal(engine.infer(obs), engine.infer(obs))
+
+
+def test_engine_zero_retraces_after_load():
+    from trpo_tpu.obs.recompile import RecompileMonitor
+
+    agent = _agent()
+    state = agent.init_state(seed=1)
+    engine = agent.serve_engine()
+    rng = np.random.RandomState(3)
+    mon = RecompileMonitor()
+    with mon:
+        engine.load(state.policy_params, state.obs_norm, step=0)
+        mon.mark_steady()  # the AOT ladder is the ONLY compilation
+        for _ in range(3):
+            for n in (1, 2, 4, 7, 8, 11):
+                engine.infer(rng.randn(n, 4).astype(np.float32))
+        # a hot swap must not retrace either (same shapes, new buffers)
+        state2 = agent.init_state(seed=2)
+        engine.load(state2.policy_params, state2.obs_norm, step=1)
+        engine.infer(rng.randn(5, 4).astype(np.float32))
+    assert mon.unexpected_retraces() == {}
+    assert engine.loaded_step == 1
+
+
+def test_engine_rejects_unloaded_and_bad_shapes(loaded_engine):
+    _, engine = loaded_engine
+    fresh = _agent().serve_engine()
+    with pytest.raises(RuntimeError, match="no params snapshot"):
+        fresh.infer(np.zeros((1, 4), np.float32))
+    with pytest.raises(ValueError, match="obs must be"):
+        engine.infer(np.zeros((2, 5), np.float32))
+    with pytest.raises(ValueError, match="obs must be"):
+        engine.infer(np.zeros(4, np.float32))  # missing batch axis
+    with pytest.raises(ValueError, match="batch_shapes"):
+        InferenceEngine(None, (4,), batch_shapes=())
+    with pytest.raises(ValueError, match="batch_shapes"):
+        InferenceEngine(None, (4,), batch_shapes=(0, 4))
+
+
+def test_engine_obs_norm_presence_contract():
+    """A normalized policy served without its statistics (or vice versa)
+    is silently-wrong-actions territory — both directions refuse."""
+    agent_n = TRPOAgent(
+        "cartpole", TRPOConfig(**{**_CFG, "normalize_obs": True})
+    )
+    state_n = agent_n.init_state(seed=0)
+    eng_n = agent_n.serve_engine()
+    assert eng_n.with_obs_norm
+    with pytest.raises(ValueError, match="obs_norm=None"):
+        eng_n.load(state_n.policy_params, None)
+    eng_n.load(state_n.policy_params, state_n.obs_norm, step=0)
+    assert eng_n.infer(np.zeros((2, 4), np.float32)).shape == (2,)
+
+    agent_r = _agent()
+    state_r = agent_r.init_state(seed=0)
+    eng_r = agent_r.serve_engine()
+    with pytest.raises(ValueError, match="with_obs_norm=True"):
+        eng_r.load(state_r.policy_params, state_n.obs_norm)
+
+
+def test_recurrent_agent_refuses_serve_engine():
+    agent = TRPOAgent(
+        "cartpole-po",
+        TRPOConfig(
+            n_envs=4, batch_timesteps=32, policy_hidden=(8,),
+            vf_hidden=(8,), policy_gru=8,
+        ),
+    )
+    with pytest.raises(ValueError, match="feedforward"):
+        agent.serve_engine()
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_coalesces_to_full_rung(loaded_engine):
+    _, engine = loaded_engine
+    events = []
+    bus = EventBus(lambda rec: events.append(rec))
+    # huge deadline: only the FULL trigger can dispatch this batch
+    batcher = MicroBatcher(engine, deadline_ms=5000.0, bus=bus)
+    try:
+        rng = np.random.RandomState(4)
+        futures = [
+            batcher.submit(rng.randn(4).astype(np.float32))
+            for _ in range(8)
+        ]
+        results = [f.result(timeout=30.0) for f in futures]
+        # futures resolve to (action, step-of-the-snapshot-that-ran)
+        assert all(a.shape == () for a, _step in results)
+        assert all(step == 0 for _a, step in results)
+        assert batcher.batches_total == 1
+        assert batcher.requests_total == 8
+    finally:
+        batcher.close()
+    (ev,) = [e for e in events if e["kind"] == "serve"]
+    assert ev["requests"] == 8 and ev["padded"] == 8
+    assert ev["queue_depth"] == 0 and ev["latency_ms"] >= 0
+    assert validate_event(ev) == []
+
+
+def test_batcher_deadline_flushes_partial_batch(loaded_engine):
+    _, engine = loaded_engine
+    events = []
+    bus = EventBus(lambda rec: events.append(rec))
+    batcher = MicroBatcher(engine, deadline_ms=40.0, bus=bus)
+    try:
+        t0 = time.perf_counter()
+        action, _step = batcher.submit(
+            np.zeros(4, np.float32)
+        ).result(timeout=30.0)
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        assert action.shape == ()
+        # dispatched by the half-deadline rule, not by a full batch
+        assert elapsed_ms < 5000
+    finally:
+        batcher.close()
+    (ev,) = [e for e in events if e["kind"] == "serve"]
+    assert ev["requests"] == 1 and ev["padded"] == 1
+
+
+def test_batcher_engine_failure_fails_only_that_batch():
+    class _FlakyEngine:
+        obs_shape = (2,)
+        obs_dtype = np.dtype(np.float32)
+        max_batch = 4
+
+        def __init__(self):
+            self.fail_next = True
+
+        def padded_shape(self, n):
+            return 4 if n > 1 else 1
+
+        def infer(self, obs, return_step=False):
+            if self.fail_next:
+                self.fail_next = False
+                raise RuntimeError("boom")
+            out = np.zeros(len(obs), np.int32)
+            return (out, 7) if return_step else out
+
+    batcher = MicroBatcher(_FlakyEngine(), deadline_ms=5.0)
+    try:
+        bad = batcher.submit(np.zeros(2, np.float32))
+        with pytest.raises(RuntimeError, match="boom"):
+            bad.result(timeout=30.0)
+        assert batcher.errors_total == 1
+        good = batcher.submit(np.zeros(2, np.float32))
+        action, step = good.result(timeout=30.0)
+        assert action == 0 and step == 7  # dispatcher survived
+    finally:
+        batcher.close()
+
+
+def test_batcher_close_drains_then_rejects(loaded_engine):
+    _, engine = loaded_engine
+    batcher = MicroBatcher(engine, deadline_ms=1000.0)
+    futures = [
+        batcher.submit(np.zeros(4, np.float32)) for _ in range(3)
+    ]
+    batcher.close()
+    # already-accepted requests still resolved (drain-on-close)
+    for f in futures:
+        action, _step = f.result(timeout=5.0)
+        assert action.shape == ()
+    with pytest.raises(RuntimeError, match="closed"):
+        batcher.submit(np.zeros(4, np.float32))
+
+
+def test_batcher_rejects_bad_config_and_shapes(loaded_engine):
+    _, engine = loaded_engine
+    with pytest.raises(ValueError, match="deadline_ms"):
+        MicroBatcher(engine, deadline_ms=0)
+    with pytest.raises(ValueError, match="max_queue"):
+        MicroBatcher(engine, max_queue=0)
+    batcher = MicroBatcher(engine, deadline_ms=5.0)
+    try:
+        with pytest.raises(ValueError, match="obs must have shape"):
+            batcher.submit(np.zeros((2, 4), np.float32))  # batched obs
+    finally:
+        batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# serve event schema (satellite: validator strict, readers tolerant)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_event_schema_strictness(tmp_path):
+    good = {
+        "v": 1, "kind": "serve", "t": 1.0,
+        "requests": 3, "padded": 4, "queue_depth": 0, "latency_ms": 2.5,
+    }
+    assert validate_event(good) == []
+    for broken in (
+        {**good, "requests": 0},          # no empty batches
+        {**good, "padded": "8"},          # wrong type
+        {**good, "latency_ms": -1},       # negative latency
+        {k: v for k, v in good.items() if k != "queue_depth"},
+    ):
+        assert validate_event(broken), broken
+
+    # the CLI validator FAILS a log with a malformed serve record
+    import sys
+    sys.path.insert(0, "scripts")
+    from validate_events import validate_file
+
+    from trpo_tpu.obs.events import manifest_fields
+
+    path = tmp_path / "serve.jsonl"
+    manifest = {"v": 1, "kind": "run_manifest", "t": 0.0,
+                **manifest_fields(None)}
+    with open(path, "w") as f:
+        f.write(json.dumps(manifest) + "\n")
+        f.write(json.dumps(good) + "\n")
+    assert validate_file(str(path)) == []
+    with open(path, "a") as f:
+        f.write(json.dumps({**good, "requests": 0}) + "\n")
+    errs = validate_file(str(path))
+    assert errs and any("requests" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+
+def test_policy_server_routes_and_errors(loaded_engine):
+    _, engine = loaded_engine
+    batcher = MicroBatcher(engine, deadline_ms=5.0)
+    srv = PolicyServer(engine, batcher, port=0)
+    try:
+        status, out = _post(srv.url + "/act", {"obs": [0.1, 0.2, 0.3, 0.4]})
+        assert status == 200
+        assert isinstance(out["action"], int)
+        assert out["step"] == engine.loaded_step
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/act", {"obs": [1.0, 2.0]})  # wrong shape
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/act", b"not json{")
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/act", {"nope": 1})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/nope", {"obs": [0, 0, 0, 0]})
+        assert e.value.code == 404
+
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=5) as r:
+            health = json.loads(r.read())
+        assert health["ok"] and health["requests_total"] >= 1
+        with urllib.request.urlopen(srv.url + "/metrics", timeout=5) as r:
+            body = r.read().decode()
+        assert "trpo_serve_requests_total" in body
+        assert 'trpo_serve_batch_shape_total{shape="1"}' in body
+        for ln in body.splitlines():
+            if ln and not ln.startswith("#"):
+                float(ln.rsplit(" ", 1)[1])  # prometheus-parseable
+    finally:
+        srv.close()
+        batcher.close()
+
+
+def test_policy_server_503_before_first_checkpoint():
+    agent = _agent()
+    engine = agent.serve_engine()  # never loaded
+    batcher = MicroBatcher(engine, deadline_ms=5.0)
+    srv = PolicyServer(engine, batcher, port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert e.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=5)
+        assert e.value.code == 503
+    finally:
+        srv.close()
+        batcher.close()
+
+
+def test_policy_server_checkpointer_template_pairing(loaded_engine):
+    _, engine = loaded_engine
+    batcher = MicroBatcher(engine, deadline_ms=5.0)
+    try:
+        with pytest.raises(ValueError, match="come together"):
+            PolicyServer(engine, batcher, port=0, checkpointer=object())
+    finally:
+        batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# hot reload across a live swap
+# ---------------------------------------------------------------------------
+
+
+def test_hot_reload_under_concurrent_load(tmp_path):
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    agent = _agent()
+    trainer_ck = Checkpointer(str(tmp_path / "ck"))
+    state = agent.init_state(seed=0)
+    state, _ = agent.run_iteration(state)
+    trainer_ck.save(1, state)
+
+    events = []
+    bus = EventBus(lambda rec: events.append(rec))
+    engine = agent.serve_engine()
+    batcher = MicroBatcher(engine, deadline_ms=5.0, bus=bus)
+    srv = PolicyServer(
+        engine, batcher, port=0,
+        checkpointer=Checkpointer(str(tmp_path / "ck")),
+        template=agent.init_state(),
+        poll_interval=0.05,
+        bus=bus,
+    )
+    errors = []
+    try:
+        assert engine.loaded_step == 1  # synchronous first load
+
+        def client(seed):
+            r = np.random.RandomState(seed)
+            for _ in range(12):
+                try:
+                    status, out = _post(
+                        srv.url + "/act", {"obs": r.randn(4).tolist()}
+                    )
+                    if status != 200:
+                        errors.append(status)
+                except Exception as e:  # noqa: BLE001 — collected below
+                    errors.append(repr(e))
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+
+        # a newer checkpoint lands WHILE the clients hammer /act
+        state, _ = agent.run_iteration(state)
+        trainer_ck.save(2, state)
+        deadline = time.time() + 30.0
+        while engine.loaded_step != 2 and time.time() < deadline:
+            time.sleep(0.02)
+        for t in threads:
+            t.join(timeout=60.0)
+        assert engine.loaded_step == 2, "hot reload never landed"
+        assert not errors, errors[:5]
+        assert batcher.errors_total == 0
+        assert srv.reloads_total >= 1
+        # the swap is announced on the bus and the new step serves
+        assert any(
+            e.get("check") == "serve_reload" for e in events
+        )
+        status, out = _post(srv.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert status == 200 and out["step"] == 2
+        # every serve record emitted under load is schema-valid
+        for ev in events:
+            assert validate_event(ev) == [], ev
+    finally:
+        srv.close()
+        batcher.close()
+        trainer_ck.close()
+
+
+def test_reload_failure_keeps_serving_last_good(tmp_path, loaded_engine):
+    """A checkpoint the watcher cannot restore (here: a template
+    mismatch) must surface as a health finding while the endpoint keeps
+    serving the last good snapshot."""
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    agent = _agent()
+    trainer_ck = Checkpointer(str(tmp_path / "ck"))
+    state = agent.init_state(seed=0)
+    trainer_ck.save(1, state)
+
+    events = []
+    bus = EventBus(lambda rec: events.append(rec))
+    engine = agent.serve_engine()
+    engine.load(state.policy_params, state.obs_norm, step=1)
+    batcher = MicroBatcher(engine, deadline_ms=5.0)
+    bad_template = {"totally": "wrong structure"}
+    srv = PolicyServer(
+        engine, batcher, port=0,
+        checkpointer=Checkpointer(str(tmp_path / "ck")),
+        template=bad_template,
+        poll_interval=0.05,
+        bus=bus,
+    )
+    try:
+        trainer_ck.save(2, state)
+        deadline = time.time() + 10.0
+        while time.time() < deadline and not any(
+            e.get("check") == "serve_reload_failed" for e in events
+        ):
+            time.sleep(0.02)
+        assert any(
+            e.get("check") == "serve_reload_failed" for e in events
+        )
+        assert engine.loaded_step == 1  # still the last good snapshot
+        status, _ = _post(srv.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert status == 200
+    finally:
+        srv.close()
+        batcher.close()
+        trainer_ck.close()
+
+
+# ---------------------------------------------------------------------------
+# analyze: serving summaries + the SLO compare gate
+# ---------------------------------------------------------------------------
+
+
+def _serve_log(path, latency_scale=1.0, n=20, t0=100.0):
+    from trpo_tpu.obs.events import manifest_fields
+
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "v": 1, "kind": "run_manifest", "t": t0,
+            **manifest_fields(None, extra={"driver": "serve"}),
+        }) + "\n")
+        for i in range(n):
+            f.write(json.dumps({
+                "v": 1, "kind": "serve", "t": t0 + 0.1 * (i + 1),
+                "requests": 2 + (i % 3), "padded": 4 if i % 2 else 8,
+                "queue_depth": i % 2,
+                "latency_ms": latency_scale * (2.0 + (i % 5)),
+            }) + "\n")
+
+
+def test_summarize_run_serving_block(tmp_path):
+    from trpo_tpu.obs.analyze import load_events, summarize_run
+
+    path = tmp_path / "serve.jsonl"
+    _serve_log(str(path))
+    summary = summarize_run(load_events(str(path)))
+    srv = summary["serving"]
+    assert srv["batches_total"] == 20
+    assert srv["requests_total"] == sum(2 + (i % 3) for i in range(20))
+    assert srv["actions_per_sec"] is not None
+    assert srv["latency_p50_ms"] is not None
+    assert srv["latency_p99_ms"] >= srv["latency_p50_ms"]
+    assert set(srv["shapes"]) == {"4", "8"}
+    assert srv["queue_depth_max"] == 1
+    # a training-only log has no serving block
+    assert summarize_run(
+        [{"kind": "iteration", "iteration": 1, "stats": {}}]
+    )["serving"] is None
+
+
+def test_compare_runs_serving_verdicts():
+    from trpo_tpu.obs.analyze import compare_runs
+
+    base = {
+        "serving": {
+            "latency_p50_ms": 2.0, "latency_p99_ms": 5.0,
+            "actions_per_sec": 1000.0,
+            "shapes": {"8": {"p50_ms": 2.0}},
+        }
+    }
+    slower = {
+        "serving": {
+            "latency_p50_ms": 6.0, "latency_p99_ms": 15.0,
+            "actions_per_sec": 300.0,
+            "shapes": {"8": {"p50_ms": 6.0}},
+        }
+    }
+    result = compare_runs(base, slower, threshold_pct=50.0)
+    by = {v["metric"]: v["verdict"] for v in result["verdicts"]}
+    assert by["serve/latency_p50_ms"] == "regressed"   # time-like: grew
+    assert by["serve/latency_p99_ms"] == "regressed"
+    assert by["serve/actions_per_sec"] == "regressed"  # rate-like: shrank
+    assert by["serve/shape8/p50_ms"] == "regressed"
+    assert result["regressed"]
+    # the improved direction reads as improved, not regressed
+    back = compare_runs(slower, base, threshold_pct=50.0)
+    by = {v["metric"]: v["verdict"] for v in back["verdicts"]}
+    assert by["serve/latency_p50_ms"] == "improved"
+    assert not back["regressed"]
+    # training-only comparisons grow NO serve rows
+    plain = compare_runs({}, {}, threshold_pct=50.0)
+    assert not any(
+        v["metric"].startswith("serve/") for v in plain["verdicts"]
+    )
+
+
+def test_analyze_cli_exit_contract_on_serving_logs(tmp_path):
+    import sys
+    sys.path.insert(0, "scripts")
+    from analyze_run import main as analyze_main
+
+    base = tmp_path / "base.jsonl"
+    same = tmp_path / "same.jsonl"
+    slow = tmp_path / "slow.jsonl"
+    _serve_log(str(base))
+    _serve_log(str(same))
+    _serve_log(str(slow), latency_scale=10.0)
+    # 0 = clean, 1 = regressed, 2 = unreadable (the documented contract)
+    assert analyze_main([str(same), "--compare", str(base)]) == 0
+    assert analyze_main([str(slow), "--compare", str(base)]) == 1
+    assert analyze_main([str(tmp_path / "missing.jsonl")]) == 2
+    # the single-run report renders the serving table
+    assert analyze_main([str(base)]) == 0
+
+
+def test_serve_cli_parser_and_overrides():
+    """The serve CLI's config plumbing (the live path is exercised by
+    the check.sh serving smoke): flags map onto the config fields that
+    shape the restore template and the serving knobs."""
+    import sys
+    sys.path.insert(0, "scripts")
+    from serve import build_parser
+
+    with pytest.raises(SystemExit):  # --checkpoint-dir is required
+        build_parser().parse_args([])
+    args = build_parser().parse_args([
+        "--checkpoint-dir", "/tmp/ck", "--n-envs", "4",
+        "--policy-hidden", "32,32", "--vf-hidden", "16",
+        "--batch-shapes", "1,2,4", "--deadline-ms", "7.5",
+        "--poll-interval", "0.2", "--serve-seconds", "1",
+    ])
+    assert args.checkpoint_dir == "/tmp/ck"
+    assert args.n_envs == 4
+    assert args.batch_shapes == "1,2,4"
+    assert args.deadline_ms == 7.5
+
+
+# ---------------------------------------------------------------------------
+# shared httpd plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_background_httpd_post_limits_and_handler_errors():
+    from trpo_tpu.utils.httpd import BackgroundHTTPServer
+
+    def boom():
+        raise RuntimeError("handler bug")
+
+    def echo(body):
+        return 200, "application/json", body or b"{}"
+
+    srv = BackgroundHTTPServer(
+        0,
+        get={"/boom": boom},
+        post={"/echo": echo},
+        max_body_bytes=64,
+    )
+    try:
+        status, out = _post(srv.url + "/echo", {"x": 1})
+        assert status == 200 and out == {"x": 1}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(srv.url + "/boom", timeout=5)
+        assert e.value.code == 500  # handler bug -> 500, server survives
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/echo", {"x": "y" * 200})
+        assert e.value.code == 413  # oversized body refused pre-read
+        status, out = _post(srv.url + "/echo", {"x": 2})
+        assert status == 200  # still serving after both failures
+    finally:
+        srv.close()

@@ -626,6 +626,48 @@ class TRPOAgent:
         return action, dist
 
     # ------------------------------------------------------------------
+    # serving (trpo_tpu/serve — ISSUE 6)
+    # ------------------------------------------------------------------
+
+    def serve_engine(self, batch_shapes=None, obs_dtype=None):
+        """An AOT policy-inference engine over this agent's policy
+        (``serve/engine.InferenceEngine``): the eval-mode ``act``
+        compiled ahead-of-time at a fixed batch-shape ladder
+        (``cfg.serve_batch_shapes`` by default), donation-free so a
+        hot-reloaded snapshot never invalidates an in-flight request.
+
+        Load it with a state's ``(policy_params, obs_norm)`` — from a
+        live ``TrainState`` or a ``Checkpointer.restore`` — and serve
+        through ``serve.MicroBatcher`` / ``serve.PolicyServer``.
+        Normalization follows the TRAINING placement: when this agent
+        normalizes observations (device-managed or host-adapter
+        statistics — both ride ``TrainState.obs_norm``), the engine
+        fuses ``normalize`` in front of the policy, so clients always
+        send raw observations. Feedforward policies only: a recurrent
+        policy's carry would make serving a session protocol."""
+        from trpo_tpu.serve.engine import InferenceEngine
+
+        if self.is_recurrent:
+            raise ValueError(
+                "serve_engine supports feedforward policies only — a "
+                "recurrent policy's hidden state is per-client session "
+                "state the stateless /act data plane cannot carry"
+            )
+        import jax.numpy as jnp
+
+        return InferenceEngine(
+            self.policy,
+            self.obs_shape,
+            batch_shapes=tuple(
+                batch_shapes
+                if batch_shapes is not None
+                else self.cfg.serve_batch_shapes
+            ),
+            with_obs_norm=self._obs_norm_on_device or self._obs_norm_host,
+            obs_dtype=obs_dtype if obs_dtype is not None else jnp.float32,
+        )
+
+    # ------------------------------------------------------------------
     # the fused iteration
     # ------------------------------------------------------------------
 

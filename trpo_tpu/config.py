@@ -376,6 +376,28 @@ class TRPOConfig:
     #                                Off by default: the extra compile is
     #                                real money at the flagship shapes.
 
+    # --- serving (trpo_tpu/serve — ISSUE 6) ------------------------------
+    serve_batch_shapes: Tuple[int, ...] = (1, 8, 64)  # AOT-compiled batch
+    #                                ladder for the inference engine
+    #                                (serve/engine.py): requests pad up to
+    #                                the nearest rung, so steady-state
+    #                                serving performs zero retraces;
+    #                                over-sized batches chunk at the top
+    #                                rung. Small ladders keep the compile
+    #                                bill bounded (one program per rung).
+    serve_deadline_ms: float = 10.0  # micro-batcher latency budget
+    #                                (serve/batcher.py): a batch
+    #                                dispatches when it reaches the top
+    #                                rung OR when the oldest queued
+    #                                request has spent HALF this budget
+    #                                waiting (the other half belongs to
+    #                                the inference itself)
+    serve_poll_interval: float = 1.0  # checkpoint hot-reload watcher
+    #                                (serve/server.py): seconds between
+    #                                Checkpointer.latest_step() polls;
+    #                                the marker gate means a torn save is
+    #                                never offered for loading
+
     # --- io --------------------------------------------------------------
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 10
@@ -457,6 +479,23 @@ class TRPOConfig:
             raise ValueError(
                 "requeue_exit_code must be in (0, 255], got "
                 f"{self.requeue_exit_code}"
+            )
+        if not self.serve_batch_shapes or any(
+            not isinstance(b, int) or isinstance(b, bool) or b < 1
+            for b in self.serve_batch_shapes
+        ):
+            raise ValueError(
+                "serve_batch_shapes must be a non-empty tuple of positive "
+                f"ints, got {self.serve_batch_shapes!r}"
+            )
+        if self.serve_deadline_ms <= 0:
+            raise ValueError(
+                f"serve_deadline_ms must be > 0, got {self.serve_deadline_ms}"
+            )
+        if self.serve_poll_interval <= 0:
+            raise ValueError(
+                "serve_poll_interval must be > 0, got "
+                f"{self.serve_poll_interval}"
             )
         if self.inject_faults:
             # fail at construction: a chaos run with an unparseable spec

@@ -25,6 +25,10 @@ Comparison semantics (:func:`compare_runs`):
 * byte-like metrics (program temp/peak bytes, live-buffer peak) regress
   when they GROW past the threshold — an HBM regression OOMs the
   flagship shape as surely as a slowdown misses the deadline;
+* serving runs (``serve`` events) are judged by the same rules: latency
+  p50/p99 (overall and per padded rung) are time-like, actions/s is
+  rate-like — the ISSUE 6 SLO gate; the rows appear only when at least
+  one run actually served;
 * phases below ``min_ms`` in BOTH runs are skipped (a 0.1 ms phase
   doubling is scheduler noise, not a regression), as are metrics absent
   from either run (no silent verdict about unmeasured things — they are
@@ -84,6 +88,70 @@ def _finite(v) -> Optional[float]:
 def _mean(vals: list) -> Optional[float]:
     vals = [v for v in (_finite(v) for v in vals) if v is not None]
     return sum(vals) / len(vals) if vals else None
+
+
+def _quantile(vals: list, q: float) -> Optional[float]:
+    """Nearest-rank quantile over the finite values (None when empty) —
+    the shared estimator (``utils/metrics.quantile_nearest_rank``), so a
+    scraped /metrics gauge and an analyzed event log tell the same
+    story."""
+    from trpo_tpu.utils.metrics import quantile_nearest_rank
+
+    return quantile_nearest_rank(
+        [v for v in (_finite(v) for v in vals) if v is not None], q
+    )
+
+
+def _summarize_serving(records: list) -> Optional[dict]:
+    """Aggregate the ``serve`` micro-batch records into the serving SLO
+    report: request/batch totals, actions/s over the serving span,
+    latency p50/p99 (per-batch oldest-request latency — the conservative,
+    SLO-relevant end), and a per-padded-rung breakdown."""
+    serves = [r for r in records if r.get("kind") == "serve"]
+    if not serves:
+        return None
+    lats = [r.get("latency_ms") for r in serves]
+    requests = sum(
+        r.get("requests") for r in serves
+        if isinstance(r.get("requests"), int)
+    )
+    times = [r.get("t") for r in serves if _finite(r.get("t")) is not None]
+    span = (max(times) - min(times)) if len(times) >= 2 else None
+    shapes: dict = {}
+    for r in serves:
+        rung = r.get("padded")
+        if rung is None:
+            continue
+        row = shapes.setdefault(str(rung), {"batches": 0, "requests": 0,
+                                            "lats": []})
+        row["batches"] += 1
+        if isinstance(r.get("requests"), int):
+            row["requests"] += r["requests"]
+        if _finite(r.get("latency_ms")) is not None:
+            row["lats"].append(r["latency_ms"])
+    return {
+        "requests_total": requests,
+        "batches_total": len(serves),
+        "mean_batch_size": requests / len(serves) if serves else None,
+        # span covers first→last dispatch; one lone batch has no rate
+        "actions_per_sec": (requests / span) if span else None,
+        "latency_p50_ms": _quantile(lats, 0.5),
+        "latency_p99_ms": _quantile(lats, 0.99),
+        "queue_depth_max": max(
+            (r.get("queue_depth") for r in serves
+             if _finite(r.get("queue_depth")) is not None),
+            default=None,
+        ),
+        "shapes": {
+            rung: {
+                "batches": row["batches"],
+                "requests": row["requests"],
+                "p50_ms": _quantile(row["lats"], 0.5),
+                "p99_ms": _quantile(row["lats"], 0.99),
+            }
+            for rung, row in shapes.items()
+        },
+    }
 
 
 def summarize_run(records: list) -> dict:
@@ -161,6 +229,8 @@ def summarize_run(records: list) -> dict:
             if b is not None:
                 live_peak = b if live_peak is None else max(live_peak, b)
 
+    serving = _summarize_serving(records)
+
     return {
         "manifest": {
             k: manifest.get(k)
@@ -191,6 +261,7 @@ def summarize_run(records: list) -> dict:
             "programs": programs,
             "peak_live_buffer_bytes": live_peak,
         },
+        "serving": serving,
         "events_total": dict(
             Counter(r.get("kind") for r in records)
         ),
@@ -207,6 +278,15 @@ _METRIC_DIRECTIONS = {
     "steady_iteration_ms": "time",
     "timesteps_per_sec": "rate",
 }
+
+
+def _rung_key(rung: str):
+    """Numeric sort for padded-rung keys ('8' before '64'), tolerating a
+    non-numeric key from a foreign log."""
+    try:
+        return (0, int(rung))
+    except ValueError:
+        return (1, rung)
 
 
 def _verdict(metric, base, new, threshold_pct, direction) -> dict:
@@ -288,6 +368,37 @@ def compare_runs(
             threshold_pct, "bytes",
         )
     )
+    # serving SLOs — only when at least one run served (training-only
+    # comparisons must not grow a block of always-skipped rows). Latency
+    # is time-like (grow = regress), actions/s is rate-like (shrink =
+    # regress) — the ISSUE 6 acceptance contract; per-rung p50 rows use
+    # the same union-not-intersection policy as the program-memory rows.
+    b_srv = base.get("serving") or {}
+    n_srv = new.get("serving") or {}
+    if b_srv or n_srv:
+        for metric, direction in (
+            ("latency_p50_ms", "time"),
+            ("latency_p99_ms", "time"),
+            ("actions_per_sec", "rate"),
+        ):
+            verdicts.append(
+                _verdict(
+                    f"serve/{metric}", b_srv.get(metric),
+                    n_srv.get(metric), threshold_pct, direction,
+                )
+            )
+        b_shapes = b_srv.get("shapes") or {}
+        n_shapes = n_srv.get("shapes") or {}
+        for rung in sorted(set(b_shapes) | set(n_shapes), key=_rung_key):
+            verdicts.append(
+                _verdict(
+                    f"serve/shape{rung}/p50_ms",
+                    (b_shapes.get(rung) or {}).get("p50_ms"),
+                    (n_shapes.get(rung) or {}).get("p50_ms"),
+                    threshold_pct, "time",
+                )
+            )
+
     b_prog = b_mem.get("programs") or {}
     n_prog = n_mem.get("programs") or {}
     # union, not intersection: a program only one run measured (added,
@@ -396,6 +507,34 @@ def render_summary(summary: dict) -> str:
         + f"  faults: {summary.get('faults_injected', 0)}"
         f"  recoveries: {summary.get('recoveries', 0)}"
     )
+    srv = summary.get("serving") or {}
+    if srv:
+        out.append("")
+        out.append(
+            f"serving: requests={srv.get('requests_total')}"
+            f" batches={srv.get('batches_total')}"
+            f" actions/s={_fmt(srv.get('actions_per_sec'), 1)}"
+            f" p50={_fmt(srv.get('latency_p50_ms'))}ms"
+            f" p99={_fmt(srv.get('latency_p99_ms'))}ms"
+            f" queue_max={srv.get('queue_depth_max')}"
+        )
+        shapes = srv.get("shapes") or {}
+        if shapes:
+            out.append(format_table(
+                [
+                    [
+                        rung,
+                        row.get("batches"),
+                        row.get("requests"),
+                        _fmt(row.get("p50_ms")),
+                        _fmt(row.get("p99_ms")),
+                    ]
+                    for rung, row in sorted(
+                        shapes.items(), key=lambda kv: _rung_key(kv[0])
+                    )
+                ],
+                ["padded", "batches", "requests", "p50_ms", "p99_ms"],
+            ))
     mem = summary.get("memory") or {}
     progs = mem.get("programs") or {}
     if progs or mem.get("peak_live_buffer_bytes") is not None:

@@ -46,6 +46,12 @@ Kinds:
   (``obs/server.py``): the bound port and the paths served, so a log
   reader (or a human tailing the JSONL) knows where to ``curl`` while
   the run is in flight.
+* ``serve`` — one micro-batch dispatched by the policy-serving tier
+  (``serve/batcher.py``): requests coalesced, padded batch rung, queue
+  depth left behind, oldest-request latency. ``obs/analyze.py``
+  aggregates these into p50/p99 latency and actions/s so
+  ``analyze_run.py --compare`` regression-gates serving runs like
+  training runs.
 
 Sinks are append-only and flush-on-write; the JSONL sink repairs a
 crash-truncated final line on open (``utils/metrics.repair_jsonl_tail``),
@@ -129,6 +135,24 @@ _REQUIRED = {
         "port": lambda v: isinstance(v, int)
         and not isinstance(v, bool)
         and 0 < v < 65536,
+    },
+    "serve": {
+        # one record per micro-batch the serving tier dispatched
+        # (serve/batcher.py): how many real requests coalesced, which
+        # ladder rung the batch padded to, what was left waiting, and
+        # the oldest coalesced request's end-to-end latency
+        "requests": lambda v: isinstance(v, int)
+        and not isinstance(v, bool)
+        and v >= 1,
+        "padded": lambda v: isinstance(v, int)
+        and not isinstance(v, bool)
+        and v >= 1,
+        "queue_depth": lambda v: isinstance(v, int)
+        and not isinstance(v, bool)
+        and v >= 0,
+        "latency_ms": lambda v: isinstance(v, (int, float))
+        and not isinstance(v, bool)
+        and v >= 0,
     },
 }
 

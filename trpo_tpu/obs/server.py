@@ -35,7 +35,6 @@ Contracts (test-pinned in ``tests/test_introspection.py``):
 
 from __future__ import annotations
 
-import http.server
 import json
 import math
 import threading
@@ -370,62 +369,37 @@ class StatusServer:
 
     Binds ``host:port`` at construction (``port=0`` = OS-assigned; read
     the result from ``.port``) and serves on a daemon thread until
-    :meth:`close`. Handler threads are daemons too — a hung client never
-    blocks interpreter exit.
+    :meth:`close` — the shared plumbing (daemon handler threads,
+    silenced logs/errors, address reuse) lives in
+    ``utils/httpd.BackgroundHTTPServer``, which the policy-serving
+    front end (``serve/server.py``) reuses.
     """
 
     ENDPOINTS = ("/status", "/metrics")
 
     def __init__(self, sink: StatusSink, port: int,
                  host: str = "127.0.0.1"):
+        from trpo_tpu.utils.httpd import BackgroundHTTPServer
+
         self.sink = sink
 
-        class _Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(handler):  # noqa: N805 — handler, not self
-                path = handler.path.split("?", 1)[0]
-                if path in ("/status", "/"):
-                    body = json.dumps(
-                        _json_safe(self.sink.snapshot)
-                    ).encode()
-                    ctype = "application/json"
-                elif path == "/metrics":
-                    body = render_prometheus(self.sink.snapshot).encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
-                else:
-                    handler.send_error(404, "have /status and /metrics")
-                    return
-                handler.send_response(200)
-                handler.send_header("Content-Type", ctype)
-                handler.send_header("Content-Length", str(len(body)))
-                handler.end_headers()
-                handler.wfile.write(body)
+        def _status():
+            body = json.dumps(_json_safe(self.sink.snapshot)).encode()
+            return 200, "application/json", body
 
-            def log_message(handler, *args):  # noqa: N805
-                pass  # scrapes must not spray the training console
+        def _metrics():
+            body = render_prometheus(self.sink.snapshot).encode()
+            return 200, "text/plain; version=0.0.4; charset=utf-8", body
 
-        class _Server(http.server.ThreadingHTTPServer):
-            daemon_threads = True
-            # a relaunched run must be able to rebind the same --status-port
-            # immediately (TIME_WAIT would otherwise hold it for minutes)
-            allow_reuse_address = True
-
-            def handle_error(server, request, client_address):  # noqa: N805
-                # a scraper dropping the connection mid-response
-                # (timeout, `curl | head`) raises in wfile.write; the
-                # default handler tracebacks onto the training console —
-                # same silence contract as log_message above
-                pass
-
-        self._httpd = _Server((host, port), _Handler)
-        self.host = host
-        self.port = int(self._httpd.server_address[1])
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            kwargs={"poll_interval": 0.1},
-            name="obs-status-server",
-            daemon=True,
+        self._httpd = BackgroundHTTPServer(
+            port,
+            host=host,
+            get={"/": _status, "/status": _status, "/metrics": _metrics},
+            not_found="have /status and /metrics",
+            thread_name="obs-status-server",
         )
-        self._thread.start()
+        self.host = host
+        self.port = self._httpd.port
 
     @property
     def url(self) -> str:
@@ -435,6 +409,4 @@ class StatusServer:
         httpd, self._httpd = self._httpd, None
         if httpd is None:
             return
-        httpd.shutdown()
-        httpd.server_close()
-        self._thread.join(timeout=5.0)
+        httpd.close()

@@ -1,0 +1,31 @@
+"""Policy-inference serving tier (ISSUE 6 tentpole).
+
+The paper's agent has exactly two capabilities — ``learn()`` and
+``act(state)`` — and five PRs industrialized only the first. This
+package is the second one as a data plane:
+
+* :mod:`trpo_tpu.serve.engine` — :class:`InferenceEngine`: the
+  ``eval_mode`` act program compiled ahead-of-time at a small ladder of
+  fixed batch shapes; requests pad up to the nearest rung so
+  steady-state serving performs ZERO retraces. Donation-free — a params
+  snapshot swapped mid-flight never invalidates an in-flight call.
+* :mod:`trpo_tpu.serve.batcher` — :class:`MicroBatcher`: a bounded
+  queue coalescing concurrent requests under a latency deadline
+  (dispatch when full, or when the oldest request's deadline budget is
+  half-spent), emitting one ``serve`` event per dispatched batch on the
+  run-event bus.
+* :mod:`trpo_tpu.serve.server` — :class:`PolicyServer`: the stdlib HTTP
+  front end (``POST /act``, ``GET /healthz``, ``GET /metrics``) with a
+  background checkpoint watcher hot-swapping the params snapshot from
+  ``Checkpointer.latest_step()`` (marker-gated — a torn save is never
+  loaded) with zero dropped or mis-served requests.
+
+``scripts/serve.py`` is the CLI; ``bench.py``'s ``serving`` block and
+``scripts/analyze_run.py --compare`` carry the latency/throughput SLOs.
+"""
+
+from trpo_tpu.serve.batcher import MicroBatcher
+from trpo_tpu.serve.engine import InferenceEngine
+from trpo_tpu.serve.server import PolicyServer
+
+__all__ = ["InferenceEngine", "MicroBatcher", "PolicyServer"]

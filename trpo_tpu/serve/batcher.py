@@ -1,0 +1,201 @@
+"""Request micro-batcher: coalesce concurrent ``act`` requests under a
+latency deadline.
+
+A TPU answers a padded batch-8 inference in essentially the time of a
+batch-1 — the way to serve traffic is to NOT dispatch each request
+alone. The batcher is a bounded queue plus one dispatcher thread:
+
+* requests enqueue with their arrival time and a ``Future``;
+* the dispatcher sends a batch when the queue reaches the engine's top
+  rung (**full**) or when the oldest request has spent HALF its
+  ``deadline_ms`` budget waiting (**deadline**) — half, because the
+  inference itself still has to fit inside the other half;
+* the batch pads up to the engine ladder's nearest rung
+  (``serve/engine.py``), per-request actions come back through the
+  futures, and one ``serve`` event (requests coalesced, padded rung,
+  queue depth left behind, oldest-request latency) goes on the run-event
+  bus — the same JSONL stream training emits, so
+  ``scripts/analyze_run.py --compare`` judges serving runs too.
+
+Backpressure: the queue is bounded (``max_queue``); ``submit`` blocks
+when it is full, so a traffic spike turns into client latency instead
+of unbounded process memory — the same bound-not-buffer policy as the
+PR 5 ``StatsDrain``. An engine failure fails exactly the requests in
+that batch (their futures carry the exception); the dispatcher thread
+survives and keeps serving.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["MicroBatcher"]
+
+
+class _Pending:
+    __slots__ = ("obs", "t", "future")
+
+    def __init__(self, obs, t: float):
+        self.obs = obs
+        self.t = t
+        self.future: Future = Future()
+
+
+class MicroBatcher:
+    """Deadline-bounded request coalescing in front of an
+    :class:`~trpo_tpu.serve.engine.InferenceEngine`."""
+
+    def __init__(
+        self,
+        engine,
+        deadline_ms: float = 10.0,
+        max_queue: int = 1024,
+        bus=None,
+        latency_window: int = 2048,
+    ):
+        if deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.engine = engine
+        self.deadline_ms = float(deadline_ms)
+        self.max_queue = int(max_queue)
+        self.bus = bus
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._closed = False
+        # observability (read by the /metrics handler): counters under
+        # _cond, the latency window under its own lock so a metrics
+        # scrape never contends with submit/dispatch
+        self.requests_total = 0
+        self.batches_total = 0
+        self.errors_total = 0
+        self.queue_high_water = 0
+        self._lat_lock = threading.Lock()
+        self._latencies_ms: deque = deque(maxlen=latency_window)
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- client side -------------------------------------------------------
+
+    def submit(self, obs) -> Future:
+        """Enqueue ONE observation; the returned future resolves to
+        ``(action, step)`` — the action and the checkpoint step of the
+        snapshot that actually computed it (captured inside the engine
+        call, so a hot swap racing the response can never mislabel an
+        old snapshot's action with the new step). Blocks while the queue
+        is at its bound (backpressure); raises ``RuntimeError`` after
+        :meth:`close`."""
+        obs = np.asarray(obs, self.engine.obs_dtype)
+        if obs.shape != self.engine.obs_shape:
+            raise ValueError(
+                f"obs must have shape {self.engine.obs_shape}, "
+                f"got {obs.shape}"
+            )
+        pending = _Pending(obs, time.perf_counter())
+        with self._cond:
+            while len(self._queue) >= self.max_queue and not self._closed:
+                self._cond.wait(0.05)
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._queue.append(pending)
+            self.requests_total += 1
+            self.queue_high_water = max(
+                self.queue_high_water, len(self._queue)
+            )
+            self._cond.notify_all()
+        return pending.future
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def latency_quantiles_ms(self, qs=(0.5, 0.99)) -> dict:
+        """Nearest-rank quantiles over the recent per-request latency
+        window (empty dict before the first completed request) — the
+        shared estimator, so these /metrics gauges agree with the
+        analyze report and the bench block."""
+        from trpo_tpu.utils.metrics import quantile_nearest_rank
+
+        with self._lat_lock:
+            lats = list(self._latencies_ms)
+        if not lats:
+            return {}
+        return {q: quantile_nearest_rank(lats, q) for q in qs}
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _loop(self) -> None:
+        full = self.engine.max_batch
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if not self._queue and self._closed:
+                    return
+                # dispatch when full, when the oldest request's deadline
+                # budget is half-spent, or when draining at close
+                age_ms = (time.perf_counter() - self._queue[0].t) * 1e3
+                budget_ms = self.deadline_ms / 2.0 - age_ms
+                if (
+                    len(self._queue) < full
+                    and budget_ms > 0
+                    and not self._closed
+                ):
+                    self._cond.wait(budget_ms / 1e3)
+                    continue  # re-evaluate: more requests may have landed
+                batch = [
+                    self._queue.popleft()
+                    for _ in range(min(full, len(self._queue)))
+                ]
+                depth_after = len(self._queue)
+                self._cond.notify_all()  # wake submitters blocked on space
+            self._dispatch(batch, depth_after)
+
+    def _dispatch(self, batch, depth_after: int) -> None:
+        obs = np.stack([p.obs for p in batch], axis=0)
+        rung = self.engine.padded_shape(len(batch))
+        try:
+            actions, step = self.engine.infer(obs, return_step=True)
+        except Exception as e:
+            # fail THESE requests; the dispatcher survives for the next
+            with self._cond:
+                self.errors_total += len(batch)
+            for p in batch:
+                p.future.set_exception(e)
+            return
+        done = time.perf_counter()
+        lats = [(done - p.t) * 1e3 for p in batch]
+        with self._lat_lock:
+            self._latencies_ms.extend(lats)
+        for p, action in zip(batch, actions):
+            p.future.set_result((np.asarray(action), step))
+        with self._cond:
+            self.batches_total += 1
+        if self.bus is not None:
+            self.bus.emit(
+                "serve",
+                requests=len(batch),
+                padded=rung,
+                queue_depth=depth_after,
+                latency_ms=max(lats),
+            )
+
+    def close(self) -> None:
+        """Stop accepting requests, drain what is queued, and join the
+        dispatcher — every already-accepted future still resolves."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=30.0)

@@ -1,0 +1,204 @@
+"""AOT policy-inference engine: ``act()`` at a fixed ladder of batch shapes.
+
+Training compiles programs lazily and tolerates a warmup retrace;
+serving cannot — a retrace on the request path is a multi-second p99
+spike. So the engine compiles the eval-mode act program **ahead of
+time** (``jax.jit(...).lower(...).compile()``) at a small ladder of
+fixed batch shapes (default 1/8/64) when the first params snapshot is
+loaded, and every later request pads up to the nearest rung: after
+:meth:`load` returns, the steady state performs ZERO traces and ZERO
+compilations (pinned by ``tests/test_serve.py`` via the PR 3 recompile
+monitor).
+
+The program is **donation-free** (unlike every training entry point in
+``agent.py``): a hot-reload swaps ``self._snapshot`` by reference while
+requests compiled against the OLD params may still be in flight — their
+buffers must stay valid until the last reader drops them. Snapshot
+reads/writes are single attribute operations (atomic in CPython), so a
+request sees either the old params or the new ones, never a mix.
+
+Determinism contract (the reference's eval-mode argmax,
+``trpo_inksci.py:83``): same observation → same action, no PRNG key
+consumed, and the action for row i is independent of the rung the batch
+padded to (pinned in ``tests/test_host_inference.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["InferenceEngine"]
+
+
+class InferenceEngine:
+    """AOT-compiled eval-mode ``act`` over a swappable params snapshot.
+
+    Feedforward policies only: serving is stateless per request, and a
+    recurrent policy's carry would make it a session protocol — a
+    different subsystem. ``with_obs_norm`` folds ``normalize(stats,
+    obs)`` in front of the policy (the stats ride the snapshot, so a
+    hot-reload updates them atomically with the params); clients always
+    send RAW observations.
+    """
+
+    def __init__(
+        self,
+        policy,
+        obs_shape: Tuple[int, ...],
+        batch_shapes: Tuple[int, ...] = (1, 8, 64),
+        with_obs_norm: bool = False,
+        obs_dtype=jnp.float32,
+    ):
+        if not batch_shapes or any(
+            not isinstance(b, int) or b < 1 for b in batch_shapes
+        ):
+            raise ValueError(
+                f"batch_shapes must be positive ints, got {batch_shapes!r}"
+            )
+        self.policy = policy
+        self.obs_shape = tuple(obs_shape)
+        self.batch_shapes = tuple(sorted(set(int(b) for b in batch_shapes)))
+        self.max_batch = self.batch_shapes[-1]
+        self.with_obs_norm = bool(with_obs_norm)
+        self.obs_dtype = np.dtype(obs_dtype)
+
+        def _act(params, obs_norm, obs):
+            if self.with_obs_norm:
+                from trpo_tpu.utils.normalize import normalize
+
+                obs = normalize(obs_norm, obs)
+            dist = policy.apply(params, obs)
+            return policy.dist.mode(dist)
+
+        self._act = _act
+        self._compiled: dict = {}       # rung -> AOT-compiled executable
+        self._snapshot = None           # (params, obs_norm, step) — swapped
+        #                                 atomically by reference; never
+        #                                 mutated in place
+        self._lock = threading.Lock()   # counters only — never the hot path
+        #                                 of snapshot reads
+        self.shape_counts: Counter = Counter()  # rung -> dispatches
+        self.infer_calls = 0
+
+    # -- snapshot lifecycle ------------------------------------------------
+
+    @property
+    def loaded_step(self) -> Optional[int]:
+        snap = self._snapshot
+        return snap[2] if snap is not None else None
+
+    @property
+    def ready(self) -> bool:
+        return self._snapshot is not None
+
+    def load(self, params, obs_norm=None, step: Optional[int] = None) -> None:
+        """Install a params snapshot (and its obs-norm statistics when the
+        engine normalizes). The FIRST load AOT-compiles the whole rung
+        ladder against the params' abstract shapes — the one expensive
+        call; every later load is a pure reference swap (the hot-reload
+        path), valid because checkpoints of one run never change
+        parameter shapes."""
+        if self.with_obs_norm and obs_norm is None:
+            raise ValueError(
+                "engine was built with with_obs_norm=True but load() got "
+                "obs_norm=None — serving would skip the normalization the "
+                "policy was trained behind (silently wrong actions)"
+            )
+        if not self.with_obs_norm:
+            # a snapshot from a non-normalized run may still carry None
+            # explicitly; a non-None stats object here would be silently
+            # ignored, which is the same wrong-numbers trap inverted
+            if obs_norm is not None:
+                raise ValueError(
+                    "engine was built with with_obs_norm=False but load() "
+                    "got obs-norm statistics — rebuild the engine with "
+                    "with_obs_norm=True to serve a normalized policy"
+                )
+        if not self._compiled:
+            self._compile_ladder(params, obs_norm)
+        self._snapshot = (params, obs_norm, step)
+
+    def _compile_ladder(self, params, obs_norm) -> None:
+        abstract = lambda tree: jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype),
+            tree,
+        )
+        params_sds = abstract(params)
+        norm_sds = abstract(obs_norm) if self.with_obs_norm else None
+        fn = jax.jit(self._act)
+        for rung in self.batch_shapes:
+            obs_sds = jax.ShapeDtypeStruct(
+                (rung,) + self.obs_shape, self.obs_dtype
+            )
+            self._compiled[rung] = fn.lower(
+                params_sds, norm_sds, obs_sds
+            ).compile()
+
+    # -- inference ---------------------------------------------------------
+
+    def padded_shape(self, n: int) -> int:
+        """The rung a request batch of ``n`` dispatches at: the smallest
+        ladder shape ≥ n, or the top rung (over-sized batches chunk)."""
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        for rung in self.batch_shapes:
+            if n <= rung:
+                return rung
+        return self.max_batch
+
+    def infer(self, obs, return_step: bool = False):
+        """Greedy actions for a batch of raw observations ``(n, *obs_shape)``.
+
+        Pads up to the nearest compiled rung (over-sized batches chunk at
+        the top rung) and slices the padding back off — the executable is
+        AOT-compiled, so this call never traces. Reads the snapshot ONCE:
+        a concurrent hot-reload affects the next call, never this one.
+
+        ``return_step=True`` returns ``(actions, step)`` where ``step``
+        is the checkpoint step of the snapshot THIS call actually used —
+        the provenance the serving tier reports per request (reading
+        ``loaded_step`` after the fact could race a hot swap and label
+        an old snapshot's action with the new step)."""
+        snap = self._snapshot
+        if snap is None:
+            raise RuntimeError(
+                "no params snapshot loaded — call load() (or point the "
+                "server at a checkpoint directory) before serving"
+            )
+        params, obs_norm, step = snap
+        obs = np.asarray(obs, self.obs_dtype)
+        if obs.ndim != 1 + len(self.obs_shape) or (
+            obs.shape[1:] != self.obs_shape
+        ):
+            raise ValueError(
+                f"obs must be (n, {', '.join(map(str, self.obs_shape))}), "
+                f"got shape {obs.shape}"
+            )
+        n = obs.shape[0]
+        outs = []
+        i = 0
+        while i < n:
+            chunk = obs[i : i + self.max_batch]
+            rung = self.padded_shape(chunk.shape[0])
+            if chunk.shape[0] != rung:
+                pad = np.zeros(
+                    (rung - chunk.shape[0],) + self.obs_shape, self.obs_dtype
+                )
+                chunk = np.concatenate([chunk, pad], axis=0)
+            out = self._compiled[rung](params, obs_norm, chunk)
+            outs.append(np.asarray(out)[: min(self.max_batch, n - i)])
+            with self._lock:
+                self.shape_counts[rung] += 1
+            i += self.max_batch
+        with self._lock:
+            self.infer_calls += 1
+        actions = (
+            outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+        )
+        return (actions, step) if return_step else actions

@@ -1,0 +1,325 @@
+"""HTTP front end for the serving tier: ``POST /act`` + hot-reload.
+
+``obs/server.py`` proved the pattern for READING a run over stdlib HTTP
+(snapshot swap, daemon threads, silenced handlers); this module
+graduates it to a data plane. A :class:`PolicyServer` owns three routes
+on a :class:`~trpo_tpu.utils.httpd.BackgroundHTTPServer`:
+
+* ``POST /act`` — ``{"obs": [...]}`` in, ``{"action": ..., "step": N}``
+  out. The handler thread submits to the micro-batcher and blocks on
+  its future (that block IS the coalescing window); malformed JSON or a
+  wrong obs shape is a 400, serving before any checkpoint loaded is a
+  503, an engine failure is a 500 — each scoped to that one request.
+* ``GET /healthz`` — liveness + the loaded checkpoint step (a smoke
+  test polls this to observe a hot reload landing).
+* ``GET /metrics`` — Prometheus ``trpo_serve_*``: request/batch/error
+  counters, queue depth, per-rung dispatch counts, p50/p99 latency over
+  the recent window, loaded step and reload count.
+
+Hot-reload: a background watcher polls ``Checkpointer.latest_step()``
+every ``poll_interval`` seconds. The step gate is marker-based
+(``utils/checkpoint.py``'s save-integrity markers), so a save torn by
+``kill -9`` is never offered for loading; a NEW complete step restores
+into the agent's state template and swaps the engine snapshot by
+reference — in-flight requests finish on the old params, later requests
+see the new ones, and nothing is dropped or mis-served (test-pinned
+across a live swap in ``tests/test_serve.py`` and the ``check.sh``
+serving smoke). A failed restore (mid-write race, transient IO) is
+reported as a ``health`` event and retried next poll — the endpoint
+keeps serving the last good snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from concurrent.futures import TimeoutError as _FutureTimeout
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = ["PolicyServer"]
+
+_JSON = "application/json"
+
+
+def _json_body(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _finite_or_none(v: float):
+    return v if math.isfinite(v) else None
+
+
+class PolicyServer:
+    """Serve a policy over HTTP, hot-reloading from a checkpoint dir.
+
+    ``snapshot_fn`` maps a restored ``TrainState`` to the
+    ``(policy_params, obs_norm)`` pair the engine loads (default: the
+    obvious field extraction). ``checkpointer``/``template`` may be
+    ``None`` for a pre-loaded engine (no hot reload — tests, benches).
+    """
+
+    ENDPOINTS = ("/act", "/healthz", "/metrics")
+
+    def __init__(
+        self,
+        engine,
+        batcher,
+        port: int,
+        host: str = "127.0.0.1",
+        checkpointer=None,
+        template=None,
+        snapshot_fn: Optional[Callable] = None,
+        poll_interval: float = 1.0,
+        bus=None,
+        act_timeout_s: float = 30.0,
+    ):
+        if (checkpointer is None) != (template is None):
+            raise ValueError(
+                "checkpointer and template come together: the watcher "
+                "restores INTO the template (agent.init_state())"
+            )
+        if poll_interval <= 0:
+            raise ValueError(
+                f"poll_interval must be > 0, got {poll_interval}"
+            )
+        self.engine = engine
+        self.batcher = batcher
+        self.checkpointer = checkpointer
+        self.template = template
+        self.snapshot_fn = snapshot_fn or (
+            lambda state: (state.policy_params, state.obs_norm)
+        )
+        self.bus = bus
+        self.poll_interval = float(poll_interval)
+        self.act_timeout_s = float(act_timeout_s)
+        self.reloads_total = 0
+        self._stop = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
+
+        if checkpointer is not None:
+            # synchronous first load: a server that answers 503 for a
+            # whole poll interval after a checkpoint already exists is a
+            # needless cold start (no checkpoint yet is fine — the
+            # watcher picks up the first one)
+            self._maybe_reload()
+            self._watcher = threading.Thread(
+                target=self._watch, name="serve-reload-watcher", daemon=True
+            )
+            self._watcher.start()
+
+        from trpo_tpu.utils.httpd import BackgroundHTTPServer
+
+        self._httpd = BackgroundHTTPServer(
+            port,
+            host=host,
+            get={"/healthz": self._healthz, "/metrics": self._metrics},
+            post={"/act": self._act},
+            not_found="have POST /act, GET /healthz, GET /metrics",
+            thread_name="serve-http",
+        )
+        self.host = host
+        self.port = self._httpd.port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- hot reload --------------------------------------------------------
+
+    def _maybe_reload(self) -> None:
+        # refresh=True: the trainer writing this directory is a DIFFERENT
+        # process/manager; without it orbax's cached step list would pin
+        # the server to whatever existed at watcher construction
+        step = self.checkpointer.latest_step(refresh=True)
+        if step is None or step == self.engine.loaded_step:
+            return
+        try:
+            # prune=False: a reader must never delete a save the live
+            # trainer is mid-write on (to us it looks exactly like a torn
+            # one); we only ever load marker-gated complete steps
+            state = self.checkpointer.restore(
+                self.template, step, prune=False
+            )
+            params, obs_norm = self.snapshot_fn(state)
+            if not self.engine.with_obs_norm:
+                obs_norm = None
+            self.engine.load(params, obs_norm, step=step)
+        except Exception as e:
+            # keep serving the last good snapshot; next poll retries.
+            # stderr ALWAYS (a bus-less `scripts/serve.py` run whose very
+            # first load fails would otherwise 503 forever with zero
+            # diagnostic — usually a model-shape flag mismatched against
+            # the checkpoint), bus additionally when attached — the same
+            # loud-degradation policy as Checkpointer._health
+            import sys
+
+            msg = (
+                f"serve: checkpoint step {step} failed to load "
+                f"({type(e).__name__}: {e}) — "
+                + (
+                    f"still serving step {self.engine.loaded_step}"
+                    if self.engine.ready
+                    else "nothing loaded yet (serving 503; do the model "
+                    "flags match the training run?)"
+                )
+            )
+            print(msg, file=sys.stderr)
+            if self.bus is not None:
+                self.bus.emit(
+                    "health",
+                    check="serve_reload_failed",
+                    level="warn",
+                    message=msg,
+                    data={"step": step},
+                )
+            return
+        self.reloads_total += 1
+        if self.bus is not None:
+            self.bus.emit(
+                "health",
+                check="serve_reload",
+                level="info",
+                message=f"hot-reloaded policy snapshot from step {step}",
+                data={"step": step},
+            )
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self._maybe_reload()
+            except Exception:  # pragma: no cover — the watcher must never die
+                pass
+
+    # -- handlers ----------------------------------------------------------
+
+    def _act(self, body: bytes):
+        if not self.engine.ready:
+            return 503, _JSON, _json_body(
+                {"error": "no policy loaded yet (no complete checkpoint)"}
+            )
+        try:
+            payload = json.loads(body)
+            obs = np.asarray(payload["obs"], self.engine.obs_dtype)
+        except (ValueError, KeyError, TypeError) as e:
+            return 400, _JSON, _json_body(
+                {"error": f'body must be {{"obs": [...]}} ({e})'}
+            )
+        if obs.shape != self.engine.obs_shape:
+            return 400, _JSON, _json_body(
+                {
+                    "error": (
+                        f"obs shape {list(obs.shape)} != expected "
+                        f"{list(self.engine.obs_shape)}"
+                    )
+                }
+            )
+        future = self.batcher.submit(obs)
+        try:
+            action, step = future.result(timeout=self.act_timeout_s)
+        except _FutureTimeout:
+            return 504, _JSON, _json_body(
+                {"error": f"inference exceeded {self.act_timeout_s}s"}
+            )
+        except Exception as e:
+            return 500, _JSON, _json_body(
+                {"error": f"inference failed: {type(e).__name__}"}
+            )
+        # `step` is the snapshot the batch ACTUALLY ran on (captured
+        # inside the engine call) — reading loaded_step here instead
+        # could race a hot swap and mislabel this action's provenance
+        return 200, _JSON, _json_body(
+            {"action": np.asarray(action).tolist(), "step": step}
+        )
+
+    def _healthz(self):
+        ok = self.engine.ready
+        body = _json_body(
+            {
+                "ok": ok,
+                "step": self.engine.loaded_step,
+                "requests_total": self.batcher.requests_total,
+                "reloads_total": self.reloads_total,
+            }
+        )
+        return (200 if ok else 503), _JSON, body
+
+    def _metrics(self):
+        b = self.batcher
+        q = b.latency_quantiles_ms((0.5, 0.99))
+        lines = []
+
+        def fam(name, mtype, help_, samples):
+            rows = [
+                f"{name}{labels} {value}"
+                for labels, value in samples
+                if value is not None
+            ]
+            if rows:
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} {mtype}")
+                lines.extend(rows)
+
+        fam(
+            "trpo_serve_requests_total", "counter",
+            "act requests accepted", [("", b.requests_total)],
+        )
+        fam(
+            "trpo_serve_batches_total", "counter",
+            "micro-batches dispatched", [("", b.batches_total)],
+        )
+        fam(
+            "trpo_serve_request_errors_total", "counter",
+            "requests failed by engine errors", [("", b.errors_total)],
+        )
+        fam(
+            "trpo_serve_queue_depth", "gauge",
+            "requests waiting in the micro-batcher", [("", b.queue_depth)],
+        )
+        fam(
+            "trpo_serve_queue_high_water", "gauge",
+            "max queue depth observed", [("", b.queue_high_water)],
+        )
+        fam(
+            "trpo_serve_batch_shape_total", "counter",
+            "dispatches per padded batch rung",
+            [
+                (f'{{shape="{rung}"}}', count)
+                for rung, count in sorted(
+                    self.engine.shape_counts.items()
+                )
+            ],
+        )
+        fam(
+            "trpo_serve_latency_ms", "gauge",
+            "per-request latency quantiles over the recent window",
+            [
+                (f'{{quantile="{qq}"}}', _finite_or_none(v))
+                for qq, v in sorted(q.items())
+            ],
+        )
+        fam(
+            "trpo_serve_checkpoint_step", "gauge",
+            "checkpoint step currently served",
+            [("", self.engine.loaded_step)],
+        )
+        fam(
+            "trpo_serve_reloads_total", "counter",
+            "hot reloads applied", [("", self.reloads_total)],
+        )
+        body = ("\n".join(lines) + "\n").encode()
+        return 200, "text/plain; version=0.0.4; charset=utf-8", body
+
+    # -- teardown ----------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop the watcher and the HTTP server (the batcher is owned by
+        the caller — it may outlive the front end)."""
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=5.0)
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.close()

@@ -175,9 +175,27 @@ class Checkpointer:
             except OSError:  # pragma: no cover
                 pass
 
-    def latest_step(self) -> Optional[int]:
+    def refresh(self) -> None:
+        """Re-read the step list from disk. Orbax's ``CheckpointManager``
+        caches ``all_steps()`` at construction and tracks only its OWN
+        saves afterwards — correct for the writer, blind for a READER
+        watching a directory another process appends to (the serving
+        tier's hot-reload watcher, a fleet orchestrator). Call this
+        before :meth:`latest_step` when the writer is someone else."""
+        reload = getattr(self.manager, "reload", None)
+        if reload is not None:
+            reload()
+        else:  # pragma: no cover — older orbax spells it read=True
+            self.manager.all_steps(read=True)
+
+    def latest_step(self, refresh: bool = False) -> Optional[int]:
         """Newest COMPLETE step (see the save-integrity gate above) —
-        never a save torn by ``kill -9``."""
+        never a save torn by ``kill -9``. ``refresh=True`` re-reads the
+        directory first (see :meth:`refresh`) so steps written by a
+        DIFFERENT process/manager are visible — the serving tier's
+        hot-reload contract."""
+        if refresh:
+            self.refresh()
         steps = self._complete_steps()
         return max(steps) if steps else None
 
@@ -202,13 +220,22 @@ class Checkpointer:
             )
         return torn
 
-    def restore(self, template, step: Optional[int] = None):
+    def restore(self, template, step: Optional[int] = None,
+                prune: bool = True):
         """Restore into the structure of ``template`` (an abstract or
         concrete TrainState from ``agent.init_state()``). Torn saves
         (kill -9 mid-write — see the save-integrity gate) are pruned
         first, so the default ``step`` is always the newest COMPLETE
-        one."""
-        self.prune_incomplete()
+        one.
+
+        ``prune=False`` for READERS of a directory a live trainer is
+        still writing (the serving tier's hot-reload watcher): to a
+        reader, a save currently IN FLIGHT is indistinguishable from a
+        torn one (orbax files present, completion marker not yet), and
+        pruning it would delete the trainer's write out from under it.
+        Readers restore marker-gated steps only and never prune."""
+        if prune:
+            self.prune_incomplete()
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")

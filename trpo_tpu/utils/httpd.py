@@ -1,0 +1,143 @@
+"""Shared stdlib HTTP plumbing for the in-process endpoints.
+
+Two subsystems serve HTTP out of a training/serving process: the
+introspection endpoint (``obs/server.StatusServer`` — PR 5) and the
+policy-inference front end (``serve/server.PolicyServer`` — this PR).
+Both need the same non-negotiables, first proven by the introspection
+endpoint and factored here so the contracts stay in ONE place:
+
+* **ThreadingHTTPServer on a daemon thread** — a hung client never
+  blocks interpreter exit, and serving never runs on the training or
+  batching thread.
+* **Silenced ``log_message``/``handle_error``** — scrapes and dropped
+  connections (``curl | head``, a scraper timing out mid-response) must
+  not spray the console; a broken pipe in ``wfile.write`` is the
+  CLIENT's problem.
+* **``allow_reuse_address``** — a relaunched run must rebind the same
+  port immediately (TIME_WAIT would otherwise hold it for minutes).
+* **Port 0 = ephemeral** — the OS picks; the bound port is exposed as
+  ``.port`` so callers can print/announce it.
+
+Handlers are plain callables returning ``(status, content_type,
+body_bytes)``: GET handlers take no arguments, POST handlers take the
+raw request body. A handler raising is a bug in the handler, but it
+must degrade to a 500 for THAT request — never kill the server thread
+or traceback onto the console (same silence contract as above).
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+__all__ = ["BackgroundHTTPServer"]
+
+# handler return type: (status_code, content_type, body)
+Response = Tuple[int, str, bytes]
+
+
+class BackgroundHTTPServer:
+    """A stdlib ``ThreadingHTTPServer`` on a background daemon thread,
+    routing by exact path.
+
+    ``get``: ``{path: fn() -> (status, ctype, body)}``;
+    ``post``: ``{path: fn(body_bytes) -> (status, ctype, body)}``.
+    Unknown paths get a 404 carrying ``not_found`` (which should name
+    the paths that DO exist — the introspection endpoint's
+    "have /status and /metrics" idiom). ``max_body_bytes`` bounds POST
+    bodies: an oversized request is refused with 413 before the read,
+    so a hostile client cannot balloon the handler thread's memory.
+    """
+
+    def __init__(
+        self,
+        port: int,
+        host: str = "127.0.0.1",
+        get: Optional[Dict[str, Callable[[], Response]]] = None,
+        post: Optional[Dict[str, Callable[[bytes], Response]]] = None,
+        not_found: str = "unknown path",
+        thread_name: str = "httpd",
+        max_body_bytes: int = 1 << 20,
+    ):
+        get_routes = dict(get or {})
+        post_routes = dict(post or {})
+
+        def _respond(handler, status: int, ctype: str, body: bytes) -> None:
+            handler.send_response(status)
+            handler.send_header("Content-Type", ctype)
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+
+        def _run(handler, fn, *args) -> None:
+            try:
+                status, ctype, body = fn(*args)
+            except Exception as e:  # a handler bug degrades to a 500 for
+                # THIS request; the server thread and console stay clean
+                status, ctype = 500, "text/plain; charset=utf-8"
+                body = f"internal error: {type(e).__name__}".encode()
+            _respond(handler, status, ctype, body)
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(handler):  # noqa: N805 — handler, not self
+                path = handler.path.split("?", 1)[0]
+                fn = get_routes.get(path)
+                if fn is None:
+                    handler.send_error(404, not_found)
+                    return
+                _run(handler, fn)
+
+            def do_POST(handler):  # noqa: N805
+                path = handler.path.split("?", 1)[0]
+                fn = post_routes.get(path)
+                if fn is None:
+                    handler.send_error(404, not_found)
+                    return
+                try:
+                    length = int(handler.headers.get("Content-Length", 0))
+                except ValueError:
+                    length = -1
+                if length < 0 or length > max_body_bytes:
+                    handler.send_error(413, "request body too large")
+                    return
+                body = handler.rfile.read(length) if length else b""
+                _run(handler, fn, body)
+
+            def log_message(handler, *args):  # noqa: N805
+                pass  # requests must not spray the owning console
+
+        class _Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+            # a relaunched run must be able to rebind the same port
+            # immediately (TIME_WAIT would otherwise hold it for minutes)
+            allow_reuse_address = True
+
+            def handle_error(server, request, client_address):  # noqa: N805
+                # a client dropping the connection mid-response raises in
+                # wfile.write; the default handler tracebacks onto the
+                # console — same silence contract as log_message above
+                pass
+
+        self._httpd = _Server((host, port), _Handler)
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name=thread_name,
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
+        self._thread.join(timeout=5.0)

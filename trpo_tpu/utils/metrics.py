@@ -26,7 +26,26 @@ from typing import IO, Optional
 
 import jax.numpy as jnp
 
-__all__ = ["explained_variance", "StatsLogger", "repair_jsonl_tail"]
+__all__ = [
+    "explained_variance",
+    "StatsLogger",
+    "repair_jsonl_tail",
+    "quantile_nearest_rank",
+]
+
+
+def quantile_nearest_rank(vals, q: float):
+    """Nearest-rank quantile (no interpolation) over ``vals``; None when
+    empty. The ONE estimator behind every serving-latency quantile — the
+    batcher's ``/metrics`` gauges, ``obs/analyze``'s serving report, and
+    ``bench.py``'s serving block all call this, so a scraped gauge, an
+    analyzed event log, and a bench artifact tell the same story (three
+    hand-rolled copies would silently desynchronize on the first fix to
+    one of them)."""
+    vals = sorted(vals)
+    if not vals:
+        return None
+    return vals[min(len(vals) - 1, int(q * len(vals)))]
 
 
 def repair_jsonl_tail(path: str) -> int:
