@@ -1419,7 +1419,13 @@ def serving_bench(
             "actions_per_sec": round(rung / mean_s, 1),
         }
 
-        batcher = MicroBatcher(engine, deadline_ms=deadline_ms)
+        # mirror the production default (cfg.serve_adaptive_deadline) —
+        # the SLO numbers must measure the dispatch semantics serve.py
+        # actually runs
+        batcher = MicroBatcher(
+            engine, deadline_ms=deadline_ms,
+            adaptive_deadline=agent.cfg.serve_adaptive_deadline,
+        )
         conc = min(rung, max_concurrency)
         per_client = max(1, open_requests // conc)
         open_lats: list = []

@@ -28,6 +28,10 @@ echo "== tier-1: resilience chaos suite (fault injection, CPU backend) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
     -m 'not slow'
 
+echo "== tier-1: fleet orchestrator (spec/scheduler/scrape/gate) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
+    -m 'not slow'
+
 echo "== event-stream smoke: train + bench emit schema-valid JSONL =="
 OBS_TMP=$(mktemp -d)
 JAX_PLATFORMS=cpu python -m trpo_tpu.train --preset cartpole \
@@ -103,6 +107,48 @@ JAX_PLATFORMS=cpu python -m trpo_tpu.train --env "gymproc:CartPole-v1" \
 python scripts/validate_events.py "$CHAOS_TMP/chaos_events.jsonl" \
     "$CHAOS_TMP/resume_events.jsonl"
 
+echo "== fleet chaos smoke: 3-member fleet, one member preempted mid-run =="
+# the ISSUE 7 acceptance scenario: a 3-member cartpole fleet with a
+# sigterm injected into one member must complete with that member
+# requeued exactly once and resumed from the marker-gated checkpoint
+# with ZERO lost iterations (gapless iteration events across the
+# requeue), all event logs schema-valid (including the fleet lifecycle
+# log's preempted->requeued contract), and the fleet gate
+# (compare_runs member-vs-reference) clean on the non-preempted members
+FLEET_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu python scripts/fleet.py --fleet-dir "$FLEET_TMP" \
+    --grid seed=0..2 --max-workers 2 --backoff 0.2 \
+    --inject "seed1=sigterm@iter=2" --status-port 0 --json \
+    -- --preset cartpole --iterations 5 --batch-timesteps 64 \
+       --n-envs 4 --platform cpu --checkpoint-every 2 \
+    > "$FLEET_TMP/result.json"
+python scripts/validate_events.py "$FLEET_TMP/fleet_events.jsonl" \
+    "$FLEET_TMP"/seed0/events.jsonl "$FLEET_TMP"/seed1/events.jsonl \
+    "$FLEET_TMP"/seed2/events.jsonl
+python - "$FLEET_TMP" <<'PYEOF'
+import json, os, sys
+d = sys.argv[1]
+res = json.load(open(os.path.join(d, "result.json")))
+states = {m: r["state"] for m, r in res["members"].items()}
+assert all(s == "finished" for s in states.values()), states
+assert res["members"]["seed1"]["requeues"] == 1, res["members"]["seed1"]
+assert res["members"]["seed0"]["requeues"] == 0
+verdicts = {m: g["verdict"] for m, g in res["gate"]["members"].items()}
+assert verdicts["seed2"] == "ok", verdicts       # clean member gates clean
+assert verdicts["seed1"] == "skipped", verdicts  # requeued: not judged
+iters = [
+    json.loads(line)["iteration"]
+    for line in open(os.path.join(d, "seed1", "events.jsonl"))
+    if json.loads(line).get("kind") == "iteration"
+]
+assert iters == list(range(1, 6)), iters  # gapless across the requeue
+assert res["exit_code"] == 0, res["exit_code"]
+print(
+    "fleet chaos smoke OK: seed1 preempted -> requeued once, iterations "
+    f"{iters[0]}..{iters[-1]} gapless, gate clean on seed2"
+)
+PYEOF
+
 echo "== serving smoke: hot-swap under concurrent load + SLO gate =="
 # ISSUE 6 acceptance: train a short CartPole checkpoint, serve it, fire
 # concurrent POST /act clients WHILE saving a newer checkpoint into the
@@ -121,8 +167,14 @@ python scripts/validate_events.py "$SERVE_TMP/base/serve_events.jsonl" \
 python scripts/analyze_run.py "$SERVE_TMP/new/serve_events.jsonl" \
     --compare "$SERVE_TMP/base/serve_events.jsonl" --threshold-pct 500
 
-echo "== pytest (8-device virtual CPU mesh) =="
-python -m pytest tests/ -q
+echo "== pytest tier-1 (8-device virtual CPU mesh) =="
+# timed so every PR sees the headroom against the ROADMAP tier-1 budget
+T1_START=$SECONDS
+python -m pytest tests/ -q -m 'not slow'
+echo "tier-1 wall time: $((SECONDS - T1_START))s (budget 1200s — ROADMAP.md)"
+
+echo "== pytest slow tier (@pytest.mark.slow) =="
+python -m pytest tests/ -q -m 'slow'
 
 echo "== driver entry: compile check + multichip dryrun (8 virtual CPUs) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

@@ -103,6 +103,13 @@ def build_parser() -> argparse.ArgumentParser:
         "the oldest request has waited half of this; default 10)",
     )
     p.add_argument(
+        "--no-adaptive-deadline", action="store_true",
+        help="disable the adaptive dispatch wait (config default ON: "
+        "the batcher caps its idle wait at ~2x the observed dispatch "
+        "cost EMA instead of always holding requests for half the "
+        "deadline) — fixed half-deadline semantics",
+    )
+    p.add_argument(
         "--poll-interval", type=float,
         help="seconds between checkpoint hot-reload polls (default 1.0)",
     )
@@ -165,6 +172,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         updates["serve_deadline_ms"] = args.deadline_ms
     if args.poll_interval is not None:
         updates["serve_poll_interval"] = args.poll_interval
+    if args.no_adaptive_deadline:
+        updates["serve_adaptive_deadline"] = False
     if updates:
         cfg = cfg.replace(**updates)
 
@@ -189,7 +198,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         args.checkpoint_dir, cg_damping_seed=cfg.cg_damping, bus=bus
     )
     batcher = MicroBatcher(
-        engine, deadline_ms=cfg.serve_deadline_ms, bus=bus
+        engine,
+        deadline_ms=cfg.serve_deadline_ms,
+        bus=bus,
+        adaptive_deadline=cfg.serve_adaptive_deadline,
     )
     server = PolicyServer(
         engine,

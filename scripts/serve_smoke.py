@@ -108,7 +108,8 @@ def main(argv=None) -> int:
     errors: list = []
     try:
         batcher = MicroBatcher(
-            engine, deadline_ms=cfg.serve_deadline_ms, bus=bus
+            engine, deadline_ms=cfg.serve_deadline_ms, bus=bus,
+            adaptive_deadline=cfg.serve_adaptive_deadline,
         )
         server = PolicyServer(
             engine, batcher, port=0,

@@ -17,7 +17,12 @@ every ``fault_injected`` record must be FOLLOWED by a matching
 detection/recovery record (the ISSUE 4 chaos contract: worker kill/hang
 → a ``worker_*`` health event, NaN poison → a ``recovery`` event or nan
 health finding, SIGTERM → a ``preempted`` health event — an injected
-fault nothing reacted to means the detect→recover loop is broken).
+fault nothing reacted to means the detect→recover loop is broken); and
+— ISSUE 7 — in a fleet log every ``fleet`` record with
+``state="preempted"`` must be FOLLOWED by the same member's
+``requeued`` or ``failed`` record (a preemption the scheduler never
+resolved means the requeue loop is broken; malformed fleet records FAIL
+outright via the shared ``validate_event``).
 Exits non-zero with per-line diagnostics on any failure; prints a
 per-kind count summary on success. Used by ``scripts/check.sh`` against
 both a training run's ``--metrics-jsonl`` output and ``bench.py``'s
@@ -135,6 +140,23 @@ def validate_file(path: str) -> list:
             errs.append(
                 f"{path}:{n}: fault_injected ({rec.get('spec')}) has no "
                 "matching detection/recovery record after it"
+            )
+    # ISSUE 7 fleet contract (same pattern as the fault-matching rule):
+    # a preempted member the scheduler never requeued or failed is a
+    # broken requeue loop, not a valid log
+    for idx, (n, rec) in enumerate(records):
+        if rec.get("kind") != "fleet" or rec.get("state") != "preempted":
+            continue
+        member = rec.get("member")
+        if not any(
+            later.get("kind") == "fleet"
+            and later.get("member") == member
+            and later.get("state") in ("requeued", "failed", "finished")
+            for _, later in records[idx + 1:]
+        ):
+            errs.append(
+                f"{path}:{n}: fleet member {member!r} preempted with no "
+                "matching requeued/failed terminal record after it"
             )
     return errs
 

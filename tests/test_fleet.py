@@ -1,0 +1,1012 @@
+"""Fleet orchestrator (ISSUE 7): spec/grid parsing, the scheduler's
+requeue/restart state machine, fleet events + validator contract, the
+scrape/endpoint surface, selection, and the fleet gate.
+
+Fast tests drive the scheduler with stub subprocesses (``python -c`` —
+no jax import, no training) so the state machine is pinned cheaply;
+the slow tests run REAL ``trpo_tpu.train`` members end to end: the
+2-member scrape acceptance (fleet ``/metrics`` carrying per-member
+state, attempts and scraped iteration timings from live members) and
+the resume-loses-zero-iterations contract (a sigterm'd member requeues
+once and its event log's iteration sequence stays gapless across the
+requeue, resuming at ``latest_step + 1``).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trpo_tpu.fleet import (
+    FleetScheduler,
+    FleetSpec,
+    FleetStatusServer,
+    MemberSpec,
+    expand_grid,
+    load_spec_file,
+    member_cli_args,
+    member_total_iterations,
+    render_fleet_prometheus,
+    score_event_records,
+)
+from trpo_tpu.obs.events import EventBus, validate_event
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _recording_bus():
+    events = []
+    return EventBus(lambda rec: events.append(rec)), events
+
+
+def _manifest_rec(**extra):
+    rec = {
+        "v": 1, "t": 1.0, "kind": "run_manifest",
+        "schema": "trpo-tpu-events", "jax_version": "0", "backend": "cpu",
+        "config_hash": "0123456789abcdef", "config": None,
+    }
+    rec.update(extra)
+    return rec
+
+
+def _iter_rec(i, ms, reward=None, episodes=None, t=None):
+    stats = {
+        "iteration_ms": ms,
+        "cg_iters_total": i, "linesearch_trials_total": i,
+    }
+    if reward is not None:
+        stats["mean_episode_reward"] = reward
+    if episodes is not None:
+        stats["episodes_in_batch"] = episodes
+    return {
+        "v": 1, "t": float(t if t is not None else i), "kind": "iteration",
+        "iteration": i, "stats": stats,
+    }
+
+
+def _fleet_rec(member, state, attempt=1, **extra):
+    return {
+        "v": 1, "t": 1.0, "kind": "fleet", "member": member,
+        "state": state, "attempt": attempt, **extra,
+    }
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+# stub member bodies (python -c): tiny, no jax import
+_STUB_WRITE_LOG_AND_EXIT = """
+import sys, os, json
+member_dir, code = sys.argv[1], int(sys.argv[2])
+rows = json.loads(sys.argv[3]) if len(sys.argv) > 3 else []
+path = os.path.join(member_dir, "events.jsonl")
+with open(path, "a") as f:
+    f.write(json.dumps({"v":1,"t":0.0,"kind":"run_manifest",
+        "schema":"trpo-tpu-events","jax_version":"0","backend":"cpu",
+        "config_hash":"0123456789abcdef","config":None}) + "\\n")
+    for row in rows:
+        f.write(json.dumps(row) + "\\n")
+sys.exit(code)
+"""
+
+_STUB_EXIT_75_ONCE = """
+import sys, os, json
+member_dir, marker = sys.argv[1], sys.argv[2]
+with open(os.path.join(member_dir, "events.jsonl"), "a") as f:
+    f.write(json.dumps({"v":1,"t":0.0,"kind":"run_manifest",
+        "schema":"trpo-tpu-events","jax_version":"0","backend":"cpu",
+        "config_hash":"0123456789abcdef","config":None}) + "\\n")
+if not os.path.exists(marker):
+    open(marker, "w").close()
+    sys.exit(75)
+sys.exit(0)
+"""
+
+
+def _stub_launcher_exit(code):
+    def launcher(member, ctx):
+        return [sys.executable, "-c", _STUB_WRITE_LOG_AND_EXIT,
+                ctx["member_dir"], str(code)]
+    return launcher
+
+
+def _fast_spec(members, **kw):
+    kw.setdefault("requeue_backoff", 0.01)
+    kw.setdefault("poll_interval", 0.02)
+    kw.setdefault("scrape_interval", 60.0)
+    return FleetSpec(members=tuple(members), **kw)
+
+
+# ---------------------------------------------------------------------------
+# spec + grid
+# ---------------------------------------------------------------------------
+
+
+def test_grid_expansion_ranges_lists_and_ids():
+    members = expand_grid("seed=0..2,cg_damping=0.1|0.3")
+    assert len(members) == 6
+    ids = [m.member_id for m in members]
+    assert ids[0] == "seed0-cg_damping0.1"
+    assert len(set(ids)) == 6
+    assert members[0].overrides_dict == {"seed": 0, "cg_damping": 0.1}
+    # single-valued fields pin constants and stay out of the id
+    members = expand_grid("seed=1..2,batch_timesteps=64")
+    assert [m.member_id for m in members] == ["seed1", "seed2"]
+    assert members[0].overrides_dict["batch_timesteps"] == 64
+    # all-constant grid falls back to positional ids
+    assert [m.member_id for m in expand_grid("seed=5")] == ["m0"]
+    # values outside the id alphabet (env sweeps) sanitize instead of
+    # failing the whole spec; post-sanitize collisions get a suffix
+    envs = expand_grid("env=gymproc:CartPole-v1|gymproc:Acrobot-v1")
+    assert [m.member_id for m in envs] == [
+        "envgymproc-CartPole-v1", "envgymproc-Acrobot-v1",
+    ]
+    assert envs[0].overrides_dict["env"] == "gymproc:CartPole-v1"
+    collide = expand_grid("seed=1|01")  # '1' vs '01' → same id text
+    assert len({m.member_id for m in collide}) == 2
+
+
+def test_grid_expansion_rejects_malformed():
+    with pytest.raises(ValueError, match="name=values"):
+        expand_grid("seed")
+    with pytest.raises(ValueError, match="hi < lo"):
+        expand_grid("seed=3..1")
+    with pytest.raises(ValueError, match="empty grid"):
+        expand_grid(" , ")
+
+
+def test_spec_validation_rejects_bad_fleets():
+    m = [MemberSpec("a"), MemberSpec("b")]
+    with pytest.raises(ValueError, match="duplicate"):
+        FleetSpec(members=(MemberSpec("a"), MemberSpec("a")))
+    with pytest.raises(ValueError, match="max_workers"):
+        FleetSpec(members=tuple(m), max_workers=0)
+    with pytest.raises(ValueError, match="whole fleet"):
+        FleetSpec(members=tuple(m), cull_bottom_k=2)
+    with pytest.raises(ValueError, match="gate_reference"):
+        FleetSpec(members=tuple(m), gate_reference="nope")
+    with pytest.raises(ValueError, match="at least one member"):
+        FleetSpec(members=())
+
+
+def test_spec_file_roundtrip_and_unknown_keys(tmp_path):
+    path = tmp_path / "fleet.json"
+    path.write_text(json.dumps({
+        "base_args": ["--preset", "cartpole", "--iterations", "6"],
+        "max_workers": 3,
+        "members": [
+            {"id": "ref", "overrides": {"seed": 0}},
+            {"id": "chaos", "overrides": {
+                "seed": 1, "inject_faults": "sigterm@iter=2"}},
+        ],
+    }))
+    spec = load_spec_file(str(path))
+    assert [m.member_id for m in spec.members] == ["ref", "chaos"]
+    assert spec.max_workers == 3
+    assert member_total_iterations(spec, spec.members[0]) == 6
+    assert "--inject-faults" in member_cli_args(spec.members[1])
+    path.write_text(json.dumps({
+        "members": [{"id": "a"}], "max_wrokers": 2,
+    }))
+    with pytest.raises(ValueError, match="max_wrokers"):
+        load_spec_file(str(path))
+
+
+def test_member_cli_args_rendering():
+    m = MemberSpec("x", (("seed", 3), ("adaptive_damping", True),
+                         ("resume", False), ("env", None)))
+    assert member_cli_args(m) == ["--seed", "3", "--adaptive-damping"]
+
+
+def test_member_total_iterations_override_beats_base():
+    spec = FleetSpec(
+        members=(MemberSpec("a", (("iterations", 9),)), MemberSpec("b")),
+        base_args=("--preset", "cartpole", "--iterations", "6"),
+    )
+    assert member_total_iterations(spec, spec.members[0]) == 9
+    assert member_total_iterations(spec, spec.members[1]) == 6
+    bare = FleetSpec(members=(MemberSpec("a"),))
+    assert member_total_iterations(bare, bare.members[0]) is None
+
+
+# ---------------------------------------------------------------------------
+# fleet event schema + validator contract
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_event_schema():
+    assert validate_event(_fleet_rec("m0", "launched")) == []
+    assert validate_event(
+        _fleet_rec("m0", "requeued", attempt=2, resume_step=4,
+                   reason="preempted", exit_code=75)
+    ) == []
+    assert validate_event(_fleet_rec("m0", "exploded"))
+    assert validate_event(_fleet_rec("", "launched"))
+    assert validate_event({**_fleet_rec("m0", "launched"), "attempt": -1})
+    rec = _fleet_rec("m0", "launched")
+    del rec["member"]
+    assert validate_event(rec)
+
+
+def test_bus_emits_valid_fleet_events():
+    bus, events = _recording_bus()
+    from trpo_tpu.fleet import emit_fleet
+
+    emit_fleet(bus, "m0", "preempted", 1, exit_code=75)
+    emit_fleet(bus, "m0", "requeued", 1, resume_step=3, reason="preempted")
+    assert [e["state"] for e in events] == ["preempted", "requeued"]
+    assert events[1]["resume_step"] == 3
+    with pytest.raises(ValueError, match="unknown fleet state"):
+        emit_fleet(bus, "m0", "bogus", 1)
+    assert emit_fleet(None, "m0", "launched", 1) is None  # busless no-op
+    # a -inf score (no-episode member) must not reach JsonlSink, whose
+    # bare json.dumps would write the non-RFC `-Infinity` token
+    emit_fleet(bus, "m0", "culled", 1, score=float("-inf"))
+    assert "score" not in events[-1]
+    emit_fleet(bus, "m0", "culled", 1, score=3.5)
+    assert events[-1]["score"] == 3.5
+
+
+def test_validator_fails_unresolved_preemption(tmp_path):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ))
+    from validate_events import validate_file
+
+    path = tmp_path / "fleet_events.jsonl"
+    _write_jsonl(path, [
+        _manifest_rec(),
+        _fleet_rec("m0", "launched"),
+        _fleet_rec("m0", "preempted", exit_code=75),
+    ])
+    errs = validate_file(str(path))
+    assert any("no matching requeued/failed" in e for e in errs)
+    # resolution (requeued) clears it; so does a terminal failed
+    _write_jsonl(path, [
+        _manifest_rec(),
+        _fleet_rec("m0", "launched"),
+        _fleet_rec("m0", "preempted", exit_code=75),
+        _fleet_rec("m0", "requeued", attempt=1, resume_step=2),
+        _fleet_rec("m0", "launched", attempt=2),
+        _fleet_rec("m0", "finished", attempt=2),
+    ])
+    assert validate_file(str(path)) == []
+    # a malformed fleet record FAILS (strictness contract)
+    _write_jsonl(path, [
+        _manifest_rec(),
+        {**_fleet_rec("m0", "launched"), "state": "warp"},
+    ])
+    assert any("state" in e for e in validate_file(str(path)))
+
+
+# ---------------------------------------------------------------------------
+# scheduler state machine (stub subprocesses — no jax)
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_finishes_clean_member(tmp_path):
+    bus, events = _recording_bus()
+    spec = _fast_spec([MemberSpec("m0")])
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"), bus=bus,
+        launcher=_stub_launcher_exit(0),
+        latest_step_fn=lambda d: None,
+    )
+    result = sch.run(timeout=30)
+    assert result["members"]["m0"]["state"] == "finished"
+    assert result["exit_code"] == 0
+    assert [(e["state"], e["attempt"]) for e in events
+            if e["kind"] == "fleet"] == [("launched", 1), ("finished", 1)]
+
+
+def test_scheduler_requeues_preempted_member_once(tmp_path):
+    bus, events = _recording_bus()
+    marker = str(tmp_path / "fired")
+    ctxs = []
+
+    def launcher(member, ctx):
+        ctxs.append(dict(ctx))
+        return [sys.executable, "-c", _STUB_EXIT_75_ONCE,
+                ctx["member_dir"], marker]
+
+    spec = _fast_spec([MemberSpec("m0")],
+                      base_args=("--iterations", "6"))
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"), bus=bus, launcher=launcher,
+        latest_step_fn=lambda d: 4,
+    )
+    result = sch.run(timeout=30)
+    row = result["members"]["m0"]
+    assert row["state"] == "finished"
+    assert row["requeues"] == 1 and row["attempt"] == 2
+    states = [(e["state"], e["attempt"]) for e in events
+              if e["kind"] == "fleet"]
+    assert states == [
+        ("launched", 1), ("preempted", 1), ("requeued", 1),
+        ("launched", 2), ("finished", 2),
+    ]
+    requeued = next(e for e in events if e.get("state") == "requeued")
+    assert requeued["resume_step"] == 4
+    assert requeued["reason"] == "preempted"
+    # the relaunch resumed with the REMAINING budget: 6 total − step 4
+    assert ctxs[0]["resume_step"] is None
+    assert ctxs[1]["resume_step"] == 4
+    assert ctxs[1]["remaining_iterations"] == 2
+    assert result["exit_code"] == 0
+
+
+def test_scheduler_preempted_after_final_save_is_finished(tmp_path):
+    """Preemption AFTER the last iteration's save: remaining == 0, the
+    member is complete — no pointless relaunch."""
+    bus, events = _recording_bus()
+
+    def launcher(member, ctx):
+        return [sys.executable, "-c", "import sys; sys.exit(75)"]
+
+    spec = _fast_spec([MemberSpec("m0")], base_args=("--iterations", "6"))
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"), bus=bus, launcher=launcher,
+        latest_step_fn=lambda d: 6,
+    )
+    result = sch.run(timeout=30)
+    assert result["members"]["m0"]["state"] == "finished"
+    assert result["members"]["m0"]["attempt"] == 1
+    # never actually requeued: the counter must not read 1, or the
+    # gate would skip this member's single clean segment
+    assert result["members"]["m0"]["requeues"] == 0
+    states = [e["state"] for e in events if e["kind"] == "fleet"]
+    assert states == ["launched", "preempted", "finished"]
+    fin = [e for e in events if e.get("state") == "finished"][0]
+    assert fin["reason"] == "complete_at_preemption"
+
+
+def test_scheduler_derives_total_from_member_manifest(tmp_path):
+    """No --iterations anywhere in the spec: the requeue reads the
+    member's own run_manifest (config.n_iterations) so the relaunch
+    runs the REMAINDER, not a fresh full default budget on top of the
+    restored counter."""
+    marker = str(tmp_path / "fired")
+    ctxs = []
+    stub = (
+        "import sys, os, json\n"
+        "member_dir, marker = sys.argv[1], sys.argv[2]\n"
+        "with open(os.path.join(member_dir, 'events.jsonl'), 'a') as f:\n"
+        "    f.write(json.dumps({'v':1,'t':0.0,'kind':'run_manifest',"
+        "'schema':'trpo-tpu-events','jax_version':'0','backend':'cpu',"
+        "'config_hash':'0123456789abcdef',"
+        "'config':{'n_iterations': 8}}) + '\\n')\n"
+        "if not os.path.exists(marker):\n"
+        "    open(marker, 'w').close(); sys.exit(75)\n"
+        "sys.exit(0)\n"
+    )
+
+    def launcher(member, ctx):
+        ctxs.append(dict(ctx))
+        return [sys.executable, "-c", stub, ctx["member_dir"], marker]
+
+    spec = _fast_spec([MemberSpec("m0")])  # NO --iterations stated
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"), launcher=launcher,
+        latest_step_fn=lambda d: 5,
+    )
+    result = sch.run(timeout=30)
+    assert result["members"]["m0"]["state"] == "finished"
+    assert ctxs[1]["resume_step"] == 5
+    assert ctxs[1]["remaining_iterations"] == 3  # 8 (manifest) − 5
+
+
+def test_scheduler_requeue_budget_exhaustion_reports_true_count(tmp_path):
+    """The 'requeue budget exhausted' failure must report the requeues
+    that actually happened — the budget is checked BEFORE counting, so
+    the counter stays monotone and never overshoots by one."""
+    bus, events = _recording_bus()
+
+    def launcher(member, ctx):
+        return [sys.executable, "-c", "import sys; sys.exit(75)"]
+
+    spec = _fast_spec([MemberSpec("m0")], max_requeues=1,
+                      base_args=("--iterations", "6"))
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"), bus=bus, launcher=launcher,
+        latest_step_fn=lambda d: None,
+    )
+    result = sch.run(timeout=30)
+    row = result["members"]["m0"]
+    assert row["state"] == "failed"
+    assert row["requeues"] == 1  # one requeue happened, one was refused
+    states = [e["state"] for e in events if e["kind"] == "fleet"]
+    assert states == [
+        "launched", "preempted", "requeued", "launched", "preempted",
+        "failed",
+    ]
+    failed = next(e for e in events if e.get("state") == "failed")
+    assert failed["reason"] == "requeue budget exhausted"
+
+
+def test_scheduler_crash_budget_fails_member_not_fleet(tmp_path):
+    bus, events = _recording_bus()
+    spec = _fast_spec([MemberSpec("bad"), MemberSpec("good")],
+                      max_restarts=1, max_workers=2)
+
+    def launcher(member, ctx):
+        code = 3 if member.member_id == "bad" else 0
+        return [sys.executable, "-c", _STUB_WRITE_LOG_AND_EXIT,
+                ctx["member_dir"], str(code)]
+
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"), bus=bus, launcher=launcher,
+        latest_step_fn=lambda d: None,
+    )
+    result = sch.run(timeout=30)
+    assert result["members"]["bad"]["state"] == "failed"
+    assert result["members"]["bad"]["failures"] == 2  # 1 retry allowed
+    assert result["members"]["good"]["state"] == "finished"
+    assert result["failed"] == ["bad"]
+    assert result["exit_code"] == 1  # a failed member fails the fleet run
+    bad_states = [e["state"] for e in events
+                  if e["kind"] == "fleet" and e["member"] == "bad"]
+    assert bad_states == [
+        "launched", "requeued", "launched", "failed",
+    ]
+    crash = next(e for e in events if e.get("state") == "requeued")
+    assert crash["reason"] == "crash" and crash["exit_code"] == 3
+
+
+def test_scheduler_bounds_worker_slots(tmp_path):
+    """max_workers=1 serializes members: no two stub runtimes overlap."""
+    trace = str(tmp_path / "trace.jsonl")
+    stub = (
+        "import sys, time, json\n"
+        "t0 = time.monotonic(); time.sleep(0.25)\n"
+        "with open(sys.argv[1], 'a') as f:\n"
+        "    f.write(json.dumps([sys.argv[2], t0, time.monotonic()])"
+        " + '\\n')\n"
+    )
+
+    def launcher(member, ctx):
+        return [sys.executable, "-c", stub, trace, member.member_id]
+
+    spec = _fast_spec(
+        [MemberSpec("a"), MemberSpec("b"), MemberSpec("c")],
+        max_workers=1,
+    )
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"), launcher=launcher,
+        latest_step_fn=lambda d: None,
+    )
+    result = sch.run(timeout=60)
+    assert all(r["state"] == "finished"
+               for r in result["members"].values())
+    spans = sorted(
+        [json.loads(line) for line in open(trace)], key=lambda s: s[1]
+    )
+    assert len(spans) == 3
+    for (_, _, end), (_, start, _) in zip(spans, spans[1:]):
+        assert start >= end - 0.05  # no overlap beyond clock fuzz
+
+
+def test_scheduler_timeout_terminates_and_fails(tmp_path):
+    bus, events = _recording_bus()
+
+    def launcher(member, ctx):
+        return [sys.executable, "-c", "import time; time.sleep(600)"]
+
+    # max_workers=1: m1 is still PENDING when the timeout hits — an
+    # aborted fleet must fail never-ran members too, not report them
+    # skipped-but-clean
+    spec = _fast_spec([MemberSpec("m0"), MemberSpec("m1")],
+                      max_workers=1)
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"), bus=bus, launcher=launcher,
+        latest_step_fn=lambda d: None,
+    )
+    t0 = time.monotonic()
+    result = sch.run(timeout=0.5)
+    assert time.monotonic() - t0 < 30
+    assert result["members"]["m0"]["state"] == "failed"
+    assert result["members"]["m1"]["state"] == "failed"
+    assert result["failed"] == ["m0", "m1"]
+    assert result["exit_code"] == 1
+    failed = [e for e in events if e.get("state") == "failed"]
+    assert len(failed) == 2
+    assert all(e["reason"] == "fleet timeout" for e in failed)
+
+
+def test_scheduler_crash_after_completed_budget_is_failed(tmp_path):
+    """A nonzero-non-75 exit with nothing left to run (teardown crash
+    after the final save) must surface as FAILED — never laundered into
+    the preemption path's complete-at-preemption finish."""
+    bus, events = _recording_bus()
+
+    def launcher(member, ctx):
+        return [sys.executable, "-c", "import sys; sys.exit(1)"]
+
+    spec = _fast_spec([MemberSpec("m0")], base_args=("--iterations", "6"))
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"), bus=bus, launcher=launcher,
+        latest_step_fn=lambda d: 6,  # budget fully checkpointed
+    )
+    result = sch.run(timeout=30)
+    assert result["members"]["m0"]["state"] == "failed"
+    assert result["exit_code"] == 1
+    failed = next(e for e in events if e.get("state") == "failed")
+    assert failed["exit_code"] == 1
+    assert "crashed after completing" in failed["reason"]
+
+
+# ---------------------------------------------------------------------------
+# scoring, selection, gate
+# ---------------------------------------------------------------------------
+
+
+def test_score_event_records_episode_weighted():
+    recs = [
+        _manifest_rec(),
+        _iter_rec(1, 10.0, reward=10.0, episodes=1),
+        _iter_rec(2, 10.0, reward=40.0, episodes=3),
+        _iter_rec(3, 10.0, reward=float("nan"), episodes=0),
+    ]
+    # (10·1 + 40·3) / 4 = 32.5; the NaN batch contributes nothing
+    assert score_event_records(recs) == pytest.approx(32.5)
+    assert score_event_records([_manifest_rec()]) == float("-inf")
+
+
+def test_selection_culls_bottom_k(tmp_path):
+    rewards = {"a": 100.0, "b": 10.0, "c": 50.0}
+
+    def launcher(member, ctx):
+        rows = [
+            _iter_rec(i, 10.0, reward=rewards[member.member_id],
+                      episodes=2)
+            for i in (1, 2, 3)
+        ]
+        return [sys.executable, "-c", _STUB_WRITE_LOG_AND_EXIT,
+                ctx["member_dir"], "0", json.dumps(rows)]
+
+    bus, events = _recording_bus()
+    spec = _fast_spec(
+        [MemberSpec(m) for m in ("a", "b", "c")],
+        max_workers=3, cull_bottom_k=1,
+    )
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"), bus=bus, launcher=launcher,
+        latest_step_fn=lambda d: None,
+    )
+    result = sch.run(timeout=60)
+    assert result["culled"] == ["b"]
+    assert result["members"]["b"]["state"] == "culled"
+    assert result["scores"]["a"] == pytest.approx(100.0)
+    culled = [e for e in events if e.get("state") == "culled"]
+    assert culled and culled[0]["member"] == "b"
+    assert culled[0]["score"] == pytest.approx(10.0)
+    # culling is a selection verdict, not a failure: the fleet is clean
+    assert result["exit_code"] == 0
+
+
+def test_selection_hook_overrides_bottom_k(tmp_path):
+    def launcher(member, ctx):
+        rows = [_iter_rec(1, 10.0, reward=5.0, episodes=1)]
+        return [sys.executable, "-c", _STUB_WRITE_LOG_AND_EXIT,
+                ctx["member_dir"], "0", json.dumps(rows)]
+
+    seen = {}
+
+    def selection(scores):
+        seen.update(scores)
+        return ["a"]
+
+    spec = _fast_spec([MemberSpec("a"), MemberSpec("b")], max_workers=2)
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"), launcher=launcher,
+        latest_step_fn=lambda d: None, selection=selection,
+    )
+    result = sch.run(timeout=60)
+    assert set(seen) == {"a", "b"}
+    assert result["culled"] == ["a"]
+
+
+def test_fleet_gate_ok_regressed_and_requeued_skip(tmp_path):
+    spec = _fast_spec(
+        [MemberSpec(m) for m in ("ref", "ok", "slow", "requeued")],
+        gate_threshold_pct=200.0,
+    )
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"),
+        launcher=_stub_launcher_exit(0), latest_step_fn=lambda d: None,
+    )
+    rows = {
+        "ref": [10.0, 10.0, 10.0, 10.0],
+        "ok": [11.0, 11.0, 11.0, 11.0],
+        "slow": [10.0, 90.0, 90.0, 90.0],   # ~+800% steady: regressed
+        "requeued": [10.0, 10.0, 10.0, 10.0],
+    }
+    for mid, rec in sch.members.items():
+        _write_jsonl(rec.events_path, [_manifest_rec()] + [
+            _iter_rec(i + 1, ms) for i, ms in enumerate(rows[mid])
+        ])
+        rec.state = "finished"
+    sch.members["requeued"].requeues = 1
+    gate = sch.run_gate()
+    assert gate["members"]["ok"]["verdict"] == "ok"
+    assert gate["members"]["slow"]["verdict"] == "regressed"
+    assert gate["members"]["requeued"]["verdict"] == "skipped"
+    assert gate["exit_code"] == 1
+    # drop the regressor: clean gate
+    sch.members["slow"].state = "failed"
+    gate = sch.run_gate()
+    assert gate["members"]["slow"]["verdict"] == "skipped"
+    assert gate["exit_code"] == 0
+    # a requeued REFERENCE has no clean baseline: everything skips
+    # (comparing against downtime-polluted timings would wave real
+    # regressions through), and the gate says why
+    sch.members["ref"].requeues = 1
+    gate = sch.run_gate()
+    assert "no clean baseline" in gate["reason"]
+    assert all(
+        g["verdict"] == "skipped" for g in gate["members"].values()
+    )
+    assert gate["exit_code"] == 0
+
+
+def test_fleet_gate_unreadable_reference_exits_2(tmp_path):
+    spec = _fast_spec([MemberSpec("ref"), MemberSpec("x")])
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"),
+        launcher=_stub_launcher_exit(0), latest_step_fn=lambda d: None,
+    )
+    for rec in sch.members.values():
+        rec.state = "finished"  # but no event logs exist
+    gate = sch.run_gate()
+    assert gate["exit_code"] == 2
+    assert "reference" in gate["reason"]
+
+
+# ---------------------------------------------------------------------------
+# scrape + fleet endpoint
+# ---------------------------------------------------------------------------
+
+
+def _fake_snapshot():
+    return {
+        "schema": "trpo-tpu-fleet",
+        "members": {
+            "m0": {
+                "state": "running", "attempt": 2, "requeues": 1,
+                "failures": 0,
+                "live": {
+                    "iteration": 7,
+                    "stats": {"iteration_ms": 12.5,
+                              "reward_running": 30.0},
+                },
+            },
+            "m1": {"state": "pending", "attempt": 0, "requeues": 0,
+                   "failures": 0, "live": None},
+        },
+        "state_counts": {"running": 1, "pending": 1},
+        "finished": False,
+    }
+
+
+def test_render_fleet_prometheus_families():
+    text = render_fleet_prometheus(_fake_snapshot())
+    assert (
+        'trpo_fleet_member_state{member="m0",state="running"} 1' in text
+    )
+    assert (
+        'trpo_fleet_member_state{member="m0",state="pending"} 0' in text
+    )
+    assert 'trpo_fleet_member_attempt{member="m0"} 2' in text
+    assert 'trpo_fleet_member_requeues{member="m0"} 1' in text
+    assert 'trpo_fleet_member_iteration{member="m0"} 7' in text
+    assert 'trpo_fleet_member_iteration_ms{member="m0"} 12.5' in text
+    assert 'trpo_fleet_members_total{state="running"} 1' in text
+    # m1 has no live scrape: no iteration sample for it
+    assert 'trpo_fleet_member_iteration{member="m1"}' not in text
+
+
+def test_fleet_status_server_serves_status_and_metrics():
+    server = FleetStatusServer(_fake_snapshot, port=0)
+    try:
+        with urllib.request.urlopen(
+            server.url + "/status", timeout=10
+        ) as r:
+            snap = json.load(r)
+        assert snap["members"]["m0"]["live"]["iteration"] == 7
+        with urllib.request.urlopen(
+            server.url + "/metrics", timeout=10
+        ) as r:
+            text = r.read().decode()
+        assert "trpo_fleet_member_state" in text
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(server.url + "/nope", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        server.close()
+
+
+def test_scheduler_snapshot_tracks_states(tmp_path):
+    spec = _fast_spec([MemberSpec("m0")])
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"),
+        launcher=_stub_launcher_exit(0), latest_step_fn=lambda d: None,
+    )
+    assert sch.snapshot["members"]["m0"]["state"] == "pending"
+    assert sch.snapshot["finished"] is False
+    sch.run(timeout=30)
+    assert sch.snapshot["members"]["m0"]["state"] == "finished"
+    assert sch.snapshot["state_counts"] == {"finished": 1}
+    assert sch.snapshot["finished"] is True
+
+
+# ---------------------------------------------------------------------------
+# analyze: fleet summary + per-segment steady time
+# ---------------------------------------------------------------------------
+
+
+def test_analyze_summarizes_fleet_records():
+    from trpo_tpu.obs.analyze import render_summary, summarize_run
+
+    records = [
+        _manifest_rec(driver="fleet"),
+        _fleet_rec("m0", "launched", 1),
+        _fleet_rec("m0", "preempted", 1),
+        _fleet_rec("m0", "requeued", 1, resume_step=2),
+        _fleet_rec("m0", "launched", 2),
+        _fleet_rec("m0", "finished", 2),
+        _fleet_rec("m1", "launched", 1),
+        _fleet_rec("m1", "failed", 1),
+    ]
+    summary = summarize_run(records)
+    fleet = summary["fleet"]
+    assert fleet["members"]["m0"] == {
+        "last_state": "finished", "attempts": 2, "requeues": 1,
+        "transitions": 5,
+    }
+    assert fleet["members"]["m1"]["last_state"] == "failed"
+    assert fleet["counts"]["launched"] == 3
+    text = render_summary(summary)
+    assert "fleet:" in text and "m0" in text
+    # non-fleet logs: no block
+    assert summarize_run([_manifest_rec()])["fleet"] is None
+    # reader tolerance: a stateless fleet record (validator-invalid)
+    # must not crash the summary
+    broken = _fleet_rec("m2", "launched")
+    del broken["state"]
+    tolerated = summarize_run([_manifest_rec(), broken])
+    assert tolerated["fleet"]["counts"] == {"unknown": 1}
+
+
+def test_analyze_drops_first_row_per_segment():
+    """A requeued member's log holds TWO run segments; the first row
+    after EACH manifest carries compile and must stay out of the steady
+    mean."""
+    from trpo_tpu.obs.analyze import summarize_run
+
+    records = [
+        _manifest_rec(),
+        _iter_rec(1, 4000.0),
+        _iter_rec(2, 10.0),
+        _iter_rec(3, 10.0),
+        _manifest_rec(),       # the resumed run appends to the same file
+        _iter_rec(4, 3000.0),  # compile again
+        _iter_rec(5, 10.0),
+        _iter_rec(6, 10.0),
+    ]
+    summary = summarize_run(records)
+    assert summary["steady_iteration_ms"] == pytest.approx(10.0)
+    # single-segment logs keep the original drop-first rule
+    one = summarize_run([
+        _manifest_rec(),
+        _iter_rec(1, 4000.0), _iter_rec(2, 10.0), _iter_rec(3, 10.0),
+    ])
+    assert one["steady_iteration_ms"] == pytest.approx(10.0)
+
+
+# ---------------------------------------------------------------------------
+# fleet CLI plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_cli_builds_spec_with_inject(tmp_path):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ))
+    import fleet as fleet_cli
+
+    args = fleet_cli.build_parser().parse_args([
+        "--fleet-dir", str(tmp_path), "--grid", "seed=0..1",
+        "--max-workers", "1", "--inject", "seed1=sigterm@iter=2",
+        "--cull-bottom-k", "1",
+        "--", "--preset", "cartpole", "--iterations", "4",
+    ])
+    spec = fleet_cli._build_spec(args)
+    assert [m.member_id for m in spec.members] == ["seed0", "seed1"]
+    assert spec.max_workers == 1 and spec.cull_bottom_k == 1
+    assert spec.base_args[:2] == ("--preset", "cartpole")
+    assert spec.members[1].overrides_dict["inject_faults"] == \
+        "sigterm@iter=2"
+    # a typoed --inject member is a spec problem (ValueError → the
+    # CLI's documented exit 2), never the gate's exit 1
+    with pytest.raises(ValueError, match="known member"):
+        fleet_cli._build_spec(fleet_cli.build_parser().parse_args([
+            "--fleet-dir", str(tmp_path), "--grid", "seed=0..1",
+            "--inject", "nope=sigterm@iter=2",
+        ]))
+
+
+# ---------------------------------------------------------------------------
+# real members (slow): descriptor, live scrape acceptance, zero-lost-
+# iterations resume
+# ---------------------------------------------------------------------------
+
+_TRAIN_BASE = (
+    "--preset", "cartpole", "--batch-timesteps", "64", "--n-envs", "4",
+    "--platform", "cpu",
+)
+
+
+def test_train_writes_run_descriptor(tmp_path):
+    """Satellite 1: run.json carries pid, the BOUND ephemeral status
+    port, event-log path and checkpoint dir — discoverable without
+    parsing stdout."""
+    from trpo_tpu.train import main
+
+    desc_path = tmp_path / "run.json"
+    code = main([
+        *_TRAIN_BASE, "--iterations", "2",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+        "--metrics-jsonl", str(tmp_path / "events.jsonl"),
+        "--status-port", "0",
+        "--run-descriptor", str(desc_path),
+    ])
+    assert code == 0
+    desc = json.loads(desc_path.read_text())
+    assert desc["schema"] == "trpo-tpu-run-descriptor"
+    assert desc["pid"] == os.getpid()
+    assert isinstance(desc["status_port"], int)
+    assert 0 < desc["status_port"] < 65536
+    assert desc["status_url"].endswith(str(desc["status_port"]))
+    assert desc["events_jsonl"] == str(tmp_path / "events.jsonl")
+    assert desc["checkpoint_dir"] == str(tmp_path / "ck")
+    assert desc["resumed_from"] is None
+    # without the flag nothing is written (and no stale tmp remains)
+    assert not (tmp_path / "run.json.tmp").exists()
+
+
+@pytest.mark.slow
+def test_fleet_real_two_member_scrape_metrics(tmp_path):
+    """Acceptance: a REAL 2-member run's fleet /metrics exposes
+    per-member state, attempt counts and scraped steady-iteration
+    timings from the live members."""
+    spec = FleetSpec(
+        members=(MemberSpec("s0", (("seed", 0),)),
+                 MemberSpec("s1", (("seed", 1),))),
+        base_args=_TRAIN_BASE + ("--iterations", "400",),
+        max_workers=2,
+        poll_interval=0.05,
+        scrape_interval=0.2,
+    )
+    bus, events = _recording_bus()
+    sch = FleetScheduler(
+        spec, str(tmp_path / "fleet"), bus=bus, status_port=0
+    )
+    url = sch.status_server.url
+    seen_running = []
+
+    def poll():
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    url + "/metrics", timeout=5
+                ) as r:
+                    text = r.read().decode()
+            except Exception:
+                text = ""
+            if (
+                'trpo_fleet_member_iteration_ms{member="s0"}' in text
+                and 'trpo_fleet_member_iteration_ms{member="s1"}' in text
+                and 'state="running"} 1' in text
+            ):
+                seen_running.append(text)
+                return
+            time.sleep(0.2)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    try:
+        result = sch.run(timeout=240)
+    finally:
+        sch.close()
+    poller.join(timeout=10)
+    assert seen_running, (
+        "fleet /metrics never exposed scraped iteration timings from "
+        "both live members"
+    )
+    live_text = seen_running[0]
+    assert 'trpo_fleet_member_attempt{member="s0"} 1' in live_text
+    assert 'trpo_fleet_member_iteration{member="s0"}' in live_text
+    assert all(r["state"] == "finished"
+               for r in result["members"].values())
+    # the descriptor fed the scraper: final snapshot kept the last scrape
+    assert sch.snapshot["members"]["s0"]["live"] is not None
+    assert result["exit_code"] == 0
+
+
+@pytest.mark.slow
+def test_fleet_requeue_resumes_with_zero_lost_iterations(tmp_path):
+    """Satellite 4 (the orchestrator-level resume contract): a member
+    killed mid-run by the PR 4 injector requeues exactly once, its
+    event log's iteration sequence is gapless across the requeue, and
+    the resumed segment's first iteration is latest_step + 1."""
+    spec = FleetSpec(
+        members=(MemberSpec(
+            "chaos",
+            (("inject_faults", "sigterm@iter=2"),
+             ("checkpoint_every", 1)),
+        ),),
+        base_args=_TRAIN_BASE + ("--iterations", "5",),
+        max_workers=1,
+        requeue_backoff=0.1,
+        poll_interval=0.1,
+        scrape_interval=60.0,
+    )
+    bus, events = _recording_bus()
+    sch = FleetScheduler(spec, str(tmp_path / "fleet"), bus=bus)
+    try:
+        result = sch.run(timeout=300)
+    finally:
+        sch.close()
+    row = result["members"]["chaos"]
+    assert row["state"] == "finished", row
+    assert row["requeues"] == 1 and row["attempt"] == 2
+    fleet_states = [e["state"] for e in events if e["kind"] == "fleet"]
+    assert fleet_states == [
+        "launched", "preempted", "requeued", "launched", "finished",
+    ]
+    requeued = next(e for e in events if e.get("state") == "requeued")
+    resume_step = requeued["resume_step"]
+    assert isinstance(resume_step, int) and resume_step >= 1
+
+    # the member's event log: segments split by manifest, iteration
+    # sequence gapless overall, resumed segment starts at
+    # latest_step + 1
+    records = [
+        json.loads(line)
+        for line in open(sch.members["chaos"].events_path)
+    ]
+    manifest_idx = [
+        i for i, r in enumerate(records) if r["kind"] == "run_manifest"
+    ]
+    assert len(manifest_idx) == 2  # original + resumed segment
+    iterations = [
+        r["iteration"] for r in records if r["kind"] == "iteration"
+    ]
+    assert iterations == list(range(1, 6)), iterations  # gapless, total 5
+    second_segment = [
+        r["iteration"]
+        for r in records[manifest_idx[1]:]
+        if r["kind"] == "iteration"
+    ]
+    assert second_segment[0] == resume_step + 1
+
+    # both the member log and a fleet-event log pass the validator
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ))
+    from validate_events import validate_file
+
+    assert validate_file(sch.members["chaos"].events_path) == []

@@ -64,6 +64,9 @@ def test_phase_timer_jax_profiler_annotations():
     assert t.last_ms("annotated") >= 0.0
 
 
+@pytest.mark.slow  # tier-1 budget guard (ISSUE 7): whole-run trace is
+# ~30 s; test_profile_iteration_window_writes_trace stays the fast
+# tier-1 representative of the profiler path
 def test_cli_profile_dir_writes_trace(tmp_path):
     """--profile-dir produces a profiler trace (the CLI's jax.profiler
     wiring, validated end to end)."""

@@ -335,6 +335,8 @@ def _tiny_cfg(**kw):
     return TRPOConfig(**base)
 
 
+@pytest.mark.slow  # tier-1 budget guard (ISSUE 7): bit-exactness leg;
+# test_recovery_emits_events_and_counts stays the fast representative
 def test_nan_recovery_bit_exact_continuation():
     """The acceptance pin: a NaN-poisoned iteration is detected, the
     last-good state restored, the batch skipped — and the continuation is
@@ -377,6 +379,7 @@ def test_nan_recovery_bit_exact_continuation():
     _tree_equal(clean_final, fault_final)
 
 
+@pytest.mark.slow  # tier-1 budget guard (ISSUE 7)
 def test_nan_recovery_fused_chunk_no_duplicate_rows():
     """NaN inside a FUSED device chunk: only the first nonfinite row of
     the failed chunk is logged — the re-run's rows are the canonical
@@ -544,6 +547,9 @@ def test_recovery_escalates_adaptive_damping():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # tier-1 budget guard (ISSUE 7): in-process resume
+# leg; test_cli_exits_with_requeue_code stays the fast e2e
+# representative of the preemption path
 def test_sigterm_checkpoints_and_resumes(tmp_path):
     """SIGTERM mid-run: orderly shutdown writes a final checkpoint +
     raises Preempted with the requeue exit code; a resume loses NOTHING

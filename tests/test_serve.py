@@ -250,6 +250,64 @@ def test_batcher_engine_failure_fails_only_that_batch():
         batcher.close()
 
 
+def test_batcher_adaptive_deadline_cuts_idle_wait():
+    """Satellite (ROADMAP serving follow-on): with adaptive_deadline the
+    dispatcher caps its wait at ~2x the observed dispatch-cost EMA, so a
+    fast model under a SLOW request rate stops idling the fixed
+    half-budget — p50 drops to roughly the dispatch cost itself, while
+    the fixed-deadline batcher holds every lone request for
+    deadline/2."""
+    class _InstantEngine:
+        obs_shape = (2,)
+        obs_dtype = np.dtype(np.float32)
+        max_batch = 8
+
+        def padded_shape(self, n):
+            return 8 if n > 1 else 1
+
+        def infer(self, obs, return_step=False):
+            out = np.zeros(len(obs), np.int32)
+            return (out, 0) if return_step else out
+
+    deadline_ms = 80.0  # fixed half-budget: 40 ms of pure idle wait
+
+    def p50_of_lone_requests(batcher, n=9):
+        lats = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            batcher.submit(np.zeros(2, np.float32)).result(timeout=30.0)
+            lats.append((time.perf_counter() - t0) * 1e3)
+        return sorted(lats)[len(lats) // 2]
+
+    fixed = MicroBatcher(_InstantEngine(), deadline_ms=deadline_ms)
+    adaptive = MicroBatcher(
+        _InstantEngine(), deadline_ms=deadline_ms, adaptive_deadline=True
+    )
+    try:
+        # warm the EMA: the first adaptive dispatch has no cost sample
+        # yet and honors the fixed budget (upper-bound semantics)
+        adaptive.submit(np.zeros(2, np.float32)).result(timeout=30.0)
+        assert adaptive.dispatch_cost_ema_ms is not None
+        fixed_p50 = p50_of_lone_requests(fixed)
+        adaptive_p50 = p50_of_lone_requests(adaptive)
+        # fixed: every lone request idles the full half-budget
+        assert fixed_p50 >= deadline_ms / 2 * 0.8, fixed_p50
+        # adaptive: the wait collapses to ~the (sub-ms) dispatch cost
+        assert adaptive_p50 < fixed_p50 / 2, (adaptive_p50, fixed_p50)
+        assert adaptive_p50 < deadline_ms / 4, adaptive_p50
+        # the effective budget never EXCEEDS the configured half-budget
+        assert (
+            adaptive._effective_half_budget_ms() <= deadline_ms / 2
+        )
+    finally:
+        fixed.close()
+        adaptive.close()
+    with pytest.raises(ValueError, match="adaptive_headroom"):
+        MicroBatcher(_InstantEngine(), adaptive_headroom=0)
+    with pytest.raises(ValueError, match="cost_ema_alpha"):
+        MicroBatcher(_InstantEngine(), cost_ema_alpha=0)
+
+
 def test_batcher_close_drains_then_rejects(loaded_engine):
     _, engine = loaded_engine
     batcher = MicroBatcher(engine, deadline_ms=1000.0)
@@ -353,6 +411,9 @@ def test_policy_server_routes_and_errors(loaded_engine):
             body = r.read().decode()
         assert "trpo_serve_requests_total" in body
         assert 'trpo_serve_batch_shape_total{shape="1"}' in body
+        # the adaptive-deadline signal is observable once a dispatch
+        # has seeded the EMA (the /act above did)
+        assert "trpo_serve_dispatch_cost_ema_ms" in body
         for ln in body.splitlines():
             if ln and not ln.startswith("#"):
                 float(ln.rsplit(" ", 1)[1])  # prometheus-parseable
@@ -393,6 +454,9 @@ def test_policy_server_checkpointer_template_pairing(loaded_engine):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # tier-1 budget guard (ISSUE 7): the e2e hot-swap
+# scenario; test_reload_failure_keeps_serving_last_good stays the
+# fast tier-1 representative of the reload path
 def test_hot_reload_under_concurrent_load(tmp_path):
     from trpo_tpu.utils.checkpoint import Checkpointer
 

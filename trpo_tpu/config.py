@@ -392,6 +392,18 @@ class TRPOConfig:
     #                                request has spent HALF this budget
     #                                waiting (the other half belongs to
     #                                the inference itself)
+    serve_adaptive_deadline: bool = True  # batcher-level adaptive
+    #                                deadline (serve/batcher.py): cap the
+    #                                effective dispatch wait at ~2× the
+    #                                EMA of observed inference cost
+    #                                (never above the fixed half-budget
+    #                                — adaptivity only SHRINKS the idle),
+    #                                so a small/fast model doesn't hold
+    #                                every request for the full
+    #                                serve_deadline_ms/2 on the off-
+    #                                chance more coalesce; under a slow
+    #                                request rate p50 drops to roughly
+    #                                the dispatch cost itself
     serve_poll_interval: float = 1.0  # checkpoint hot-reload watcher
     #                                (serve/server.py): seconds between
     #                                Checkpointer.latest_step() polls;

@@ -1,0 +1,69 @@
+"""Elastic fleet orchestrator (ISSUE 7 tentpole): preemptible multi-run
+scheduling with auto-requeue, checkpoint resume, and a fleet-wide
+health/perf gate.
+
+The reference paper trains one run on one host; the ROADMAP's
+preemptible-fleet item asks for the control plane above it — the
+composition of PR 4's survivability (exit 75 = requeue, marker-gated
+checkpoints) with PR 5's observability (/status, /metrics,
+``analyze_run --compare``):
+
+* :mod:`trpo_tpu.fleet.spec` — :class:`FleetSpec`/:class:`MemberSpec`,
+  the ``--grid seed=0..3,...`` expansion and the JSON spec-file form;
+* :mod:`trpo_tpu.fleet.scheduler` — :class:`FleetScheduler`: bounded
+  worker slots, requeue-on-preemption with zero lost iterations,
+  crash budgets, the selection (cull) hook and the fleet gate;
+* :mod:`trpo_tpu.fleet.scrape` — member discovery via ``run.json``
+  descriptors, /status scraping, and the fleet-level ``/status`` +
+  ``/metrics`` endpoint;
+* :mod:`trpo_tpu.fleet.events` — the typed ``fleet`` lifecycle records
+  on the PR 3 run-event bus.
+
+``scripts/fleet.py`` is the CLI; see ARCHITECTURE.md "Fleet".
+"""
+
+from trpo_tpu.fleet.events import (  # noqa: F401
+    FLEET_STATES,
+    TERMINAL_STATES,
+    emit_fleet,
+)
+from trpo_tpu.fleet.scheduler import (  # noqa: F401
+    FleetScheduler,
+    MemberRecord,
+    default_member_argv,
+    score_event_records,
+)
+from trpo_tpu.fleet.scrape import (  # noqa: F401
+    FleetStatusServer,
+    read_descriptor,
+    render_fleet_prometheus,
+    scrape_member,
+)
+from trpo_tpu.fleet.spec import (  # noqa: F401
+    FleetSpec,
+    MemberSpec,
+    expand_grid,
+    load_spec_file,
+    member_cli_args,
+    member_total_iterations,
+)
+
+__all__ = [
+    "FLEET_STATES",
+    "TERMINAL_STATES",
+    "emit_fleet",
+    "FleetScheduler",
+    "MemberRecord",
+    "default_member_argv",
+    "score_event_records",
+    "FleetStatusServer",
+    "read_descriptor",
+    "render_fleet_prometheus",
+    "scrape_member",
+    "FleetSpec",
+    "MemberSpec",
+    "expand_grid",
+    "load_spec_file",
+    "member_cli_args",
+    "member_total_iterations",
+]

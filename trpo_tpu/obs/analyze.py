@@ -34,9 +34,17 @@ Comparison semantics (:func:`compare_runs`):
   from either run (no silent verdict about unmeasured things — they are
   reported as ``skipped``).
 
-The steady iteration time drops each run's FIRST iteration row when
-more than two exist: iteration 1 carries XLA compilation, which would
-otherwise dominate short gate runs and hide real regressions.
+The steady iteration time drops each run *segment*'s FIRST iteration
+row when more than two rows exist: the first row after every
+``run_manifest`` carries XLA compilation — and a resumed/requeued
+member (the ISSUE 7 fleet orchestrator appends the resumed run to the
+SAME event file) has one such compile-laden row per segment, which
+would otherwise dominate short gate runs and hide real regressions.
+
+Fleet logs (``fleet`` lifecycle records from ``fleet/scheduler.py``)
+get their own summary block: per-member last state / attempts /
+requeues plus state totals — so ``analyze_run.py`` on a fleet's event
+log reads as a fleet report.
 """
 
 from __future__ import annotations
@@ -154,6 +162,38 @@ def _summarize_serving(records: list) -> Optional[dict]:
     }
 
 
+def _summarize_fleet(records: list) -> Optional[dict]:
+    """Aggregate ``fleet`` lifecycle records (fleet/scheduler.py) into a
+    per-member table: last state, launch attempts, requeues — plus the
+    fleet-wide state totals. None for non-fleet logs."""
+    fleet = [r for r in records if r.get("kind") == "fleet"]
+    if not fleet:
+        return None
+    members: dict = {}
+    counts: Counter = Counter()
+    for r in fleet:
+        mid, state = r.get("member"), r.get("state")
+        if not isinstance(mid, str):
+            continue
+        if not isinstance(state, str):
+            # reader contract: tolerate what the validator rejects — a
+            # stateless record must not make sorted() compare None<str
+            state = "unknown"
+        counts[state] += 1
+        row = members.setdefault(
+            mid, {"last_state": None, "attempts": 0, "requeues": 0,
+                  "transitions": 0}
+        )
+        row["last_state"] = state
+        row["transitions"] += 1
+        a = r.get("attempt")
+        if isinstance(a, int) and not isinstance(a, bool):
+            row["attempts"] = max(row["attempts"], a)
+        if state == "requeued":
+            row["requeues"] += 1
+    return {"members": members, "counts": dict(sorted(counts.items()))}
+
+
 def summarize_run(records: list) -> dict:
     """One run's report, computed from its event records alone."""
     manifest = next(
@@ -167,7 +207,27 @@ def summarize_run(records: list) -> dict:
     iter_ms = [
         (r.get("stats") or {}).get("iteration_ms") for r in iters
     ]
-    steady_ms = _mean(iter_ms[1:] if len(iter_ms) > 2 else iter_ms)
+    # every run SEGMENT's first iteration row carries XLA compilation: a
+    # resumed/requeued run appends a new manifest + a compile-laden first
+    # row mid-file, so the drop is per segment, not just row 1 (records
+    # walk in FILE order here — `iters` above is sorted by iteration)
+    compile_rows = set()
+    awaiting_first = False
+    for r in records:
+        if r.get("kind") == "run_manifest":
+            awaiting_first = True
+        elif r.get("kind") == "iteration" and awaiting_first:
+            compile_rows.add(id(r))
+            awaiting_first = False
+    steady_vals = [
+        (r.get("stats") or {}).get("iteration_ms")
+        for r in iters
+        if id(r) not in compile_rows
+    ]
+    if compile_rows and steady_vals and len(iter_ms) > 2:
+        steady_ms = _mean(steady_vals)
+    else:  # manifest-less/tiny logs: the pre-fleet single-drop rule
+        steady_ms = _mean(iter_ms[1:] if len(iter_ms) > 2 else iter_ms)
     throughput = None
     if len(iters) >= 2:
         ts0 = (iters[0].get("stats") or {}).get("timesteps_total")
@@ -262,6 +322,7 @@ def summarize_run(records: list) -> dict:
             "peak_live_buffer_bytes": live_peak,
         },
         "serving": serving,
+        "fleet": _summarize_fleet(records),
         "events_total": dict(
             Counter(r.get("kind") for r in records)
         ),
@@ -535,6 +596,23 @@ def render_summary(summary: dict) -> str:
                 ],
                 ["padded", "batches", "requests", "p50_ms", "p99_ms"],
             ))
+    fleet = summary.get("fleet") or {}
+    if fleet:
+        out.append("")
+        out.append(
+            "fleet: "
+            + ", ".join(
+                f"{k}×{v}" for k, v in (fleet.get("counts") or {}).items()
+            )
+        )
+        out.append(format_table(
+            [
+                [mid, row.get("last_state"), row.get("attempts"),
+                 row.get("requeues")]
+                for mid, row in sorted((fleet.get("members") or {}).items())
+            ],
+            ["member", "state", "attempts", "requeues"],
+        ))
     mem = summary.get("memory") or {}
     progs = mem.get("programs") or {}
     if progs or mem.get("peak_live_buffer_bytes") is not None:

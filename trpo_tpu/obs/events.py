@@ -52,6 +52,15 @@ Kinds:
   aggregates these into p50/p99 latency and actions/s so
   ``analyze_run.py --compare`` regression-gates serving runs like
   training runs.
+* ``fleet`` — one member lifecycle transition recorded by the fleet
+  orchestrator (``fleet/scheduler.py``): which member, which state
+  (``FLEET_STATES``: launched / preempted / requeued / finished /
+  failed / culled), and the launch attempt it happened on. A fleet's
+  event log is self-auditing the same way a chaos run's is —
+  ``scripts/validate_events.py`` checks every ``preempted`` record is
+  followed by the member's ``requeued`` or ``failed`` resolution (a
+  preemption the scheduler never resolved means the requeue loop is
+  broken).
 
 Sinks are append-only and flush-on-write; the JSONL sink repairs a
 crash-truncated final line on open (``utils/metrics.repair_jsonl_tail``),
@@ -76,6 +85,7 @@ from trpo_tpu.utils.metrics import repair_jsonl_tail
 __all__ = [
     "SCHEMA_VERSION",
     "EVENT_KINDS",
+    "FLEET_STATES",
     "EventBus",
     "JsonlSink",
     "ConsoleSink",
@@ -84,6 +94,13 @@ __all__ = [
 ]
 
 SCHEMA_VERSION = 1
+
+# member lifecycle states the fleet orchestrator may record (the state
+# machine lives in fleet/scheduler.py; the vocabulary lives HERE so the
+# validator needs no fleet import)
+FLEET_STATES = (
+    "launched", "preempted", "requeued", "finished", "failed", "culled",
+)
 
 _SCALAR = (bool, int, float, str, type(None))
 
@@ -151,6 +168,16 @@ _REQUIRED = {
         and not isinstance(v, bool)
         and v >= 0,
         "latency_ms": lambda v: isinstance(v, (int, float))
+        and not isinstance(v, bool)
+        and v >= 0,
+    },
+    "fleet": {
+        # one member lifecycle transition (fleet/scheduler.py): member
+        # id, the state entered, and the 1-based launch attempt it
+        # happened on (0 for records before any launch)
+        "member": lambda v: isinstance(v, str) and v,
+        "state": lambda v: v in FLEET_STATES,
+        "attempt": lambda v: isinstance(v, int)
         and not isinstance(v, bool)
         and v >= 0,
     },

@@ -238,6 +238,19 @@ def _num(v):
     return None
 
 
+def _fmt(v: float) -> str:
+    """One Prometheus sample value as text (NaN/±Inf are legal).
+    Module-level so the fleet endpoint (fleet/scrape.py) renders
+    samples identically instead of keeping a diverging copy."""
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
 def render_prometheus(snap: dict) -> str:
     """The snapshot as Prometheus text format (version 0.0.4).
 
@@ -267,15 +280,6 @@ def render_prometheus(snap: dict) -> str:
             out.append(f"# HELP {name} {help_}")
             out.append(f"# TYPE {name} {mtype}")
             out.extend(rows)
-
-    def _fmt(v: float) -> str:
-        if math.isnan(v):
-            return "NaN"
-        if math.isinf(v):
-            return "+Inf" if v > 0 else "-Inf"
-        if v == int(v) and abs(v) < 1e15:
-            return str(int(v))
-        return repr(v)
 
     stats = snap.get("stats") or {}
     if snap.get("iteration") is not None:

@@ -23,6 +23,18 @@ of unbounded process memory — the same bound-not-buffer policy as the
 PR 5 ``StatsDrain``. An engine failure fails exactly the requests in
 that batch (their futures carry the exception); the dispatcher thread
 survives and keeps serving.
+
+Adaptive deadline (``adaptive_deadline=True``, the ROADMAP follow-on):
+the fixed half-budget is tuned for the inference cost it must leave
+room for — but a small/fast model answers in well under a millisecond,
+and idling a 5 ms half-budget on the off-chance more requests coalesce
+costs every request ~5 ms of pure queue latency. The batcher tracks an
+EMA of the observed dispatch cost and caps the effective wait at
+``adaptive_headroom ×`` that EMA (never above the configured
+half-budget — the deadline stays the upper bound, adaptivity only
+shrinks the idle): under a slow request rate p50 drops to roughly the
+dispatch cost itself (test-pinned), while a fast model under burst
+load still coalesces within its (tiny) natural batching window.
 """
 
 from __future__ import annotations
@@ -58,15 +70,30 @@ class MicroBatcher:
         max_queue: int = 1024,
         bus=None,
         latency_window: int = 2048,
+        adaptive_deadline: bool = False,
+        adaptive_headroom: float = 2.0,
+        cost_ema_alpha: float = 0.2,
     ):
         if deadline_ms <= 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if adaptive_headroom <= 0:
+            raise ValueError(
+                f"adaptive_headroom must be > 0, got {adaptive_headroom}"
+            )
+        if not 0 < cost_ema_alpha <= 1:
+            raise ValueError(
+                f"cost_ema_alpha must be in (0, 1], got {cost_ema_alpha}"
+            )
         self.engine = engine
         self.deadline_ms = float(deadline_ms)
         self.max_queue = int(max_queue)
         self.bus = bus
+        self.adaptive_deadline = bool(adaptive_deadline)
+        self.adaptive_headroom = float(adaptive_headroom)
+        self._cost_alpha = float(cost_ema_alpha)
+        self._cost_ema_ms: Optional[float] = None
         self._cond = threading.Condition()
         self._queue: deque = deque()
         self._closed = False
@@ -132,6 +159,29 @@ class MicroBatcher:
             return {}
         return {q: quantile_nearest_rank(lats, q) for q in qs}
 
+    @property
+    def dispatch_cost_ema_ms(self) -> Optional[float]:
+        """EMA of the observed per-dispatch engine cost (None before
+        the first successful dispatch) — the adaptive-deadline signal,
+        exposed for /metrics and the tests."""
+        with self._lat_lock:
+            return self._cost_ema_ms
+
+    def _effective_half_budget_ms(self) -> float:
+        """The wait budget the dispatcher actually honors: the fixed
+        half-deadline, shrunk — when ``adaptive_deadline`` — to
+        ``adaptive_headroom × dispatch-cost EMA`` (floored at 0.1 ms so
+        concurrent submitters still coalesce). Before the first
+        dispatch there is no EMA and the fixed budget applies."""
+        half = self.deadline_ms / 2.0
+        if not self.adaptive_deadline:
+            return half
+        with self._lat_lock:
+            ema = self._cost_ema_ms
+        if ema is None:
+            return half
+        return min(half, max(self.adaptive_headroom * ema, 0.1))
+
     # -- dispatcher --------------------------------------------------------
 
     def _loop(self) -> None:
@@ -145,7 +195,7 @@ class MicroBatcher:
                 # dispatch when full, when the oldest request's deadline
                 # budget is half-spent, or when draining at close
                 age_ms = (time.perf_counter() - self._queue[0].t) * 1e3
-                budget_ms = self.deadline_ms / 2.0 - age_ms
+                budget_ms = self._effective_half_budget_ms() - age_ms
                 if (
                     len(self._queue) < full
                     and budget_ms > 0
@@ -164,6 +214,7 @@ class MicroBatcher:
     def _dispatch(self, batch, depth_after: int) -> None:
         obs = np.stack([p.obs for p in batch], axis=0)
         rung = self.engine.padded_shape(len(batch))
+        t_infer = time.perf_counter()
         try:
             actions, step = self.engine.infer(obs, return_step=True)
         except Exception as e:
@@ -174,9 +225,16 @@ class MicroBatcher:
                 p.future.set_exception(e)
             return
         done = time.perf_counter()
+        cost_ms = (done - t_infer) * 1e3
         lats = [(done - p.t) * 1e3 for p in batch]
         with self._lat_lock:
             self._latencies_ms.extend(lats)
+            self._cost_ema_ms = (
+                cost_ms
+                if self._cost_ema_ms is None
+                else self._cost_alpha * cost_ms
+                + (1.0 - self._cost_alpha) * self._cost_ema_ms
+            )
         for p, action in zip(batch, actions):
             p.future.set_result((np.asarray(action), step))
         with self._cond:

@@ -300,6 +300,17 @@ class PolicyServer:
                 for qq, v in sorted(q.items())
             ],
         )
+        ema = getattr(b, "dispatch_cost_ema_ms", None)
+        if ema is not None:
+            # the adaptive-deadline signal: without it an operator
+            # cannot see why the effective dispatch wait collapsed
+            # (or didn't)
+            fam(
+                "trpo_serve_dispatch_cost_ema_ms", "gauge",
+                "EMA of observed per-dispatch engine cost (the "
+                "adaptive-deadline signal)",
+                [("", _finite_or_none(ema))],
+            )
         fam(
             "trpo_serve_checkpoint_step", "gauge",
             "checkpoint step currently served",

@@ -262,6 +262,16 @@ def build_parser() -> argparse.ArgumentParser:
         "(health:memory_leak)",
     )
     p.add_argument(
+        "--run-descriptor",
+        metavar="PATH",
+        help="write a run.json descriptor here at startup (atomic): pid, "
+        "the BOUND status port/url (an ephemeral --status-port 0 is "
+        "otherwise only printed to stdout), event-log path, checkpoint "
+        "dir, resume step — so external tooling (the fleet scraper, "
+        "scripts/fleet.py) discovers a run without parsing console "
+        "output",
+    )
+    p.add_argument(
         "--evaluate",
         type=_positive_int,
         metavar="N_STEPS",
@@ -432,6 +442,45 @@ def config_from_args(args: argparse.Namespace) -> TRPOConfig:
     return dataclasses.replace(cfg, **updates)
 
 
+def _write_run_descriptor(args, cfg, telemetry, checkpointer) -> None:
+    """The ``--run-descriptor`` run.json: everything external tooling
+    needs to find this run (pid, BOUND status port, event log,
+    checkpoint dir) — written atomically (tmp + replace) so a reader
+    polling for the file never sees a partial JSON, and written AFTER
+    the status server bound so an ephemeral ``--status-port 0`` is
+    discoverable without parsing stdout."""
+    import json
+    import os
+    import time
+
+    server = telemetry.status_server if telemetry is not None else None
+    desc = {
+        "schema": "trpo-tpu-run-descriptor",
+        "pid": os.getpid(),
+        "started_t": time.time(),
+        "env": cfg.env,
+        "preset": args.preset,
+        "status_port": server.port if server is not None else None,
+        "status_url": server.url if server is not None else None,
+        "events_jsonl": os.path.abspath(args.metrics_jsonl)
+        if args.metrics_jsonl
+        else None,
+        "log_jsonl": os.path.abspath(cfg.log_jsonl)
+        if cfg.log_jsonl
+        else None,
+        "checkpoint_dir": os.path.abspath(cfg.checkpoint_dir)
+        if cfg.checkpoint_dir
+        else None,
+        "resumed_from": checkpointer.latest_step()
+        if (checkpointer is not None and args.resume)
+        else None,
+    }
+    tmp = args.run_descriptor + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(desc, f)
+    os.replace(tmp, args.run_descriptor)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.platform:
@@ -501,6 +550,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         jsonl_path=cfg.log_jsonl,
         bus=telemetry.bus if telemetry is not None else None,
     )
+
+    if args.run_descriptor:
+        _write_run_descriptor(args, cfg, telemetry, checkpointer)
 
     import contextlib
 
