@@ -353,12 +353,17 @@ def build_problem(compute_dtype=None, hidden=None) -> Problem:
     )
 
 
-def _update_bench_setup(device=None, fvp_subsample=None):
+def _update_bench_setup(device=None, fvp_subsample=None, fvp_dtype=None,
+                        cfg_overrides=None):
     """Policy/batch/update builder at the Humanoid operating point —
-    shared by :func:`time_full_update` and
-    :func:`update_tail_breakdown` so the phase programs time EXACTLY the
+    shared by :func:`time_full_update`, :func:`update_tail_breakdown`
+    and :func:`solve_precision` so the phase programs time EXACTLY the
     shapes/dtypes the full-update metric runs (bf16 matmuls on the
-    accelerator, fp32 on the CPU paths)."""
+    accelerator, fp32 on the CPU paths). ``fvp_dtype``/``cfg_overrides``
+    parameterize the solver-precision-ladder variants; bf16 configs get
+    ``solve_audit_every=1`` to satisfy validation — the audit itself
+    only traces when a ladder state is threaded (trpo.py's contract), so
+    pure timings stay clean."""
     from trpo_tpu.config import TRPOConfig
     from trpo_tpu.models import make_policy, BoxSpec
     from trpo_tpu.trpo import TRPOBatch, make_trpo_update
@@ -384,22 +389,44 @@ def _update_bench_setup(device=None, fvp_subsample=None):
         old_dist=dist,
         weight=jnp.ones((BATCH,), jnp.float32),
     )
-    cfg = TRPOConfig(
+    kw = dict(
         cg_iters=CG_ITERS, cg_damping=DAMPING, cg_residual_tol=0.0,
         fvp_subsample=fvp_subsample,
     )
+    if fvp_dtype is not None:
+        kw["fvp_dtype"] = fvp_dtype
+        if fvp_dtype == "bf16":
+            kw["solve_audit_every"] = 1
+    kw.update(cfg_overrides or {})
+    cfg = TRPOConfig(**kw)
     return policy, params, batch, cfg, make_trpo_update(policy, cfg)
 
 
-def time_full_update(device=None, fvp_subsample=None):
+def time_full_update(device=None, fvp_subsample=None, fvp_dtype=None,
+                     cfg_overrides=None, thread_ladder=False):
     """Secondary tracked metric (BASELINE.json): policy-updates/sec — the
     ENTIRE fused natural-gradient update (surrogate grad → 10-iter CG over
     FVPs → step scale → line search → KL rollback) as one jitted program at
     the Humanoid operating point.
 
-    ``fvp_subsample`` reports the framework's curvature-subsampling
-    operating point (``TRPOConfig.fvp_subsample``) as an additional
-    number; the headline stays full-batch (reference semantics)."""
+    ``fvp_subsample``/``fvp_dtype``/``cfg_overrides`` parameterize the
+    solver-precision-ladder variants (the ``solve_precision`` block);
+    the headline stays full-batch f32 (reference semantics).
+
+    ``thread_ladder`` carries a ``trpo.LadderState`` through the chained
+    updates (required for ``cg_budget_adaptive`` to act) and WARMS it
+    before timing — three untimed chains converge the adaptive budget,
+    then the timed chains run from that steady state. The timed config's
+    ``solve_audit_every`` is forced far beyond the chain length so NO
+    audit re-solve ever lands inside a timed chain on ANY backend (the
+    accelerator path chains 120 updates — at the preset cadence of 25
+    that would embed ~5 full-precision re-solves per timed rep): the
+    published number is the steady-state non-audit cost, and the
+    audit's amortized overhead is ~(full_solve/cheap_solve)/cadence on
+    top.
+
+    Returns ``(updates_per_sec, ms_per_update, runs_ms)`` — runs_ms is
+    the per-rep list feeding the contention-retry machinery."""
     import contextlib
 
     ctx = (
@@ -408,8 +435,16 @@ def time_full_update(device=None, fvp_subsample=None):
         else contextlib.nullcontext()
     )
     with ctx:
+        if thread_ladder:
+            # audits must never land inside a timed chain (docstring):
+            # step 0's audit fires in the first (untimed) warm chain,
+            # and the next one sits far past any chain this function
+            # ever replays
+            cfg_overrides = {
+                **(cfg_overrides or {}), "solve_audit_every": 1_000_000,
+            }
         policy, params, batch, cfg, update = _update_bench_setup(
-            device, fvp_subsample
+            device, fvp_subsample, fvp_dtype, cfg_overrides
         )
         # full updates are ~4× a bare solve; CPU path: see time_fused_solve.
         # The subsampled update is ~5× cheaper — chain proportionally more
@@ -431,37 +466,69 @@ def time_full_update(device=None, fvp_subsample=None):
         # loaded 2-core host (round-6 tail study) — take best of 3
         n_reps = TIMING_REPS if device is None else 3
 
-        @jax.jit
-        def chained_updates(params, batch):
-            def body(p, _):
-                new_p, stats = update(p, batch)
-                # carry the updated params: each step is a genuinely new
-                # problem (serialized, nothing hoistable out of the scan)
-                return new_p, stats.kl
+        if thread_ladder:
+            from trpo_tpu.trpo import init_ladder
 
-            p_last, kls = jax.lax.scan(
-                body, params, None, length=n_chain
-            )
-            return p_last, kls
+            ladder0 = init_ladder(cfg)
 
-        _progress("full update: compiling")
-        p_last, kls = chained_updates(params, batch)
-        np.asarray(kls)
+            @jax.jit
+            def chained_updates(carry, batch):
+                def body(c, _):
+                    p, lad = c
+                    new_p, stats = update(p, batch, None, None, lad)
+                    return (new_p, stats.ladder_next), stats.kl
+
+                c_last, kls = jax.lax.scan(
+                    body, carry, None, length=n_chain
+                )
+                return c_last, kls
+
+            _progress("full update: compiling (ladder threaded)")
+            carry = (params, ladder0)
+            # warm the ladder: the adaptive budget converges to the
+            # residual rule's exit point before any timed rep
+            for _ in range(3):
+                carry, kls = chained_updates(carry, batch)
+            np.asarray(kls)
+            carry0 = carry
+            run = lambda: chained_updates(carry0, batch)
+        else:
+            @jax.jit
+            def chained_updates(params, batch):
+                def body(p, _):
+                    new_p, stats = update(p, batch)
+                    # carry the updated params: each step is a genuinely
+                    # new problem (serialized, nothing hoistable out of
+                    # the scan)
+                    return new_p, stats.kl
+
+                p_last, kls = jax.lax.scan(
+                    body, params, None, length=n_chain
+                )
+                return p_last, kls
+
+            _progress("full update: compiling")
+            _, kls = chained_updates(params, batch)
+            np.asarray(kls)
+            run = lambda: chained_updates(params, batch)
         rtt = _device_rtt()
         _progress(f"full update: timing (rtt {rtt * 1e3:.0f} ms)")
-        best = float("inf")
+        best, runs_ms = float("inf"), []
         for _ in range(n_reps):
             t0 = time.perf_counter()
-            p_last, kls = chained_updates(params, batch)
+            _, kls = run()
             np.asarray(kls)
-            best = min(best, time.perf_counter() - t0)
+            elapsed = time.perf_counter() - t0
+            runs_ms.append(max(elapsed - rtt, 1e-9) / n_chain * 1e3)
+            best = min(best, elapsed)
         assert np.all(np.isfinite(np.asarray(kls))), "non-finite KL chain"
         _progress("full update: done")
     per_update = max(best - rtt, 1e-9) / n_chain
-    return 1.0 / per_update, per_update * 1e3
+    return 1.0 / per_update, per_update * 1e3, runs_ms
 
 
-def update_tail_breakdown(full_update_ms=None, device=None):
+def update_tail_breakdown(full_update_ms=None, device=None,
+                          ladder_row=None):
     """Phase-level attribution of the full fused update (round 6
     tentpole: the non-solve tail had grown to ~25% of the update budget
     and had never been itemized).
@@ -494,7 +561,7 @@ def update_tail_breakdown(full_update_ms=None, device=None):
     on_accel = _ACCEL and device is None
     with ctx:
         if full_update_ms is None:
-            _, full_update_ms = time_full_update(device=device)
+            _, full_update_ms, _ = time_full_update(device=device)
         policy, params, batch, cfg, _ = _update_bench_setup(device)
         flat0, unravel = flatten_params(params)
         flat0 = jnp.asarray(flat0, jnp.float32)
@@ -710,6 +777,21 @@ def update_tail_breakdown(full_update_ms=None, device=None):
         )
     return {
         "full_update_ms": round(full_update_ms, 4),
+        # the configuration these phase programs ran (ISSUE 8: every
+        # update-tail row carries its precision tags; the phase programs
+        # here time the full-batch f32 reference semantics, cosine 1 by
+        # definition)
+        "fvp_dtype": "f32",
+        "fvp_subsample": None,
+        "solve_cosine": 1.0,
+        # the ladder's full-update row (solve_precision's "ladder"
+        # variant: bf16 FVP + ¾-batch curvature + adaptive budget) —
+        # embedded HERE so the regenerated breakdown quotes the ladder
+        # delta next to the phase attribution it explains
+        "ladder": ladder_row,
+        "ladder_speedup_vs_f32": None
+        if not ladder_row
+        else round(full_update_ms / ladder_row["full_update_ms"], 3),
         "phases_ms": phases,
         "expected_linesearch_trials": n_trials,
         "phases_sum_ms": round(phases_sum, 4),
@@ -726,6 +808,129 @@ def update_tail_breakdown(full_update_ms=None, device=None):
             "stats pass (linesearch aux)",
             "linesearch_kl_cap constraint reads the trial's forward — "
             "zero extra forwards per trial",
+        ],
+    }
+
+
+def solve_precision(device=None, f32_row=None):
+    """The solver-precision-ladder harvest (ISSUE 8 satellite): the full
+    fused update at the flagship shape under each ladder rung —
+
+    * ``f32``       — reference semantics (the r06 lineage baseline);
+    * ``bf16``      — bf16 FVP matvec, f32 CG accumulators;
+    * ``subsample`` — ¾-batch curvature (the preset operating point);
+    * ``ladder``    — everything on: bf16 + ¾-batch + the residual-rule
+      early exit with the adaptive CG budget, timed with a WARMED
+      ``LadderState`` threaded through the chain (steady-state
+      non-audit cost; the audit re-solve amortizes over its cadence).
+
+    Every row: min-over-reps via :func:`time_full_update`, the
+    contention retry the headline phases use, and a measured
+    ``solve_cosine`` tag — one audited update per variant (ladder state
+    with ``step=0`` forces the audit) quoting the on-device cosine
+    between that variant's solution and the full-precision/full-batch
+    solve of the same system.
+    """
+    import contextlib
+
+    from trpo_tpu.trpo import init_ladder
+
+    # a fresh context manager per use — jax.default_device() objects are
+    # single-entry
+    make_ctx = lambda: (
+        jax.default_device(device)
+        if device is not None
+        else contextlib.nullcontext()
+    )
+    load0 = os.getloadavg()[0] if hasattr(os, "getloadavg") else None
+    ladder_cfg = {
+        "cg_residual_rtol": 1e-2,
+        "cg_budget_adaptive": True,
+        "cg_budget_floor": 2,
+        "solve_audit_every": 25,  # the preset cadence
+    }
+    variants = [
+        ("f32", dict()),
+        ("bf16", dict(fvp_dtype="bf16")),
+        ("subsample", dict(fvp_subsample=0.75, cfg_overrides={
+            "solve_audit_every": 25,
+        })),
+        ("ladder", dict(fvp_dtype="bf16", fvp_subsample=0.75,
+                        cfg_overrides=dict(ladder_cfg),
+                        thread_ladder=True)),
+    ]
+    rows = []
+    f32_ms = None
+    for label, kw in variants:
+        if label == "f32" and f32_row is not None:
+            # the headline full-update timing IS this row — reuse it
+            ms, runs = f32_row
+            retried, runs_first = False, None
+        else:
+            _progress(f"solve precision: {label}")
+            _, ms, runs = time_full_update(device=device, **kw)
+            ms, _x, runs, retried, runs_first = _retry_phase_if_contended(
+                f"solve_precision/{label}",
+                (ms, None, runs),
+                lambda kw=kw: (
+                    lambda r: (r[1], None, r[2])
+                )(time_full_update(device=device, **kw)),
+                load=load0,
+            )
+        # measured solution cosine: one audited update per variant (the
+        # f32 row audits trivially against itself → 1.0)
+        cos = None
+        if label == "f32":
+            cos = 1.0
+        else:
+            try:
+                with make_ctx():
+                    _p, _pp, batch, cfg, update = _update_bench_setup(
+                        device,
+                        kw.get("fvp_subsample"),
+                        kw.get("fvp_dtype"),
+                        {**kw.get("cfg_overrides", {}),
+                         "solve_audit_every": 1},
+                    )
+                    _, stats = jax.jit(update)(
+                        _pp, batch, None, None, init_ladder(cfg)
+                    )
+                    cos = float(np.asarray(stats.solve_cosine))
+            except Exception as e:
+                _progress(
+                    f"solve precision: cosine probe failed for {label} "
+                    f"({type(e).__name__}: {e})"
+                )
+        if label == "f32":
+            f32_ms = ms
+        rows.append({
+            "variant": label,
+            "fvp_dtype": kw.get("fvp_dtype", "f32"),
+            "fvp_subsample": kw.get("fvp_subsample"),
+            "adaptive_budget": bool(
+                kw.get("cfg_overrides", {}).get("cg_budget_adaptive")
+            ),
+            "full_update_ms": round(ms, 4),
+            "runs_ms": [round(r, 4) for r in runs],
+            "retried": retried,
+            "runs_first_attempt": None
+            if runs_first is None
+            else [round(r, 4) for r in runs_first],
+            "solve_cosine": None if cos is None else round(cos, 6),
+            "speedup_vs_f32": None
+            if f32_ms is None
+            else round(f32_ms / ms, 3),
+        })
+    return {
+        "rows": rows,
+        "notes": [
+            "ladder row: steady-state non-audit cost with a warmed "
+            "LadderState threaded (budget converged before timing); "
+            "the full-precision audit re-solve adds ~1/solve_audit_"
+            "every of an f32 solve amortized",
+            "solve_cosine: on-device audit cosine of ONE update "
+            "(ladder step=0 forces the audit) vs the f32/full-batch "
+            "solve of the same system",
         ],
     }
 
@@ -1684,19 +1889,48 @@ def main():
         except Exception as e:
             _progress(f"host-driven ablation failed ({type(e).__name__}: {e})")
     upd_dev = None if _ACCEL else jax.devices("cpu")[0]
+    update_runs = None
     try:
-        updates_per_sec, update_ms = time_full_update(device=upd_dev)
+        updates_per_sec, update_ms, update_runs = time_full_update(
+            device=upd_dev
+        )
     except Exception as e:  # secondary metric must not sink the headline
         _progress(f"full-update timing failed ({type(e).__name__}: {e})")
         updates_per_sec = update_ms = None
+    # solver precision ladder harvest (ISSUE 8): f32 vs bf16 vs
+    # subsampled vs full-ladder full update, each with a measured
+    # solution-cosine tag; BENCH_SOLVE_PRECISION=0 skips
+    precision = None
+    if update_ms is not None and os.environ.get(
+        "BENCH_SOLVE_PRECISION", "1"
+    ) != "0":
+        try:
+            _progress("solve precision ladder")
+            precision = solve_precision(
+                device=upd_dev, f32_row=(update_ms, update_runs)
+            )
+        except Exception as e:
+            _progress(
+                f"solve-precision ladder failed ({type(e).__name__}: {e})"
+            )
     # phase-level attribution of the full update (round-6 tentpole);
     # BENCH_TAIL=0 skips (smoke runs that only need the solve headline)
     tail_breakdown = None
     if update_ms is not None and os.environ.get("BENCH_TAIL", "1") != "0":
         try:
             _progress("update-tail breakdown")
+            ladder_row = None
+            if precision:
+                ladder_row = next(
+                    (
+                        r for r in precision["rows"]
+                        if r["variant"] == "ladder"
+                    ),
+                    None,
+                )
             tail_breakdown = update_tail_breakdown(
-                full_update_ms=update_ms, device=upd_dev
+                full_update_ms=update_ms, device=upd_dev,
+                ladder_row=ladder_row,
             )
         except Exception as e:
             _progress(
@@ -1727,7 +1961,7 @@ def main():
     updates_per_sec_sub = None
     if _ACCEL and updates_per_sec is not None:
         try:
-            updates_per_sec_sub, _ = time_full_update(
+            updates_per_sec_sub, _, _ = time_full_update(
                 device=upd_dev, fvp_subsample=FVP_SUB
             )
         except Exception as e:
@@ -1953,10 +2187,24 @@ def main():
                 "solution_cosine": round(cos, 6),
                 "policy_updates_per_sec": _r(updates_per_sec, 2),
                 "full_update_ms": _r(update_ms, 3),
+                # precision tags for the headline full-update row
+                # (ISSUE 8): reference semantics — the ladder variants
+                # live in solve_precision.rows with the same tags
+                "full_update_tags": {
+                    "fvp_dtype": "f32",
+                    "fvp_subsample": None,
+                    "solve_cosine": 1.0,
+                },
                 "policy_updates_per_sec_fvp_subsample": _r(
                     updates_per_sec_sub, 2
                 ),
                 "fvp_subsample": FVP_SUB,
+                # -- solver precision ladder (ISSUE 8): f32/bf16/
+                #    subsampled/full-ladder full update at the flagship
+                #    shape, min-over-reps with the contention retry,
+                #    each row tagged with its measured on-device audit
+                #    cosine vs the f32/full-batch solve --
+                "solve_precision": precision,
                 # -- phase-level attribution of the full update (round-6
                 #    tentpole): each phase its own chained-dependent
                 #    program; coverage = sum(phases)/full_update_ms --
@@ -2140,6 +2388,17 @@ def _emit_bench_events(artifact, tail_breakdown, host_pipe) -> None:
         if tail_breakdown:
             for name, ms in tail_breakdown["phases_ms"].items():
                 bus.emit("phase", name=f"update_tail/{name}", ms=ms)
+        # solve-precision rows: one phase record per ladder variant,
+        # carrying the precision tags (extra fields are schema-legal)
+        for row in (artifact.get("solve_precision") or {}).get("rows", []):
+            bus.emit(
+                "phase",
+                name=f"solve_precision/{row['variant']}",
+                ms=row["full_update_ms"],
+                fvp_dtype=row["fvp_dtype"],
+                fvp_subsample=row["fvp_subsample"],
+                solve_cosine=row["solve_cosine"],
+            )
         if host_pipe:
             for key in ("host_step_ms_per_iter", "device_rtt_ms"):
                 if host_pipe.get(key) is not None:

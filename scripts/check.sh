@@ -167,6 +167,47 @@ python scripts/validate_events.py "$SERVE_TMP/base/serve_events.jsonl" \
 python scripts/analyze_run.py "$SERVE_TMP/new/serve_events.jsonl" \
     --compare "$SERVE_TMP/base/serve_events.jsonl" --threshold-pct 500
 
+echo "== solver precision ladder smoke: bf16/subsampled solve vs f32 gate =="
+# ISSUE 8 acceptance: a cartpole run with the full ladder on (bf16 FVP,
+# half-batch curvature, audit every 2 updates) must emit a schema-valid
+# event log whose audit counters are populated, hold reward parity with
+# an f32 twin through analyze_run.py --compare, and take ZERO fallbacks.
+# --solve-cosine-floor 0.9: the audit cosine's subsample noise scales
+# as 1/sqrt(curvature batch) — the 0.999 default floor belongs to the
+# flagship 50k batch (BENCH_LADDER "Solve precision harvest"); at this
+# 256-step smoke batch the half-batch cosine sits ~0.97 (seeded runs,
+# so the margin is deterministic).
+LADDER_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu python -m trpo_tpu.train --preset cartpole \
+    --iterations 4 --batch-timesteps 256 --n-envs 4 --platform cpu \
+    --metrics-jsonl "$LADDER_TMP/f32.jsonl" > /dev/null
+JAX_PLATFORMS=cpu python -m trpo_tpu.train --preset cartpole \
+    --iterations 4 --batch-timesteps 256 --n-envs 4 --platform cpu \
+    --fvp-dtype bf16 --fvp-subsample 0.5 --solve-audit-every 2 \
+    --solve-cosine-floor 0.9 --health-checks \
+    --metrics-jsonl "$LADDER_TMP/ladder.jsonl" > /dev/null
+python scripts/validate_events.py "$LADDER_TMP/f32.jsonl" \
+    "$LADDER_TMP/ladder.jsonl"
+python scripts/analyze_run.py "$LADDER_TMP/ladder.jsonl" \
+    --compare "$LADDER_TMP/f32.jsonl" --threshold-pct 200 --min-ms 5
+python - "$LADDER_TMP" <<'PYEOF'
+import json, os, sys
+rows = [
+    json.loads(line)
+    for line in open(os.path.join(sys.argv[1], "ladder.jsonl"))
+]
+last = [r for r in rows if r.get("kind") == "iteration"][-1]["stats"]
+assert last["audit_runs"] >= 2, last
+assert last["fallbacks"] == 0, last
+assert not last["solve_pinned"], last
+assert last["solve_cosine_min"] >= 0.9, last
+assert last["rollback_total"] == 0, last  # ladder must not cost rollbacks
+print(
+    "ladder smoke OK: audits=%d fallbacks=0 rollbacks=0 cosine_min=%.4f"
+    % (last["audit_runs"], last["solve_cosine_min"])
+)
+PYEOF
+
 echo "== pytest tier-1 (8-device virtual CPU mesh) =="
 # timed so every PR sees the headroom against the ROADMAP tier-1 budget
 T1_START=$SECONDS

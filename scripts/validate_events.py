@@ -141,6 +141,42 @@ def validate_file(path: str) -> list:
                 f"{path}:{n}: fault_injected ({rec.get('spec')}) has no "
                 "matching detection/recovery record after it"
             )
+    # ISSUE 8 solver-precision contract (same pattern as the
+    # fault-matching rule): a rise in the run-cumulative `fallbacks`
+    # counter means an audit failed and the update fell back — the
+    # health monitor MUST have recorded a matching health:solve_fallback
+    # after that iteration row; a silent fallback means the
+    # detect→report loop is broken.
+    # Gated on the log showing the monitor RAN at all (any health
+    # record): the fallbacks counter is emitted whenever the ladder is
+    # armed, but health records only exist under --health-checks — a
+    # run without the opt-in monitor has a valid log with no pairing to
+    # enforce. Baseline 0, matching the monitor: the counter starts at
+    # 0 (trpo.init_ladder), so a first-row fallback is a rise too — and
+    # a resumed log's carried-over total is re-reported by the
+    # monitor's own 0-baseline, keeping the pairing satisfiable there.
+    monitor_ran = any(rec.get("kind") == "health" for _, rec in records)
+    prev_fb = 0
+    for idx, (n, rec) in enumerate(records):
+        if not monitor_ran:
+            break
+        if rec.get("kind") != "iteration":
+            continue
+        fb = (rec.get("stats") or {}).get("fallbacks")
+        if not isinstance(fb, int) or isinstance(fb, bool):
+            continue
+        if fb > prev_fb:
+            if not any(
+                later.get("kind") == "health"
+                and later.get("check") == "solve_fallback"
+                for _, later in records[idx + 1:]
+            ):
+                errs.append(
+                    f"{path}:{n}: solve fallback count rose "
+                    f"({prev_fb} -> {fb}) with no matching "
+                    "health:solve_fallback record after it"
+                )
+        prev_fb = fb
     # ISSUE 7 fleet contract (same pattern as the fault-matching rule):
     # a preempted member the scheduler never requeued or failed is a
     # broken requeue loop, not a valid log

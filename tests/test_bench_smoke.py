@@ -29,7 +29,7 @@ def test_bench_cpu_smoke_json_contract():
         [sys.executable, "bench.py"],
         capture_output=True,
         text=True,
-        timeout=420,
+        timeout=540,
         env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
@@ -81,6 +81,26 @@ def test_bench_cpu_smoke_json_contract():
     # width study ran with the overridden width
     assert [r["hidden"] for r in j["width_study"]] == [[16, 16]]
     assert all(r["ms_per_iter"] > 0 for r in j["width_study"])
+    # solver precision ladder (ISSUE 8): the four variant rows with
+    # their precision tags, each timed and cosine-probed; the headline
+    # full-update row carries its own tags
+    sp = j["solve_precision"]
+    assert [r["variant"] for r in sp["rows"]] == [
+        "f32", "bf16", "subsample", "ladder",
+    ]
+    for r in sp["rows"]:
+        assert r["full_update_ms"] > 0
+        assert "fvp_dtype" in r and "fvp_subsample" in r
+        assert r["speedup_vs_f32"] and r["speedup_vs_f32"] > 0
+    assert sp["rows"][0]["solve_cosine"] == 1.0
+    # bf16 under f32 accumulators stays essentially exact at any batch
+    assert sp["rows"][1]["solve_cosine"] >= 0.999
+    assert j["full_update_tags"]["fvp_dtype"] == "f32"
+    # the tail breakdown carries the same tags + the embedded ladder row
+    bd = j["update_tail_breakdown"]
+    assert bd["fvp_dtype"] == "f32" and bd["solve_cosine"] == 1.0
+    assert bd["ladder"]["variant"] == "ladder"
+    assert bd["ladder_speedup_vs_f32"] > 0
 
 
 @pytest.mark.slow
@@ -124,6 +144,7 @@ def test_bench_analytic_fallback_fills_flops():
     env["BENCH_BATCH"] = "256"
     env["BENCH_WIDTHS"] = ""
     env["BENCH_FORCE_ANALYTIC"] = "1"
+    env["BENCH_SOLVE_PRECISION"] = "0"  # covered by the main smoke
     out = subprocess.run(
         [sys.executable, "bench.py"],
         capture_output=True,

@@ -177,15 +177,18 @@ def test_fvp_subsample_validates_fraction():
         obs, jnp.zeros(16, jnp.int32), jnp.zeros(16),
         jax.lax.stop_gradient(dist), jnp.ones(16),
     )
+    # range validation moved to TRPOConfig.__post_init__ (ISSUE 8
+    # satellite): a bad fraction fails at CONSTRUCTION, before any solve
     for bad in (-0.5, 0.0, 5.0):
-        with pytest.raises(ValueError):
-            make_trpo_update(policy, TRPOConfig(fvp_subsample=bad))(
-                params, batch
-            )
-    # an in-range fraction just under 1 must actually subsample (ceil
-    # stride), never silently run full-batch
+        with pytest.raises(ValueError, match="fvp_subsample"):
+            TRPOConfig(fvp_subsample=bad)
+    # an in-range fraction just under 1 must actually subsample, never
+    # silently run full-batch: fractions ≤ ½ stride (0.5 → every 2nd),
+    # fractions above ½ drop every k-th sample (0.75 → keep 3 of 4)
     from trpo_tpu.trpo import _fvp_batch
-    assert _fvp_batch(batch, 0.75).weight.shape[0] == 8
+    assert _fvp_batch(batch, 0.5).weight.shape[0] == 8
+    assert _fvp_batch(batch, 0.75).weight.shape[0] == 12
+    assert _fvp_batch(batch, 0.9).weight.shape[0] < 16
 
 
 def test_adaptive_damping_feedback():

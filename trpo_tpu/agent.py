@@ -95,6 +95,17 @@ class TrainState(NamedTuple):
     #                           the stats pytree, so they ride the
     #                           deferred stats drain with zero extra
     #                           device→host syncs. Donated like the rest.
+    ladder: Any = None        # trpo.LadderState when the solver precision
+    #                           ladder's stateful machinery is armed
+    #                           (trpo.ladder_stateful(cfg): bf16/
+    #                           subsampled solve under the cosine audit,
+    #                           and/or the adaptive CG budget), else
+    #                           None. Audit cadence, fail-streak/pin
+    #                           escalation, adaptive budget, and the
+    #                           run-cumulative audit counters — all
+    #                           device scalars riding the same donated
+    #                           state + deferred-drain path as
+    #                           ``metrics``.
 
 
 class TRPOAgent:
@@ -255,6 +266,12 @@ class TRPOAgent:
             cfg.cg_precondition == "head_block"
             and cfg.precond_refresh_every > 1
         )
+        # Solver precision ladder (ISSUE 8): the audit/fallback machine
+        # and the adaptive CG budget need state threaded between updates
+        # (trpo.LadderState in TrainState.ladder)
+        from trpo_tpu.trpo import ladder_stateful
+
+        self._ladder_stateful = ladder_stateful(cfg)
 
         # steps per env per iteration, so T·N ≥ batch_timesteps
         # (ref batch budget semantics, trpo_inksci.py:17 + utils.py:21).
@@ -534,7 +551,12 @@ class TRPOAgent:
             else None,
             precond=precond,
             metrics=init_device_metrics(),
+            ladder=None,
         )
+        if self._ladder_stateful:
+            from trpo_tpu.trpo import init_ladder
+
+            state = state._replace(ladder=init_ladder(self.cfg))
         if self.mesh is not None:
             # Annotate EVERY remaining leaf replicated over the mesh. This
             # matters for checkpoint/resume: Checkpointer.restore takes its
@@ -812,7 +834,7 @@ class TRPOAgent:
             )
         new_policy_params, trpo_stats = self.trpo_update(
             train_state.policy_params, batch, train_state.cg_damping,
-            train_state.precond,
+            train_state.precond, train_state.ladder,
         )
 
         done_f = traj.done.astype(jnp.float32)
@@ -836,8 +858,12 @@ class TRPOAgent:
         # TrainState (donated) and snapshot into phase B's stats pytree
         new_metrics = train_state.metrics
         if new_metrics is not None:
+            # cap = the budget THIS update actually solved under
+            # (stats.cg_budget == cfg.cg_iters unless the adaptive
+            # ladder shrank it): a solve that runs its shrunken budget
+            # to the cap unconverged must not count as an early exit
             new_metrics = accumulate_update(
-                new_metrics, trpo_stats, self.cfg.cg_iters
+                new_metrics, trpo_stats, trpo_stats.cg_budget
             )
         new_state = train_state._replace(
             policy_params=new_policy_params,
@@ -853,11 +879,15 @@ class TRPOAgent:
             if trpo_stats.precond_next is not None
             else train_state.precond,
             metrics=new_metrics,
+            ladder=trpo_stats.ladder_next
+            if trpo_stats.ladder_next is not None
+            else train_state.ladder,
         )
-        # the (H+1)² factor matrices belong in TrainState, not in the
-        # per-iteration stats pytree (run_iterations would stack them
-        # n times over)
-        trpo_stats = trpo_stats._replace(precond_next=None)
+        # the (H+1)² factor matrices (and the ladder state — its scalar
+        # counters are snapshotted below instead) belong in TrainState,
+        # not in the per-iteration stats pytree (run_iterations would
+        # stack them n times over)
+        trpo_stats = trpo_stats._replace(precond_next=None, ladder_next=None)
         fit_pack = {
             "vf_in": vf_in,
             "vtarg": flat(vtarg),
@@ -872,6 +902,10 @@ class TRPOAgent:
             # stats assembly (same buffers as new_state.metrics — phase B
             # is always dispatched before the next phase A donates them)
             "device_metrics": new_metrics,
+            # post-update ladder snapshot (same contract as
+            # device_metrics): the audit counters surface in the stats
+            # pytree with zero extra transfers
+            "ladder": new_state.ladder,
         }
         return new_state, fit_pack
 
@@ -918,13 +952,37 @@ class TRPOAgent:
             "cg_damping": trpo_stats.damping,
             # --- per-iteration solver observability (PR 3) ---
             "linesearch_trials": trpo_stats.linesearch_trials,
-            "cg_early_exit": trpo_stats.cg_iterations < self.cfg.cg_iters,
+            # against the budget this update SOLVED UNDER (== cg_iters
+            # unless the adaptive ladder shrank it), so a shrunken-cap
+            # solve that ran unconverged never reads as an early exit
+            "cg_early_exit": trpo_stats.cg_iterations
+            < trpo_stats.cg_budget,
             "nan_guard": trpo_stats.nan_guard,
         }
         if fit_pack.get("device_metrics") is not None:
             # run-cumulative device counters — part of the SAME stats
             # pytree, so they drain/log/emit with zero extra transfers
             stats.update(metrics_stats(fit_pack["device_metrics"]))
+        if fit_pack.get("ladder") is not None:
+            # solver precision ladder (ISSUE 8): per-update audit result
+            # + the run-cumulative audit counters, riding the same stats
+            # pytree. The health monitor watches `fallbacks` rises and
+            # the `solve_pinned` flip; validate_events.py enforces the
+            # fallback→health:solve_fallback pairing.
+            lad = fit_pack["ladder"]
+            stats.update({
+                "solve_cosine": trpo_stats.solve_cosine,
+                "solve_audited": trpo_stats.solve_audited,
+                "solve_fallback": trpo_stats.solve_fallback,
+                # POST-update pin state (lad is the carried-forward
+                # ladder): the pinning iteration reports it immediately
+                # instead of one drain later
+                "solve_pinned": lad.pinned,
+                "cg_budget": lad.cg_budget,
+                "solve_cosine_min": lad.cosine_min,
+                "audit_runs": lad.audit_runs,
+                "fallbacks": lad.fallbacks,
+            })
         return new_vf_state, stats
 
     def _process_trajectory(

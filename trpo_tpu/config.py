@@ -129,6 +129,80 @@ class TRPOConfig:
     #                                full-batch. The curvature estimate
     #                                tolerates sampling noise — the classic
     #                                TRPO large-batch throughput lever.
+    #                                Range-validated HERE (__post_init__),
+    #                                with the other config invariants, not
+    #                                at solve time. The MuJoCo presets
+    #                                default 0.75 with solve_audit_every=25
+    #                                (the measured-safe operating point —
+    #                                BENCH_LADDER "Solve precision
+    #                                harvest").
+    fvp_dtype: str = "f32"         # solver precision ladder, rung 1: run
+    #                                the Fisher-vector matvec's forward/
+    #                                tangent matmuls in this dtype ("f32"
+    #                                or "bf16" — XLA GGN, jvp_grad, and
+    #                                Pallas fused paths all honor it).
+    #                                ops/cg.py keeps ALL solver
+    #                                accumulators (x, r, p, dot products,
+    #                                residual test) in f32 regardless —
+    #                                Fisher conditioning at flagship
+    #                                batches does not survive bf16
+    #                                accumulation (cg.py header). "bf16"
+    #                                REQUIRES solve_audit_every >= 1: a
+    #                                reduced-precision solve without the
+    #                                cosine audit is a config error.
+    solve_audit_every: int = 0     # every k-th update, re-solve the same
+    #                                system at full precision / full batch
+    #                                under a lax.cond and fold the solution
+    #                                cosine into the donated
+    #                                TrainState.metrics-style ladder state
+    #                                (zero extra host syncs — PR 3's
+    #                                solver-counter pattern). A cosine
+    #                                below solve_cosine_floor flags the
+    #                                update and uses the full-precision
+    #                                solution for that step;
+    #                                solve_fallback_limit consecutive
+    #                                failures pin the ladder at f32.
+    #                                0 = no auditing (only valid while the
+    #                                ladder's bf16 rung is off). Audits run
+    #                                only when the agent threads
+    #                                TrainState.ladder — direct
+    #                                make_trpo_update calls without a
+    #                                ladder state time the bare cheap path
+    #                                (bench.py's contract).
+    solve_cosine_floor: float = 0.999  # minimum audit cosine between the
+    #                                cheap (bf16/subsampled) and the
+    #                                full-precision solution before the
+    #                                update falls back (the acceptance
+    #                                gate bench.py has used since r03)
+    solve_fallback_limit: int = 3  # consecutive failed audits before the
+    #                                ladder pins itself at the f32/full-
+    #                                batch solve for the rest of the run
+    #                                (health:solve_pinned — the
+    #                                adaptive_damping-style escalation)
+    cg_budget_adaptive: bool = False  # adaptive restart/iteration
+    #                                budgets: track the residual-rule
+    #                                early-exit point and shrink the CG
+    #                                iteration cap toward it (exit + 1),
+    #                                growing it again (+2) whenever a
+    #                                solve runs to the cap without
+    #                                converging — so the preconditioned
+    #                                solve stops paying for iterations it
+    #                                never uses. Needs a residual rule
+    #                                (cg_residual_tol/rtol > 0) to observe
+    #                                exits, and takes effect when
+    #                                TrainState.ladder is threaded.
+    cg_budget_floor: int = 2       # adaptive budget never shrinks below
+    cg_budget_ceiling: Optional[int] = None  # …or grows above this
+    #                                (None = cg_iters)
+    solve_fault_skew: float = 0.0  # fault injection (chaos/testing): scale
+    #                                the CHEAP FVP operator by a symmetric
+    #                                alternating diagonal (D·F·D, D =
+    #                                1 + skew on every other coordinate) so
+    #                                it solves a genuinely wrong system
+    #                                while the audit's full-precision
+    #                                operator stays clean — the lever the
+    #                                audit→fallback→pin tests and the chaos
+    #                                smoke drive. 0 = off (production).
     fvp_mode: str = "auto"         # Fisher-vector product factorization:
     #                                "auto" (default) = "fused" when the
     #                                policy/backend qualify (plain-MLP
@@ -428,6 +502,63 @@ class TRPOConfig:
                 'fvp_mode must be "auto", "fused", "ggn" or "jvp_grad", '
                 f"got {self.fvp_mode!r}"
             )
+        if self.fvp_dtype not in ("f32", "bf16"):
+            raise ValueError(
+                f'fvp_dtype must be "f32" or "bf16", got {self.fvp_dtype!r}'
+            )
+        if self.fvp_subsample is not None and not (
+            0.0 < self.fvp_subsample <= 1.0
+        ):
+            # moved here from solve time (trpo._fvp_batch): a bad fraction
+            # fails at construction with the other invariants, not on the
+            # first traced update
+            raise ValueError(
+                "fvp_subsample must be in (0, 1], got "
+                f"{self.fvp_subsample}"
+            )
+        if self.solve_audit_every < 0:
+            raise ValueError(
+                "solve_audit_every must be >= 0 (0 = no auditing), got "
+                f"{self.solve_audit_every}"
+            )
+        if self.fvp_dtype == "bf16" and self.solve_audit_every < 1:
+            # the ladder's reduced-precision rung without its audit is a
+            # config error, not a quiet mode: there would be nothing to
+            # catch a bf16 solve drifting off the true natural gradient
+            raise ValueError(
+                'fvp_dtype="bf16" requires solve_audit_every >= 1 — the '
+                "precision ladder is only safe under the on-device "
+                "solution-cosine audit (set solve_audit_every, or keep "
+                'fvp_dtype="f32")'
+            )
+        if not 0.0 < self.solve_cosine_floor <= 1.0:
+            raise ValueError(
+                "solve_cosine_floor must be in (0, 1], got "
+                f"{self.solve_cosine_floor}"
+            )
+        if self.solve_fallback_limit < 1:
+            raise ValueError(
+                "solve_fallback_limit must be >= 1, got "
+                f"{self.solve_fallback_limit}"
+            )
+        if self.solve_fault_skew < 0:
+            raise ValueError(
+                "solve_fault_skew must be >= 0, got "
+                f"{self.solve_fault_skew}"
+            )
+        if self.cg_budget_adaptive:
+            ceiling = self.resolved_cg_budget_ceiling()
+            if not 1 <= self.cg_budget_floor <= ceiling:
+                raise ValueError(
+                    "need 1 <= cg_budget_floor <= cg_budget_ceiling, got "
+                    f"({self.cg_budget_floor}, {ceiling})"
+                )
+            if not (self.cg_residual_tol > 0 or self.cg_residual_rtol > 0):
+                raise ValueError(
+                    "cg_budget_adaptive needs a residual rule to observe "
+                    "early exits — set cg_residual_tol or "
+                    "cg_residual_rtol > 0"
+                )
         if self.cg_precondition not in (
             False, True, "jacobi", "head_block"
         ):
@@ -531,6 +662,17 @@ class TRPOConfig:
                     f"({self.damping_min}, {self.damping_max})"
                 )
 
+    def resolved_cg_budget_ceiling(self) -> int:
+        """The adaptive CG budget's ceiling with its None-default
+        resolved (= cg_iters) — the ONE place the rule lives; the
+        validator above, ``trpo.init_ladder`` and the traced clip in
+        ``trpo._natural_gradient_update`` all call this."""
+        return (
+            self.cg_iters
+            if self.cg_budget_ceiling is None
+            else self.cg_budget_ceiling
+        )
+
     def replace(self, **kw) -> "TRPOConfig":
         return dataclasses.replace(self, **kw)
 
@@ -564,6 +706,15 @@ PRESETS = {
     # Overriding a preset with a conv/MoE/recurrent policy requires
     # cg_precondition=False (head_block inverts the plain-MLP Gaussian
     # head's exact Fisher block).
+    # They also default the solver precision ladder's curvature
+    # subsampling ON (fvp_subsample=0.75 — keep 3 of every 4 samples —
+    # audited every 25 updates): the r07 solve-precision harvest
+    # measured the 3/4-batch curvature at solution cosine ≥ 0.999 at
+    # both the halfcheetah (5k) and humanoid (50k) shapes, where the
+    # 1/2-batch rung fell to ~0.9984 (BENCH_LADDER "Solve precision
+    # harvest"). fvp_dtype stays
+    # "f32" in the presets — the bf16 rung is opt-in until the TPU
+    # re-run protocol (ROADMAP) confirms the deltas on hardware.
     "halfcheetah": TRPOConfig(
         env="gym:HalfCheetah-v4",
         gamma=0.99,
@@ -575,6 +726,8 @@ PRESETS = {
         cg_damping=0.1,
         cg_precondition="head_block",
         precond_refresh_every=25,
+        fvp_subsample=0.75,
+        solve_audit_every=25,
     ),
     # "Humanoid-v2 MuJoCo (376-dim obs, batch 50k — large FVP matvec)"
     "humanoid": TRPOConfig(
@@ -588,6 +741,8 @@ PRESETS = {
         cg_damping=0.1,
         cg_precondition="head_block",
         precond_refresh_every=25,
+        fvp_subsample=0.75,
+        solve_audit_every=25,
     ),
     # On-device stand-ins for the MuJoCo/Atari rungs (same obs/act dims,
     # pure-JAX dynamics — see trpo_tpu.envs.locomotion / .catch): these run
@@ -603,6 +758,8 @@ PRESETS = {
         cg_damping=0.1,
         cg_precondition="head_block",
         precond_refresh_every=25,
+        fvp_subsample=0.75,
+        solve_audit_every=25,
     ),
     "humanoid-sim": TRPOConfig(
         env="humanoid-sim",
@@ -615,6 +772,8 @@ PRESETS = {
         cg_damping=0.1,
         cg_precondition="head_block",
         precond_refresh_every=25,
+        fvp_subsample=0.75,
+        solve_audit_every=25,
     ),
     # Partially observable CartPole (velocities masked) + GRU policy — the
     # recurrent-model-family rung; no reference analogue (SURVEY §2.1: the

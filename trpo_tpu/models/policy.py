@@ -45,6 +45,14 @@ class Policy(NamedTuple):
     # MoE / recurrent policies): lets the update layer choose the fused
     # Pallas FVP kernel (ops/fused_fvp.py) when the architecture matches.
     mlp_spec: Any = None
+    # ``apply`` with the matmul compute dtype overridden per call:
+    # ``apply_cast(params, obs, dtype) -> dist params``. This is the
+    # solver precision ladder's bf16 FVP boundary (cfg.fvp_dtype): the
+    # Fisher-vector matvec re-runs the forward/tangent matmuls in bf16
+    # while params, dist outputs, and every CG accumulator stay f32.
+    # None for model families without a castable forward (recurrent,
+    # MoE) — the update layer rejects fvp_dtype="bf16" there.
+    apply_cast: Any = None
 
 
 def make_policy(
@@ -99,13 +107,12 @@ def make_policy(
                 )
             return params
 
-        def head_forward(params, obs):
+        def head_forward(params, obs, dtype=None):
+            dtype = compute_dtype if dtype is None else dtype
             feats = apply_atari_torso(
-                params["torso"], obs, compute_dtype=compute_dtype
+                params["torso"], obs, compute_dtype=dtype
             )
-            return apply_mlp(
-                params["head"], feats, activation, compute_dtype
-            )
+            return apply_mlp(params["head"], feats, activation, dtype)
     else:
         obs_dim = math.prod(obs_shape)
 
@@ -118,16 +125,27 @@ def make_policy(
                 )
             return params
 
-        def head_forward(params, obs):
+        def head_forward(params, obs, dtype=None):
             obs = obs.reshape(obs.shape[0], -1)
-            return apply_mlp(params["net"], obs, activation, compute_dtype)
+            return apply_mlp(
+                params["net"], obs, activation,
+                compute_dtype if dtype is None else dtype,
+            )
 
-    def apply(params, obs):
-        raw = head_forward(params, obs)
+    def _apply(params, obs, dtype):
+        raw = head_forward(params, obs, dtype)
         if dist is Categorical:
             return {"logits": raw}
         log_std = jnp.broadcast_to(params["log_std"], raw.shape)
         return {"mean": raw, "log_std": log_std}
+
+    def apply(params, obs):
+        return _apply(params, obs, None)
+
+    def apply_cast(params, obs, dtype):
+        """``apply`` with the matmul dtype overridden (f32 everywhere
+        else) — the fvp_dtype="bf16" matvec boundary."""
+        return _apply(params, obs, dtype)
 
     mlp_spec = None
     if not conv_torso:
@@ -142,6 +160,7 @@ def make_policy(
         dist=dist,
         action_spec=action_spec,
         mlp_spec=mlp_spec,
+        apply_cast=apply_cast,
     )
 
 

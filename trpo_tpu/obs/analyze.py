@@ -291,6 +291,33 @@ def summarize_run(records: list) -> dict:
 
     serving = _summarize_serving(records)
 
+    # -- solver precision ladder (ISSUE 8) ---------------------------------
+    # the counters are run-cumulative (they ride TrainState.ladder), so
+    # the LAST row that carries them is the run total; cosine stats come
+    # from the per-iteration audit values
+    solver_precision = None
+    ladder_rows = [
+        r for r in iters if "fallbacks" in (r.get("stats") or {})
+    ]
+    if ladder_rows:
+        last_l = ladder_rows[-1].get("stats") or {}
+        cosines = [
+            v
+            for v in (
+                _finite((r.get("stats") or {}).get("solve_cosine"))
+                for r in ladder_rows
+            )
+            if v is not None
+        ]
+        solver_precision = {
+            "audit_runs": last_l.get("audit_runs"),
+            "fallbacks": last_l.get("fallbacks"),
+            "solve_cosine_min": _finite(last_l.get("solve_cosine_min")),
+            "solve_cosine_mean": _mean(cosines),
+            "cg_budget_final": last_l.get("cg_budget"),
+            "pinned": bool(last_l.get("solve_pinned")),
+        }
+
     return {
         "manifest": {
             k: manifest.get(k)
@@ -322,6 +349,7 @@ def summarize_run(records: list) -> dict:
             "peak_live_buffer_bytes": live_peak,
         },
         "serving": serving,
+        "solver_precision": solver_precision,
         "fleet": _summarize_fleet(records),
         "events_total": dict(
             Counter(r.get("kind") for r in records)
@@ -338,6 +366,10 @@ def summarize_run(records: list) -> dict:
 _METRIC_DIRECTIONS = {
     "steady_iteration_ms": "time",
     "timesteps_per_sec": "rate",
+    # reward parity (ISSUE 8's mixed-precision gate: a ladder run must
+    # land within the threshold of its f32 twin; identical-config gate
+    # legs are seed-deterministic, so the row is exact there)
+    "final_reward_running": "rate",
 }
 
 
@@ -411,11 +443,21 @@ def compare_runs(
 
     # scalar run metrics
     for metric, direction in _METRIC_DIRECTIONS.items():
+        b, n = base.get(metric), new.get(metric)
+        if metric == "final_reward_running" and b is not None and b <= 0:
+            # rewards are signed: _verdict's base<=0 branch was written
+            # for time/bytes growth-from-zero and would call a collapse
+            # from -50 to -400 "ok" (and -50 → +100 "skipped"). A
+            # percent threshold is meaningless against a ≤0 baseline —
+            # surface the pair for a human instead of auto-judging.
+            verdicts.append({
+                "metric": metric, "base": b, "new": n,
+                "direction": direction, "delta_pct": None,
+                "verdict": "skipped",
+            })
+            continue
         verdicts.append(
-            _verdict(
-                metric, base.get(metric), new.get(metric),
-                threshold_pct, direction,
-            )
+            _verdict(metric, b, n, threshold_pct, direction)
         )
 
     # memory: live peak + per-program compiled footprints
@@ -459,6 +501,41 @@ def compare_runs(
                     threshold_pct, "time",
                 )
             )
+
+    # solver-precision counters (ISSUE 8) — only when at least one run
+    # carried the ladder. `fallbacks` is judged as a strict counter: ANY
+    # rise is a failed audit, which no noise threshold excuses; cosine
+    # floors are config-enforced on-device, so cosine_min is reported
+    # (delta row) rather than thresholded here.
+    b_sp = base.get("solver_precision") or {}
+    n_sp = new.get("solver_precision") or {}
+    if b_sp or n_sp:
+        b_fb = b_sp.get("fallbacks") or 0
+        n_fb = n_sp.get("fallbacks") or 0
+        verdicts.append({
+            "metric": "solve/fallbacks",
+            "base": b_fb,
+            "new": n_fb,
+            "direction": "count",
+            "delta_pct": None,
+            "verdict": "regressed" if n_fb > b_fb else "ok",
+        })
+        verdicts.append(
+            _verdict(
+                "solve/cosine_min",
+                b_sp.get("solve_cosine_min"),
+                n_sp.get("solve_cosine_min"),
+                threshold_pct, "rate",
+            )
+        )
+        verdicts.append(
+            _verdict(
+                "solve/cg_budget_final",
+                b_sp.get("cg_budget_final"),
+                n_sp.get("cg_budget_final"),
+                threshold_pct, "time",
+            )
+        )
 
     b_prog = b_mem.get("programs") or {}
     n_prog = n_mem.get("programs") or {}
@@ -596,6 +673,17 @@ def render_summary(summary: dict) -> str:
                 ],
                 ["padded", "batches", "requests", "p50_ms", "p99_ms"],
             ))
+    sp = summary.get("solver_precision") or {}
+    if sp:
+        out.append("")
+        out.append(
+            f"solver precision: audits={sp.get('audit_runs')}"
+            f" fallbacks={sp.get('fallbacks')}"
+            f" cosine_min={_fmt(sp.get('solve_cosine_min'), 5)}"
+            f" cosine_mean={_fmt(sp.get('solve_cosine_mean'), 5)}"
+            f" cg_budget={sp.get('cg_budget_final')}"
+            + ("  PINNED-AT-F32" if sp.get("pinned") else "")
+        )
     fleet = summary.get("fleet") or {}
     if fleet:
         out.append("")

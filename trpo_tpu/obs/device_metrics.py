@@ -47,8 +47,11 @@ def accumulate_update(
     metrics: DeviceMetrics, trpo_stats, cg_iter_cap: int
 ) -> DeviceMetrics:
     """Fold one TRPO update's ``TRPOStats`` into the counters (traced into
-    the update program — ``cg_iter_cap`` is the static ``cfg.cg_iters``
-    budget, so "early exit" means the residual rule fired first)."""
+    the update program — ``cg_iter_cap`` is the iteration cap the solve
+    actually ran under: the static ``cfg.cg_iters``, or the traced
+    ``stats.cg_budget`` when the solver precision ladder's adaptive
+    budget shrank it — so "early exit" always means the residual rule
+    fired before the cap, never that the cap itself was small)."""
     i32 = lambda x: jnp.asarray(x, jnp.int32)
     return DeviceMetrics(
         cg_iters_total=metrics.cg_iters_total

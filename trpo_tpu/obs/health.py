@@ -60,6 +60,8 @@ class HealthMonitor:
         self._streak_reported = False
         self._ev_reported = False
         self._drain_reported = False
+        self._prev_fallbacks: Optional[int] = None  # solve-ladder counter
+        self._pinned_reported = False
         self._mem_samples: list = []   # live-bytes window (leak rule)
         self._mem_seen = 0
         self._leak_reported = False
@@ -110,6 +112,45 @@ class HealthMonitor:
         else:
             self._rollback_streak = 0
             self._streak_reported = False
+        # solver precision ladder (ISSUE 8): every rise of the
+        # run-cumulative fallback counter is one audit that failed its
+        # cosine floor — emitted per rise (fallbacks are at most one per
+        # solve_audit_every updates, never a flood), and
+        # validate_events.py REQUIRES the pairing, so the emission here
+        # is part of the event-log contract, not just advice
+        fb = stats.get("fallbacks")
+        if fb is not None:
+            # baseline 0, not None: the run-cumulative counter starts at
+            # 0 by construction (trpo.init_ladder), so a fallback on the
+            # VERY FIRST update (the audit always fires at step 0) must
+            # report too. A resumed run's first row re-reports the
+            # pre-resume total once — informative, and it keeps the
+            # validator's pairing rule satisfiable on resumed logs.
+            prev = (
+                0 if self._prev_fallbacks is None else self._prev_fallbacks
+            )
+            if fb > prev:
+                out.append(self._emit(
+                    "solve_fallback", "warn",
+                    "solve audit cosine fell below the floor — the "
+                    "update used the f32/full-batch solution "
+                    f"(fallbacks total {fb})",
+                    iteration,
+                    fallbacks=fb,
+                    solve_cosine=stats.get("solve_cosine"),
+                ))
+            self._prev_fallbacks = fb
+        if stats.get("solve_pinned") and not self._pinned_reported:
+            self._pinned_reported = True
+            out.append(self._emit(
+                "solve_pinned", "error",
+                "persistent solve-audit failures — the precision ladder "
+                "is pinned at the f32/full-batch solve for the rest of "
+                "the run (check fvp_dtype/fvp_subsample against this "
+                "problem's conditioning)",
+                iteration,
+                fallbacks=stats.get("fallbacks"),
+            ))
         ev = stats.get("vf_explained_variance")
         if (
             ev is not None

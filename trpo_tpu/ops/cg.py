@@ -23,7 +23,17 @@ Humanoid run's late-training residual grew 2000× at fixed iterations):
 
 The solve is always fp32 regardless of the forward-pass compute dtype —
 Fisher conditioning at Humanoid-scale batches does not survive bf16
-accumulation (SURVEY §7 "hard parts").
+accumulation (SURVEY §7 "hard parts"). This is the solver precision
+ladder's dtype contract (``cfg.fvp_dtype``, ISSUE 8): the FVP *matvec*
+may run its matmuls in bf16, but every quantity THIS module owns — the
+iterates ``x``/``r``/``p``, both dot products, and the residual test —
+is f32: ``tree_f32`` casts ``b`` and every ``f_Ax`` result on entry, so
+a bf16 operator contributes rounded *values*, never reduced-precision
+*accumulation*.
+
+``cg_iters`` may be a traced int32 scalar (the ladder's adaptive
+iteration budget, ``cfg.cg_budget_adaptive``): the ``while_loop`` bound
+is data-dependent already, so a carried budget costs nothing.
 """
 
 from __future__ import annotations

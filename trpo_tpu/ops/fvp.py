@@ -14,6 +14,13 @@ into the operator, not bolted on by the host.
 itself with the first argument's dependence on θ severed (the reference's
 ``stop_gradient`` at ``trpo_inksci.py:56``). Its Hessian at θ is exactly the
 Fisher information matrix, so no explicit "old" distribution is needed.
+
+Precision: the operators here are dtype-agnostic — the matvec's matmul
+dtype is whatever the ``apply_fn``/``kl_fn`` closure computes in (the
+solver precision ladder's ``cfg.fvp_dtype="bf16"`` passes a
+``Policy.apply_cast`` closure), while every operator OUTPUT is cast f32
+and damping is added in f32, so ``ops/cg.py``'s all-f32 accumulator
+contract holds under any matvec dtype.
 """
 
 from __future__ import annotations

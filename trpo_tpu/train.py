@@ -110,6 +110,49 @@ def build_parser() -> argparse.ArgumentParser:
         "every k-th sample; gradient/line search stay full-batch",
     )
     p.add_argument(
+        "--fvp-dtype",
+        choices=("f32", "bf16"),
+        help="solver precision ladder: run the Fisher-vector matvec's "
+        "matmuls in this dtype (CG accumulators stay f32 either way); "
+        "bf16 requires --solve-audit-every >= 1 — the on-device cosine "
+        "audit is what makes the cheap solve safe",
+    )
+    p.add_argument(
+        "--solve-audit-every",
+        type=int,
+        help="every k-th update, re-solve at full precision / full batch "
+        "under a lax.cond and gate the cheap (bf16/subsampled) solution "
+        "on the solution cosine: below --solve-cosine-floor the update "
+        "falls back to the full solve (health:solve_fallback), and "
+        "persistent failures pin the ladder at f32 "
+        "(health:solve_pinned). 0 = off",
+    )
+    p.add_argument(
+        "--solve-cosine-floor",
+        type=float,
+        help="minimum audit cosine before a fallback fires (default "
+        "0.999 — calibrated at the flagship 50k batch; small smoke "
+        "batches need a looser floor, the subsample noise scales as "
+        "1/sqrt(curvature batch))",
+    )
+    p.add_argument(
+        "--cg-budget-adaptive",
+        action="store_true",
+        help="adapt the CG iteration cap toward the residual rule's "
+        "observed early-exit point (floor/ceiling via "
+        "--cg-budget-floor/--cg-budget-ceiling); needs "
+        "--cg-residual-rtol or a positive residual tol",
+    )
+    p.add_argument("--cg-budget-floor", type=_positive_int)
+    p.add_argument("--cg-budget-ceiling", type=_positive_int)
+    p.add_argument(
+        "--solve-fault-skew",
+        type=float,
+        help="chaos/testing: skew the cheap FVP operator by this factor "
+        "(symmetric alternating diagonal) so it solves a wrong system — "
+        "drives the audit→fallback→pin escalation end to end",
+    )
+    p.add_argument(
         "--fvp-mode",
         choices=("auto", "fused", "ggn", "jvp_grad"),
         help="Fisher-vector-product factorization: auto (default — the "
@@ -351,6 +394,13 @@ _OVERRIDES = {
     "reward_target": "reward_target",
     "fuse_iterations": "fuse_iterations",
     "fvp_subsample": "fvp_subsample",
+    "fvp_dtype": "fvp_dtype",
+    "solve_audit_every": "solve_audit_every",
+    "solve_cosine_floor": "solve_cosine_floor",
+    "cg_budget_adaptive": "cg_budget_adaptive",
+    "cg_budget_floor": "cg_budget_floor",
+    "cg_budget_ceiling": "cg_budget_ceiling",
+    "solve_fault_skew": "solve_fault_skew",
     "fvp_mode": "fvp_mode",
     "policy_gru": "policy_gru",
     "policy_cell": "policy_cell",

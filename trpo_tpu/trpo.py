@@ -58,11 +58,16 @@ from trpo_tpu.ops.treemath import (
     tree_sub,
     tree_vdot,
     tree_where,
+    tree_zeros_like,
 )
 
 __all__ = [
+    "LadderState",
     "TRPOBatch",
     "TRPOStats",
+    "init_ladder",
+    "ladder_enabled",
+    "ladder_stateful",
     "make_trpo_update",
     "make_tree_trpo_update",
     "surrogate_and_dist",
@@ -115,6 +120,71 @@ class TRPOStats(NamedTuple):
     nan_guard: Any = False   # bool: nonfinite gradient/surrogate/entropy
     #   detected this update — computed from scalars already paid for,
     #   so watching for divergence costs nothing
+    # --- solver precision ladder (ISSUE 8) — populated only when a
+    #     LadderState is threaded through the update; static defaults
+    #     otherwise (plain-float/bool: see the class comment above) ---
+    solve_cosine: Any = float("nan")  # f32: audit cosine between the
+    #   cheap (bf16/subsampled) and full-precision solutions; NaN on
+    #   updates the audit did not run
+    solve_audited: Any = False  # bool: the full-precision re-solve ran
+    solve_fallback: Any = False  # bool: audit cosine < floor — this
+    #   update used the full-precision solution instead
+    solve_pinned: Any = False   # bool: the ladder is pinned at f32
+    #   (solve_fallback_limit consecutive failed audits)
+    cg_budget: Any = 0       # int32: the CG iteration cap this update
+    #   solved under (cfg.cg_iters unless cg_budget_adaptive)
+    ladder_next: Any = None  # trpo.LadderState for the NEXT update when
+    #   a ladder state was passed in, else None. The agent moves it into
+    #   TrainState and strips it from the logged stats (the precond_next
+    #   pattern).
+
+
+class LadderState(NamedTuple):
+    """Solver-precision-ladder state carried in ``TrainState.ladder``
+    (ISSUE 8): the audit cadence phase, the escalation machine, the
+    adaptive CG budget, and the run-cumulative audit counters — all
+    device scalars, donated and drained exactly like
+    ``obs/device_metrics.DeviceMetrics`` (zero extra host syncs)."""
+
+    step: jax.Array        # i32: updates performed (audit cadence phase)
+    cg_budget: jax.Array   # i32: current adaptive CG iteration cap
+    fail_streak: jax.Array  # i32: consecutive failed audits
+    pinned: jax.Array      # bool: escalated — f32/full-batch from now on
+    cosine_min: jax.Array  # f32: worst audit cosine observed (init 1.0)
+    audit_runs: jax.Array  # i32: full-precision re-solves executed
+    fallbacks: jax.Array   # i32: per-step fallbacks taken
+
+
+def ladder_enabled(cfg: TRPOConfig) -> bool:
+    """True when a cheap-solve rung is on (bf16 matvec and/or curvature
+    subsampling) — i.e. there is something for the audit to check."""
+    return cfg.fvp_dtype == "bf16" or (
+        cfg.fvp_subsample is not None and cfg.fvp_subsample < 1.0
+    )
+
+
+def ladder_stateful(cfg: TRPOConfig) -> bool:
+    """True when the update needs a ``LadderState`` threaded through it:
+    the audit/fallback machine (cheap rung + audit cadence) or the
+    adaptive CG budget. Callers that do not thread one (bench, direct
+    API use) get the bare cheap path — measured, never audited."""
+    return (
+        ladder_enabled(cfg) and cfg.solve_audit_every > 0
+    ) or cfg.cg_budget_adaptive
+
+
+def init_ladder(cfg: TRPOConfig) -> LadderState:
+    i32 = lambda v: jnp.asarray(v, jnp.int32)
+    ceiling = cfg.resolved_cg_budget_ceiling()
+    return LadderState(
+        step=i32(0),
+        cg_budget=i32(ceiling if cfg.cg_budget_adaptive else cfg.cg_iters),
+        fail_streak=i32(0),
+        pinned=jnp.asarray(False),
+        cosine_min=jnp.float32(1.0),
+        audit_runs=i32(0),
+        fallbacks=i32(0),
+    )
 
 
 def _wmean(x: jax.Array, w: jax.Array) -> jax.Array:
@@ -151,40 +221,69 @@ def surrogate_loss(policy: Policy, params, batch: TRPOBatch) -> jax.Array:
     return surrogate_and_dist(policy, params, batch)[0]
 
 
+def _fvp_keep_indices(n: int, fraction: float):
+    """Host-computed (static under jit) sample indices realizing
+    ``fraction`` of ``n``: ``fraction ≤ ½`` keeps every ``ceil(1/f)``-th
+    sample (the classic stride); ``fraction > ½`` DROPS every
+    ``floor(1/(1-f))``-th sample instead, so the rungs between half- and
+    full-batch (¾, ⅚, …) exist — the r07 solve-precision harvest needed
+    exactly the ¾ rung to hold the 0.999 cosine floor at the flagship
+    shape. A valid fraction < 1 always subsamples — never a silent
+    full-batch no-op; sole exception: n == 1, where one sample must
+    survive — with the effective fraction ≤ the request up to one
+    sample of rounding on sizes the drop interval does not divide. The
+    indices are a numpy constant: static shapes, a single gather.
+    """
+    import numpy as np
+
+    if fraction <= 0.5:
+        stride = max(int(math.ceil(1.0 / fraction)), 2)
+        return np.arange(0, n, stride)
+    k = max(int(math.floor(1.0 / (1.0 - fraction))), 2)
+    idx = np.arange(n)
+    keep = idx[(idx % k) != (k - 1)]
+    if len(keep) == n and n > 1:
+        # n < k: no index hits the drop pattern — drop the last sample
+        # instead, upholding the invariant above (e.g. fraction 0.9 on
+        # an 8-env recurrent batch must not silently run full-batch);
+        # n == 1 keeps its single sample (an empty curvature batch
+        # would make the FVP a 0/0 NaN operator)
+        keep = idx[:-1]
+    return keep
+
+
 def _fvp_batch(batch: TRPOBatch, fraction) -> TRPOBatch:
-    """Strided subsample of the batch for Fisher-vector products.
+    """Deterministic subsample of the batch for Fisher-vector products.
 
     The classic TRPO throughput lever: the curvature estimate tolerates far
     more sampling noise than the gradient, so the FVP — evaluated
-    ``cg_iters``+1 times per update, the dominant cost — can run on every
-    k-th sample while gradient/line-search/rollback stay full-batch.
-    Static stride → static shapes under jit. Feedforward batches stride the
-    flat axis; recurrent ones stride the ENV axis (striding time would
-    break the GRU replay).
+    ``cg_iters``+1 times per update, the dominant cost — can run on a
+    fixed sample pattern (see :func:`_fvp_keep_indices`) while
+    gradient/line-search/rollback stay full-batch. Static indices →
+    static shapes under jit. Feedforward batches thin the flat axis;
+    recurrent ones thin the ENV axis (striding time would break the GRU
+    replay). Range validation lives in ``TRPOConfig.__post_init__`` with
+    the other config invariants — by the time a fraction reaches the
+    solve it is known to be in (0, 1].
     """
-    if fraction is None:
+    if fraction is None or fraction == 1.0:
         return batch
-    if not 0.0 < fraction <= 1.0:
-        raise ValueError(f"fvp_subsample must be in (0, 1], got {fraction}")
-    if fraction == 1.0:
-        return batch
-    # ceil: a valid fraction < 1 always subsamples (effective fraction
-    # 1/stride ≤ requested — never a silent full-batch no-op).
-    stride = max(int(math.ceil(1.0 / fraction)), 2)
     from trpo_tpu.models.recurrent import SeqObs
 
     if isinstance(batch.obs, SeqObs):
-        # stride the ENV axis; SeqObs.h0 is (N, H), the rest (T, N, ...)
-        sub = lambda x: x[:, ::stride]
+        # thin the ENV axis; SeqObs.h0 is (N, H), the rest (T, N, ...)
+        keep = _fvp_keep_indices(batch.obs.reset.shape[1], fraction)
+        sub = lambda x: x[:, keep]
         obs = SeqObs(
             obs=sub(batch.obs.obs),
             reset=sub(batch.obs.reset),
-            h0=batch.obs.h0[::stride],
+            h0=batch.obs.h0[keep],
         )
         return jax.tree_util.tree_map(sub, batch._replace(obs=None))._replace(
             obs=obs
         )
-    return jax.tree_util.tree_map(lambda x: x[::stride], batch)
+    keep = _fvp_keep_indices(batch.weight.shape[0], fraction)
+    return jax.tree_util.tree_map(lambda x: x[keep], batch)
 
 
 def _next_damping(cfg: TRPOConfig, damping, ls_success, rollback):
@@ -202,7 +301,8 @@ def _next_damping(cfg: TRPOConfig, damping, ls_success, rollback):
     return jnp.clip(damping * factor, cfg.damping_min, cfg.damping_max)
 
 
-def _maybe_fused_fvp(policy, cfg, to_params, x0, fb: TRPOBatch, damping):
+def _maybe_fused_fvp(policy, cfg, to_params, x0, fb: TRPOBatch, damping,
+                     dtype=None):
     """The fused single-Pallas-kernel GGN operator (``ops/fused_fvp.py``)
     when the architecture qualifies, else None.
 
@@ -266,10 +366,14 @@ def _maybe_fused_fvp(policy, cfg, to_params, x0, fb: TRPOBatch, damping):
     # Compile-probe the kernel at selection time (cached per shape): a
     # Mosaic failure or real VMEM OOM falls back here instead of crashing
     # the training step when the enclosing jit compiles (ADVICE r5).
+    # cfg.fvp_dtype="bf16" overrides the policy's own compute dtype for
+    # the kernel's matmuls (the ladder's bf16 rung — the kernel output
+    # and the damping add stay f32 either way)
+    kernel_dtype = spec["compute_dtype"] if dtype is None else dtype
     probe_fail = probe_compile_fused_fvp(
         params0["net"], fb.obs, fb.weight, params0["log_std"],
         activation=spec["activation"],
-        compute_dtype=spec["compute_dtype"],
+        compute_dtype=kernel_dtype,
     )
     if probe_fail is not None:
         return bail(f"kernel failed to compile on this backend: {probe_fail}")
@@ -281,7 +385,7 @@ def _maybe_fused_fvp(policy, cfg, to_params, x0, fb: TRPOBatch, damping):
             params0["log_std"],
             damping,
             activation=spec["activation"],
-            compute_dtype=spec["compute_dtype"],
+            compute_dtype=kernel_dtype,
         )
     except ValueError:  # VMEM cost model rejected the shape
         if explicit:
@@ -296,10 +400,28 @@ def _maybe_fused_fvp(policy, cfg, to_params, x0, fb: TRPOBatch, damping):
     return fvp
 
 
+def _skewed_operator(op, skew: float):
+    """Chaos lever (``cfg.solve_fault_skew``): wrap ``v ↦ (F+λI)v`` as
+    ``v ↦ D·op(D·v)`` with ``D`` a fixed alternating positive diagonal
+    (1 on even coordinates, 1+skew on odd). The wrapped operator stays
+    symmetric positive definite — CG converges cleanly — but to a
+    genuinely WRONG system, so the audit's full-precision re-solve sees
+    a low solution cosine. Test/fault-injection only."""
+
+    def scale(v):
+        def leaf(t):
+            idx = jnp.arange(t.size, dtype=jnp.float32).reshape(t.shape)
+            return t * (1.0 + jnp.float32(skew) * (idx % 2.0))
+
+        return jax.tree_util.tree_map(leaf, v)
+
+    return lambda v: scale(op(scale(v)))
+
+
 def _natural_gradient_update(
     policy: Policy, cfg: TRPOConfig, to_params: Callable[[Any], Any],
     x0: Any, batch: TRPOBatch, damping=None, allow_fused: bool = True,
-    precond=None,
+    precond=None, ladder=None,
 ) -> Tuple[Any, TRPOStats]:
     """The fused solve, generic over the parameter REPRESENTATION.
 
@@ -315,6 +437,20 @@ def _natural_gradient_update(
     preconditioner to the amortized path: the Gram/eigh factors refresh
     only when ``age % cfg.precond_refresh_every == 0`` and ride back out
     via ``stats.precond_next``.
+
+    ``ladder`` (a :class:`LadderState`, ISSUE 8) arms the solver
+    precision ladder's stateful machinery: every
+    ``cfg.solve_audit_every`` updates the same system re-solves at full
+    precision / full batch under a ``lax.cond`` and the solution cosine
+    gates the cheap (``cfg.fvp_dtype="bf16"`` matvec and/or
+    ``cfg.fvp_subsample``) solution — below ``cfg.solve_cosine_floor``
+    the update uses the full-precision solution instead, and
+    ``cfg.solve_fallback_limit`` consecutive failures pin the ladder at
+    f32 for the rest of the run. With ``cfg.cg_budget_adaptive`` the CG
+    iteration cap carried in the ladder shrinks toward the residual
+    rule's observed early-exit point (never past the config
+    floor/ceiling). ``ladder=None`` (bench, direct API use) runs the
+    bare cheap path with the static budget — no audit is ever traced.
 
     The post-solve TAIL is fused (round 6 — it had grown to ~25% of the
     update): ``surrogate_before`` folds into the gradient's
@@ -363,36 +499,72 @@ def _natural_gradient_update(
             '"ggn". An explicit "fused" must never silently time the '
             "wrong operator."
         )
-    fvp = None
-    if allow_fused:
-        # single-Pallas-kernel GGN operator when architecture + backend
-        # qualify (see _maybe_fused_fvp; ~1.3× the XLA GGN chain on the
-        # v5e at the flagship shape)
-        fvp = _maybe_fused_fvp(policy, cfg, to_params, x0, fb, damping)
-    if fvp is not None:
-        pass  # fused operator selected above
-    elif cfg.fvp_mode in ("auto", "fused", "ggn") and hasattr(
-        policy.dist, "fisher_weight"
-    ):
-        # Gauss-Newton factorization (ops/fvp.make_ggn_fvp): same Fisher,
-        # ~1.9× per CG iteration at the Humanoid shape on the v5e
-        fvp = make_ggn_fvp(
-            lambda x: policy.apply(to_params(x), fb.obs),
-            policy.dist.fisher_weight,
-            x0,
-            fb.weight,
-            damping=damping,
-        )
-    else:
-        cur_dist = jax.lax.stop_gradient(
-            policy.apply(to_params(x0), fb.obs)
-        )
+    def _build_fvp(b: TRPOBatch, dtype, fused_ok: bool, skew: float):
+        """``v ↦ (F + λI)v`` over batch ``b`` with forward/tangent
+        matmuls in ``dtype`` (None = the policy's own compute dtype —
+        the pre-ladder op sequence, bit-exact). The cheap operator is
+        built once here at ``(fb, cfg.fvp_dtype)``; the audit branch
+        rebuilds at ``(batch, None)`` INSIDE its ``lax.cond`` so the
+        full-batch linearization primal only executes on audit steps."""
+        if dtype is None:
+            apply_b = lambda x: policy.apply(to_params(x), b.obs)
+        else:
+            if getattr(policy, "apply_cast", None) is None:
+                raise ValueError(
+                    'fvp_dtype="bf16" needs a policy with a dtype-'
+                    "castable forward (plain-MLP/conv policies from "
+                    "models.make_policy expose apply_cast; recurrent/"
+                    'MoE do not) — use fvp_dtype="f32" here'
+                )
+            apply_b = lambda x: policy.apply_cast(
+                to_params(x), b.obs, dtype
+            )
+        op = None
+        if fused_ok:
+            # single-Pallas-kernel GGN operator when architecture +
+            # backend qualify (see _maybe_fused_fvp; ~1.3× the XLA GGN
+            # chain on the v5e at the flagship shape)
+            op = _maybe_fused_fvp(
+                policy, cfg, to_params, x0, b, damping, dtype
+            )
+        if op is not None:
+            pass  # fused operator selected above
+        elif cfg.fvp_mode in ("auto", "fused", "ggn") and hasattr(
+            policy.dist, "fisher_weight"
+        ):
+            # Gauss-Newton factorization (ops/fvp.make_ggn_fvp): same
+            # Fisher, ~1.9× per CG iteration at the Humanoid shape
+            op = make_ggn_fvp(
+                apply_b,
+                policy.dist.fisher_weight,
+                x0,
+                b.weight,
+                damping=damping,
+            )
+        else:
+            # the stop-grad anchor stays at the policy's native dtype:
+            # only the differentiated matvec sweep runs reduced
+            cur_dist = jax.lax.stop_gradient(
+                policy.apply(to_params(x0), b.obs)
+            )
 
-        def kl_fixed_fn(x):
-            dist_params = policy.apply(to_params(x), fb.obs)
-            return _wmean(policy.dist.kl(cur_dist, dist_params), fb.weight)
+            def kl_fixed_fn(x):
+                dist_params = apply_b(x)
+                return _wmean(
+                    policy.dist.kl(cur_dist, dist_params), b.weight
+                )
 
-        fvp = make_tree_fvp(kl_fixed_fn, x0, damping=damping)
+            op = make_tree_fvp(kl_fixed_fn, x0, damping=damping)
+        if skew:
+            op = _skewed_operator(op, skew)
+        return op
+
+    fvp = _build_fvp(
+        fb,
+        jnp.bfloat16 if cfg.fvp_dtype == "bf16" else None,
+        allow_fused,
+        cfg.solve_fault_skew,
+    )
     M_inv = None
     precond_next = None
     if cfg.cg_precondition == "head_block":
@@ -478,19 +650,152 @@ def _natural_gradient_update(
             key=jax.random.key(0),
             floor=damping,
         )
-    with jax.named_scope("trpo/cg_solve"):
+    audit_on = (
+        ladder is not None
+        and cfg.solve_audit_every > 0
+        and ladder_enabled(cfg)
+    )
+    budget_on = ladder is not None and cfg.cg_budget_adaptive
+    ceiling = int(cfg.resolved_cg_budget_ceiling())
+
+    def _solve(op, iters):
+        """One CG solve + the step-scale FVP (``shs = ½ sᵀ(F+λI)s``) on
+        the operator that produced it — the pre-ladder op sequence."""
         cg = conjugate_gradient(
-            fvp,
+            op,
             neg_g,
-            cg_iters=cfg.cg_iters,
+            cg_iters=iters,
             residual_tol=cfg.cg_residual_tol,
             M_inv=M_inv,
             residual_rtol=cfg.cg_residual_rtol,
         )
-        stepdir = cg.x
+        shs = 0.5 * tree_vdot(cg.x, op(cg.x))
+        return cg.x, shs, cg.iterations, cg.residual_norm_sq
+
+    with jax.named_scope("trpo/cg_solve"):
+        ladder_next = None
+        if not (audit_on or budget_on):
+            # plain path — identical op-for-op to the pre-ladder rounds
+            # (the default-config bit-exactness contract, test-pinned)
+            stepdir, shs, cg_iterations, cg_residual = _solve(
+                fvp, cfg.cg_iters
+            )
+            solve_cosine = jnp.float32(jnp.nan)
+            audited = fallback = pinned = jnp.asarray(False)
+            budget_used = jnp.asarray(cfg.cg_iters, jnp.int32)
+        else:
+            budget = (
+                jnp.clip(ladder.cg_budget, cfg.cg_budget_floor, ceiling)
+                if budget_on
+                else cfg.cg_iters
+            )
+            budget_used = jnp.asarray(budget, jnp.int32)
+            if audit_on:
+                pinned = ladder.pinned
+                do_audit = jnp.logical_and(
+                    jnp.logical_not(pinned),
+                    ladder.step % cfg.solve_audit_every == 0,
+                )
+
+                def _skip_cheap(_):
+                    return (
+                        tree_zeros_like(neg_g),
+                        jnp.float32(0.0),
+                        jnp.asarray(0, jnp.int32),
+                        jnp.float32(0.0),
+                    )
+
+                # pinned runs pay ONLY the full solve (the cheap branch
+                # is skipped, not discarded)
+                cheap = jax.lax.cond(
+                    pinned, _skip_cheap, lambda _: _solve(fvp, budget),
+                    None,
+                )
+                x_c, shs_c, it_c, res_c = cheap
+
+                def _full_solve(_):
+                    # full precision / full batch / clean operator, at
+                    # the static configured budget and the SAME M_inv —
+                    # built inside the branch so its linearization
+                    # primal only executes on audit/pinned steps
+                    return _solve(
+                        _build_fvp(batch, None, False, 0.0), cfg.cg_iters
+                    )
+
+                x_f, shs_f, it_f, res_f = jax.lax.cond(
+                    jnp.logical_or(pinned, do_audit),
+                    _full_solve,
+                    lambda _: cheap,
+                    None,
+                )
+
+                cos_raw = tree_vdot(x_c, x_f) / jnp.maximum(
+                    tree_norm(x_c) * tree_norm(x_f), 1e-30
+                )
+                audited = do_audit
+                solve_cosine = jnp.where(audited, cos_raw, jnp.nan)
+                fallback = jnp.logical_and(
+                    audited, cos_raw < cfg.solve_cosine_floor
+                )
+                use_full = jnp.logical_or(pinned, fallback)
+                stepdir = tree_where(use_full, x_f, x_c)
+                shs = jnp.where(use_full, shs_f, shs_c)
+                cg_iterations = jnp.where(use_full, it_f, it_c)
+                cg_residual = jnp.where(use_full, res_f, res_c)
+                # the cap of the solve that PRODUCED the used solution:
+                # the full solve runs at the static cfg.cg_iters, so the
+                # early-exit accounting (cg_iterations < cg_budget)
+                # stays truthful on fallback/pinned steps too
+                budget_used = jnp.where(
+                    use_full,
+                    jnp.asarray(cfg.cg_iters, jnp.int32),
+                    budget_used,
+                )
+            else:
+                # budget adaptation alone (no cheap rung to audit)
+                stepdir, shs, cg_iterations, cg_residual = _solve(
+                    fvp, budget
+                )
+                it_c = cg_iterations
+                solve_cosine = jnp.float32(jnp.nan)
+                audited = fallback = pinned = jnp.asarray(False)
+
+            if budget_on:
+                # shrink to the residual rule's observed exit (+1
+                # slack); grow +2 toward the ceiling when the solve ran
+                # to the cap unconverged — on pinned steps the budget
+                # holds (the cheap solve did not run)
+                early = it_c < budget_used
+                shrink = jnp.clip(it_c + 1, cfg.cg_budget_floor, ceiling)
+                grow = jnp.minimum(budget_used + 2, ceiling)
+                budget_next = jnp.where(
+                    pinned, budget_used, jnp.where(early, shrink, grow)
+                )
+            else:
+                budget_next = budget_used
+            streak_next = jnp.where(
+                fallback,
+                ladder.fail_streak + 1,
+                jnp.where(audited, 0, ladder.fail_streak),
+            )
+            ladder_next = LadderState(
+                step=ladder.step + 1,
+                cg_budget=jnp.asarray(budget_next, jnp.int32),
+                fail_streak=jnp.asarray(streak_next, jnp.int32),
+                pinned=jnp.logical_or(
+                    pinned, streak_next >= cfg.solve_fallback_limit
+                ),
+                cosine_min=jnp.minimum(
+                    ladder.cosine_min,
+                    jnp.where(audited, solve_cosine, 1.0),
+                ),
+                audit_runs=ladder.audit_runs
+                + jnp.asarray(audited, jnp.int32),
+                fallbacks=ladder.fallbacks
+                + jnp.asarray(fallback, jnp.int32),
+            )
 
         # Step scaling to the KL radius (ref trpo_inksci.py:148-150).
-        shs = 0.5 * tree_vdot(stepdir, fvp(stepdir))
         shs = jnp.maximum(shs, 1e-12)  # guard degenerate/zero-grad solves
         lm = jnp.sqrt(shs / cfg.max_kl)
         fullstep = tree_scale(1.0 / lm, stepdir)
@@ -566,8 +871,8 @@ def _natural_gradient_update(
         entropy=entropy,
         grad_norm=grad_norm,
         step_norm=tree_norm(tree_sub(x_new, x0)),
-        cg_iterations=cg.iterations,
-        cg_residual=cg.residual_norm_sq,
+        cg_iterations=cg_iterations,
+        cg_residual=cg_residual,
         linesearch_success=ls.success,
         step_fraction=ls.step_fraction,
         rolled_back=rollback,
@@ -576,6 +881,12 @@ def _natural_gradient_update(
         precond_next=precond_next,
         linesearch_trials=ls.trials,
         nan_guard=nan_guard,
+        solve_cosine=solve_cosine,
+        solve_audited=audited,
+        solve_fallback=fallback,
+        solve_pinned=pinned,
+        cg_budget=budget_used,
+        ladder_next=ladder_next,
     )
     return new_params, stats
 
@@ -596,12 +907,13 @@ def make_trpo_update(
     axis).
     """
 
-    def update(params, batch: TRPOBatch, damping=None, precond=None):
+    def update(params, batch: TRPOBatch, damping=None, precond=None,
+               ladder=None):
         flat0, unravel = flatten_params(params)
         flat0 = jnp.asarray(flat0, jnp.float32)
         return _natural_gradient_update(
             policy, cfg, unravel, flat0, batch, damping,
-            allow_fused=allow_fused, precond=precond,
+            allow_fused=allow_fused, precond=precond, ladder=ladder,
         )
 
     return update
@@ -625,12 +937,13 @@ def make_tree_trpo_update(
     contract (SURVEY §1) and bit-stable against ``compat``/bench baselines.
     """
 
-    def update(params, batch: TRPOBatch, damping=None, precond=None):
+    def update(params, batch: TRPOBatch, damping=None, precond=None,
+               ladder=None):
         # allow_fused=False: the pytree domain exists for tensor-sharded
         # leaves (GSPMD), which the Pallas kernel does not partition
         return _natural_gradient_update(
             policy, cfg, lambda p: p, tree_f32(params), batch, damping,
-            allow_fused=False, precond=precond,
+            allow_fused=False, precond=precond, ladder=ladder,
         )
 
     return update

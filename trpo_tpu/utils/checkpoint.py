@@ -262,17 +262,22 @@ class Checkpointer:
                 restored_tree,
             )
 
-        # Three TrainState fields can differ in presence between save and
+        # Four TrainState fields can differ in presence between save and
         # restore, changing the pytree structure: cg_damping (f32 scalar
         # iff cfg.adaptive_damping), precond (ops/precond.PrecondState iff
         # the amortized head-block preconditioner is on — default for the
         # MuJoCo presets since round 6, so pre-r06 checkpoints lack it),
-        # and metrics (obs/device_metrics.DeviceMetrics — added in round
-        # 7, so pre-r07 checkpoints lack it). Tolerate every presence
-        # combination: a dropped field's saved value is discarded, a
-        # gained field is seeded from the template below (precond factors
-        # and observability counters are both safely reconstructible —
-        # age 0 refreshes on the first update, counters restart at 0).
+        # metrics (obs/device_metrics.DeviceMetrics — added in round
+        # 7, so pre-r07 checkpoints lack it), and ladder
+        # (trpo.LadderState iff trpo.ladder_stateful(cfg) — default for
+        # the MuJoCo presets since round 8, so pre-r08 checkpoints lack
+        # it). Tolerate every presence combination: a dropped field's
+        # saved value is discarded, a gained field is seeded from the
+        # template below (precond factors, observability counters and the
+        # ladder's audit state are all safely reconstructible — age 0
+        # refreshes on the first update, counters restart at 0, the
+        # ladder re-warms its budget and audit cadence within a few
+        # updates).
         flippable = hasattr(template, "_replace") and hasattr(
             template, "cg_damping"
         )
@@ -312,6 +317,28 @@ class Checkpointer:
                 return None
             return t._replace(metrics=None)
 
+        def ladder_alt(t):
+            """Template with the solver-precision-ladder state presence
+            flipped: stripped when present (pre-round-8 checkpoint, or
+            the ladder turned off since the save), added as the 7-scalar
+            abstract LadderState when absent (checkpoint saved with the
+            ladder on, restored into a ladder-off config)."""
+            if not hasattr(t, "ladder"):
+                return None
+            if t.ladder is not None:
+                return t._replace(ladder=None)
+            from trpo_tpu.trpo import LadderState
+
+            f32 = jax.ShapeDtypeStruct((), "float32")
+            i32 = jax.ShapeDtypeStruct((), "int32")
+            return t._replace(
+                ladder=LadderState(
+                    step=i32, cg_budget=i32, fail_streak=i32,
+                    pinned=jax.ShapeDtypeStruct((), "bool"),
+                    cosine_min=f32, audit_runs=i32, fallbacks=i32,
+                )
+            )
+
         abstract = jax.tree_util.tree_map(as_abstract, template)
         try:
             restored = rewrap_keys(
@@ -334,6 +361,13 @@ class Checkpointer:
                 m_alt = metrics_alt(alt)
                 if m_alt is not None:
                     candidates.append(m_alt)
+            # ...and/or the ladder presence flipped (checkpoint predates
+            # TrainState.ladder, or the ladder was toggled since the
+            # save — the MuJoCo presets arm it by default from round 8)
+            for alt in [template] + list(candidates):
+                l_alt = ladder_alt(alt)
+                if l_alt is not None:
+                    candidates.append(l_alt)
             restored = None
             for alt in candidates:
                 abstract_alt = jax.tree_util.tree_map(as_abstract, alt)
@@ -422,6 +456,42 @@ class Checkpointer:
                     lambda s: jnp.zeros(s.shape, s.dtype), seed
                 )
             restored = restored._replace(metrics=seed)
+        if flippable and hasattr(template, "ladder"):
+            t_has = template.ladder is not None
+            r_has = getattr(restored, "ladder", None) is not None
+            if t_has and not r_has:
+                # checkpoint predates the ladder (or it was off): seed
+                # the template's fresh state (the normal init_state path
+                # carries concrete trpo.init_ladder values). Abstract
+                # templates materialize the init semantics — everything
+                # zero except cosine_min (worst-observed tracker, starts
+                # at 1.0); a zero cg_budget is clipped up to the config
+                # floor at the first solve.
+                seed = template.ladder
+                if any(
+                    not hasattr(leaf, "__array__")
+                    for leaf in jax.tree_util.tree_leaves(seed)
+                ):
+                    import jax.numpy as jnp
+
+                    seed = seed._replace(
+                        **{
+                            f: jnp.zeros(
+                                getattr(seed, f).shape,
+                                getattr(seed, f).dtype,
+                            )
+                            for f in seed._fields
+                            if f != "cosine_min"
+                        },
+                        cosine_min=jnp.ones(
+                            seed.cosine_min.shape, seed.cosine_min.dtype
+                        ),
+                    )
+                restored = restored._replace(ladder=seed)
+            elif r_has and not t_has:
+                # ladder turned off since the save: the audit state is
+                # meaningless without the machinery — drop it
+                restored = restored._replace(ladder=None)
         return restored
 
     # -- host-env sidecar --------------------------------------------------
