@@ -32,6 +32,10 @@ echo "== tier-1: fleet orchestrator (spec/scheduler/scrape/gate) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
     -m 'not slow'
 
+echo "== tier-1: replicated serving (replica set, router, sessions) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q \
+    -m 'not slow'
+
 echo "== event-stream smoke: train + bench emit schema-valid JSONL =="
 OBS_TMP=$(mktemp -d)
 JAX_PLATFORMS=cpu python -m trpo_tpu.train --preset cartpole \
@@ -166,6 +170,24 @@ python scripts/validate_events.py "$SERVE_TMP/base/serve_events.jsonl" \
     "$SERVE_TMP/new/serve_events.jsonl"
 python scripts/analyze_run.py "$SERVE_TMP/new/serve_events.jsonl" \
     --compare "$SERVE_TMP/base/serve_events.jsonl" --threshold-pct 500
+
+echo "== router chaos smoke: replica killed under load + scale gate =="
+# the ISSUE 9 acceptance scenario: (a) 4-replica closed-loop actions/s
+# must be >= 3x the single replica at equal-or-better p99 (simulated
+# 60 ms device cost — capacity-limited replicas, the regime where
+# replication pays; TPU rows are a ROADMAP follow-up); (b) a replica
+# killed under concurrent load must be evicted, the in-flight request
+# transparently retried (exactly once), the replica restarted after
+# backoff, with ZERO client-visible errors; (c) a recurrent policy is
+# served end-to-end through the session API with actions BIT-EXACT vs
+# direct act(), and a session on the killed replica re-establishes on
+# the survivor from a fresh carry. The event log must validate
+# (router died -> restarted/evicted resolution) and analyze (the
+# per-replica table + scaling row).
+ROUTER_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu python scripts/router_smoke.py --tmp "$ROUTER_TMP"
+python scripts/validate_events.py "$ROUTER_TMP/router_events.jsonl"
+python scripts/analyze_run.py "$ROUTER_TMP/router_events.jsonl"
 
 echo "== solver precision ladder smoke: bf16/subsampled solve vs f32 gate =="
 # ISSUE 8 acceptance: a cartpole run with the full ladder on (bf16 FVP,

@@ -1,0 +1,293 @@
+#!/usr/bin/env python
+"""Router chaos + scale smoke (``check.sh``): the ISSUE 9 acceptance.
+
+    python scripts/router_smoke.py --tmp DIR
+
+Four legs, end to end in one process:
+
+1. **Scale gate** — ``bench.serving_scale_bench`` at 1 and 4 replicas
+   (closed loop through the router, simulated 60 ms device cost —
+   capacity-limited replicas): 4-replica actions/s must be ≥ 3× the
+   single replica at an equal-or-better p99.
+2. **Chaos** — 3 feedforward replicas under concurrent ``POST /act``
+   load; one replica is killed mid-load. Every client request must
+   still answer 200 (the transparent retry), the dead replica must be
+   evicted immediately and restarted by the supervisor within its
+   backoff, and the set must end healthy×3.
+3. **Sessions under chaos** — 2 recurrent replicas; a session's
+   actions through the router must be BIT-EXACT vs driving
+   ``agent.act(..., policy_carry=...)`` by hand; killing the pinned
+   replica must re-establish the session on the survivor from a fresh
+   carry (``reestablished: true``) with zero client-visible errors.
+4. The whole run's ``router``/``session`` event log is left at
+   ``DIR/router_events.jsonl`` for ``scripts/validate_events.py`` (the
+   died→restarted/evicted contract) and ``scripts/analyze_run.py``
+   (per-replica table + scaling row).
+
+Exit 0 on success; any assertion failure exits nonzero with the reason.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _post(url, payload=None, timeout=30.0):
+    data = b"" if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="router_smoke.py")
+    p.add_argument("--tmp", required=True, help="scratch directory")
+    p.add_argument(
+        "--skip-scale", action="store_true",
+        help="skip the 1-vs-4-replica scale gate (debugging)",
+    )
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.obs.events import EventBus, JsonlSink, manifest_fields
+    from trpo_tpu.serve import (
+        InProcessReplica,
+        MicroBatcher,
+        PolicyServer,
+        ReplicaSet,
+        Router,
+    )
+
+    os.makedirs(args.tmp, exist_ok=True)
+    events_path = os.path.join(args.tmp, "router_events.jsonl")
+    bus = EventBus(JsonlSink(events_path))
+    bus.emit(
+        "run_manifest",
+        **manifest_fields(None, extra={"driver": "router_smoke"}),
+    )
+
+    # -- 1. scale gate: 4 replicas >= 3x one, p99 equal-or-better --------
+    if not args.skip_scale:
+        import bench
+
+        scale = bench.serving_scale_bench(replica_counts=(1, 4))
+        rows = {r["replicas"]: r for r in scale["rows"]}
+        r1, r4 = rows[1], rows[4]
+        ratio = r4["actions_per_sec"] / r1["actions_per_sec"]
+        print(
+            f"scale gate: 1 replica {r1['actions_per_sec']} a/s "
+            f"(p99 {r1['p99_ms']} ms) -> 4 replicas "
+            f"{r4['actions_per_sec']} a/s (p99 {r4['p99_ms']} ms), "
+            f"{ratio:.2f}x, efficiency {r4['scaling_efficiency']}"
+        )
+        assert r1["errors"] == 0 and r4["errors"] == 0, (r1, r4)
+        assert ratio >= 3.0, (
+            f"4-replica throughput only {ratio:.2f}x the single replica "
+            "(bar: >= 3x)"
+        )
+        assert r4["p99_ms"] <= r1["p99_ms"], (
+            f"4-replica p99 {r4['p99_ms']} worse than single-replica "
+            f"{r1['p99_ms']}"
+        )
+        for row in scale["rows"]:
+            bus.emit(
+                "phase",
+                name=f"serving_scale/r{row['replicas']}_p99",
+                ms=row["p99_ms"],
+                actions_per_sec=row["actions_per_sec"],
+            )
+
+    # -- 2. chaos: kill one of 3 replicas under concurrent load ----------
+    cfg = TRPOConfig(
+        n_envs=4, batch_timesteps=32, policy_hidden=(8,), vf_hidden=(8,),
+        seed=5, serve_batch_shapes=(1, 2),
+    )
+    agent = TRPOAgent("cartpole", cfg)
+    state = agent.init_state(seed=0)
+
+    def ff_factory(rid):
+        def factory():
+            engine = agent.serve_engine()
+            engine.load(state.policy_params, state.obs_norm, step=1)
+            batcher = MicroBatcher(engine, deadline_ms=5.0)
+            server = PolicyServer(
+                engine, batcher, port=0, replica_name=rid,
+            )
+            return server, [batcher]
+
+        return factory
+
+    # health_interval long enough that the ROUTER (report_failure), not
+    # the poll, discovers the death — the retry path is what this leg
+    # exists to exercise; the supervisor still owns the restart
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(ff_factory(rid)), 3,
+        health_interval=1.0, backoff=0.2, health_fail_threshold=1,
+        max_restarts=3, bus=bus,
+    )
+    rs.start()
+    assert rs.wait_healthy(3, timeout=60.0), rs.snapshot()
+    router = Router(rs, port=0, bus=bus)
+    errors: list = []
+    try:
+        stop = threading.Event()
+
+        def client(seed: int) -> None:
+            r = np.random.RandomState(seed)
+            while not stop.is_set():
+                try:
+                    status, out = _post(
+                        router.url + "/act",
+                        {"obs": (r.randn(4) * 2).tolist()},
+                    )
+                    if status != 200 or "action" not in out:
+                        errors.append(f"bad response: {status} {out}")
+                except Exception as e:  # noqa: BLE001 — collected
+                    errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # load is flowing
+        rs.replicas["r1"].handle.kill()  # the chaos event
+        time.sleep(1.0)  # keep hammering through death + eviction
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+            assert not t.is_alive(), "client thread hung"
+
+        assert not errors, (
+            f"{len(errors)} client-visible errors: {errors[:5]}"
+        )
+        assert router.retried_total >= 1, (
+            "the kill was never observed mid-request — no retry "
+            "exercised (timing fluke: rerun)"
+        )
+        # the supervisor restarts it within the backoff
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            if rs.snapshot()["healthy"] == 3:
+                break
+            time.sleep(0.1)
+        snap = rs.snapshot()
+        assert snap["healthy"] == 3, snap
+        assert snap["replicas"]["r1"]["restarts"] == 1, snap
+        routed = router.routed_total
+        print(
+            f"chaos: {routed} requests routed across the kill, "
+            f"{router.retried_total} retried, 0 client-visible errors, "
+            "r1 evicted -> restarted -> healthy"
+        )
+    finally:
+        router.close()
+        rs.close()
+
+    # -- 3. sessions: bit-exact through the router, recover on death -----
+    rcfg = TRPOConfig(
+        n_envs=4, batch_timesteps=32, policy_hidden=(8,), vf_hidden=(8,),
+        seed=5, policy_gru=8,
+    )
+    ragent = TRPOAgent("pendulum", rcfg)
+    rstate = ragent.init_state(seed=0)
+
+    def rec_factory(rid):
+        def factory():
+            engine = ragent.serve_session_engine()
+            engine.load(rstate.policy_params, rstate.obs_norm, step=1)
+            server = PolicyServer(
+                engine, None, port=0, bus=bus, replica_name=rid,
+            )
+            return server, []
+
+        return factory
+
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(rec_factory(rid)), 2,
+        health_interval=1.0, backoff=0.2, health_fail_threshold=1,
+        bus=bus,
+    )
+    rs.start()
+    assert rs.wait_healthy(2, timeout=60.0), rs.snapshot()
+    router = Router(rs, port=0, bus=bus)
+    try:
+        # the structured refusal rides the same replicas: stateless /act
+        # against the recurrent set answers the typed 409
+        status, out = _post(
+            router.url + "/act",
+            {"obs": [0.0] * int(np.prod(ragent.obs_shape))},
+        )
+        assert status == 409 and out["endpoint"] == "/session", out
+
+        status, out = _post(router.url + "/session")
+        assert status == 200, out
+        sid, pinned = out["session"], out["replica"]
+
+        obs_seq = [
+            np.random.RandomState(i).randn(*ragent.obs_shape)
+            .astype(np.float32)
+            for i in range(5)
+        ]
+        carry = None
+        direct = []
+        for o in obs_seq:
+            a, _d, carry = ragent.act(
+                rstate, o, eval_mode=True, policy_carry=carry
+            )
+            direct.append(np.asarray(a, np.float64))
+        for t in range(3):
+            status, out = _post(
+                router.url + f"/session/{sid}/act",
+                {"obs": obs_seq[t].tolist()},
+            )
+            assert status == 200, out
+            assert np.array_equal(
+                np.asarray(out["action"], np.float64), direct[t]
+            ), f"session action diverged from direct act() at step {t}"
+
+        rs.replicas[pinned].handle.kill()
+        status, out = _post(
+            router.url + f"/session/{sid}/act",
+            {"obs": obs_seq[0].tolist()},
+        )
+        assert status == 200 and out.get("reestablished") is True, out
+        assert np.array_equal(
+            np.asarray(out["action"], np.float64), direct[0]
+        ), "re-established session is not a fresh carry"
+        print(
+            "sessions: 3 routed actions bit-exact vs direct act(), "
+            f"pinned replica {pinned} killed -> re-established on the "
+            "survivor with a fresh carry, zero client-visible errors"
+        )
+    finally:
+        router.close()
+        rs.close()
+        bus.close()
+
+    print(f"router smoke OK — events at {events_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,17 +4,32 @@
     python scripts/serve.py --checkpoint-dir /tmp/ck --port 0
     python scripts/serve.py --checkpoint-dir /tmp/ck --preset pendulum \\
         --port 8700 --deadline-ms 5 --metrics-jsonl serve_events.jsonl
+    python scripts/serve.py --checkpoint-dir /tmp/ck --replicas 4 \\
+        --port 8700               # 4 replicas behind one router
+    python scripts/serve.py --checkpoint-dir /tmp/ck --preset cartpole-po \\
+        --policy-gru 64           # recurrent: the session protocol
 
 Builds the SAME policy the checkpoint was trained with (``--preset`` +
 the same overrides ``trpo_tpu.train`` takes for the model: ``--env``,
-``--policy-hidden``, ``--normalize-obs``), AOT-compiles the eval-mode
-``act()`` at the ``--batch-shapes`` ladder, and serves:
+``--policy-hidden``, ``--policy-gru``, ``--normalize-obs``),
+AOT-compiles the eval-mode program, and serves:
 
 * ``POST /act``   — ``{"obs": [...]}`` → ``{"action": ..., "step": N}``
+  (feedforward; on a recurrent policy this answers a typed 409 naming
+  ``/session``)
+* ``POST /session`` + ``POST /session/<id>/act`` — the recurrent
+  session protocol: server-side carry in a bounded TTL store
 * ``GET /healthz`` — liveness + the checkpoint step currently served
 * ``GET /metrics`` — Prometheus ``trpo_serve_*`` gauges/counters
 
-A background watcher polls the checkpoint directory every
+``--replicas N`` (N > 1) runs N in-process replicas on ephemeral ports
+behind ONE routing front end on ``--port`` (``trpo_tpu/serve/router``):
+least-queue-depth dispatch, one transparent retry when a replica dies
+mid-request, health supervision with restart-with-backoff, aggregated
+``GET /status`` + ``/metrics`` (``trpo_router_*``), and session
+affinity for recurrent policies.
+
+A background watcher per replica polls the checkpoint directory every
 ``--poll-interval`` seconds and hot-swaps the params snapshot when a
 newer COMPLETE step appears (marker-gated — a save torn by ``kill -9``
 is never loaded), with zero dropped requests across the swap. With no
@@ -22,10 +37,15 @@ checkpoint yet, the server comes up answering 503 and starts serving
 the moment the first complete save lands.
 
 ``--metrics-jsonl`` appends the run-event stream (``run_manifest``,
-``status``, one ``serve`` record per dispatched micro-batch, ``health``
-records for each hot reload): validate it with
-``scripts/validate_events.py``, regression-gate two serving runs with
-``scripts/analyze_run.py NEW.jsonl --compare BASE.jsonl``.
+``status``, one ``serve`` record per dispatched micro-batch, ``router``
+/ ``session`` records from the control plane, ``health`` records for
+each hot reload): validate it with ``scripts/validate_events.py``,
+regression-gate two serving runs with ``scripts/analyze_run.py
+NEW.jsonl --compare BASE.jsonl``.
+
+``--run-descriptor PATH`` writes an atomic run.json (pid, bound port,
+url, endpoints) at startup — the PR 7 discovery pattern, so a replica
+supervisor (or any tooling) never parses stdout.
 """
 
 from __future__ import annotations
@@ -78,6 +98,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="K experts for the MoE torso (match training)",
     )
     p.add_argument(
+        "--policy-gru", type=int,
+        help="recurrent-cell hidden size (match training) — serves the "
+        "SESSION protocol instead of stateless /act",
+    )
+    p.add_argument(
+        "--policy-cell", choices=("gru", "lstm"),
+        help="recurrence type (match training; default gru)",
+    )
+    p.add_argument(
         "--vf-hidden",
         help="comma-separated critic sizes — the restore template carries "
         "the critic too, so this must match the training run",
@@ -128,7 +157,51 @@ def build_parser() -> argparse.ArgumentParser:
         help="serve for this many seconds then exit cleanly (smoke "
         "tests); default: until SIGTERM/SIGINT",
     )
+    p.add_argument(
+        "--replicas", type=int,
+        help="N serving replicas behind one router on --port (default "
+        "1 = bare single-engine front end); replicas bind ephemeral "
+        "ports and are supervised (restart-with-backoff, crash budget)",
+    )
+    p.add_argument(
+        "--health-interval", type=float,
+        help="replica supervisor /healthz poll seconds (default 0.5)",
+    )
+    p.add_argument(
+        "--replica-restarts", type=int,
+        help="per-replica crash budget before it is failed (default 3)",
+    )
+    p.add_argument(
+        "--max-inflight", type=int,
+        help="per-replica outstanding-request bound; all replicas at "
+        "the bound = 503 backpressure (default 64)",
+    )
+    p.add_argument(
+        "--session-ttl", type=float,
+        help="recurrent session idle TTL seconds (default 300)",
+    )
+    p.add_argument(
+        "--max-sessions", type=int,
+        help="bounded session store size per replica (default 1024)",
+    )
+    p.add_argument(
+        "--run-descriptor",
+        help="write an atomic run.json here at startup (pid, bound "
+        "port, url, endpoints) — tooling discovery without stdout "
+        "parsing (the PR 7 pattern)",
+    )
     return p
+
+
+def _write_descriptor(path: str, payload: dict) -> None:
+    """Atomic run.json (the PR 7 pattern): write-then-rename, so a
+    discovery poll never reads a partial file."""
+    import json
+
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2)
+    os.replace(tmp, path)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -141,7 +214,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from trpo_tpu.agent import TRPOAgent
     from trpo_tpu.config import get_preset
     from trpo_tpu.obs.events import EventBus, JsonlSink, manifest_fields
-    from trpo_tpu.serve import MicroBatcher, PolicyServer
+    from trpo_tpu.serve import (
+        InProcessReplica,
+        MicroBatcher,
+        PolicyServer,
+        ReplicaSet,
+        Router,
+    )
     from trpo_tpu.utils.checkpoint import Checkpointer
 
     cfg = get_preset(args.preset)
@@ -156,6 +235,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         updates["policy_activation"] = args.policy_activation
     if args.policy_experts is not None:
         updates["policy_experts"] = args.policy_experts
+    if args.policy_gru is not None:
+        updates["policy_gru"] = args.policy_gru
+    if args.policy_cell is not None:
+        updates["policy_cell"] = args.policy_cell
     if args.vf_hidden:
         updates["vf_hidden"] = tuple(
             int(s) for s in args.vf_hidden.split(",") if s.strip()
@@ -174,11 +257,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         updates["serve_poll_interval"] = args.poll_interval
     if args.no_adaptive_deadline:
         updates["serve_adaptive_deadline"] = False
+    if args.replicas is not None:
+        updates["serve_replicas"] = args.replicas
+    if args.health_interval is not None:
+        updates["serve_health_interval"] = args.health_interval
+    if args.replica_restarts is not None:
+        updates["serve_replica_restarts"] = args.replica_restarts
+    if args.max_inflight is not None:
+        updates["serve_max_inflight"] = args.max_inflight
+    if args.session_ttl is not None:
+        updates["serve_session_ttl"] = args.session_ttl
+    if args.max_sessions is not None:
+        updates["serve_max_sessions"] = args.max_sessions
     if updates:
         cfg = cfg.replace(**updates)
 
     agent = TRPOAgent(cfg.env, cfg)
-    engine = agent.serve_engine()
+    recurrent = agent.is_recurrent
 
     bus = None
     if args.metrics_jsonl:
@@ -190,42 +285,107 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 extra={
                     "driver": "serve",
                     "checkpoint_dir": os.path.abspath(args.checkpoint_dir),
+                    "replicas": cfg.serve_replicas,
+                    "recurrent": recurrent,
                 },
             ),
         )
 
-    checkpointer = Checkpointer(
-        args.checkpoint_dir, cg_damping_seed=cfg.cg_damping, bus=bus
-    )
-    batcher = MicroBatcher(
-        engine,
-        deadline_ms=cfg.serve_deadline_ms,
-        bus=bus,
-        adaptive_deadline=cfg.serve_adaptive_deadline,
-    )
-    server = PolicyServer(
-        engine,
-        batcher,
-        args.port,
-        host=args.host,
-        checkpointer=checkpointer,
-        template=agent.init_state(),
-        poll_interval=cfg.serve_poll_interval,
-        bus=bus,
-    )
+    def build_replica(replica_name: Optional[str], port: int):
+        """One complete serving stack: the right engine for the model
+        family (recurrent → session protocol; the structured 409s on
+        the wrong endpoint come from PolicyServer), its own checkpoint
+        watcher, its own port."""
+        checkpointer = Checkpointer(
+            args.checkpoint_dir, cg_damping_seed=cfg.cg_damping, bus=bus
+        )
+        if recurrent:
+            engine = agent.serve_session_engine()
+            batcher = None
+        else:
+            engine = agent.serve_engine()
+            batcher = MicroBatcher(
+                engine,
+                deadline_ms=cfg.serve_deadline_ms,
+                bus=bus,
+                adaptive_deadline=cfg.serve_adaptive_deadline,
+            )
+        server = PolicyServer(
+            engine,
+            batcher,
+            port,
+            host=args.host,
+            checkpointer=checkpointer,
+            template=agent.init_state(),
+            poll_interval=cfg.serve_poll_interval,
+            bus=bus,
+            session_ttl_s=cfg.serve_session_ttl,
+            max_sessions=cfg.serve_max_sessions,
+            replica_name=replica_name,
+        )
+        closers = ([batcher] if batcher is not None else []) + [
+            checkpointer
+        ]
+        return server, closers
+
+    replicaset = router = None
+    server = None
+    closers: list = []
+    if cfg.serve_replicas > 1:
+        replicaset = ReplicaSet(
+            lambda rid: InProcessReplica(
+                lambda: build_replica(rid, port=0)
+            ),
+            cfg.serve_replicas,
+            health_interval=cfg.serve_health_interval,
+            max_restarts=cfg.serve_replica_restarts,
+            bus=bus,
+        )
+        replicaset.start()
+        router = Router(
+            replicaset,
+            args.port,
+            host=args.host,
+            max_inflight=cfg.serve_max_inflight,
+            session_ttl_s=cfg.serve_session_ttl,
+            max_sessions=cfg.serve_max_sessions,
+            bus=bus,
+        )
+        front_url, endpoints = router.url, list(Router.ENDPOINTS)
+        front_port = router.port
+    else:
+        server, closers = build_replica(None, args.port)
+        front_url, endpoints = server.url, list(server.ENDPOINTS)
+        front_port = server.port
+
     if bus is not None:
         bus.emit(
-            "status",
-            port=server.port,
-            url=server.url,
-            endpoints=list(server.ENDPOINTS),
+            "status", port=front_port, url=front_url, endpoints=endpoints,
         )
-    step = engine.loaded_step
+    if args.run_descriptor:
+        _write_descriptor(
+            args.run_descriptor,
+            {
+                "schema": "trpo-tpu-serve-descriptor",
+                "pid": os.getpid(),
+                "port": front_port,
+                "url": front_url,
+                "endpoints": endpoints,
+                "replicas": cfg.serve_replicas,
+                "recurrent": recurrent,
+                "checkpoint_dir": os.path.abspath(args.checkpoint_dir),
+                "event_log": (
+                    os.path.abspath(args.metrics_jsonl)
+                    if args.metrics_jsonl else None
+                ),
+            },
+        )
+    proto = "/session" if recurrent else "/act"
     print(
-        f"serving {cfg.env} policy at {server.url} "
-        f"(POST /act, GET /healthz, GET /metrics) — "
-        + (f"checkpoint step {step}" if step is not None
-           else "no checkpoint yet (503 until one lands)"),
+        f"serving {cfg.env} policy at {front_url} "
+        f"(POST {proto}, GET /healthz, GET /metrics"
+        + (", GET /status" if router is not None else "")
+        + f") — {cfg.serve_replicas} replica(s)",
         flush=True,
     )
 
@@ -238,17 +398,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     try:
         done.wait(args.serve_seconds)
     finally:
-        server.close()
-        batcher.close()
+        if router is not None:
+            router.close()
+        if replicaset is not None:
+            replicaset.close()
+        if server is not None:
+            server.close()
+        for c in closers:
+            c.close()
         if bus is not None:
             bus.close()
-        checkpointer.close()
-    print(
-        f"served {batcher.requests_total} requests in "
-        f"{batcher.batches_total} batches "
-        f"({batcher.errors_total} errors, {server.reloads_total} reloads)",
-        flush=True,
-    )
+    if router is not None:
+        print(
+            f"routed {router.routed_total} requests "
+            f"({router.retried_total} retried, {router.failed_total} "
+            f"failed, {router.backpressure_total} backpressured)",
+            flush=True,
+        )
+    else:
+        served = (
+            server.session_acts_total if recurrent
+            else server.batcher.requests_total
+        )
+        print(f"served {served} requests", flush=True)
     return 0
 
 

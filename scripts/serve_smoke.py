@@ -206,6 +206,67 @@ def main(argv=None) -> int:
             batcher.close()
         except NameError:
             pass
+
+    # -- 5. 2-replica leg (ISSUE 9 satellite): the same checkpoint
+    # served by two supervised replicas behind one router — requests
+    # spread over BOTH replicas, zero errors, both serving step 3 --
+    from trpo_tpu.serve import (
+        InProcessReplica,
+        ReplicaSet,
+        Router,
+    )
+
+    def replica_factory(rid):
+        def factory():
+            r_engine = agent.serve_engine()
+            r_batcher = MicroBatcher(
+                r_engine, deadline_ms=cfg.serve_deadline_ms,
+                adaptive_deadline=cfg.serve_adaptive_deadline,
+            )
+            r_server = PolicyServer(
+                r_engine, r_batcher, port=0,
+                checkpointer=Checkpointer(ck_dir),
+                template=agent.init_state(),
+                poll_interval=cfg.serve_poll_interval,
+                replica_name=rid,
+            )
+            return r_server, [r_batcher]
+
+        return factory
+
+    replicaset = ReplicaSet(
+        lambda rid: InProcessReplica(replica_factory(rid)), 2,
+        health_interval=0.1, bus=bus,
+    )
+    replicaset.start()
+    router = None
+    try:
+        assert replicaset.wait_healthy(2, timeout=60.0), (
+            replicaset.snapshot()
+        )
+        router = Router(replicaset, port=0, bus=bus)
+        rng = np.random.RandomState(1)
+        for _ in range(24):
+            status, out = _post_act(router.url, rng.randn(4).tolist())
+            assert status == 200 and out["step"] == 3, out
+        snap = replicaset.snapshot()
+        assert snap["healthy"] == 2, snap
+        assert all(
+            row["loaded_step"] == 3 for row in snap["replicas"].values()
+        ), snap
+        counts = {
+            rid: rec.inflight for rid, rec in replicaset.replicas.items()
+        }
+        assert all(v == 0 for v in counts.values()), counts
+        assert router.routed_total == 24 and router.failed_total == 0
+        print(
+            "2-replica leg OK: 24 requests routed over "
+            f"{snap['size']} replicas (both at step 3), 0 errors"
+        )
+    finally:
+        if router is not None:
+            router.close()
+        replicaset.close()
         bus.close()
         trainer_ck.close()
     return 0

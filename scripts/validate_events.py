@@ -22,7 +22,13 @@ fault nothing reacted to means the detect→recover loop is broken); and
 ``state="preempted"`` must be FOLLOWED by the same member's
 ``requeued`` or ``failed`` record (a preemption the scheduler never
 resolved means the requeue loop is broken; malformed fleet records FAIL
-outright via the shared ``validate_event``).
+outright via the shared ``validate_event``); and — ISSUE 9 — in a
+router log every ``router`` ``scope="replica"`` record with
+``state="died"`` must be FOLLOWED by the same replica's ``restarted``
+or ``evicted`` record (a death the replica supervisor never resolved
+means the restart-with-backoff loop is broken; malformed
+router/session records FAIL outright via the shared
+``validate_event``).
 Exits non-zero with per-line diagnostics on any failure; prints a
 per-kind count summary on success. Used by ``scripts/check.sh`` against
 both a training run's ``--metrics-jsonl`` output and ``bench.py``'s
@@ -193,6 +199,28 @@ def validate_file(path: str) -> list:
             errs.append(
                 f"{path}:{n}: fleet member {member!r} preempted with no "
                 "matching requeued/failed terminal record after it"
+            )
+    # ISSUE 9 router contract (same pattern): a replica that died with
+    # no later restarted/evicted record means the supervisor's
+    # restart-with-backoff loop is broken, not a valid log
+    for idx, (n, rec) in enumerate(records):
+        if (
+            rec.get("kind") != "router"
+            or rec.get("scope") != "replica"
+            or rec.get("state") != "died"
+        ):
+            continue
+        replica = rec.get("replica")
+        if not any(
+            later.get("kind") == "router"
+            and later.get("scope") == "replica"
+            and later.get("replica") == replica
+            and later.get("state") in ("restarted", "evicted")
+            for _, later in records[idx + 1:]
+        ):
+            errs.append(
+                f"{path}:{n}: router replica {replica!r} died with no "
+                "matching restarted/evicted resolution record after it"
             )
     return errs
 

@@ -1,0 +1,835 @@
+"""Replicated serving control plane (ISSUE 9): replica set, router,
+session protocol.
+
+Contracts pinned here:
+
+* dispatch picks the least-loaded healthy replica, honors exclusion,
+  and reports saturation (``None``) only when every in-rotation
+  replica is at its inflight bound;
+* a replica dying mid-request is retried EXACTLY once on a different
+  replica with zero client-visible errors; the supervisor evicts it
+  immediately, relaunches it after backoff, and fails it permanently
+  once the crash budget burns — the set keeps serving throughout;
+* a reloading replica leaves rotation while its hot swap is in flight
+  (zero dropped requests) and returns when it lands;
+* the session protocol: affinity pins a session to the replica holding
+  its carry, actions are BIT-EXACT vs driving ``agent.act(...,
+  policy_carry=...)`` by hand, TTL eviction surfaces as a typed 404,
+  and a session on a dead replica is re-established with a fresh
+  carry (``reestablished: true``) instead of failing the client;
+* the structured protocol refusal: stateless ``/act`` on a recurrent
+  policy (and session calls on a feedforward one) answer a typed 409
+  naming the correct endpoint;
+* ``router``/``session`` events are schema-valid, and the validator
+  FAILS a ``died`` replica with no later ``restarted``/``evicted``
+  resolution.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.obs.events import EventBus, validate_event
+from trpo_tpu.serve import (
+    InProcessReplica,
+    MicroBatcher,
+    PolicyServer,
+    ReplicaSet,
+    Router,
+    SessionStore,
+)
+
+_FF_CFG = dict(
+    n_envs=4, batch_timesteps=32, cg_iters=2, vf_train_steps=2,
+    policy_hidden=(8,), vf_hidden=(8,), seed=11,
+    serve_batch_shapes=(1, 2),
+)
+
+
+@pytest.fixture(scope="module")
+def ff():
+    agent = TRPOAgent("cartpole", TRPOConfig(**_FF_CFG))
+    state = agent.init_state(seed=0)
+    return agent, state
+
+
+@pytest.fixture(scope="module")
+def rec():
+    agent = TRPOAgent(
+        "pendulum",
+        TRPOConfig(**{**_FF_CFG, "policy_gru": 8}),
+    )
+    state = agent.init_state(seed=0)
+    return agent, state
+
+
+def _ff_factory(agent, state, bus=None, replica_name=None, **server_kw):
+    def factory():
+        engine = agent.serve_engine()
+        engine.load(state.policy_params, state.obs_norm, step=1)
+        batcher = MicroBatcher(engine, deadline_ms=5.0, bus=bus)
+        server = PolicyServer(
+            engine, batcher, port=0, bus=bus,
+            replica_name=replica_name, **server_kw,
+        )
+        return server, [batcher]
+
+    return factory
+
+
+def _rec_factory(agent, state, bus=None, replica_name=None, **server_kw):
+    def factory():
+        engine = agent.serve_session_engine()
+        engine.load(state.policy_params, state.obs_norm, step=1)
+        server = PolicyServer(
+            engine, None, port=0, bus=bus,
+            replica_name=replica_name, **server_kw,
+        )
+        return server, []
+
+    return factory
+
+
+def _replicaset(make_factory, n, bus=None, **kw):
+    """A replica set driven by MANUAL ticks (no supervisor thread) with
+    a long poll interval, so tests decide exactly when supervision
+    happens — the router's own death-reporting is what's under test."""
+    kw.setdefault("health_interval", 60.0)
+    kw.setdefault("backoff", 0.05)
+    kw.setdefault("health_fail_threshold", 1)
+    kw.setdefault("max_restarts", 2)
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(make_factory(rid)), n, bus=bus, **kw
+    )
+    assert rs.wait_healthy(n, timeout=60.0), rs.snapshot()
+    return rs
+
+
+def _post(url, payload=None, timeout=30.0):
+    data = b"" if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# session engine + store (no HTTP)
+# ---------------------------------------------------------------------------
+
+
+def test_session_engine_bit_exact_vs_direct_act(rec):
+    agent, state = rec
+    engine = agent.serve_session_engine()
+    engine.load(state.policy_params, state.obs_norm, step=0)
+    rng = np.random.RandomState(0)
+    carry_e = engine.initial_carry()
+    carry_d = None
+    for t in range(6):
+        obs = rng.randn(*agent.obs_shape).astype(np.float32)
+        a_e, carry_e, step = engine.step(carry_e, obs, return_step=True)
+        a_d, _dist, carry_d = agent.act(
+            state, obs, eval_mode=True, policy_carry=carry_d
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a_e), np.asarray(a_d), err_msg=f"step {t}"
+        )
+        np.testing.assert_array_equal(carry_e, np.asarray(carry_d))
+        assert step == 0
+
+
+def test_session_engine_rejects_bad_inputs(rec, ff):
+    agent, state = rec
+    fresh = agent.serve_session_engine()
+    with pytest.raises(RuntimeError, match="no params snapshot"):
+        fresh.step(fresh.initial_carry(), np.zeros(agent.obs_shape))
+    engine = agent.serve_session_engine()
+    engine.load(state.policy_params, state.obs_norm, step=0)
+    with pytest.raises(ValueError, match="carry"):
+        engine.step(np.zeros(99, np.float32), np.zeros(agent.obs_shape))
+    with pytest.raises(ValueError, match="obs"):
+        engine.step(engine.initial_carry(), np.zeros(99, np.float32))
+    # the factory refusals both ways
+    ff_agent, _ = ff
+    with pytest.raises(ValueError, match="recurrent policies only"):
+        ff_agent.serve_session_engine()
+    with pytest.raises(ValueError, match="feedforward"):
+        agent.serve_engine()
+
+
+def test_session_store_ttl_capacity_and_events():
+    events = []
+    bus = EventBus(lambda rec_: events.append(rec_))
+    store = SessionStore(
+        ttl_s=0.15, max_sessions=2, bus=bus, replica="r9",
+        sweep_interval=0.05,
+    )
+    try:
+        zero = np.zeros(4, np.float32)
+        a = store.create(zero)
+        b = store.create(zero)
+        assert store.get(a) is not None
+        # capacity: creating a third LRU-evicts the longest-idle (b —
+        # a was refreshed by the get above)
+        c = store.create(zero)
+        assert len(store) == 2 and store.evicted_total == 1
+        assert store.get(b) is None
+        # TTL: idle sessions expire via the sweeper
+        deadline = time.time() + 5.0
+        while len(store) and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(store) == 0
+        assert store.expired_total >= 2
+        assert store.get(c) is None
+    finally:
+        store.close()
+    for e in events:
+        assert validate_event(e) == [], e
+        assert e["replica"] == "r9"
+    kinds = [e["event"] for e in events]
+    assert kinds.count("created") == 3 and "evicted" in kinds
+    assert "expired" in kinds
+    with pytest.raises(ValueError, match="ttl_s"):
+        SessionStore(ttl_s=0)
+    with pytest.raises(ValueError, match="max_sessions"):
+        SessionStore(max_sessions=0)
+
+
+# ---------------------------------------------------------------------------
+# structured protocol refusal (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_structured_protocol_refusals(ff, rec):
+    ff_agent, ff_state = ff
+    rec_agent, rec_state = rec
+
+    server, closers = _ff_factory(ff_agent, ff_state)()
+    try:
+        status, out = _post(server.url + "/session")
+        assert status == 409
+        assert out["code"] == "wrong_protocol"
+        assert out["endpoint"] == "/act"
+        status, out = _post(
+            server.url + "/session/xyz/act", {"obs": [0, 0, 0, 0]}
+        )
+        assert status == 409 and out["endpoint"] == "/act"
+    finally:
+        server.close()
+        for c in closers:
+            c.close()
+
+    server, closers = _rec_factory(rec_agent, rec_state)()
+    try:
+        status, out = _post(
+            server.url + "/act",
+            {"obs": [0.0] * int(np.prod(rec_agent.obs_shape))},
+        )
+        assert status == 409
+        assert out["code"] == "wrong_protocol"
+        assert out["endpoint"] == "/session"
+    finally:
+        server.close()
+        for c in closers:
+            c.close()
+
+
+def test_recurrent_server_requires_no_batcher(rec, ff):
+    rec_agent, rec_state = rec
+    engine = rec_agent.serve_session_engine()
+    engine.load(rec_state.policy_params, rec_state.obs_norm, step=0)
+    with pytest.raises(ValueError, match="no micro-batcher"):
+        PolicyServer(engine, object(), port=0)
+    ff_agent, ff_state = ff
+    ff_engine = ff_agent.serve_engine()
+    with pytest.raises(ValueError, match="needs a MicroBatcher"):
+        PolicyServer(ff_engine, None, port=0)
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_router_least_loaded_dispatch_and_saturation(ff):
+    agent, state = ff
+    rs = _replicaset(lambda rid: _ff_factory(agent, state), 2)
+    router = Router(rs, port=0, max_inflight=2)
+    try:
+        # skew the load: r0 carries 1 outstanding request
+        with rs.lock:
+            rs.replicas["r0"].inflight = 1
+        picked = router._pick()
+        assert picked == "r1"  # least-loaded wins
+        with rs.lock:
+            assert rs.replicas["r1"].inflight == 1  # reservation taken
+        # exclusion (the retry path never re-picks the dead replica)
+        assert router._pick(exclude=("r1",)) == "r0"
+        # saturation: every replica at the bound -> None
+        with rs.lock:
+            rs.replicas["r0"].inflight = 2
+            rs.replicas["r1"].inflight = 2
+        assert router._pick() is None
+        with rs.lock:
+            rs.replicas["r0"].inflight = 0
+            rs.replicas["r1"].inflight = 0
+        # a real request round-trips and releases its reservation
+        status, out = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert status == 200 and "action" in out and out["step"] == 1
+        with rs.lock:
+            assert all(
+                r.inflight == 0 for r in rs.replicas.values()
+            )
+    finally:
+        router.close()
+        rs.close()
+
+
+def test_router_backpressure_503_only_when_all_saturated(ff):
+    agent, state = ff
+    rs = _replicaset(lambda rid: _ff_factory(agent, state), 2)
+    router = Router(rs, port=0, max_inflight=1)
+    try:
+        with rs.lock:
+            rs.replicas["r0"].inflight = 1
+        # one replica free: still routed
+        status, _ = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert status == 200
+        with rs.lock:
+            rs.replicas["r0"].inflight = 1
+            rs.replicas["r1"].inflight = 1
+        status, out = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert status == 503
+        assert "saturated" in out["error"]
+        assert router.backpressure_total == 1
+        with rs.lock:
+            rs.replicas["r0"].inflight = 0
+            rs.replicas["r1"].inflight = 0
+    finally:
+        router.close()
+        rs.close()
+
+
+def test_router_passes_client_errors_through_without_retry(ff):
+    agent, state = ff
+    rs = _replicaset(lambda rid: _ff_factory(agent, state), 2)
+    router = Router(rs, port=0)
+    try:
+        status, out = _post(router.url + "/act", {"obs": [1.0]})
+        assert status == 400  # wrong shape: the replica's 400, verbatim
+        assert router.retried_total == 0
+        status, _ = _post(router.url + "/act", {"nope": 1})
+        assert status == 400
+    finally:
+        router.close()
+        rs.close()
+
+
+# ---------------------------------------------------------------------------
+# death, retry, restart, crash budget
+# ---------------------------------------------------------------------------
+
+
+def test_retry_on_death_is_exactly_once_with_zero_client_errors(ff):
+    agent, state = ff
+    events = []
+    bus = EventBus(lambda rec_: events.append(rec_))
+    rs = _replicaset(lambda rid: _ff_factory(agent, state), 2, bus=bus)
+    router = Router(rs, port=0, bus=bus)
+    try:
+        rs.replicas["r0"].handle.kill()
+        errors = []
+        for _ in range(12):
+            status, out = _post(
+                router.url + "/act", {"obs": [0, 0, 0, 0]}
+            )
+            if status != 200:
+                errors.append((status, out))
+        assert not errors
+        # exactly one retry: the first request to touch the corpse; the
+        # eviction is immediate, so later requests never pick it
+        assert router.retried_total == 1
+        assert router.failed_total == 0
+        snap = rs.snapshot()
+        assert snap["replicas"]["r0"]["state"] == "evicted"
+
+        # backoff elapses -> relaunch -> healthy again
+        time.sleep(0.15)
+        rs.tick()  # relaunch
+        rs.tick()  # healthz -> healthy
+        snap = rs.snapshot()
+        assert snap["replicas"]["r0"]["state"] == "healthy"
+        assert snap["replicas"]["r0"]["restarts"] == 1
+        status, _ = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert status == 200
+    finally:
+        router.close()
+        rs.close()
+    for e in events:
+        assert validate_event(e) == [], e
+    lifecycle = [
+        (e["replica"], e["state"]) for e in events
+        if e["kind"] == "router" and e.get("scope") == "replica"
+    ]
+    assert ("r0", "died") in lifecycle
+    assert ("r0", "evicted") in lifecycle
+    assert ("r0", "restarted") in lifecycle
+    # the request records carry the retry flag exactly once
+    retried = [
+        e for e in events
+        if e["kind"] == "router" and e.get("scope") == "request"
+        and e.get("retried")
+    ]
+    assert len(retried) == 1 and retried[0]["ok"] is True
+
+
+def test_single_replica_death_is_a_failure_not_a_phantom_retry(ff):
+    """With one replica, a mid-request death has nowhere to retry: the
+    client gets a 502, `failed_total` counts it, and `retried_total`
+    stays 0 — a retry that never dispatched anywhere must not inflate
+    the counter (and the 503 backpressure counter must not absorb a
+    request that actually reached and lost a replica)."""
+    agent, state = ff
+    rs = _replicaset(lambda rid: _ff_factory(agent, state), 1)
+    router = Router(rs, port=0)
+    try:
+        rs.replicas["r0"].handle.kill()
+        status, out = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert status == 502, (status, out)
+        assert router.failed_total == 1
+        assert router.retried_total == 0
+        assert router.backpressure_total == 0
+        # with the corpse evicted, the next request is backpressure
+        status, _ = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert status == 503
+        assert router.backpressure_total == 1
+    finally:
+        router.close()
+        rs.close()
+
+
+def test_session_create_rejects_non_object_bodies(ff, rec):
+    """A valid-JSON non-dict body is a 400 per the contract, never an
+    AttributeError surfacing as a 500 — at the router AND the replica."""
+    agent, state = rec
+    rs = _replicaset(
+        lambda rid: _rec_factory(agent, state), 1
+    )
+    router = Router(rs, port=0)
+    try:
+        replica_url = rs.replicas["r0"].url
+        for url in (router.url, replica_url):
+            status, out = _post(url + "/session", [1, 2])
+            assert status == 400, (url, status, out)
+            status, out = _post(url + "/session", "strings too")
+            assert status == 400, (url, status, out)
+    finally:
+        router.close()
+        rs.close()
+
+
+def test_crash_budget_fails_the_replica_never_the_set(ff):
+    agent, state = ff
+    events = []
+    bus = EventBus(lambda rec_: events.append(rec_))
+    rs = _replicaset(
+        lambda rid: _ff_factory(agent, state), 2, bus=bus,
+        max_restarts=1,
+    )
+    router = Router(rs, port=0, bus=bus)
+    try:
+        for round_ in range(2):
+            rs.replicas["r0"].handle.kill()
+            rs.tick()            # observe the death
+            time.sleep(0.15)
+            rs.tick()            # relaunch (round 0) / nothing (round 1)
+            rs.tick()
+        snap = rs.snapshot()
+        assert snap["replicas"]["r0"]["state"] == "failed"
+        assert snap["replicas"]["r0"]["restarts"] == 1  # budget burned
+        # the SET is still serving on the survivor
+        for _ in range(3):
+            status, _ = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+            assert status == 200
+    finally:
+        router.close()
+        rs.close()
+    states = [
+        e["state"] for e in events
+        if e["kind"] == "router" and e.get("scope") == "replica"
+        and e["replica"] == "r0"
+    ]
+    assert "failed" in states
+    # every died is resolved (the validator contract, asserted inline)
+    for i, s in enumerate(states):
+        if s == "died":
+            assert any(
+                later in ("restarted", "evicted")
+                for later in states[i + 1:]
+            )
+
+
+# ---------------------------------------------------------------------------
+# reload rotation
+# ---------------------------------------------------------------------------
+
+
+def test_reload_takes_replica_out_of_rotation_zero_drops(ff, tmp_path):
+    """While a replica's hot reload is restoring, the supervisor marks
+    it ``reloading`` and the router prefers healthy replicas — with
+    zero dropped requests throughout, and the replica returns to
+    rotation serving the new step."""
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    agent, state = ff
+    trainer_ck = Checkpointer(str(tmp_path / "ck"))
+    trainer_ck.save(1, state)
+
+    gate = threading.Event()
+
+    def make_factory(rid):
+        def factory():
+            engine = agent.serve_engine()
+            batcher = MicroBatcher(engine, deadline_ms=5.0)
+
+            def slow_snapshot(st):
+                if rid == "r0" and st is not None:
+                    gate.wait(timeout=30.0)  # holds r0's reload open
+                return st.policy_params, st.obs_norm
+
+            server = PolicyServer(
+                engine, batcher, port=0,
+                checkpointer=Checkpointer(str(tmp_path / "ck")),
+                template=agent.init_state(),
+                snapshot_fn=slow_snapshot,
+                # r0 notices new checkpoints fast; r1 effectively never
+                # polls during the test window, so exactly one replica
+                # reloads at a time
+                poll_interval=0.05 if rid == "r0" else 60.0,
+            )
+            return server, [batcher]
+
+        return factory
+
+    gate.set()  # first (synchronous) load passes straight through
+    rs = _replicaset(make_factory, 2)
+    router = Router(rs, port=0)
+    try:
+        gate.clear()
+        trainer_ck.save(2, state)  # r0's watcher starts a SLOW reload
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            rs.tick()
+            if rs.snapshot()["replicas"]["r0"]["state"] == "reloading":
+                break
+            time.sleep(0.02)
+        assert rs.snapshot()["replicas"]["r0"]["state"] == "reloading"
+        assert [r.id for r in rs.in_rotation()] == ["r1"]
+
+        # requests during the reload: all served (by r1), zero drops
+        for _ in range(8):
+            status, _ = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+            assert status == 200
+        gate.set()
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            rs.tick()
+            row = rs.snapshot()["replicas"]["r0"]
+            if row["state"] == "healthy" and row["loaded_step"] == 2:
+                break
+            time.sleep(0.02)
+        row = rs.snapshot()["replicas"]["r0"]
+        assert row["state"] == "healthy" and row["loaded_step"] == 2
+        assert row["restarts"] == 0  # a reload is not a crash
+    finally:
+        gate.set()
+        router.close()
+        rs.close()
+        trainer_ck.close()
+
+
+# ---------------------------------------------------------------------------
+# sessions over the router
+# ---------------------------------------------------------------------------
+
+
+def test_session_affinity_ttl_and_dead_replica_reestablishment(rec):
+    agent, state = rec
+    events = []
+    bus = EventBus(lambda rec_: events.append(rec_))
+    rs = _replicaset(
+        lambda rid: _rec_factory(
+            agent, state, bus=bus, replica_name=rid,
+            session_ttl_s=0.25,
+        ),
+        2, bus=bus,
+    )
+    router = Router(rs, port=0, bus=bus)
+    try:
+        status, out = _post(router.url + "/session")
+        assert status == 200
+        sid, pinned = out["session"], out["replica"]
+
+        obs_seq = [
+            np.random.RandomState(i).randn(*agent.obs_shape)
+            .astype(np.float32)
+            for i in range(4)
+        ]
+        carry = None
+        direct = []
+        for o in obs_seq:
+            a, _d, carry = agent.act(
+                state, o, eval_mode=True, policy_carry=carry
+            )
+            direct.append(np.asarray(a))
+
+        # affinity: every act lands on the pinned replica, bit-exact
+        for t in range(3):
+            status, out = _post(
+                router.url + f"/session/{sid}/act",
+                {"obs": obs_seq[t].tolist()},
+            )
+            assert status == 200 and out["session"] == sid
+            np.testing.assert_array_equal(
+                np.asarray(out["action"], np.float64),
+                direct[t].astype(np.float64),
+            )
+            assert "reestablished" not in out
+        acts = [
+            e for e in events
+            if e["kind"] == "router" and e.get("scope") == "request"
+            and e.get("endpoint") == "session_act"
+        ]
+        assert acts and all(e["replica"] == pinned for e in acts)
+
+        # kill the pinned replica: the next act re-establishes on the
+        # survivor with a FRESH carry — bit-exact with a fresh direct
+        # session, flagged, zero client-visible errors
+        rs.replicas[pinned].handle.kill()
+        status, out = _post(
+            router.url + f"/session/{sid}/act",
+            {"obs": obs_seq[0].tolist()},
+        )
+        assert status == 200
+        assert out.get("reestablished") is True
+        np.testing.assert_array_equal(
+            np.asarray(out["action"], np.float64),
+            direct[0].astype(np.float64),
+        )
+        assert router.sessions_reestablished_total == 1
+        assert any(
+            e["kind"] == "session" and e["event"] == "reestablished"
+            for e in events
+        )
+
+        # TTL: an idle session expires replica-side -> typed 404
+        time.sleep(0.6)
+        status, out = _post(
+            router.url + f"/session/{sid}/act",
+            {"obs": obs_seq[0].tolist()},
+        )
+        assert status == 404 and out["code"] == "session_unknown"
+
+        # unknown id at the router: typed 404 without a replica hop
+        status, out = _post(
+            router.url + "/session/feedfeed/act",
+            {"obs": obs_seq[0].tolist()},
+        )
+        assert status == 404 and out["code"] == "session_unknown"
+    finally:
+        router.close()
+        rs.close()
+    for e in events:
+        assert validate_event(e) == [], e
+
+
+# ---------------------------------------------------------------------------
+# aggregated introspection
+# ---------------------------------------------------------------------------
+
+
+def test_router_status_and_metrics_aggregate_the_set(ff):
+    agent, state = ff
+    rs = _replicaset(lambda rid: _ff_factory(agent, state), 2)
+    router = Router(rs, port=0)
+    try:
+        for _ in range(4):
+            status, _ = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+            assert status == 200
+        status_doc = _get(router.url + "/status")
+        assert status_doc["size"] == 2 and status_doc["healthy"] == 2
+        assert status_doc["counters"]["routed_total"] == 4
+        assert set(status_doc["replicas"]) == {"r0", "r1"}
+        assert "0.5" in status_doc["latency_ms"]
+
+        with urllib.request.urlopen(
+            router.url + "/metrics", timeout=10
+        ) as r:
+            metrics = r.read().decode()
+        assert "trpo_router_replicas 2" in metrics
+        assert (
+            'trpo_router_replica_state{replica="r0",state="healthy"} 1'
+            in metrics
+        )
+        assert "trpo_router_routed_total 4" in metrics
+        assert 'trpo_router_latency_ms{quantile="0.5"}' in metrics
+        for ln in metrics.splitlines():
+            if ln and not ln.startswith("#"):
+                float(ln.rsplit(" ", 1)[1])  # prometheus-parseable
+        health = _get(router.url + "/healthz")
+        assert health["ok"] and health["healthy"] == 2
+    finally:
+        router.close()
+        rs.close()
+
+
+# ---------------------------------------------------------------------------
+# validator contract (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_validator_router_and_session_contract(tmp_path):
+    import sys
+
+    sys.path.insert(0, "scripts")
+    from validate_events import validate_file
+
+    from trpo_tpu.obs.events import manifest_fields
+
+    manifest = {
+        "v": 1, "kind": "run_manifest", "t": 0.0,
+        **manifest_fields(None),
+    }
+    died = {
+        "v": 1, "kind": "router", "t": 1.0, "scope": "replica",
+        "replica": "r0", "state": "died",
+    }
+    evicted = {**died, "t": 2.0, "state": "evicted"}
+    request = {
+        "v": 1, "kind": "router", "t": 3.0, "scope": "request",
+        "ms": 2.5, "ok": True, "retried": False, "replica": "r1",
+    }
+    session = {
+        "v": 1, "kind": "session", "t": 4.0, "session": "abc",
+        "event": "created", "replica": "r0",
+    }
+
+    def write(path, recs):
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        return str(path)
+
+    # resolved death + request + session: valid
+    ok = write(tmp_path / "ok.jsonl", [manifest, died, evicted, request,
+                                       session])
+    assert validate_file(ok) == []
+
+    # a died with no later restarted/evicted FAILS
+    bad = write(tmp_path / "bad.jsonl", [manifest, died, request])
+    errs = validate_file(bad)
+    assert errs and any("died with no matching" in e for e in errs)
+
+    # malformed records FAIL outright
+    assert validate_event({**request, "ms": -1})
+    assert validate_event({**request, "ok": "yes"})
+    assert validate_event(
+        {k: v for k, v in request.items() if k != "retried"}
+    )
+    assert validate_event({**died, "state": "zombie"})
+    assert validate_event({**session, "event": "teleported"})
+    assert validate_event({k: v for k, v in session.items()
+                           if k != "session"})
+    malformed = write(
+        tmp_path / "malformed.jsonl",
+        [manifest, {**request, "ms": -1}],
+    )
+    assert validate_file(malformed)
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing + subprocess discovery
+# ---------------------------------------------------------------------------
+
+
+def test_serve_cli_replica_and_session_flags():
+    import sys
+
+    sys.path.insert(0, "scripts")
+    from serve import build_parser
+
+    args = build_parser().parse_args([
+        "--checkpoint-dir", "/tmp/ck", "--replicas", "3",
+        "--policy-gru", "16", "--policy-cell", "lstm",
+        "--session-ttl", "30", "--max-sessions", "64",
+        "--max-inflight", "8", "--health-interval", "0.2",
+        "--replica-restarts", "5",
+        "--run-descriptor", "/tmp/run.json",
+    ])
+    assert args.replicas == 3
+    assert args.policy_gru == 16 and args.policy_cell == "lstm"
+    assert args.session_ttl == 30.0 and args.max_sessions == 64
+    assert args.max_inflight == 8 and args.replica_restarts == 5
+    assert args.run_descriptor == "/tmp/run.json"
+
+
+@pytest.mark.slow  # spawns a real serve.py subprocess (jax import ~10s);
+# the in-process launcher covers the supervision logic in tier-1
+def test_subprocess_replica_discovery_and_routing(ff, tmp_path):
+    from trpo_tpu.serve import SubprocessReplica
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    agent, state = ff
+    ck_dir = str(tmp_path / "ck")
+    trainer_ck = Checkpointer(ck_dir)
+    trainer_ck.save(1, state)
+    trainer_ck.close()
+
+    argv = [
+        "--checkpoint-dir", ck_dir, "--port", "0", "--platform", "cpu",
+        "--preset", "cartpole", "--policy-hidden", "8",
+        "--vf-hidden", "8", "--n-envs", "4",
+        "--batch-shapes", "1,2", "--serve-seconds", "300",
+    ]
+    rs = ReplicaSet(
+        lambda rid: SubprocessReplica(
+            argv, str(tmp_path / f"replica_{rid}")
+        ),
+        1,
+        health_interval=60.0,
+        start_timeout=180.0,
+    )
+    router = Router(rs, port=0)
+    try:
+        # discovery: the run.json appears, the supervisor finds the URL
+        deadline = time.time() + 180.0
+        while time.time() < deadline:
+            rs.tick()
+            if rs.snapshot()["replicas"]["r0"]["state"] == "healthy":
+                break
+            time.sleep(0.25)
+        snap = rs.snapshot()
+        assert snap["replicas"]["r0"]["state"] == "healthy", snap
+        assert snap["replicas"]["r0"]["url"]
+
+        status, out = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert status == 200 and out["step"] == 1
+    finally:
+        router.close()
+        rs.close()

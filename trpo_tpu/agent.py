@@ -673,7 +673,8 @@ class TRPOAgent:
             raise ValueError(
                 "serve_engine supports feedforward policies only — a "
                 "recurrent policy's hidden state is per-client session "
-                "state the stateless /act data plane cannot carry"
+                "state the stateless /act data plane cannot carry; use "
+                "serve_session_engine() (the POST /session protocol)"
             )
         import jax.numpy as jnp
 
@@ -685,6 +686,34 @@ class TRPOAgent:
                 if batch_shapes is not None
                 else self.cfg.serve_batch_shapes
             ),
+            with_obs_norm=self._obs_norm_on_device or self._obs_norm_host,
+            obs_dtype=obs_dtype if obs_dtype is not None else jnp.float32,
+        )
+
+    def serve_session_engine(self, obs_dtype=None):
+        """The recurrent twin of :meth:`serve_engine`
+        (``serve/session.RecurrentServeEngine``): the eval-mode
+        ``policy.step`` AOT-compiled at batch 1 over ``(carry, obs)``,
+        donation-free and snapshot-swappable, for the ``POST /session``
+        protocol — the carry lives server-side next to the engine
+        (``serve/session.SessionStore``), threaded by session id.
+        Stepping a session through this engine is bit-exact with
+        ``act(..., eval_mode=True, policy_carry=...)``. Recurrent
+        policies only: a feedforward policy has no carry to thread —
+        serve it through the stateless :meth:`serve_engine`."""
+        from trpo_tpu.serve.session import RecurrentServeEngine
+
+        if not self.is_recurrent:
+            raise ValueError(
+                "serve_session_engine supports recurrent policies only — "
+                "a feedforward policy has no carry to thread; use "
+                "serve_engine() (the stateless POST /act plane)"
+            )
+        import jax.numpy as jnp
+
+        return RecurrentServeEngine(
+            self.policy,
+            self.obs_shape,
             with_obs_norm=self._obs_norm_on_device or self._obs_norm_host,
             obs_dtype=obs_dtype if obs_dtype is not None else jnp.float32,
         )

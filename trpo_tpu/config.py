@@ -484,6 +484,36 @@ class TRPOConfig:
     #                                the marker gate means a torn save is
     #                                never offered for loading
 
+    # --- replicated serving (serve/{replicaset,router} — ISSUE 9) --------
+    serve_replicas: int = 1        # N serving replicas behind one router
+    #                                (scripts/serve.py --replicas): 1 =
+    #                                the bare single-engine front end;
+    #                                >1 = in-process engines on ephemeral
+    #                                ports + the routing front end on the
+    #                                public port
+    serve_health_interval: float = 0.5  # replica supervisor /healthz
+    #                                poll cadence (serve/replicaset.py);
+    #                                the router also reports deaths it
+    #                                observes mid-request, so eviction
+    #                                never waits a full tick
+    serve_replica_restarts: int = 3  # per-replica crash budget: dead
+    #                                replicas relaunch with exponential
+    #                                backoff this many times, then the
+    #                                REPLICA is failed — never the set
+    #                                (the fleet max_restarts semantics)
+    serve_max_inflight: int = 64   # per-replica router-outstanding
+    #                                request bound; every in-rotation
+    #                                replica at the bound = 503
+    #                                backpressure (bound, not buffer)
+    serve_session_ttl: float = 300.0  # recurrent session idle lifetime
+    #                                (serve/session.SessionStore):
+    #                                TTL-evicted past it; the next act
+    #                                gets a typed session_unknown 404
+    serve_max_sessions: int = 1024  # bounded session store per replica;
+    #                                at capacity the longest-idle session
+    #                                is LRU-evicted (with a `session`
+    #                                event — never silently)
+
     # --- io --------------------------------------------------------------
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 10
@@ -639,6 +669,35 @@ class TRPOConfig:
             raise ValueError(
                 "serve_poll_interval must be > 0, got "
                 f"{self.serve_poll_interval}"
+            )
+        if self.serve_replicas < 1:
+            raise ValueError(
+                f"serve_replicas must be >= 1, got {self.serve_replicas}"
+            )
+        if self.serve_health_interval <= 0:
+            raise ValueError(
+                "serve_health_interval must be > 0, got "
+                f"{self.serve_health_interval}"
+            )
+        if self.serve_replica_restarts < 0:
+            raise ValueError(
+                "serve_replica_restarts must be >= 0, got "
+                f"{self.serve_replica_restarts}"
+            )
+        if self.serve_max_inflight < 1:
+            raise ValueError(
+                "serve_max_inflight must be >= 1, got "
+                f"{self.serve_max_inflight}"
+            )
+        if self.serve_session_ttl <= 0:
+            raise ValueError(
+                "serve_session_ttl must be > 0, got "
+                f"{self.serve_session_ttl}"
+            )
+        if self.serve_max_sessions < 1:
+            raise ValueError(
+                "serve_max_sessions must be >= 1, got "
+                f"{self.serve_max_sessions}"
             )
         if self.inject_faults:
             # fail at construction: a chaos run with an unparseable spec

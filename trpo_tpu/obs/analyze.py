@@ -29,6 +29,10 @@ Comparison semantics (:func:`compare_runs`):
   p50/p99 (overall and per padded rung) are time-like, actions/s is
   rate-like — the ISSUE 6 SLO gate; the rows appear only when at least
   one run actually served;
+* replicated-serving runs (``router`` events — ISSUE 9) likewise:
+  router p50/p99 time-like, routed actions/s rate-like, rows only when
+  a run actually routed; the single-run summary adds the per-replica
+  table, the scaling/balance row, and the session lifecycle counts;
 * phases below ``min_ms`` in BOTH runs are skipped (a 0.1 ms phase
   doubling is scheduler noise, not a regression), as are metrics absent
   from either run (no silent verdict about unmeasured things — they are
@@ -159,6 +163,101 @@ def _summarize_serving(records: list) -> Optional[dict]:
             }
             for rung, row in shapes.items()
         },
+    }
+
+
+def _summarize_router(records: list) -> Optional[dict]:
+    """Aggregate the replicated-serving control plane's records (ISSUE
+    9): ``router`` ``scope="request"`` rows into routed/retried/failed
+    totals, p50/p99 and routed actions/s; ``scope="replica"`` rows into
+    a per-replica lifecycle/traffic table; ``session`` rows into the
+    session lifecycle counts. The ``scaling`` row reports per-replica
+    throughput and load balance (worst/best replica request share —
+    1.0 = perfectly even); the CROSS-run scaling efficiency (N-replica
+    vs 1-replica actions/s) lives in ``bench.py serving_scale`` /
+    BENCH_LADDER, where both legs exist."""
+    reqs = [
+        r for r in records
+        if r.get("kind") == "router" and r.get("scope") == "request"
+    ]
+    lifecycle = [
+        r for r in records
+        if r.get("kind") == "router" and r.get("scope") == "replica"
+    ]
+    sessions = [r for r in records if r.get("kind") == "session"]
+    if not reqs and not lifecycle:
+        return None
+    ok_reqs = [r for r in reqs if r.get("ok")]
+    lats = [r.get("ms") for r in ok_reqs]
+    times = [
+        r.get("t") for r in ok_reqs if _finite(r.get("t")) is not None
+    ]
+    span = (max(times) - min(times)) if len(times) >= 2 else None
+
+    replicas: dict = {}
+
+    def _row(rid):
+        return replicas.setdefault(
+            str(rid),
+            {"requests": 0, "lats": [], "restarts": 0, "deaths": 0,
+             "last_state": None},
+        )
+
+    for r in lifecycle:
+        rid = r.get("replica")
+        if rid is None:
+            continue
+        row = _row(rid)
+        state = r.get("state")
+        row["last_state"] = state if isinstance(state, str) else "unknown"
+        if state == "restarted":
+            row["restarts"] += 1
+        elif state == "died":
+            row["deaths"] += 1
+    for r in ok_reqs:
+        rid = r.get("replica")
+        if rid is None:
+            continue
+        row = _row(rid)
+        row["requests"] += 1
+        if _finite(r.get("ms")) is not None:
+            row["lats"].append(r["ms"])
+
+    shares = [
+        row["requests"] for row in replicas.values() if row["requests"]
+    ]
+    routed = len(ok_reqs)
+    return {
+        "routed_total": routed,
+        "retried_total": sum(1 for r in reqs if r.get("retried")),
+        "failed_total": sum(1 for r in reqs if not r.get("ok")),
+        "actions_per_sec": (routed / span) if span else None,
+        "latency_p50_ms": _quantile(lats, 0.5),
+        "latency_p99_ms": _quantile(lats, 0.99),
+        "replicas": {
+            rid: {
+                "requests": row["requests"],
+                "p50_ms": _quantile(row["lats"], 0.5),
+                "restarts": row["restarts"],
+                "deaths": row["deaths"],
+                "last_state": row["last_state"],
+            }
+            for rid, row in replicas.items()
+        },
+        "scaling": {
+            "replicas": len(replicas),
+            "actions_per_sec_per_replica": (
+                routed / span / len(replicas)
+                if span and replicas else None
+            ),
+            "balance": (
+                min(shares) / max(shares) if shares and max(shares)
+                else None
+            ),
+        },
+        "sessions": dict(
+            sorted(Counter(r.get("event") for r in sessions).items())
+        ) if sessions else None,
     }
 
 
@@ -349,6 +448,7 @@ def summarize_run(records: list) -> dict:
             "peak_live_buffer_bytes": live_peak,
         },
         "serving": serving,
+        "router": _summarize_router(records),
         "solver_precision": solver_precision,
         "fleet": _summarize_fleet(records),
         "events_total": dict(
@@ -499,6 +599,24 @@ def compare_runs(
                     (b_shapes.get(rung) or {}).get("p50_ms"),
                     (n_shapes.get(rung) or {}).get("p50_ms"),
                     threshold_pct, "time",
+                )
+            )
+
+    # replicated-serving SLOs (ISSUE 9) — router p50/p99 are time-like,
+    # routed actions/s rate-like; rows only when at least one run
+    # actually routed (same gating policy as the serve block)
+    b_rt = base.get("router") or {}
+    n_rt = new.get("router") or {}
+    if b_rt or n_rt:
+        for metric, direction in (
+            ("latency_p50_ms", "time"),
+            ("latency_p99_ms", "time"),
+            ("actions_per_sec", "rate"),
+        ):
+            verdicts.append(
+                _verdict(
+                    f"router/{metric}", b_rt.get(metric),
+                    n_rt.get(metric), threshold_pct, direction,
                 )
             )
 
@@ -673,6 +791,48 @@ def render_summary(summary: dict) -> str:
                 ],
                 ["padded", "batches", "requests", "p50_ms", "p99_ms"],
             ))
+    rt = summary.get("router") or {}
+    if rt:
+        out.append("")
+        out.append(
+            f"router: routed={rt.get('routed_total')}"
+            f" retried={rt.get('retried_total')}"
+            f" failed={rt.get('failed_total')}"
+            f" actions/s={_fmt(rt.get('actions_per_sec'), 1)}"
+            f" p50={_fmt(rt.get('latency_p50_ms'))}ms"
+            f" p99={_fmt(rt.get('latency_p99_ms'))}ms"
+        )
+        replicas = rt.get("replicas") or {}
+        if replicas:
+            out.append(format_table(
+                [
+                    [
+                        rid,
+                        row.get("last_state"),
+                        row.get("requests"),
+                        _fmt(row.get("p50_ms")),
+                        row.get("deaths"),
+                        row.get("restarts"),
+                    ]
+                    for rid, row in sorted(replicas.items())
+                ],
+                ["replica", "state", "requests", "p50_ms", "deaths",
+                 "restarts"],
+            ))
+        sc = rt.get("scaling") or {}
+        if sc.get("replicas"):
+            out.append(
+                f"scaling: replicas={sc.get('replicas')}"
+                "  actions/s/replica="
+                + _fmt(sc.get("actions_per_sec_per_replica"), 1)
+                + f"  balance={_fmt(sc.get('balance'))}"
+            )
+        sess = rt.get("sessions") or {}
+        if sess:
+            out.append(
+                "sessions: "
+                + ", ".join(f"{k}×{v}" for k, v in sess.items())
+            )
     sp = summary.get("solver_precision") or {}
     if sp:
         out.append("")

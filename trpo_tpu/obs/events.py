@@ -61,6 +61,25 @@ Kinds:
   followed by the member's ``requeued`` or ``failed`` resolution (a
   preemption the scheduler never resolved means the requeue loop is
   broken).
+* ``router`` — the replicated serving control plane
+  (``serve/{replicaset,router}.py``), scope-discriminated like
+  ``memory``: ``scope="replica"`` is one replica lifecycle transition
+  (``ROUTER_REPLICA_STATES``: started / healthy / reloading / died /
+  evicted / restarted / failed) as seen by the replica supervisor;
+  ``scope="request"`` is one client request through the routing front
+  end (end-to-end ``ms``, whether it succeeded, whether it took the
+  transparent one-shot retry after a replica died mid-request). The
+  log is self-auditing: ``scripts/validate_events.py`` checks every
+  ``died`` replica has a later ``restarted``/``evicted`` resolution —
+  a death the supervisor never acted on means the replica-restart
+  loop is broken.
+* ``session`` — one session lifecycle transition in the recurrent
+  serving protocol (``serve/session.py`` stores on the replicas,
+  ``serve/router.py`` affinity): ``SESSION_EVENTS`` — ``created``
+  (replica minted carry), ``reestablished`` (the router re-created the
+  session with a FRESH carry after its replica died), ``expired``
+  (TTL eviction), ``evicted`` (capacity eviction from the bounded
+  store).
 
 Sinks are append-only and flush-on-write; the JSONL sink repairs a
 crash-truncated final line on open (``utils/metrics.repair_jsonl_tail``),
@@ -86,6 +105,8 @@ __all__ = [
     "SCHEMA_VERSION",
     "EVENT_KINDS",
     "FLEET_STATES",
+    "ROUTER_REPLICA_STATES",
+    "SESSION_EVENTS",
     "EventBus",
     "JsonlSink",
     "ConsoleSink",
@@ -101,6 +122,18 @@ SCHEMA_VERSION = 1
 FLEET_STATES = (
     "launched", "preempted", "requeued", "finished", "failed", "culled",
 )
+
+# replica lifecycle states the serving replica supervisor may record
+# (the state machine lives in serve/replicaset.py; the vocabulary lives
+# HERE so the validator needs no serve import — the FLEET_STATES pattern)
+ROUTER_REPLICA_STATES = (
+    "started", "healthy", "reloading", "died", "evicted", "restarted",
+    "failed",
+)
+
+# session lifecycle transitions the recurrent serving protocol records
+# (stores live in serve/session.py, router affinity in serve/router.py)
+SESSION_EVENTS = ("created", "reestablished", "expired", "evicted")
 
 _SCALAR = (bool, int, float, str, type(None))
 
@@ -181,6 +214,19 @@ _REQUIRED = {
         and not isinstance(v, bool)
         and v >= 0,
     },
+    "router": {
+        # scope-discriminated (like `memory`): "replica" lifecycle
+        # transitions vs per-"request" routing records — the per-scope
+        # required fields live in _ROUTER_SCOPED below
+        "scope": lambda v: v in ("replica", "request"),
+    },
+    "session": {
+        # one session lifecycle transition (serve/session.py store,
+        # serve/router.py affinity); `replica` rides along as an
+        # optional field
+        "session": lambda v: isinstance(v, str) and v,
+        "event": lambda v: v in SESSION_EVENTS,
+    },
 }
 
 _BYTES = lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 0
@@ -198,6 +244,22 @@ _MEMORY_SCOPED = {
         "iteration": lambda v: isinstance(v, int)
         and not isinstance(v, bool),
         "live_buffer_bytes": _BYTES,
+    },
+}
+
+# router events are scope-discriminated the same way (checked by
+# validate_event after the flat table above passes)
+_ROUTER_SCOPED = {
+    "replica": {
+        "replica": lambda v: isinstance(v, str) and v,
+        "state": lambda v: v in ROUTER_REPLICA_STATES,
+    },
+    "request": {
+        "ms": lambda v: isinstance(v, (int, float))
+        and not isinstance(v, bool)
+        and v >= 0,
+        "ok": lambda v: isinstance(v, bool),
+        "retried": lambda v: isinstance(v, bool),
     },
 }
 
@@ -228,17 +290,22 @@ def validate_event(rec: Any) -> list:
         elif not ok(rec[field]):
             errs.append(f"{kind}: field {field!r} failed its check "
                         f"(got {rec[field]!r})")
-    if kind == "memory":
+    for scoped_kind, table in (
+        ("memory", _MEMORY_SCOPED),
+        ("router", _ROUTER_SCOPED),
+    ):
+        if kind != scoped_kind:
+            continue
         # scope-discriminated record: each scope has its own required set
-        for field, ok in _MEMORY_SCOPED.get(rec.get("scope"), {}).items():
+        for field, ok in table.get(rec.get("scope"), {}).items():
             if field not in rec:
                 errs.append(
-                    f"memory[{rec.get('scope')}]: missing required "
+                    f"{kind}[{rec.get('scope')}]: missing required "
                     f"field {field!r}"
                 )
             elif not ok(rec[field]):
                 errs.append(
-                    f"memory[{rec.get('scope')}]: field {field!r} failed "
+                    f"{kind}[{rec.get('scope')}]: field {field!r} failed "
                     f"its check (got {rec[field]!r})"
                 )
     return errs

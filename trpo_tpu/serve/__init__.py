@@ -20,12 +20,50 @@ package is the second one as a data plane:
   ``Checkpointer.latest_step()`` (marker-gated — a torn save is never
   loaded) with zero dropped or mis-served requests.
 
-``scripts/serve.py`` is the CLI; ``bench.py``'s ``serving`` block and
-``scripts/analyze_run.py --compare`` carry the latency/throughput SLOs.
+The replicated control plane (ISSUE 9) composes the data plane into
+the "millions of users" scale leg:
+
+* :mod:`trpo_tpu.serve.replicaset` — :class:`ReplicaSet`: N serving
+  replicas (in-process engines or ``scripts/serve.py`` subprocesses
+  discovered via run.json), supervised over ``/healthz`` with
+  restart-with-backoff and a crash budget; a reloading replica leaves
+  rotation while its hot swap is in flight.
+* :mod:`trpo_tpu.serve.router` — :class:`Router`: the one public
+  ``POST /act`` over the set — least-queue-depth dispatch, one
+  transparent retry when a replica dies mid-request, 503 backpressure
+  only when ALL replicas are saturated, aggregated
+  ``/status``/``/metrics`` (``trpo_router_*``).
+* :mod:`trpo_tpu.serve.session` — the session protocol for RECURRENT
+  policies: :class:`RecurrentServeEngine` (AOT batch-1 ``step``) +
+  :class:`SessionStore` (bounded, TTL-evicting, server-side carry);
+  the router adds session→replica affinity and re-establishes a
+  session from a fresh carry when its replica dies.
+
+``scripts/serve.py`` is the CLI (``--replicas N`` = replicas + router
+in one process); ``bench.py``'s ``serving``/``serving_scale`` blocks
+and ``scripts/analyze_run.py --compare`` carry the latency/throughput
+SLOs.
 """
 
 from trpo_tpu.serve.batcher import MicroBatcher
 from trpo_tpu.serve.engine import InferenceEngine
+from trpo_tpu.serve.replicaset import (
+    InProcessReplica,
+    ReplicaSet,
+    SubprocessReplica,
+)
+from trpo_tpu.serve.router import Router
 from trpo_tpu.serve.server import PolicyServer
+from trpo_tpu.serve.session import RecurrentServeEngine, SessionStore
 
-__all__ = ["InferenceEngine", "MicroBatcher", "PolicyServer"]
+__all__ = [
+    "InferenceEngine",
+    "MicroBatcher",
+    "PolicyServer",
+    "RecurrentServeEngine",
+    "SessionStore",
+    "InProcessReplica",
+    "SubprocessReplica",
+    "ReplicaSet",
+    "Router",
+]

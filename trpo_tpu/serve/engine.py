@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["InferenceEngine"]
+__all__ = ["InferenceEngine", "SimulatedCostEngine"]
 
 
 class InferenceEngine:
@@ -202,3 +202,34 @@ class InferenceEngine:
             outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
         )
         return (actions, step) if return_step else actions
+
+
+class SimulatedCostEngine:
+    """An engine wrapper adding a fixed per-``infer`` cost (a GIL-free
+    sleep) — the serving twin of PR 1's sleep-bound sim env.
+
+    Replica-scaling experiments on a CPU-only box need a per-dispatch
+    cost that behaves like DEVICE time (off-thread, concurrent across
+    replicas) rather than like host compute (serialized onto 2 cores):
+    ``time.sleep`` releases the GIL, so N replicas "compute" in
+    parallel exactly as N device-backed engines would, and the measured
+    scaling isolates what the experiment is actually about — the
+    router/batcher control plane — from this host's core count.
+    ``bench.py serving_scale`` and the check.sh router scale smoke use
+    it; production paths never do.
+    """
+
+    def __init__(self, engine, cost_ms: float):
+        if cost_ms < 0:
+            raise ValueError(f"cost_ms must be >= 0, got {cost_ms}")
+        self._engine = engine
+        self.cost_ms = float(cost_ms)
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+    def infer(self, obs, return_step: bool = False):
+        import time as _time
+
+        _time.sleep(self.cost_ms / 1e3)
+        return self._engine.infer(obs, return_step=return_step)

@@ -1,0 +1,543 @@
+"""Replica manager: N serving replicas, supervised, restartable.
+
+The fleet orchestrator (PR 7) proved the supervision grammar this
+module reuses — descriptors over console parsing, health scraping,
+restart-with-backoff, a crash budget that fails ONE member and never
+the set. Here the members are serving replicas instead of training
+runs, and the consumer is the routing front end (``serve/router.py``)
+instead of a scheduler:
+
+* A **replica** is one complete serving stack answering ``POST /act``
+  (or the session protocol) on its own ephemeral port. Two launchers:
+
+  - :class:`InProcessReplica` — engine + batcher + ``PolicyServer``
+    built in this process by a caller-supplied factory. The default
+    for ``scripts/serve.py --replicas N`` (one process, N engines —
+    on a TPU host they share the device; on CPU they share the cores)
+    and for every test/bench.
+  - :class:`SubprocessReplica` — a ``scripts/serve.py`` child
+    process, discovered through the PR 7 ``run.json`` descriptor
+    pattern (``serve.py --run-descriptor`` writes the bound URL
+    atomically; the supervisor polls the file, NEVER parses stdout).
+    Process isolation: a segfaulting replica takes out one process,
+    not the router.
+
+* The **supervisor thread** polls every replica's ``GET /healthz`` on
+  ``health_interval``. A replica answering with ``reloading=true`` is
+  taken OUT of rotation while its hot reload is in flight (the swap is
+  atomic, but the restore competes for cores) and returns when it
+  lands. A replica that stops answering is declared ``died`` →
+  ``evicted`` (out of rotation immediately) → relaunched after an
+  exponential backoff, burning its ``max_restarts`` crash budget;
+  past the budget it is ``failed`` permanently — the SET keeps serving
+  on the survivors, exactly the fleet's member-not-fleet failure
+  semantics. The router can also report a death it observed mid-request
+  (:meth:`ReplicaSet.report_failure`) so eviction doesn't wait for the
+  next poll tick.
+
+Every lifecycle transition is a ``router`` ``scope="replica"`` event
+on the bus (``obs/events.ROUTER_REPLICA_STATES``), and
+``scripts/validate_events.py`` enforces that a ``died`` record has a
+later ``restarted``/``evicted`` resolution — a silent death means this
+loop is broken.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "RECORD_STATES",
+    "InProcessReplica",
+    "SubprocessReplica",
+    "ReplicaSet",
+]
+
+# the states a ReplicaRecord actually takes (the rotation view; the
+# transitional EVENT states died/restarted exist only as bus records —
+# the same RECORD/EVENT split as fleet/scrape.RECORD_STATES)
+RECORD_STATES = ("starting", "healthy", "reloading", "evicted", "failed")
+
+
+class InProcessReplica:
+    """One in-process serving stack, built by ``factory()`` →
+    ``(server, closers)`` where ``server`` is the ``PolicyServer`` and
+    ``closers`` the extra resources (batcher, checkpointer) to close
+    after it, in order."""
+
+    def __init__(self, factory: Callable):
+        self._factory = factory
+        self.server, self._closers = factory()
+        self.url = self.server.url
+        self._killed = False
+
+    def alive(self) -> bool:
+        return not self._killed
+
+    def kill(self) -> None:
+        """Abrupt death (chaos/testing): drop the HTTP socket NOW —
+        in-flight and later connections fail like a crashed process's
+        would — and tear down the rest quietly."""
+        self._killed = True
+        try:
+            self.server.close()
+        except Exception:
+            pass
+        for c in self._closers:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        if self._killed:
+            return
+        self._killed = True
+        self.server.close()
+        for c in self._closers:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
+class SubprocessReplica:
+    """One ``scripts/serve.py`` child, discovered via its run.json.
+
+    ``argv`` is the full serve.py argument list EXCLUDING
+    ``--run-descriptor`` (appended here, pointing into
+    ``replica_dir``); ``--port 0`` should be in it so replicas never
+    collide. ``url`` is ``None`` until the descriptor appears — the
+    supervisor keeps the replica in ``starting`` and polls."""
+
+    def __init__(self, argv: List[str], replica_dir: str):
+        os.makedirs(replica_dir, exist_ok=True)
+        self.descriptor_path = os.path.join(replica_dir, "run.json")
+        # a stale descriptor from a previous attempt must not be
+        # "discovered" as the new replica's URL
+        try:
+            os.remove(self.descriptor_path)
+        except OSError:
+            pass
+        self.log_path = os.path.join(replica_dir, "serve.log")
+        self._log = open(self.log_path, "a")
+        self.proc = subprocess.Popen(
+            [sys.executable, self._serve_script()]
+            + list(argv)
+            + ["--run-descriptor", self.descriptor_path],
+            stdout=self._log,
+            stderr=subprocess.STDOUT,
+        )
+        self.url: Optional[str] = None
+
+    @staticmethod
+    def _serve_script() -> str:
+        return os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            ))),
+            "scripts",
+            "serve.py",
+        )
+
+    def discover(self) -> Optional[str]:
+        """The bound URL from run.json (the PR 7 pattern: atomic write
+        by the child, poll-don't-parse by the parent); None while the
+        child is still importing jax / binding its port."""
+        if self.url is not None:
+            return self.url
+        from trpo_tpu.fleet.scrape import read_descriptor
+
+        desc = read_descriptor(self.descriptor_path)
+        if desc and desc.get("url"):
+            self.url = desc["url"]
+        return self.url
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        try:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+        except Exception:
+            pass
+        self._log.close()
+
+    def close(self) -> None:
+        try:
+            self.proc.terminate()
+            self.proc.wait(timeout=10)
+        except Exception:
+            self.proc.kill()
+        self._log.close()
+
+
+class ReplicaRecord:
+    """One replica's scheduling view (state in ``RECORD_STATES``) plus
+    the counters the router and /metrics read. ``inflight`` is
+    maintained by the ROUTER under the set's lock — the replica itself
+    never sees it."""
+
+    def __init__(self, replica_id: str):
+        self.id = replica_id
+        self.handle = None
+        self.url: Optional[str] = None
+        self.state = "starting"
+        self.inflight = 0
+        self.restarts = 0          # relaunches consumed (crash budget)
+        self.health_fails = 0      # consecutive failed health polls
+        self.not_before = 0.0      # monotonic gate for backoff relaunch
+        self.started_at = 0.0
+        self.loaded_step: Optional[int] = None
+        self.sessions = 0
+
+    def row(self) -> dict:
+        return {
+            "state": self.state,
+            "url": self.url,
+            "inflight": self.inflight,
+            "restarts": self.restarts,
+            "loaded_step": self.loaded_step,
+            "sessions": self.sessions,
+        }
+
+
+class ReplicaSet:
+    """Launch, supervise, and restart N serving replicas.
+
+    ``launcher(replica_id)`` builds one replica handle
+    (:class:`InProcessReplica` / :class:`SubprocessReplica`); it is
+    called again — with the same id — for every restart. Thread-safe:
+    the router reads rotation state and bumps inflight under
+    ``self.lock``; the supervisor mutates lifecycle state under the
+    same lock and emits events outside it.
+    """
+
+    def __init__(
+        self,
+        launcher: Callable[[str], object],
+        n_replicas: int,
+        health_interval: float = 0.5,
+        health_timeout: float = 2.0,
+        health_fail_threshold: int = 2,
+        max_restarts: int = 3,
+        backoff: float = 0.5,
+        backoff_cap: float = 30.0,
+        start_timeout: float = 120.0,
+        bus=None,
+    ):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if health_interval <= 0:
+            raise ValueError(
+                f"health_interval must be > 0, got {health_interval}"
+            )
+        if max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0, got {max_restarts}"
+            )
+        if backoff < 0 or backoff_cap < backoff:
+            raise ValueError(
+                f"need 0 <= backoff <= backoff_cap, got "
+                f"{backoff}/{backoff_cap}"
+            )
+        self.launcher = launcher
+        self.health_interval = float(health_interval)
+        self.health_timeout = float(health_timeout)
+        self.health_fail_threshold = int(health_fail_threshold)
+        self.max_restarts = int(max_restarts)
+        self.backoff = float(backoff)
+        self.backoff_cap = float(backoff_cap)
+        self.start_timeout = float(start_timeout)
+        self.bus = bus
+        self.lock = threading.Lock()
+        self.replicas: Dict[str, ReplicaRecord] = {
+            f"r{i}": ReplicaRecord(f"r{i}") for i in range(n_replicas)
+        }
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        for rec in self.replicas.values():
+            self._launch(rec)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _emit(self, replica_id: str, state: str, **extra) -> None:
+        if self.bus is None:
+            return
+        try:
+            self.bus.emit(
+                "router", scope="replica", replica=replica_id,
+                state=state, **extra,
+            )
+        except Exception:  # a closed bus must never break supervision
+            pass
+
+    def _launch(self, rec: ReplicaRecord) -> None:
+        rec.handle = self.launcher(rec.id)
+        rec.url = getattr(rec.handle, "url", None)
+        rec.state = "starting"
+        rec.health_fails = 0
+        rec.started_at = time.monotonic()
+        self._emit(rec.id, "started", attempt=rec.restarts + 1)
+
+    def start(self) -> None:
+        """Run the supervisor thread (the constructor already launched
+        the replicas; tests that drive ticks by hand skip this)."""
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="replica-supervisor", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.health_interval):
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover — must never die
+                pass
+
+    # -- supervision -------------------------------------------------------
+
+    def _healthz(self, url: str) -> Optional[dict]:
+        try:
+            with urllib.request.urlopen(
+                url + "/healthz", timeout=self.health_timeout
+            ) as r:
+                return json.load(r)
+        except urllib.error.HTTPError as e:
+            # an HTTP answer IS liveness: a 503 (no checkpoint yet)
+            # replica is starting, not dead
+            try:
+                return json.loads(e.read())
+            except Exception:
+                return {"ok": False}
+        except Exception:
+            return None
+
+    def tick(self) -> None:
+        """One supervision pass over every replica (called by the
+        supervisor thread; callable directly for deterministic tests)."""
+        now = time.monotonic()
+        for rec in list(self.replicas.values()):
+            with self.lock:
+                state = rec.state
+                handle, url = rec.handle, rec.url
+            if state == "failed":
+                continue
+            if state == "evicted":
+                if now >= rec.not_before:
+                    self._relaunch(rec)
+                continue
+            if url is None:  # subprocess still binding: discover
+                url = getattr(handle, "discover", lambda: None)()
+                if url is not None:
+                    with self.lock:
+                        rec.url = url
+                elif (
+                    not handle.alive()
+                    or now - rec.started_at > self.start_timeout
+                ):
+                    self._mark_died(rec, reason="never became reachable")
+                continue
+            health = self._healthz(url)
+            if health is None:
+                alive = handle.alive() if handle is not None else False
+                rec.health_fails += 1
+                if (
+                    not alive
+                    or rec.health_fails >= self.health_fail_threshold
+                ):
+                    self._mark_died(
+                        rec,
+                        reason=(
+                            "process exited" if not alive
+                            else f"{rec.health_fails} failed health polls"
+                        ),
+                    )
+                continue
+            rec.health_fails = 0
+            rec.loaded_step = health.get("step")
+            rec.sessions = int(health.get("sessions") or 0)
+            if not health.get("ok"):
+                # answering but no snapshot yet: keep out of rotation
+                # without burning the crash budget (a replica waiting
+                # for its first checkpoint is starting, not dying)
+                continue
+            new_state = (
+                "reloading" if health.get("reloading") else "healthy"
+            )
+            with self.lock:
+                # guard the flip: the unlocked healthz poll above takes
+                # up to health_timeout, during which the router may have
+                # observed a death (report_failure -> evicted/failed) —
+                # a stale "it answered me" must never resurrect a dead
+                # replica or cancel its scheduled relaunch
+                changed = (
+                    rec.state in ("starting", "healthy", "reloading")
+                    and rec.state != new_state
+                )
+                if changed:
+                    rec.state = new_state
+            if changed and new_state in ("healthy", "reloading"):
+                self._emit(rec.id, new_state)
+
+    def _mark_died(self, rec: ReplicaRecord, reason: str) -> None:
+        """died → evicted (out of rotation NOW) → backoff relaunch, or
+        ``failed`` once the crash budget is burned."""
+        with self.lock:
+            if rec.state in ("evicted", "failed"):
+                return  # already resolved (e.g. router reported first)
+            rec.state = "evicted"
+        self._emit(rec.id, "died", reason=reason)
+        try:
+            rec.handle.kill()  # reap a half-dead process/socket
+        except Exception:
+            pass
+        if rec.restarts >= self.max_restarts:
+            with self.lock:
+                rec.state = "failed"
+            self._emit(
+                rec.id, "evicted", reason=reason,
+            )
+            self._emit(
+                rec.id, "failed",
+                reason=f"crash budget exhausted ({self.max_restarts})",
+            )
+            return
+        delay = min(
+            self.backoff * (2 ** rec.restarts), self.backoff_cap
+        )
+        rec.not_before = time.monotonic() + delay
+        self._emit(rec.id, "evicted", reason=reason, backoff_s=delay)
+
+    def _relaunch(self, rec: ReplicaRecord) -> None:
+        """Backoff elapsed: burn one crash-budget unit and relaunch.
+        Only the state flip holds the lock — the launch itself (process
+        spawn / AOT compile) must not stall the router's pick()."""
+        with self.lock:
+            if rec.state != "evicted":
+                return
+            rec.restarts += 1
+            rec.state = "starting"
+            rec.url = None
+        self._emit(rec.id, "restarted", attempt=rec.restarts + 1)
+        try:
+            handle = self.launcher(rec.id)
+        except Exception:
+            # a failed relaunch burns the budget exactly like a death:
+            # a persistently-unlaunchable replica (port exhaustion, bad
+            # argv) must reach `failed`, not loop restarted/evicted
+            # forever
+            if rec.restarts >= self.max_restarts:
+                with self.lock:
+                    rec.state = "failed"
+                self._emit(
+                    rec.id, "failed",
+                    reason=(
+                        "crash budget exhausted "
+                        f"({self.max_restarts}) — relaunch raised"
+                    ),
+                )
+                return
+            with self.lock:
+                rec.state = "evicted"
+                rec.not_before = time.monotonic() + min(
+                    self.backoff * (2 ** rec.restarts), self.backoff_cap
+                )
+            return
+        with self.lock:
+            rec.handle = handle
+            rec.url = getattr(handle, "url", None)
+            rec.health_fails = 0
+            rec.started_at = time.monotonic()
+
+    def report_failure(self, replica_id: str) -> None:
+        """The router observed a transport-level failure mid-request:
+        evict NOW instead of waiting for the next poll tick (the router
+        already retried the request elsewhere)."""
+        rec = self.replicas.get(replica_id)
+        if rec is None:
+            return
+        with self.lock:
+            if rec.state in ("evicted", "failed", "starting"):
+                return
+        self._mark_died(rec, reason="router observed transport failure")
+
+    # -- the router's view -------------------------------------------------
+
+    def in_rotation(self) -> List[ReplicaRecord]:
+        """Replicas the router may dispatch to, preference-ordered:
+        healthy first; reloading replicas only when NO healthy one
+        exists (the snapshot swap is atomic, so serving through a
+        reload is degraded, not wrong)."""
+        with self.lock:
+            healthy = [
+                r for r in self.replicas.values() if r.state == "healthy"
+            ]
+            if healthy:
+                return healthy
+            return [
+                r for r in self.replicas.values()
+                if r.state == "reloading"
+            ]
+
+    def get(self, replica_id: str) -> Optional[ReplicaRecord]:
+        return self.replicas.get(replica_id)
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {
+                "replicas": {
+                    rid: rec.row()
+                    for rid, rec in sorted(self.replicas.items())
+                },
+                "healthy": sum(
+                    1 for r in self.replicas.values()
+                    if r.state == "healthy"
+                ),
+                "size": len(self.replicas),
+            }
+
+    def wait_healthy(
+        self, n: Optional[int] = None, timeout: float = 120.0
+    ) -> bool:
+        """Block until ``n`` (default: all non-failed) replicas are
+        healthy — startup convenience for the CLI and the smokes."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                healthy = sum(
+                    1 for r in self.replicas.values()
+                    if r.state == "healthy"
+                )
+                want = n if n is not None else sum(
+                    1 for r in self.replicas.values()
+                    if r.state != "failed"
+                )
+            if want and healthy >= want:
+                return True
+            self.tick() if self._thread is None else time.sleep(0.05)
+        return False
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        for rec in self.replicas.values():
+            if rec.handle is not None:
+                try:
+                    rec.handle.close()
+                except Exception:
+                    pass

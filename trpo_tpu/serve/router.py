@@ -1,0 +1,649 @@
+"""Routing front end: one ``POST /act`` contract over N replicas.
+
+The client-facing half of the replicated control plane
+(``serve/replicaset.py`` is the supervision half). One
+:class:`Router` owns the public port and dispatches to whichever
+replicas are in rotation:
+
+* **Least-queue-depth dispatch** — the router is the only client of
+  its replicas, so the truthful queue depth is the router's own
+  in-flight counter per replica: pick the healthy replica with the
+  fewest outstanding requests (ties break by id, deterministically).
+  Reloading replicas are used only when no healthy one exists
+  (``ReplicaSet.in_rotation`` — the snapshot swap is atomic, serving
+  through a reload is degraded, not wrong).
+* **One transparent retry** — a TRANSPORT-level failure (connection
+  refused/reset, a replica dying mid-request) reports the replica to
+  the supervisor (immediate eviction, no poll-tick wait) and retries
+  the request ONCE on a different replica; ``/act`` is a pure function
+  of the snapshot, so the retry can never double-apply anything. An
+  HTTP-level answer (400, 409, 404, even 500) is passed through
+  untouched — the replica is alive and already answered; retrying a
+  400 elsewhere would just burn a second replica's time.
+* **503 backpressure only when ALL replicas are saturated** — each
+  replica carries at most ``max_inflight`` router-outstanding
+  requests; a request finding every in-rotation replica at its bound
+  (or rotation empty) answers 503 with ``Retry-After``, so a traffic
+  spike turns into client-visible backpressure instead of unbounded
+  queueing — the MicroBatcher/StatsDrain bound-not-buffer policy one
+  level up.
+* **Session affinity** (recurrent policies) — ``POST /session`` mints
+  the id HERE (the router must own it to re-establish), registers it
+  on the least-loaded replica, and pins the session to that replica;
+  ``POST /session/<id>/act`` follows the pin. When the pinned replica
+  dies, the next session act RE-ESTABLISHES the session on a healthy
+  replica from a FRESH carry (the old carry died with the replica —
+  recurrent state is lossy under replica failure by design; the
+  response carries ``"reestablished": true`` and a ``session`` event
+  records it) instead of failing the client.
+* ``GET /status`` (JSON) + ``GET /metrics`` (Prometheus
+  ``trpo_router_*``: per-replica state one-hot over the record states,
+  routed/retried/failed/backpressure counters, windowed p50/p99,
+  replica-set size/healthy gauges, session counters) aggregate the
+  whole set behind one scrape target.
+
+Every client request emits a ``router`` ``scope="request"`` event
+(end-to-end ms, ok, retried, replica) on the bus; ``obs/analyze.py``
+folds them into the per-replica table, p50/p99, routed actions/s and
+the scaling row that ``analyze_run.py --compare`` judges.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import threading
+import time
+import urllib.parse
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+# ONE escaping/formatting implementation for all endpoints (the PR 7
+# review contract): obs/server.py owns it
+from trpo_tpu.obs.server import _esc, _fmt
+
+__all__ = ["Router"]
+
+_JSON = "application/json"
+
+
+def _body(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+class _Affinity:
+    __slots__ = ("replica", "last_used")
+
+    def __init__(self, replica: str, now: float):
+        self.replica = replica
+        self.last_used = now
+
+
+class Router:
+    """HTTP front end dispatching over a :class:`ReplicaSet`.
+
+    ``replicaset`` must already be constructed (and usually
+    ``start()``-ed); the router does not own its lifecycle — callers
+    close the router first, then the set (so a draining request can
+    still reach its replica).
+    """
+
+    ENDPOINTS = (
+        "/act", "/session", "/healthz", "/status", "/metrics",
+    )
+
+    def __init__(
+        self,
+        replicaset,
+        port: int,
+        host: str = "127.0.0.1",
+        max_inflight: int = 64,
+        act_timeout_s: float = 30.0,
+        session_ttl_s: float = 300.0,
+        max_sessions: int = 4096,
+        bus=None,
+        latency_window: int = 4096,
+    ):
+        if max_inflight < 1:
+            raise ValueError(
+                f"max_inflight must be >= 1, got {max_inflight}"
+            )
+        self.replicaset = replicaset
+        self.max_inflight = int(max_inflight)
+        self.act_timeout_s = float(act_timeout_s)
+        self.session_ttl_s = float(session_ttl_s)
+        self.max_sessions = int(max_sessions)
+        self.bus = bus
+
+        self.routed_total = 0       # requests answered via a replica
+        self.retried_total = 0      # transparent transport retries taken
+        self.failed_total = 0       # requests failed after the retry
+        self.backpressure_total = 0  # 503s for saturation/empty rotation
+        self.sessions_created_total = 0
+        self.sessions_reestablished_total = 0
+        self._lock = threading.Lock()
+        self._affinity: Dict[str, _Affinity] = {}
+        self._lat_lock = threading.Lock()
+        self._latencies_ms: deque = deque(maxlen=latency_window)
+        self._tls = threading.local()  # per-thread replica conn pool
+
+        from trpo_tpu.utils.httpd import BackgroundHTTPServer
+
+        self._httpd = BackgroundHTTPServer(
+            port,
+            host=host,
+            get={
+                "/healthz": self._healthz,
+                "/status": self._status,
+                "/metrics": self._metrics,
+            },
+            post={
+                "/act": self._act,
+                "/session": self._session_create,
+            },
+            post_prefix={"/session/": self._session_act},
+            not_found=(
+                "have POST /act, POST /session, POST /session/<id>/act, "
+                "GET /healthz, GET /status, GET /metrics"
+            ),
+            thread_name="router-http",
+        )
+        self.host = host
+        self.port = self._httpd.port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- dispatch core -----------------------------------------------------
+
+    def _pick(self, exclude=()) -> Optional[str]:
+        """Least-inflight healthy replica id under ``max_inflight``, or
+        None (saturated / empty rotation). Bumps the winner's inflight
+        under the set's lock — the reservation IS the queue-depth
+        signal."""
+        rotation = self.replicaset.in_rotation()
+        with self.replicaset.lock:
+            candidates = [
+                r for r in rotation
+                if r.id not in exclude and r.inflight < self.max_inflight
+            ]
+            if not candidates:
+                return None
+            best = min(candidates, key=lambda r: (r.inflight, r.id))
+            best.inflight += 1
+            return best.id
+
+    def _release(self, replica_id: str) -> None:
+        rec = self.replicaset.get(replica_id)
+        if rec is None:
+            return
+        with self.replicaset.lock:
+            rec.inflight = max(0, rec.inflight - 1)
+
+    def _conn(self, replica_id: str, netloc: str):
+        """A pooled keep-alive connection to the replica, one per
+        (handler thread, replica, address). Per-request connection
+        setup — TCP handshake plus the replica spawning a handler
+        thread per CONNECTION — costs more than a small model's
+        inference; the pool amortizes both, and a replica restart (new
+        port = new netloc) naturally misses the pool and dials fresh."""
+        pool = getattr(self._tls, "conns", None)
+        if pool is None:
+            pool = self._tls.conns = {}
+        key = (replica_id, netloc)
+        conn = pool.get(key)
+        if conn is None:
+            # a restarted replica has a NEW netloc: drop this thread's
+            # stale entries for the same replica, or fds to dead
+            # addresses accumulate one per restart under crash churn
+            for old in [
+                k for k in pool if k[0] == replica_id and k != key
+            ]:
+                stale = pool.pop(old)
+                try:
+                    stale.close()
+                except Exception:
+                    pass
+            conn = http.client.HTTPConnection(
+                netloc, timeout=self.act_timeout_s
+            )
+            # TCP_NODELAY on the OUTGOING half too: http.client sends
+            # headers and body as two segments, and Nagle holding the
+            # body for the peer's delayed ACK adds ~40 ms to a
+            # millisecond-scale forward (the server side already
+            # disables it — utils/httpd)
+            conn.connect()
+            conn.sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+            )
+            pool[key] = conn
+        return key, conn
+
+    def _forward(
+        self, replica_id: str, path: str, body: bytes
+    ) -> Tuple[int, bytes]:
+        """POST ``body`` to the replica; returns ``(status, body)`` for
+        HTTP-level answers (including error statuses) and raises OSError
+        subclasses for transport-level failures."""
+        rec = self.replicaset.get(replica_id)
+        url = rec.url if rec is not None else None
+        if url is None:
+            raise ConnectionError(f"replica {replica_id} has no URL")
+        netloc = urllib.parse.urlsplit(url).netloc
+        key, conn = self._conn(replica_id, netloc)
+        try:
+            conn.request(
+                "POST", path, body=body,
+                headers={"Content-Type": _JSON},
+            )
+            resp = conn.getresponse()
+            payload = resp.read()
+            return resp.status, payload
+        except Exception:
+            # transport failure OR a stale pooled connection: drop it so
+            # the retry (and every later request) dials fresh
+            self._tls.conns.pop(key, None)
+            try:
+                conn.close()
+            except Exception:
+                pass
+            raise
+
+    def _emit_request(
+        self, ms: float, ok: bool, retried: bool,
+        replica: Optional[str], endpoint: str,
+    ) -> None:
+        if self.bus is None:
+            return
+        try:
+            self.bus.emit(
+                "router", scope="request", ms=ms, ok=ok,
+                retried=retried, replica=replica, endpoint=endpoint,
+            )
+        except Exception:
+            pass
+
+    def _dispatch(self, path: str, body: bytes, endpoint: str,
+                  pinned: Optional[str] = None):
+        """The routed request core: pick (or follow the pin), forward,
+        retry ONCE on transport failure, account, emit. Returns the
+        upstream ``(status, ctype, body)`` plus the replica that finally
+        answered (None = never reached one) and whether the retry was
+        taken — session handling needs both."""
+        t0 = time.perf_counter()
+        retried = False
+        tried = []
+        lost_rid = None  # a replica we reached and lost mid-request
+        for attempt in (0, 1):
+            if pinned is not None and attempt == 0:
+                rid = pinned
+                rec = self.replicaset.get(rid)
+                with self.replicaset.lock:
+                    pinned_ok = (
+                        rec is not None
+                        and rec.state in ("healthy", "reloading")
+                    )
+                    if pinned_ok:
+                        rec.inflight += 1
+                if not pinned_ok:
+                    # the pin's replica left rotation: the caller
+                    # (session path) re-establishes; plain /act never pins
+                    return None, None, retried
+            else:
+                rid = self._pick(exclude=tried)
+                if rid is None:
+                    break
+                if lost_rid is not None:
+                    # the retry is COUNTED only once it actually has a
+                    # second replica to go to — a single-replica death
+                    # is a failure, not a phantom retry
+                    with self._lock:
+                        self.retried_total += 1
+                    retried = True
+            tried.append(rid)
+            try:
+                status, payload = self._forward(rid, path, body)
+            except Exception:
+                # transport failure: the replica died under us — tell
+                # the supervisor (immediate eviction) and retry once
+                self._release(rid)
+                self.replicaset.report_failure(rid)
+                lost_rid = rid
+                if attempt == 0 and pinned is None:
+                    continue
+                return None, rid, retried
+            self._release(rid)
+            ms = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                self.routed_total += 1
+            with self._lat_lock:
+                self._latencies_ms.append(ms)
+            self._emit_request(ms, True, retried, rid, endpoint)
+            return (status, _JSON, payload), rid, retried
+        # no replica left to try: a reached-and-lost replica makes this
+        # a FAILURE (lost_rid propagates so _unrouted counts it as one);
+        # otherwise it is backpressure (saturated / empty rotation)
+        return None, lost_rid, retried
+
+    # -- handlers ----------------------------------------------------------
+
+    def _act(self, body: bytes):
+        result, rid, retried = self._dispatch(body=body, path="/act",
+                                              endpoint="act")
+        if result is not None:
+            return result
+        return self._unrouted(rid, retried, "act")
+
+    def _unrouted(self, rid, retried: bool, endpoint: str):
+        """No replica answered: 502 when we reached-and-lost replicas
+        (both attempts died), 503 backpressure otherwise."""
+        with self._lock:
+            if rid is not None:
+                self.failed_total += 1
+            else:
+                self.backpressure_total += 1
+        self._emit_request(0.0, False, retried, rid, endpoint)
+        if rid is not None:
+            return 502, _JSON, _body(
+                {"error": "replica died mid-request and the retry "
+                          "failed or had no replica to go to"}
+            )
+        snap = self.replicaset.snapshot()
+        saturated = snap["healthy"] > 0
+        return 503, _JSON, _body(
+            {
+                "error": (
+                    "all replicas saturated (backpressure) — retry"
+                    if saturated
+                    else "no replicas in rotation"
+                ),
+                "healthy": snap["healthy"],
+                "replicas": snap["size"],
+            }
+        )
+
+    # -- sessions ----------------------------------------------------------
+
+    def _session_create(self, body: bytes):
+        sid = None
+        if body:
+            try:
+                payload = json.loads(body)
+            except ValueError as e:
+                return 400, _JSON, _body(
+                    {"error": f"body must be empty or a JSON object ({e})"}
+                )
+            if not isinstance(payload, dict):
+                return 400, _JSON, _body(
+                    {"error": "body must be empty or a JSON object"}
+                )
+            if payload.get("session_id") is not None:
+                return 400, _JSON, _body(
+                    {"error": "the router mints session ids — POST "
+                              "an empty body"}
+                )
+        from trpo_tpu.serve.session import mint_session_id
+
+        # capacity check BEFORE the replica hop: a create the router is
+        # going to refuse must not leak a replica-side session (there is
+        # no delete endpoint) or LRU-evict another client's LIVE session
+        # out of the replica's bounded store. Concurrent creates may
+        # overshoot the bound by the in-flight count — bounded, and far
+        # better than the leak.
+        now = time.monotonic()
+        with self._lock:
+            self._expire_affinity_locked(now)
+            if len(self._affinity) >= self.max_sessions:
+                return 503, _JSON, _body(
+                    {"error": "session table full — retry later"}
+                )
+        sid = mint_session_id()
+        result, rid, _retried = self._dispatch(
+            body=_body({"session_id": sid}), path="/session",
+            endpoint="session",
+        )
+        if result is None:
+            return self._unrouted(rid, False, "session")
+        status, ctype, payload = result
+        if status != 200:
+            return status, ctype, payload  # 409 wrong_protocol, 503, …
+        with self._lock:
+            self._affinity[sid] = _Affinity(rid, time.monotonic())
+            self.sessions_created_total += 1
+        out = json.loads(payload)
+        out["replica"] = rid
+        return 200, _JSON, _body(out)
+
+    def _expire_affinity_locked(self, now: float) -> None:
+        # lazy TTL sweep of the affinity table (the replica-side store
+        # is the authoritative TTL; this just stops the table growing
+        # without bound when clients abandon sessions)
+        if len(self._affinity) < self.max_sessions:
+            return
+        for sid, aff in list(self._affinity.items()):
+            if now - aff.last_used > self.session_ttl_s:
+                del self._affinity[sid]
+
+    def _session_act(self, path: str, body: bytes):
+        parts = path.strip("/").split("/")
+        if len(parts) != 3 or parts[0] != "session" or parts[2] != "act":
+            return 404, _JSON, _body(
+                {"error": "unknown session path; have POST "
+                          "/session/<id>/act"}
+            )
+        sid = parts[1]
+        with self._lock:
+            aff = self._affinity.get(sid)
+        if aff is None:
+            return 404, _JSON, _body(
+                {
+                    "error": (
+                        f"unknown session {sid!r} — mint one with "
+                        "POST /session"
+                    ),
+                    "code": "session_unknown",
+                }
+            )
+        reestablished = False
+        result, rid, retried = self._dispatch(
+            body=body, path=f"/session/{sid}/act",
+            endpoint="session_act", pinned=aff.replica,
+        )
+        if result is None:
+            # the pinned replica is gone (left rotation, or died on the
+            # forward): re-establish the session — FRESH carry — on a
+            # healthy replica, then act there
+            reestablished = True
+            result, rid, _ = self._dispatch(
+                body=_body({"session_id": sid}), path="/session",
+                endpoint="session",
+            )
+            if result is None or result[0] != 200:
+                if result is not None:
+                    return result
+                return self._unrouted(rid, retried, "session_act")
+            with self._lock:
+                self._affinity[sid] = _Affinity(rid, time.monotonic())
+                self.sessions_reestablished_total += 1
+            if self.bus is not None:
+                try:
+                    self.bus.emit(
+                        "session", session=sid, event="reestablished",
+                        replica=rid,
+                    )
+                except Exception:
+                    pass
+            result, rid, _ = self._dispatch(
+                body=body, path=f"/session/{sid}/act",
+                endpoint="session_act", pinned=rid,
+            )
+            if result is None:
+                return self._unrouted(rid, True, "session_act")
+        status, ctype, payload = result
+        aff.last_used = time.monotonic()
+        if status != 200 or not reestablished:
+            return status, ctype, payload
+        out = json.loads(payload)
+        out["reestablished"] = True
+        return status, _JSON, _body(out)
+
+    # -- introspection -----------------------------------------------------
+
+    def _healthz(self):
+        snap = self.replicaset.snapshot()
+        ok = snap["healthy"] > 0 or any(
+            r["state"] == "reloading"
+            for r in snap["replicas"].values()
+        )
+        return (200 if ok else 503), _JSON, _body(
+            {"ok": ok, "healthy": snap["healthy"],
+             "replicas": snap["size"]}
+        )
+
+    def _status(self):
+        snap = self.replicaset.snapshot()
+        with self._lock:
+            counters = {
+                "routed_total": self.routed_total,
+                "retried_total": self.retried_total,
+                "failed_total": self.failed_total,
+                "backpressure_total": self.backpressure_total,
+                "sessions": len(self._affinity),
+                "sessions_created_total": self.sessions_created_total,
+                "sessions_reestablished_total":
+                    self.sessions_reestablished_total,
+            }
+        q = self.latency_quantiles_ms((0.5, 0.99))
+        return 200, _JSON, _body(
+            {
+                "replicas": snap["replicas"],
+                "healthy": snap["healthy"],
+                "size": snap["size"],
+                "counters": counters,
+                "latency_ms": {str(k): v for k, v in q.items()},
+            }
+        )
+
+    def latency_quantiles_ms(self, qs=(0.5, 0.99)) -> dict:
+        from trpo_tpu.utils.metrics import quantile_nearest_rank
+
+        with self._lat_lock:
+            lats = list(self._latencies_ms)
+        if not lats:
+            return {}
+        return {q: quantile_nearest_rank(lats, q) for q in qs}
+
+    def _metrics(self):
+        from trpo_tpu.serve.replicaset import RECORD_STATES
+
+        snap = self.replicaset.snapshot()
+        lines = []
+
+        def fam(name, mtype, help_, samples):
+            rows = []
+            for labels, value in samples:
+                if isinstance(value, bool):
+                    value = float(value)
+                if not isinstance(value, (int, float)):
+                    continue
+                lbl = ",".join(
+                    f'{k}="{_esc(v)}"' for k, v in labels.items()
+                )
+                rows.append(
+                    f"{name}{{{lbl}}} {_fmt(float(value))}"
+                    if lbl else f"{name} {_fmt(float(value))}"
+                )
+            if rows:
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} {mtype}")
+                lines.extend(rows)
+
+        replicas = snap["replicas"]
+        fam(
+            "trpo_router_replicas", "gauge",
+            "replica-set size", [({}, snap["size"])],
+        )
+        fam(
+            "trpo_router_replicas_healthy", "gauge",
+            "replicas currently healthy", [({}, snap["healthy"])],
+        )
+        fam(
+            "trpo_router_replica_state", "gauge",
+            "replica rotation state (one-hot over record states)",
+            [
+                ({"replica": rid, "state": s},
+                 1.0 if row["state"] == s else 0.0)
+                for rid, row in sorted(replicas.items())
+                for s in RECORD_STATES
+            ],
+        )
+        fam(
+            "trpo_router_replica_inflight", "gauge",
+            "router-outstanding requests per replica",
+            [
+                ({"replica": rid}, row["inflight"])
+                for rid, row in sorted(replicas.items())
+            ],
+        )
+        fam(
+            "trpo_router_replica_restarts", "counter",
+            "relaunches consumed per replica (crash budget)",
+            [
+                ({"replica": rid}, row["restarts"])
+                for rid, row in sorted(replicas.items())
+            ],
+        )
+        fam(
+            "trpo_router_replica_checkpoint_step", "gauge",
+            "checkpoint step each replica currently serves",
+            [
+                ({"replica": rid}, row["loaded_step"])
+                for rid, row in sorted(replicas.items())
+                if row["loaded_step"] is not None
+            ],
+        )
+        with self._lock:
+            counter_rows = [
+                ("trpo_router_routed_total",
+                 "requests answered via a replica", self.routed_total),
+                ("trpo_router_retried_total",
+                 "transparent one-shot transport retries",
+                 self.retried_total),
+                ("trpo_router_failed_total",
+                 "requests failed after the retry", self.failed_total),
+                ("trpo_router_backpressure_total",
+                 "503s for saturation or empty rotation",
+                 self.backpressure_total),
+                ("trpo_router_sessions_created_total",
+                 "sessions minted through the router",
+                 self.sessions_created_total),
+                ("trpo_router_sessions_reestablished_total",
+                 "sessions re-established after replica death",
+                 self.sessions_reestablished_total),
+            ]
+            sessions_live = len(self._affinity)
+        for name, help_, value in counter_rows:
+            fam(name, "counter", help_, [({}, value)])
+        fam(
+            "trpo_router_sessions_active", "gauge",
+            "sessions with live affinity", [({}, sessions_live)],
+        )
+        fam(
+            "trpo_router_latency_ms", "gauge",
+            "routed-request latency quantiles over the recent window",
+            [
+                ({"quantile": str(q)}, v)
+                for q, v in sorted(
+                    self.latency_quantiles_ms((0.5, 0.99)).items()
+                )
+            ],
+        )
+        body = ("\n".join(lines) + "\n").encode()
+        return 200, "text/plain; version=0.0.4; charset=utf-8", body
+
+    def close(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.close()

@@ -1,20 +1,36 @@
-"""HTTP front end for the serving tier: ``POST /act`` + hot-reload.
+"""HTTP front end for the serving tier: ``POST /act`` / the session
+protocol + hot-reload.
 
 ``obs/server.py`` proved the pattern for READING a run over stdlib HTTP
 (snapshot swap, daemon threads, silenced handlers); this module
-graduates it to a data plane. A :class:`PolicyServer` owns three routes
+graduates it to a data plane. A :class:`PolicyServer` owns the routes
 on a :class:`~trpo_tpu.utils.httpd.BackgroundHTTPServer`:
 
 * ``POST /act`` — ``{"obs": [...]}`` in, ``{"action": ..., "step": N}``
-  out. The handler thread submits to the micro-batcher and blocks on
-  its future (that block IS the coalescing window); malformed JSON or a
-  wrong obs shape is a 400, serving before any checkpoint loaded is a
-  503, an engine failure is a 500 — each scoped to that one request.
-* ``GET /healthz`` — liveness + the loaded checkpoint step (a smoke
-  test polls this to observe a hot reload landing).
+  out (feedforward engines). The handler thread submits to the
+  micro-batcher and blocks on its future (that block IS the coalescing
+  window); malformed JSON or a wrong obs shape is a 400, serving before
+  any checkpoint loaded is a 503, an engine failure is a 500 — each
+  scoped to that one request.
+* ``POST /session`` + ``POST /session/<id>/act`` — the recurrent
+  protocol (ISSUE 9): mint a session (server-side carry in a bounded
+  TTL :class:`~trpo_tpu.serve.session.SessionStore`), then step it by
+  id; an unknown/expired session is a typed 404
+  (``code="session_unknown"``), never a KeyError 500.
+* **Structured protocol refusal** (ISSUE 9 satellite): a stateless
+  ``/act`` against a recurrent engine — and a session call against a
+  feedforward one — answers a typed 409 JSON error naming the CORRECT
+  endpoint (``code="wrong_protocol"``, ``endpoint="/session"`` or
+  ``"/act"``), instead of an engine-construction failure surfacing as
+  a 500. The model family is a property of the checkpoint, not the
+  client; the client is told where to go.
+* ``GET /healthz`` — liveness + the loaded checkpoint step, the model
+  family (``recurrent``), the live session count, and ``reloading``
+  (True while a hot reload is restoring — the replica supervisor takes
+  a reloading replica out of rotation until it lands).
 * ``GET /metrics`` — Prometheus ``trpo_serve_*``: request/batch/error
   counters, queue depth, per-rung dispatch counts, p50/p99 latency over
-  the recent window, loaded step and reload count.
+  the recent window, loaded step and reload count, session gauges.
 
 Hot-reload: a background watcher polls ``Checkpointer.latest_step()``
 every ``poll_interval`` seconds. The step gate is marker-based
@@ -59,9 +75,17 @@ class PolicyServer:
     ``(policy_params, obs_norm)`` pair the engine loads (default: the
     obvious field extraction). ``checkpointer``/``template`` may be
     ``None`` for a pre-loaded engine (no hot reload — tests, benches).
+
+    ``engine`` may be the stateless
+    :class:`~trpo_tpu.serve.engine.InferenceEngine` (``batcher``
+    required; ``/act`` active) or a
+    :class:`~trpo_tpu.serve.session.RecurrentServeEngine` (``batcher``
+    must be ``None`` — session steps are per-session batch-1, carry
+    threading has nothing to coalesce; the session routes are active
+    and ``/act`` answers the typed 409).
     """
 
-    ENDPOINTS = ("/act", "/healthz", "/metrics")
+    ENDPOINTS = ("/act", "/session", "/healthz", "/metrics")
 
     def __init__(
         self,
@@ -75,6 +99,9 @@ class PolicyServer:
         poll_interval: float = 1.0,
         bus=None,
         act_timeout_s: float = 30.0,
+        session_ttl_s: float = 300.0,
+        max_sessions: int = 1024,
+        replica_name: Optional[str] = None,
     ):
         if (checkpointer is None) != (template is None):
             raise ValueError(
@@ -84,6 +111,16 @@ class PolicyServer:
         if poll_interval <= 0:
             raise ValueError(
                 f"poll_interval must be > 0, got {poll_interval}"
+            )
+        self.is_recurrent = bool(getattr(engine, "is_recurrent", False))
+        if self.is_recurrent and batcher is not None:
+            raise ValueError(
+                "a recurrent engine takes no micro-batcher: session "
+                "steps are per-session batch-1 (pass batcher=None)"
+            )
+        if not self.is_recurrent and batcher is None:
+            raise ValueError(
+                "a feedforward engine needs a MicroBatcher on /act"
             )
         self.engine = engine
         self.batcher = batcher
@@ -96,8 +133,22 @@ class PolicyServer:
         self.poll_interval = float(poll_interval)
         self.act_timeout_s = float(act_timeout_s)
         self.reloads_total = 0
+        self.session_acts_total = 0
+        self.session_act_errors_total = 0
+        self._counter_lock = threading.Lock()
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
+        self._reloading = False  # True while a restore+load is in flight
+        self.sessions = None
+        if self.is_recurrent:
+            from trpo_tpu.serve.session import SessionStore
+
+            self.sessions = SessionStore(
+                ttl_s=session_ttl_s,
+                max_sessions=max_sessions,
+                bus=bus,
+                replica=replica_name,
+            )
 
         if checkpointer is not None:
             # synchronous first load: a server that answers 503 for a
@@ -116,8 +167,12 @@ class PolicyServer:
             port,
             host=host,
             get={"/healthz": self._healthz, "/metrics": self._metrics},
-            post={"/act": self._act},
-            not_found="have POST /act, GET /healthz, GET /metrics",
+            post={"/act": self._act, "/session": self._session_create},
+            post_prefix={"/session/": self._session_act},
+            not_found=(
+                "have POST /act, POST /session, POST /session/<id>/act, "
+                "GET /healthz, GET /metrics"
+            ),
             thread_name="serve-http",
         )
         self.host = host
@@ -137,6 +192,12 @@ class PolicyServer:
         if step is None or step == self.engine.loaded_step:
             return
         try:
+            # the reloading window is visible in /healthz so a replica
+            # supervisor (serve/replicaset.py) can take this replica out
+            # of rotation while the restore is in flight — the snapshot
+            # swap itself is atomic, but the restore's disk/compile work
+            # competes with the request path for the same cores
+            self._reloading = True
             # prune=False: a reader must never delete a save the live
             # trainer is mid-write on (to us it looks exactly like a torn
             # one); we only ever load marker-gated complete steps
@@ -176,6 +237,8 @@ class PolicyServer:
                     data={"step": step},
                 )
             return
+        finally:
+            self._reloading = False
         self.reloads_total += 1
         if self.bus is not None:
             self.bus.emit(
@@ -196,6 +259,22 @@ class PolicyServer:
     # -- handlers ----------------------------------------------------------
 
     def _act(self, body: bytes):
+        if self.is_recurrent:
+            # structured refusal (ISSUE 9 satellite): the model family is
+            # a property of the checkpoint — tell the client where to go
+            # instead of letting a carry-less step 500
+            return 409, _JSON, _json_body(
+                {
+                    "error": (
+                        "this endpoint serves a RECURRENT policy: the "
+                        "stateless /act plane cannot thread its carry — "
+                        "mint a session with POST /session, then "
+                        "POST /session/<id>/act"
+                    ),
+                    "code": "wrong_protocol",
+                    "endpoint": "/session",
+                }
+            )
         if not self.engine.ready:
             return 503, _JSON, _json_body(
                 {"error": "no policy loaded yet (no complete checkpoint)"}
@@ -234,21 +313,146 @@ class PolicyServer:
             {"action": np.asarray(action).tolist(), "step": step}
         )
 
+    # -- session protocol (recurrent policies — ISSUE 9) -------------------
+
+    def _wrong_protocol_feedforward(self):
+        return 409, _JSON, _json_body(
+            {
+                "error": (
+                    "this endpoint serves a FEEDFORWARD policy: there "
+                    "is no carry to thread — use the stateless "
+                    "POST /act"
+                ),
+                "code": "wrong_protocol",
+                "endpoint": "/act",
+            }
+        )
+
+    def _session_create(self, body: bytes):
+        """Mint a session: fresh zero carry in the bounded store. An
+        optional ``{"session_id": ...}`` lets the ROUTER own the id (it
+        needs to, for affinity and dead-replica re-establishment);
+        direct clients just POST an empty body."""
+        if not self.is_recurrent:
+            return self._wrong_protocol_feedforward()
+        if not self.engine.ready:
+            return 503, _JSON, _json_body(
+                {"error": "no policy loaded yet (no complete checkpoint)"}
+            )
+        session_id = None
+        if body:
+            try:
+                payload = json.loads(body)
+                if not isinstance(payload, dict):
+                    raise ValueError("body must be a JSON object")
+                session_id = payload.get("session_id")
+                if session_id is not None and not isinstance(
+                    session_id, str
+                ):
+                    raise ValueError("session_id must be a string")
+            except ValueError as e:
+                return 400, _JSON, _json_body(
+                    {"error": f"body must be empty or JSON ({e})"}
+                )
+        sid = self.sessions.create(
+            self.engine.initial_carry(), session_id=session_id
+        )
+        return 200, _JSON, _json_body(
+            {"session": sid, "step": self.engine.loaded_step}
+        )
+
+    def _session_act(self, path: str, body: bytes):
+        """``POST /session/<id>/act`` — advance one session's carry by
+        one observation. The carry read-modify-write is serialized by
+        the session's own lock; different sessions never contend."""
+        if not self.is_recurrent:
+            return self._wrong_protocol_feedforward()
+        parts = path.strip("/").split("/")
+        if len(parts) != 3 or parts[0] != "session" or parts[2] != "act":
+            return 404, _JSON, _json_body(
+                {"error": "unknown session path; have POST "
+                          "/session/<id>/act"}
+            )
+        sid = parts[1]
+        if not self.engine.ready:
+            return 503, _JSON, _json_body(
+                {"error": "no policy loaded yet (no complete checkpoint)"}
+            )
+        sess = self.sessions.get(sid)
+        if sess is None:
+            return 404, _JSON, _json_body(
+                {
+                    "error": (
+                        f"unknown or expired session {sid!r} — mint a "
+                        "new one with POST /session"
+                    ),
+                    "code": "session_unknown",
+                }
+            )
+        try:
+            payload = json.loads(body)
+            obs = np.asarray(payload["obs"], self.engine.obs_dtype)
+        except (ValueError, KeyError, TypeError) as e:
+            return 400, _JSON, _json_body(
+                {"error": f'body must be {{"obs": [...]}} ({e})'}
+            )
+        if obs.shape != self.engine.obs_shape:
+            return 400, _JSON, _json_body(
+                {
+                    "error": (
+                        f"obs shape {list(obs.shape)} != expected "
+                        f"{list(self.engine.obs_shape)}"
+                    )
+                }
+            )
+        try:
+            with sess.lock:
+                action, carry_new, step = self.engine.step(
+                    sess.carry, obs, return_step=True
+                )
+                sess.carry = carry_new
+                self.sessions.touch_steps(sess)
+        except Exception as e:
+            with self._counter_lock:
+                self.session_act_errors_total += 1
+            return 500, _JSON, _json_body(
+                {"error": f"inference failed: {type(e).__name__}"}
+            )
+        with self._counter_lock:
+            self.session_acts_total += 1
+        return 200, _JSON, _json_body(
+            {
+                "action": np.asarray(action).tolist(),
+                "step": step,
+                "session": sid,
+                "session_steps": sess.steps,
+            }
+        )
+
     def _healthz(self):
         ok = self.engine.ready
         body = _json_body(
             {
                 "ok": ok,
                 "step": self.engine.loaded_step,
-                "requests_total": self.batcher.requests_total,
+                "requests_total": (
+                    self.batcher.requests_total
+                    if self.batcher is not None
+                    else self.session_acts_total
+                ),
                 "reloads_total": self.reloads_total,
+                # the replica supervisor's rotation signals (ISSUE 9)
+                "reloading": self._reloading,
+                "recurrent": self.is_recurrent,
+                "sessions": (
+                    len(self.sessions) if self.sessions is not None else 0
+                ),
             }
         )
         return (200 if ok else 503), _JSON, body
 
     def _metrics(self):
         b = self.batcher
-        q = b.latency_quantiles_ms((0.5, 0.99))
         lines = []
 
         def fam(name, mtype, help_, samples):
@@ -262,6 +466,48 @@ class PolicyServer:
                 lines.append(f"# TYPE {name} {mtype}")
                 lines.extend(rows)
 
+        if b is None:  # recurrent replica: the session data plane
+            fam(
+                "trpo_serve_session_acts_total", "counter",
+                "session act requests served",
+                [("", self.session_acts_total)],
+            )
+            fam(
+                "trpo_serve_session_act_errors_total", "counter",
+                "session act requests failed by engine errors",
+                [("", self.session_act_errors_total)],
+            )
+            s = self.sessions
+            fam(
+                "trpo_serve_sessions_active", "gauge",
+                "live sessions in the bounded store", [("", len(s))],
+            )
+            fam(
+                "trpo_serve_sessions_created_total", "counter",
+                "sessions minted", [("", s.created_total)],
+            )
+            fam(
+                "trpo_serve_sessions_expired_total", "counter",
+                "sessions TTL-expired", [("", s.expired_total)],
+            )
+            fam(
+                "trpo_serve_sessions_evicted_total", "counter",
+                "sessions LRU-evicted at capacity",
+                [("", s.evicted_total)],
+            )
+            fam(
+                "trpo_serve_checkpoint_step", "gauge",
+                "checkpoint step currently served",
+                [("", self.engine.loaded_step)],
+            )
+            fam(
+                "trpo_serve_reloads_total", "counter",
+                "hot reloads applied", [("", self.reloads_total)],
+            )
+            body = ("\n".join(lines) + "\n").encode()
+            return 200, "text/plain; version=0.0.4; charset=utf-8", body
+
+        q = b.latency_quantiles_ms((0.5, 0.99))
         fam(
             "trpo_serve_requests_total", "counter",
             "act requests accepted", [("", b.requests_total)],
@@ -334,3 +580,5 @@ class PolicyServer:
         httpd, self._httpd = self._httpd, None
         if httpd is not None:
             httpd.close()
+        if self.sessions is not None:
+            self.sessions.close()

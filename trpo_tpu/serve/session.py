@@ -1,0 +1,348 @@
+"""Session protocol for serving recurrent policies: the carry is state.
+
+The stateless ``/act`` plane (``serve/engine.py``) refuses recurrent
+policies for a reason: a GRU/LSTM policy's action depends on a hidden
+carry integrated over the client's whole episode, and HTTP requests
+don't carry it. This module makes that a first-class protocol instead
+of a refusal:
+
+* :class:`RecurrentServeEngine` — the eval-mode ``policy.step``
+  (argmax/mode, keyless) AOT-compiled at batch 1 over ``(params,
+  obs_norm, carry, obs)`` → ``(action, new_carry)``. Same snapshot
+  contract as the feedforward engine: donation-free, swapped by
+  reference on hot reload, ZERO steady-state retraces after
+  :meth:`load`. Determinism contract: stepping a session through this
+  engine is BIT-EXACT with driving ``agent.act(..., eval_mode=True,
+  policy_carry=...)`` by hand (pinned in ``tests/test_router.py``) —
+  the session API is the training-time act path, not an approximation.
+* :class:`SessionStore` — a bounded, thread-safe map ``session id →
+  carry`` with TTL eviction (idle sessions expire; a sweep thread and
+  lazy access checks both enforce it) and LRU capacity eviction (the
+  store is a BOUND, not a buffer — the StatsDrain/MicroBatcher
+  policy). Every eviction/expiry emits a ``session`` event so a
+  vanished session is observable, never silent.
+
+Topology: each serving replica owns its own store — the carry lives
+NEXT TO the engine that advances it (one device hop per step, no
+carry-over-HTTP per request). The router (``serve/router.py``) keeps
+session→replica AFFINITY and re-establishes a session with a fresh
+carry when its replica dies; the replica-side store is the source of
+truth for the carry itself.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["RecurrentServeEngine", "SessionStore", "mint_session_id"]
+
+
+def mint_session_id() -> str:
+    """An opaque session id (hex uuid4) — minted by whichever side
+    creates the session (the replica for direct clients, the router
+    when it needs to own the id for affinity/re-establishment)."""
+    return uuid.uuid4().hex
+
+
+class RecurrentServeEngine:
+    """AOT-compiled eval-mode ``step`` over a swappable params snapshot.
+
+    The recurrent twin of :class:`~trpo_tpu.serve.engine.InferenceEngine`:
+    one session's step is a batch-1 program ``(carry, obs) → (action,
+    new_carry)`` compiled ahead-of-time at :meth:`load`, so the
+    steady-state request path never traces. ``with_obs_norm`` folds
+    ``normalize(stats, obs)`` in front of the torso exactly as the
+    training act path does — clients always send RAW observations.
+
+    ``is_recurrent`` is the protocol discriminator the HTTP front end
+    and the router read: engines with it set serve ``/session``, engines
+    without serve ``/act`` (wrong-protocol calls get a typed 409, never
+    an engine-construction crash).
+    """
+
+    is_recurrent = True
+
+    def __init__(
+        self,
+        policy,
+        obs_shape: Tuple[int, ...],
+        with_obs_norm: bool = False,
+        obs_dtype=jnp.float32,
+    ):
+        if not hasattr(policy, "step") or not hasattr(
+            policy, "initial_state"
+        ):
+            raise ValueError(
+                "RecurrentServeEngine needs a recurrent policy "
+                "(step/initial_state) — serve a feedforward policy "
+                "through the stateless InferenceEngine instead"
+            )
+        self.policy = policy
+        self.obs_shape = tuple(obs_shape)
+        self.state_size = int(policy.state_size or policy.hidden_size)
+        self.with_obs_norm = bool(with_obs_norm)
+        self.obs_dtype = np.dtype(obs_dtype)
+
+        def _step(params, obs_norm, carry, obs):
+            if self.with_obs_norm:
+                from trpo_tpu.utils.normalize import normalize
+
+                obs = normalize(obs_norm, obs)
+            carry_new, dist = policy.step(params, carry, obs)
+            return policy.dist.mode(dist), carry_new
+
+        self._step_fn = _step
+        self._compiled = None          # AOT executable (batch 1)
+        self._snapshot = None          # (params, obs_norm, step) — swapped
+        #                                atomically by reference
+        self._lock = threading.Lock()  # counters only, never the hot path
+        self.steps_total = 0
+
+    # -- snapshot lifecycle (the InferenceEngine contract) -----------------
+
+    @property
+    def loaded_step(self) -> Optional[int]:
+        snap = self._snapshot
+        return snap[2] if snap is not None else None
+
+    @property
+    def ready(self) -> bool:
+        return self._snapshot is not None
+
+    def load(self, params, obs_norm=None, step: Optional[int] = None) -> None:
+        """Install a params snapshot; the FIRST load AOT-compiles the
+        batch-1 step program, every later load is a pure reference swap
+        (hot reload — in-flight steps finish on the old params)."""
+        if self.with_obs_norm and obs_norm is None:
+            raise ValueError(
+                "engine was built with with_obs_norm=True but load() got "
+                "obs_norm=None — serving would skip the normalization the "
+                "policy was trained behind (silently wrong actions)"
+            )
+        if not self.with_obs_norm and obs_norm is not None:
+            raise ValueError(
+                "engine was built with with_obs_norm=False but load() "
+                "got obs-norm statistics — rebuild the engine with "
+                "with_obs_norm=True to serve a normalized policy"
+            )
+        if self._compiled is None:
+            abstract = lambda tree: jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    jnp.shape(x), jnp.asarray(x).dtype
+                ),
+                tree,
+            )
+            self._compiled = (
+                jax.jit(self._step_fn)
+                .lower(
+                    abstract(params),
+                    abstract(obs_norm) if self.with_obs_norm else None,
+                    jax.ShapeDtypeStruct(
+                        (1, self.state_size), jnp.float32
+                    ),
+                    jax.ShapeDtypeStruct(
+                        (1,) + self.obs_shape, self.obs_dtype
+                    ),
+                )
+                .compile()
+            )
+        self._snapshot = (params, obs_norm, step)
+
+    # -- stepping ----------------------------------------------------------
+
+    def initial_carry(self) -> np.ndarray:
+        """A fresh session's carry: the policy's zero state, host-side
+        (``(state_size,)`` float32) — what ``SessionStore.create``
+        installs and what a re-established session restarts from."""
+        return np.zeros((self.state_size,), np.float32)
+
+    def step(self, carry, obs, return_step: bool = False):
+        """Advance ONE session: ``(carry (S,), obs (*obs_shape))`` →
+        ``(action, new_carry)`` — or ``(action, new_carry, step)`` with
+        the checkpoint step of the snapshot THIS call used (captured
+        before the call, so a concurrent hot swap can never mislabel the
+        action's provenance)."""
+        snap = self._snapshot
+        if snap is None:
+            raise RuntimeError(
+                "no params snapshot loaded — call load() (or point the "
+                "server at a checkpoint directory) before serving"
+            )
+        params, obs_norm, ck_step = snap
+        obs = np.asarray(obs, self.obs_dtype)
+        if obs.shape != self.obs_shape:
+            raise ValueError(
+                f"obs must have shape {self.obs_shape}, got {obs.shape}"
+            )
+        carry = np.asarray(carry, np.float32)
+        if carry.shape != (self.state_size,):
+            raise ValueError(
+                f"carry must have shape ({self.state_size},), "
+                f"got {carry.shape}"
+            )
+        action, carry_new = self._compiled(
+            params, obs_norm, carry[None], obs[None]
+        )
+        with self._lock:
+            self.steps_total += 1
+        out = (
+            np.asarray(action)[0],
+            np.asarray(carry_new, np.float32)[0],
+        )
+        return out + (ck_step,) if return_step else out
+
+
+class _Session:
+    __slots__ = ("carry", "created", "last_used", "steps", "lock")
+
+    def __init__(self, carry: np.ndarray, now: float):
+        self.carry = carry
+        self.created = now
+        self.last_used = now
+        self.steps = 0
+        self.lock = threading.Lock()  # serializes steps WITHIN a session
+
+
+class SessionStore:
+    """Bounded ``session id → carry`` map with TTL + LRU eviction.
+
+    ``ttl_s`` bounds idle lifetime (enforced lazily on access and by a
+    background sweep so an abandoned session releases its slot without
+    anyone touching it); ``max_sessions`` bounds the map itself — at
+    capacity the longest-idle session is evicted (LRU). Both paths emit
+    a ``session`` event (``expired`` / ``evicted``) on the bus when one
+    is attached, so a session vanishing is observable; its next act gets
+    a typed "session_unknown" error from the front end, never a KeyError.
+
+    Per-session steps are serialized by a session-level lock (two
+    concurrent acts on ONE session would otherwise race the carry
+    read-modify-write); different sessions never contend.
+    """
+
+    def __init__(
+        self,
+        ttl_s: float = 300.0,
+        max_sessions: int = 1024,
+        bus=None,
+        replica: Optional[str] = None,
+        sweep_interval: Optional[float] = None,
+    ):
+        if ttl_s <= 0:
+            raise ValueError(f"ttl_s must be > 0, got {ttl_s}")
+        if max_sessions < 1:
+            raise ValueError(
+                f"max_sessions must be >= 1, got {max_sessions}"
+            )
+        self.ttl_s = float(ttl_s)
+        self.max_sessions = int(max_sessions)
+        self.bus = bus
+        self.replica = replica
+        self.created_total = 0
+        self.expired_total = 0
+        self.evicted_total = 0
+        self._lock = threading.Lock()
+        self._sessions: "OrderedDict[str, _Session]" = OrderedDict()
+        self._stop = threading.Event()
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop,
+            name="session-ttl-sweeper",
+            daemon=True,
+            args=(
+                sweep_interval
+                if sweep_interval is not None
+                else max(self.ttl_s / 4.0, 0.05),
+            ),
+        )
+        self._sweeper.start()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def _emit(self, event: str, session_id: str) -> None:
+        if self.bus is None:
+            return
+        try:
+            fields = {"session": session_id, "event": event}
+            if self.replica:
+                fields["replica"] = self.replica
+            self.bus.emit("session", **fields)
+        except Exception:  # a closed bus must never break the data plane
+            pass
+
+    def create(
+        self, initial_carry: np.ndarray, session_id: Optional[str] = None
+    ) -> str:
+        """Register a session (minting an id unless the caller — the
+        router, which needs to own it for affinity — supplies one).
+        Re-creating an EXISTING id resets its carry: that is exactly the
+        router's re-establish semantics, and for a direct client it is
+        an explicit restart, not an error."""
+        sid = session_id or mint_session_id()
+        now = time.monotonic()
+        evicted = None
+        with self._lock:
+            if sid not in self._sessions and (
+                len(self._sessions) >= self.max_sessions
+            ):
+                evicted, _ = self._sessions.popitem(last=False)  # LRU
+                self.evicted_total += 1
+            self._sessions[sid] = _Session(
+                np.asarray(initial_carry, np.float32), now
+            )
+            self._sessions.move_to_end(sid)
+            self.created_total += 1
+        if evicted is not None:
+            self._emit("evicted", evicted)
+        self._emit("created", sid)
+        return sid
+
+    def get(self, session_id: str) -> Optional[_Session]:
+        """The live session, refreshed to most-recently-used — or None
+        (unknown, or just now found expired and dropped)."""
+        now = time.monotonic()
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            if sess is None:
+                return None
+            if now - sess.last_used > self.ttl_s:
+                del self._sessions[session_id]
+                self.expired_total += 1
+                expired = True
+            else:
+                sess.last_used = now
+                self._sessions.move_to_end(session_id)
+                expired = False
+        if expired:
+            self._emit("expired", session_id)
+            return None
+        return sess
+
+    def touch_steps(self, sess: _Session) -> None:
+        sess.steps += 1
+        sess.last_used = time.monotonic()
+
+    def _sweep_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            now = time.monotonic()
+            expired = []
+            with self._lock:
+                for sid, sess in list(self._sessions.items()):
+                    if now - sess.last_used > self.ttl_s:
+                        del self._sessions[sid]
+                        self.expired_total += 1
+                        expired.append(sid)
+            for sid in expired:
+                self._emit("expired", sid)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._sweeper.join(timeout=5.0)

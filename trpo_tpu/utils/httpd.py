@@ -23,6 +23,12 @@ body_bytes)``: GET handlers take no arguments, POST handlers take the
 raw request body. A handler raising is a bug in the handler, but it
 must degrade to a 500 for THAT request — never kill the server thread
 or traceback onto the console (same silence contract as above).
+
+Dynamic paths (the serving tier's session protocol routes by id:
+``POST /session/<id>/act``) use ``post_prefix``: ``{prefix:
+fn(path, body)}`` — consulted only after the exact tables miss, longest
+prefix wins, and the handler receives the FULL path so it can parse the
+dynamic segment itself.
 """
 
 from __future__ import annotations
@@ -56,12 +62,20 @@ class BackgroundHTTPServer:
         host: str = "127.0.0.1",
         get: Optional[Dict[str, Callable[[], Response]]] = None,
         post: Optional[Dict[str, Callable[[bytes], Response]]] = None,
+        post_prefix: Optional[
+            Dict[str, Callable[[str, bytes], Response]]
+        ] = None,
         not_found: str = "unknown path",
         thread_name: str = "httpd",
         max_body_bytes: int = 1 << 20,
     ):
         get_routes = dict(get or {})
         post_routes = dict(post or {})
+        # longest prefix first, so "/session/" can coexist with a more
+        # specific prefix without registration-order surprises
+        prefix_routes = sorted(
+            (post_prefix or {}).items(), key=lambda kv: -len(kv[0])
+        )
 
         def _respond(handler, status: int, ctype: str, body: bytes) -> None:
             handler.send_response(status)
@@ -80,6 +94,20 @@ class BackgroundHTTPServer:
             _respond(handler, status, ctype, body)
 
         class _Handler(http.server.BaseHTTPRequestHandler):
+            # HTTP/1.1: connections persist across requests (every
+            # response here carries Content-Length, so framing is
+            # sound). A data plane dies by per-request connection
+            # setup — a fresh TCP handshake plus a fresh handler
+            # THREAD per request (ThreadingHTTPServer spawns per
+            # CONNECTION) costs more than a small model's inference;
+            # keep-alive amortizes both across a client's whole run.
+            protocol_version = "HTTP/1.1"
+            # TCP_NODELAY: a small JSON response held back by Nagle
+            # waiting on the peer's delayed ACK adds ~40 ms to a
+            # millisecond-scale request; inference traffic is
+            # latency-bound, never bandwidth-bound
+            disable_nagle_algorithm = True
+
             def do_GET(handler):  # noqa: N805 — handler, not self
                 path = handler.path.split("?", 1)[0]
                 fn = get_routes.get(path)
@@ -91,6 +119,12 @@ class BackgroundHTTPServer:
             def do_POST(handler):  # noqa: N805
                 path = handler.path.split("?", 1)[0]
                 fn = post_routes.get(path)
+                args = ()
+                if fn is None:
+                    for prefix, pfn in prefix_routes:
+                        if path.startswith(prefix):
+                            fn, args = pfn, (path,)
+                            break
                 if fn is None:
                     handler.send_error(404, not_found)
                     return
@@ -102,7 +136,7 @@ class BackgroundHTTPServer:
                     handler.send_error(413, "request body too large")
                     return
                 body = handler.rfile.read(length) if length else b""
-                _run(handler, fn, body)
+                _run(handler, fn, *args, body)
 
             def log_message(handler, *args):  # noqa: N805
                 pass  # requests must not spray the owning console
@@ -112,6 +146,12 @@ class BackgroundHTTPServer:
             # a relaunched run must be able to rebind the same port
             # immediately (TIME_WAIT would otherwise hold it for minutes)
             allow_reuse_address = True
+            # the stdlib default listen backlog is 5: a burst of
+            # concurrent clients dialing at once overflows it and the
+            # dropped SYNs retransmit after ~1 s — a whole second of
+            # connect stall that reads as a p99 cliff. Size the backlog
+            # for a data plane, not a debug endpoint.
+            request_queue_size = 128
 
             def handle_error(server, request, client_address):  # noqa: N805
                 # a client dropping the connection mid-response raises in
