@@ -127,7 +127,7 @@ def bench_rung(name: str, k: int, overrides: dict, reps: int = 3,
     agent = TRPOAgent(cfg.env, cfg)
     agent._capture_program_args = True
     state = agent.init_state(seed=0)
-    steps_per_iter = agent.n_steps * cfg.n_envs
+    steps_per_iter = agent.n_steps * agent.n_envs
 
     t0 = time.perf_counter()
     new_state, stats = agent.run_iterations(state, k)   # compile + warm
@@ -152,7 +152,7 @@ def bench_rung(name: str, k: int, overrides: dict, reps: int = 3,
     mem = _rung_program_memory(agent)
     return {
         "rung": name,
-        "n_envs": cfg.n_envs,
+        "n_envs": agent.n_envs,
         "batch_timesteps": steps_per_iter,
         "updates_per_sec": 1.0 / per_iter,
         "env_steps_per_sec": steps_per_iter / per_iter,
@@ -169,7 +169,7 @@ def bench_host_rung(name: str, preset: str, iters: int, overrides: dict):
     agent = TRPOAgent(cfg.env, cfg)
     agent._capture_program_args = True
     state = agent.init_state(seed=0)
-    steps_per_iter = agent.n_steps * cfg.n_envs
+    steps_per_iter = agent.n_steps * agent.n_envs
 
     t0 = time.perf_counter()
     state, stats = agent.run_iteration(state)           # compile + warm
@@ -185,7 +185,7 @@ def bench_host_rung(name: str, preset: str, iters: int, overrides: dict):
     mem = _rung_program_memory(agent)
     return {
         "rung": name,
-        "n_envs": cfg.n_envs,
+        "n_envs": agent.n_envs,
         "batch_timesteps": steps_per_iter,
         "updates_per_sec": 1.0 / per_iter,
         "env_steps_per_sec": steps_per_iter / per_iter,

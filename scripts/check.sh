@@ -36,6 +36,10 @@ echo "== tier-1: replicated serving (replica set, router, sessions) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q \
     -m 'not slow'
 
+echo "== tier-1: env fleet (chunked rollouts, wide-N presets, env-steps/s) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_env_fleet.py -q \
+    -m 'not slow'
+
 echo "== event-stream smoke: train + bench emit schema-valid JSONL =="
 OBS_TMP=$(mktemp -d)
 JAX_PLATFORMS=cpu python -m trpo_tpu.train --preset cartpole \
@@ -44,7 +48,10 @@ JAX_PLATFORMS=cpu python -m trpo_tpu.train --preset cartpole \
     --status-port 0 --memory-accounting \
     > /dev/null
 BENCH_FORCE_CPU=1 BENCH_BATCH=256 BENCH_WIDTHS= BENCH_HOST_PIPELINE=0 \
-    BENCH_TAIL=0 BENCH_EVENTS_JSONL="$OBS_TMP/bench_events.jsonl" \
+    BENCH_TAIL=0 \
+    BENCH_FLEET_FAMILIES=cartpole BENCH_FLEET_NS=64,128 \
+    BENCH_FLEET_K=5 BENCH_FLEET_BATCH=512 \
+    BENCH_EVENTS_JSONL="$OBS_TMP/bench_events.jsonl" \
     python bench.py > "$OBS_TMP/bench.json"
 python scripts/validate_events.py "$OBS_TMP/train_events.jsonl" \
     "$OBS_TMP/bench_events.jsonl"
@@ -189,6 +196,81 @@ JAX_PLATFORMS=cpu python scripts/router_smoke.py --tmp "$ROUTER_TMP"
 python scripts/validate_events.py "$ROUTER_TMP/router_events.jsonl"
 python scripts/analyze_run.py "$ROUTER_TMP/router_events.jsonl"
 
+echo "== env fleet smoke: chunked == unchunked + wide-N beats the N=128 row =="
+# ISSUE 10 acceptance, cartpole-cheap: (a) a rollout_chunk training run
+# must be BITWISE identical to the unchunked twin through 3 full fused
+# iterations (stats and params); (b) the widest CPU-feasible rung's
+# rollout-program env-steps/s must beat the N=128 full-iteration row —
+# the same ratio shape bench.py's env_fleet block reports as
+# rollout_vs_n128_row (on CPU the width-invariant update dominates the
+# iteration, so the fleet win is the rollout substrate's; the >=10x
+# end-to-end claim is the TPU re-run protocol in env_fleet_bench's
+# docstring)
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import time
+import jax
+import jax.flatten_util  # submodule: not loaded by `import jax` alone
+import numpy as np
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import get_preset
+from trpo_tpu.rollout import device_rollout, init_carry
+
+base = get_preset("cartpole").replace(batch_timesteps=2048, fleet_n_envs=256)
+au = TRPOAgent(base.env, base)
+ac = TRPOAgent(base.env, base.replace(rollout_chunk=4))
+su, stu = au.run_iterations(au.init_state(0), 3)
+sc, stc = ac.run_iterations(ac.init_state(0), 3)
+for k in stu:
+    assert np.array_equal(
+        np.asarray(stu[k]), np.asarray(stc[k]), equal_nan=True
+    ), k
+fu = jax.flatten_util.ravel_pytree(su.policy_params)[0]
+fc = jax.flatten_util.ravel_pytree(sc.policy_params)[0]
+assert np.array_equal(np.asarray(fu), np.asarray(fc))
+
+def iter_rate(n, k=8):
+    cfg = get_preset("cartpole").replace(
+        batch_timesteps=8192, fleet_n_envs=n
+    )
+    a = TRPOAgent(cfg.env, cfg)
+    _, st = a.run_iterations(a.init_state(0), k)
+    np.asarray(st["entropy"])                      # compile + warm
+    s = a.init_state(0)      # rebuilt OUTSIDE the timed window (the
+    t0 = time.perf_counter()  # donation contract consumed the warm one)
+    _, st = a.run_iterations(s, k)
+    np.asarray(st["entropy"])
+    return a.n_steps * a.n_envs * k / (time.perf_counter() - t0)
+
+def rollout_rate(n):
+    cfg = get_preset("cartpole").replace(
+        batch_timesteps=8192, fleet_n_envs=n
+    )
+    a = TRPOAgent(cfg.env, cfg)
+    p = a.init_state(1).policy_params
+    c = init_carry(a.env, jax.random.key(0), a.n_envs)
+    fn = jax.jit(lambda p, c, k: device_rollout(
+        a.env, a.policy, p, c, k, a.n_steps
+    ))
+    c, t = fn(p, c, jax.random.key(1))
+    jax.block_until_ready(t.rewards)               # compile + warm
+    best = float("inf")
+    for i in range(3):
+        t0 = time.perf_counter()
+        c, t = fn(p, c, jax.random.key(2 + i))
+        jax.block_until_ready(t.rewards)
+        best = min(best, time.perf_counter() - t0)
+    return a.n_steps * a.n_envs / best
+
+row128 = iter_rate(128)
+wide = rollout_rate(2048)
+assert wide > row128, (wide, row128)
+print(
+    "fleet smoke OK: chunked==unchunked bitwise over 3 fused iterations; "
+    f"N=2048 rollout {wide:,.0f} env-steps/s vs N=128 row "
+    f"{row128:,.0f} ({wide / row128:.1f}x)"
+)
+PYEOF
+
 echo "== solver precision ladder smoke: bf16/subsampled solve vs f32 gate =="
 # ISSUE 8 acceptance: a cartpole run with the full ladder on (bf16 FVP,
 # half-batch curvature, audit every 2 updates) must emit a schema-valid
@@ -234,7 +316,9 @@ echo "== pytest tier-1 (8-device virtual CPU mesh) =="
 # timed so every PR sees the headroom against the ROADMAP tier-1 budget
 T1_START=$SECONDS
 python -m pytest tests/ -q -m 'not slow'
-echo "tier-1 wall time: $((SECONDS - T1_START))s (budget 1200s — ROADMAP.md)"
+T1_WALL=$((SECONDS - T1_START))
+echo "tier-1 wall time: ${T1_WALL}s (budget 1200s — ROADMAP.md;" \
+    "margin $((1200 - T1_WALL))s)"
 
 echo "== pytest slow tier (@pytest.mark.slow) =="
 python -m pytest tests/ -q -m 'slow'

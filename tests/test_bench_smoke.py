@@ -25,6 +25,11 @@ def test_bench_cpu_smoke_json_contract():
     # the host-env pipeline section has its own dedicated smoke below —
     # skipping it here keeps this run inside the timeout budget
     env["BENCH_HOST_PIPELINE"] = "0"
+    # env fleet block (ISSUE 10) at smoke scale: one family, tiny ladder
+    env["BENCH_FLEET_FAMILIES"] = "cartpole"
+    env["BENCH_FLEET_NS"] = "64,128"
+    env["BENCH_FLEET_K"] = "5"
+    env["BENCH_FLEET_BATCH"] = "512"
     out = subprocess.run(
         [sys.executable, "bench.py"],
         capture_output=True,
@@ -101,6 +106,19 @@ def test_bench_cpu_smoke_json_contract():
     assert bd["fvp_dtype"] == "f32" and bd["solve_cosine"] == 1.0
     assert bd["ladder"]["variant"] == "ladder"
     assert bd["ladder_speedup_vs_f32"] > 0
+    # env fleet block (ISSUE 10): both rates per rung, and the
+    # chunk-memory study's chunk-program bytes bounded by the flat
+    # (T, N) program's — memory grows with chunk, not with T
+    ef = j["env_fleet"]
+    assert [r["n_envs"] for r in ef["rows"]] == [64, 128]
+    for r in ef["rows"]:
+        assert r["env_steps_per_sec"] > 0
+        assert r["rollout_steps_per_sec"] > 0
+        assert r["batch"] == 512
+    ck = ef["chunk_memory"]
+    flat_peak = ck["flat"]["peak_estimate_bytes"]
+    for fields in ck["chunks"].values():
+        assert fields["peak_estimate_bytes"] < flat_peak
 
 
 @pytest.mark.slow
@@ -145,6 +163,7 @@ def test_bench_analytic_fallback_fills_flops():
     env["BENCH_WIDTHS"] = ""
     env["BENCH_FORCE_ANALYTIC"] = "1"
     env["BENCH_SOLVE_PRECISION"] = "0"  # covered by the main smoke
+    env["BENCH_ENV_FLEET"] = "0"        # covered by the main smoke
     out = subprocess.run(
         [sys.executable, "bench.py"],
         capture_output=True,

@@ -27,6 +27,16 @@ RETURNED state, and deep-copy first (``jax.tree_util.tree_map(jnp.copy,
 state)``) if the old one must stay readable (e.g. for a comparison).
 Checkpoint saves and ``evaluate`` read whatever state object you still
 hold — call them BEFORE handing that state to an update.
+
+The same contract extends across ROLLOUT CHUNK boundaries (ISSUE 10):
+the env-state / obs-norm / recurrent-policy carry buffers are donated
+from chunk to chunk, never copied per chunk. Inside the fused iteration
+(``cfg.rollout_chunk``) the chunked ``lax.scan`` threads ONE carry whose
+buffers the donated TrainState already owns; in the host-driven
+``rollout.ChunkedRollout`` each chunk call donates the carry it is given
+(``donate_argnums``), so a wide-N rollout's carry working set is
+chunk-count-independent — the live-buffer gauges (obs/memory.py) pin
+"no per-chunk copies" in tests/test_env_fleet.py.
 """
 
 from __future__ import annotations
@@ -114,10 +124,37 @@ class TRPOAgent:
 
     def __init__(self, env, config: Optional[TRPOConfig] = None):
         cfg = config or TRPOConfig()
+        # the ONE resolved fleet width (cfg.fleet_n_envs wins over
+        # cfg.n_envs — config.resolved_n_envs): every env-count consumer
+        # below (env construction, carry init, step accounting, mesh
+        # divisibility) reads this, so a fleet preset resolves
+        # consistently across all env families
+        self.n_envs = cfg.resolved_n_envs()
         host_normalized = False
         if isinstance(env, str):
+            if (
+                cfg.fleet_n_envs is not None
+                and env.startswith(("gym:", "gymproc:"))
+                and self.n_envs > envs_lib.HOST_ENV_FLEET_MAX
+            ):
+                # device envs take any fleet width (one vmap axis) and
+                # native: steps any width in one batched C++ call, but
+                # gym:/gymproc: build one simulator object (or worker
+                # slice) per env — a thousands-wide fleet there is a
+                # misconfiguration, failed here with the alternative
+                # spelled out rather than discovered as an OOM mid-run
+                raise ValueError(
+                    f"fleet_n_envs={cfg.fleet_n_envs} exceeds the host "
+                    f"simulator fleet cap ({envs_lib.HOST_ENV_FLEET_MAX}) "
+                    f"for {env!r}: the gym:/gymproc: families construct "
+                    "one simulator instance per env and cannot honor a "
+                    "thousands-wide fleet — use a device env family "
+                    "(e.g. the -sim stand-ins) or native:, or set n_envs "
+                    "explicitly if you really want this many host "
+                    "simulators"
+                )
             kwargs = (
-                {"n_envs": cfg.n_envs}
+                {"n_envs": self.n_envs}
                 if env.startswith(("gym:", "gymproc:", "native:"))
                 else {}
             )
@@ -275,7 +312,29 @@ class TRPOAgent:
 
         # steps per env per iteration, so T·N ≥ batch_timesteps
         # (ref batch budget semantics, trpo_inksci.py:17 + utils.py:21).
-        self.n_steps = max(1, -(-cfg.batch_timesteps // cfg.n_envs))
+        # Widening the fleet under a fixed batch budget holds T·N
+        # constant and shortens the window — the wide-N presets' trade.
+        self.n_steps = max(1, -(-cfg.batch_timesteps // self.n_envs))
+
+        if cfg.rollout_chunk is not None and not self.is_device_env:
+            raise ValueError(
+                "rollout_chunk applies to pure-JAX device envs (the "
+                "time-chunked lax.scan rollout); host-simulator envs "
+                "collect with host_rollout and have no device scan to "
+                "chunk — set rollout_chunk=None"
+            )
+        if cfg.rollout_chunk is not None and (
+            cfg.rollout_chunk > self.n_steps
+            or self.n_steps % cfg.rollout_chunk
+        ):
+            # config.__post_init__ validates against the config-derived
+            # window; re-check against the AGENT's (a pre-constructed env
+            # object cannot change n_steps, but belt-and-braces keeps the
+            # invariant local to where the scan is built)
+            raise ValueError(
+                f"rollout_chunk={cfg.rollout_chunk} must divide the "
+                f"steps per rollout window ({self.n_steps})"
+            )
 
         if cfg.host_async_pipeline:
             # fail at construction, not mid-training (same policy as the
@@ -316,7 +375,7 @@ class TRPOAgent:
                 )
             # the adapter's true env count, not cfg's: pre-constructed env
             # objects may disagree with cfg.n_envs
-            env_count = getattr(self.env, "n_envs", cfg.n_envs)
+            env_count = getattr(self.env, "n_envs", self.n_envs)
             if cfg.host_pipeline_groups > env_count:
                 raise ValueError(
                     f"host_pipeline_groups={cfg.host_pipeline_groups} "
@@ -357,9 +416,9 @@ class TRPOAgent:
                     f'"{cfg.mesh_axes[0]}")'
                 )
             dp = self.mesh.shape[cfg.mesh_axes[0]]
-            if cfg.n_envs % dp != 0:
+            if self.n_envs % dp != 0:
                 raise ValueError(
-                    f"n_envs={cfg.n_envs} must divide evenly over the "
+                    f"n_envs={self.n_envs} must divide evenly over the "
                     f"{cfg.mesh_axes[0]}={dp} mesh axis"
                 )
             param_axes = [
@@ -467,14 +526,14 @@ class TRPOAgent:
         k_policy, k_vf, k_env, k_run = jax.random.split(key, 4)
         if self.is_device_env:
             env_carry = init_carry(
-                self.env, k_env, self.cfg.n_envs, policy=self.policy
+                self.env, k_env, self.n_envs, policy=self.policy
             )
         elif self.is_recurrent:
             # host sims: the env lives outside, but the policy memory is
             # ours to carry — (h, prev_done), persisted across windows
             env_carry = (
-                self.policy.initial_state(self.cfg.n_envs),
-                jnp.ones(self.cfg.n_envs, bool),
+                self.policy.initial_state(self.n_envs),
+                jnp.ones(self.n_envs, bool),
             )
         else:
             env_carry = None
@@ -1029,7 +1088,12 @@ class TRPOAgent:
 
     def _device_iteration(self, train_state: TrainState, _=None, lam=None):
         """rollout + process as ONE program (pure-JAX envs only).
-        ``lam``: optional traced GAE-λ override (Population sweeps)."""
+        ``lam``: optional traced GAE-λ override (Population sweeps).
+        ``cfg.rollout_chunk`` threads through to the rollout's
+        time-chunked scan (bit-exact vs unchunked — rollout.py); it
+        composes with the member vmap (Population) and the fused
+        multi-iteration scan unchanged, since the chunking is internal
+        to the rollout's own scan structure."""
         rng, k_roll = jax.random.split(train_state.rng)
         train_state = train_state._replace(rng=rng)
         new_carry, traj = device_rollout(
@@ -1039,6 +1103,7 @@ class TRPOAgent:
             train_state.env_carry,
             k_roll,
             self.n_steps,
+            chunk=self.cfg.rollout_chunk,
         )
         train_state = train_state._replace(env_carry=new_carry)
         return self._process_trajectory(train_state, traj, lam=lam)
@@ -1142,8 +1207,8 @@ class TRPOAgent:
                 # evaluate() hard-reset the shared host envs; stale GRU
                 # memory must not leak into the fresh episodes
                 policy_state = (
-                    self.policy.initial_state(self.cfg.n_envs),
-                    jnp.ones(self.cfg.n_envs, bool),
+                    self.policy.initial_state(self.n_envs),
+                    jnp.ones(self.n_envs, bool),
                 )
                 self._host_env_reset_pending = False
         act_fn = getattr(self, "_host_act_fn", None) or self._make_host_act()
@@ -1347,7 +1412,7 @@ class TRPOAgent:
 
                 fn = self._eval_roll_fns[n_steps] = jax.jit(_eval_roll)
             carry = init_carry(
-                self.env, k_init, self.cfg.n_envs, policy=self.policy
+                self.env, k_init, self.n_envs, policy=self.policy
             )
             _, traj = fn(
                 train_state.policy_params, carry, k_roll,
@@ -1563,7 +1628,7 @@ class TRPOAgent:
         # would otherwise dominate a ~10ms update. Host envs roll out on
         # the host each iteration, so there is nothing to fuse.
         chunk = max(1, cfg.fuse_iterations) if self.is_device_env else 1
-        steps_per_iter = self.n_steps * cfg.n_envs
+        steps_per_iter = self.n_steps * self.n_envs
 
         # cross-batch running episode-return mean (reward_running): finite
         # from the first finished episode onward, even on rungs where most
@@ -1910,7 +1975,7 @@ class TRPOAgent:
         from trpo_tpu.utils.async_pipe import StatsDrain
 
         cfg = self.cfg
-        steps_per_iter = self.n_steps * cfg.n_envs
+        steps_per_iter = self.n_steps * self.n_envs
         reward_running = RunningEpisodeMean()
         bus = telemetry.bus if telemetry is not None else None
         # the ONLY entry syncs; the loop itself never fetches device scalars

@@ -24,6 +24,40 @@ class TRPOConfig:
     #                                becomes an explicit override here.
     batch_timesteps: int = 1000    # ref config["episodes_per_roll"] — a timestep
     #                                budget despite its name (SURVEY §2.1)
+    fleet_n_envs: Optional[int] = None  # wide-N env fleet (ISSUE 10):
+    #                                overrides n_envs with a brax-style
+    #                                wide vectorized fleet. batch_timesteps
+    #                                is a TOTAL budget, so widening N under
+    #                                a fixed batch holds T·N constant and
+    #                                shortens the rollout window (T =
+    #                                ceil(batch/N)) — short truncation-
+    #                                bootstrapped windows, the trade that
+    #                                turns scan depth into vector width.
+    #                                Device envs take any width (the fleet
+    #                                is one vmap axis); native: is a
+    #                                batched C++ stepper and takes any
+    #                                width too; gym:/gymproc: build one
+    #                                simulator OBJECT (or worker) per env
+    #                                and refuse a fleet wider than
+    #                                HOST_ENV_FLEET_MAX with a clear error
+    #                                (agent.__init__) — thousands of
+    #                                in-process MuJoCo instances is a
+    #                                misconfiguration, not a preset.
+    rollout_chunk: Optional[int] = None  # time-chunked device rollout
+    #                                (rollout.device_rollout `chunk`): the
+    #                                fused iteration's rollout scans over
+    #                                T/chunk time-chunks of the shared
+    #                                step body with the env/obs-norm/
+    #                                policy carry threaded through the
+    #                                chunk boundary — bit-exact vs the
+    #                                flat scan (test-pinned), and the
+    #                                granularity the host-driven
+    #                                rollout.ChunkedRollout compiles (its
+    #                                live rollout buffer is (chunk, N,
+    #                                ...), memory growing with chunk, not
+    #                                T). Must divide ceil(batch_timesteps
+    #                                / n_envs); None = unchunked (seed
+    #                                behavior). Device envs only.
 
     # --- discounting / advantages ---------------------------------------
     gamma: float = 0.95            # ref config["gamma"]
@@ -522,6 +556,26 @@ class TRPOConfig:
     def __post_init__(self):
         # fail at construction, not mid-training: inverted feedback knobs
         # would silently make conditioning worse on every failure signal
+        if self.fleet_n_envs is not None and self.fleet_n_envs < 1:
+            raise ValueError(
+                f"fleet_n_envs must be >= 1, got {self.fleet_n_envs}"
+            )
+        if self.rollout_chunk is not None:
+            if self.rollout_chunk < 1:
+                raise ValueError(
+                    f"rollout_chunk must be >= 1, got {self.rollout_chunk}"
+                )
+            n_steps = max(
+                1, -(-self.batch_timesteps // self.resolved_n_envs())
+            )
+            if self.rollout_chunk > n_steps or n_steps % self.rollout_chunk:
+                raise ValueError(
+                    f"rollout_chunk={self.rollout_chunk} must divide the "
+                    f"steps per rollout window ({n_steps} = "
+                    f"ceil(batch_timesteps={self.batch_timesteps} / "
+                    f"n_envs={self.resolved_n_envs()})) — pick a divisor "
+                    "or adjust batch_timesteps/the fleet width"
+                )
         if self.host_inference not in ("device", "cpu"):
             raise ValueError(
                 'host_inference must be "device" or "cpu", got '
@@ -721,6 +775,13 @@ class TRPOConfig:
                     f"({self.damping_min}, {self.damping_max})"
                 )
 
+    def resolved_n_envs(self) -> int:
+        """The vectorized-env fleet width this config actually trains
+        with: ``fleet_n_envs`` when the wide-fleet override is set, else
+        ``n_envs`` — the ONE place the precedence lives (agent, carry
+        init, step accounting and the benches all call this)."""
+        return self.n_envs if self.fleet_n_envs is None else self.fleet_n_envs
+
     def resolved_cg_budget_ceiling(self) -> int:
         """The adaptive CG budget's ceiling with its None-default
         resolved (= cg_iters) — the ONE place the rule lives; the
@@ -878,6 +939,39 @@ PRESETS = {
         policy_hidden=(512,),   # dense head on top of the conv torso
     ),
 }
+
+# Wide-N env-fleet variants (ISSUE 10): the brax-style scale-out of the
+# device-env rungs — same total batch (T·N held ≈ the base preset's), the
+# fleet widened 8-32× so the rollout trades lax.scan depth for vmap
+# width (4096×1 step vectorizes; 1×4096 steps serialize). Short windows
+# bootstrap through the critic at the truncation boundary — exactly the
+# mechanism the base presets already rely on at max_pathlength — so the
+# shorter T changes the GAE horizon, not its correctness. rollout_chunk
+# is set where the window splits evenly, keeping the chunked path (the
+# (chunk, N, ...) live-buffer mode) exercised by production configs.
+# Measured curve: BENCH_LADDER.md "Env fleet scale-out" (bench.py's
+# env_fleet block).
+PRESETS.update({
+    # 2048 × 4-step windows (T·N = 8192): the CPU-feasible wide rung the
+    # check.sh fleet smoke and the wide-N training test use.
+    "cartpole-fleet": PRESETS["cartpole"].replace(
+        batch_timesteps=8192,
+        fleet_n_envs=2048,
+        rollout_chunk=2,
+    ),
+    # 1024 × 5-step windows (T·N = 5120 ≈ the 5k base batch)
+    "halfcheetah-sim-fleet": PRESETS["halfcheetah-sim"].replace(
+        batch_timesteps=5120,
+        fleet_n_envs=1024,
+    ),
+    # 1024 × 49-step windows (T·N = 50176 ≈ the flagship 50k batch);
+    # chunk 7 splits the window into 7 time-chunks
+    "humanoid-sim-fleet": PRESETS["humanoid-sim"].replace(
+        batch_timesteps=50_000,
+        fleet_n_envs=1024,
+        rollout_chunk=7,
+    ),
+})
 
 
 def get_preset(name: str) -> TRPOConfig:

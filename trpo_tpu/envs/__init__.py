@@ -50,6 +50,16 @@ def _cartpole_po(max_episode_steps: int = 500):
     )
 
 
+# Widest env fleet the one-simulator-object-per-env host families
+# (gym:, gymproc:) will construct (ISSUE 10): a wide-N fleet preset names
+# thousands of envs, which is one vmap axis for device envs and one
+# batched C++ call for native:, but thousands of in-process gymnasium
+# instances (or worker-pool slices) for gym:/gymproc: — a
+# misconfiguration that deserves a clear construction-time error, not an
+# OOM an hour in. The cap bounds cfg.fleet_n_envs only; an explicit
+# n_envs stays the user's call.
+HOST_ENV_FLEET_MAX = 256
+
 _JAX_ENVS = {
     "cartpole": CartPole,
     "cartpole-po": _cartpole_po,

@@ -148,6 +148,11 @@ class NativeVecEnv(EpisodeStatsMixin, ObsNormMixin):
     ):
         if kind not in _KINDS:
             raise KeyError(f"unknown native env {kind!r}; have {sorted(_KINDS)}")
+        if n_envs < 1:
+            # the batched C++ stepper honors any positive fleet width
+            # (wide-N presets included) — but a zero/negative count would
+            # allocate empty state arrays and step nothing, silently
+            raise ValueError(f"n_envs must be >= 1, got {n_envs}")
         self._lib = load_library()
         state_w, obs_dim, discrete = _KINDS[kind]
         default_steps = _default_horizon(kind)
@@ -282,11 +287,24 @@ class NativeVecEnv(EpisodeStatsMixin, ObsNormMixin):
                 f"snapshot is for native env {snap.get('kind')!r}, "
                 f"this adapter is {self.kind!r}"
             )
-        if np.asarray(snap["state"]).shape != self._state.shape:
+        snap_state = np.asarray(snap["state"])
+        if snap_state.shape[0] != self.n_envs:
+            # the n_envs-resume guard: a fleet preset resumed at another
+            # width must fail with the actionable count message (a wide-N
+            # fleet restored into a narrow adapter would silently drop
+            # envs; the reverse would read garbage)
             raise ValueError(
-                f"snapshot holds {np.asarray(snap['state']).shape[0]} "
+                f"snapshot holds {snap_state.shape[0]} "
                 f"envs, this adapter has {self.n_envs} — resume with the "
-                "same n_envs"
+                "same n_envs (fleet presets pin the width via "
+                "fleet_n_envs)"
+            )
+        if snap_state.shape != self._state.shape:
+            raise ValueError(
+                f"snapshot state layout {snap_state.shape} does not "
+                f"match this {self.kind!r} adapter's "
+                f"{self._state.shape} — snapshot from a different env "
+                "build?"
             )
         if self.has_obs_norm and "raw_obs" not in snap:
             raise ValueError(

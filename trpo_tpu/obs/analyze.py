@@ -334,6 +334,28 @@ def summarize_run(records: list) -> dict:
         t0, t1 = iters[0].get("t"), iters[-1].get("t")
         if None not in (ts0, ts1, t0, t1) and t1 > t0:
             throughput = (ts1 - ts0) / (t1 - t0)
+    # env-steps/s as a first-class rate metric (ISSUE 10): per-iteration
+    # batch size (the median of consecutive timesteps_total deltas —
+    # robust to a resume gap or a dropped row) over the STEADY iteration
+    # time, so the regression gate judges rollout throughput directly
+    # instead of only iter ms. Differs from timesteps_per_sec above,
+    # which divides by wall-clock time between rows (logging, drain and
+    # checkpoint stalls included).
+    env_steps_per_sec = None
+    batch_per_iter = None
+    ts_vals = [
+        _finite((r.get("stats") or {}).get("timesteps_total"))
+        for r in iters
+    ]
+    deltas = sorted(
+        b - a
+        for a, b in zip(ts_vals, ts_vals[1:])
+        if a is not None and b is not None and b > a
+    )
+    if deltas:
+        batch_per_iter = deltas[len(deltas) // 2]
+    if batch_per_iter and steady_ms:
+        env_steps_per_sec = batch_per_iter / (steady_ms / 1e3)
     rewards = [
         (r.get("stats") or {}).get("reward_running") for r in iters
     ]
@@ -433,6 +455,8 @@ def summarize_run(records: list) -> dict:
         "final_reward_running": rewards[-1] if rewards else None,
         "steady_iteration_ms": steady_ms,
         "timesteps_per_sec": throughput,
+        "env_steps_per_sec": env_steps_per_sec,
+        "batch_per_iteration": batch_per_iter,
         "phases": phase_table,
         "health": dict(sorted(health.items())),
         "recompiles": {
@@ -466,6 +490,9 @@ def summarize_run(records: list) -> dict:
 _METRIC_DIRECTIONS = {
     "steady_iteration_ms": "time",
     "timesteps_per_sec": "rate",
+    # rollout throughput judged directly (ISSUE 10): batch/iteration over
+    # steady iteration time — shrink = regress, like any rate
+    "env_steps_per_sec": "rate",
     # reward parity (ISSUE 8's mixed-precision gate: a ladder run must
     # land within the threshold of its f32 twin; identical-config gate
     # legs are seed-deterministic, so the row is exact there)
@@ -736,6 +763,7 @@ def render_summary(summary: dict) -> str:
         f" (last={summary['last_iteration']})"
         f"  steady_iteration_ms={_fmt(summary['steady_iteration_ms'])}"
         f"  timesteps/s={_fmt(summary['timesteps_per_sec'], 1)}"
+        f"  env-steps/s={_fmt(summary.get('env_steps_per_sec'), 1)}"
         f"  final_reward_running={_fmt(summary['final_reward_running'])}"
     )
     phases = summary.get("phases") or {}

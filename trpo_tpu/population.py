@@ -11,6 +11,14 @@ competes against, the batch sharding inside each member).
 
 Typical uses: seed sweeps at the cost of one (batched) run, and
 population-based selection (``best_member``).
+
+The member axis composes with the wide-N env fleet (ISSUE 10): an agent
+built from a ``*-fleet`` preset (or ``cfg.fleet_n_envs`` /
+``cfg.rollout_chunk``) vmaps here unchanged — members × fleet × time
+is ONE device program, the chunked rollout scan included, because the
+chunking is internal to the rollout's own scan structure
+(tests/test_env_fleet.py pins member-wise equality vs the unchunked
+population).
 """
 
 from __future__ import annotations

@@ -28,6 +28,7 @@ from trpo_tpu.models.policy import Policy
 __all__ = [
     "Trajectory",
     "device_rollout",
+    "ChunkedRollout",
     "init_env_states",
     "host_rollout",
     "pipelined_host_rollout",
@@ -67,41 +68,11 @@ def init_env_states(env, key, n_envs: int):
     return states, obs
 
 
-def device_rollout(
-    env,
-    policy: Policy,
-    params,
-    carry,
-    key,
-    n_steps: int,
-    deterministic: bool = False,
-):
-    """Collect ``n_steps × n_envs`` transitions fully on-device.
-
-    ``carry`` is ``(env_states, obs, episode_return, episode_length)`` from
-    :func:`init_env_states` / a previous call — env state persists across
-    training iterations so episodes continue rather than restarting every
-    batch (the reference restarts its env every batch, discarding progress
-    mid-episode — see ``utils.py:22-26``).
-
-    ``deterministic=True`` acts greedily (distribution mode) instead of
-    sampling — the reference's eval path (``trpo_inksci.py:82-83``) minus
-    the render call.
-
-    Jit-safe: designed to be traced inside the full training-step program.
-    Returns ``(new_carry, Trajectory)``.
-
-    Recurrent policies (``models/recurrent.py``): the carry gains the policy
-    hidden state and a ``prev_done`` flag — ``h`` threads through the scan,
-    is zeroed at episode boundaries, and the emitted trajectory carries the
-    ``reset`` flags + window-entry ``h0`` the training replay needs.
-    """
-    recurrent = hasattr(policy, "step")
-    if recurrent:
-        env_states, obs0, ep_ret0, ep_len0, h0, prev_done0 = carry
-    else:
-        env_states, obs0, ep_ret0, ep_len0 = carry
-        h0 = prev_done0 = None
+def _make_step_fn(env, policy: Policy, params, deterministic: bool,
+                  recurrent: bool):
+    """The ONE rollout scan body (shared by the unchunked, in-graph
+    chunked, and host-driven chunked paths — chunking must never fork the
+    step semantics): ``(carry, step_key) -> (carry, Trajectory_step)``."""
 
     def step_fn(c, step_key):
         if recurrent:
@@ -165,15 +136,199 @@ def device_rollout(
             ), out
         return (carried_states, carried_obs, ep_ret, ep_len), out
 
-    step_keys = jax.random.split(key, n_steps)
+    return step_fn
+
+
+def _rollout_scan(env, policy: Policy, params, carry, step_keys,
+                  deterministic: bool = False):
+    """Scan the shared step body over pre-split ``step_keys``; returns
+    ``(new_carry, Trajectory)`` with ``policy_h0`` filled for recurrent
+    policies. The common core of :func:`device_rollout` and the
+    :class:`ChunkedRollout` chunk program."""
+    recurrent = hasattr(policy, "step")
+    step_fn = _make_step_fn(env, policy, params, deterministic, recurrent)
+    new_carry, traj = jax.lax.scan(step_fn, carry, step_keys)
     if recurrent:
-        init = (env_states, obs0, ep_ret0, ep_len0, h0, prev_done0)
-    else:
-        init = (env_states, obs0, ep_ret0, ep_len0)
-    new_carry, traj = jax.lax.scan(step_fn, init, step_keys)
-    if recurrent:
-        traj = traj._replace(policy_h0=h0)
+        traj = traj._replace(policy_h0=carry[4])
     return new_carry, traj
+
+
+def device_rollout(
+    env,
+    policy: Policy,
+    params,
+    carry,
+    key,
+    n_steps: int,
+    deterministic: bool = False,
+    chunk: int = None,
+):
+    """Collect ``n_steps × n_envs`` transitions fully on-device.
+
+    ``carry`` is ``(env_states, obs, episode_return, episode_length)`` from
+    :func:`init_env_states` / a previous call — env state persists across
+    training iterations so episodes continue rather than restarting every
+    batch (the reference restarts its env every batch, discarding progress
+    mid-episode — see ``utils.py:22-26``).
+
+    ``deterministic=True`` acts greedily (distribution mode) instead of
+    sampling — the reference's eval path (``trpo_inksci.py:82-83``) minus
+    the render call.
+
+    Jit-safe: designed to be traced inside the full training-step program.
+    Returns ``(new_carry, Trajectory)``.
+
+    ``chunk`` (``cfg.rollout_chunk``): time-chunked rollout — an outer
+    ``lax.scan`` over ``n_steps // chunk`` time-chunks of the SAME step
+    body, the env-state/obs-norm/policy carry threaded through the chunk
+    boundary, each chunk's live emission buffer ``(chunk, N, ...)``. The
+    stacked chunks reshape back to the ``(T, N, ...)`` layout GAE and the
+    critic fit consume, so the chunked path is BIT-EXACT vs unchunked
+    (same per-step keys, same step order, same float ops — pinned by
+    tests/test_env_fleet.py, auto-reset, truncation bootstrap and
+    recurrent ``policy_h`` threading included). ``chunk`` must divide
+    ``n_steps``; ``None``/``n_steps`` is the single flat scan.
+
+    Recurrent policies (``models/recurrent.py``): the carry gains the policy
+    hidden state and a ``prev_done`` flag — ``h`` threads through the scan,
+    is zeroed at episode boundaries, and the emitted trajectory carries the
+    ``reset`` flags + window-entry ``h0`` the training replay needs.
+    """
+    recurrent = hasattr(policy, "step")
+    if chunk is not None and not 1 <= chunk <= n_steps:
+        raise ValueError(
+            f"rollout chunk must be in [1, n_steps={n_steps}], got {chunk}"
+        )
+    if chunk is not None and n_steps % chunk:
+        raise ValueError(
+            f"rollout chunk ({chunk}) must divide the steps per rollout "
+            f"({n_steps}) — pad batch_timesteps or pick a divisor"
+        )
+    step_keys = jax.random.split(key, n_steps)
+    if chunk is None or chunk == n_steps:
+        return _rollout_scan(
+            env, policy, params, carry, step_keys, deterministic
+        )
+
+    step_fn = _make_step_fn(env, policy, params, deterministic, recurrent)
+    n_chunks = n_steps // chunk
+    # (T, ...) keys -> (n_chunks, chunk, ...): trailing key dims (typed
+    # keys have none; legacy uint32 keys carry (2,)) ride along untouched
+    keys_c = step_keys.reshape((n_chunks, chunk) + step_keys.shape[1:])
+
+    def chunk_body(c, chunk_keys):
+        return jax.lax.scan(step_fn, c, chunk_keys)
+
+    new_carry, traj = jax.lax.scan(chunk_body, carry, keys_c)
+    # (n_chunks, chunk, N, ...) -> (T, N, ...): row-major reshape of the
+    # stacked chunks IS the unchunked stacking order
+    traj = jax.tree_util.tree_map(
+        lambda x: x.reshape((n_steps,) + x.shape[2:]), traj
+    )
+    if recurrent:
+        traj = traj._replace(policy_h0=carry[4])
+    return new_carry, traj
+
+
+class ChunkedRollout:
+    """Host-driven time-chunked rollout: ONE compiled chunk program,
+    looped over ``n_steps // chunk`` chunks.
+
+    Where :func:`device_rollout`'s ``chunk`` mode nests the time-chunks
+    inside one traced program (for the fused iteration), this driver jits
+    the chunk alone — so (a) the COMPILED program's memory grows with
+    ``chunk``, not with the total horizon ``T`` (the ``env_fleet`` bench
+    quotes ``program_memory_analysis`` of exactly this program), and
+    (b) changing the chunk COUNT (any ``n_steps`` multiple of ``chunk``
+    at fixed ``(chunk, N)`` shapes) re-runs the same executable with
+    ZERO retraces (``self.traces`` pins it in tests).
+
+    The per-chunk memory claim belongs to the CONSUMPTION mode:
+    :meth:`iter_chunks` streams one ``(chunk, N, ...)`` emission at a
+    time (plus the donated carry) — only that chunk and the carry are
+    live between dispatches. :meth:`__call__` is the convenience that
+    assembles the full ``(T, N, ...)`` trajectory, which by construction
+    holds every chunk live and transiently ~2× the trajectory during the
+    final concatenation — a rollout sized against the memory ceiling
+    must consume :meth:`iter_chunks` instead.
+
+    Donation contract (the agent module docstring's rule, applied at the
+    chunk boundary): every call DONATES the carry it is given — the env
+    states / episode accumulators / recurrent ``h`` buffers are reused in
+    place for the next chunk's carry, so a T-step rollout holds ONE
+    carry-sized working set regardless of chunk count. The caller's
+    original carry is dead after ``__call__``; keep using the returned
+    one.
+
+    Bit-exact vs :func:`device_rollout` (chunked or not): same step body
+    (``_make_step_fn``), same ``jax.random.split(key, n_steps)`` key
+    sequence, chunks concatenated in time order.
+    """
+
+    def __init__(self, env, policy: Policy, chunk: int,
+                 deterministic: bool = False):
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.env = env
+        self.policy = policy
+        self.chunk = chunk
+        self.deterministic = deterministic
+        self.traces = 0  # trace counter — tests pin zero retraces
+
+        def chunk_prog(params, carry, step_keys):
+            self.traces += 1
+            return _rollout_scan(
+                env, policy, params, carry, step_keys, deterministic
+            )
+
+        # donate the carry: chunk i+1's carry reuses chunk i's buffers
+        self._fn = jax.jit(chunk_prog, donate_argnums=1)
+
+    def iter_chunks(self, params, carry, key, n_steps: int):
+        """Stream the rollout chunk by chunk: yields ``(carry_after,
+        Trajectory_chunk)`` per chunk, each trajectory ``(chunk, N,
+        ...)`` — the memory-winning consumption mode (one chunk + the
+        donated carry live at a time; class docstring). The carry of the
+        LAST yield is the rollout's final carry; each chunk's
+        ``policy_h0`` is that chunk's own entry memory. ``carry`` is
+        DONATED."""
+        c = self.chunk
+        if n_steps < 1 or n_steps % c:
+            raise ValueError(
+                f"n_steps ({n_steps}) must be a positive multiple of the "
+                f"chunk ({c})"
+            )
+        keys = jax.random.split(key, n_steps)
+        for i in range(n_steps // c):
+            carry, traj = self._fn(params, carry, keys[i * c:(i + 1) * c])
+            yield carry, traj
+
+    def __call__(self, params, carry, key, n_steps: int):
+        """Roll ``n_steps`` (a multiple of ``chunk``) steps; returns
+        ``(new_carry, Trajectory)`` with the standard ``(T, N, ...)``
+        layout — assembled from every chunk, so the full trajectory
+        (transiently ~2×, during the concatenation) is live; use
+        :meth:`iter_chunks` when that footprint is the constraint.
+        ``carry`` is DONATED (class docstring)."""
+        recurrent = hasattr(self.policy, "step")
+        parts = []
+        h0 = None
+        for carry, traj in self.iter_chunks(params, carry, key, n_steps):
+            if recurrent:
+                if h0 is None:
+                    h0 = traj.policy_h0  # window-entry memory: chunk 0's
+                # per-chunk h0 is (N, H) — strip before the time concat
+                traj = traj._replace(policy_h0=None)
+            parts.append(traj)
+        if len(parts) == 1:
+            traj = parts[0]
+        else:
+            traj = jax.tree_util.tree_map(
+                lambda *xs: jnp.concatenate(xs, axis=0), *parts
+            )
+        if recurrent:
+            traj = traj._replace(policy_h0=h0)
+        return carry, traj
 
 
 def init_carry(env, key, n_envs: int, policy=None):

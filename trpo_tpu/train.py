@@ -45,6 +45,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int)
     p.add_argument("--n-envs", type=int)
     p.add_argument("--batch-timesteps", type=int)
+    p.add_argument(
+        "--fleet-n-envs",
+        type=_positive_int,
+        help="wide-N env fleet (overrides n-envs): widen the vectorized "
+        "fleet while batch-timesteps holds the total T*N budget, so the "
+        "rollout trades scan depth for vmap width (the *-fleet presets' "
+        "mechanism); device and native: envs take any width, gym:/"
+        "gymproc: error above the host fleet cap",
+    )
+    p.add_argument(
+        "--rollout-chunk",
+        type=_positive_int,
+        help="time-chunked device rollout: scan the rollout in chunks of "
+        "this many steps (must divide ceil(batch-timesteps / n-envs)); "
+        "bit-exact vs unchunked, live rollout emission buffer becomes "
+        "(chunk, N, ...) in the host-driven chunk driver",
+    )
     p.add_argument("--max-kl", type=float)
     p.add_argument("--cg-iters", type=int)
     p.add_argument("--cg-damping", type=float)
@@ -379,6 +396,8 @@ _OVERRIDES = {
     "iterations": "n_iterations",
     "seed": "seed",
     "n_envs": "n_envs",
+    "fleet_n_envs": "fleet_n_envs",
+    "rollout_chunk": "rollout_chunk",
     "batch_timesteps": "batch_timesteps",
     "max_kl": "max_kl",
     "cg_iters": "cg_iters",
