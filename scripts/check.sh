@@ -36,6 +36,10 @@ echo "== tier-1: replicated serving (replica set, router, sessions) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q \
     -m 'not slow'
 
+echo "== tier-1: serving failover (carry journal, seq dedupe, canary) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_failover.py -q \
+    -m 'not slow'
+
 echo "== tier-1: env fleet (chunked rollouts, wide-N presets, env-steps/s) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_env_fleet.py -q \
     -m 'not slow'
@@ -178,19 +182,29 @@ python scripts/validate_events.py "$SERVE_TMP/base/serve_events.jsonl" \
 python scripts/analyze_run.py "$SERVE_TMP/new/serve_events.jsonl" \
     --compare "$SERVE_TMP/base/serve_events.jsonl" --threshold-pct 500
 
-echo "== router chaos smoke: replica killed under load + scale gate =="
-# the ISSUE 9 acceptance scenario: (a) 4-replica closed-loop actions/s
-# must be >= 3x the single replica at equal-or-better p99 (simulated
-# 60 ms device cost — capacity-limited replicas, the regime where
-# replication pays; TPU rows are a ROADMAP follow-up); (b) a replica
-# killed under concurrent load must be evicted, the in-flight request
-# transparently retried (exactly once), the replica restarted after
-# backoff, with ZERO client-visible errors; (c) a recurrent policy is
-# served end-to-end through the session API with actions BIT-EXACT vs
-# direct act(), and a session on the killed replica re-establishes on
-# the survivor from a fresh carry. The event log must validate
-# (router died -> restarted/evicted resolution) and analyze (the
-# per-replica table + scaling row).
+echo "== router chaos smoke: kill/resume under load, canary gate, scale =="
+# the ISSUE 9 + ISSUE 11 acceptance scenario: (a) 4-replica closed-loop
+# actions/s must be >= 3x the single replica at equal-or-better p99
+# (simulated 60 ms device cost — capacity-limited replicas, the regime
+# where replication pays; TPU rows are a ROADMAP follow-up); (b) a
+# replica killed under concurrent load must be evicted, the in-flight
+# request transparently retried (exactly once), the replica restarted
+# after backoff, with ZERO client-visible errors; (c) a recurrent
+# policy is served end-to-end through the session API with actions
+# BIT-EXACT vs direct act(), and a session on the killed replica
+# re-establishes on the survivor from a fresh carry; (d) ISSUE 11
+# lossless failover: with the carry journal on, the pinned replica is
+# killed UNDER CONCURRENT SESSION LOAD via the chaos injector
+# (kill_replica@request=K) and the session RESUMES from the journaled
+# carry (`resumed: true`, continuation BIT-EXACT vs an uninterrupted
+# session); (e) a wedge_reload-poisoned checkpoint (loads fine,
+# answers NaN) is REJECTED by the canary gate (rolled_back +
+# health:canary_rejected, incumbent keeps serving) and a clean step
+# then promotes to the whole set — zero client-visible errors either
+# way. The event log must validate (router died -> restarted/evicted,
+# canary started -> promoted/rolled_back, every injected serving
+# fault matched by its detection record) and analyze (per-replica
+# table + scaling row + failover/canary rows).
 ROUTER_TMP=$(mktemp -d)
 JAX_PLATFORMS=cpu python scripts/router_smoke.py --tmp "$ROUTER_TMP"
 python scripts/validate_events.py "$ROUTER_TMP/router_events.jsonl"

@@ -1,9 +1,10 @@
 #!/usr/bin/env python
-"""Router chaos + scale smoke (``check.sh``): the ISSUE 9 acceptance.
+"""Router chaos + scale smoke (``check.sh``): the ISSUE 9 + ISSUE 11
+acceptance.
 
     python scripts/router_smoke.py --tmp DIR
 
-Four legs, end to end in one process:
+Six legs, end to end in one process:
 
 1. **Scale gate** — ``bench.serving_scale_bench`` at 1 and 4 replicas
    (closed loop through the router, simulated 60 ms device cost —
@@ -14,15 +15,30 @@ Four legs, end to end in one process:
    still answer 200 (the transparent retry), the dead replica must be
    evicted immediately and restarted by the supervisor within its
    backoff, and the set must end healthy×3.
-3. **Sessions under chaos** — 2 recurrent replicas; a session's
-   actions through the router must be BIT-EXACT vs driving
-   ``agent.act(..., policy_carry=...)`` by hand; killing the pinned
-   replica must re-establish the session on the survivor from a fresh
-   carry (``reestablished: true``) with zero client-visible errors.
-4. The whole run's ``router``/``session`` event log is left at
-   ``DIR/router_events.jsonl`` for ``scripts/validate_events.py`` (the
-   died→restarted/evicted contract) and ``scripts/analyze_run.py``
-   (per-replica table + scaling row).
+3. **Sessions under chaos** — 2 recurrent replicas (no carry journal:
+   the ISSUE 9 baseline); a session's actions through the router must
+   be BIT-EXACT vs driving ``agent.act(..., policy_carry=...)`` by
+   hand; killing the pinned replica must re-establish the session on
+   the survivor from a fresh carry (``reestablished: true``) with
+   zero client-visible errors.
+4. **Lossless failover** (ISSUE 11) — 2 recurrent replicas WITH the
+   carry journal; the session's pinned replica is killed UNDER
+   CONCURRENT SESSION LOAD via the chaos injector
+   (``kill_replica@request=K``): the next act answers ``resumed:
+   true`` with the replayed step count and the continuation is
+   BIT-EXACT vs an uninterrupted session — zero client-visible errors
+   across every concurrent session.
+5. **Canary gate** (ISSUE 11) — 3 managed feedforward replicas behind
+   a ``CanaryController``; a ``wedge_reload``-poisoned checkpoint is
+   pushed (loads fine, answers NaN): the canary must REJECT it
+   (``rolled_back`` + ``health:canary_rejected``) while the incumbent
+   keeps serving and clients see zero errors; a clean step then
+   PROMOTES to the whole set.
+6. The whole run's event log is left at ``DIR/router_events.jsonl``
+   for ``scripts/validate_events.py`` (died→restarted/evicted,
+   canary started→terminal, every injected serving fault matched by
+   its detection record) and ``scripts/analyze_run.py`` (per-replica
+   table + scaling row + failover/canary rows).
 
 Exit 0 on success; any assertion failure exits nonzero with the reason.
 """
@@ -283,6 +299,261 @@ def main(argv=None) -> int:
     finally:
         router.close()
         rs.close()
+
+    # -- 4. lossless failover: journaled carry survives the kill ---------
+    from trpo_tpu.resilience.inject import FaultInjector
+
+    jdir = os.path.join(args.tmp, "carry_journal")
+
+    def dur_factory(rid):
+        def factory():
+            engine = ragent.serve_session_engine()
+            engine.load(rstate.policy_params, rstate.obs_norm, step=1)
+            server = PolicyServer(
+                engine, None, port=0, bus=bus, replica_name=rid,
+                carry_journal_dir=jdir, carry_sync_every=1,
+            )
+            return server, []
+
+        return factory
+
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(dur_factory(rid)), 2,
+        health_interval=1.0, backoff=0.2, health_fail_threshold=1,
+        bus=bus,
+    )
+    rs.start()
+    assert rs.wait_healthy(2, timeout=60.0), rs.snapshot()
+    router = Router(rs, port=0, bus=bus, journal_dir=jdir)
+    try:
+        status, out = _post(router.url + "/session")
+        assert status == 200, out
+        sid, pinned = out["session"], out["replica"]
+
+        # concurrent session load: background sessions keep stepping
+        # while the main session's replica dies under them
+        stop = threading.Event()
+        bg_errors: list = []
+
+        def bg_session(seed: int) -> None:
+            s, o = _post(router.url + "/session")
+            if s != 200:
+                bg_errors.append((s, o))
+                return
+            bsid = o["session"]
+            r = np.random.RandomState(seed)
+            while not stop.is_set():
+                try:
+                    s, o = _post(
+                        router.url + f"/session/{bsid}/act",
+                        {"obs": r.randn(*ragent.obs_shape).tolist()},
+                    )
+                    if s != 200:
+                        bg_errors.append((s, o))
+                except Exception as e:  # noqa: BLE001 — collected
+                    bg_errors.append(repr(e))
+
+        bg = [
+            threading.Thread(target=bg_session, args=(i,), daemon=True)
+            for i in range(3)
+        ]
+        for t in bg:
+            t.start()
+
+        obs_seq = [
+            np.random.RandomState(100 + i)
+            .randn(*ragent.obs_shape).astype(np.float32)
+            for i in range(8)
+        ]
+        carry = None
+        direct = []
+        for o in obs_seq:
+            a, _d, carry = ragent.act(
+                rstate, o, eval_mode=True, policy_carry=carry
+            )
+            direct.append(np.asarray(a, np.float64))
+        for t in range(5):
+            status, out = _post(
+                router.url + f"/session/{sid}/act",
+                {"obs": obs_seq[t].tolist()},
+            )
+            assert status == 200, out
+            assert np.array_equal(
+                np.asarray(out["action"], np.float64), direct[t]
+            ), f"journaled session diverged at step {t}"
+        # snapshot current, then kill the pinned replica via the
+        # injector's request clock (the serving chaos grammar)
+        rs.replicas[pinned].handle.server.sessions.journal.drain()
+        router.injector = FaultInjector.from_spec(
+            f"kill_replica@request=1:replica={int(pinned[1:])}",
+            bus=bus,
+        )
+        status, out = _post(
+            router.url + f"/session/{sid}/act",
+            {"obs": obs_seq[5].tolist()},
+        )
+        assert status == 200, out
+        assert out.get("resumed") is True, out
+        assert out.get("resumed_steps") == 5, out
+        assert np.array_equal(
+            np.asarray(out["action"], np.float64), direct[5]
+        ), "resumed act diverged from the uninterrupted session"
+        for t in (6, 7):
+            status, out = _post(
+                router.url + f"/session/{sid}/act",
+                {"obs": obs_seq[t].tolist()},
+            )
+            assert status == 200 and "resumed" not in out, out
+            assert np.array_equal(
+                np.asarray(out["action"], np.float64), direct[t]
+            ), f"post-resume continuation diverged at step {t}"
+        stop.set()
+        for t in bg:
+            t.join(timeout=30.0)
+            assert not t.is_alive(), "background session hung"
+        assert not bg_errors, (
+            f"{len(bg_errors)} client-visible errors in concurrent "
+            f"sessions: {bg_errors[:5]}"
+        )
+        assert router.injector.all_fired
+        print(
+            f"failover: pinned replica {pinned} killed under "
+            "concurrent session load -> resumed: true from the carry "
+            "journal (5 replayed steps), continuation BIT-EXACT, "
+            "zero client-visible errors"
+        )
+    finally:
+        router.close()
+        rs.close()
+
+    # -- 5. canary gate: wedge rejected, clean step promoted -------------
+    from trpo_tpu.serve import CanaryController
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    ck_dir = os.path.join(args.tmp, "canary_ck")
+    ccfg = TRPOConfig(
+        n_envs=4, batch_timesteps=32, policy_hidden=(8,), vf_hidden=(8,),
+        seed=5, serve_batch_shapes=(1, 2),
+    )
+    cagent = TRPOAgent("pendulum", ccfg)  # continuous: a NaN wedge is
+    #                                       visible in the actions
+    cstate = cagent.init_state(seed=0)
+    trainer_ck = Checkpointer(ck_dir)
+    trainer_ck.save(1, cstate)
+    injector = FaultInjector.from_spec("wedge_reload@step=2", bus=bus)
+    incumbent = {"step": None}
+
+    def managed_factory(rid):
+        def factory():
+            engine = cagent.serve_engine()
+            batcher = MicroBatcher(engine, deadline_ms=5.0)
+            server = PolicyServer(
+                engine, batcher, port=0, bus=bus, replica_name=rid,
+                checkpointer=Checkpointer(ck_dir),
+                template=cagent.init_state(),
+                poll_interval=60.0,
+                managed_reload=True,
+                initial_step=incumbent["step"],
+                injector=injector,
+            )
+            return server, [batcher]
+
+        return factory
+
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(managed_factory(rid)), 3,
+        health_interval=0.2, backoff=0.1, health_fail_threshold=2,
+        bus=bus,
+    )
+    rs.start()
+    assert rs.wait_healthy(3, timeout=120.0), rs.snapshot()
+    router = Router(rs, port=0, bus=bus, canary_fraction=0.5)
+    ctrl_ck = Checkpointer(ck_dir)
+    controller = CanaryController(
+        rs, router, lambda: ctrl_ck.latest_step(refresh=True),
+        incumbent=incumbent, window_requests=6, poll_interval=0.1,
+        gate_timeout_s=60.0, bus=bus,
+    )
+    try:
+        controller.tick()
+        assert incumbent["step"] == 1  # first checkpoint adopts ungated
+        stop = threading.Event()
+        cerrors: list = []
+
+        def canary_client(seed: int) -> None:
+            r = np.random.RandomState(seed)
+            while not stop.is_set():
+                try:
+                    s, o = _post(
+                        router.url + "/act",
+                        {"obs": r.randn(*cagent.obs_shape).tolist()},
+                    )
+                    if s != 200:
+                        cerrors.append((s, o))
+                except Exception as e:  # noqa: BLE001 — collected
+                    cerrors.append(repr(e))
+
+        threads = [
+            threading.Thread(target=canary_client, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+
+        def settle(step, timeout=20.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                snap = rs.snapshot()
+                if all(
+                    r["loaded_step"] == step
+                    for r in snap["replicas"].values()
+                ):
+                    return snap
+                time.sleep(0.05)
+            return rs.snapshot()
+
+        # the WEDGED step 2: must be rejected, incumbent keeps serving
+        trainer_ck.save(2, cstate)
+        controller.tick()
+        assert controller.rolled_back_total == 1, "wedge not rejected"
+        assert incumbent["step"] == 1
+        snap = settle(1)
+        assert all(
+            r["loaded_step"] == 1 for r in snap["replicas"].values()
+        ), snap
+
+        # a CLEAN step 3: must promote to the whole set
+        trainer_ck.save(3, cstate)
+        controller.tick()
+        assert controller.promoted_total == 1, "clean step not promoted"
+        assert incumbent["step"] == 3
+        snap = settle(3)
+        assert all(
+            r["loaded_step"] == 3 for r in snap["replicas"].values()
+        ), snap
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+            assert not t.is_alive(), "canary client hung"
+        assert not cerrors, (
+            f"{len(cerrors)} client-visible errors across the canary "
+            f"cycle: {cerrors[:5]}"
+        )
+        assert injector.all_fired, injector.unfired
+        print(
+            "canary: wedged step 2 rejected (rolled_back + "
+            "health:canary_rejected, incumbent kept serving), clean "
+            "step 3 promoted to all 3 replicas, zero client-visible "
+            "errors"
+        )
+    finally:
+        controller.close()
+        router.close()
+        rs.close()
+        trainer_ck.close()
+        ctrl_ck.close()
         bus.close()
 
     print(f"router smoke OK — events at {events_path}")

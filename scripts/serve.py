@@ -185,10 +185,58 @@ def build_parser() -> argparse.ArgumentParser:
         help="bounded session store size per replica (default 1024)",
     )
     p.add_argument(
+        "--carry-sync-every", type=int,
+        help="journal a session's carry every N applied steps (default "
+        "1 = lossless failover whenever the write-behind drain has "
+        "caught up); the router resumes a dead replica's sessions from "
+        "the journal instead of restarting them fresh",
+    )
+    p.add_argument(
+        "--carry-journal-dir",
+        help="directory for the per-replica carry journals (default: "
+        "<checkpoint-dir>/carry_journal when --replicas > 1 on a "
+        "recurrent policy; pass 'none' to disable durability)",
+    )
+    p.add_argument(
+        "--canary-fraction", type=float,
+        help="gated checkpoint deployment (default 0 = off): a new "
+        "step loads on ONE canary replica first, this fraction of "
+        "stateless traffic routes to it, and the rest of the set "
+        "follows only on a clean windowed p99 + action-parity gate "
+        "(a failed gate rolls the canary back and emits "
+        "health:canary_rejected)",
+    )
+    p.add_argument(
+        "--canary-window", type=int,
+        help="routed canary requests observed before the gate judges "
+        "(default 24)",
+    )
+    p.add_argument(
+        "--canary-parity-tol", type=float,
+        help="max mean |canary - incumbent| action difference on "
+        "mirrored obs (default: unset — the parity sample only "
+        "requires finite actions)",
+    )
+    p.add_argument(
+        "--inject-faults",
+        help="serving-plane chaos spec (resilience/inject.py grammar): "
+        "kill_replica@request=K:replica=R, "
+        "stall_replica@request=K:replica=R:seconds=S, "
+        "wedge_reload@step=N, drop_carry_journal@request=K:replica=R",
+    )
+    p.add_argument(
         "--run-descriptor",
         help="write an atomic run.json here at startup (pid, bound "
         "port, url, endpoints) — tooling discovery without stdout "
         "parsing (the PR 7 pattern)",
+    )
+    p.add_argument(
+        "--replica-name",
+        help="name this single-server process as a replica (default "
+        "'solo'): a SubprocessReplica supervisor passes its replica id "
+        "here so the carry journal lands at "
+        "<carry-journal-dir>/<name>.carry.jsonl — the path the parent "
+        "router resumes from",
     )
     return p
 
@@ -215,6 +263,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from trpo_tpu.config import get_preset
     from trpo_tpu.obs.events import EventBus, JsonlSink, manifest_fields
     from trpo_tpu.serve import (
+        CanaryController,
         InProcessReplica,
         MicroBatcher,
         PolicyServer,
@@ -269,11 +318,55 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         updates["serve_session_ttl"] = args.session_ttl
     if args.max_sessions is not None:
         updates["serve_max_sessions"] = args.max_sessions
+    if args.carry_sync_every is not None:
+        updates["serve_carry_sync_every"] = args.carry_sync_every
+    if args.canary_fraction is not None:
+        updates["serve_canary_fraction"] = args.canary_fraction
+    if args.canary_window is not None:
+        updates["serve_canary_window"] = args.canary_window
     if updates:
         cfg = cfg.replace(**updates)
 
     agent = TRPOAgent(cfg.env, cfg)
     recurrent = agent.is_recurrent
+
+    injector = None
+    if args.inject_faults:
+        from trpo_tpu.resilience.inject import FaultInjector
+
+        injector = FaultInjector.from_spec(args.inject_faults)
+
+    # carry durability: replicated recurrent serving journals by
+    # default (losing a session's carry with its replica is the ISSUE 9
+    # behavior this PR retires); 'none' opts out
+    journal_dir = None
+    if recurrent and args.carry_journal_dir != "none":
+        if args.carry_journal_dir:
+            journal_dir = args.carry_journal_dir
+        elif (args.replicas or cfg.serve_replicas) > 1:
+            journal_dir = os.path.join(
+                os.path.abspath(args.checkpoint_dir), "carry_journal"
+            )
+
+    canary = cfg.serve_canary_fraction > 0 and cfg.serve_replicas > 1
+    if canary and recurrent:
+        # the gate windows STATELESS traffic and keeps sessions off the
+        # canary — a recurrent set would starve every gate window and
+        # blacklist every new checkpoint. Refuse loudly instead of
+        # silently pinning the fleet to its first checkpoint.
+        print(
+            "error: --canary-fraction gates stateless /act traffic; a "
+            "recurrent policy serves only sessions (which never route "
+            "to the canary), so no gate window could ever fill. Run "
+            "recurrent serving without --canary-fraction (session-"
+            "aware gating is a ROADMAP item).",
+            file=sys.stderr,
+        )
+        return 2
+    # the shared incumbent cell: the canary controller promotes into
+    # it; a replica (re)launched mid-gate reads it so it never comes up
+    # wearing the unvalidated step
+    incumbent = {"step": None}
 
     bus = None
     if args.metrics_jsonl:
@@ -287,15 +380,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "checkpoint_dir": os.path.abspath(args.checkpoint_dir),
                     "replicas": cfg.serve_replicas,
                     "recurrent": recurrent,
+                    "canary_fraction": cfg.serve_canary_fraction,
+                    "carry_journal": journal_dir,
                 },
             ),
         )
+    if injector is not None:
+        injector.bus = bus
 
     def build_replica(replica_name: Optional[str], port: int):
         """One complete serving stack: the right engine for the model
         family (recurrent → session protocol; the structured 409s on
         the wrong endpoint come from PolicyServer), its own checkpoint
-        watcher, its own port."""
+        watcher, its own port. Under canary deployment the replica runs
+        MANAGED reload pinned to the current incumbent step — a
+        relaunch mid-gate must never come up wearing the step under
+        test."""
         checkpointer = Checkpointer(
             args.checkpoint_dir, cg_damping_seed=cfg.cg_damping, bus=bus
         )
@@ -322,13 +422,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             session_ttl_s=cfg.serve_session_ttl,
             max_sessions=cfg.serve_max_sessions,
             replica_name=replica_name,
+            carry_journal_dir=journal_dir,
+            carry_sync_every=cfg.serve_carry_sync_every,
+            managed_reload=canary,
+            initial_step=incumbent["step"],
+            injector=injector,
         )
         closers = ([batcher] if batcher is not None else []) + [
             checkpointer
         ]
         return server, closers
 
-    replicaset = router = None
+    replicaset = router = controller = None
     server = None
     closers: list = []
     if cfg.serve_replicas > 1:
@@ -350,11 +455,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             session_ttl_s=cfg.serve_session_ttl,
             max_sessions=cfg.serve_max_sessions,
             bus=bus,
+            journal_dir=journal_dir,
+            canary_fraction=cfg.serve_canary_fraction,
+            injector=injector,
         )
+        if canary:
+            canary_ck = Checkpointer(
+                args.checkpoint_dir, cg_damping_seed=cfg.cg_damping
+            )
+            controller = CanaryController(
+                replicaset,
+                router,
+                lambda: canary_ck.latest_step(refresh=True),
+                incumbent=incumbent,
+                window_requests=cfg.serve_canary_window,
+                parity_tol=args.canary_parity_tol,
+                poll_interval=cfg.serve_poll_interval,
+                bus=bus,
+            )
+            controller.start()
+            closers.append(canary_ck)
         front_url, endpoints = router.url, list(Router.ENDPOINTS)
         front_port = router.port
     else:
-        server, closers = build_replica(None, args.port)
+        server, closers = build_replica(args.replica_name, args.port)
         front_url, endpoints = server.url, list(server.ENDPOINTS)
         front_port = server.port
 
@@ -398,6 +522,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     try:
         done.wait(args.serve_seconds)
     finally:
+        if controller is not None:
+            controller.close()
         if router is not None:
             router.close()
         if replicaset is not None:
@@ -406,6 +532,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             server.close()
         for c in closers:
             c.close()
+        if injector is not None and injector.unfired:
+            # a chaos run whose faults never fired tested NOTHING —
+            # same loud-completion contract as the training injector
+            print(
+                "WARNING: injected faults never fired: "
+                + "; ".join(injector.unfired),
+                file=sys.stderr,
+                flush=True,
+            )
         if bus is not None:
             bus.close()
     if router is not None:

@@ -28,7 +28,14 @@ router log every ``router`` ``scope="replica"`` record with
 or ``evicted`` record (a death the replica supervisor never resolved
 means the restart-with-backoff loop is broken; malformed
 router/session records FAIL outright via the shared
-``validate_event``).
+``validate_event``); and — ISSUE 11 — every ``canary`` record with
+``event="started"`` must be FOLLOWED by the same step's ``promoted``
+or ``rolled_back`` terminal (an unresolved canary means the gate loop
+is broken), and the serving-plane faults (``kill_replica``,
+``stall_replica``, ``wedge_reload``, ``drop_carry_journal``) must each
+be matched by their detection record (died/evicted for the targeted
+replica or a routed retry; ``health:canary_rejected``;
+``session:reestablished``).
 Exits non-zero with per-line diagnostics on any failure; prints a
 per-kind count summary on success. Used by ``scripts/check.sh`` against
 both a training run's ``--metrics-jsonl`` output and ``bench.py``'s
@@ -59,10 +66,15 @@ sys.path.insert(
 _REQUIRED_ITERATION_COUNTERS = ("cg_iters_total", "linesearch_trials_total")
 
 
-def _fault_matcher(fault_kind: str):
+def _fault_matcher(fault_rec: dict):
     """Predicate over later records that counts as the detection/recovery
     response to one injected fault — or None when the fault is a pure
-    perturbation (``delay_step``) that nothing is required to react to."""
+    perturbation (``delay_step``) that nothing is required to react to.
+    Takes the whole ``fault_injected`` record: the serving-plane faults
+    (ISSUE 11) must be matched by the response to THEIR replica, not
+    any replica's."""
+    fault_kind = fault_rec.get("fault")
+    replica = fault_rec.get("replica")
     if fault_kind in ("kill_worker", "hang_worker"):
         return lambda rec: rec.get("kind") == "health" and str(
             rec.get("check", "")
@@ -75,6 +87,49 @@ def _fault_matcher(fault_kind: str):
     if fault_kind == "sigterm":
         return lambda rec: (
             rec.get("kind") == "health" and rec.get("check") == "preempted"
+        )
+    if fault_kind in ("kill_replica", "stall_replica"):
+        # the supervisor (or the router's report_failure) must have
+        # declared the targeted replica dead/evicted; a stall shorter
+        # than the request timeout may instead surface as the router's
+        # transparent retry — either is a detection
+        def _replica_dead(rec):
+            return (
+                rec.get("kind") == "router"
+                and rec.get("scope") == "replica"
+                and (replica is None or rec.get("replica") == replica)
+                and rec.get("state") in ("died", "evicted")
+            )
+
+        if fault_kind == "kill_replica":
+            return _replica_dead
+        return lambda rec: _replica_dead(rec) or (
+            rec.get("kind") == "router"
+            and rec.get("scope") == "request"
+            and rec.get("retried") is True
+        )
+    if fault_kind == "wedge_reload":
+        # the canary gate is the REQUIRED detector for a checkpoint
+        # that loads but answers garbage — for the WEDGED step, not
+        # some other step's rejection
+        at = fault_rec.get("at")
+        return lambda rec: (
+            rec.get("kind") == "health"
+            and rec.get("check") == "canary_rejected"
+            and (rec.get("data") or {}).get("step") == at
+        ) or (
+            rec.get("kind") == "canary"
+            and rec.get("event") == "rolled_back"
+            and rec.get("step") == at
+        )
+    if fault_kind == "drop_carry_journal":
+        # losing the journal must surface as the loud fresh-carry
+        # fallback, never as a silent wrong resume. (The reestablished
+        # record names the SURVIVOR replica, not the dropped journal's
+        # owner, so no replica-level pairing is possible here.)
+        return lambda rec: (
+            rec.get("kind") == "session"
+            and rec.get("event") == "reestablished"
         )
     return None
 
@@ -139,7 +194,7 @@ def validate_file(path: str) -> list:
     for idx, (n, rec) in enumerate(records):
         if rec.get("kind") != "fault_injected":
             continue
-        matcher = _fault_matcher(rec.get("fault"))
+        matcher = _fault_matcher(rec)
         if matcher is None:
             continue
         if not any(matcher(later) for _, later in records[idx + 1:]):
@@ -221,6 +276,24 @@ def validate_file(path: str) -> list:
             errs.append(
                 f"{path}:{n}: router replica {replica!r} died with no "
                 "matching restarted/evicted resolution record after it"
+            )
+    # ISSUE 11 canary contract (the fleet `preempted` pattern): a
+    # canary that started with no later promoted/rolled_back terminal
+    # for the same step means the gate loop is broken — an unvalidated
+    # checkpoint left wearing live traffic is not a valid log
+    for idx, (n, rec) in enumerate(records):
+        if rec.get("kind") != "canary" or rec.get("event") != "started":
+            continue
+        step = rec.get("step")
+        if not any(
+            later.get("kind") == "canary"
+            and later.get("step") == step
+            and later.get("event") in ("promoted", "rolled_back")
+            for _, later in records[idx + 1:]
+        ):
+            errs.append(
+                f"{path}:{n}: canary for step {step} started with no "
+                "matching promoted/rolled_back terminal record after it"
             )
     return errs
 

@@ -1,0 +1,1090 @@
+"""Lossless serving failover + canary promotion (ISSUE 11).
+
+Contracts pinned here:
+
+* the carry journal is write-behind (latest-wins pending, background
+  drain), self-compacting, tombstones evicted sessions, and — the
+  crash-window edge — an entry torn by ``kill -9`` mid-write reads as
+  ABSENT, never as a corrupt store;
+* the router stamps session acts with a per-session ``seq`` and the
+  replica dedupes a replayed seq (returns the stored action, does NOT
+  re-step the carry) — the retry-idempotency contract;
+* killing a session's pinned replica resumes it from the journaled
+  carry (``resumed: true`` + replayed step count), BIT-EXACT vs an
+  uninterrupted session when the snapshot is current; a restarted
+  replica's empty store (404 session_unknown) resumes the same way;
+  with no journal entry the router falls back to the ISSUE 9
+  fresh-carry path and says so (``reestablished: true``);
+* the serving-plane fault specs parse, fire once, and are matched by
+  their detection records (``drop_carry_journal`` → the loud
+  fresh-carry fallback; ``stall_replica`` → timeout/eviction/retry
+  with zero client-visible errors);
+* managed reload serves EXACTLY the commanded step (``POST /reload``),
+  rollback is an instant in-memory swap, unmanaged replicas refuse the
+  control route with a typed 409;
+* the canary gate: a wedged checkpoint (loads fine, answers NaN) is
+  rejected — rolled back with ``health:canary_rejected`` and zero
+  client-visible errors — while a clean step promotes to the whole
+  set; a canary killed mid-gate resolves to ``rolled_back`` and the
+  set stays healthy on the incumbent;
+* the validator FAILS a ``canary:started`` with no terminal
+  ``promoted``/``rolled_back``, and the analyze layer reports the
+  failover/canary rows under the 0/1/2 contract.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.obs.events import EventBus, validate_event
+from trpo_tpu.resilience.inject import FaultInjector, parse_fault_specs
+from trpo_tpu.serve import (
+    CanaryController,
+    CarryJournal,
+    InProcessReplica,
+    MicroBatcher,
+    PolicyServer,
+    ReplicaSet,
+    Router,
+    journal_path,
+    read_carry_journal,
+)
+
+_CFG = dict(
+    n_envs=4, batch_timesteps=32, cg_iters=2, vf_train_steps=2,
+    policy_hidden=(8,), vf_hidden=(8,), seed=11,
+    serve_batch_shapes=(1, 2),
+)
+
+
+@pytest.fixture(scope="module")
+def rec():
+    agent = TRPOAgent("pendulum", TRPOConfig(**{**_CFG, "policy_gru": 8}))
+    state = agent.init_state(seed=0)
+    return agent, state
+
+
+@pytest.fixture(scope="module")
+def ff():
+    agent = TRPOAgent("pendulum", TRPOConfig(**_CFG))
+    state = agent.init_state(seed=0)
+    return agent, state
+
+
+def _post(url, payload=None, timeout=30.0):
+    data = b"" if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _rec_factory(agent, state, bus=None, journal_dir=None, **server_kw):
+    def make(rid):
+        def factory():
+            engine = agent.serve_session_engine()
+            engine.load(state.policy_params, state.obs_norm, step=1)
+            server = PolicyServer(
+                engine, None, port=0, bus=bus, replica_name=rid,
+                carry_journal_dir=journal_dir, **server_kw,
+            )
+            return server, []
+
+        return factory
+
+    return make
+
+
+def _replicaset(make, n, bus=None, **kw):
+    kw.setdefault("health_interval", 60.0)
+    kw.setdefault("backoff", 0.05)
+    kw.setdefault("health_fail_threshold", 1)
+    kw.setdefault("max_restarts", 2)
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(make(rid)), n, bus=bus, **kw
+    )
+    assert rs.wait_healthy(n, timeout=60.0), rs.snapshot()
+    return rs
+
+
+def _direct_actions(agent, state, obs_seq):
+    carry = None
+    out = []
+    for o in obs_seq:
+        a, _d, carry = agent.act(
+            state, o, eval_mode=True, policy_carry=carry
+        )
+        out.append(np.asarray(a, np.float64))
+    return out
+
+
+def _obs_seq(agent, n, start=0):
+    return [
+        np.random.RandomState(start + i)
+        .randn(*agent.obs_shape).astype(np.float32)
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# carry journal (no HTTP, no jax)
+# ---------------------------------------------------------------------------
+
+
+def test_carry_journal_roundtrip_tombstones_and_compaction(tmp_path):
+    path = str(tmp_path / "r0.carry.jsonl")
+    j = CarryJournal(path, compact_factor=2, min_compact=8)
+    try:
+        for step in range(1, 6):
+            j.record({"session": "a", "steps": step,
+                      "carry": [float(step)] * 3, "seq": step})
+        j.record({"session": "b", "steps": 1, "carry": [9.0]})
+        assert j.drain()
+        # latest-wins per session, both in memory and on disk
+        assert j.lookup("a")["steps"] == 5
+        entries = read_carry_journal(path)
+        assert entries["a"]["steps"] == 5 and entries["a"]["seq"] == 5
+        assert entries["b"]["steps"] == 1
+        # tombstone: an evicted session must not be resurrected
+        j.forget("b")
+        assert j.drain()
+        assert j.lookup("b") is None
+        assert "b" not in read_carry_journal(path)
+        # compaction keeps the file bounded around the live set
+        for k in range(40):
+            j.record({"session": "a", "steps": 100 + k, "carry": [1.0]})
+            j.drain()
+        assert j.compactions_total >= 1
+        with open(path) as f:
+            assert len(f.readlines()) <= 16
+        assert read_carry_journal(path)["a"]["steps"] == 139
+    finally:
+        j.close()
+    # a new incarnation on the same path inherits the entries
+    j2 = CarryJournal(path)
+    try:
+        assert j2.lookup("a")["steps"] == 139
+    finally:
+        j2.close()
+
+
+def test_abandon_drops_pending_like_a_crash(tmp_path):
+    """The chaos-kill path (`InProcessReplica.kill` →
+    `PolicyServer.close(abrupt=True)` → `SessionStore.close(flush=
+    False)` → `CarryJournal.abandon`) must DROP the write-behind
+    window exactly as a real crash would — a graceful flush on an
+    injected kill would make the durability window untestable."""
+    from trpo_tpu.serve import SessionStore
+
+    path = str(tmp_path / "r0.carry.jsonl")
+    # poll_interval 60: the writer only moves when record() wakes it,
+    # so an entry injected WITHOUT a wake models the unflushed window
+    j = CarryJournal(path, poll_interval=60.0)
+    store = SessionStore(journal=j)
+    try:
+        store.create(
+            np.zeros(2, np.float32), session_id="flushed", steps=3,
+        )
+        assert j.drain()
+        with j._lock:
+            j._pending["pending"] = {
+                "session": "pending", "steps": 9, "carry": [9.0],
+            }
+            j._idle.clear()
+    finally:
+        store.close(flush=False)  # the kill path
+    entries = read_carry_journal(path)
+    assert "flushed" in entries
+    assert "pending" not in entries  # the crash window was LOST
+
+
+def test_engine_rollback_is_one_shot(ff):
+    """A duplicated rollback (operator retry after an ambiguous
+    timeout) must refuse — never reinstate the rejected snapshot."""
+    agent, state = ff
+    engine = agent.serve_engine()
+    engine.load(state.policy_params, state.obs_norm, step=1)
+    engine.load(state.policy_params, state.obs_norm, step=2)
+    assert engine.rollback() == 1
+    assert engine.loaded_step == 1
+    with pytest.raises(RuntimeError, match="no previous snapshot"):
+        engine.rollback()
+    # a later load re-arms the history
+    engine.load(state.policy_params, state.obs_norm, step=3)
+    assert engine.rollback() == 1
+
+
+def test_fresh_recreate_tombstones_stale_journal_entry(tmp_path):
+    """An explicit fresh (re-)create of a journaled session id must
+    tombstone the stale entry: a failover inside the next sync window
+    would otherwise silently resume the pre-restart state."""
+    from trpo_tpu.serve import SessionStore
+
+    path = str(tmp_path / "r0.carry.jsonl")
+    j = CarryJournal(path)
+    store = SessionStore(journal=j, sync_every=3)
+    try:
+        # a restored create journals immediately (second-failover cover)
+        store.create(
+            np.zeros(4, np.float32), session_id="s", steps=7, seq=7,
+        )
+        assert j.drain()
+        assert read_carry_journal(path)["s"]["steps"] == 7
+        # the client restarts the session fresh: stale entry must go
+        store.create(np.zeros(4, np.float32), session_id="s")
+        assert j.drain()
+        assert j.lookup("s") is None
+        assert "s" not in read_carry_journal(path)
+    finally:
+        store.close()  # owns (and closes) the journal
+
+
+def test_carry_journal_torn_tail_reads_absent(tmp_path):
+    """The crash-window edge: a replica killed mid-journal-write leaves
+    a partial final line — it must read as ABSENT (the previous
+    complete entry for that session still resumes), and a corrupt
+    middle line must not poison the rest."""
+    path = str(tmp_path / "r1.carry.jsonl")
+    j = CarryJournal(path)
+    j.record({"session": "s", "steps": 3, "carry": [1.0, 2.0]})
+    j.record({"session": "t", "steps": 7, "carry": [0.5]})
+    assert j.drain()
+    j.close()
+    # kill -9 mid-write: a torn, newline-less entry for s at steps=4
+    with open(path, "a") as f:
+        f.write('{"session": "s", "steps": 4, "carry": [9.9')
+    entries = read_carry_journal(path)
+    assert entries["s"]["steps"] == 3  # torn update absent, not corrupt
+    assert entries["t"]["steps"] == 7
+    # corrupt middle line: skipped, later records still read
+    with open(path, "w") as f:
+        f.write(json.dumps({"session": "s", "steps": 1,
+                            "carry": [1.0]}) + "\n")
+        f.write("NOT JSON AT ALL\n")
+        f.write(json.dumps({"session": "t", "steps": 2,
+                            "carry": [2.0]}) + "\n")
+    entries = read_carry_journal(path)
+    assert entries["s"]["steps"] == 1 and entries["t"]["steps"] == 2
+    # a new journal on the torn file repairs the tail and keeps serving
+    with open(path, "a") as f:
+        f.write('{"session": "t", "steps"')
+    j2 = CarryJournal(path)
+    try:
+        assert j2.lookup("t")["steps"] == 2
+    finally:
+        j2.close()
+    assert read_carry_journal(str(tmp_path / "missing.jsonl")) == {}
+
+
+# ---------------------------------------------------------------------------
+# fault-spec grammar + validator contracts (no HTTP)
+# ---------------------------------------------------------------------------
+
+
+def test_serving_fault_specs_parse_and_validate():
+    specs = parse_fault_specs(
+        "kill_replica@request=3:replica=1;"
+        "stall_replica@request=2:replica=0:seconds=1.5;"
+        "wedge_reload@step=2;"
+        "drop_carry_journal@request=4:replica=1"
+    )
+    assert [s.kind for s in specs] == [
+        "kill_replica", "stall_replica", "wedge_reload",
+        "drop_carry_journal",
+    ]
+    assert all(s.serve_level for s in specs)
+    assert specs[0].replica_id == "r1" and specs[1].seconds == 1.5
+    # round-trip through str (the event `spec` field)
+    for s in specs:
+        assert parse_fault_specs(str(s))[0] == s
+    with pytest.raises(ValueError, match="routed client request"):
+        parse_fault_specs("kill_replica@step=3:replica=1")
+    with pytest.raises(ValueError, match="unknown keys"):
+        parse_fault_specs("kill_replica@request=3:target=1")
+    with pytest.raises(ValueError, match="replica must be"):
+        parse_fault_specs("kill_replica@request=3:replica=-1")
+    # serving faults never fire at the training hook sites
+    inj = FaultInjector(specs)
+    state = inj.before_iteration(2, None, span=10)
+    assert state is None and not inj._fired
+    # wedge poisons exactly its step, once
+    poisoned = inj.on_checkpoint_load(2, {"w": np.ones(3, np.float32)})
+    assert np.all(np.isnan(np.asarray(poisoned["w"])))
+    clean = inj.on_checkpoint_load(2, {"w": np.ones(3, np.float32)})
+    assert np.all(np.asarray(clean["w"]) == 1.0)
+
+
+def test_validator_canary_and_serving_fault_contracts(tmp_path):
+    import sys
+
+    sys.path.insert(0, "scripts")
+    from validate_events import validate_file
+
+    from trpo_tpu.obs.events import manifest_fields
+
+    manifest = {
+        "v": 1, "kind": "run_manifest", "t": 0.0,
+        **manifest_fields(None),
+    }
+    started = {
+        "v": 1, "kind": "canary", "t": 1.0, "step": 5, "event": "started",
+        "replica": "r1",
+    }
+    promoted = {**started, "t": 2.0, "event": "promoted"}
+    rolled = {**started, "t": 2.0, "event": "rolled_back",
+              "reason": "nonfinite actions"}
+    resumed = {
+        "v": 1, "kind": "session", "t": 3.0, "session": "abc",
+        "event": "resumed", "replica": "r0", "steps": 5, "lag": 0,
+    }
+
+    def write(path, recs):
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        return str(path)
+
+    # terminal canary + resumed session: valid
+    ok = write(tmp_path / "ok.jsonl", [manifest, started, promoted,
+                                       resumed])
+    assert validate_file(ok) == []
+    ok2 = write(tmp_path / "ok2.jsonl", [manifest, started, rolled])
+    assert validate_file(ok2) == []
+    # a started with no terminal FAILS (the fleet `preempted` pattern)
+    bad = write(tmp_path / "bad.jsonl", [manifest, started])
+    errs = validate_file(bad)
+    assert errs and any("promoted/rolled_back" in e for e in errs)
+    # a terminal for a DIFFERENT step does not resolve it
+    bad2 = write(
+        tmp_path / "bad2.jsonl",
+        [manifest, started, {**promoted, "step": 6}],
+    )
+    assert validate_file(bad2)
+    # malformed canary/session records FAIL outright
+    assert validate_event({**started, "event": "deployed"})
+    assert validate_event({k: v for k, v in started.items()
+                           if k != "step"})
+    assert validate_event({**resumed, "event": "teleported"})
+
+    # serving-fault matching: wedge must be answered by the gate
+    wedge = {
+        "v": 1, "kind": "fault_injected", "t": 1.5,
+        "fault": "wedge_reload", "at": 5, "spec": "wedge_reload@step=5",
+    }
+    rejected = {
+        "v": 1, "kind": "health", "t": 2.5, "check": "canary_rejected",
+        "level": "warn", "message": "rejected",
+    }
+    assert validate_file(
+        write(tmp_path / "w_ok.jsonl",
+              [manifest, started, wedge, rejected, rolled])
+    ) == []
+    errs = validate_file(
+        write(tmp_path / "w_bad.jsonl", [manifest, started, wedge,
+                                         rolled])
+    )
+    # rolled_back itself matches the wedge; drop it and it must fail
+    errs = validate_file(
+        write(tmp_path / "w_bad2.jsonl", [manifest, wedge])
+    )
+    assert any("no matching detection" in e for e in errs)
+    # kill_replica must be answered by ITS replica's death, not any
+    kill = {
+        "v": 1, "kind": "fault_injected", "t": 1.0,
+        "fault": "kill_replica", "at": 3,
+        "spec": "kill_replica@request=3:replica=1", "replica": "r1",
+    }
+    died = {
+        "v": 1, "kind": "router", "t": 2.0, "scope": "replica",
+        "replica": "r1", "state": "died",
+    }
+    evicted = {**died, "t": 3.0, "state": "evicted"}
+    assert validate_file(
+        write(tmp_path / "k_ok.jsonl", [manifest, kill, died, evicted])
+    ) == []
+    errs = validate_file(
+        write(tmp_path / "k_bad.jsonl",
+              [manifest, kill, {**died, "replica": "r0"},
+               {**evicted, "replica": "r0"}])
+    )
+    assert any("no matching detection" in e for e in errs)
+    # drop_carry_journal must surface as the fresh-carry fallback
+    drop = {
+        "v": 1, "kind": "fault_injected", "t": 1.0,
+        "fault": "drop_carry_journal", "at": 4,
+        "spec": "drop_carry_journal@request=4:replica=0",
+        "replica": "r0",
+    }
+    reest = {
+        "v": 1, "kind": "session", "t": 2.0, "session": "abc",
+        "event": "reestablished", "replica": "r1",
+    }
+    assert validate_file(
+        write(tmp_path / "d_ok.jsonl", [manifest, drop, reest])
+    ) == []
+    assert validate_file(write(tmp_path / "d_bad.jsonl",
+                               [manifest, drop]))
+
+
+# ---------------------------------------------------------------------------
+# seq dedupe (replica-side retry idempotency)
+# ---------------------------------------------------------------------------
+
+
+def test_session_act_seq_dedupe_replica_side(rec):
+    agent, state = rec
+    server, _ = _rec_factory(agent, state)("r0")()
+    try:
+        status, out = _post(server.url + "/session")
+        assert status == 200
+        sid = out["session"]
+        obs = _obs_seq(agent, 2)
+        s1, o1 = _post(
+            server.url + f"/session/{sid}/act",
+            {"obs": obs[0].tolist(), "seq": 1},
+        )
+        assert s1 == 200 and o1["session_steps"] == 1
+        # a replayed seq returns the STORED action without stepping
+        s2, o2 = _post(
+            server.url + f"/session/{sid}/act",
+            {"obs": obs[0].tolist(), "seq": 1},
+        )
+        assert s2 == 200 and o2.get("deduped") is True
+        assert o2["session_steps"] == 1
+        assert o2["action"] == o1["action"]
+        assert server.sessions.deduped_total == 1
+        # a NEW seq steps — and the carry advanced exactly once overall
+        s3, o3 = _post(
+            server.url + f"/session/{sid}/act",
+            {"obs": obs[1].tolist(), "seq": 2},
+        )
+        assert s3 == 200 and o3["session_steps"] == 2
+        direct = _direct_actions(agent, state, obs)
+        np.testing.assert_array_equal(
+            np.asarray(o3["action"], np.float64), direct[1]
+        )
+        # seq-less acts (direct clients) keep stepping untouched
+        s4, o4 = _post(
+            server.url + f"/session/{sid}/act", {"obs": obs[1].tolist()}
+        )
+        assert s4 == 200 and o4["session_steps"] == 3
+        # a malformed seq is the client's 400, not a 500
+        s5, _ = _post(
+            server.url + f"/session/{sid}/act",
+            {"obs": obs[1].tolist(), "seq": "seven"},
+        )
+        assert s5 == 400
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# lossless failover through the router
+# ---------------------------------------------------------------------------
+
+
+def test_pinned_replica_kill_resumes_from_journal_bit_exact(
+    rec, tmp_path
+):
+    agent, state = rec
+    jdir = str(tmp_path / "carry")
+    events = []
+    bus = EventBus(lambda r: events.append(r))
+    rs = _replicaset(
+        _rec_factory(agent, state, bus=bus, journal_dir=jdir),
+        2, bus=bus,
+    )
+    router = Router(rs, port=0, bus=bus, journal_dir=jdir)
+    try:
+        status, out = _post(router.url + "/session")
+        assert status == 200
+        sid, pinned = out["session"], out["replica"]
+        obs = _obs_seq(agent, 8)
+        direct = _direct_actions(agent, state, obs)
+        for t in range(5):
+            status, out = _post(
+                router.url + f"/session/{sid}/act",
+                {"obs": obs[t].tolist()},
+            )
+            assert status == 200
+            np.testing.assert_array_equal(
+                np.asarray(out["action"], np.float64), direct[t]
+            )
+        # snapshot current (sync_every=1 + drained), then the kill
+        rs.replicas[pinned].handle.server.sessions.journal.drain()
+        rs.replicas[pinned].handle.kill()
+        status, out = _post(
+            router.url + f"/session/{sid}/act",
+            {"obs": obs[5].tolist()},
+        )
+        assert status == 200, out
+        assert out.get("resumed") is True
+        assert out.get("resumed_steps") == 5
+        assert out["session_steps"] == 6
+        np.testing.assert_array_equal(
+            np.asarray(out["action"], np.float64), direct[5],
+            err_msg="resumed act diverged from the uninterrupted session",
+        )
+        assert router.sessions_resumed_total == 1
+        assert router.sessions_reestablished_total == 0
+        # continuation stays bit-exact with no further flags
+        for t in (6, 7):
+            status, out = _post(
+                router.url + f"/session/{sid}/act",
+                {"obs": obs[t].tolist()},
+            )
+            assert status == 200 and "resumed" not in out
+            np.testing.assert_array_equal(
+                np.asarray(out["action"], np.float64), direct[t]
+            )
+    finally:
+        router.close()
+        rs.close()
+    for e in events:
+        assert validate_event(e) == [], e
+    resumed = [
+        e for e in events
+        if e["kind"] == "session" and e["event"] == "resumed"
+    ]
+    assert len(resumed) == 1
+    assert resumed[0]["steps"] == 5 and resumed[0]["lag"] == 0
+
+
+def test_replica_restart_empty_store_resumes_via_journal(rec, tmp_path):
+    """The 404 crash window: the pinned replica died AND restarted
+    before the session's next act — its store is empty
+    (session_unknown), but the journal file survived the incarnation,
+    so the act resumes instead of surfacing the 404."""
+    agent, state = rec
+    jdir = str(tmp_path / "carry")
+    rs = _replicaset(
+        _rec_factory(agent, state, journal_dir=jdir), 2,
+    )
+    router = Router(rs, port=0, journal_dir=jdir)
+    try:
+        status, out = _post(router.url + "/session")
+        sid, pinned = out["session"], out["replica"]
+        obs = _obs_seq(agent, 4)
+        direct = _direct_actions(agent, state, obs)
+        for t in range(2):
+            status, out = _post(
+                router.url + f"/session/{sid}/act",
+                {"obs": obs[t].tolist()},
+            )
+            assert status == 200
+        rs.replicas[pinned].handle.server.sessions.journal.drain()
+        rs.replicas[pinned].handle.kill()
+        rs.tick()           # observe the death -> evicted
+        time.sleep(0.1)     # backoff
+        rs.tick()           # relaunch (fresh, EMPTY store)
+        rs.tick()           # healthz -> healthy
+        assert rs.snapshot()["replicas"][pinned]["state"] == "healthy"
+        status, out = _post(
+            router.url + f"/session/{sid}/act",
+            {"obs": obs[2].tolist()},
+        )
+        assert status == 200, out
+        assert out.get("resumed") is True and out["resumed_steps"] == 2
+        np.testing.assert_array_equal(
+            np.asarray(out["action"], np.float64), direct[2]
+        )
+    finally:
+        router.close()
+        rs.close()
+
+
+def test_dropped_journal_falls_back_to_fresh_carry_loudly(
+    rec, tmp_path
+):
+    """``drop_carry_journal`` + ``kill_replica`` through the router's
+    chaos hook: the failover finds no journal entry and must fall back
+    to the ISSUE 9 fresh-carry path — flagged ``reestablished``, with
+    the matching session event (the fault's validator contract), zero
+    client-visible errors."""
+    agent, state = rec
+    jdir = str(tmp_path / "carry")
+    events = []
+    bus = EventBus(lambda r: events.append(r))
+    rs = _replicaset(
+        _rec_factory(agent, state, bus=bus, journal_dir=jdir),
+        2, bus=bus,
+    )
+    router = Router(rs, port=0, bus=bus, journal_dir=jdir)
+    try:
+        status, out = _post(router.url + "/session")
+        sid, pinned = out["session"], out["replica"]
+        obs = _obs_seq(agent, 4)
+        direct = _direct_actions(agent, state, obs)
+        for t in range(2):
+            status, out = _post(
+                router.url + f"/session/{sid}/act",
+                {"obs": obs[t].tolist()},
+            )
+            assert status == 200
+        rs.replicas[pinned].handle.server.sessions.journal.drain()
+        # arm the chaos: at the next session act (request index 1 — the
+        # chaos clock starts when the injector is armed), drop the
+        # journal AND kill the replica
+        idx = int(pinned[1:])
+        router.injector = FaultInjector.from_spec(
+            f"drop_carry_journal@request=1:replica={idx};"
+            f"kill_replica@request=1:replica={idx}",
+            bus=bus,
+        )
+        status, out = _post(
+            router.url + f"/session/{sid}/act",
+            {"obs": obs[2].tolist()},
+        )
+        assert status == 200, out
+        assert out.get("reestablished") is True
+        assert "resumed" not in out
+        # fresh carry: the action matches a FRESH session's first act
+        # on the same observation (not the interrupted session's third)
+        a_fresh, _d, _c = agent.act(
+            state, obs[2], eval_mode=True, policy_carry=None
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["action"], np.float64),
+            np.asarray(a_fresh, np.float64),
+        )
+        assert router.injector.all_fired
+        assert router.sessions_reestablished_total == 1
+    finally:
+        router.close()
+        rs.close()
+    for e in events:
+        assert validate_event(e) == [], e
+    assert any(
+        e["kind"] == "session" and e["event"] == "reestablished"
+        for e in events
+    )
+    assert any(
+        e["kind"] == "fault_injected"
+        and e["fault"] == "drop_carry_journal"
+        for e in events
+    )
+
+
+def test_stall_replica_detected_from_request_path(ff):
+    """A stalled replica (health checks fine, acts wedged) must be
+    detected by the ROUTER — timeout → transport failure → eviction →
+    transparent retry — with zero client-visible errors."""
+    agent, state = ff
+
+    def make(rid):
+        def factory():
+            engine = agent.serve_engine()
+            engine.load(state.policy_params, state.obs_norm, step=1)
+            batcher = MicroBatcher(engine, deadline_ms=5.0)
+            server = PolicyServer(
+                engine, batcher, port=0, replica_name=rid
+            )
+            return server, [batcher]
+
+        return factory
+
+    rs = _replicaset(make, 2)
+    router = Router(rs, port=0, act_timeout_s=1.0)
+    router.injector = FaultInjector.from_spec(
+        "stall_replica@request=1:replica=0:seconds=30"
+    )
+    try:
+        obs = [0.0] * int(np.prod(agent.obs_shape))
+        t0 = time.monotonic()
+        status, out = _post(router.url + "/act", {"obs": obs})
+        assert status == 200 and "action" in out, out
+        # answered by the survivor after the 1s timeout, not 30s later
+        assert time.monotonic() - t0 < 10.0
+        assert router.retried_total == 1
+        assert rs.snapshot()["replicas"]["r0"]["state"] == "evicted"
+        status, _ = _post(router.url + "/act", {"obs": obs})
+        assert status == 200
+    finally:
+        router.close()
+        rs.close()
+
+
+# ---------------------------------------------------------------------------
+# managed reload + canary routing (fast, no checkpoints)
+# ---------------------------------------------------------------------------
+
+
+def test_router_retries_5xx_once_and_passes_through_as_last_resort(ff):
+    """A server-side (5xx) answer from an un-pinned replica retries
+    ONCE elsewhere (safe: /act is pure); with no second replica the
+    original answer passes through verbatim instead of being masked by
+    a router-made 502/503. 4xx never retries (pinned by
+    test_router_passes_client_errors_through_without_retry)."""
+    agent, state = ff
+
+    def make(broken):
+        def inner(rid):
+            def factory():
+                engine = agent.serve_engine()
+                engine.load(state.policy_params, state.obs_norm, step=1)
+                batcher = MicroBatcher(engine, deadline_ms=5.0)
+                server = PolicyServer(
+                    engine, batcher, port=0, replica_name=rid
+                )
+                if rid in broken:
+                    # engine failure -> the handler's JSON 500
+                    batcher.submit = lambda obs: (_ for _ in ()).throw(
+                        RuntimeError("wedged")
+                    )
+                return server, [batcher]
+
+            return factory
+
+        return inner
+
+    obs = [0.0] * int(np.prod(agent.obs_shape))
+    # two replicas, one wedged: the 500 retries onto the survivor
+    rs = _replicaset(make({"r0"}), 2)
+    router = Router(rs, port=0)
+    try:
+        for _ in range(4):
+            status, out = _post(router.url + "/act", {"obs": obs})
+            assert status == 200 and "action" in out, (status, out)
+        assert router.retried_total >= 1
+        assert router.failed_total == 0
+    finally:
+        router.close()
+        rs.close()
+    # one replica, wedged: the 500 passes through verbatim
+    rs = _replicaset(make({"r0"}), 1)
+    router = Router(rs, port=0)
+    try:
+        status, out = _post(router.url + "/act", {"obs": obs})
+        assert status == 500, (status, out)
+        assert "inference failed" in out["error"]
+        assert router.backpressure_total == 0
+        assert router.failed_total == 0
+    finally:
+        router.close()
+        rs.close()
+
+
+def test_reload_route_refused_on_unmanaged_replica(rec):
+    agent, state = rec
+    server, _ = _rec_factory(agent, state)("r0")()
+    try:
+        status, out = _post(server.url + "/reload", {"step": 2})
+        assert status == 409 and out["code"] == "unmanaged"
+    finally:
+        server.close()
+
+
+def test_canary_fraction_routes_stateless_only(ff):
+    agent, state = ff
+
+    def make(rid):
+        def factory():
+            engine = agent.serve_engine()
+            engine.load(state.policy_params, state.obs_norm, step=1)
+            batcher = MicroBatcher(engine, deadline_ms=5.0)
+            server = PolicyServer(
+                engine, batcher, port=0, replica_name=rid
+            )
+            return server, [batcher]
+
+        return factory
+
+    rs = _replicaset(make, 2)
+    router = Router(rs, port=0, canary_fraction=0.5)
+    try:
+        with rs.lock:
+            rs.replicas["r1"].canary = True
+        obs = [0.0] * int(np.prod(agent.obs_shape))
+        for _ in range(8):
+            status, _ = _post(router.url + "/act", {"obs": obs})
+            assert status == 200
+        canary_n = len(router.replica_latencies_ms("r1"))
+        # deterministic stride at fraction 0.5: exactly half
+        assert canary_n == 4, router._replica_lats
+        # sessions NEVER pick the canary: the picker refuses it while
+        # an incumbent exists (exercised via the internal seam — the
+        # recurrent stack is covered by the e2e tests)
+        for _ in range(6):
+            rid = router._pick(stateless=False)
+            assert rid == "r0"
+            router._release(rid)
+        # the canary is still the last resort: incumbent saturated
+        with rs.lock:
+            rs.replicas["r0"].inflight = router.max_inflight
+        rid = router._pick(stateless=False)
+        assert rid == "r1"  # degraded beats dropped
+        router._release(rid)
+        with rs.lock:
+            rs.replicas["r0"].inflight = 0
+    finally:
+        router.close()
+        rs.close()
+
+
+# ---------------------------------------------------------------------------
+# managed reload + the full canary gate (real checkpoints — slow)
+# ---------------------------------------------------------------------------
+
+
+def _managed_ff_factory(agent, ck_dir, state, incumbent, bus=None,
+                        injector=None):
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    def make(rid):
+        def factory():
+            engine = agent.serve_engine()
+            batcher = MicroBatcher(engine, deadline_ms=5.0)
+            server = PolicyServer(
+                engine, batcher, port=0, bus=bus, replica_name=rid,
+                checkpointer=Checkpointer(ck_dir),
+                template=agent.init_state(),
+                poll_interval=60.0,
+                managed_reload=True,
+                initial_step=incumbent["step"],
+                injector=injector,
+            )
+            return server, [batcher]
+
+        return factory
+
+    return make
+
+
+@pytest.mark.slow  # real checkpoint saves/restores + three gate runs;
+# the fast managed/canary contracts above stay tier-1
+def test_canary_gate_wedge_rejected_clean_promoted_killed_rolls_back(
+    ff, tmp_path
+):
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    agent, state = ff
+    ck_dir = str(tmp_path / "ck")
+    trainer_ck = Checkpointer(ck_dir)
+    trainer_ck.save(1, state)
+    events = []
+    bus = EventBus(lambda r: events.append(r))
+    injector = FaultInjector.from_spec("wedge_reload@step=2", bus=bus)
+    incumbent = {"step": None}
+    rs = _replicaset(
+        _managed_ff_factory(agent, ck_dir, state, incumbent, bus=bus,
+                            injector=injector),
+        3, bus=bus, health_interval=0.2, health_fail_threshold=2,
+    )
+    rs.start()
+    router = Router(rs, port=0, bus=bus, canary_fraction=0.5)
+    ctrl_ck = Checkpointer(ck_dir)
+    ctrl = CanaryController(
+        rs, router, lambda: ctrl_ck.latest_step(refresh=True),
+        incumbent=incumbent, window_requests=6, poll_interval=0.1,
+        gate_timeout_s=60.0, bus=bus,
+    )
+    stop = threading.Event()
+    errors = []
+
+    def client(seed):
+        r = np.random.RandomState(seed)
+        while not stop.is_set():
+            try:
+                s, out = _post(
+                    router.url + "/act",
+                    {"obs": r.randn(*agent.obs_shape).tolist()},
+                )
+                if s != 200:
+                    errors.append((s, out))
+            except Exception as e:  # noqa: BLE001 — collected
+                errors.append(repr(e))
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(4)
+    ]
+
+    def settle(step, timeout=15.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            snap = rs.snapshot()
+            if all(
+                r["loaded_step"] == step
+                for r in snap["replicas"].values()
+            ):
+                return snap
+            time.sleep(0.05)
+        return rs.snapshot()
+
+    try:
+        ctrl.tick()
+        assert incumbent["step"] == 1  # first checkpoint adopts ungated
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+
+        # 1. a WEDGED step 2 is rejected: rolled back, incumbent serves
+        trainer_ck.save(2, state)
+        ctrl.tick()
+        assert ctrl.rolled_back_total == 1
+        assert incumbent["step"] == 1
+        snap = settle(1)
+        assert all(
+            r["loaded_step"] == 1 for r in snap["replicas"].values()
+        ), snap
+        assert not any(
+            r["canary"] for r in snap["replicas"].values()
+        )
+        # a rejected step is never re-canaried
+        ctrl.tick()
+        assert ctrl.rolled_back_total == 1
+
+        # 2. a CLEAN step 3 promotes to the whole set
+        trainer_ck.save(3, state)
+        ctrl.tick()
+        assert ctrl.promoted_total == 1 and incumbent["step"] == 3
+        snap = settle(3)
+        assert all(
+            r["loaded_step"] == 3 for r in snap["replicas"].values()
+        ), snap
+
+        # 3. canary killed MID-GATE resolves to rolled_back; the set
+        # stays healthy on the incumbent (the relaunch reads
+        # incumbent["step"], never the step under test)
+        trainer_ck.save(4, state)
+        big = CanaryController(
+            rs, router, lambda: ctrl_ck.latest_step(refresh=True),
+            incumbent=incumbent, window_requests=10_000,
+            poll_interval=0.1, gate_timeout_s=60.0, bus=bus,
+        )
+        gate = threading.Thread(target=big.tick, daemon=True)
+        gate.start()
+        deadline = time.monotonic() + 30.0
+        canary_id = None
+        while time.monotonic() < deadline and canary_id is None:
+            snap = rs.snapshot()
+            canary_id = next(
+                (r for r, row in snap["replicas"].items()
+                 if row["canary"]), None,
+            )
+            time.sleep(0.05)
+        assert canary_id is not None, "gate never started"
+        rs.replicas[canary_id].handle.kill()
+        gate.join(timeout=60.0)
+        assert not gate.is_alive(), "gate did not resolve after the kill"
+        assert big.rolled_back_total == 1
+        assert incumbent["step"] == 3
+        # a TRANSIENT failure (canary died) must not blacklist the
+        # step — only a judged verdict (p99/parity/bad save) does
+        assert 4 not in big._rejected_steps
+        assert 2 in ctrl._rejected_steps  # the wedge stays judged
+        # supervisor relaunches the dead canary — on the INCUMBENT step
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            row = rs.snapshot()["replicas"][canary_id]
+            if row["state"] == "healthy" and row["loaded_step"] == 3:
+                break
+            time.sleep(0.05)
+        row = rs.snapshot()["replicas"][canary_id]
+        assert row["state"] == "healthy" and row["loaded_step"] == 3, row
+        big.close()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=15.0)
+        ctrl.close()
+        router.close()
+        rs.close()
+        trainer_ck.close()
+        ctrl_ck.close()
+    assert not errors, (
+        f"{len(errors)} client-visible errors: {errors[:5]}"
+    )
+    for e in events:
+        assert validate_event(e) == [], e
+    canary_events = [
+        (e["event"], e["step"]) for e in events if e["kind"] == "canary"
+    ]
+    assert ("started", 2) in canary_events
+    assert ("rolled_back", 2) in canary_events
+    assert ("started", 3) in canary_events
+    assert ("promoted", 3) in canary_events
+    assert ("rolled_back", 4) in canary_events
+    assert any(
+        e["kind"] == "health" and e["check"] == "canary_rejected"
+        for e in events
+    )
+    assert injector.all_fired
+
+
+# ---------------------------------------------------------------------------
+# analyze rows
+# ---------------------------------------------------------------------------
+
+
+def test_analyze_failover_and_canary_rows():
+    from trpo_tpu.obs.analyze import compare_runs, render_summary, \
+        summarize_run
+
+    def rec_(kind, t, **f):
+        return {"v": 1, "kind": kind, "t": t, **f}
+
+    records = [
+        rec_("run_manifest", 0.0, schema="trpo-tpu-events",
+             jax_version="x", backend="cpu", config_hash="0" * 16,
+             config=None),
+        rec_("router", 1.0, scope="request", ms=2.0, ok=True,
+             retried=False, replica="r0", endpoint="act"),
+        rec_("router", 2.0, scope="request", ms=3.0, ok=True,
+             retried=False, replica="r1", endpoint="act"),
+        rec_("session", 3.0, session="a", event="resumed",
+             replica="r1", steps=5, lag=1),
+        rec_("session", 4.0, session="b", event="reestablished",
+             replica="r1"),
+        rec_("canary", 5.0, step=2, event="started", replica="r0"),
+        rec_("canary", 6.0, step=2, event="rolled_back", replica="r0",
+             reason="nonfinite actions"),
+        rec_("canary", 7.0, step=3, event="started", replica="r0"),
+        rec_("canary", 8.0, step=3, event="promoted", replica="r0"),
+    ]
+    summary = summarize_run(records)
+    rt = summary["router"]
+    assert rt["failover"] == {
+        "resumed": 1, "restarted_fresh": 1, "resumed_fraction": 0.5,
+        "journal_lag_mean": 1.0, "journal_lag_max": 1,
+    }
+    assert rt["canary"]["started"] == 2
+    assert rt["canary"]["promoted"] == 1
+    assert rt["canary"]["rolled_back"] == 1
+    assert rt["canary"]["steps"]["2"]["outcome"] == "rolled_back"
+    assert rt["canary"]["steps"]["2"]["reason"] == "nonfinite actions"
+    assert rt["canary"]["steps"]["3"]["outcome"] == "promoted"
+    text = render_summary(summary)
+    assert "failover:" in text and "canary:" in text
+
+    # compare: a rolled_back rise is a strict-counter regression
+    base = summarize_run(records[:5])  # no canary records
+    cmp_ = compare_runs(summary, summary)
+    rows = {v["metric"]: v for v in cmp_["verdicts"]}
+    assert rows["router/canary_rolled_back"]["verdict"] == "ok"
+    assert not cmp_["regressed"]
+    worse = [dict(r) for r in records] + [
+        rec_("canary", 9.0, step=4, event="started", replica="r1"),
+        rec_("canary", 10.0, step=4, event="rolled_back",
+             replica="r1", reason="p99"),
+    ]
+    cmp_bad = compare_runs(summarize_run(records), summarize_run(worse))
+    rows = {v["metric"]: v for v in cmp_bad["verdicts"]}
+    assert rows["router/canary_rolled_back"]["verdict"] == "regressed"
+    assert cmp_bad["regressed"]
+    # failover rows skip cleanly when neither run failed over
+    cmp_none = compare_runs(base, base)
+    rows = {v["metric"]: v for v in cmp_none["verdicts"]}
+    assert "router/canary_rolled_back" not in rows

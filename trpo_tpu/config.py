@@ -547,6 +547,34 @@ class TRPOConfig:
     #                                at capacity the longest-idle session
     #                                is LRU-evicted (with a `session`
     #                                event — never silently)
+    serve_carry_sync_every: int = 1  # session-carry durability (ISSUE
+    #                                11): journal a session's carry into
+    #                                the replica's write-behind carry
+    #                                journal every N applied steps. 1 =
+    #                                lossless failover whenever the
+    #                                write-behind drain has caught up
+    #                                (the act path never blocks on the
+    #                                disk write — the StatsDrain
+    #                                pattern); larger values trade
+    #                                journal IO for a staleness bound of
+    #                                up to N-1 replayed-from-older-carry
+    #                                steps on failover
+    serve_canary_fraction: float = 0.0  # gated checkpoint deployment
+    #                                (ISSUE 11): > 0 turns the per-
+    #                                replica hot swap into a canary
+    #                                promotion — a new step loads on ONE
+    #                                replica first, the router routes
+    #                                this fraction of STATELESS traffic
+    #                                to it, and the rest of the set
+    #                                follows only on a clean windowed
+    #                                p99 + action-parity gate. 0 (the
+    #                                default) keeps the ungated ISSUE 6
+    #                                behavior: every replica's own
+    #                                watcher swaps to latest
+    serve_canary_window: int = 24  # canary gate window: routed canary
+    #                                requests observed before the gate
+    #                                judges p99 + action parity (small =
+    #                                fast promotion, large = confident)
 
     # --- io --------------------------------------------------------------
     checkpoint_dir: Optional[str] = None
@@ -752,6 +780,21 @@ class TRPOConfig:
             raise ValueError(
                 "serve_max_sessions must be >= 1, got "
                 f"{self.serve_max_sessions}"
+            )
+        if self.serve_carry_sync_every < 1:
+            raise ValueError(
+                "serve_carry_sync_every must be >= 1, got "
+                f"{self.serve_carry_sync_every}"
+            )
+        if not 0.0 <= self.serve_canary_fraction <= 1.0:
+            raise ValueError(
+                "serve_canary_fraction must be in [0, 1], got "
+                f"{self.serve_canary_fraction}"
+            )
+        if self.serve_canary_window < 1:
+            raise ValueError(
+                "serve_canary_window must be >= 1, got "
+                f"{self.serve_canary_window}"
             )
         if self.inject_faults:
             # fail at construction: a chaos run with an unparseable spec

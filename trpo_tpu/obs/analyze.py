@@ -33,6 +33,11 @@ Comparison semantics (:func:`compare_runs`):
   router p50/p99 time-like, routed actions/s rate-like, rows only when
   a run actually routed; the single-run summary adds the per-replica
   table, the scaling/balance row, and the session lifecycle counts;
+* failover quality (ISSUE 11): sessions resumed from a journaled carry
+  vs restarted fresh (``resumed_fraction`` rate-like — losing lossless
+  failover is a regression) plus carry-journal lag, and canary
+  deployment verdicts (``rolled_back`` is a strict counter — any rise
+  between clean runs means a checkpoint failed its gate);
 * phases below ``min_ms`` in BOTH runs are skipped (a 0.1 ms phase
   doubling is scheduler noise, not a regression), as are metrics absent
   from either run (no silent verdict about unmeasured things — they are
@@ -185,6 +190,7 @@ def _summarize_router(records: list) -> Optional[dict]:
         if r.get("kind") == "router" and r.get("scope") == "replica"
     ]
     sessions = [r for r in records if r.get("kind") == "session"]
+    canary = [r for r in records if r.get("kind") == "canary"]
     if not reqs and not lifecycle:
         return None
     ok_reqs = [r for r in reqs if r.get("ok")]
@@ -258,6 +264,62 @@ def _summarize_router(records: list) -> Optional[dict]:
         "sessions": dict(
             sorted(Counter(r.get("event") for r in sessions).items())
         ) if sessions else None,
+        "failover": _failover_rows(sessions),
+        "canary": _canary_rows(canary),
+    }
+
+
+def _failover_rows(sessions: list) -> Optional[dict]:
+    """Failover quality (ISSUE 11): sessions resumed from a journaled
+    carry vs restarted fresh, and the carry-journal lag (router-observed
+    acts minus journaled steps at resume — 0 = the snapshot was current
+    and the continuation bit-exact). None when no failover happened."""
+    resumed = [r for r in sessions if r.get("event") == "resumed"]
+    fresh = [r for r in sessions if r.get("event") == "reestablished"]
+    if not resumed and not fresh:
+        return None
+    lags = [
+        r.get("lag") for r in resumed
+        if isinstance(r.get("lag"), int) and not isinstance(
+            r.get("lag"), bool
+        )
+    ]
+    total = len(resumed) + len(fresh)
+    return {
+        "resumed": len(resumed),
+        "restarted_fresh": len(fresh),
+        "resumed_fraction": len(resumed) / total,
+        "journal_lag_mean": (sum(lags) / len(lags)) if lags else None,
+        "journal_lag_max": max(lags) if lags else None,
+    }
+
+
+def _canary_rows(canary: list) -> Optional[dict]:
+    """Canary deployment verdicts (ISSUE 11): per-lifecycle counts plus
+    the per-step outcome table. None for logs with no canary records."""
+    if not canary:
+        return None
+    counts = Counter(r.get("event") for r in canary)
+    steps: dict = {}
+    for r in canary:
+        step = r.get("step")
+        if step is None:
+            continue
+        row = steps.setdefault(
+            str(step), {"replica": None, "outcome": "unresolved",
+                        "reason": None}
+        )
+        if isinstance(r.get("replica"), str):
+            row["replica"] = r["replica"]
+        if r.get("event") in ("promoted", "rolled_back"):
+            row["outcome"] = r["event"]
+            if r.get("reason") is not None:
+                row["reason"] = r["reason"]
+    return {
+        "started": counts.get("started", 0),
+        "promoted": counts.get("promoted", 0),
+        "rolled_back": counts.get("rolled_back", 0),
+        "steps": steps,
     }
 
 
@@ -646,6 +708,53 @@ def compare_runs(
                     n_rt.get(metric), threshold_pct, direction,
                 )
             )
+        # failover quality (ISSUE 11): the resumed fraction is
+        # rate-like — a serving change that turns lossless failovers
+        # back into fresh restarts is a regression; rows only when a
+        # run actually failed over (skipped otherwise, per _verdict)
+        b_fo = b_rt.get("failover") or {}
+        n_fo = n_rt.get("failover") or {}
+        if b_fo or n_fo:
+            verdicts.append(
+                _verdict(
+                    "router/failover_resumed_fraction",
+                    b_fo.get("resumed_fraction"),
+                    n_fo.get("resumed_fraction"),
+                    threshold_pct, "rate",
+                )
+            )
+            verdicts.append(
+                _verdict(
+                    "router/journal_lag_max",
+                    b_fo.get("journal_lag_max"),
+                    n_fo.get("journal_lag_max"),
+                    threshold_pct, "time",
+                )
+            )
+        # canary verdicts: rolled_back is a strict counter (the
+        # solve/fallbacks pattern) — ANY rise between two supposedly
+        # clean runs means a checkpoint failed its gate, which no
+        # noise threshold excuses
+        b_cn = b_rt.get("canary") or {}
+        n_cn = n_rt.get("canary") or {}
+        if b_cn or n_cn:
+            b_rb = b_cn.get("rolled_back") or 0
+            n_rb = n_cn.get("rolled_back") or 0
+            verdicts.append({
+                "metric": "router/canary_rolled_back",
+                "base": b_rb,
+                "new": n_rb,
+                "direction": "count",
+                "delta_pct": None,
+                "verdict": "regressed" if n_rb > b_rb else "ok",
+            })
+            verdicts.append(
+                _verdict(
+                    "router/canary_promoted",
+                    b_cn.get("promoted"), n_cn.get("promoted"),
+                    threshold_pct, "rate",
+                )
+            )
 
     # solver-precision counters (ISSUE 8) — only when at least one run
     # carried the ladder. `fallbacks` is judged as a strict counter: ANY
@@ -861,6 +970,34 @@ def render_summary(summary: dict) -> str:
                 "sessions: "
                 + ", ".join(f"{k}×{v}" for k, v in sess.items())
             )
+        fo = rt.get("failover") or {}
+        if fo:
+            out.append(
+                f"failover: resumed={fo.get('resumed')}"
+                f" restarted_fresh={fo.get('restarted_fresh')}"
+                f" resumed_fraction={_fmt(fo.get('resumed_fraction'))}"
+                f" journal_lag_mean={_fmt(fo.get('journal_lag_mean'))}"
+                f" journal_lag_max={fo.get('journal_lag_max')}"
+            )
+        cn = rt.get("canary") or {}
+        if cn:
+            out.append(
+                f"canary: started={cn.get('started')}"
+                f" promoted={cn.get('promoted')}"
+                f" rolled_back={cn.get('rolled_back')}"
+            )
+            steps = cn.get("steps") or {}
+            if steps:
+                out.append(format_table(
+                    [
+                        [step, row.get("replica"), row.get("outcome"),
+                         row.get("reason") or ""]
+                        for step, row in sorted(
+                            steps.items(), key=lambda kv: _rung_key(kv[0])
+                        )
+                    ],
+                    ["step", "canary", "outcome", "reason"],
+                ))
     sp = summary.get("solver_precision") or {}
     if sp:
         out.append("")

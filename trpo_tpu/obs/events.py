@@ -76,10 +76,21 @@ Kinds:
 * ``session`` — one session lifecycle transition in the recurrent
   serving protocol (``serve/session.py`` stores on the replicas,
   ``serve/router.py`` affinity): ``SESSION_EVENTS`` — ``created``
-  (replica minted carry), ``reestablished`` (the router re-created the
-  session with a FRESH carry after its replica died), ``expired``
-  (TTL eviction), ``evicted`` (capacity eviction from the bounded
-  store).
+  (replica minted carry), ``resumed`` (the router re-created the
+  session FROM the dead replica's journaled carry — lossless failover;
+  carries ``steps`` replayed and the journal ``lag``),
+  ``reestablished`` (the fresh-carry fallback when no journal entry
+  existed), ``expired`` (TTL eviction), ``evicted`` (capacity eviction
+  from the bounded store). ``resumed`` vs ``reestablished`` is the
+  failover-quality discriminator ``obs/analyze.py`` reports.
+* ``canary`` — one gated-deployment transition
+  (``serve/replicaset.CanaryController``): which checkpoint ``step``,
+  which ``replica`` wore it, and the lifecycle ``event``
+  (``CANARY_EVENTS``: ``started`` / ``promoted`` / ``rolled_back``,
+  rolled_back carrying a ``reason``). The log is self-auditing the
+  same way the fleet's is: ``scripts/validate_events.py`` FAILS a
+  ``started`` with no later terminal ``promoted``/``rolled_back`` for
+  the same step — an unresolved canary means the gate loop is broken.
 
 Sinks are append-only and flush-on-write; the JSONL sink repairs a
 crash-truncated final line on open (``utils/metrics.repair_jsonl_tail``),
@@ -107,6 +118,7 @@ __all__ = [
     "FLEET_STATES",
     "ROUTER_REPLICA_STATES",
     "SESSION_EVENTS",
+    "CANARY_EVENTS",
     "EventBus",
     "JsonlSink",
     "ConsoleSink",
@@ -132,8 +144,19 @@ ROUTER_REPLICA_STATES = (
 )
 
 # session lifecycle transitions the recurrent serving protocol records
-# (stores live in serve/session.py, router affinity in serve/router.py)
-SESSION_EVENTS = ("created", "reestablished", "expired", "evicted")
+# (stores live in serve/session.py, router affinity in serve/router.py);
+# `resumed` = re-created from a journaled carry (lossless failover),
+# `reestablished` = the fresh-carry fallback when no journal entry
+# existed — the discriminator the failover report reads
+SESSION_EVENTS = (
+    "created", "resumed", "reestablished", "expired", "evicted",
+)
+
+# gated-deployment transitions the canary controller records (the state
+# machine lives in serve/replicaset.CanaryController; the vocabulary
+# lives HERE so the validator needs no serve import — the FLEET_STATES
+# pattern). `started` must resolve to `promoted` or `rolled_back`.
+CANARY_EVENTS = ("started", "promoted", "rolled_back")
 
 _SCALAR = (bool, int, float, str, type(None))
 
@@ -223,9 +246,17 @@ _REQUIRED = {
     "session": {
         # one session lifecycle transition (serve/session.py store,
         # serve/router.py affinity); `replica` rides along as an
-        # optional field
+        # optional field, `steps`/`lag` on resumed records
         "session": lambda v: isinstance(v, str) and v,
         "event": lambda v: v in SESSION_EVENTS,
+    },
+    "canary": {
+        # one gated-deployment transition
+        # (serve/replicaset.CanaryController); `reason` rides along on
+        # rolled_back records
+        "step": lambda v: isinstance(v, int) and not isinstance(v, bool),
+        "event": lambda v: v in CANARY_EVENTS,
+        "replica": lambda v: isinstance(v, str) and v,
     },
 }
 

@@ -21,6 +21,25 @@ reproducible and test-pinnable:
   process just before iteration N runs (a preemption notice), exercising
   the drain → checkpoint → requeue-exit path.
 
+The SERVING plane (ISSUE 11) has its own failure modes, injected at the
+router's request clock or the checkpoint-load seam:
+
+* ``kill_replica@request=K:replica=R``  — kill serving replica ``rR``
+  just before the K-th routed client request (the supervisor must
+  evict/restart it; a pinned session must resume from its carry
+  journal).
+* ``stall_replica@request=K:replica=R:seconds=S`` — wedge the replica's
+  act path for S seconds while its health checks keep answering
+  (a stuck device / GC pause): detection must come from the REQUEST
+  path — the router's timeout, eviction, retry.
+* ``wedge_reload@step=N``          — poison the params of checkpoint
+  step N as a replica loads it: the save restores cleanly but answers
+  garbage — exactly what the canary gate exists to catch.
+* ``drop_carry_journal@request=K:replica=R`` — delete replica ``rR``'s
+  carry journal just before the K-th request: the next failover must
+  DETECT the miss and fall back loudly to the fresh-carry path
+  (``session:reestablished``), never crash or resume silently wrong.
+
 Specs are ``;``-separated; each fires EXACTLY ONCE (a recovery that
 re-runs the target iteration re-runs it clean — which is what lets the
 chaos suite pin bit-exact continuation against an unfaulted run). Every
@@ -34,18 +53,26 @@ from __future__ import annotations
 import dataclasses
 import os
 import signal
+import threading
 import time
 from typing import Optional, Tuple
 
 __all__ = ["FaultSpec", "FaultInjector", "parse_fault_specs"]
 
-# fault kind -> (trigger key, is_env_level)
+# fault kind -> (trigger key, level); level discriminates which hook
+# site fires it: "env" = on_env_step (host env steps), "update" =
+# before_iteration (training iterations), "serve" = on_serve_request /
+# on_checkpoint_load (the serving plane's request clock / reload seam)
 _KINDS = {
-    "kill_worker": ("step", True),
-    "hang_worker": ("step", True),
-    "delay_step": ("step", True),
-    "nan_update": ("iter", False),
-    "sigterm": ("iter", False),
+    "kill_worker": ("step", "env"),
+    "hang_worker": ("step", "env"),
+    "delay_step": ("step", "env"),
+    "nan_update": ("iter", "update"),
+    "sigterm": ("iter", "update"),
+    "kill_replica": ("request", "serve"),
+    "stall_replica": ("request", "serve"),
+    "wedge_reload": ("step", "serve"),
+    "drop_carry_journal": ("request", "serve"),
 }
 
 
@@ -53,12 +80,15 @@ _KINDS = {
 class FaultSpec:
     """One injectable fault: what (``kind``), when (``at`` — a 1-based
     host env step for env-level faults, a 1-based absolute training
-    iteration for update-level ones), and the kind-specific parameters."""
+    iteration for update-level ones, a 1-based routed client request
+    for serving-level ones, a checkpoint step for ``wedge_reload``),
+    and the kind-specific parameters."""
 
     kind: str
     at: int
     worker: int = 0
     seconds: float = 0.25
+    replica: int = 0
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -73,10 +103,22 @@ class FaultSpec:
             raise ValueError(f"{self.kind}: worker must be >= 0")
         if self.seconds < 0:
             raise ValueError(f"{self.kind}: seconds must be >= 0")
+        if self.replica < 0:
+            raise ValueError(f"{self.kind}: replica must be >= 0")
 
     @property
     def env_level(self) -> bool:
-        return _KINDS[self.kind][1]
+        return _KINDS[self.kind][1] == "env"
+
+    @property
+    def serve_level(self) -> bool:
+        return _KINDS[self.kind][1] == "serve"
+
+    @property
+    def replica_id(self) -> str:
+        """The serving replica this fault targets, in the replica set's
+        naming convention (``r<N>``)."""
+        return f"r{self.replica}"
 
     def __str__(self) -> str:
         key = _KINDS[self.kind][0]
@@ -85,6 +127,10 @@ class FaultSpec:
             extra = f":worker={self.worker}"
         elif self.kind == "delay_step":
             extra = f":seconds={self.seconds:g}"
+        elif self.kind in ("kill_replica", "drop_carry_journal"):
+            extra = f":replica={self.replica}"
+        elif self.kind == "stall_replica":
+            extra = f":replica={self.replica}:seconds={self.seconds:g}"
         return f"{self.kind}@{key}={self.at}{extra}"
 
 
@@ -119,14 +165,23 @@ def parse_fault_specs(spec: str) -> Tuple[FaultSpec, ...]:
                 )
             fields[key] = value.strip()
         if trigger_key not in fields:
+            trigger_name = {
+                "step": (
+                    "checkpoint step" if kind == "wedge_reload"
+                    else "host env step"
+                ),
+                "iter": "iteration",
+                "request": "routed client request",
+            }[trigger_key]
             raise ValueError(
                 f"fault spec {frag!r}: {kind} needs {trigger_key}=N "
-                f"({'host env step' if trigger_key == 'step' else 'iteration'})"
+                f"({trigger_name})"
             )
         try:
             at = int(fields.pop(trigger_key))
             worker = int(fields.pop("worker", 0))
             seconds = float(fields.pop("seconds", 0.25))
+            replica = int(fields.pop("replica", 0))
         except ValueError as e:
             raise ValueError(f"fault spec {frag!r}: {e}") from None
         if fields:
@@ -134,7 +189,7 @@ def parse_fault_specs(spec: str) -> Tuple[FaultSpec, ...]:
                 f"fault spec {frag!r}: unknown keys {sorted(fields)}"
             )
         out.append(FaultSpec(kind=kind, at=at, worker=worker,
-                             seconds=seconds))
+                             seconds=seconds, replica=replica))
     if not out:
         raise ValueError(f"fault spec {spec!r} contains no faults")
     return tuple(out)
@@ -162,6 +217,10 @@ class FaultInjector:
         self.specs = tuple(specs)
         self.bus = bus
         self._fired: set = set()
+        # serving hooks run on concurrent HTTP handler threads (the
+        # training hooks are single-threaded); the check-and-mark must
+        # be atomic or one fault could fire twice
+        self._lock = threading.Lock()
 
     @classmethod
     def from_spec(cls, spec: str, bus=None) -> "FaultInjector":
@@ -234,7 +293,7 @@ class FaultInjector:
         for i, s in enumerate(self.specs):
             if (
                 i in self._fired
-                or s.env_level
+                or _KINDS[s.kind][1] != "update"
                 or not iteration <= s.at < iteration + span
             ):
                 continue
@@ -258,3 +317,145 @@ class FaultInjector:
                 )
                 self._emit(s, iteration=s.at)
         return state
+
+    # -- serving plane (ISSUE 11) ------------------------------------------
+
+    def on_serve_request(
+        self, request_idx: int, replicaset=None, journal_dir=None
+    ) -> None:
+        """Fire request-clocked serving faults due at the
+        ``request_idx``-th routed client request (1-based, counted by
+        the router). ``replicaset`` is the live
+        :class:`~trpo_tpu.serve.replicaset.ReplicaSet` whose replica
+        the kill/stall specs target; ``journal_dir`` is where
+        ``drop_carry_journal`` finds its victim file."""
+        due = []
+        with self._lock:
+            for i, s in enumerate(self.specs):
+                if (
+                    i in self._fired
+                    or not s.serve_level
+                    or s.kind == "wedge_reload"
+                    or s.at != request_idx
+                ):
+                    continue
+                self._fired.add(i)
+                due.append((i, s))
+        first_error = None
+        for i, s in due:
+            try:
+                self._fire_serve_fault(s, replicaset, journal_dir)
+            except Exception as e:
+                # a fault that could not execute (bad replica index,
+                # wrong launcher family) must end the run UNFIRED —
+                # the end-of-run warning names it instead of the run
+                # passing as if the chaos had been exercised. The
+                # OTHER due faults still execute (one bad spec must
+                # not silently un-exercise its siblings); the first
+                # error re-raises afterwards.
+                with self._lock:
+                    self._fired.discard(i)
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+
+    def _fire_serve_fault(self, s, replicaset, journal_dir) -> None:
+        # emit BEFORE executing: concurrent request threads may detect
+        # the failure (report_failure -> died/evicted records) within
+        # microseconds of the kill, and the validator's matched-by-
+        # detection rule requires the detection AFTER the injection
+        if s.kind == "kill_replica":
+            rec = (
+                replicaset.replicas.get(s.replica_id)
+                if replicaset is not None else None
+            )
+            if rec is None or rec.handle is None:
+                raise ValueError(
+                    f"fault {s}: no replica {s.replica_id} to kill"
+                )
+            self._emit(s, replica=s.replica_id)
+            rec.handle.kill()
+        elif s.kind == "stall_replica":
+            rec = (
+                replicaset.replicas.get(s.replica_id)
+                if replicaset is not None else None
+            )
+            if rec is None or rec.handle is None:
+                raise ValueError(
+                    f"fault {s}: no replica {s.replica_id} to stall"
+                )
+            self._emit(s, replica=s.replica_id, seconds=s.seconds)
+            self._stall_replica(rec.handle, s.seconds)
+        elif s.kind == "drop_carry_journal":
+            if journal_dir is None:
+                raise ValueError(
+                    f"fault {s}: no carry-journal directory to "
+                    "target (router has journal_dir=None)"
+                )
+            from trpo_tpu.serve.session import journal_path
+
+            self._emit(s, replica=s.replica_id)
+            try:
+                os.remove(journal_path(journal_dir, s.replica_id))
+            except OSError:
+                pass  # never journaled anything yet: same outcome —
+                #       the failover finds nothing and says so
+
+    @staticmethod
+    def _stall_replica(handle, seconds: float) -> None:
+        """Wedge one replica's act path: in-process replicas stall the
+        PolicyServer's handlers (health checks keep answering — the
+        honest wedged-device shape); subprocess replicas get
+        SIGSTOP + a timed SIGCONT."""
+        server = getattr(handle, "server", None)
+        if server is not None and hasattr(server, "stall"):
+            server.stall(seconds)
+            return
+        proc = getattr(handle, "proc", None)
+        if proc is not None:
+            os.kill(proc.pid, signal.SIGSTOP)
+            timer = threading.Timer(
+                seconds, lambda: os.kill(proc.pid, signal.SIGCONT)
+            )
+            timer.daemon = True
+            timer.start()
+            return
+        raise ValueError(
+            "stall_replica: replica handle exposes neither an "
+            "in-process server nor a subprocess to signal"
+        )
+
+    def on_checkpoint_load(self, step: int, params):
+        """Fire ``wedge_reload`` specs due at checkpoint ``step``:
+        returns the params with every floating-point leaf NaN-poisoned
+        (the checkpoint "loads but answers garbage" — the canary gate's
+        target failure class); untouched params otherwise. Called by
+        the serving reload path with the freshly restored snapshot —
+        the FIRST replica to load the step (the canary, under gated
+        deployment) is the one that wears it."""
+        due = None
+        with self._lock:
+            for i, s in enumerate(self.specs):
+                if (
+                    i in self._fired
+                    or s.kind != "wedge_reload"
+                    or s.at != step
+                ):
+                    continue
+                self._fired.add(i)
+                due = s
+                break
+        if due is None:
+            return params
+        import jax
+        import jax.numpy as jnp
+
+        def poison(x):
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                return jnp.full_like(x, jnp.nan)
+            return x
+
+        params = jax.tree_util.tree_map(poison, params)
+        self._emit(due, step=step)
+        return params

@@ -48,13 +48,20 @@ SLOs.
 from trpo_tpu.serve.batcher import MicroBatcher
 from trpo_tpu.serve.engine import InferenceEngine
 from trpo_tpu.serve.replicaset import (
+    CanaryController,
     InProcessReplica,
     ReplicaSet,
     SubprocessReplica,
 )
 from trpo_tpu.serve.router import Router
 from trpo_tpu.serve.server import PolicyServer
-from trpo_tpu.serve.session import RecurrentServeEngine, SessionStore
+from trpo_tpu.serve.session import (
+    CarryJournal,
+    RecurrentServeEngine,
+    SessionStore,
+    journal_path,
+    read_carry_journal,
+)
 
 __all__ = [
     "InferenceEngine",
@@ -62,8 +69,12 @@ __all__ = [
     "PolicyServer",
     "RecurrentServeEngine",
     "SessionStore",
+    "CarryJournal",
+    "journal_path",
+    "read_carry_journal",
     "InProcessReplica",
     "SubprocessReplica",
     "ReplicaSet",
     "Router",
+    "CanaryController",
 ]

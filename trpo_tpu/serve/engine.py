@@ -81,6 +81,7 @@ class InferenceEngine:
         self._snapshot = None           # (params, obs_norm, step) — swapped
         #                                 atomically by reference; never
         #                                 mutated in place
+        self._prev_snapshot = None      # one-deep history for rollback()
         self._lock = threading.Lock()   # counters only — never the hot path
         #                                 of snapshot reads
         self.shape_counts: Counter = Counter()  # rung -> dispatches
@@ -122,7 +123,28 @@ class InferenceEngine:
                 )
         if not self._compiled:
             self._compile_ladder(params, obs_norm)
+        self._prev_snapshot = self._snapshot
         self._snapshot = (params, obs_norm, step)
+
+    def rollback(self) -> Optional[int]:
+        """Swap the PREVIOUS snapshot back in (one-deep, ONE-SHOT). The
+        canary gate's rejection path: rolling a bad checkpoint back is
+        an instant in-memory reference swap — it must not depend on the
+        incumbent save still existing on disk (retention may have
+        pruned it) or on a restore competing with the request path.
+        The history is consumed: a duplicated rollback (an operator
+        retry after an ambiguous timeout) must answer "nothing to roll
+        back to", never reinstate the rejected snapshot. Returns the
+        step now serving; raises when there is no previous snapshot."""
+        prev = self._prev_snapshot
+        if prev is None:
+            raise RuntimeError(
+                "no previous snapshot to roll back to — the engine has "
+                "loaded at most one checkpoint (or already rolled back)"
+            )
+        self._prev_snapshot = None
+        self._snapshot = prev
+        return prev[2]
 
     def _compile_ladder(self, params, obs_norm) -> None:
         abstract = lambda tree: jax.tree_util.tree_map(

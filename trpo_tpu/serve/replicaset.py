@@ -59,6 +59,7 @@ __all__ = [
     "InProcessReplica",
     "SubprocessReplica",
     "ReplicaSet",
+    "CanaryController",
 ]
 
 # the states a ReplicaRecord actually takes (the rotation view; the
@@ -85,10 +86,19 @@ class InProcessReplica:
     def kill(self) -> None:
         """Abrupt death (chaos/testing): drop the HTTP socket NOW —
         in-flight and later connections fail like a crashed process's
-        would — and tear down the rest quietly."""
+        would — and tear down the rest quietly. Pending carry-journal
+        entries are DROPPED (``abrupt=True``), exactly as a real crash
+        would lose the write-behind window; only explicitly drained
+        snapshots survive, keeping injected kills honest about
+        durability."""
         self._killed = True
         try:
-            self.server.close()
+            self.server.close(abrupt=True)
+        except TypeError:  # a non-PolicyServer test stand-in
+            try:
+                self.server.close()
+            except Exception:
+                pass
         except Exception:
             pass
         for c in self._closers:
@@ -199,6 +209,11 @@ class ReplicaRecord:
         self.started_at = 0.0
         self.loaded_step: Optional[int] = None
         self.sessions = 0
+        self.canary = False        # wearing an unvalidated checkpoint
+        #                            (set by CanaryController; the
+        #                            router routes a fraction of
+        #                            stateless traffic here and keeps
+        #                            sessions away)
 
     def row(self) -> dict:
         return {
@@ -208,6 +223,7 @@ class ReplicaRecord:
             "restarts": self.restarts,
             "loaded_step": self.loaded_step,
             "sessions": self.sessions,
+            "canary": self.canary,
         }
 
 
@@ -541,3 +557,481 @@ class ReplicaSet:
                     rec.handle.close()
                 except Exception:
                     pass
+
+
+class CanaryController:
+    """Gated checkpoint deployment over a :class:`ReplicaSet` (ISSUE 11).
+
+    PR 6's hot swap promotes every new checkpoint to 100% of traffic
+    with no gate — an unvalidated save takes the whole set down with
+    it. This controller turns the swap into a deployment: the replicas
+    run MANAGED reload (``PolicyServer(managed_reload=True)`` — their
+    watchers never auto-swap past the first load), and every new step
+    from ``latest_step_fn`` walks the canary lifecycle:
+
+    1. **started** — pick one healthy replica (fewest sessions, so
+       pinned recurrent sessions stay off the unvalidated checkpoint),
+       mark it canary (the router starts striding ``canary_fraction``
+       of stateless traffic onto it), and ``POST /reload {"step": N}``.
+    2. **gate** — wait until the canary has answered
+       ``window_requests`` routed requests, then judge:
+       (a) *windowed p99*: the canary's p99 over the gate window must
+       be within ``p99_budget_pct`` of the pooled incumbents' p99 over
+       the SAME window; (b) *action parity*: recent REAL request
+       bodies are mirrored to the canary and an incumbent — every
+       canary action must be finite, and (when ``parity_tol`` is set)
+       within it of the incumbent's mean absolute difference. A wedged
+       checkpoint — loads fine, answers garbage — dies here.
+    3. **promoted** — a clean gate reloads the step onto every other
+       replica (serially; each one's ``reloading`` window takes it out
+       of rotation, so no request is ever dropped), updates the
+       incumbent step, and clears the canary mark.
+    4. **rolled_back** — a failed gate swaps the canary's PREVIOUS
+       in-memory snapshot back (``{"rollback": true}`` — instant, no
+       disk, one-shot) and emits ``health:canary_rejected``. JUDGED
+       failures (p99 over budget, parity, a save that will not load)
+       blacklist the step so it is never re-canaried; TRANSIENT ones
+       (canary died mid-gate, gate window starved) retry on a later
+       tick. A canary that DIES mid-gate resolves to rolled_back: its
+       relaunch loads the incumbent step (the launcher reads
+       ``incumbent["step"]``), and the set stays healthy.
+
+    Every transition is a ``canary`` event on the bus;
+    ``scripts/validate_events.py`` fails a ``started`` with no later
+    ``promoted``/``rolled_back`` terminal — an unresolved canary means
+    this loop is broken.
+    """
+
+    def __init__(
+        self,
+        replicaset: ReplicaSet,
+        router,
+        latest_step_fn: Callable[[], Optional[int]],
+        incumbent: Optional[dict] = None,
+        window_requests: int = 24,
+        p99_budget_pct: float = 50.0,
+        parity_samples: int = 4,
+        parity_tol: Optional[float] = None,
+        gate_timeout_s: float = 120.0,
+        poll_interval: float = 1.0,
+        reload_timeout_s: float = 120.0,
+        bus=None,
+    ):
+        if window_requests < 1:
+            raise ValueError(
+                f"window_requests must be >= 1, got {window_requests}"
+            )
+        if p99_budget_pct < 0:
+            raise ValueError(
+                f"p99_budget_pct must be >= 0, got {p99_budget_pct}"
+            )
+        self.replicaset = replicaset
+        self.router = router
+        self.latest_step_fn = latest_step_fn
+        # the shared mutable incumbent cell: the replica LAUNCHER reads
+        # incumbent["step"] so a relaunch mid-gate loads the validated
+        # step, never the one under test
+        self.incumbent = incumbent if incumbent is not None else {
+            "step": None
+        }
+        self.window_requests = int(window_requests)
+        self.p99_budget_pct = float(p99_budget_pct)
+        self.parity_samples = int(parity_samples)
+        self.parity_tol = parity_tol
+        self.gate_timeout_s = float(gate_timeout_s)
+        self.poll_interval = float(poll_interval)
+        self.reload_timeout_s = float(reload_timeout_s)
+        self.bus = bus
+        self.promoted_total = 0
+        self.rolled_back_total = 0
+        self._rejected_steps: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def incumbent_step(self) -> Optional[int]:
+        return self.incumbent["step"]
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _emit(self, event: str, step: int, replica: str, **extra) -> None:
+        if self.bus is None:
+            return
+        try:
+            self.bus.emit(
+                "canary", step=step, event=event, replica=replica,
+                **extra,
+            )
+        except Exception:
+            pass
+
+    def _emit_rejected(self, step: int, replica: str, reason: str) -> None:
+        if self.bus is None:
+            return
+        try:
+            self.bus.emit(
+                "health", check="canary_rejected", level="warn",
+                message=(
+                    f"canary gate rejected checkpoint step {step} on "
+                    f"{replica}: {reason}"
+                ),
+                data={"step": step, "replica": replica},
+            )
+        except Exception:
+            pass
+
+    def _post(self, url: Optional[str], path: str, payload: dict,
+              timeout: Optional[float] = None):
+        """POST to a replica's control/data route; ``(status, parsed)``
+        or ``(None, None)`` on transport failure (including a replica
+        mid-relaunch with no bound URL yet)."""
+        try:
+            req = urllib.request.Request(
+                url + path,
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(
+                req, timeout=timeout or self.reload_timeout_s
+            ) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except Exception:
+                return e.code, None
+        except Exception:
+            return None, None
+
+    def _replica_alive(self, rec: ReplicaRecord) -> bool:
+        with self.replicaset.lock:
+            return rec.state in ("starting", "healthy", "reloading")
+
+    def _canary_lost(self, rec: ReplicaRecord, restarts0: int) -> bool:
+        """The canary no longer wears the step under test: it died, or
+        it died AND the supervisor already relaunched it (the relaunch
+        reads ``incumbent["step"]``, so a bumped restart counter means
+        the unvalidated snapshot is gone even though the record reads
+        healthy again)."""
+        with self.replicaset.lock:
+            return (
+                rec.state not in ("starting", "healthy", "reloading")
+                or rec.restarts != restarts0
+            )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="canary-controller", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover — must never die
+                pass
+
+    def tick(self) -> None:
+        """One control pass: adopt/gate the newest complete checkpoint.
+        Synchronous — a gate runs to its terminal inside this call
+        (tests drive it directly; the thread just repeats it)."""
+        try:
+            step = self.latest_step_fn()
+        except Exception:
+            return
+        if step is None:
+            return
+        if self.incumbent["step"] is None:
+            # first adoption: take what the replicas ACTUALLY serve
+            # (their ungated first load), not blindly the latest step —
+            # a save landing between their first load and this first
+            # tick must go through the gate like any other
+            with self.replicaset.lock:
+                served = [
+                    r.loaded_step
+                    for r in self.replicaset.replicas.values()
+                    if r.loaded_step is not None
+                ]
+            self.incumbent["step"] = max(served) if served else step
+            return
+        if step == self.incumbent["step"] or step in self._rejected_steps:
+            self._reconcile()
+            return
+        self._run_gate(step)
+
+    def _reconcile(self) -> None:
+        """Converge stragglers onto the incumbent: a replica that
+        relaunched mid-promotion (launcher read the pre-promotion
+        cell) or whose promotion reload failed transiently would
+        otherwise serve a mixed step forever — managed replicas never
+        follow latest on their own."""
+        incumbent = self.incumbent["step"]
+        if incumbent is None:
+            return
+        with self.replicaset.lock:
+            lagging = [
+                (r.id, r.url) for r in self.replicaset.replicas.values()
+                if (
+                    r.state == "healthy"
+                    and not r.canary
+                    and r.loaded_step is not None
+                    and r.loaded_step != incumbent
+                )
+            ]
+        for rid, url in lagging:
+            self._post(url, "/reload", {"step": incumbent})
+
+    # -- the gate ----------------------------------------------------------
+
+    def _pick_canary(self) -> Optional[ReplicaRecord]:
+        with self.replicaset.lock:
+            healthy = [
+                r for r in self.replicaset.replicas.values()
+                if r.state == "healthy"
+            ]
+            if len(healthy) < 2:
+                # a 1-replica "canary" is just an ungated swap with
+                # extra steps; wait for the set to heal
+                return None
+            return min(healthy, key=lambda r: (r.sessions, r.id))
+
+    def _run_gate(self, step: int) -> None:
+        rec = self._pick_canary()
+        if rec is None:
+            return  # retry next tick
+        with self.replicaset.lock:
+            rec.canary = True
+        self._emit("started", step, rec.id)
+        try:
+            ok, reason = self._deploy_and_judge(rec, step)
+        except Exception as e:
+            # a gate bug must still resolve the canary: an unresolved
+            # `started` is exactly what the validator fails logs for
+            ok, reason = False, f"gate error: {type(e).__name__}: {e}"
+        if ok:
+            self._promote(rec, step)
+        else:
+            self._rollback(rec, step, reason)
+
+    # gate failures that say nothing about the CHECKPOINT: the canary
+    # died under it, traffic lulled and the window starved, or no
+    # mirrored body produced a usable parity verdict. These roll back
+    # but do NOT blacklist the step — the next tick retries; a judged
+    # failure (p99, parity, a save that will not load) does.
+    _TRANSIENT_REASONS = (
+        "canary died mid-gate",
+        "gate window starved",
+        "no usable parity sample",
+    )
+
+    def _deploy_and_judge(self, rec: ReplicaRecord, step: int):
+        with self.replicaset.lock:
+            restarts0 = rec.restarts
+        # 1. command the canary onto the new step (synchronous reload)
+        status, out = self._post(rec.url, "/reload", {"step": step})
+        if status != 200 or not (out or {}).get("ok"):
+            return False, (
+                f"canary reload to step {step} failed "
+                f"(status={status}, {out})"
+            )
+        # 2. observe a fresh window of routed traffic
+        self.router.reset_replica_latencies()
+        deadline = time.monotonic() + self.gate_timeout_s
+        while True:
+            if self._canary_lost(rec, restarts0):
+                return False, "canary died mid-gate"
+            canary_lats = self.router.replica_latencies_ms(rec.id)
+            if len(canary_lats) >= self.window_requests:
+                break
+            if time.monotonic() >= deadline:
+                return False, (
+                    f"gate window starved: {len(canary_lats)}/"
+                    f"{self.window_requests} canary requests within "
+                    f"{self.gate_timeout_s:g}s"
+                )
+            time.sleep(0.02)
+        # 3a. windowed p99 vs the pooled incumbents over the same window
+        from trpo_tpu.utils.metrics import quantile_nearest_rank
+
+        incumbent_lats: list = []
+        with self.replicaset.lock:
+            others = [
+                r.id for r in self.replicaset.replicas.values()
+                if r.id != rec.id
+            ]
+        for rid in others:
+            incumbent_lats.extend(self.router.replica_latencies_ms(rid))
+        if incumbent_lats:
+            c99 = quantile_nearest_rank(canary_lats, 0.99)
+            i99 = quantile_nearest_rank(incumbent_lats, 0.99)
+            budget = i99 * (1.0 + self.p99_budget_pct / 100.0)
+            if c99 > budget:
+                return False, (
+                    f"canary p99 {c99:.1f}ms over budget "
+                    f"{budget:.1f}ms (incumbent p99 {i99:.1f}ms + "
+                    f"{self.p99_budget_pct:g}%)"
+                )
+        # 3b. action parity on mirrored REAL traffic
+        return self._judge_parity(rec, others)
+
+    def _judge_parity(self, rec: ReplicaRecord, others) -> tuple:
+        """Mirror recent REAL request bodies to the canary (and an
+        incumbent referee). Client bodies are untrusted: a body BOTH
+        replicas refuse is the client's problem and judges nothing —
+        only a body the incumbent answers and the canary refuses (or
+        answers nonfinite / out-of-tolerance) convicts the canary.
+        Zero usable samples is a TRANSIENT outcome (retry next tick),
+        never a vacuous pass."""
+        import numpy as np
+
+        bodies = self.router.recent_act_bodies(self.parity_samples)
+        incumbent_url = None
+        with self.replicaset.lock:
+            for rid in others:
+                other = self.replicaset.replicas.get(rid)
+                if other is not None and other.state == "healthy":
+                    incumbent_url = other.url
+                    break
+        usable = 0
+        diffs = []
+        for body in bodies:
+            try:
+                payload = json.loads(body)
+            except ValueError:
+                continue  # unparseable client body: judges nothing
+            c_status, c_out = self._post(
+                rec.url, "/act", payload, timeout=30.0
+            )
+            if c_status != 200 or not isinstance(c_out, dict):
+                if incumbent_url is None:
+                    continue  # no referee: cannot attribute the refusal
+                i_status, i_out = self._post(
+                    incumbent_url, "/act", payload, timeout=30.0
+                )
+                if i_status != 200:
+                    continue  # BOTH refuse: a bad client body, skip it
+                return False, (
+                    f"canary refused a mirrored request "
+                    f"(status={c_status}) the incumbent answered"
+                )
+            c_act = np.asarray(c_out.get("action"), dtype=np.float64)
+            if not np.all(np.isfinite(c_act)):
+                return False, (
+                    "canary answered nonfinite actions on mirrored "
+                    "traffic (wedged checkpoint)"
+                )
+            usable += 1
+            if incumbent_url is not None and self.parity_tol is not None:
+                i_status, i_out = self._post(
+                    incumbent_url, "/act", payload, timeout=30.0
+                )
+                if i_status == 200 and isinstance(i_out, dict):
+                    i_act = np.asarray(
+                        i_out.get("action"), dtype=np.float64
+                    )
+                    if i_act.shape == c_act.shape:
+                        diffs.append(
+                            float(np.mean(np.abs(c_act - i_act)))
+                        )
+        if usable == 0:
+            # the gate window proved traffic flows, but none of the
+            # sampled bodies produced a usable verdict: hold the line
+            # (transient — not blacklisted) rather than promote blind
+            return False, "no usable parity sample in mirrored traffic"
+        if diffs and self.parity_tol is not None:
+            mean_diff = sum(diffs) / len(diffs)
+            if mean_diff > self.parity_tol:
+                return False, (
+                    f"action parity {mean_diff:.4f} over tolerance "
+                    f"{self.parity_tol:g} vs the incumbent on mirrored "
+                    "obs"
+                )
+        return True, None
+
+    def _promote(self, rec: ReplicaRecord, step: int) -> None:
+        # publish the new incumbent BEFORE the reload sweep: a replica
+        # relaunching while the sweep runs reads this cell through the
+        # launcher closure — updating it afterwards would let the
+        # relaunch come up pinned to the OLD step with nothing to
+        # converge it until the next promotion (the _reconcile pass
+        # also sweeps any such straggler on later ticks)
+        self.incumbent["step"] = step
+        with self.replicaset.lock:
+            others = [
+                r for r in self.replicaset.replicas.values()
+                if r.id != rec.id and r.state in ("healthy", "reloading")
+            ]
+        for other in others:
+            # serial: each replica's reloading window takes it out of
+            # rotation while the survivors keep serving — zero drops
+            status, out = self._post(other.url, "/reload", {"step": step})
+            if status != 200 or not (out or {}).get("ok"):
+                self._emit_health_warn(
+                    f"promotion reload to step {step} failed on "
+                    f"{other.id} (status={status}) — it keeps serving "
+                    f"step {other.loaded_step}; the reconcile pass on "
+                    "a later tick will converge it"
+                )
+        with self.replicaset.lock:
+            rec.canary = False
+        self.promoted_total += 1
+        self._emit("promoted", step, rec.id)
+
+    def _rollback(self, rec: ReplicaRecord, step: int, reason: str) -> None:
+        if self._replica_alive(rec) and rec.url:
+            health = self.replicaset._healthz(rec.url) or {}
+            if health.get("step") == step:
+                # the canary actually serves the step under test:
+                # instant in-memory rollback (explicit incumbent load
+                # as the fallback when the one-shot history is spent)
+                status, out = self._post(
+                    rec.url, "/reload", {"rollback": True}
+                )
+                if status != 200:
+                    incumbent = self.incumbent["step"]
+                    if incumbent is not None:
+                        self._post(
+                            rec.url, "/reload", {"step": incumbent}
+                        )
+            else:
+                # the reload never swapped (failed restore): a rollback
+                # would revert PAST the incumbent and waste the one-shot
+                # history — instead unpin the target back to the
+                # incumbent so the replica's watcher stops retrying the
+                # rejected step
+                incumbent = self.incumbent["step"]
+                if incumbent is not None:
+                    self._post(rec.url, "/reload", {"step": incumbent})
+        # a DEAD canary needs no reload: its relaunch reads
+        # incumbent["step"] from the launcher closure
+        with self.replicaset.lock:
+            rec.canary = False
+        if not any(
+            (reason or "").startswith(t) for t in self._TRANSIENT_REASONS
+        ):
+            self._rejected_steps.add(step)
+        self.rolled_back_total += 1
+        self._emit("rolled_back", step, rec.id, reason=reason)
+        self._emit_rejected(step, rec.id, reason)
+
+    def _emit_health_warn(self, message: str) -> None:
+        if self.bus is None:
+            return
+        try:
+            self.bus.emit(
+                "health", check="canary_promotion_partial",
+                level="warn", message=message,
+            )
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

@@ -16,10 +16,14 @@ replicas are in rotation:
   refused/reset, a replica dying mid-request) reports the replica to
   the supervisor (immediate eviction, no poll-tick wait) and retries
   the request ONCE on a different replica; ``/act`` is a pure function
-  of the snapshot, so the retry can never double-apply anything. An
-  HTTP-level answer (400, 409, 404, even 500) is passed through
-  untouched — the replica is alive and already answered; retrying a
-  400 elsewhere would just burn a second replica's time.
+  of the snapshot — and session acts are seq-deduped (ISSUE 11) — so
+  the retry can never double-apply anything. A 5xx answer from an
+  UN-pinned replica (a half-dead replica racing its own teardown, a
+  wedged engine) also retries once elsewhere, with the original
+  answer passed through verbatim when no second replica exists.
+  Client-level answers (400, 409, 404) pass through untouched — the
+  replica is alive and already answered; retrying a 400 elsewhere
+  would just burn a second replica's time.
 * **503 backpressure only when ALL replicas are saturated** — each
   replica carries at most ``max_inflight`` router-outstanding
   requests; a request finding every in-rotation replica at its bound
@@ -27,15 +31,27 @@ replicas are in rotation:
   spike turns into client-visible backpressure instead of unbounded
   queueing — the MicroBatcher/StatsDrain bound-not-buffer policy one
   level up.
-* **Session affinity** (recurrent policies) — ``POST /session`` mints
-  the id HERE (the router must own it to re-establish), registers it
-  on the least-loaded replica, and pins the session to that replica;
-  ``POST /session/<id>/act`` follows the pin. When the pinned replica
-  dies, the next session act RE-ESTABLISHES the session on a healthy
-  replica from a FRESH carry (the old carry died with the replica —
-  recurrent state is lossy under replica failure by design; the
-  response carries ``"reestablished": true`` and a ``session`` event
-  records it) instead of failing the client.
+* **Session affinity + lossless failover** (recurrent policies) —
+  ``POST /session`` mints the id HERE (the router must own it to
+  re-establish), registers it on the least-loaded replica, and pins
+  the session to that replica; ``POST /session/<id>/act`` follows the
+  pin, stamped with a per-session ``seq`` number so the replica can
+  dedupe a replayed retry (exactly-once application). When the pinned
+  replica dies, the next session act looks the session up in the dead
+  replica's carry journal (``journal_dir`` —
+  ``serve/session.CarryJournal``): a journaled carry RESUMES the
+  session on a healthy replica (``"resumed": true`` +
+  ``"resumed_steps"``; bit-exact continuation when the snapshot is
+  current), and only when no journal entry exists does the router fall
+  back to the ISSUE 9 fresh-carry path (``"reestablished": true`` —
+  and it says so). Either way the client's request is answered, never
+  failed.
+* **Canary routing** (ISSUE 11) — while a
+  :class:`~trpo_tpu.serve.replicaset.CanaryController` has one replica
+  wearing an unvalidated checkpoint, the router sends it
+  ``canary_fraction`` of STATELESS traffic (deterministic stride, not
+  sampling) and keeps sessions off it; if every incumbent is saturated
+  or gone the canary still serves — degraded beats dropped.
 * ``GET /status`` (JSON) + ``GET /metrics`` (Prometheus
   ``trpo_router_*``: per-replica state one-hot over the record states,
   routed/retried/failed/backpressure counters, windowed p50/p99,
@@ -73,11 +89,13 @@ def _body(obj) -> bytes:
 
 
 class _Affinity:
-    __slots__ = ("replica", "last_used")
+    __slots__ = ("replica", "last_used", "seq", "acts")
 
     def __init__(self, replica: str, now: float):
         self.replica = replica
         self.last_used = now
+        self.seq = 0   # per-session act sequence (the dedupe stamp)
+        self.acts = 0  # acts the router saw succeed (journal-lag probe)
 
 
 class Router:
@@ -104,10 +122,17 @@ class Router:
         max_sessions: int = 4096,
         bus=None,
         latency_window: int = 4096,
+        journal_dir: Optional[str] = None,
+        canary_fraction: float = 0.0,
+        injector=None,
     ):
         if max_inflight < 1:
             raise ValueError(
                 f"max_inflight must be >= 1, got {max_inflight}"
+            )
+        if not 0.0 <= canary_fraction <= 1.0:
+            raise ValueError(
+                f"canary_fraction must be in [0, 1], got {canary_fraction}"
             )
         self.replicaset = replicaset
         self.max_inflight = int(max_inflight)
@@ -115,17 +140,29 @@ class Router:
         self.session_ttl_s = float(session_ttl_s)
         self.max_sessions = int(max_sessions)
         self.bus = bus
+        self.journal_dir = journal_dir
+        self.canary_fraction = float(canary_fraction)
+        self.injector = injector  # serving-plane chaos (may be set late)
 
         self.routed_total = 0       # requests answered via a replica
         self.retried_total = 0      # transparent transport retries taken
         self.failed_total = 0       # requests failed after the retry
         self.backpressure_total = 0  # 503s for saturation/empty rotation
         self.sessions_created_total = 0
-        self.sessions_reestablished_total = 0
+        self.sessions_reestablished_total = 0  # failover, fresh carry
+        self.sessions_resumed_total = 0        # failover, journaled carry
         self._lock = threading.Lock()
         self._affinity: Dict[str, _Affinity] = {}
         self._lat_lock = threading.Lock()
         self._latencies_ms: deque = deque(maxlen=latency_window)
+        # per-replica rolling windows: the canary gate compares the
+        # canary's p99 against the incumbents' over the same period
+        self._replica_lats: Dict[str, deque] = {}
+        # recent stateless request bodies, mirrored by the canary
+        # gate's action-parity sample (real traffic, not synthetic obs)
+        self._recent_obs: deque = deque(maxlen=64)
+        self._canary_clock = 0.0  # deterministic fraction accumulator
+        self._chaos_requests = 0
         self._tls = threading.local()  # per-thread replica conn pool
 
         from trpo_tpu.utils.httpd import BackgroundHTTPServer
@@ -158,11 +195,17 @@ class Router:
 
     # -- dispatch core -----------------------------------------------------
 
-    def _pick(self, exclude=()) -> Optional[str]:
+    def _pick(self, exclude=(), stateless: bool = True) -> Optional[str]:
         """Least-inflight healthy replica id under ``max_inflight``, or
         None (saturated / empty rotation). Bumps the winner's inflight
         under the set's lock — the reservation IS the queue-depth
-        signal."""
+        signal.
+
+        Canary-aware: while a replica is marked canary, STATELESS
+        requests route to it on a deterministic ``canary_fraction``
+        stride and everything else routes around it (sessions never
+        pin to an unvalidated checkpoint). If the canary is the only
+        viable candidate it still serves — degraded beats dropped."""
         rotation = self.replicaset.in_rotation()
         with self.replicaset.lock:
             candidates = [
@@ -171,9 +214,35 @@ class Router:
             ]
             if not candidates:
                 return None
+            canary = [
+                r for r in candidates if getattr(r, "canary", False)
+            ]
+            incumbents = [
+                r for r in candidates if not getattr(r, "canary", False)
+            ]
+            if canary and incumbents:
+                if stateless and self._canary_take():
+                    candidates = canary
+                else:
+                    candidates = incumbents
             best = min(candidates, key=lambda r: (r.inflight, r.id))
             best.inflight += 1
             return best.id
+
+    def _canary_take(self) -> bool:
+        """Deterministic error-accumulator over stateless requests: the
+        canary receives EXACTLY ``canary_fraction`` of them in the long
+        run for any fraction (a rounded stride would quantize 0.4 to
+        1-in-2 and anything above 2/3 to EVERY request — the whole
+        fleet wearing the unvalidated checkpoint). Reproducible gate
+        windows instead of sampling noise. Called under the set lock."""
+        if self.canary_fraction <= 0.0:
+            return False
+        self._canary_clock += self.canary_fraction
+        if self._canary_clock >= 1.0:
+            self._canary_clock -= 1.0
+            return True
+        return False
 
     def _release(self, replica_id: str) -> None:
         rec = self.replicaset.get(replica_id)
@@ -266,7 +335,7 @@ class Router:
             pass
 
     def _dispatch(self, path: str, body: bytes, endpoint: str,
-                  pinned: Optional[str] = None):
+                  pinned: Optional[str] = None, stateless: bool = True):
         """The routed request core: pick (or follow the pin), forward,
         retry ONCE on transport failure, account, emit. Returns the
         upstream ``(status, ctype, body)`` plus the replica that finally
@@ -276,6 +345,7 @@ class Router:
         retried = False
         tried = []
         lost_rid = None  # a replica we reached and lost mid-request
+        first_5xx = None  # a server-side error answer held as fallback
         for attempt in (0, 1):
             if pinned is not None and attempt == 0:
                 rid = pinned
@@ -292,10 +362,10 @@ class Router:
                     # (session path) re-establishes; plain /act never pins
                     return None, None, retried
             else:
-                rid = self._pick(exclude=tried)
+                rid = self._pick(exclude=tried, stateless=stateless)
                 if rid is None:
                     break
-                if lost_rid is not None:
+                if lost_rid is not None or first_5xx is not None:
                     # the retry is COUNTED only once it actually has a
                     # second replica to go to — a single-replica death
                     # is a failure, not a phantom retry
@@ -313,15 +383,48 @@ class Router:
                 lost_rid = rid
                 if attempt == 0 and pinned is None:
                     continue
-                return None, rid, retried
+                break  # post-loop: a held 5xx answer still passes
+                #        through; otherwise this reads as a failure
+            if (
+                status >= 500
+                and attempt == 0
+                and pinned is None
+                and lost_rid is None
+            ):
+                # a SERVER-side error from an un-pinned replica (a
+                # half-dead replica racing its own teardown, a wedged
+                # engine): these requests are safe to re-run — /act is
+                # a pure function of the snapshot and session acts are
+                # seq-deduped — so try ONCE elsewhere. The answer is
+                # kept: if no second replica exists, it passes through
+                # verbatim (4xx client errors never retry)
+                self._release(rid)
+                first_5xx = ((status, _JSON, payload), rid)
+                continue
             self._release(rid)
             ms = (time.perf_counter() - t0) * 1e3
             with self._lock:
                 self.routed_total += 1
             with self._lat_lock:
                 self._latencies_ms.append(ms)
+                win = self._replica_lats.get(rid)
+                if win is None:
+                    win = self._replica_lats[rid] = deque(maxlen=512)
+                win.append(ms)
             self._emit_request(ms, True, retried, rid, endpoint)
             return (status, _JSON, payload), rid, retried
+        if first_5xx is not None:
+            # the 5xx retry found no (or no better) second replica:
+            # pass the original upstream answer through rather than
+            # masking it behind a router-made 502/503
+            (status, ctype, payload), rid = first_5xx
+            ms = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                self.routed_total += 1
+            with self._lat_lock:
+                self._latencies_ms.append(ms)
+            self._emit_request(ms, True, retried, rid, endpoint)
+            return (status, ctype, payload), rid, retried
         # no replica left to try: a reached-and-lost replica makes this
         # a FAILURE (lost_rid propagates so _unrouted counts it as one);
         # otherwise it is backpressure (saturated / empty rotation)
@@ -329,12 +432,51 @@ class Router:
 
     # -- handlers ----------------------------------------------------------
 
+    def _chaos_tick(self) -> None:
+        """One client request entered the router: give the serving-plane
+        fault injector (``resilience/inject.py``) its trigger point.
+        A hook failure must never fail the request it rode in on."""
+        if self.injector is None:
+            return
+        with self._lock:
+            self._chaos_requests += 1
+            idx = self._chaos_requests
+        try:
+            self.injector.on_serve_request(
+                idx, replicaset=self.replicaset,
+                journal_dir=self.journal_dir,
+            )
+        except Exception:
+            pass
+
     def _act(self, body: bytes):
+        self._chaos_tick()
+        # keep a small ring of real request bodies: the canary gate's
+        # action-parity sample mirrors ACTUAL traffic to the canary and
+        # an incumbent instead of guessing an obs distribution
+        self._recent_obs.append(body)
         result, rid, retried = self._dispatch(body=body, path="/act",
                                               endpoint="act")
         if result is not None:
             return result
         return self._unrouted(rid, retried, "act")
+
+    # -- the canary controller's probes ------------------------------------
+
+    def recent_act_bodies(self, n: int = 8) -> list:
+        """Up to ``n`` recent stateless request bodies (newest last)."""
+        ring = list(self._recent_obs)
+        return ring[-n:]
+
+    def replica_latencies_ms(self, replica_id: str) -> list:
+        with self._lat_lock:
+            win = self._replica_lats.get(replica_id)
+            return list(win) if win is not None else []
+
+    def reset_replica_latencies(self) -> None:
+        """Start a fresh observation window (gate start)."""
+        with self._lat_lock:
+            self._replica_lats.clear()
 
     def _unrouted(self, rid, retried: bool, endpoint: str):
         """No replica answered: 502 when we reached-and-lost replicas
@@ -402,7 +544,7 @@ class Router:
         sid = mint_session_id()
         result, rid, _retried = self._dispatch(
             body=_body({"session_id": sid}), path="/session",
-            endpoint="session",
+            endpoint="session", stateless=False,
         )
         if result is None:
             return self._unrouted(rid, False, "session")
@@ -426,7 +568,77 @@ class Router:
             if now - aff.last_used > self.session_ttl_s:
                 del self._affinity[sid]
 
+    def _journal_lookup(self, replica_id: str, sid: str):
+        """The newest journaled entry for one session from one replica's
+        carry journal — read fresh from disk (failover is rare; the
+        file is the crash-surviving source of truth). None when
+        durability is off, the file is missing, the entry is torn, or
+        the session was never journaled."""
+        if self.journal_dir is None:
+            return None
+        from trpo_tpu.serve.session import journal_path, read_carry_journal
+
+        try:
+            return read_carry_journal(
+                journal_path(self.journal_dir, replica_id)
+            ).get(sid)
+        except Exception:
+            return None
+
+    def _reestablish(self, sid: str, aff, entry):
+        """Re-create the session on a healthy replica — from the
+        journaled ``entry`` when one exists (RESUME: carry + steps +
+        dedupe state travel), from a fresh carry otherwise. Returns
+        ``(ok, rid, resumed)``; on success the affinity is re-pinned
+        (the seq counter is NEVER reset — dedupe continuity across the
+        failover is the exactly-once guarantee)."""
+        create = {"session_id": sid}
+        resumed = entry is not None
+        if resumed:
+            create.update(
+                carry=entry["carry"], steps=entry["steps"],
+                seq=entry.get("seq"), last_action=entry.get("last_action"),
+                last_step=entry.get("last_step"),
+            )
+        result, rid, _ = self._dispatch(
+            body=_body(create), path="/session", endpoint="session",
+            stateless=False,
+        )
+        if result is None or result[0] != 200:
+            if resumed and result is not None and result[0] == 400:
+                # a journaled entry the new replica refuses (e.g. carry
+                # width from an incompatible incarnation) must degrade
+                # to the fresh-carry path, not fail the client
+                return self._reestablish(sid, aff, None)
+            return (result, rid, resumed) if result is not None else (
+                None, rid, resumed
+            )
+        with self._lock:
+            aff.replica = rid
+            aff.last_used = time.monotonic()
+            if resumed:
+                self.sessions_resumed_total += 1
+            else:
+                self.sessions_reestablished_total += 1
+        if self.bus is not None:
+            try:
+                if resumed:
+                    self.bus.emit(
+                        "session", session=sid, event="resumed",
+                        replica=rid, steps=int(entry["steps"]),
+                        lag=max(0, aff.acts - int(entry["steps"])),
+                    )
+                else:
+                    self.bus.emit(
+                        "session", session=sid, event="reestablished",
+                        replica=rid,
+                    )
+            except Exception:
+                pass
+        return True, rid, resumed
+
     def _session_act(self, path: str, body: bytes):
+        self._chaos_tick()
         parts = path.strip("/").split("/")
         if len(parts) != 3 or parts[0] != "session" or parts[2] != "act":
             return 404, _JSON, _body(
@@ -446,35 +658,54 @@ class Router:
                     "code": "session_unknown",
                 }
             )
-        reestablished = False
+        # stamp the per-session sequence number: the replica dedupes a
+        # replay of an already-applied seq (the retry-idempotency
+        # contract) — an unparseable body forwards untouched and takes
+        # the replica's 400
+        try:
+            payload = json.loads(body)
+            if not isinstance(payload, dict):
+                raise ValueError
+            with self._lock:
+                aff.seq += 1
+                payload["seq"] = aff.seq
+            body = _body(payload)
+        except ValueError:
+            pass
+        pinned = aff.replica
+        resumed = reestablished = False
+        entry = None
         result, rid, retried = self._dispatch(
             body=body, path=f"/session/{sid}/act",
-            endpoint="session_act", pinned=aff.replica,
+            endpoint="session_act", pinned=pinned,
         )
-        if result is None:
-            # the pinned replica is gone (left rotation, or died on the
-            # forward): re-establish the session — FRESH carry — on a
-            # healthy replica, then act there
-            reestablished = True
-            result, rid, _ = self._dispatch(
-                body=_body({"session_id": sid}), path="/session",
-                endpoint="session",
-            )
-            if result is None or result[0] != 200:
-                if result is not None:
-                    return result
+        lost_pin = result is None
+        if not lost_pin and result[0] == 404:
+            # the pinned replica restarted with an empty store (or
+            # TTL-expired the session): a journaled carry still resumes
+            # it — only a journal miss passes the 404 through
+            try:
+                unknown = (
+                    json.loads(result[2]).get("code") == "session_unknown"
+                )
+            except ValueError:
+                unknown = False
+            if unknown:
+                entry = self._journal_lookup(pinned, sid)
+                lost_pin = entry is not None
+        if lost_pin:
+            # the pinned replica is gone (left rotation, died on the
+            # forward, or restarted without the session): resume from
+            # its carry journal when an entry exists, re-establish from
+            # a fresh carry otherwise — never fail the client
+            if entry is None:
+                entry = self._journal_lookup(pinned, sid)
+            ok, rid, resumed = self._reestablish(sid, aff, entry)
+            if ok is not True:
+                if ok is not None:
+                    return ok  # the create's upstream error, verbatim
                 return self._unrouted(rid, retried, "session_act")
-            with self._lock:
-                self._affinity[sid] = _Affinity(rid, time.monotonic())
-                self.sessions_reestablished_total += 1
-            if self.bus is not None:
-                try:
-                    self.bus.emit(
-                        "session", session=sid, event="reestablished",
-                        replica=rid,
-                    )
-                except Exception:
-                    pass
+            reestablished = not resumed
             result, rid, _ = self._dispatch(
                 body=body, path=f"/session/{sid}/act",
                 endpoint="session_act", pinned=rid,
@@ -483,10 +714,17 @@ class Router:
                 return self._unrouted(rid, True, "session_act")
         status, ctype, payload = result
         aff.last_used = time.monotonic()
-        if status != 200 or not reestablished:
+        if status == 200:
+            with self._lock:
+                aff.acts += 1
+        if status != 200 or not (resumed or reestablished):
             return status, ctype, payload
         out = json.loads(payload)
-        out["reestablished"] = True
+        if resumed:
+            out["resumed"] = True
+            out["resumed_steps"] = int(entry["steps"])
+        else:
+            out["reestablished"] = True
         return status, _JSON, _body(out)
 
     # -- introspection -----------------------------------------------------
@@ -514,6 +752,7 @@ class Router:
                 "sessions_created_total": self.sessions_created_total,
                 "sessions_reestablished_total":
                     self.sessions_reestablished_total,
+                "sessions_resumed_total": self.sessions_resumed_total,
             }
         q = self.latency_quantiles_ms((0.5, 0.99))
         return 200, _JSON, _body(
@@ -604,6 +843,14 @@ class Router:
                 if row["loaded_step"] is not None
             ],
         )
+        fam(
+            "trpo_router_replica_canary", "gauge",
+            "1 while the replica is canarying an unvalidated checkpoint",
+            [
+                ({"replica": rid}, 1.0 if row.get("canary") else 0.0)
+                for rid, row in sorted(replicas.items())
+            ],
+        )
         with self._lock:
             counter_rows = [
                 ("trpo_router_routed_total",
@@ -620,8 +867,13 @@ class Router:
                  "sessions minted through the router",
                  self.sessions_created_total),
                 ("trpo_router_sessions_reestablished_total",
-                 "sessions re-established after replica death",
+                 "sessions re-established after replica death "
+                 "(fresh carry — no journal entry existed)",
                  self.sessions_reestablished_total),
+                ("trpo_router_sessions_resumed_total",
+                 "sessions resumed from a journaled carry after "
+                 "replica death (lossless failover)",
+                 self.sessions_resumed_total),
             ]
             sessions_live = len(self._affinity)
         for name, help_, value in counter_rows:

@@ -50,6 +50,7 @@ from __future__ import annotations
 import json
 import math
 import threading
+import time
 from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Callable, Optional
 
@@ -83,9 +84,28 @@ class PolicyServer:
     must be ``None`` — session steps are per-session batch-1, carry
     threading has nothing to coalesce; the session routes are active
     and ``/act`` answers the typed 409).
+
+    **Managed reload** (ISSUE 11, the canary seam):
+    ``managed_reload=True`` stops the watcher from auto-swapping to
+    every new checkpoint — the replica's FIRST load takes
+    ``initial_step`` (or latest when ``None``, for a cold directory),
+    and every later step lands only through ``POST /reload``
+    (``{"step": N}`` loads a specific marker-complete step;
+    ``{"rollback": true}`` swaps the previous in-memory snapshot back
+    instantly — no disk). The
+    :class:`~trpo_tpu.serve.replicaset.CanaryController` drives this:
+    one replica canaries the new step, the rest follow only on a clean
+    gate.
+
+    **Carry durability**: ``carry_journal_dir`` (recurrent engines)
+    attaches a :class:`~trpo_tpu.serve.session.CarryJournal` at
+    ``journal_path(dir, replica_name)`` — session carries snapshot
+    into it every ``carry_sync_every`` applied steps (write-behind,
+    off the act path), which is what the router resumes from when this
+    replica dies.
     """
 
-    ENDPOINTS = ("/act", "/session", "/healthz", "/metrics")
+    ENDPOINTS = ("/act", "/session", "/healthz", "/metrics", "/reload")
 
     def __init__(
         self,
@@ -102,6 +122,11 @@ class PolicyServer:
         session_ttl_s: float = 300.0,
         max_sessions: int = 1024,
         replica_name: Optional[str] = None,
+        carry_journal_dir: Optional[str] = None,
+        carry_sync_every: int = 1,
+        managed_reload: bool = False,
+        initial_step: Optional[int] = None,
+        injector=None,
     ):
         if (checkpointer is None) != (template is None):
             raise ValueError(
@@ -135,19 +160,44 @@ class PolicyServer:
         self.reloads_total = 0
         self.session_acts_total = 0
         self.session_act_errors_total = 0
+        self.replica_name = replica_name
+        self.injector = injector
+        self.managed_reload = bool(managed_reload)
+        # managed mode: the ONLY step this replica may serve; None =
+        # "adopt whatever first checkpoint appears" (cold directory)
+        self._target_step: Optional[int] = (
+            int(initial_step)
+            if managed_reload and initial_step is not None
+            else None
+        )
         self._counter_lock = threading.Lock()
+        self._reload_lock = threading.Lock()  # watcher vs POST /reload
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
         self._reloading = False  # True while a restore+load is in flight
+        self._stall_until = 0.0  # chaos: acts sleep past this deadline
         self.sessions = None
         if self.is_recurrent:
-            from trpo_tpu.serve.session import SessionStore
+            from trpo_tpu.serve.session import (
+                CarryJournal,
+                SessionStore,
+                journal_path,
+            )
 
+            journal = None
+            if carry_journal_dir is not None:
+                journal = CarryJournal(
+                    journal_path(
+                        carry_journal_dir, replica_name or "solo"
+                    )
+                )
             self.sessions = SessionStore(
                 ttl_s=session_ttl_s,
                 max_sessions=max_sessions,
                 bus=bus,
                 replica=replica_name,
+                journal=journal,
+                sync_every=carry_sync_every,
             )
 
         if checkpointer is not None:
@@ -167,11 +217,15 @@ class PolicyServer:
             port,
             host=host,
             get={"/healthz": self._healthz, "/metrics": self._metrics},
-            post={"/act": self._act, "/session": self._session_create},
+            post={
+                "/act": self._act,
+                "/session": self._session_create,
+                "/reload": self._reload_cmd,
+            },
             post_prefix={"/session/": self._session_act},
             not_found=(
                 "have POST /act, POST /session, POST /session/<id>/act, "
-                "GET /healthz, GET /metrics"
+                "POST /reload, GET /healthz, GET /metrics"
             ),
             thread_name="serve-http",
         )
@@ -185,10 +239,21 @@ class PolicyServer:
     # -- hot reload --------------------------------------------------------
 
     def _maybe_reload(self) -> None:
-        # refresh=True: the trainer writing this directory is a DIFFERENT
-        # process/manager; without it orbax's cached step list would pin
-        # the server to whatever existed at watcher construction
-        step = self.checkpointer.latest_step(refresh=True)
+        with self._reload_lock:
+            self._maybe_reload_locked()
+
+    def _maybe_reload_locked(self) -> None:
+        if self.managed_reload and self._target_step is not None:
+            # managed replica: serve EXACTLY the commanded step — a new
+            # latest in the directory is the canary controller's
+            # business, not this watcher's
+            step = self._target_step
+        else:
+            # refresh=True: the trainer writing this directory is a
+            # DIFFERENT process/manager; without it orbax's cached step
+            # list would pin the server to whatever existed at watcher
+            # construction
+            step = self.checkpointer.latest_step(refresh=True)
         if step is None or step == self.engine.loaded_step:
             return
         try:
@@ -207,6 +272,12 @@ class PolicyServer:
             params, obs_norm = self.snapshot_fn(state)
             if not self.engine.with_obs_norm:
                 obs_norm = None
+            if self.injector is not None:
+                # chaos seam (ISSUE 11): a `wedge_reload@step=N` spec
+                # poisons the params AFTER a successful restore — the
+                # checkpoint "loads but answers garbage", which is
+                # exactly the failure class the canary gate exists for
+                params = self.injector.on_checkpoint_load(step, params)
             self.engine.load(params, obs_norm, step=step)
         except Exception as e:
             # keep serving the last good snapshot; next poll retries.
@@ -239,6 +310,11 @@ class PolicyServer:
             return
         finally:
             self._reloading = False
+        if self.managed_reload and self._target_step is None:
+            # a managed replica on a cold directory adopts its FIRST
+            # checkpoint ungated (there is no incumbent to protect);
+            # every later step must come through POST /reload
+            self._target_step = step
         self.reloads_total += 1
         if self.bus is not None:
             self.bus.emit(
@@ -256,9 +332,86 @@ class PolicyServer:
             except Exception:  # pragma: no cover — the watcher must never die
                 pass
 
+    def _reload_cmd(self, body: bytes):
+        """``POST /reload`` — the managed-deployment control route:
+        ``{"step": N}`` loads one specific marker-complete step;
+        ``{"rollback": true}`` swaps the previous in-memory snapshot
+        back (instant, disk-free — the canary rejection path).
+        Unmanaged replicas refuse with a typed 409: their watcher owns
+        the snapshot and a command would silently fight it."""
+        if not self.managed_reload:
+            return 409, _JSON, _json_body(
+                {
+                    "error": (
+                        "this replica follows latest_step() on its own "
+                        "watcher — run it with managed_reload=True "
+                        "(serve.py --canary-fraction > 0) to command "
+                        "reloads"
+                    ),
+                    "code": "unmanaged",
+                }
+            )
+        try:
+            payload = json.loads(body) if body else {}
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except ValueError as e:
+            return 400, _JSON, _json_body(
+                {"error": f'body must be {{"step": N}} or '
+                          f'{{"rollback": true}} ({e})'}
+            )
+        if payload.get("rollback"):
+            with self._reload_lock:
+                try:
+                    step = self.engine.rollback()
+                except RuntimeError as e:
+                    return 409, _JSON, _json_body(
+                        {"error": str(e), "code": "no_previous_snapshot"}
+                    )
+                self._target_step = step
+            return 200, _JSON, _json_body(
+                {"ok": True, "step": step, "rolled_back": True}
+            )
+        step = payload.get("step")
+        if not isinstance(step, int) or isinstance(step, bool):
+            return 400, _JSON, _json_body(
+                {"error": 'body must carry an integer "step" (or '
+                          '"rollback": true)'}
+            )
+        if self.checkpointer is None:
+            return 409, _JSON, _json_body(
+                {"error": "no checkpoint directory attached — nothing "
+                          "to reload from", "code": "no_checkpointer"}
+            )
+        with self._reload_lock:
+            self._target_step = step
+            self._maybe_reload_locked()  # synchronous: the caller gets
+            #                              a definitive answer
+            loaded = self.engine.loaded_step
+        ok = loaded == step
+        return (200 if ok else 500), _JSON, _json_body(
+            {"ok": ok, "step": loaded}
+        )
+
+    # -- chaos seam (resilience/inject.py stall_replica) -------------------
+
+    def stall(self, seconds: float) -> None:
+        """Make every act on this replica sleep until ``seconds`` from
+        now have passed — the injected version of a wedged device or a
+        GC pause. Health checks still answer, so detection must come
+        from the request path (the router's timeout → transport failure
+        → eviction), exactly like production."""
+        self._stall_until = time.monotonic() + float(seconds)
+
+    def _maybe_stall(self) -> None:
+        delay = self._stall_until - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+
     # -- handlers ----------------------------------------------------------
 
     def _act(self, body: bytes):
+        self._maybe_stall()
         if self.is_recurrent:
             # structured refusal (ISSUE 9 satellite): the model family is
             # a property of the checkpoint — tell the client where to go
@@ -295,8 +448,11 @@ class PolicyServer:
                     )
                 }
             )
-        future = self.batcher.submit(obs)
         try:
+            # submit INSIDE the try: a batcher racing its own teardown
+            # (this replica being killed) must answer a scoped JSON
+            # 500, not crash the handler into httpd's plain-text 500
+            future = self.batcher.submit(obs)
             action, step = future.result(timeout=self.act_timeout_s)
         except _FutureTimeout:
             return 504, _JSON, _json_body(
@@ -332,7 +488,12 @@ class PolicyServer:
         """Mint a session: fresh zero carry in the bounded store. An
         optional ``{"session_id": ...}`` lets the ROUTER own the id (it
         needs to, for affinity and dead-replica re-establishment);
-        direct clients just POST an empty body."""
+        direct clients just POST an empty body.
+
+        The durability path (ISSUE 11) additionally accepts a JOURNALED
+        state — ``carry``/``steps``/``seq``/``last_action``/
+        ``last_step`` — so the router can resume a dead replica's
+        session here instead of restarting it from a fresh carry."""
         if not self.is_recurrent:
             return self._wrong_protocol_feedforward()
         if not self.engine.ready:
@@ -340,6 +501,7 @@ class PolicyServer:
                 {"error": "no policy loaded yet (no complete checkpoint)"}
             )
         session_id = None
+        restore = {}
         if body:
             try:
                 payload = json.loads(body)
@@ -350,23 +512,73 @@ class PolicyServer:
                     session_id, str
                 ):
                     raise ValueError("session_id must be a string")
-            except ValueError as e:
+                if payload.get("carry") is not None:
+                    carry = np.asarray(payload["carry"], np.float32)
+                    if carry.shape != (self.engine.state_size,):
+                        raise ValueError(
+                            f"carry must have {self.engine.state_size} "
+                            f"elements, got shape {list(carry.shape)}"
+                        )
+                    steps = payload.get("steps")
+                    if not isinstance(steps, int) or isinstance(
+                        steps, bool
+                    ) or steps < 0:
+                        raise ValueError(
+                            "a restored carry needs its integer "
+                            '"steps" count'
+                        )
+                    # validate the dedupe fields HERE: an int() blowing
+                    # up inside SessionStore.create would surface as an
+                    # unscoped 500 AFTER the LRU eviction side effect
+                    for key in ("seq", "last_step"):
+                        v = payload.get(key)
+                        if v is not None and (
+                            not isinstance(v, int)
+                            or isinstance(v, bool)
+                        ):
+                            raise ValueError(f"{key} must be an integer")
+                    last_action = payload.get("last_action")
+                    if last_action is not None:
+                        last_action = np.asarray(last_action)
+                        if last_action.dtype == object:
+                            raise ValueError(
+                                "last_action must be numeric"
+                            )
+                    restore = {
+                        "steps": steps,
+                        "seq": payload.get("seq"),
+                        "last_action": last_action,
+                        "last_step": payload.get("last_step"),
+                    }
+                    restore["carry"] = carry
+            except (ValueError, TypeError) as e:
                 return 400, _JSON, _json_body(
                     {"error": f"body must be empty or JSON ({e})"}
                 )
+        carry = restore.pop("carry", None)
         sid = self.sessions.create(
-            self.engine.initial_carry(), session_id=session_id
+            carry if carry is not None else self.engine.initial_carry(),
+            session_id=session_id,
+            **restore,
         )
-        return 200, _JSON, _json_body(
-            {"session": sid, "step": self.engine.loaded_step}
-        )
+        out = {"session": sid, "step": self.engine.loaded_step}
+        if carry is not None:
+            out["resumed_steps"] = restore["steps"]
+        return 200, _JSON, _json_body(out)
 
     def _session_act(self, path: str, body: bytes):
         """``POST /session/<id>/act`` — advance one session's carry by
         one observation. The carry read-modify-write is serialized by
-        the session's own lock; different sessions never contend."""
+        the session's own lock; different sessions never contend.
+
+        An optional ``"seq"`` (the router stamps one per session) makes
+        the act idempotent: a replay of the last applied seq returns
+        the STORED action without re-stepping the carry — the replica
+        may have died after applying but before answering, and the
+        router's transparent retry must not double-step the session."""
         if not self.is_recurrent:
             return self._wrong_protocol_feedforward()
+        self._maybe_stall()
         parts = path.strip("/").split("/")
         if len(parts) != 3 or parts[0] != "session" or parts[2] != "act":
             return 404, _JSON, _json_body(
@@ -392,6 +604,11 @@ class PolicyServer:
         try:
             payload = json.loads(body)
             obs = np.asarray(payload["obs"], self.engine.obs_dtype)
+            seq = payload.get("seq")
+            if seq is not None and (
+                not isinstance(seq, int) or isinstance(seq, bool)
+            ):
+                raise ValueError("seq must be an integer")
         except (ValueError, KeyError, TypeError) as e:
             return 400, _JSON, _json_body(
                 {"error": f'body must be {{"obs": [...]}} ({e})'}
@@ -407,11 +624,38 @@ class PolicyServer:
             )
         try:
             with sess.lock:
+                if (
+                    seq is not None
+                    and sess.last_seq == seq
+                    and sess.last_action is not None
+                ):
+                    # replayed seq: already applied — return the stored
+                    # action, do NOT advance the carry (exactly-once)
+                    self.sessions.deduped_total += 1
+                    sess.last_used = time.monotonic()
+                    return 200, _JSON, _json_body(
+                        {
+                            "action": np.asarray(
+                                sess.last_action
+                            ).tolist(),
+                            "step": sess.last_step,
+                            "session": sid,
+                            "session_steps": sess.steps,
+                            "deduped": True,
+                        }
+                    )
                 action, carry_new, step = self.engine.step(
                     sess.carry, obs, return_step=True
                 )
                 sess.carry = carry_new
+                if seq is not None:
+                    sess.last_seq = seq
+                sess.last_action = np.asarray(action)
+                sess.last_step = step
                 self.sessions.touch_steps(sess)
+                # write-behind carry snapshot (copies taken here, under
+                # the session lock; the disk write happens elsewhere)
+                self.sessions.journal_step(sid, sess)
         except Exception as e:
             with self._counter_lock:
                 self.session_act_errors_total += 1
@@ -444,6 +688,8 @@ class PolicyServer:
                 # the replica supervisor's rotation signals (ISSUE 9)
                 "reloading": self._reloading,
                 "recurrent": self.is_recurrent,
+                # the canary controller's deployment signals (ISSUE 11)
+                "managed": self.managed_reload,
                 "sessions": (
                     len(self.sessions) if self.sessions is not None else 0
                 ),
@@ -494,6 +740,17 @@ class PolicyServer:
                 "trpo_serve_sessions_evicted_total", "counter",
                 "sessions LRU-evicted at capacity",
                 [("", s.evicted_total)],
+            )
+            fam(
+                "trpo_serve_sessions_resumed_total", "counter",
+                "sessions restored from a journaled carry",
+                [("", s.resumed_total)],
+            )
+            fam(
+                "trpo_serve_session_acts_deduped_total", "counter",
+                "acts answered from the seq-dedupe cache (replayed "
+                "retries that must not double-step)",
+                [("", s.deduped_total)],
             )
             fam(
                 "trpo_serve_checkpoint_step", "gauge",
@@ -571,9 +828,11 @@ class PolicyServer:
 
     # -- teardown ----------------------------------------------------------
 
-    def close(self) -> None:
+    def close(self, abrupt: bool = False) -> None:
         """Stop the watcher and the HTTP server (the batcher is owned by
-        the caller — it may outlive the front end)."""
+        the caller — it may outlive the front end). ``abrupt=True`` is
+        the chaos-kill path: pending carry-journal entries are DROPPED
+        like a real crash would, never flushed."""
         self._stop.set()
         if self._watcher is not None:
             self._watcher.join(timeout=5.0)
@@ -581,4 +840,4 @@ class PolicyServer:
         if httpd is not None:
             httpd.close()
         if self.sessions is not None:
-            self.sessions.close()
+            self.sessions.close(flush=not abrupt)

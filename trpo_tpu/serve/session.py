@@ -25,24 +25,52 @@ of a refusal:
 Topology: each serving replica owns its own store — the carry lives
 NEXT TO the engine that advances it (one device hop per step, no
 carry-over-HTTP per request). The router (``serve/router.py``) keeps
-session→replica AFFINITY and re-establishes a session with a fresh
-carry when its replica dies; the replica-side store is the source of
-truth for the carry itself.
+session→replica AFFINITY; when that replica dies the router
+re-establishes the session on a healthy replica — from the dead
+replica's :class:`CarryJournal` entry when one exists (lossless
+failover, ``"resumed": true``), from a fresh carry otherwise
+(``"reestablished": true``). The replica-side store is the source of
+truth for the live carry; the journal is its crash-durable shadow.
+
+**Carry durability** (ISSUE 11). :class:`CarryJournal` is a
+write-behind, per-replica journal of session carries: the act path
+copies the carry into a bounded latest-wins pending map (one dict
+assignment — never a disk write) and a background writer drains it to
+an append-only JSONL file, snapshot-swapping the pending map exactly
+like ``StatsDrain`` drains stats rows. The file self-compacts (latest
+entry per session) once the append count outgrows the live set, so it
+is a BOUND, not a log. Readers (:func:`read_carry_journal` — what the
+router resumes from) tolerate a torn final line and skip corrupt
+records: an entry torn by ``kill -9`` mid-write reads as ABSENT,
+never as a corrupt store (the ``repair_jsonl_tail`` contract).
+Staleness bound: a resumed session is at most
+``cfg.serve_carry_sync_every - 1`` steps behind the dead replica's
+live carry, plus whatever the write-behind drain had not flushed at
+the instant of death.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 import uuid
 from collections import OrderedDict
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["RecurrentServeEngine", "SessionStore", "mint_session_id"]
+__all__ = [
+    "RecurrentServeEngine",
+    "SessionStore",
+    "CarryJournal",
+    "read_carry_journal",
+    "journal_path",
+    "mint_session_id",
+]
 
 
 def mint_session_id() -> str:
@@ -103,6 +131,7 @@ class RecurrentServeEngine:
         self._compiled = None          # AOT executable (batch 1)
         self._snapshot = None          # (params, obs_norm, step) — swapped
         #                                atomically by reference
+        self._prev_snapshot = None     # one-deep history for rollback()
         self._lock = threading.Lock()  # counters only, never the hot path
         self.steps_total = 0
 
@@ -154,7 +183,27 @@ class RecurrentServeEngine:
                 )
                 .compile()
             )
+        self._prev_snapshot = self._snapshot
         self._snapshot = (params, obs_norm, step)
+
+    def rollback(self) -> Optional[int]:
+        """Swap the PREVIOUS snapshot back in (one-deep, ONE-SHOT) —
+        the canary gate's instant, disk-free rejection path: rolling a
+        bad checkpoint back must not depend on the incumbent save still
+        existing on disk or on a restore racing the request path. The
+        history is consumed: a duplicated rollback (an operator retry
+        after an ambiguous timeout) must answer "nothing to roll back
+        to", never reinstate the rejected snapshot. Returns the step
+        now serving; raises when there is no previous snapshot."""
+        prev = self._prev_snapshot
+        if prev is None:
+            raise RuntimeError(
+                "no previous snapshot to roll back to — the engine has "
+                "loaded at most one checkpoint (or already rolled back)"
+            )
+        self._prev_snapshot = None
+        self._snapshot = prev
+        return prev[2]
 
     # -- stepping ----------------------------------------------------------
 
@@ -201,7 +250,10 @@ class RecurrentServeEngine:
 
 
 class _Session:
-    __slots__ = ("carry", "created", "last_used", "steps", "lock")
+    __slots__ = (
+        "carry", "created", "last_used", "steps", "lock",
+        "last_seq", "last_action", "last_step",
+    )
 
     def __init__(self, carry: np.ndarray, now: float):
         self.carry = carry
@@ -209,6 +261,258 @@ class _Session:
         self.last_used = now
         self.steps = 0
         self.lock = threading.Lock()  # serializes steps WITHIN a session
+        # retry idempotency (ISSUE 11): the router stamps each act with
+        # a per-session sequence number; a replayed seq returns the
+        # STORED action instead of re-stepping the carry (a replica
+        # that died after applying but before answering must not
+        # double-step on the router's transparent retry)
+        self.last_seq: Optional[int] = None
+        self.last_action: Optional[np.ndarray] = None
+        self.last_step: Optional[int] = None
+
+
+# a tombstone in the journal's pending map / file: the session was
+# evicted or expired — a post-crash reader must not resurrect it
+_DROPPED = object()
+
+
+def journal_path(journal_dir: str, replica_id: str) -> str:
+    """The one naming convention both halves share: the replica WRITES
+    ``<dir>/<replica_id>.carry.jsonl``; the router READS the same path
+    when that replica dies."""
+    return os.path.join(journal_dir, f"{replica_id}.carry.jsonl")
+
+
+def read_carry_journal(path: str) -> Dict[str, dict]:
+    """Parse a carry journal into ``{session_id: entry}`` — latest entry
+    per session wins, tombstones (``{"drop": true}``) remove, and any
+    unparseable line (a tail torn by ``kill -9`` mid-write, or a
+    corrupt middle record) is SKIPPED: a torn entry reads as absent,
+    never as a corrupt store. Missing file = empty journal."""
+    entries: Dict[str, dict] = {}
+    try:
+        f = open(path, "rb")
+    except OSError:
+        return entries
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn/corrupt line: absent, not fatal
+            if not isinstance(rec, dict):
+                continue
+            sid = rec.get("session")
+            if not isinstance(sid, str) or not sid:
+                continue
+            if rec.get("drop"):
+                entries.pop(sid, None)
+                continue
+            carry = rec.get("carry")
+            steps = rec.get("steps")
+            if not isinstance(carry, list) or not isinstance(steps, int):
+                continue
+            entries[sid] = rec
+    return entries
+
+
+class CarryJournal:
+    """Write-behind, bounded, self-compacting session-carry journal.
+
+    The act path calls :meth:`record` — one latest-wins dict assignment
+    under a small lock, never a disk write. A daemon writer thread
+    snapshot-swaps the pending map (the StatsDrain drain pattern) and
+    appends one JSON line per dirty session, flush-on-write. When the
+    appended-line count outgrows the live session set
+    (``compact_factor`` ×, floored at ``min_compact``), the file is
+    compacted to one latest entry per session via write-then-rename —
+    the journal is a BOUND over live sessions, not an unbounded log.
+
+    Crash semantics: ``repair_jsonl_tail`` truncates a previous
+    incarnation's torn final line on open, and readers additionally
+    skip anything unparseable — an entry torn mid-write is ABSENT,
+    and the newest complete entry before it still resumes the session.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        compact_factor: int = 4,
+        min_compact: int = 256,
+        poll_interval: float = 0.5,
+    ):
+        from trpo_tpu.utils.metrics import repair_jsonl_tail
+
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        repair_jsonl_tail(path)
+        # a restarted replica inherits its previous incarnation's
+        # entries: the router may still resume sessions journaled
+        # before the crash, and compaction must preserve them
+        self._latest: Dict[str, dict] = read_carry_journal(path)
+        # count the ACTUAL file lines, not the live-entry count: the
+        # compaction bound must keep holding across restart loops (a
+        # crash-cycling replica would otherwise reset the trigger and
+        # grow the file without bound)
+        try:
+            with open(path, "rb") as f:
+                self._lines = sum(1 for _ in f)
+        except OSError:
+            self._lines = 0
+        self.compact_factor = int(compact_factor)
+        self.min_compact = int(min_compact)
+        self._poll = float(poll_interval)
+        self._pending: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._stop = False
+        self.records_total = 0
+        self.writes_total = 0
+        self.compactions_total = 0
+        self._f = open(path, "a")
+        self._writer = threading.Thread(
+            target=self._loop, name="carry-journal-writer", daemon=True
+        )
+        self._writer.start()
+
+    # -- producer side (the act path) --------------------------------------
+
+    def record(self, entry: dict) -> None:
+        """Queue one session snapshot (``entry`` must carry ``session``;
+        the caller passes a fully-copied entry — the journal never
+        reaches back into live store state). Latest-wins per session;
+        never blocks on IO."""
+        sid = entry["session"]
+        with self._lock:
+            if self._stop:
+                return
+            self._pending[sid] = entry
+            self.records_total += 1
+            self._idle.clear()
+        self._wake.set()
+
+    def forget(self, session_id: str) -> None:
+        """The session was evicted/expired: tombstone it so a post-crash
+        reader does not resurrect a session the store already dropped."""
+        with self._lock:
+            if self._stop:
+                return
+            self._pending[session_id] = _DROPPED
+            self._idle.clear()
+        self._wake.set()
+
+    def lookup(self, session_id: str) -> Optional[dict]:
+        """The newest entry for one session — pending (not yet flushed)
+        beats flushed; a pending tombstone reads as absent."""
+        with self._lock:
+            hit = self._pending.get(session_id)
+            if hit is _DROPPED:
+                return None
+            if hit is not None:
+                return dict(hit)
+            hit = self._latest.get(session_id)
+            return dict(hit) if hit is not None else None
+
+    # -- writer side --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                pending, self._pending = self._pending, {}
+                stop = self._stop
+                if not pending:
+                    # set idle UNDER the lock: record() clears it under
+                    # the same lock, so drain() can never observe idle
+                    # while an unflushed entry exists (a drain-then-kill
+                    # test racing the writer would otherwise resume
+                    # from a stale carry)
+                    self._idle.set()
+            if pending:
+                try:
+                    self._write_batch(pending)
+                except Exception:  # pragma: no cover — a full disk must
+                    pass           # degrade, never kill the act path
+                continue
+            if stop:
+                return
+            self._wake.wait(timeout=self._poll)
+            self._wake.clear()
+
+    @staticmethod
+    def _jsonable(entry: dict) -> dict:
+        """Producer entries carry ndarray fields by reference (the act
+        path never pays the list conversion); this is where they
+        become JSON, on the writer thread."""
+        return {
+            k: (v.tolist() if isinstance(v, np.ndarray) else v)
+            for k, v in entry.items()
+        }
+
+    def _write_batch(self, pending: Dict[str, object]) -> None:
+        for sid, entry in pending.items():
+            if entry is _DROPPED:
+                self._f.write(
+                    json.dumps({"session": sid, "drop": True}) + "\n"
+                )
+                self._latest.pop(sid, None)
+            else:
+                entry = self._jsonable(entry)
+                self._f.write(json.dumps(entry) + "\n")
+                self._latest[sid] = entry
+            self._lines += 1
+            self.writes_total += 1
+        self._f.flush()
+        if self._lines > max(
+            self.min_compact, self.compact_factor * len(self._latest)
+        ):
+            self._compact()
+
+    def _compact(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            for entry in self._latest.values():
+                f.write(json.dumps(entry) + "\n")
+        os.replace(tmp, self.path)  # atomic: a reader sees old or new
+        self._f.close()
+        self._f = open(self.path, "a")
+        self._lines = len(self._latest)
+        self.compactions_total += 1
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Block until every pending record is flushed to disk — tests
+        and graceful shutdown; the act path never calls this."""
+        self._wake.set()
+        return self._idle.wait(timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            self._stop = True
+        self._wake.set()
+        self._writer.join(timeout=5.0)
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+    def abandon(self) -> None:
+        """Crash-style teardown: DROP the pending (unflushed) entries
+        instead of writing them. An injected abrupt replica death must
+        look like ``kill -9`` — a graceful flush on kill would make the
+        write-behind window untestable (and hide a broken drain)."""
+        with self._lock:
+            self._stop = True
+            self._pending.clear()
+        self._wake.set()
+        self._writer.join(timeout=5.0)
+        try:
+            self._f.close()
+        except Exception:
+            pass
 
 
 class SessionStore:
@@ -234,6 +538,8 @@ class SessionStore:
         bus=None,
         replica: Optional[str] = None,
         sweep_interval: Optional[float] = None,
+        journal: Optional[CarryJournal] = None,
+        sync_every: int = 1,
     ):
         if ttl_s <= 0:
             raise ValueError(f"ttl_s must be > 0, got {ttl_s}")
@@ -241,13 +547,19 @@ class SessionStore:
             raise ValueError(
                 f"max_sessions must be >= 1, got {max_sessions}"
             )
+        if sync_every < 1:
+            raise ValueError(f"sync_every must be >= 1, got {sync_every}")
         self.ttl_s = float(ttl_s)
         self.max_sessions = int(max_sessions)
         self.bus = bus
         self.replica = replica
+        self.journal = journal  # owned: closed with the store
+        self.sync_every = int(sync_every)
         self.created_total = 0
         self.expired_total = 0
         self.evicted_total = 0
+        self.resumed_total = 0   # sessions created FROM a journaled carry
+        self.deduped_total = 0   # acts answered from the seq-dedupe cache
         self._lock = threading.Lock()
         self._sessions: "OrderedDict[str, _Session]" = OrderedDict()
         self._stop = threading.Event()
@@ -279,13 +591,26 @@ class SessionStore:
             pass
 
     def create(
-        self, initial_carry: np.ndarray, session_id: Optional[str] = None
+        self,
+        initial_carry: np.ndarray,
+        session_id: Optional[str] = None,
+        steps: int = 0,
+        seq: Optional[int] = None,
+        last_action=None,
+        last_step: Optional[int] = None,
     ) -> str:
         """Register a session (minting an id unless the caller — the
         router, which needs to own it for affinity — supplies one).
         Re-creating an EXISTING id resets its carry: that is exactly the
         router's re-establish semantics, and for a direct client it is
-        an explicit restart, not an error."""
+        an explicit restart, not an error.
+
+        ``steps``/``seq``/``last_action``/``last_step`` restore a
+        JOURNALED session (the router's lossless-failover path): the new
+        session continues from the journaled carry with its step count
+        and seq-dedupe state intact, so a retried act either replays
+        (same seq already applied in the journaled carry) or re-steps
+        from the journaled carry — exactly once either way."""
         sid = session_id or mint_session_id()
         now = time.monotonic()
         evicted = None
@@ -295,15 +620,75 @@ class SessionStore:
             ):
                 evicted, _ = self._sessions.popitem(last=False)  # LRU
                 self.evicted_total += 1
-            self._sessions[sid] = _Session(
-                np.asarray(initial_carry, np.float32), now
-            )
+            sess = _Session(np.asarray(initial_carry, np.float32), now)
+            sess.steps = int(steps)
+            if seq is not None:
+                sess.last_seq = int(seq)
+            if last_action is not None:
+                sess.last_action = np.asarray(last_action)
+            if last_step is not None:
+                sess.last_step = int(last_step)
+            self._sessions[sid] = sess
             self._sessions.move_to_end(sid)
             self.created_total += 1
+            if steps:
+                self.resumed_total += 1
         if evicted is not None:
+            self._forget_journal(evicted)
             self._emit("evicted", evicted)
         self._emit("created", sid)
+        if steps and self.journal is not None:
+            # journal the restored state immediately: a SECOND failover
+            # before this session's next act must still find its carry.
+            # Under the session lock — a concurrent act on this id must
+            # not let a torn steps/carry pair be snapshotted
+            with sess.lock:
+                self.journal_session(sid, sess)
+        elif (
+            self.journal is not None
+            and self.journal.lookup(sid) is not None
+        ):
+            # a FRESH (re-)create of a previously journaled id is an
+            # explicit restart: tombstone the stale entry, or a
+            # failover inside the next sync window would silently
+            # resume the pre-restart state
+            self.journal.forget(sid)
         return sid
+
+    def journal_session(self, sid: str, sess: _Session) -> None:
+        """Snapshot one session into the write-behind journal (called
+        under the session's lock). Array fields go in BY REFERENCE —
+        the act path replaces ``sess.carry``/``last_action`` wholesale
+        (never mutates in place), so the reference IS an immutable
+        snapshot and the O(state_size) JSON conversion happens on the
+        writer thread, keeping the act path to one dict assignment."""
+        if self.journal is None:
+            return
+        entry = {
+            "session": sid,
+            "steps": int(sess.steps),
+            "carry": sess.carry,
+            "t": time.time(),
+        }
+        if sess.last_seq is not None:
+            entry["seq"] = int(sess.last_seq)
+        if sess.last_action is not None:
+            entry["last_action"] = sess.last_action
+        if sess.last_step is not None:
+            entry["last_step"] = int(sess.last_step)
+        self.journal.record(entry)
+
+    def journal_step(self, sid: str, sess: _Session) -> None:
+        """The post-act journaling hook: snapshot every ``sync_every``
+        applied steps (1 = every act — lossless up to the write-behind
+        flush)."""
+        if self.journal is None or sess.steps % self.sync_every != 0:
+            return
+        self.journal_session(sid, sess)
+
+    def _forget_journal(self, sid: str) -> None:
+        if self.journal is not None:
+            self.journal.forget(sid)
 
     def get(self, session_id: str) -> Optional[_Session]:
         """The live session, refreshed to most-recently-used — or None
@@ -322,6 +707,7 @@ class SessionStore:
                 self._sessions.move_to_end(session_id)
                 expired = False
         if expired:
+            self._forget_journal(session_id)
             self._emit("expired", session_id)
             return None
         return sess
@@ -341,8 +727,17 @@ class SessionStore:
                         self.expired_total += 1
                         expired.append(sid)
             for sid in expired:
+                self._forget_journal(sid)
                 self._emit("expired", sid)
 
-    def close(self) -> None:
+    def close(self, flush: bool = True) -> None:
+        """``flush=False`` is the crash-injection path: pending journal
+        entries are DROPPED, exactly as a real ``kill -9`` would lose
+        them."""
         self._stop.set()
         self._sweeper.join(timeout=5.0)
+        if self.journal is not None:
+            if flush:
+                self.journal.close()
+            else:
+                self.journal.abandon()
