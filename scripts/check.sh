@@ -40,6 +40,10 @@ echo "== tier-1: serving failover (carry journal, seq dedupe, canary) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_failover.py -q \
     -m 'not slow'
 
+echo "== tier-1: elastic autoscaler (hysteresis, drain, admission, storms) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_autoscaler.py -q \
+    -m 'not slow'
+
 echo "== tier-1: env fleet (chunked rollouts, wide-N presets, env-steps/s) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_env_fleet.py -q \
     -m 'not slow'
@@ -201,10 +205,19 @@ echo "== router chaos smoke: kill/resume under load, canary gate, scale =="
 # answers NaN) is REJECTED by the canary gate (rolled_back +
 # health:canary_rejected, incumbent keeps serving) and a clean step
 # then promotes to the whole set — zero client-visible errors either
-# way. The event log must validate (router died -> restarted/evicted,
-# canary started -> promoted/rolled_back, every injected serving
-# fault matched by its detection record) and analyze (per-replica
-# table + scaling row + failover/canary rows).
+# way; (f) ISSUE 12 storm smoke: an injected overload_storm floods a
+# 2-replica recurrent set (simulated 50 ms act cost, carry journal on)
+# and the elastic autoscaler must scale 2->4 from the router's own
+# metrics (new replicas warmed via healthz before rotation), probe p99
+# must recover under the SLO, and the set must drain back to 2 with
+# EVERY live session resumed losslessly from the journal (resumed:
+# true, bit-exact continuation), zero aborted drains, and no client-
+# visible errors beyond typed 503 sheds. The event log must validate
+# (router died -> restarted/evicted, canary started ->
+# promoted/rolled_back, autoscale drain_started -> terminal, every
+# injected serving fault — including the storm — matched by its
+# detection record) and analyze (per-replica table + scaling row +
+# failover/canary/autoscale rows).
 ROUTER_TMP=$(mktemp -d)
 JAX_PLATFORMS=cpu python scripts/router_smoke.py --tmp "$ROUTER_TMP"
 python scripts/validate_events.py "$ROUTER_TMP/router_events.jsonl"

@@ -34,11 +34,24 @@ Six legs, end to end in one process:
    (``rolled_back`` + ``health:canary_rejected``) while the incumbent
    keeps serving and clients see zero errors; a clean step then
    PROMOTES to the whole set.
-6. The whole run's event log is left at ``DIR/router_events.jsonl``
+6. **Storm + elastic autoscale** (ISSUE 12) — 2 recurrent replicas
+   (simulated 50 ms act cost — capacity-limited) with the carry
+   journal, behind a router + ``Autoscaler`` (min 2, max 4); an
+   injected ``overload_storm`` floods the set with storm-owned
+   sessions: the autoscaler must scale 2→4 from the router's own
+   metrics (new replicas enter rotation only after healthz), p99 must
+   recover under the SLO, and the only client-visible errors across
+   the storm may be TYPED 503 sheds. When the storm passes, a live
+   stepped session's pinned replica is drained — the session resumes
+   on a survivor from the journal (``resumed: true``, BIT-EXACT
+   continuation) — and the metric-driven loop drains the set back to
+   2 with zero aborted drains and every migrated session resumed.
+7. The whole run's event log is left at ``DIR/router_events.jsonl``
    for ``scripts/validate_events.py`` (died→restarted/evicted,
-   canary started→terminal, every injected serving fault matched by
-   its detection record) and ``scripts/analyze_run.py`` (per-replica
-   table + scaling row + failover/canary rows).
+   canary started→terminal, drain_started→terminal, every injected
+   serving fault — including the storm — matched by its detection
+   record) and ``scripts/analyze_run.py`` (per-replica table +
+   scaling row + failover/canary/autoscale rows).
 
 Exit 0 on success; any assertion failure exits nonzero with the reason.
 """
@@ -554,6 +567,220 @@ def main(argv=None) -> int:
         rs.close()
         trainer_ck.close()
         ctrl_ck.close()
+
+    # -- 6. overload storm -> autoscale 2->4 -> lossless drain to 2 ------
+    from trpo_tpu.serve import Autoscaler
+
+    class _SlowEngine:
+        """A 50 ms GIL-free act cost on top of the real engine:
+        capacity-limited replicas, the regime where elasticity pays
+        (the serving_scale bench's SimulatedCostEngine calibration)."""
+
+        def __init__(self, inner, sleep_s=0.05):
+            self._inner = inner
+            self._sleep = sleep_s
+
+        def step(self, carry, obs, return_step=False):
+            time.sleep(self._sleep)
+            return self._inner.step(carry, obs, return_step=return_step)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    jdir2 = os.path.join(args.tmp, "storm_journal")
+
+    def storm_factory(rid):
+        def factory():
+            engine = ragent.serve_session_engine()
+            engine.load(rstate.policy_params, rstate.obs_norm, step=1)
+            server = PolicyServer(
+                _SlowEngine(engine), None, port=0, bus=bus,
+                replica_name=rid,
+                carry_journal_dir=jdir2, carry_sync_every=1,
+            )
+            return server, []
+
+        return factory
+
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(storm_factory(rid)), 2,
+        health_interval=0.2, backoff=0.2, health_fail_threshold=2,
+        bus=bus,
+    )
+    rs.start()
+    assert rs.wait_healthy(2, timeout=60.0), rs.snapshot()
+    router = Router(
+        rs, port=0, bus=bus, journal_dir=jdir2, max_inflight=4,
+        min_latency_samples=8,
+    )
+    asc = Autoscaler(
+        rs, router, min_replicas=2, max_replicas=4,
+        slo_p99_ms=500.0, interval=0.15, min_samples=8,
+        breach_ticks=2, clear_ticks=6, cooldown_s=1.0,
+        latency_window_s=4.0, drain_timeout_s=20.0, bus=bus,
+    )
+    try:
+        status, out = _post(router.url + "/session")
+        assert status == 200, out
+        sid, pinned = out["session"], out["replica"]
+        obs_seq = [
+            np.random.RandomState(200 + i)
+            .randn(*ragent.obs_shape).astype(np.float32)
+            for i in range(10)
+        ]
+        carry = None
+        direct = []
+        for o in obs_seq:
+            a, _d, carry = ragent.act(
+                rstate, o, eval_mode=True, policy_carry=carry
+            )
+            direct.append(np.asarray(a, np.float64))
+
+        sheds = []      # typed 503s the probe absorbed (EXPECTED)
+        serrors = []    # anything else (MUST be empty)
+
+        def probe_act(t, expect_resumed=None):
+            """One probe step, retrying typed 503 sheds — the only
+            client-visible error the storm may produce."""
+            for _ in range(120):
+                s_, o_ = _post(
+                    router.url + f"/session/{sid}/act",
+                    {"obs": obs_seq[t].tolist()},
+                )
+                if s_ == 200:
+                    if expect_resumed is True:
+                        assert o_.get("resumed") is True, o_
+                    elif expect_resumed is False:
+                        assert "resumed" not in o_, o_
+                    assert np.array_equal(
+                        np.asarray(o_["action"], np.float64), direct[t]
+                    ), f"probe session diverged at step {t}"
+                    return o_
+                if s_ == 503:
+                    sheds.append(o_)
+                    time.sleep(0.1)
+                    continue
+                serrors.append((s_, o_))
+                raise AssertionError(f"non-typed probe error: {s_} {o_}")
+            raise AssertionError("probe act shed past every retry")
+
+        for t in range(3):
+            probe_act(t, expect_resumed=False)
+
+        # background session clients: tolerate ONLY 200s and typed 503s
+        stop = threading.Event()
+        bg_errors: list = []
+        bg_sheds = [0]
+
+        def bg_session(seed: int) -> None:
+            s_, o_ = _post(router.url + "/session")
+            if s_ != 200:
+                bg_errors.append((s_, o_))
+                return
+            bsid = o_["session"]
+            r = np.random.RandomState(seed)
+            while not stop.is_set():
+                try:
+                    s_, o_ = _post(
+                        router.url + f"/session/{bsid}/act",
+                        {"obs": r.randn(*ragent.obs_shape).tolist()},
+                    )
+                    if s_ == 503:
+                        bg_sheds[0] += 1
+                    elif s_ != 200:
+                        bg_errors.append((s_, o_))
+                except Exception as e:  # noqa: BLE001 — collected
+                    bg_errors.append(repr(e))
+                time.sleep(0.15)
+
+        bg = [
+            threading.Thread(target=bg_session, args=(i,), daemon=True)
+            for i in range(2)
+        ]
+        for t_ in bg:
+            t_.start()
+
+        # unleash the storm on the next probe act's request clock
+        storm_secs = 15.0
+        router.injector = FaultInjector.from_spec(
+            f"overload_storm@request=1:rps=200:seconds={storm_secs:g}",
+            bus=bus,
+        )
+        storm_end = time.time() + storm_secs + 1.0
+        probe_act(3, expect_resumed=False)
+        assert router.injector.all_fired
+
+        # the metric-driven loop must scale 2 -> 4 while the storm blows
+        deadline = time.time() + storm_secs + 30.0
+        while time.time() < deadline:
+            asc.tick()
+            snap = rs.snapshot()
+            if snap["size"] == 4 and snap["healthy"] == 4:
+                break
+            time.sleep(0.1)
+        snap = rs.snapshot()
+        assert snap["size"] == 4 and snap["healthy"] == 4, snap
+        assert asc.scale_outs_total == 2, asc.scale_outs_total
+
+        # p99 recovery: once capacity landed (storm may still be
+        # blowing), probe latencies sit back under the SLO
+        while time.time() < storm_end:
+            asc.tick()
+            time.sleep(0.1)
+        lat = []
+        for t in (4, 5, 6):
+            t0 = time.perf_counter()
+            probe_act(t, expect_resumed=False)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        assert max(lat) < 500.0, (
+            f"post-scale probe latency never recovered: {lat}"
+        )
+
+        # deterministic lossless drain: retire the probe's own replica
+        with rs.lock:
+            probe_pin_alive = pinned in rs.replicas
+        if probe_pin_alive:
+            assert asc.scale_in(victim=pinned) is True, "drain failed"
+        probe_act(7, expect_resumed=probe_pin_alive or None)
+        drained_at_least = 1 if probe_pin_alive else 0
+
+        # ...and the metric-driven loop drains the rest back to 2
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            asc.tick()
+            if rs.snapshot()["size"] == 2:
+                break
+            time.sleep(0.1)
+        snap = rs.snapshot()
+        assert snap["size"] == 2, snap
+        assert asc.drains_completed_total >= drained_at_least + 1
+        assert asc.drains_aborted_total == 0, "a drain aborted"
+        for t in (8, 9):
+            probe_act(t)
+
+        stop.set()
+        for t_ in bg:
+            t_.join(timeout=30.0)
+            assert not t_.is_alive(), "background session hung"
+        assert not bg_errors, (
+            f"{len(bg_errors)} non-typed client errors across the "
+            f"storm: {bg_errors[:5]}"
+        )
+        assert not serrors, serrors
+        print(
+            "storm: overload_storm (200 rps / "
+            f"{storm_secs:g}s) -> autoscaled 2->4 from router metrics "
+            f"(probe p99 recovered: {max(lat):.0f} ms < 500 ms SLO), "
+            f"drained back to 2 ({asc.drains_completed_total} drains, "
+            f"{router.sessions_drained_total} sessions moved "
+            "losslessly, 0 aborted), probe session BIT-EXACT across "
+            f"storm + drain, {len(sheds) + bg_sheds[0]} typed 503 "
+            "sheds, zero other client-visible errors"
+        )
+    finally:
+        asc.close()
+        router.close()
+        rs.close()
         bus.close()
 
     print(f"router smoke OK — events at {events_path}")

@@ -164,6 +164,39 @@ def build_parser() -> argparse.ArgumentParser:
         "ports and are supervised (restart-with-backoff, crash budget)",
     )
     p.add_argument(
+        "--min-replicas", type=int,
+        help="autoscaler floor (default 1): scale-in drains the set no "
+        "smaller than this",
+    )
+    p.add_argument(
+        "--max-replicas", type=int,
+        help="autoscaler ceiling — setting it ARMS the elastic control "
+        "loop (default: unset = fixed set): the replica set grows and "
+        "shrinks within [--min-replicas, --max-replicas] from the "
+        "router's own inflight/p99/backpressure metrics; scale-in is a "
+        "lossless journal-backed drain",
+    )
+    p.add_argument(
+        "--slo-p99-ms", type=float,
+        help="the serving p99 SLO the autoscaler defends and "
+        "deadline-aware admission reports (default 250)",
+    )
+    p.add_argument(
+        "--drain-timeout", type=float,
+        help="seconds before a stalled lossless drain aborts back to "
+        "rotation (default 30)",
+    )
+    p.add_argument(
+        "--replica-cmd",
+        help="launch replicas as SUBPROCESS children via this command "
+        "template instead of in-process engines: shell-split, with "
+        "{port}/{checkpoint}/{replica} substituted (the command must "
+        "end up running a serve.py-compatible server that honors the "
+        "appended --run-descriptor) — the seam a non-local launcher "
+        "(ssh/k8s wrapper) plugs into; the template owns the child's "
+        "model/session flags",
+    )
+    p.add_argument(
         "--health-interval", type=float,
         help="replica supervisor /healthz poll seconds (default 0.5)",
     )
@@ -263,12 +296,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from trpo_tpu.config import get_preset
     from trpo_tpu.obs.events import EventBus, JsonlSink, manifest_fields
     from trpo_tpu.serve import (
+        Autoscaler,
         CanaryController,
         InProcessReplica,
         MicroBatcher,
         PolicyServer,
         ReplicaSet,
         Router,
+        SubprocessReplica,
+        render_launch_argv,
     )
     from trpo_tpu.utils.checkpoint import Checkpointer
 
@@ -308,6 +344,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         updates["serve_adaptive_deadline"] = False
     if args.replicas is not None:
         updates["serve_replicas"] = args.replicas
+    if args.min_replicas is not None:
+        updates["serve_min_replicas"] = args.min_replicas
+    if args.max_replicas is not None:
+        updates["serve_max_replicas"] = args.max_replicas
+    if args.slo_p99_ms is not None:
+        updates["serve_slo_p99_ms"] = args.slo_p99_ms
+    if args.drain_timeout is not None:
+        updates["serve_drain_timeout"] = args.drain_timeout
+    if args.replica_cmd is not None:
+        updates["serve_replica_cmd"] = args.replica_cmd
     if args.health_interval is not None:
         updates["serve_health_interval"] = args.health_interval
     if args.replica_restarts is not None:
@@ -348,7 +394,63 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 os.path.abspath(args.checkpoint_dir), "carry_journal"
             )
 
+    if args.min_replicas is not None and cfg.serve_max_replicas is None:
+        print(
+            "error: --min-replicas only bounds the elastic autoscaler "
+            "— pass --max-replicas to arm it (a floor without a "
+            "ceiling would silently do nothing).",
+            file=sys.stderr,
+        )
+        return 2
+    if cfg.serve_replica_cmd and cfg.serve_replicas < 2:
+        print(
+            "error: --replica-cmd launches replicas under the "
+            "replicated control plane — run with --replicas >= 2 "
+            "(a single-engine front end would silently ignore the "
+            "template and serve in-process).",
+            file=sys.stderr,
+        )
+        return 2
+    if cfg.serve_replica_cmd and recurrent and not all(
+        part in cfg.serve_replica_cmd
+        for part in ("--carry-journal-dir", "--replica-name", "{replica}")
+    ):
+        # a templated child owns its own flags — without these three,
+        # each child journals nowhere (or under the wrong name), every
+        # replica death silently degrades to lossy fresh-carry
+        # reestablishment, and every scale-in drain aborts forever
+        print(
+            "error: a RECURRENT --replica-cmd template must wire the "
+            "carry journal the parent router resumes/drains from — "
+            'include: --carry-journal-dir {checkpoint}/carry_journal '
+            "--replica-name {replica} (the parent reads "
+            "<checkpoint>/carry_journal/<replica>.carry.jsonl).",
+            file=sys.stderr,
+        )
+        return 2
+    if cfg.serve_max_replicas is not None and cfg.serve_replicas < 2:
+        print(
+            "error: --max-replicas (the elastic autoscaler) needs the "
+            "replicated control plane — run with --replicas >= 2 so a "
+            "router exists to read metrics from and drain through.",
+            file=sys.stderr,
+        )
+        return 2
+
     canary = cfg.serve_canary_fraction > 0 and cfg.serve_replicas > 1
+    if canary and cfg.serve_replica_cmd:
+        # managed reload (the canary seam) is commanded through the
+        # shared incumbent cell at replica CONSTRUCTION — a templated
+        # subprocess child can't read it, so its relaunch mid-gate
+        # could come up wearing the step under test
+        print(
+            "error: --canary-fraction needs in-process replicas (the "
+            "canary controller pins relaunches to the incumbent step "
+            "through a shared cell) — drop --replica-cmd or the "
+            "canary gate.",
+            file=sys.stderr,
+        )
+        return 2
     if canary and recurrent:
         # the gate windows STATELESS traffic and keeps sessions off the
         # canary — a recurrent set would starve every gate window and
@@ -433,14 +535,37 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ]
         return server, closers
 
-    replicaset = router = controller = None
+    replicaset = router = controller = autoscaler = None
     server = None
     closers: list = []
     if cfg.serve_replicas > 1:
+        if cfg.serve_replica_cmd:
+            # templated subprocess children (cfg.serve_replica_cmd):
+            # the rendered command owns the child's flags; each child
+            # is discovered via the appended --run-descriptor — the
+            # same supervision/scale-out seam, a different launcher
+            replica_root = os.path.join(
+                os.path.abspath(args.checkpoint_dir), "replicas"
+            )
+
+            def launcher(rid):
+                return SubprocessReplica(
+                    [],
+                    os.path.join(replica_root, rid),
+                    command=render_launch_argv(
+                        cfg.serve_replica_cmd,
+                        port=0,
+                        checkpoint=os.path.abspath(args.checkpoint_dir),
+                        replica=rid,
+                    ),
+                )
+        else:
+            def launcher(rid):
+                return InProcessReplica(
+                    lambda: build_replica(rid, port=0)
+                )
         replicaset = ReplicaSet(
-            lambda rid: InProcessReplica(
-                lambda: build_replica(rid, port=0)
-            ),
+            launcher,
             cfg.serve_replicas,
             health_interval=cfg.serve_health_interval,
             max_restarts=cfg.serve_replica_restarts,
@@ -458,6 +583,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             journal_dir=journal_dir,
             canary_fraction=cfg.serve_canary_fraction,
             injector=injector,
+            min_latency_samples=cfg.serve_autoscale_min_samples,
         )
         if canary:
             canary_ck = Checkpointer(
@@ -475,6 +601,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             )
             controller.start()
             closers.append(canary_ck)
+        if cfg.serve_max_replicas is not None:
+            autoscaler = Autoscaler(
+                replicaset,
+                router,
+                min_replicas=cfg.serve_min_replicas,
+                max_replicas=cfg.serve_max_replicas,
+                slo_p99_ms=cfg.serve_slo_p99_ms,
+                interval=cfg.serve_autoscale_interval,
+                min_samples=cfg.serve_autoscale_min_samples,
+                drain_timeout_s=cfg.serve_drain_timeout,
+                bus=bus,
+            )
+            autoscaler.start()
         front_url, endpoints = router.url, list(Router.ENDPOINTS)
         front_port = router.port
     else:
@@ -522,6 +661,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     try:
         done.wait(args.serve_seconds)
     finally:
+        if autoscaler is not None:
+            autoscaler.close()
         if controller is not None:
             controller.close()
         if router is not None:

@@ -35,7 +35,15 @@ is broken), and the serving-plane faults (``kill_replica``,
 ``stall_replica``, ``wedge_reload``, ``drop_carry_journal``) must each
 be matched by their detection record (died/evicted for the targeted
 replica or a routed retry; ``health:canary_rejected``;
-``session:reestablished``).
+``session:reestablished``); and — ISSUE 12 — every ``autoscale``
+record with ``event="drain_started"`` must be FOLLOWED by the same
+replica's ``drain_completed`` or ``drain_aborted`` terminal (a drain
+that neither finished nor aborted may have stranded sessions on a
+half-retired replica), and the storm faults must each be matched:
+``overload_storm`` by a scale/shed reaction (``autoscale``
+``scale_out``/``shed``), ``slow_replica`` by a scale/shed reaction OR
+the targeted replica's eviction, ``flap_replica`` by the targeted
+replica's died/evicted records.
 Exits non-zero with per-line diagnostics on any failure; prints a
 per-kind count summary on success. Used by ``scripts/check.sh`` against
 both a training run's ``--metrics-jsonl`` output and ``bench.py``'s
@@ -88,7 +96,10 @@ def _fault_matcher(fault_rec: dict):
         return lambda rec: (
             rec.get("kind") == "health" and rec.get("check") == "preempted"
         )
-    if fault_kind in ("kill_replica", "stall_replica"):
+    if fault_kind in (
+        "kill_replica", "stall_replica", "flap_replica", "slow_replica",
+        "overload_storm",
+    ):
         # the supervisor (or the router's report_failure) must have
         # declared the targeted replica dead/evicted; a stall shorter
         # than the request timeout may instead surface as the router's
@@ -101,8 +112,21 @@ def _fault_matcher(fault_rec: dict):
                 and rec.get("state") in ("died", "evicted")
             )
 
-        if fault_kind == "kill_replica":
+        def _scaled_or_shed(rec):
+            # the elastic loop (ISSUE 12) reacted: capacity grew, or
+            # the admission layers shed load instead of amplifying
+            return rec.get("kind") == "autoscale" and rec.get(
+                "event"
+            ) in ("scale_out", "shed")
+
+        if fault_kind in ("kill_replica", "flap_replica"):
             return _replica_dead
+        if fault_kind == "overload_storm":
+            return _scaled_or_shed
+        if fault_kind == "slow_replica":
+            # a degraded-latency replica is caught either by the
+            # metrics (scale/shed) or by the request path (eviction)
+            return lambda rec: _scaled_or_shed(rec) or _replica_dead(rec)
         return lambda rec: _replica_dead(rec) or (
             rec.get("kind") == "router"
             and rec.get("scope") == "request"
@@ -294,6 +318,28 @@ def validate_file(path: str) -> list:
             errs.append(
                 f"{path}:{n}: canary for step {step} started with no "
                 "matching promoted/rolled_back terminal record after it"
+            )
+    # ISSUE 12 drain contract (the canary `started` pattern): a drain
+    # that started with no later same-replica completed/aborted
+    # terminal may have stranded sessions on a half-retired replica —
+    # not a valid log
+    for idx, (n, rec) in enumerate(records):
+        if (
+            rec.get("kind") != "autoscale"
+            or rec.get("event") != "drain_started"
+        ):
+            continue
+        replica = rec.get("replica")
+        if not any(
+            later.get("kind") == "autoscale"
+            and later.get("replica") == replica
+            and later.get("event") in ("drain_completed", "drain_aborted")
+            for _, later in records[idx + 1:]
+        ):
+            errs.append(
+                f"{path}:{n}: autoscale drain of replica {replica!r} "
+                "started with no matching drain_completed/drain_aborted "
+                "terminal record after it"
             )
     return errs
 

@@ -1,0 +1,997 @@
+"""Elastic serving autoscaler (ISSUE 12): metric-driven scale-out,
+lossless journal-backed drain, overload admission control, storm chaos.
+
+Contracts pinned here:
+
+* hysteresis: a metric oscillating around its threshold flaps NOTHING;
+  a sustained breach scales out exactly once per breach window, within
+  ``[min_replicas, max_replicas]``, never while a launched replica is
+  still warming;
+* the autoscaler refuses to act on a windowed p99 backed by fewer than
+  ``min_samples`` observations (and the router reports ``samples``
+  alongside its quantiles in /status and /metrics);
+* scale-out adds rotation capacity only after the new replica's
+  ``/healthz`` goes healthy — warmed exactly like a restart;
+* scale-in is a LOSSLESS drain: live sessions resume onto survivors
+  from the carry journal BIT-EXACT (``resumed: true`` on the next act,
+  seq continuity preserved), and a drain that cannot move a session
+  losslessly (no journal) — or stalls past its timeout — ABORTS back
+  to rotation instead of dropping anything;
+* overload admission: an exhausted retry budget SHEDS instead of
+  amplifying (a dead replica under load must not double traffic), a
+  request whose ``deadline_ms`` the observed p99 already exceeds gets
+  an immediate typed 503, and under sustained saturation stateless
+  traffic sheds BEFORE session traffic (the documented shed order);
+* the storm grammar (``overload_storm``/``slow_replica``/
+  ``flap_replica``) parses, fires, and is validator-matched to a
+  scale/shed/evict detection — and the validator FAILS a
+  ``drain_started`` with no same-replica terminal.
+"""
+
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.obs.events import EventBus, validate_event
+from trpo_tpu.resilience.inject import FaultInjector, parse_fault_specs
+from trpo_tpu.serve import (
+    Autoscaler,
+    InProcessReplica,
+    MicroBatcher,
+    PolicyServer,
+    ReplicaSet,
+    Router,
+    SubprocessReplica,
+    render_launch_argv,
+)
+
+_FF_CFG = dict(
+    n_envs=4, batch_timesteps=32, cg_iters=2, vf_train_steps=2,
+    policy_hidden=(8,), vf_hidden=(8,), seed=11,
+    serve_batch_shapes=(1, 2),
+)
+
+
+@pytest.fixture(scope="module")
+def ff():
+    agent = TRPOAgent("cartpole", TRPOConfig(**_FF_CFG))
+    state = agent.init_state(seed=0)
+    return agent, state
+
+
+@pytest.fixture(scope="module")
+def rec():
+    agent = TRPOAgent(
+        "pendulum",
+        TRPOConfig(**{**_FF_CFG, "policy_gru": 8}),
+    )
+    state = agent.init_state(seed=0)
+    return agent, state
+
+
+def _ff_factory(agent, state, bus=None, replica_name=None, **server_kw):
+    def factory():
+        engine = agent.serve_engine()
+        engine.load(state.policy_params, state.obs_norm, step=1)
+        batcher = MicroBatcher(engine, deadline_ms=5.0, bus=bus)
+        server = PolicyServer(
+            engine, batcher, port=0, bus=bus,
+            replica_name=replica_name, **server_kw,
+        )
+        return server, [batcher]
+
+    return factory
+
+
+def _rec_factory(agent, state, bus=None, **server_kw):
+    def factory(replica_name=None):
+        engine = agent.serve_session_engine()
+        engine.load(state.policy_params, state.obs_norm, step=1)
+        server = PolicyServer(
+            engine, None, port=0, bus=bus,
+            replica_name=replica_name, **server_kw,
+        )
+        return server, []
+
+    return factory
+
+
+def _replicaset(launcher, n, bus=None, **kw):
+    kw.setdefault("health_interval", 60.0)
+    kw.setdefault("backoff", 0.05)
+    kw.setdefault("health_fail_threshold", 1)
+    kw.setdefault("max_restarts", 2)
+    rs = ReplicaSet(launcher, n, bus=bus, **kw)
+    assert rs.wait_healthy(n, timeout=60.0), rs.snapshot()
+    return rs
+
+
+def _post(url, payload=None, timeout=30.0):
+    data = b"" if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# decision logic (fakes: no engines, milliseconds per test)
+# ---------------------------------------------------------------------------
+
+
+class _FakeRec:
+    def __init__(self, rid, sessions=0, canary=False):
+        self.id = rid
+        self.state = "healthy"
+        self.inflight = 0
+        self.sessions = sessions
+        self.canary = canary
+        self.handle = None
+        self.url = None
+
+
+class _FakeSet:
+    def __init__(self, n):
+        self.lock = threading.Lock()
+        self.replicas = {f"r{i}": _FakeRec(f"r{i}") for i in range(n)}
+        self._next = n
+        self.added = []
+        self.finished = []
+        self.aborted = []
+
+    def active_size(self):
+        with self.lock:
+            return sum(
+                1 for r in self.replicas.values() if r.state != "failed"
+            )
+
+    def add_replica(self):
+        rid = f"r{self._next}"
+        self._next += 1
+        rec = _FakeRec(rid)
+        rec.state = "starting"
+        with self.lock:
+            self.replicas[rid] = rec
+        self.added.append(rid)
+        return rid
+
+    def begin_drain(self, rid):
+        with self.lock:
+            rec = self.replicas.get(rid)
+            if rec is None or rec.state != "healthy" or rec.canary:
+                return False
+            rec.state = "draining"
+        return True
+
+    def abort_drain(self, rid):
+        with self.lock:
+            rec = self.replicas.get(rid)
+            if rec is not None and rec.state == "draining":
+                rec.state = "healthy"
+        self.aborted.append(rid)
+
+    def finish_drain(self, rid):
+        with self.lock:
+            rec = self.replicas.pop(rid, None)
+        self.finished.append(rid)
+        return rec is not None
+
+    def get(self, rid):
+        return self.replicas.get(rid)
+
+
+class _FakeRouter:
+    max_inflight = 64
+    journal_dir = "/tmp/nowhere"
+    backpressure_total = 0
+    retries_skipped_total = 0
+    shed_deadline_total = 0
+    shed_stateless_total = 0
+
+    def __init__(self, pinned=(), migrate=None):
+        self._pinned = dict(pinned)
+        self._migrate = migrate
+        self.forgotten = []
+
+    def take_fresh_latencies(self):
+        return []
+
+    def sessions_pinned_to(self, rid):
+        return list(self._pinned.get(rid, []))
+
+    def migrate_session(self, sid, rid):
+        if self._migrate is not None:
+            return self._migrate(sid, rid)
+        self._pinned.get(rid, []).remove(sid)
+        return True
+
+    def forget_drained_sessions(self, rid, sids):
+        self.forgotten.append((rid, list(sids)))
+
+
+def _metrics(p99=None, samples=0, inflight=0.0, pressure=0.0):
+    return {
+        "p99_ms": p99,
+        "p99_samples": samples,
+        "inflight_per_replica": inflight,
+        "pressure_rate": pressure,
+        "healthy": 2,
+    }
+
+
+def _autoscaler(rs, router, feed, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("slo_p99_ms", 100.0)
+    kw.setdefault("min_samples", 8)
+    kw.setdefault("breach_ticks", 3)
+    kw.setdefault("clear_ticks", 3)
+    kw.setdefault("cooldown_s", 0.0)
+    return Autoscaler(rs, router, metrics_fn=feed, **kw)
+
+
+def test_hysteresis_no_flapping_on_oscillating_metric():
+    """A p99 that alternates above/below the SLO every observation —
+    the classic threshold-oscillation — must produce ZERO scale
+    actions: the breach/clear streaks reset each other."""
+    rs, router = _FakeSet(2), _FakeRouter()
+    seq = iter(
+        _metrics(p99=200.0 if i % 2 == 0 else 20.0, samples=64)
+        for i in range(40)
+    )
+    asc = _autoscaler(rs, router, lambda: next(seq))
+    for _ in range(40):
+        asc.tick()
+    assert asc.scale_outs_total == 0
+    assert asc.drains_completed_total == 0
+    assert rs.added == [] and rs.finished == []
+
+
+def test_sustained_breach_scales_out_within_bounds():
+    rs, router = _FakeSet(2), _FakeRouter()
+    asc = _autoscaler(
+        rs, router, lambda: _metrics(p99=500.0, samples=64),
+        max_replicas=4,
+    )
+    for _ in range(3):
+        asc.tick()
+    assert rs.added == ["r2"]
+    # the new replica is still warming: no further action until it
+    # lands, no matter how hard the metrics breach
+    for _ in range(10):
+        asc.tick()
+    assert rs.added == ["r2"]
+    rs.replicas["r2"].state = "healthy"
+    for _ in range(3):
+        asc.tick()
+    assert rs.added == ["r2", "r3"]
+    rs.replicas["r3"].state = "healthy"
+    # at max_replicas: breaches keep arriving, the set stays put
+    for _ in range(10):
+        asc.tick()
+    assert rs.added == ["r2", "r3"]
+    assert asc.scale_outs_total == 2
+
+
+def test_autoscaler_refuses_p99_below_min_samples():
+    """A breaching p99 backed by 3 samples is noise: no action, ever —
+    the ISSUE 12 satellite. (Inflight is mid-range so the sample-
+    starved p99 is the only would-be signal either direction.)"""
+    rs, router = _FakeSet(2), _FakeRouter()
+    asc = _autoscaler(
+        rs, router,
+        lambda: _metrics(p99=10_000.0, samples=3, inflight=30.0),
+    )
+    for _ in range(20):
+        asc.tick()
+    assert asc.scale_outs_total == 0
+    assert asc.drains_completed_total == 0
+    assert rs.added == [] and rs.finished == []
+
+
+def test_sustained_clear_drains_fewest_sessions_never_canary():
+    rs, router = _FakeSet(3), _FakeRouter()
+    rs.replicas["r0"].sessions = 2
+    rs.replicas["r1"].sessions = 0
+    rs.replicas["r1"].canary = True   # fewest sessions but NEVER drained
+    rs.replicas["r2"].sessions = 1
+    events = []
+    bus = EventBus(lambda r: events.append(r))
+    asc = _autoscaler(
+        rs, router, lambda: _metrics(p99=10.0, samples=64, inflight=0.0),
+        min_replicas=2, bus=bus,
+    )
+    for _ in range(3):
+        asc.tick()
+    assert rs.finished == ["r2"]
+    assert asc.drains_completed_total == 1
+    # at min_replicas now: sustained calm drains nothing further
+    for _ in range(10):
+        asc.tick()
+    assert rs.finished == ["r2"]
+    kinds = [
+        (e["event"], e.get("replica")) for e in events
+        if e["kind"] == "autoscale"
+    ]
+    assert ("drain_started", "r2") in kinds
+    assert ("drain_completed", "r2") in kinds
+    for e in events:
+        assert validate_event(e) == [], e
+
+
+def test_drain_aborts_when_sessions_cannot_move_losslessly():
+    """Pinned sessions with no carry journal (or a failing migration)
+    must ABORT the drain back to rotation — never drop sessions."""
+    rs = _FakeSet(2)
+    router = _FakeRouter(pinned={"r0": ["s1"]})
+    router.journal_dir = None
+    asc = _autoscaler(rs, router, lambda: _metrics(), min_replicas=1)
+    assert asc.scale_in(victim="r0") is False
+    assert rs.aborted == ["r0"]
+    assert rs.replicas["r0"].state == "healthy"  # back in rotation
+    assert asc.drains_aborted_total == 1
+
+
+def test_drain_timeout_aborts_back_to_rotation():
+    rs = _FakeSet(2)
+
+    def slow_migrate(sid, rid):
+        time.sleep(0.05)
+        return True
+
+    router = _FakeRouter(
+        pinned={"r0": ["s1", "s2"]}, migrate=slow_migrate
+    )
+    events = []
+    bus = EventBus(lambda r: events.append(r))
+    asc = _autoscaler(
+        rs, router, lambda: _metrics(), drain_timeout_s=0.04, bus=bus,
+    )
+    assert asc.scale_in(victim="r0") is False
+    assert rs.replicas["r0"].state == "healthy"
+    aborted = [
+        e for e in events
+        if e["kind"] == "autoscale" and e["event"] == "drain_aborted"
+    ]
+    assert len(aborted) == 1 and "timeout" in aborted[0]["reason"]
+
+
+# ---------------------------------------------------------------------------
+# real replicas: warm-before-rotation, lossless drain, admission control
+# ---------------------------------------------------------------------------
+
+
+def test_scale_out_adds_rotation_capacity_only_after_healthz(ff):
+    agent, state = ff
+    rs = _replicaset(
+        lambda rid: InProcessReplica(_ff_factory(agent, state)), 1
+    )
+    router = Router(rs, port=0)
+    asc = Autoscaler(rs, router, min_replicas=1, max_replicas=2)
+    try:
+        rid = asc.scale_out("manual")
+        assert rid == "r1"
+        snap = rs.snapshot()
+        assert snap["replicas"]["r1"]["state"] == "starting"
+        # not yet in rotation: the router can only pick r0
+        picked = {router._pick() for _ in range(4)}
+        for p in picked:
+            router._release(p)
+        assert picked == {"r0"}
+        rs.tick()  # healthz -> healthy (warmed like a restart)
+        assert rs.snapshot()["replicas"]["r1"]["state"] == "healthy"
+        with rs.lock:
+            rs.replicas["r0"].inflight = 1
+        assert router._pick() == "r1"  # now carries traffic
+        router._release("r1")
+        with rs.lock:
+            rs.replicas["r0"].inflight = 0
+    finally:
+        router.close()
+        rs.close()
+
+
+def test_drain_e2e_live_session_resumed_bit_exact(rec, tmp_path):
+    """The acceptance drain: a live, stepped session rides its pinned
+    replica out of the set — resumed on the survivor FROM the carry
+    journal, ``resumed: true`` + replayed step count on the next act,
+    continuation BIT-EXACT vs an uninterrupted session."""
+    agent, state = rec
+    events = []
+    bus = EventBus(lambda r: events.append(r))
+    jdir = str(tmp_path / "journal")
+    factory = _rec_factory(
+        agent, state, bus=bus, carry_journal_dir=jdir, carry_sync_every=1,
+    )
+    rs = _replicaset(
+        lambda rid: InProcessReplica(lambda: factory(rid)), 2, bus=bus
+    )
+    router = Router(rs, port=0, bus=bus, journal_dir=jdir)
+    asc = Autoscaler(
+        rs, router, min_replicas=1, max_replicas=2, bus=bus,
+    )
+    try:
+        status, out = _post(router.url + "/session")
+        assert status == 200, out
+        sid, pinned = out["session"], out["replica"]
+
+        obs_seq = [
+            np.random.RandomState(40 + i)
+            .randn(*agent.obs_shape).astype(np.float32)
+            for i in range(6)
+        ]
+        carry = None
+        direct = []
+        for o in obs_seq:
+            a, _d, carry = agent.act(
+                state, o, eval_mode=True, policy_carry=carry
+            )
+            direct.append(np.asarray(a, np.float64))
+        for t in range(3):
+            status, out = _post(
+                router.url + f"/session/{sid}/act",
+                {"obs": obs_seq[t].tolist()},
+            )
+            assert status == 200, out
+            assert np.array_equal(
+                np.asarray(out["action"], np.float64), direct[t]
+            )
+
+        assert asc.scale_in(victim=pinned) is True
+        snap = rs.snapshot()
+        assert snap["size"] == 1 and pinned not in snap["replicas"]
+        assert router.sessions_drained_total == 1
+
+        # the next act says so, ONCE, and continues bit-exact
+        status, out = _post(
+            router.url + f"/session/{sid}/act",
+            {"obs": obs_seq[3].tolist()},
+        )
+        assert status == 200, out
+        assert out.get("resumed") is True and out["resumed_steps"] == 3
+        assert np.array_equal(
+            np.asarray(out["action"], np.float64), direct[3]
+        ), "drained session diverged from the uninterrupted one"
+        for t in (4, 5):
+            status, out = _post(
+                router.url + f"/session/{sid}/act",
+                {"obs": obs_seq[t].tolist()},
+            )
+            assert status == 200 and "resumed" not in out, out
+            assert np.array_equal(
+                np.asarray(out["action"], np.float64), direct[t]
+            )
+        # the move books as a PLANNED `drained` migration — never as a
+        # crash `resumed` (failover-quality metrics stay honest)
+        drained = [
+            e for e in events
+            if e["kind"] == "session" and e["event"] == "drained"
+        ]
+        assert len(drained) == 1 and drained[0]["session"] == sid
+        assert not any(
+            e["kind"] == "session" and e["event"] == "resumed"
+            for e in events
+        )
+        assert router.sessions_resumed_total == 0
+        terminal = [
+            e["event"] for e in events
+            if e["kind"] == "autoscale" and e.get("replica") == pinned
+        ]
+        assert terminal == ["drain_started", "drain_completed"]
+        for e in events:
+            assert validate_event(e) == [], e
+    finally:
+        asc.close()
+        router.close()
+        rs.close()
+
+
+def test_drain_abort_restores_rotation_without_journal(rec):
+    """Same topology, NO journal: the session cannot move losslessly,
+    so the drain aborts, the victim re-enters rotation, and the
+    session keeps serving exactly where it was."""
+    agent, state = rec
+    factory = _rec_factory(agent, state)
+    rs = _replicaset(
+        lambda rid: InProcessReplica(lambda: factory(rid)), 2
+    )
+    router = Router(rs, port=0)  # journal_dir=None
+    asc = Autoscaler(rs, router, min_replicas=1, max_replicas=2)
+    try:
+        status, out = _post(router.url + "/session")
+        assert status == 200, out
+        sid, pinned = out["session"], out["replica"]
+        obs = np.zeros(agent.obs_shape, np.float32)
+        status, _ = _post(
+            router.url + f"/session/{sid}/act", {"obs": obs.tolist()}
+        )
+        assert status == 200
+        assert asc.scale_in(victim=pinned) is False
+        snap = rs.snapshot()
+        assert snap["size"] == 2
+        assert snap["replicas"][pinned]["state"] == "healthy"
+        status, out = _post(
+            router.url + f"/session/{sid}/act", {"obs": obs.tolist()}
+        )
+        assert status == 200 and "resumed" not in out, out
+    finally:
+        asc.close()
+        router.close()
+        rs.close()
+
+
+def test_retry_budget_exhaustion_sheds_instead_of_amplifying(ff):
+    agent, state = ff
+    rs = _replicaset(
+        lambda rid: InProcessReplica(_ff_factory(agent, state)), 2
+    )
+    router = Router(rs, port=0, retry_budget=0.0, retry_refill_per_sec=0.0)
+    try:
+        rs.replicas["r0"].handle.kill()
+        # ties pick r0: the corpse is reached, the retry is due — and
+        # SHED (no token), so the client sees the 502 the retry would
+        # have masked, and the survivor sees zero amplified traffic
+        status, out = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert status == 502, (status, out)
+        assert router.retries_skipped_total == 1
+        assert router.retried_total == 0
+        # the corpse was still evicted: the next request routes fine
+        status, _ = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert status == 200
+    finally:
+        router.close()
+        rs.close()
+
+
+def test_retry_token_bucket_refills():
+    rs, router = _FakeSet(1), None  # bucket logic needs no replicas
+    r = Router.__new__(Router)  # bypass HTTP setup: pure bucket math
+    r._lock = threading.Lock()
+    r._retry_capacity = 2.0
+    r._retry_tokens = 2.0
+    r._retry_refill = 10.0
+    r._retry_stamp = time.monotonic()
+    r.retries_skipped_total = 0
+    r.bus = None
+    r._last_pressure = 0.0
+    r._shed_lock = threading.Lock()
+    r._shed_counts, r._shed_emitted = {}, {}
+    assert r._take_retry_token() and r._take_retry_token()
+    assert not r._take_retry_token()  # burst spent
+    assert r.retries_skipped_total == 1
+    r._retry_stamp = time.monotonic() - 0.5  # 0.5s * 10/s = 5 tokens
+    assert r._take_retry_token()  # refilled (capped at capacity 2)
+
+
+def test_deadline_admission_typed_503(ff):
+    agent, state = ff
+    rs = _replicaset(
+        lambda rid: InProcessReplica(_ff_factory(agent, state)), 1
+    )
+    router = Router(rs, port=0, min_latency_samples=8)
+    try:
+        # below min_samples: even an absurd deadline is admitted — the
+        # router refuses to act on a 3-request "p99"
+        status, out = _post(
+            router.url + "/act",
+            {"obs": [0, 0, 0, 0], "deadline_ms": 0.001},
+        )
+        assert status == 200, out
+        now = time.monotonic()
+        with router._lat_lock:
+            router._adm_lats.extend([(now, 50.0)] * 8)
+        status, out = _post(
+            router.url + "/act", {"obs": [0, 0, 0, 0], "deadline_ms": 1}
+        )
+        assert status == 503 and out["code"] == "deadline_unmeetable", out
+        # STALE samples age out of the admission window: a storm's p99
+        # must not shed a recovered set minutes later
+        old = now - Router._ADMISSION_STALE_S - 1.0
+        with router._lat_lock:
+            router._adm_lats.clear()
+            router._adm_lats.extend([(old, 900.0)] * 8)
+        status, _ = _post(
+            router.url + "/act", {"obs": [0, 0, 0, 0], "deadline_ms": 1}
+        )
+        assert status == 200
+        assert router.shed_deadline_total == 1
+        routed_before = router.routed_total
+        # a generous deadline still rides normally
+        status, out = _post(
+            router.url + "/act",
+            {"obs": [0, 0, 0, 0], "deadline_ms": 60_000},
+        )
+        assert status == 200, out
+        assert router.routed_total == routed_before + 1
+    finally:
+        router.close()
+        rs.close()
+
+
+def test_shed_order_stateless_before_session_traffic(ff):
+    agent, state = ff
+    rs = _replicaset(
+        lambda rid: InProcessReplica(_ff_factory(agent, state)), 1
+    )
+    router = Router(rs, port=0, max_inflight=8)  # headroom = 1
+    try:
+        with rs.lock:
+            rs.replicas["r0"].inflight = 7
+        # no recent pressure: the last slot admits stateless traffic
+        assert router._pick(stateless=True) == "r0"
+        router._release("r0")
+        with rs.lock:
+            rs.replicas["r0"].inflight = 7
+        # sustained saturation: stateless stops one slot early...
+        router._last_pressure = time.monotonic()
+        assert router._pick(stateless=True) is None
+        # ...while session traffic still gets the reserved slot
+        assert router._pick(stateless=False) == "r0"
+        router._release("r0")
+        with rs.lock:
+            rs.replicas["r0"].inflight = 7
+        router._last_pressure = time.monotonic()
+        status, out = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert status == 503 and out.get("code") == "shed_stateless", out
+        assert router.shed_stateless_total == 1
+        with rs.lock:
+            rs.replicas["r0"].inflight = 0
+    finally:
+        router.close()
+        rs.close()
+
+
+def test_router_reports_latency_samples_alongside_quantiles(ff):
+    agent, state = ff
+    rs = _replicaset(
+        lambda rid: InProcessReplica(_ff_factory(agent, state)), 1
+    )
+    router = Router(rs, port=0)
+    try:
+        with router._lat_lock:
+            router._latencies_ms.extend([10.0, 20.0, 30.0])
+        with urllib.request.urlopen(router.url + "/status") as r:
+            status = json.load(r)
+        assert status["latency_samples"] == 3
+        assert status["latency_ms"]["0.99"] == 30.0
+        with urllib.request.urlopen(router.url + "/metrics") as r:
+            metrics = r.read().decode()
+        assert "trpo_router_latency_window_samples 3" in metrics
+        q, n = router.latency_window((0.5, 0.99))
+        assert n == 3 and q[0.5] == 20.0
+    finally:
+        router.close()
+        rs.close()
+
+
+# ---------------------------------------------------------------------------
+# storm chaos grammar
+# ---------------------------------------------------------------------------
+
+
+def test_storm_spec_parse_and_roundtrip():
+    specs = parse_fault_specs(
+        "overload_storm@request=3:rps=50:seconds=2;"
+        "slow_replica@request=1:replica=0:ms=40;"
+        "flap_replica@request=2:replica=1:times=3"
+    )
+    assert [str(s) for s in specs] == [
+        "overload_storm@request=3:rps=50:seconds=2",
+        "slow_replica@request=1:replica=0:ms=40",
+        "flap_replica@request=2:replica=1:times=3",
+    ]
+    with pytest.raises(ValueError, match="rps"):
+        parse_fault_specs("overload_storm@request=1:rps=0")
+    with pytest.raises(ValueError, match="times"):
+        parse_fault_specs("flap_replica@request=1:times=0")
+    with pytest.raises(ValueError, match="unknown keys"):
+        parse_fault_specs("overload_storm@request=1:nope=2")
+
+
+def test_overload_storm_fires_and_replays_traffic(ff):
+    agent, state = ff
+    rs = _replicaset(
+        lambda rid: InProcessReplica(_ff_factory(agent, state)), 1
+    )
+    router = Router(rs, port=0)
+    router.injector = FaultInjector.from_spec(
+        "overload_storm@request=2:rps=30:seconds=0.5"
+    )
+    try:
+        for _ in range(2):
+            status, _ = _post(
+                router.url + "/act", {"obs": [0, 0, 0, 0]}
+            )
+            assert status == 200
+        assert router.injector.all_fired
+        deadline = time.time() + 5.0
+        while time.time() < deadline and router.routed_total < 8:
+            time.sleep(0.05)
+        # the storm replayed the triggering body many times over
+        assert router.routed_total >= 8, router.routed_total
+        time.sleep(0.6)  # storm winds down before teardown
+    finally:
+        router.close()
+        rs.close()
+
+
+def test_slow_replica_injects_persistent_latency(ff):
+    agent, state = ff
+    rs = _replicaset(
+        lambda rid: InProcessReplica(_ff_factory(agent, state)), 1
+    )
+    router = Router(rs, port=0)
+    router.injector = FaultInjector.from_spec(
+        "slow_replica@request=1:replica=0:ms=120"
+    )
+    try:
+        t0 = time.perf_counter()
+        status, _ = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+        first = time.perf_counter() - t0
+        assert status == 200
+        assert router.injector.all_fired
+        assert first >= 0.1, first  # the triggering act already pays
+        t0 = time.perf_counter()
+        status, _ = _post(router.url + "/act", {"obs": [0, 0, 0, 0]})
+        assert status == 200
+        assert time.perf_counter() - t0 >= 0.1  # persistent, not one-shot
+    finally:
+        router.close()
+        rs.close()
+
+
+def test_flap_replica_kills_through_restarts(ff):
+    agent, state = ff
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(_ff_factory(agent, state)), 2,
+        health_interval=0.1, backoff=0.05, health_fail_threshold=1,
+        max_restarts=4,
+    )
+    rs.start()
+    try:
+        assert rs.wait_healthy(2, timeout=60.0), rs.snapshot()
+        injector = FaultInjector.from_spec(
+            "flap_replica@request=1:replica=0:times=2"
+        )
+        injector.on_serve_request(1, replicaset=rs)
+        assert injector.all_fired
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            snap = rs.snapshot()
+            row = snap["replicas"]["r0"]
+            if row["restarts"] == 2 and row["state"] == "healthy":
+                break
+            time.sleep(0.1)
+        snap = rs.snapshot()
+        assert snap["replicas"]["r0"]["restarts"] == 2, snap
+        assert snap["replicas"]["r0"]["state"] == "healthy", snap
+    finally:
+        rs.close()
+
+
+def test_subprocess_replica_launch_template_seam():
+    """ISSUE 12 satellite: the launch template renders with
+    {port}/{checkpoint} substitution, and the DEFAULT command stays the
+    local scripts/serve.py child."""
+    argv = render_launch_argv(
+        "ssh worker-3 python serve.py --port {port} "
+        "--checkpoint-dir {checkpoint} --replicas 1",
+        port=8701, checkpoint="/data/ck",
+    )
+    assert argv == [
+        "ssh", "worker-3", "python", "serve.py", "--port", "8701",
+        "--checkpoint-dir", "/data/ck", "--replicas", "1",
+    ]
+    with pytest.raises(ValueError):
+        render_launch_argv("   ", port=1, checkpoint="x")
+    # TRPOConfig carries the template as cfg.serve_replica_cmd; the
+    # {replica} placeholder renders per launch (journal/replica-name
+    # plumbing for templated children)
+    cfg = TRPOConfig(
+        serve_replica_cmd="run {port} {checkpoint} --name {replica}"
+    )
+    assert render_launch_argv(
+        cfg.serve_replica_cmd, port=5, checkpoint="/ck", replica="r3"
+    ) == ["run", "5", "/ck", "--name", "r3"]
+    # default (no template): the pinned local serve.py child
+    default = SubprocessReplica._build_command(["--port", "0"], None)
+    assert default[0] == sys.executable
+    assert default[1].endswith("serve.py")
+    assert default[2:] == ["--port", "0"]
+    # a rendered command REPLACES the default launch verbatim
+    assert SubprocessReplica._build_command(
+        ["--port", "0"], ["kubectl", "run", "x"]
+    ) == ["kubectl", "run", "x"]
+
+
+# ---------------------------------------------------------------------------
+# validator contract
+# ---------------------------------------------------------------------------
+
+
+def _write_log(tmp_path, name, records):
+    import time as _t
+
+    path = tmp_path / name
+    base = [
+        {
+            "v": 1, "t": _t.time(), "kind": "run_manifest",
+            "schema": "trpo-tpu-events", "jax_version": "0",
+            "backend": "cpu", "config_hash": "deadbeefdeadbeef",
+            "config": None,
+        }
+    ]
+    with open(path, "w") as f:
+        for rec_ in base + records:
+            rec_.setdefault("v", 1)
+            rec_.setdefault("t", _t.time())
+            f.write(json.dumps(rec_) + "\n")
+    return str(path)
+
+
+def test_validator_drain_and_storm_contract(tmp_path):
+    sys.path.insert(
+        0,
+        str(
+            __import__("pathlib").Path(__file__)
+            .resolve().parents[1] / "scripts"
+        ),
+    )
+    from validate_events import validate_file
+
+    started = {
+        "kind": "autoscale", "event": "drain_started",
+        "reason": "clear", "replica": "r1",
+    }
+    done = {
+        "kind": "autoscale", "event": "drain_completed",
+        "reason": "clear", "replica": "r1", "duration_s": 0.5,
+        "sessions_moved": 2,
+    }
+    storm = {
+        "kind": "fault_injected", "fault": "overload_storm", "at": 3,
+        "spec": "overload_storm@request=3:rps=50:seconds=2",
+    }
+    shed = {
+        "kind": "autoscale", "event": "shed",
+        "reason": "backpressure", "count": 12,
+    }
+    # clean: drain paired, storm matched by a shed
+    clean = _write_log(
+        tmp_path, "clean.jsonl",
+        [dict(started), dict(storm), dict(shed), dict(done)],
+    )
+    assert validate_file(clean) == []
+    # a drain with no same-replica terminal FAILS
+    unpaired = _write_log(
+        tmp_path, "unpaired.jsonl",
+        [
+            dict(started),
+            {**done, "replica": "r9"},  # someone ELSE's terminal
+        ],
+    )
+    errs = validate_file(unpaired)
+    assert any("drain" in e and "r1" in e for e in errs), errs
+    # a storm nothing reacted to FAILS
+    ignored = _write_log(tmp_path, "ignored.jsonl", [dict(storm)])
+    errs = validate_file(ignored)
+    assert any("no matching detection" in e for e in errs), errs
+    # scale_out also counts as the storm's detection
+    scaled = _write_log(
+        tmp_path, "scaled.jsonl",
+        [
+            dict(storm),
+            {
+                "kind": "autoscale", "event": "scale_out",
+                "reason": "breach", "replica": "r2",
+            },
+        ],
+    )
+    assert validate_file(scaled) == []
+    # slow_replica: the targeted replica's eviction is a detection too
+    slow = _write_log(
+        tmp_path, "slow.jsonl",
+        [
+            {
+                "kind": "fault_injected", "fault": "slow_replica",
+                "at": 1, "replica": "r0",
+                "spec": "slow_replica@request=1:replica=0:ms=40",
+            },
+            {
+                "kind": "router", "scope": "replica", "replica": "r0",
+                "state": "died", "reason": "x",
+            },
+            {
+                "kind": "router", "scope": "replica", "replica": "r0",
+                "state": "evicted",
+            },
+        ],
+    )
+    assert validate_file(slow) == []
+    # malformed autoscale records FAIL outright
+    bad = _write_log(
+        tmp_path, "bad.jsonl",
+        [{"kind": "autoscale", "event": "scale_out", "reason": "x"}],
+    )
+    errs = validate_file(bad)
+    assert any("replica" in e for e in errs), errs
+
+
+def test_analyze_autoscale_rows(tmp_path):
+    from trpo_tpu.obs.analyze import load_events, summarize_run
+
+    log = _write_log(
+        tmp_path, "asc.jsonl",
+        [
+            {
+                "kind": "router", "scope": "request", "ms": 5.0,
+                "ok": True, "retried": False, "replica": "r0",
+            },
+            {
+                "kind": "autoscale", "event": "scale_out",
+                "reason": "breach", "replica": "r2", "p99_ms": 300.0,
+            },
+            {
+                "kind": "autoscale", "event": "shed",
+                "reason": "deadline_unmeetable", "count": 7,
+            },
+            {
+                "kind": "autoscale", "event": "drain_started",
+                "reason": "clear", "replica": "r2",
+            },
+            {
+                "kind": "autoscale", "event": "drain_completed",
+                "reason": "clear", "replica": "r2",
+                "duration_s": 1.25, "sessions_moved": 3,
+            },
+        ],
+    )
+    summary = summarize_run(load_events(log))
+    rows = summary["router"]["autoscale"]
+    assert rows["scale_out"] == 1
+    assert rows["drain_completed"] == 1 and rows["drain_aborted"] == 0
+    assert rows["sessions_moved"] == 3
+    assert rows["shed_total"] == 7
+    assert rows["shed_reasons"] == {"deadline_unmeetable": 7}
+    assert rows["drain_duration_max_s"] == 1.25
+    from trpo_tpu.obs.analyze import compare_runs, render_summary
+
+    assert "autoscale:" in render_summary(summary)
+    # an aborted drain between two "clean" runs is a strict regression
+    base = summary
+    log2 = _write_log(
+        tmp_path, "asc2.jsonl",
+        [
+            {
+                "kind": "router", "scope": "request", "ms": 5.0,
+                "ok": True, "retried": False, "replica": "r0",
+            },
+            {
+                "kind": "autoscale", "event": "drain_started",
+                "reason": "clear", "replica": "r1",
+            },
+            {
+                "kind": "autoscale", "event": "drain_aborted",
+                "reason": "drain timeout", "replica": "r1",
+                "sessions_moved": 0,
+            },
+        ],
+    )
+    new = summarize_run(load_events(log2))
+    result = compare_runs(base, new, threshold_pct=50.0)
+    verdict = {
+        v["metric"]: v["verdict"] for v in result["verdicts"]
+    }["router/autoscale_drain_aborted"]
+    assert verdict == "regressed"

@@ -576,6 +576,62 @@ class TRPOConfig:
     #                                judges p99 + action parity (small =
     #                                fast promotion, large = confident)
 
+    # --- elastic serving (serve/autoscaler — ISSUE 12) --------------------
+    serve_min_replicas: int = 1    # autoscaler floor: scale-in never
+    #                                drains below this many replicas
+    serve_max_replicas: Optional[int] = None  # autoscaler ceiling; None
+    #                                (default) = no autoscaling — the
+    #                                set stays fixed at serve_replicas
+    #                                (the pre-ISSUE-12 behavior). Set
+    #                                it (serve.py --max-replicas) to
+    #                                arm the control loop: the set
+    #                                grows/shrinks within
+    #                                [serve_min_replicas,
+    #                                serve_max_replicas] from the
+    #                                router's own inflight/p99/
+    #                                backpressure metrics, with
+    #                                lossless journal-backed drains on
+    #                                scale-in
+    serve_slo_p99_ms: float = 250.0  # the serving SLO the autoscaler
+    #                                defends: a windowed p99 above this
+    #                                (once serve_autoscale_min_samples
+    #                                back it) counts as a breach; also
+    #                                the budget deadline-aware admission
+    #                                reports in its typed 503s
+    serve_drain_timeout: float = 30.0  # lossless-drain deadline: a
+    #                                drain that has not moved every
+    #                                pinned session (and wound down the
+    #                                victim's in-flight requests) within
+    #                                this many seconds ABORTS back to
+    #                                rotation — capacity is reclaimable
+    #                                later, dropped sessions are not
+    serve_autoscale_interval: float = 0.5  # control-loop poll cadence
+    #                                (seconds between metric
+    #                                observations/decisions)
+    serve_autoscale_min_samples: int = 16  # minimum latency samples
+    #                                behind a windowed p99 before the
+    #                                autoscaler (or the router's
+    #                                deadline admission) will act on it
+    #                                — a 3-request "p99" is noise, not
+    #                                a signal
+    serve_replica_cmd: Optional[str] = None  # replica launch template
+    #                                (serve.py --replica-cmd, rendered
+    #                                by replicaset.render_launch_argv):
+    #                                shell-split, with {port}/
+    #                                {checkpoint}/{replica}
+    #                                substituted; when set, serve.py
+    #                                launches replicas as SUBPROCESS
+    #                                children via this command (which
+    #                                must run a serve.py-compatible
+    #                                server honoring the appended
+    #                                --run-descriptor) — the seam a
+    #                                non-local launcher (ssh/k8s
+    #                                wrapper) plugs into. None
+    #                                (default) = in-process engines;
+    #                                SubprocessReplica's own default
+    #                                stays the local scripts/serve.py
+    #                                child
+
     # --- io --------------------------------------------------------------
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 10
@@ -795,6 +851,60 @@ class TRPOConfig:
             raise ValueError(
                 "serve_canary_window must be >= 1, got "
                 f"{self.serve_canary_window}"
+            )
+        if self.serve_min_replicas < 1:
+            raise ValueError(
+                "serve_min_replicas must be >= 1, got "
+                f"{self.serve_min_replicas}"
+            )
+        if self.serve_max_replicas is not None:
+            if self.serve_max_replicas < self.serve_min_replicas:
+                raise ValueError(
+                    "need serve_min_replicas <= serve_max_replicas, got "
+                    f"({self.serve_min_replicas}, "
+                    f"{self.serve_max_replicas})"
+                )
+            if not (
+                self.serve_min_replicas
+                <= self.serve_replicas
+                <= self.serve_max_replicas
+            ):
+                # the starting size must sit inside the elastic bounds,
+                # or the first control tick would immediately "correct"
+                # a configuration the operator never meant
+                raise ValueError(
+                    "with autoscaling armed, serve_replicas must be in "
+                    f"[serve_min_replicas, serve_max_replicas], got "
+                    f"{self.serve_replicas} outside "
+                    f"[{self.serve_min_replicas}, "
+                    f"{self.serve_max_replicas}]"
+                )
+        if self.serve_slo_p99_ms <= 0:
+            raise ValueError(
+                "serve_slo_p99_ms must be > 0, got "
+                f"{self.serve_slo_p99_ms}"
+            )
+        if self.serve_drain_timeout <= 0:
+            raise ValueError(
+                "serve_drain_timeout must be > 0, got "
+                f"{self.serve_drain_timeout}"
+            )
+        if self.serve_autoscale_interval <= 0:
+            raise ValueError(
+                "serve_autoscale_interval must be > 0, got "
+                f"{self.serve_autoscale_interval}"
+            )
+        if self.serve_autoscale_min_samples < 1:
+            raise ValueError(
+                "serve_autoscale_min_samples must be >= 1, got "
+                f"{self.serve_autoscale_min_samples}"
+            )
+        if self.serve_replica_cmd is not None and (
+            not self.serve_replica_cmd.strip()
+        ):
+            raise ValueError(
+                "serve_replica_cmd must be a non-empty command template "
+                "(or None for the local scripts/serve.py child)"
             )
         if self.inject_faults:
             # fail at construction: a chaos run with an unparseable spec

@@ -38,6 +38,11 @@ Comparison semantics (:func:`compare_runs`):
   failover is a regression) plus carry-journal lag, and canary
   deployment verdicts (``rolled_back`` is a strict counter — any rise
   between clean runs means a checkpoint failed its gate);
+* elastic serving (ISSUE 12, ``autoscale`` events): scale events,
+  drain durations + sessions moved, shed counts by reason;
+  ``drain_aborted`` is a strict counter (a drain that could not move
+  its sessions losslessly is never noise), drain duration time-like,
+  shed totals grow-is-worse;
 * phases below ``min_ms`` in BOTH runs are skipped (a 0.1 ms phase
   doubling is scheduler noise, not a regression), as are metrics absent
   from either run (no silent verdict about unmeasured things — they are
@@ -191,6 +196,7 @@ def _summarize_router(records: list) -> Optional[dict]:
     ]
     sessions = [r for r in records if r.get("kind") == "session"]
     canary = [r for r in records if r.get("kind") == "canary"]
+    autoscale = [r for r in records if r.get("kind") == "autoscale"]
     if not reqs and not lifecycle:
         return None
     ok_reqs = [r for r in reqs if r.get("ok")]
@@ -266,6 +272,7 @@ def _summarize_router(records: list) -> Optional[dict]:
         ) if sessions else None,
         "failover": _failover_rows(sessions),
         "canary": _canary_rows(canary),
+        "autoscale": _autoscale_rows(autoscale),
     }
 
 
@@ -320,6 +327,45 @@ def _canary_rows(canary: list) -> Optional[dict]:
         "promoted": counts.get("promoted", 0),
         "rolled_back": counts.get("rolled_back", 0),
         "steps": steps,
+    }
+
+
+def _autoscale_rows(autoscale: list) -> Optional[dict]:
+    """Elastic-serving control actions (ISSUE 12): scale events, drain
+    durations/sessions-moved, and the shed totals (each ``shed`` record
+    is an aggregate carrying ``count``). None for logs with no
+    autoscale records."""
+    if not autoscale:
+        return None
+    counts = Counter(r.get("event") for r in autoscale)
+    durations = [
+        r.get("duration_s") for r in autoscale
+        if r.get("event") == "drain_completed"
+        and _finite(r.get("duration_s")) is not None
+    ]
+    moved = sum(
+        r.get("sessions_moved") for r in autoscale
+        if r.get("event") == "drain_completed"
+        and isinstance(r.get("sessions_moved"), int)
+    )
+    sheds = sum(
+        r.get("count") for r in autoscale
+        if r.get("event") == "shed"
+        and isinstance(r.get("count"), int)
+    )
+    shed_reasons = Counter()
+    for r in autoscale:
+        if r.get("event") == "shed" and isinstance(r.get("count"), int):
+            shed_reasons[str(r.get("reason"))] += r["count"]
+    return {
+        "scale_out": counts.get("scale_out", 0),
+        "drain_completed": counts.get("drain_completed", 0),
+        "drain_aborted": counts.get("drain_aborted", 0),
+        "sessions_moved": moved,
+        "shed_total": sheds,
+        "shed_reasons": dict(sorted(shed_reasons.items())),
+        "drain_duration_mean_s": _mean(durations),
+        "drain_duration_max_s": max(durations) if durations else None,
     }
 
 
@@ -755,6 +801,44 @@ def compare_runs(
                     threshold_pct, "rate",
                 )
             )
+        # elastic-serving verdicts (ISSUE 12): an aborted drain is a
+        # strict counter (the canary_rolled_back pattern — a drain
+        # that could not move its sessions losslessly is never noise);
+        # drain duration is time-like, sheds grow-is-worse under the
+        # same threshold (comparable runs drive comparable storms)
+        b_as = b_rt.get("autoscale") or {}
+        n_as = n_rt.get("autoscale") or {}
+        if b_as or n_as:
+            b_da = b_as.get("drain_aborted") or 0
+            n_da = n_as.get("drain_aborted") or 0
+            verdicts.append({
+                "metric": "router/autoscale_drain_aborted",
+                "base": b_da,
+                "new": n_da,
+                "direction": "count",
+                "delta_pct": None,
+                "verdict": "regressed" if n_da > b_da else "ok",
+            })
+            verdicts.append(
+                _verdict(
+                    "router/autoscale_drain_duration_max_s",
+                    b_as.get("drain_duration_max_s"),
+                    n_as.get("drain_duration_max_s"),
+                    threshold_pct, "time",
+                )
+            )
+            # sheds are a COUNT (grow-is-worse under the threshold —
+            # comparable runs drive comparable storms): judged with the
+            # time-direction rule, labeled honestly as a count so no
+            # consumer renders shed totals in milliseconds
+            shed_row = _verdict(
+                "router/autoscale_shed_total",
+                b_as.get("shed_total"),
+                n_as.get("shed_total"),
+                threshold_pct, "time",
+            )
+            shed_row["direction"] = "count"
+            verdicts.append(shed_row)
 
     # solver-precision counters (ISSUE 8) — only when at least one run
     # carried the ladder. `fallbacks` is judged as a strict counter: ANY
@@ -978,6 +1062,23 @@ def render_summary(summary: dict) -> str:
                 f" resumed_fraction={_fmt(fo.get('resumed_fraction'))}"
                 f" journal_lag_mean={_fmt(fo.get('journal_lag_mean'))}"
                 f" journal_lag_max={fo.get('journal_lag_max')}"
+            )
+        asr = rt.get("autoscale") or {}
+        if asr:
+            reasons = asr.get("shed_reasons") or {}
+            out.append(
+                f"autoscale: scale_out={asr.get('scale_out')}"
+                f" drain_completed={asr.get('drain_completed')}"
+                f" drain_aborted={asr.get('drain_aborted')}"
+                f" sessions_moved={asr.get('sessions_moved')}"
+                f" sheds={asr.get('shed_total')}"
+                + (
+                    " ("
+                    + ", ".join(f"{k}×{v}" for k, v in reasons.items())
+                    + ")"
+                    if reasons else ""
+                )
+                + f" drain_max={_fmt(asr.get('drain_duration_max_s'))}s"
             )
         cn = rt.get("canary") or {}
         if cn:

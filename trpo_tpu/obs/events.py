@@ -91,6 +91,21 @@ Kinds:
   same way the fleet's is: ``scripts/validate_events.py`` FAILS a
   ``started`` with no later terminal ``promoted``/``rolled_back`` for
   the same step — an unresolved canary means the gate loop is broken.
+* ``autoscale`` — one elastic-serving control action (ISSUE 12:
+  ``serve/autoscaler.py`` decisions, ``serve/router.py`` sheds):
+  ``AUTOSCALE_EVENTS`` — ``scale_out`` (a new replica launched from
+  the router's own metrics), ``drain_started`` / ``drain_completed``
+  / ``drain_aborted`` (the lossless scale-in protocol: sessions
+  resumed onto survivors from the carry journal before the victim is
+  terminated; a stalled drain aborts back to rotation), and ``shed``
+  (overload admission: deadline-unmeetable 503s, retry-budget skips,
+  stateless-headroom refusals — aggregated with a ``count``). Every
+  record carries the ``reason`` (with the trigger metrics attached);
+  scale/drain records name their ``replica``. The log is
+  self-auditing: ``scripts/validate_events.py`` FAILS a
+  ``drain_started`` with no later same-replica ``drain_completed``/
+  ``drain_aborted`` terminal — a drain that neither finished nor
+  aborted means sessions may be stranded on a half-retired replica.
 
 Sinks are append-only and flush-on-write; the JSONL sink repairs a
 crash-truncated final line on open (``utils/metrics.repair_jsonl_tail``),
@@ -119,6 +134,7 @@ __all__ = [
     "ROUTER_REPLICA_STATES",
     "SESSION_EVENTS",
     "CANARY_EVENTS",
+    "AUTOSCALE_EVENTS",
     "EventBus",
     "JsonlSink",
     "ConsoleSink",
@@ -137,19 +153,27 @@ FLEET_STATES = (
 
 # replica lifecycle states the serving replica supervisor may record
 # (the state machine lives in serve/replicaset.py; the vocabulary lives
-# HERE so the validator needs no serve import — the FLEET_STATES pattern)
+# HERE so the validator needs no serve import — the FLEET_STATES pattern).
+# `draining`/`drained` are the elastic scale-in states (ISSUE 12): a
+# draining replica leaves stateless rotation while its sessions resume
+# elsewhere; `drained` is the terminal record of a session-empty replica
+# leaving the set.
 ROUTER_REPLICA_STATES = (
-    "started", "healthy", "reloading", "died", "evicted", "restarted",
-    "failed",
+    "started", "healthy", "reloading", "draining", "drained", "died",
+    "evicted", "restarted", "failed",
 )
 
 # session lifecycle transitions the recurrent serving protocol records
 # (stores live in serve/session.py, router affinity in serve/router.py);
 # `resumed` = re-created from a journaled carry (lossless failover),
 # `reestablished` = the fresh-carry fallback when no journal entry
-# existed — the discriminator the failover report reads
+# existed — the discriminator the failover report reads; `drained`
+# (ISSUE 12) = the same lossless journal move performed ON PURPOSE by
+# a scale-in drain, kept distinct so planned migrations never inflate
+# the failover-quality metrics
 SESSION_EVENTS = (
     "created", "resumed", "reestablished", "expired", "evicted",
+    "drained",
 )
 
 # gated-deployment transitions the canary controller records (the state
@@ -157,6 +181,15 @@ SESSION_EVENTS = (
 # lives HERE so the validator needs no serve import — the FLEET_STATES
 # pattern). `started` must resolve to `promoted` or `rolled_back`.
 CANARY_EVENTS = ("started", "promoted", "rolled_back")
+
+# elastic-serving control actions (ISSUE 12: serve/autoscaler.py and
+# the router's overload sheds; vocabulary HERE so the validator needs
+# no serve import). `drain_started` must resolve to a same-replica
+# `drain_completed` or `drain_aborted`.
+AUTOSCALE_EVENTS = (
+    "scale_out", "drain_started", "drain_completed", "drain_aborted",
+    "shed",
+)
 
 _SCALAR = (bool, int, float, str, type(None))
 
@@ -258,6 +291,16 @@ _REQUIRED = {
         "event": lambda v: v in CANARY_EVENTS,
         "replica": lambda v: isinstance(v, str) and v,
     },
+    "autoscale": {
+        # one elastic-serving control action (serve/autoscaler.py /
+        # the router's overload sheds); every record says WHY — the
+        # trigger metrics (p99_ms, inflight, pressure) ride along as
+        # optional fields. Per-event required fields (replica on
+        # scale/drain records, count on sheds) live in
+        # _AUTOSCALE_SCOPED below.
+        "event": lambda v: v in AUTOSCALE_EVENTS,
+        "reason": lambda v: isinstance(v, str) and v,
+    },
 }
 
 _BYTES = lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 0
@@ -294,6 +337,21 @@ _ROUTER_SCOPED = {
     },
 }
 
+# autoscale events are EVENT-discriminated the same way: scale/drain
+# actions name the replica they act on (the validator's drain-terminal
+# pairing needs it); sheds aggregate and carry how many they stand for
+_AUTOSCALE_SCOPED = {
+    "scale_out": {"replica": lambda v: isinstance(v, str) and v},
+    "drain_started": {"replica": lambda v: isinstance(v, str) and v},
+    "drain_completed": {"replica": lambda v: isinstance(v, str) and v},
+    "drain_aborted": {"replica": lambda v: isinstance(v, str) and v},
+    "shed": {
+        "count": lambda v: isinstance(v, int)
+        and not isinstance(v, bool)
+        and v >= 1,
+    },
+}
+
 EVENT_KINDS = tuple(sorted(_REQUIRED))
 
 
@@ -321,22 +379,23 @@ def validate_event(rec: Any) -> list:
         elif not ok(rec[field]):
             errs.append(f"{kind}: field {field!r} failed its check "
                         f"(got {rec[field]!r})")
-    for scoped_kind, table in (
-        ("memory", _MEMORY_SCOPED),
-        ("router", _ROUTER_SCOPED),
+    for scoped_kind, discriminator, table in (
+        ("memory", "scope", _MEMORY_SCOPED),
+        ("router", "scope", _ROUTER_SCOPED),
+        ("autoscale", "event", _AUTOSCALE_SCOPED),
     ):
         if kind != scoped_kind:
             continue
-        # scope-discriminated record: each scope has its own required set
-        for field, ok in table.get(rec.get("scope"), {}).items():
+        # discriminated record: each scope/event has its own required set
+        tag = rec.get(discriminator)
+        for field, ok in table.get(tag, {}).items():
             if field not in rec:
                 errs.append(
-                    f"{kind}[{rec.get('scope')}]: missing required "
-                    f"field {field!r}"
+                    f"{kind}[{tag}]: missing required field {field!r}"
                 )
             elif not ok(rec[field]):
                 errs.append(
-                    f"{kind}[{rec.get('scope')}]: field {field!r} failed "
+                    f"{kind}[{tag}]: field {field!r} failed "
                     f"its check (got {rec[field]!r})"
                 )
     return errs

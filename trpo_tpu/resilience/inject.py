@@ -40,6 +40,25 @@ router's request clock or the checkpoint-load seam:
   DETECT the miss and fall back loudly to the fresh-carry path
   (``session:reestablished``), never crash or resume silently wrong.
 
+The STORM grammar (ISSUE 12) drives the elastic autoscaler and the
+router's overload admission control:
+
+* ``overload_storm@request=K:rps=R:seconds=S`` — from the K-th routed
+  request, replay realistic traffic at the router at R requests/s for
+  S seconds (the triggering request's own shape: a stateless body is
+  replayed verbatim; a session act seeds STORM-OWNED sessions so real
+  sessions' carries are never perturbed). The autoscaler must detect
+  the capacity mismatch and scale out — or the admission layers must
+  shed — and the validator fails a storm nothing reacted to.
+* ``slow_replica@request=K:replica=R:ms=M`` — persistent LATENCY (not
+  a wedge): every act on ``rR`` pays an extra M ms from then on while
+  health checks stay fast — a degraded device the p99 metrics (scale/
+  shed) or the request path (evict) must catch.
+* ``flap_replica@request=K:replica=R:times=T`` — kill ``rR``, wait for
+  its supervised restart, kill it again, T kills total: the crash-loop
+  shape that makes an unbudgeted retry path DOUBLE traffic on the
+  survivors (the router's retry token bucket is what bounds it).
+
 Specs are ``;``-separated; each fires EXACTLY ONCE (a recovery that
 re-runs the target iteration re-runs it clean — which is what lets the
 chaos suite pin bit-exact continuation against an unfaulted run). Every
@@ -73,6 +92,9 @@ _KINDS = {
     "stall_replica": ("request", "serve"),
     "wedge_reload": ("step", "serve"),
     "drop_carry_journal": ("request", "serve"),
+    "overload_storm": ("request", "serve"),
+    "slow_replica": ("request", "serve"),
+    "flap_replica": ("request", "serve"),
 }
 
 
@@ -89,6 +111,9 @@ class FaultSpec:
     worker: int = 0
     seconds: float = 0.25
     replica: int = 0
+    rps: float = 10.0     # overload_storm: synthetic request rate
+    ms: float = 100.0     # slow_replica: per-act latency injection
+    times: int = 2        # flap_replica: total kills
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -105,6 +130,12 @@ class FaultSpec:
             raise ValueError(f"{self.kind}: seconds must be >= 0")
         if self.replica < 0:
             raise ValueError(f"{self.kind}: replica must be >= 0")
+        if self.rps <= 0:
+            raise ValueError(f"{self.kind}: rps must be > 0")
+        if self.ms < 0:
+            raise ValueError(f"{self.kind}: ms must be >= 0")
+        if self.times < 1:
+            raise ValueError(f"{self.kind}: times must be >= 1")
 
     @property
     def env_level(self) -> bool:
@@ -131,6 +162,12 @@ class FaultSpec:
             extra = f":replica={self.replica}"
         elif self.kind == "stall_replica":
             extra = f":replica={self.replica}:seconds={self.seconds:g}"
+        elif self.kind == "overload_storm":
+            extra = f":rps={self.rps:g}:seconds={self.seconds:g}"
+        elif self.kind == "slow_replica":
+            extra = f":replica={self.replica}:ms={self.ms:g}"
+        elif self.kind == "flap_replica":
+            extra = f":replica={self.replica}:times={self.times}"
         return f"{self.kind}@{key}={self.at}{extra}"
 
 
@@ -182,6 +219,9 @@ def parse_fault_specs(spec: str) -> Tuple[FaultSpec, ...]:
             worker = int(fields.pop("worker", 0))
             seconds = float(fields.pop("seconds", 0.25))
             replica = int(fields.pop("replica", 0))
+            rps = float(fields.pop("rps", 10.0))
+            ms = float(fields.pop("ms", 100.0))
+            times = int(fields.pop("times", 2))
         except ValueError as e:
             raise ValueError(f"fault spec {frag!r}: {e}") from None
         if fields:
@@ -189,7 +229,8 @@ def parse_fault_specs(spec: str) -> Tuple[FaultSpec, ...]:
                 f"fault spec {frag!r}: unknown keys {sorted(fields)}"
             )
         out.append(FaultSpec(kind=kind, at=at, worker=worker,
-                             seconds=seconds, replica=replica))
+                             seconds=seconds, replica=replica,
+                             rps=rps, ms=ms, times=times))
     if not out:
         raise ValueError(f"fault spec {spec!r} contains no faults")
     return tuple(out)
@@ -321,14 +362,17 @@ class FaultInjector:
     # -- serving plane (ISSUE 11) ------------------------------------------
 
     def on_serve_request(
-        self, request_idx: int, replicaset=None, journal_dir=None
+        self, request_idx: int, replicaset=None, journal_dir=None,
+        router=None, path=None, body=None,
     ) -> None:
         """Fire request-clocked serving faults due at the
         ``request_idx``-th routed client request (1-based, counted by
         the router). ``replicaset`` is the live
         :class:`~trpo_tpu.serve.replicaset.ReplicaSet` whose replica
-        the kill/stall specs target; ``journal_dir`` is where
-        ``drop_carry_journal`` finds its victim file."""
+        the kill/stall/slow/flap specs target; ``journal_dir`` is
+        where ``drop_carry_journal`` finds its victim file; ``router``
+        + the triggering request's ``path``/``body`` are what an
+        ``overload_storm`` replays realistic traffic through."""
         due = []
         with self._lock:
             for i, s in enumerate(self.specs):
@@ -344,7 +388,10 @@ class FaultInjector:
         first_error = None
         for i, s in due:
             try:
-                self._fire_serve_fault(s, replicaset, journal_dir)
+                self._fire_serve_fault(
+                    s, replicaset, journal_dir,
+                    router=router, path=path, body=body,
+                )
             except Exception as e:
                 # a fault that could not execute (bad replica index,
                 # wrong launcher family) must end the run UNFIRED —
@@ -360,7 +407,8 @@ class FaultInjector:
         if first_error is not None:
             raise first_error
 
-    def _fire_serve_fault(self, s, replicaset, journal_dir) -> None:
+    def _fire_serve_fault(self, s, replicaset, journal_dir,
+                          router=None, path=None, body=None) -> None:
         # emit BEFORE executing: concurrent request threads may detect
         # the failure (report_failure -> died/evicted records) within
         # microseconds of the kill, and the validator's matched-by-
@@ -376,6 +424,25 @@ class FaultInjector:
                 )
             self._emit(s, replica=s.replica_id)
             rec.handle.kill()
+        elif s.kind == "overload_storm":
+            self._start_storm(s, router, path, body)
+        elif s.kind == "slow_replica":
+            rec = (
+                replicaset.replicas.get(s.replica_id)
+                if replicaset is not None else None
+            )
+            server = getattr(
+                rec.handle if rec is not None else None, "server", None
+            )
+            if server is None or not hasattr(server, "slow"):
+                raise ValueError(
+                    f"fault {s}: no in-process replica {s.replica_id} "
+                    "to slow (subprocess replicas have no latency seam)"
+                )
+            self._emit(s, replica=s.replica_id, ms=s.ms)
+            server.slow(s.ms)
+        elif s.kind == "flap_replica":
+            self._start_flap(s, replicaset)
         elif s.kind == "stall_replica":
             rec = (
                 replicaset.replicas.get(s.replica_id)
@@ -401,6 +468,137 @@ class FaultInjector:
             except OSError:
                 pass  # never journaled anything yet: same outcome —
                 #       the failover finds nothing and says so
+
+    def _start_storm(self, s, router, path, body) -> None:
+        """Launch the overload-storm generator: background workers
+        replaying REALISTIC traffic at the router at ``s.rps`` for
+        ``s.seconds``. A stateless trigger replays its own body; a
+        session-act trigger seeds STORM-OWNED sessions (a flood of new
+        users) so no real session's carry is ever perturbed. Worker
+        errors are swallowed — the storm's 503 sheds ARE the expected
+        response; what must react is the autoscaler/admission layer,
+        and the validator checks exactly that."""
+        if router is None:
+            raise ValueError(
+                f"fault {s}: overload_storm needs the router hook "
+                "(router=None)"
+            )
+        session_mode = bool(path) and path.startswith("/session/")
+        if session_mode:
+            import json as _json
+
+            try:
+                obs = _json.loads(body)["obs"]
+            except Exception:
+                raise ValueError(
+                    f"fault {s}: triggering session act carried no "
+                    "replayable obs"
+                )
+            payload = _json.dumps({"obs": obs}).encode()
+        else:
+            if not body:
+                raise ValueError(
+                    f"fault {s}: triggering request has no body to "
+                    "replay"
+                )
+            payload = bytes(body)
+        self._emit(s, rps=s.rps, seconds=s.seconds)
+        # enough workers that the target rate survives per-request
+        # latency (a worker is synchronous: at most 1 outstanding, so
+        # concurrency == workers under saturation); each paces itself
+        # at rps/workers
+        workers = max(1, min(16, int(s.rps // 5) or 1))
+        for w in range(workers):
+            t = threading.Thread(
+                target=self._storm_worker,
+                args=(router.url, session_mode, payload,
+                      s.rps / workers, s.seconds),
+                name=f"overload-storm-{w}",
+                daemon=True,
+            )
+            t.start()
+
+    @staticmethod
+    def _storm_worker(url, session_mode, payload, rps, seconds) -> None:
+        import json as _json
+        import urllib.request
+
+        def post(path, data):
+            req = urllib.request.Request(
+                url + path, data=data,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                return _json.loads(r.read())
+
+        target = "/act"
+        if session_mode:
+            target = None  # minted below, retried while the set sheds
+        end = time.monotonic() + seconds
+        interval = 1.0 / rps
+        next_t = time.monotonic()
+        while time.monotonic() < end:
+            try:
+                if session_mode and target is None:
+                    out = post("/session", b"")
+                    target = f"/session/{out['session']}/act"
+                else:
+                    post(target, payload)
+            except Exception:
+                pass  # sheds/backpressure are the system WORKING
+            next_t += interval
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            else:
+                next_t = time.monotonic()  # overloaded: don't burst
+
+    def _start_flap(self, s, replicaset) -> None:
+        """Kill the target, wait for its supervised restart to go
+        healthy, kill it again — ``s.times`` kills total, off-thread
+        (the restarts take backoff-scale wall time)."""
+        rec = (
+            replicaset.replicas.get(s.replica_id)
+            if replicaset is not None else None
+        )
+        if rec is None or rec.handle is None:
+            raise ValueError(
+                f"fault {s}: no replica {s.replica_id} to flap"
+            )
+        self._emit(s, replica=s.replica_id, times=s.times)
+        with replicaset.lock:
+            restarts0 = rec.restarts
+
+        def run():
+            for k in range(s.times):
+                deadline = time.monotonic() + 120.0
+                while time.monotonic() < deadline:
+                    with replicaset.lock:
+                        state, handle = rec.state, rec.handle
+                        restarts = rec.restarts
+                    # kill k+1 waits for the k-th RELAUNCH to land (the
+                    # restart counter, not just "healthy" — the record
+                    # can still read healthy for a poll tick after the
+                    # previous kill, and a second shot into the same
+                    # corpse would flap nothing)
+                    if (
+                        state == "healthy"
+                        and handle is not None
+                        and restarts >= restarts0 + k
+                    ):
+                        break
+                    time.sleep(0.05)
+                else:
+                    return  # never came back: the flap ends here
+                try:
+                    handle.kill()
+                except Exception:
+                    return
+
+        t = threading.Thread(
+            target=run, name="flap-replica", daemon=True
+        )
+        t.start()
 
     @staticmethod
     def _stall_replica(handle, seconds: float) -> None:

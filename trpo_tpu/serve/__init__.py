@@ -39,12 +39,27 @@ the "millions of users" scale leg:
   the router adds session→replica affinity and re-establishes a
   session from a fresh carry when its replica dies.
 
+The elastic control loop (ISSUE 12) closes the plane's last
+robustness rung:
+
+* :mod:`trpo_tpu.serve.autoscaler` — :class:`Autoscaler`: grows and
+  shrinks the replica set from the router's own inflight/p99/
+  backpressure metrics through hysteresis windows; scale-in is a
+  LOSSLESS drain (pinned sessions resumed onto survivors from the
+  carry journal before the victim is terminated; a stalled drain
+  aborts back to rotation). The router itself gained overload
+  admission control: a token-bucket retry budget, deadline-aware
+  typed 503s, and a documented shed order (stateless before session
+  traffic).
+
 ``scripts/serve.py`` is the CLI (``--replicas N`` = replicas + router
-in one process); ``bench.py``'s ``serving``/``serving_scale`` blocks
+in one process, ``--min-replicas/--max-replicas/--slo-p99-ms`` arm
+the autoscaler); ``bench.py``'s ``serving``/``serving_scale`` blocks
 and ``scripts/analyze_run.py --compare`` carry the latency/throughput
 SLOs.
 """
 
+from trpo_tpu.serve.autoscaler import Autoscaler
 from trpo_tpu.serve.batcher import MicroBatcher
 from trpo_tpu.serve.engine import InferenceEngine
 from trpo_tpu.serve.replicaset import (
@@ -52,6 +67,7 @@ from trpo_tpu.serve.replicaset import (
     InProcessReplica,
     ReplicaSet,
     SubprocessReplica,
+    render_launch_argv,
 )
 from trpo_tpu.serve.router import Router
 from trpo_tpu.serve.server import PolicyServer
@@ -74,7 +90,9 @@ __all__ = [
     "read_carry_journal",
     "InProcessReplica",
     "SubprocessReplica",
+    "render_launch_argv",
     "ReplicaSet",
     "Router",
     "CanaryController",
+    "Autoscaler",
 ]

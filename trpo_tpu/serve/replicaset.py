@@ -58,14 +58,47 @@ __all__ = [
     "RECORD_STATES",
     "InProcessReplica",
     "SubprocessReplica",
+    "render_launch_argv",
     "ReplicaSet",
     "CanaryController",
 ]
 
 # the states a ReplicaRecord actually takes (the rotation view; the
-# transitional EVENT states died/restarted exist only as bus records —
-# the same RECORD/EVENT split as fleet/scrape.RECORD_STATES)
-RECORD_STATES = ("starting", "healthy", "reloading", "evicted", "failed")
+# transitional EVENT states died/restarted/drained exist only as bus
+# records — the same RECORD/EVENT split as fleet/scrape.RECORD_STATES).
+# `draining` (ISSUE 12) is the lossless scale-in window: the replica is
+# out of stateless rotation and takes no new sessions, but pinned
+# session traffic still reaches it while the autoscaler resumes its
+# sessions onto survivors from the carry journal.
+RECORD_STATES = (
+    "starting", "healthy", "reloading", "draining", "evicted", "failed",
+)
+
+
+def render_launch_argv(
+    template: str, port, checkpoint, replica: Optional[str] = None,
+) -> List[str]:
+    """Render ``cfg.serve_replica_cmd`` into a launch argv: the template
+    is shell-split (POSIX rules) and every ``{port}``/``{checkpoint}``
+    (and, when given, ``{replica}``) placeholder substituted — the seam
+    that lets scale-out target a non-local launcher (ssh wrapper,
+    kubectl run, …) while the default stays the local
+    ``scripts/serve.py`` child. The rendered argv is what
+    :class:`SubprocessReplica` takes as ``command``; ``scripts/serve.py
+    --replica-cmd`` wires it as the replica launcher."""
+    import shlex
+
+    if not template or not template.strip():
+        raise ValueError("serve_replica_cmd template is empty")
+    out = []
+    for arg in shlex.split(template):
+        arg = arg.replace("{port}", str(port)).replace(
+            "{checkpoint}", str(checkpoint)
+        )
+        if replica is not None:
+            arg = arg.replace("{replica}", replica)
+        out.append(arg)
+    return out
 
 
 class InProcessReplica:
@@ -126,9 +159,22 @@ class SubprocessReplica:
     ``--run-descriptor`` (appended here, pointing into
     ``replica_dir``); ``--port 0`` should be in it so replicas never
     collide. ``url`` is ``None`` until the descriptor appears — the
-    supervisor keeps the replica in ``starting`` and polls."""
+    supervisor keeps the replica in ``starting`` and polls.
 
-    def __init__(self, argv: List[str], replica_dir: str):
+    ``command`` (the :func:`render_launch_argv` seam, ISSUE 12)
+    REPLACES the default ``[python, scripts/serve.py] + argv`` launch
+    with a rendered ``cfg.serve_replica_cmd`` template, so scale-out
+    can target a non-local launcher (the wrapped command must still
+    end up running ``serve.py``, which writes the descriptor this
+    supervisor discovers). ``--run-descriptor`` is appended either
+    way."""
+
+    def __init__(
+        self,
+        argv: List[str],
+        replica_dir: str,
+        command: Optional[List[str]] = None,
+    ):
         os.makedirs(replica_dir, exist_ok=True)
         self.descriptor_path = os.path.join(replica_dir, "run.json")
         # a stale descriptor from a previous attempt must not be
@@ -140,13 +186,23 @@ class SubprocessReplica:
         self.log_path = os.path.join(replica_dir, "serve.log")
         self._log = open(self.log_path, "a")
         self.proc = subprocess.Popen(
-            [sys.executable, self._serve_script()]
-            + list(argv)
+            self._build_command(argv, command)
             + ["--run-descriptor", self.descriptor_path],
             stdout=self._log,
             stderr=subprocess.STDOUT,
         )
         self.url: Optional[str] = None
+
+    @classmethod
+    def _build_command(
+        cls, argv: List[str], command: Optional[List[str]]
+    ) -> List[str]:
+        """The launch argv before the descriptor flag: the rendered
+        ``serve_replica_cmd`` when one is set, else the local
+        ``scripts/serve.py`` child (the pinned default)."""
+        if command is not None:
+            return list(command)
+        return [sys.executable, cls._serve_script()] + list(argv)
 
     @staticmethod
     def _serve_script() -> str:
@@ -279,6 +335,10 @@ class ReplicaSet:
         self.replicas: Dict[str, ReplicaRecord] = {
             f"r{i}": ReplicaRecord(f"r{i}") for i in range(n_replicas)
         }
+        # ids are NEVER reused: a drained-away r1 followed by a
+        # scale-out mints r<next>, so event logs (and carry-journal
+        # files) from different incarnations can't collide
+        self._next_idx = n_replicas
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -299,11 +359,14 @@ class ReplicaSet:
             pass
 
     def _launch(self, rec: ReplicaRecord) -> None:
-        rec.handle = self.launcher(rec.id)
-        rec.url = getattr(rec.handle, "url", None)
         rec.state = "starting"
         rec.health_fails = 0
+        # stamped BEFORE the (slow — AOT compile) launch: a tick
+        # racing add_replica must never read a zero start time and
+        # declare the replica start_timeout-expired
         rec.started_at = time.monotonic()
+        rec.handle = self.launcher(rec.id)
+        rec.url = getattr(rec.handle, "url", None)
         self._emit(rec.id, "started", attempt=rec.restarts + 1)
 
     def start(self) -> None:
@@ -345,10 +408,17 @@ class ReplicaSet:
         """One supervision pass over every replica (called by the
         supervisor thread; callable directly for deterministic tests)."""
         now = time.monotonic()
-        for rec in list(self.replicas.values()):
+        with self.lock:  # the set resizes under scale-out/drain now
+            recs = list(self.replicas.values())
+        for rec in recs:
             with self.lock:
                 state = rec.state
                 handle, url = rec.handle, rec.url
+            if handle is None:
+                # add_replica published the record but its (slow: AOT
+                # compile) launch has not assigned the handle yet —
+                # still launching, nothing to poll or kill
+                continue
             if state == "failed":
                 continue
             if state == "evicted":
@@ -489,6 +559,89 @@ class ReplicaSet:
             if rec.state in ("evicted", "failed", "starting"):
                 return
         self._mark_died(rec, reason="router observed transport failure")
+
+    # -- elastic scale (ISSUE 12: serve/autoscaler.py drives these) --------
+
+    def add_replica(self) -> str:
+        """Scale-out: mint a NEW replica id (never reused) and launch it
+        through the same launcher seam every restart uses. The replica
+        comes up ``starting`` and enters rotation only once its
+        ``/healthz`` answers ok — warmed exactly like a restart. A
+        launcher that RAISES leaves no phantom record behind (a
+        handle-less ``starting`` corpse would hold the autoscaler's
+        warming gate forever) — the error propagates to the caller,
+        which retries on a later breach window."""
+        with self.lock:
+            rid = f"r{self._next_idx}"
+            self._next_idx += 1
+            rec = self.replicas[rid] = ReplicaRecord(rid)
+        try:
+            self._launch(rec)
+        except Exception:
+            with self.lock:
+                self.replicas.pop(rid, None)
+                rec.state = "failed"  # defuse stale tick iterations
+            raise
+        return rid
+
+    def begin_drain(self, replica_id: str) -> bool:
+        """Scale-in step 1: take a HEALTHY, non-canary replica out of
+        stateless rotation (state ``draining`` — pinned session traffic
+        still reaches it while its sessions migrate). False when the
+        replica is not in a drainable state."""
+        rec = self.replicas.get(replica_id)
+        if rec is None:
+            return False
+        with self.lock:
+            if rec.state != "healthy" or rec.canary:
+                return False
+            rec.state = "draining"
+        self._emit(replica_id, "draining")
+        return True
+
+    def abort_drain(self, replica_id: str) -> None:
+        """A drain that stalled (timeout, un-migratable session) goes
+        BACK to rotation — aborting must never drop sessions. No-op if
+        the replica left ``draining`` some other way (died mid-drain:
+        the normal evict/restart path owns it)."""
+        rec = self.replicas.get(replica_id)
+        if rec is None:
+            return
+        with self.lock:
+            if rec.state != "draining":
+                return
+            rec.state = "healthy"
+        self._emit(replica_id, "healthy")
+
+    def finish_drain(self, replica_id: str) -> bool:
+        """Scale-in terminal: remove a session-empty draining replica
+        from the set and close its handle. False if it is no longer
+        draining (died mid-drain and was evicted)."""
+        with self.lock:
+            rec = self.replicas.get(replica_id)
+            if rec is None or rec.state != "draining":
+                return False
+            del self.replicas[replica_id]
+            # defuse a stale supervisor iteration still holding this
+            # record: `failed` is skipped by tick() and _mark_died, so
+            # a removed replica can never be "relaunched" into a leak
+            rec.state = "failed"
+        self._emit(replica_id, "drained")
+        if rec.handle is not None:
+            try:
+                rec.handle.close()
+            except Exception:
+                pass
+        return True
+
+    def active_size(self) -> int:
+        """Replicas that count against the autoscaler's bounds: every
+        record except permanently-failed ones (a starting or draining
+        replica is capacity in flight, not a reason to launch more)."""
+        with self.lock:
+            return sum(
+                1 for r in self.replicas.values() if r.state != "failed"
+            )
 
     # -- the router's view -------------------------------------------------
 

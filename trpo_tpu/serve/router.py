@@ -31,6 +31,24 @@ replicas are in rotation:
   spike turns into client-visible backpressure instead of unbounded
   queueing — the MicroBatcher/StatsDrain bound-not-buffer policy one
   level up.
+* **Overload robustness** (ISSUE 12) — three admission-control layers
+  keep a brownout from amplifying into an outage: (1) a token-bucket
+  **retry budget**: past it, the transparent retry is SKIPPED, never
+  queued — a dead replica under load must not double traffic on the
+  survivors; (2) **deadline-aware admission**: a request declaring a
+  ``deadline_ms`` the observed windowed p99 (≥ ``min_latency_samples``
+  behind it) already exceeds gets an immediate typed 503
+  (``deadline_unmeetable``) instead of occupying a slot it is doomed
+  to waste; (3) the documented **shed order** — under sustained
+  saturation, stateless traffic stops being admitted a headroom of
+  slots before the hard bound, so session traffic (server-side carry
+  state, costlier to fail) sheds LAST. Every shed is counted and
+  emitted as a throttled, aggregated ``autoscale`` ``shed`` event.
+* **Elastic drain seams** (ISSUE 12) — ``serve/autoscaler.py`` grows
+  and shrinks the set from this router's own metrics; scale-in calls
+  :meth:`migrate_session` per pinned session (affinity-locked journal
+  flush → read → resume on a survivor, ``resumed: true`` on the next
+  act) so a drained replica leaves the set session-empty.
 * **Session affinity + lossless failover** (recurrent policies) —
   ``POST /session`` mints the id HERE (the router must own it to
   re-establish), registers it on the least-loaded replica, and pins
@@ -89,13 +107,25 @@ def _body(obj) -> bytes:
 
 
 class _Affinity:
-    __slots__ = ("replica", "last_used", "seq", "acts")
+    __slots__ = (
+        "replica", "last_used", "seq", "acts", "lock",
+        "pending_resumed_steps",
+    )
 
     def __init__(self, replica: str, now: float):
         self.replica = replica
         self.last_used = now
         self.seq = 0   # per-session act sequence (the dedupe stamp)
         self.acts = 0  # acts the router saw succeed (journal-lag probe)
+        # serializes this session's acts against a drain migration
+        # (ISSUE 12): an act and a carry migration interleaving could
+        # resume a stale snapshot — the lock makes either order safe.
+        # Different sessions never contend.
+        self.lock = threading.Lock()
+        # set by a completed drain migration: the NEXT act's response
+        # carries `resumed: true` + the replayed step count, so the
+        # client learns its session moved losslessly
+        self.pending_resumed_steps = None
 
 
 class Router:
@@ -111,6 +141,11 @@ class Router:
         "/act", "/session", "/healthz", "/status", "/metrics",
     )
 
+    # deadline admission judges only the last this-many seconds of
+    # latency samples — a displaced-not-expired window must not shed
+    # a recovered set on storm-era latencies
+    _ADMISSION_STALE_S = 10.0
+
     def __init__(
         self,
         replicaset,
@@ -125,6 +160,9 @@ class Router:
         journal_dir: Optional[str] = None,
         canary_fraction: float = 0.0,
         injector=None,
+        min_latency_samples: int = 16,
+        retry_budget: float = 8.0,
+        retry_refill_per_sec: float = 4.0,
     ):
         if max_inflight < 1:
             raise ValueError(
@@ -133,6 +171,16 @@ class Router:
         if not 0.0 <= canary_fraction <= 1.0:
             raise ValueError(
                 f"canary_fraction must be in [0, 1], got {canary_fraction}"
+            )
+        if min_latency_samples < 1:
+            raise ValueError(
+                f"min_latency_samples must be >= 1, got "
+                f"{min_latency_samples}"
+            )
+        if retry_budget < 0 or retry_refill_per_sec < 0:
+            raise ValueError(
+                "retry_budget and retry_refill_per_sec must be >= 0, "
+                f"got {retry_budget}/{retry_refill_per_sec}"
             )
         self.replicaset = replicaset
         self.max_inflight = int(max_inflight)
@@ -144,17 +192,57 @@ class Router:
         self.canary_fraction = float(canary_fraction)
         self.injector = injector  # serving-plane chaos (may be set late)
 
+        self.min_latency_samples = int(min_latency_samples)
+
         self.routed_total = 0       # requests answered via a replica
         self.retried_total = 0      # transparent transport retries taken
         self.failed_total = 0       # requests failed after the retry
         self.backpressure_total = 0  # 503s for saturation/empty rotation
+        # overload robustness (ISSUE 12)
+        self.retries_skipped_total = 0   # retry-budget exhaustion sheds
+        self.shed_deadline_total = 0     # un-meetable-deadline 503s
+        self.shed_stateless_total = 0    # stateless headroom refusals
         self.sessions_created_total = 0
         self.sessions_reestablished_total = 0  # failover, fresh carry
         self.sessions_resumed_total = 0        # failover, journaled carry
+        self.sessions_drained_total = 0        # lossless drain migrations
+        # retry token bucket: a dead replica under load must not DOUBLE
+        # traffic on the survivors — once the budget is spent, retries
+        # are SKIPPED (the request fails/passes through as if the retry
+        # path did not exist), never queued
+        self._retry_capacity = float(retry_budget)
+        self._retry_tokens = float(retry_budget)
+        self._retry_refill = float(retry_refill_per_sec)
+        self._retry_stamp = time.monotonic()
+        # shed order (documented in ARCHITECTURE "Elastic serving"):
+        # under sustained saturation, STATELESS traffic stops being
+        # admitted `_session_headroom` slots before the hard bound, so
+        # session traffic (carry state, costlier to fail) sheds last.
+        # Tiny bounds keep headroom 0 — backpressure semantics for
+        # small test routers are unchanged.
+        self._session_headroom = (
+            max(1, self.max_inflight // 8) if self.max_inflight >= 4
+            else 0
+        )
+        self._last_pressure = 0.0   # monotonic stamp of the last 503/shed
+        self._shed_lock = threading.Lock()
+        self._shed_counts: Dict[str, int] = {}   # reason -> pending count
+        self._shed_emitted: Dict[str, float] = {}  # reason -> last emit t
         self._lock = threading.Lock()
         self._affinity: Dict[str, _Affinity] = {}
         self._lat_lock = threading.Lock()
         self._latencies_ms: deque = deque(maxlen=latency_window)
+        # fresh-sample feed for the autoscaler: drained (swap, not
+        # scan) each control tick so its p99 window sees only NEW
+        # observations; bounded so a router without an autoscaler
+        # can't grow it
+        self._fresh_lats: deque = deque(maxlen=4096)
+        # the admission check's own TIME-expiring window of (monotonic
+        # t, ms): the big rolling window ages only by displacement, so
+        # a storm's p99 could keep shedding deadline traffic for
+        # minutes after the set recovered — admission judges the last
+        # _ADMISSION_STALE_S seconds instead
+        self._adm_lats: deque = deque(maxlen=4096)
         # per-replica rolling windows: the canary gate compares the
         # canary's p99 against the incumbents' over the same period
         self._replica_lats: Dict[str, deque] = {}
@@ -205,12 +293,20 @@ class Router:
         requests route to it on a deterministic ``canary_fraction``
         stride and everything else routes around it (sessions never
         pin to an unvalidated checkpoint). If the canary is the only
-        viable candidate it still serves — degraded beats dropped."""
+        viable candidate it still serves — degraded beats dropped.
+
+        Shed order (ISSUE 12): under sustained saturation (a 503/shed
+        within the last second), stateless requests stop being
+        admitted ``_session_headroom`` slots before the hard bound —
+        stateless traffic sheds BEFORE session traffic."""
+        bound = self.max_inflight
+        if self._headroom_active(stateless):
+            bound = self.max_inflight - self._session_headroom
         rotation = self.replicaset.in_rotation()
         with self.replicaset.lock:
             candidates = [
                 r for r in rotation
-                if r.id not in exclude and r.inflight < self.max_inflight
+                if r.id not in exclude and r.inflight < bound
             ]
             if not candidates:
                 return None
@@ -351,9 +447,14 @@ class Router:
                 rid = pinned
                 rec = self.replicaset.get(rid)
                 with self.replicaset.lock:
+                    # draining replicas still serve their PINNED
+                    # sessions — that traffic is exactly what the
+                    # drain is migrating losslessly (ISSUE 12)
                     pinned_ok = (
                         rec is not None
-                        and rec.state in ("healthy", "reloading")
+                        and rec.state in (
+                            "healthy", "reloading", "draining",
+                        )
                     )
                     if pinned_ok:
                         rec.inflight += 1
@@ -366,6 +467,18 @@ class Router:
                 if rid is None:
                     break
                 if lost_rid is not None or first_5xx is not None:
+                    # retry budget (ISSUE 12): a dead replica under
+                    # load must not DOUBLE traffic on the survivors —
+                    # past the token bucket the retry is SKIPPED, not
+                    # queued: the reservation is released and the
+                    # request resolves exactly as if no second attempt
+                    # existed (held 5xx passes through; transport loss
+                    # is a 502). The token is taken only AFTER a
+                    # target exists — a set with no survivors burns
+                    # failures, never phantom retry budget
+                    if not self._take_retry_token():
+                        self._release(rid)
+                        break
                     # the retry is COUNTED only once it actually has a
                     # second replica to go to — a single-replica death
                     # is a failure, not a phantom retry
@@ -407,6 +520,8 @@ class Router:
                 self.routed_total += 1
             with self._lat_lock:
                 self._latencies_ms.append(ms)
+                self._fresh_lats.append(ms)
+                self._adm_lats.append((time.monotonic(), ms))
                 win = self._replica_lats.get(rid)
                 if win is None:
                     win = self._replica_lats[rid] = deque(maxlen=512)
@@ -423,6 +538,8 @@ class Router:
                 self.routed_total += 1
             with self._lat_lock:
                 self._latencies_ms.append(ms)
+                self._fresh_lats.append(ms)
+                self._adm_lats.append((time.monotonic(), ms))
             self._emit_request(ms, True, retried, rid, endpoint)
             return (status, ctype, payload), rid, retried
         # no replica left to try: a reached-and-lost replica makes this
@@ -430,12 +547,132 @@ class Router:
         # otherwise it is backpressure (saturated / empty rotation)
         return None, lost_rid, retried
 
+    # -- overload robustness (ISSUE 12) ------------------------------------
+
+    # how long after the last 503/shed the stateless headroom stays
+    # armed — "sustained saturation" for the shed order
+    _PRESSURE_WINDOW_S = 1.0
+
+    def _headroom_active(self, stateless: bool) -> bool:
+        """THE shed-order predicate — one implementation for both the
+        bound ``_pick`` applies and the classification ``_unrouted``
+        reports, so shed accounting can never drift from shed
+        behavior."""
+        return (
+            stateless
+            and self._session_headroom > 0
+            and time.monotonic() - self._last_pressure
+            < self._PRESSURE_WINDOW_S
+        )
+
+    def _take_retry_token(self) -> bool:
+        """One token from the retry budget, or a counted shed. The
+        bucket refills at ``retry_refill_per_sec`` up to its capacity —
+        a sustained replica-death storm burns the burst once, then
+        sheds instead of amplifying."""
+        with self._lock:
+            now = time.monotonic()
+            self._retry_tokens = min(
+                self._retry_capacity,
+                self._retry_tokens
+                + (now - self._retry_stamp) * self._retry_refill,
+            )
+            self._retry_stamp = now
+            if self._retry_tokens >= 1.0:
+                self._retry_tokens -= 1.0
+                return True
+            self.retries_skipped_total += 1
+        self._note_shed("retry_budget_exhausted")
+        return False
+
+    def _note_shed(self, reason: str) -> None:
+        """Account one shed decision: stamp the pressure clock (the
+        shed-order signal) and emit an aggregated ``autoscale`` shed
+        event, throttled to one per reason per second so a storm's
+        thousands of sheds become a handful of counted records."""
+        now = time.monotonic()
+        self._last_pressure = now
+        if self.bus is None:
+            return
+        with self._shed_lock:
+            self._shed_counts[reason] = (
+                self._shed_counts.get(reason, 0) + 1
+            )
+            if now - self._shed_emitted.get(reason, 0.0) < 1.0:
+                return
+            count = self._shed_counts.pop(reason)
+            self._shed_emitted[reason] = now
+        try:
+            self.bus.emit(
+                "autoscale", event="shed", reason=reason, count=count,
+            )
+        except Exception:
+            pass
+
+    def _admission_check(self, body: bytes):
+        """Deadline-aware admission: a request declaring a
+        ``deadline_ms`` that the observed windowed p99 already exceeds
+        gets an immediate typed 503 instead of occupying a replica slot
+        it is doomed to waste. Judged over a TIME-expiring window (the
+        last ``_ADMISSION_STALE_S`` seconds, ≥ ``min_latency_samples``
+        deep): the big rolling window ages only by displacement, so a
+        storm's p99 would otherwise keep shedding a recovered set for
+        however long a light trickle takes to displace 4096 samples —
+        and since sheds add no samples, stale judging could livelock
+        all-deadline traffic on 503s. An empty/thin recent window
+        admits. Returns the refusal response, or None (admit)."""
+        if b'"deadline_ms"' not in body:
+            return None
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            return None  # the replica's 400 owns malformed bodies
+        if not isinstance(payload, dict):
+            # a non-object body merely CONTAINING the substring (e.g.
+            # ["deadline_ms"]) is the replica's 400, not ours
+            return None
+        deadline = payload.get("deadline_ms")
+        if not isinstance(deadline, (int, float)) or isinstance(
+            deadline, bool
+        ):
+            return None
+        from trpo_tpu.utils.metrics import quantile_nearest_rank
+
+        horizon = time.monotonic() - self._ADMISSION_STALE_S
+        with self._lat_lock:
+            while self._adm_lats and self._adm_lats[0][0] < horizon:
+                self._adm_lats.popleft()
+            lats = [ms for _, ms in self._adm_lats]
+        samples = len(lats)
+        if samples < self.min_latency_samples:
+            return None
+        p99 = quantile_nearest_rank(lats, 0.99)
+        if deadline >= p99:
+            return None
+        with self._lock:
+            self.shed_deadline_total += 1
+        self._note_shed("deadline_unmeetable")
+        self._emit_request(0.0, False, False, None, "act")
+        return 503, _JSON, _body(
+            {
+                "error": (
+                    f"deadline_ms={deadline:g} is not meetable at the "
+                    f"observed p99 ({p99:.1f} ms over {samples} "
+                    "requests) — shed instead of wasting a slot"
+                ),
+                "code": "deadline_unmeetable",
+                "p99_ms": p99,
+            }
+        )
+
     # -- handlers ----------------------------------------------------------
 
-    def _chaos_tick(self) -> None:
+    def _chaos_tick(self, path: str, body: bytes) -> None:
         """One client request entered the router: give the serving-plane
-        fault injector (``resilience/inject.py``) its trigger point.
-        A hook failure must never fail the request it rode in on."""
+        fault injector (``resilience/inject.py``) its trigger point —
+        with the triggering request's shape, so an ``overload_storm``
+        can replay realistic traffic. A hook failure must never fail
+        the request it rode in on."""
         if self.injector is None:
             return
         with self._lock:
@@ -445,12 +682,16 @@ class Router:
             self.injector.on_serve_request(
                 idx, replicaset=self.replicaset,
                 journal_dir=self.journal_dir,
+                router=self, path=path, body=body,
             )
         except Exception:
             pass
 
     def _act(self, body: bytes):
-        self._chaos_tick()
+        self._chaos_tick("/act", body)
+        shed = self._admission_check(body)
+        if shed is not None:
+            return shed
         # keep a small ring of real request bodies: the canary gate's
         # action-parity sample mirrors ACTUAL traffic to the canary and
         # an incumbent instead of guessing an obs distribution
@@ -459,7 +700,7 @@ class Router:
                                               endpoint="act")
         if result is not None:
             return result
-        return self._unrouted(rid, retried, "act")
+        return self._unrouted(rid, retried, "act", stateless=True)
 
     # -- the canary controller's probes ------------------------------------
 
@@ -478,19 +719,53 @@ class Router:
         with self._lat_lock:
             self._replica_lats.clear()
 
-    def _unrouted(self, rid, retried: bool, endpoint: str):
+    def _unrouted(self, rid, retried: bool, endpoint: str,
+                  stateless: bool = False):
         """No replica answered: 502 when we reached-and-lost replicas
-        (both attempts died), 503 backpressure otherwise."""
-        with self._lock:
-            if rid is not None:
-                self.failed_total += 1
-            else:
-                self.backpressure_total += 1
-        self._emit_request(0.0, False, retried, rid, endpoint)
+        (both attempts died), 503 backpressure otherwise — typed
+        ``shed_stateless`` when the refusal came from the shed-order
+        headroom (a session request would still have been admitted)."""
         if rid is not None:
+            with self._lock:
+                self.failed_total += 1
+            self._emit_request(0.0, False, retried, rid, endpoint)
             return 502, _JSON, _body(
                 {"error": "replica died mid-request and the retry "
                           "failed or had no replica to go to"}
+            )
+        # did only the stateless headroom block this? Judged under the
+        # SAME predicate _pick applied the reduced bound with
+        # (_headroom_active) — a cold-clock saturation refusal where a
+        # slot happened to free between pick and here must stay a
+        # plain backpressure, not arm the pressure clock off a misread
+        headroom_shed = False
+        if self._headroom_active(stateless):
+            rotation = self.replicaset.in_rotation()
+            with self.replicaset.lock:
+                # some replica still under the HARD bound = a session
+                # request would have been admitted
+                headroom_shed = any(
+                    r.inflight < self.max_inflight for r in rotation
+                )
+        with self._lock:
+            if headroom_shed:
+                self.shed_stateless_total += 1
+            else:
+                self.backpressure_total += 1
+        self._note_shed(
+            "stateless_headroom" if headroom_shed else "backpressure"
+        )
+        self._emit_request(0.0, False, retried, rid, endpoint)
+        if headroom_shed:
+            return 503, _JSON, _body(
+                {
+                    "error": (
+                        "stateless traffic shed under sustained "
+                        "saturation (session traffic sheds last) — "
+                        "retry"
+                    ),
+                    "code": "shed_stateless",
+                }
             )
         snap = self.replicaset.snapshot()
         saturated = snap["healthy"] > 0
@@ -585,13 +860,22 @@ class Router:
         except Exception:
             return None
 
-    def _reestablish(self, sid: str, aff, entry):
+    def _reestablish(self, sid: str, aff, entry, strict: bool = False,
+                     drain: bool = False):
         """Re-create the session on a healthy replica — from the
         journaled ``entry`` when one exists (RESUME: carry + steps +
         dedupe state travel), from a fresh carry otherwise. Returns
         ``(ok, rid, resumed)``; on success the affinity is re-pinned
         (the seq counter is NEVER reset — dedupe continuity across the
-        failover is the exactly-once guarantee)."""
+        failover is the exactly-once guarantee).
+
+        ``strict`` (the drain path): a refused journal entry must FAIL
+        instead of degrading to a fresh carry — a drain is lossless or
+        it aborts; only a real failover may trade state for liveness.
+        ``drain`` books the move as a PLANNED migration — counter
+        ``sessions_drained_total`` and a ``session:drained`` event —
+        so scale-in moves never inflate the failover-quality metrics
+        (resumed_fraction compares crash outcomes only)."""
         create = {"session_id": sid}
         resumed = entry is not None
         if resumed:
@@ -605,7 +889,10 @@ class Router:
             stateless=False,
         )
         if result is None or result[0] != 200:
-            if resumed and result is not None and result[0] == 400:
+            if (
+                resumed and not strict
+                and result is not None and result[0] == 400
+            ):
                 # a journaled entry the new replica refuses (e.g. carry
                 # width from an incompatible incarnation) must degrade
                 # to the fresh-carry path, not fail the client
@@ -616,7 +903,9 @@ class Router:
         with self._lock:
             aff.replica = rid
             aff.last_used = time.monotonic()
-            if resumed:
+            if drain:
+                self.sessions_drained_total += 1
+            elif resumed:
                 self.sessions_resumed_total += 1
             else:
                 self.sessions_reestablished_total += 1
@@ -624,7 +913,8 @@ class Router:
             try:
                 if resumed:
                     self.bus.emit(
-                        "session", session=sid, event="resumed",
+                        "session", session=sid,
+                        event="drained" if drain else "resumed",
                         replica=rid, steps=int(entry["steps"]),
                         lag=max(0, aff.acts - int(entry["steps"])),
                     )
@@ -637,8 +927,104 @@ class Router:
                 pass
         return True, rid, resumed
 
+    # -- the autoscaler's drain protocol (ISSUE 12) ------------------------
+
+    def sessions_pinned_to(self, replica_id: str) -> list:
+        """Session ids whose affinity currently points at one replica —
+        the drain's work list."""
+        with self._lock:
+            return [
+                sid for sid, aff in self._affinity.items()
+                if aff.replica == replica_id
+            ]
+
+    def _flush_replica_journal(
+        self, replica_id: str, sid: Optional[str] = None
+    ):
+        """``POST /drain`` on the replica: the named session (or, with
+        ``sid=None``, every live session) journaled NOW and the
+        write-behind flushed, so the journal file the migration is
+        about to read is CURRENT. Per-session targeting keeps a drain
+        of S sessions O(S), not O(S²). Returns True (flushed), None
+        (the replica answered but does not KNOW the session — expired:
+        no live state to move), or False (transport/flush failure)."""
+        body = b"{}" if sid is None else _body({"session": sid})
+        try:
+            status, payload = self._forward(replica_id, "/drain", body)
+        except Exception:
+            return False
+        if status != 200:
+            return False
+        try:
+            out = json.loads(payload)
+        except ValueError:
+            return False
+        if not isinstance(out, dict):
+            # a --replica-cmd-wrapped server may answer 200 with a
+            # non-object body: a flush failure, never an AttributeError
+            return False
+        if out.get("ok"):
+            return True
+        if sid is not None and out.get("known") is False:
+            return None
+        return False
+
+    def forget_drained_sessions(self, replica_id: str, sids) -> None:
+        """Best-effort: the victim drops sessions the drain already
+        resumed elsewhere (store removal + journal tombstones). A
+        failure here never un-does the migration — the sessions live
+        on the survivors either way."""
+        try:
+            self._forward(
+                replica_id, "/drain", _body({"forget": list(sids)})
+            )
+        except Exception:
+            pass
+
+    def migrate_session(self, sid: str, from_replica: str):
+        """Move ONE session off a draining replica, losslessly: under
+        the session's affinity lock (no act can interleave), flush the
+        victim's journal, read the session's CURRENT entry, and resume
+        it on a survivor with carry + steps + seq-dedupe state intact.
+        The next act's response says ``resumed: true``.
+
+        Returns True (moved), None (no longer pinned there — nothing
+        to do), or False (could not move LOSSLESSLY: no journal, flush
+        failed, or every survivor refused — the drain must abort)."""
+        with self._lock:
+            aff = self._affinity.get(sid)
+        if aff is None:
+            return None
+        with aff.lock:
+            if aff.replica != from_replica:
+                return None  # a concurrent failover already moved it
+            if self.journal_dir is None:
+                return False
+            flushed = self._flush_replica_journal(from_replica, sid)
+            if flushed is False:
+                return False
+            entry = self._journal_lookup(from_replica, sid)
+            if entry is None:
+                if flushed is None:
+                    # no live state on the victim AND nothing journaled:
+                    # the session is dead (TTL-expired) — drop the stale
+                    # pin so it cannot wedge the drain; the client's
+                    # next act gets the same session_unknown 404 it
+                    # would have gotten anyway
+                    with self._lock:
+                        self._affinity.pop(sid, None)
+                    return None
+                return False
+            ok, rid, resumed = self._reestablish(
+                sid, aff, entry, strict=True, drain=True
+            )
+            if ok is not True or not resumed:
+                return False
+            aff.pending_resumed_steps = int(entry["steps"])
+            return True
+
     def _session_act(self, path: str, body: bytes):
-        self._chaos_tick()
+        self._chaos_tick(path, body)
         parts = path.strip("/").split("/")
         if len(parts) != 3 or parts[0] != "session" or parts[2] != "act":
             return 404, _JSON, _body(
@@ -646,18 +1032,35 @@ class Router:
                           "/session/<id>/act"}
             )
         sid = parts[1]
-        with self._lock:
-            aff = self._affinity.get(sid)
-        if aff is None:
-            return 404, _JSON, _body(
-                {
-                    "error": (
-                        f"unknown session {sid!r} — mint one with "
-                        "POST /session"
-                    ),
-                    "code": "session_unknown",
-                }
-            )
+        # the session's affinity lock serializes this act against a
+        # drain migration (and against sibling acts on the SAME
+        # session — the replica's per-session lock did that anyway):
+        # an act must run entirely before or entirely after its
+        # session moves, never interleaved with the carry snapshot.
+        # After acquiring it, RE-validate the entry: a drain that ran
+        # while we waited may have dropped a dead session's pin —
+        # acting on the orphaned object would mint an unreachable
+        # replacement and answer a success the next act contradicts
+        while True:
+            with self._lock:
+                aff = self._affinity.get(sid)
+            if aff is None:
+                return 404, _JSON, _body(
+                    {
+                        "error": (
+                            f"unknown session {sid!r} — mint one with "
+                            "POST /session"
+                        ),
+                        "code": "session_unknown",
+                    }
+                )
+            with aff.lock:
+                with self._lock:
+                    if self._affinity.get(sid) is not aff:
+                        continue  # replaced/removed while we waited
+                return self._session_act_pinned(sid, aff, body)
+
+    def _session_act_pinned(self, sid: str, aff, body: bytes):
         # stamp the per-session sequence number: the replica dedupes a
         # replay of an already-applied seq (the retry-idempotency
         # contract) — an unparseable body forwards untouched and takes
@@ -717,12 +1120,26 @@ class Router:
         if status == 200:
             with self._lock:
                 aff.acts += 1
+        resumed_steps = int(entry["steps"]) if resumed else None
+        if status == 200 and aff.pending_resumed_steps is not None:
+            pending = aff.pending_resumed_steps
+            aff.pending_resumed_steps = None  # consumed either way
+            if not (resumed or reestablished):
+                # a drain moved this session since its last act: tell
+                # the client once, exactly like a failover resume
+                # would. If THIS act itself failed over (the survivor
+                # died too), that outcome's own flags win — claiming
+                # "resumed at the drain-era step" over a fresh-carry
+                # reestablish would be exactly the mislead the
+                # resumed/reestablished discriminator exists to stop
+                resumed = True
+                resumed_steps = pending
         if status != 200 or not (resumed or reestablished):
             return status, ctype, payload
         out = json.loads(payload)
         if resumed:
             out["resumed"] = True
-            out["resumed_steps"] = int(entry["steps"])
+            out["resumed_steps"] = resumed_steps
         else:
             out["reestablished"] = True
         return status, _JSON, _body(out)
@@ -748,13 +1165,17 @@ class Router:
                 "retried_total": self.retried_total,
                 "failed_total": self.failed_total,
                 "backpressure_total": self.backpressure_total,
+                "retries_skipped_total": self.retries_skipped_total,
+                "shed_deadline_total": self.shed_deadline_total,
+                "shed_stateless_total": self.shed_stateless_total,
                 "sessions": len(self._affinity),
                 "sessions_created_total": self.sessions_created_total,
                 "sessions_reestablished_total":
                     self.sessions_reestablished_total,
                 "sessions_resumed_total": self.sessions_resumed_total,
+                "sessions_drained_total": self.sessions_drained_total,
             }
-        q = self.latency_quantiles_ms((0.5, 0.99))
+        q, samples = self.latency_window((0.5, 0.99))
         return 200, _JSON, _body(
             {
                 "replicas": snap["replicas"],
@@ -762,17 +1183,37 @@ class Router:
                 "size": snap["size"],
                 "counters": counters,
                 "latency_ms": {str(k): v for k, v in q.items()},
+                # always alongside the quantiles: a 3-request "p99" must
+                # never be read as a measurement (ISSUE 12 satellite)
+                "latency_samples": samples,
             }
         )
 
     def latency_quantiles_ms(self, qs=(0.5, 0.99)) -> dict:
+        return self.latency_window(qs)[0]
+
+    def latency_window(self, qs=(0.5, 0.99)):
+        """``(quantiles, samples)`` over the rolling latency window.
+        The quantiles are computed over HOWEVER many samples exist —
+        but ``samples`` rides along so no consumer (the autoscaler,
+        the admission check, an operator reading /status) ever
+        mistakes a 3-request "p99" for a measurement."""
         from trpo_tpu.utils.metrics import quantile_nearest_rank
 
         with self._lat_lock:
             lats = list(self._latencies_ms)
         if not lats:
-            return {}
-        return {q: quantile_nearest_rank(lats, q) for q in qs}
+            return {}, 0
+        return {q: quantile_nearest_rank(lats, q) for q in qs}, len(lats)
+
+    def take_fresh_latencies(self) -> list:
+        """Drain (swap out) the latencies observed since the last call
+        — the autoscaler's per-tick feed, so its own time-expiring
+        window sees each observation exactly once."""
+        with self._lat_lock:
+            fresh = list(self._fresh_lats)
+            self._fresh_lats.clear()
+        return fresh
 
     def _metrics(self):
         from trpo_tpu.serve.replicaset import RECORD_STATES
@@ -863,6 +1304,18 @@ class Router:
                 ("trpo_router_backpressure_total",
                  "503s for saturation or empty rotation",
                  self.backpressure_total),
+                ("trpo_router_retries_skipped_total",
+                 "retries shed by the exhausted retry budget (a dead "
+                 "replica under load must not double traffic)",
+                 self.retries_skipped_total),
+                ("trpo_router_shed_deadline_total",
+                 "immediate 503s for requests whose deadline_ms the "
+                 "observed p99 already exceeded",
+                 self.shed_deadline_total),
+                ("trpo_router_shed_stateless_total",
+                 "stateless requests shed by the saturation headroom "
+                 "(session traffic sheds last)",
+                 self.shed_stateless_total),
                 ("trpo_router_sessions_created_total",
                  "sessions minted through the router",
                  self.sessions_created_total),
@@ -874,6 +1327,10 @@ class Router:
                  "sessions resumed from a journaled carry after "
                  "replica death (lossless failover)",
                  self.sessions_resumed_total),
+                ("trpo_router_sessions_drained_total",
+                 "sessions moved losslessly off a draining replica "
+                 "(elastic scale-in)",
+                 self.sessions_drained_total),
             ]
             sessions_live = len(self._affinity)
         for name, help_, value in counter_rows:
@@ -882,20 +1339,45 @@ class Router:
             "trpo_router_sessions_active", "gauge",
             "sessions with live affinity", [({}, sessions_live)],
         )
+        quantiles, lat_samples = self.latency_window((0.5, 0.99))
         fam(
             "trpo_router_latency_ms", "gauge",
             "routed-request latency quantiles over the recent window",
             [
                 ({"quantile": str(q)}, v)
-                for q, v in sorted(
-                    self.latency_quantiles_ms((0.5, 0.99)).items()
-                )
+                for q, v in sorted(quantiles.items())
             ],
+        )
+        fam(
+            "trpo_router_latency_window_samples", "gauge",
+            "samples behind the latency quantiles (a 3-request p99 is "
+            "not a measurement — consumers gate on this)",
+            [({}, lat_samples)],
         )
         body = ("\n".join(lines) + "\n").encode()
         return 200, "text/plain; version=0.0.4; charset=utf-8", body
 
+    def _flush_shed_counts(self) -> None:
+        """Emit whatever the per-reason throttle still holds: a burst's
+        tail accumulates waiting for a NEXT same-reason shed that may
+        never come — without this flush the log would undercount sheds
+        vs the counters (close() calls it; the analyze/compare rows
+        depend on the totals matching)."""
+        if self.bus is None:
+            return
+        with self._shed_lock:
+            pending, self._shed_counts = self._shed_counts, {}
+        for reason, count in pending.items():
+            try:
+                self.bus.emit(
+                    "autoscale", event="shed", reason=reason,
+                    count=count,
+                )
+            except Exception:
+                pass
+
     def close(self) -> None:
+        self._flush_shed_counts()
         httpd, self._httpd = self._httpd, None
         if httpd is not None:
             httpd.close()

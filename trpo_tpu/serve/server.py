@@ -105,7 +105,9 @@ class PolicyServer:
     replica dies.
     """
 
-    ENDPOINTS = ("/act", "/session", "/healthz", "/metrics", "/reload")
+    ENDPOINTS = (
+        "/act", "/session", "/healthz", "/metrics", "/reload", "/drain",
+    )
 
     def __init__(
         self,
@@ -176,6 +178,7 @@ class PolicyServer:
         self._watcher: Optional[threading.Thread] = None
         self._reloading = False  # True while a restore+load is in flight
         self._stall_until = 0.0  # chaos: acts sleep past this deadline
+        self._slow_ms = 0.0      # chaos: persistent per-act latency
         self.sessions = None
         if self.is_recurrent:
             from trpo_tpu.serve.session import (
@@ -221,6 +224,7 @@ class PolicyServer:
                 "/act": self._act,
                 "/session": self._session_create,
                 "/reload": self._reload_cmd,
+                "/drain": self._drain_cmd,
             },
             post_prefix={"/session/": self._session_act},
             not_found=(
@@ -393,7 +397,77 @@ class PolicyServer:
             {"ok": ok, "step": loaded}
         )
 
-    # -- chaos seam (resilience/inject.py stall_replica) -------------------
+    def _drain_cmd(self, body: bytes):
+        """``POST /drain`` — the lossless scale-in control route (ISSUE
+        12, driven by ``serve/autoscaler.py`` through the router):
+
+        * empty body / ``{}`` — snapshot EVERY live session into the
+          carry journal regardless of ``sync_every`` cadence and block
+          until the write-behind drain has flushed to disk, so the
+          caller's next journal read is CURRENT (the bit-exact
+          migration contract). Answers the live session count.
+        * ``{"session": sid}`` — snapshot just ONE session (the
+          per-session migration path: a whole-store snapshot per
+          migrated session would make a drain O(sessions²)).
+        * ``{"forget": [sids]}`` — the caller has resumed these
+          sessions elsewhere: remove them from the store and tombstone
+          their journal entries (a later failover must resume from the
+          SURVIVOR's journal, never this replica's stale one).
+
+        Feedforward replicas answer trivially (no sessions to move) —
+        a drain of a stateless replica is just the inflight wind-down
+        the router already owns."""
+        if self.sessions is None:
+            return 200, _JSON, _json_body({"ok": True, "sessions": 0})
+        try:
+            payload = json.loads(body) if body else {}
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            forget = payload.get("forget")
+            if forget is not None and (
+                not isinstance(forget, list)
+                or not all(isinstance(s, str) for s in forget)
+            ):
+                raise ValueError('"forget" must be a list of session ids')
+            one = payload.get("session")
+            if one is not None and not isinstance(one, str):
+                raise ValueError('"session" must be a session id')
+        except ValueError as e:
+            return 400, _JSON, _json_body(
+                {"error": f'body must be empty, {{"session": sid}} or '
+                          f'{{"forget": [...]}} ({e})'}
+            )
+        if forget is not None:
+            removed = sum(
+                1 for sid in forget if self.sessions.remove(sid)
+            )
+            return 200, _JSON, _json_body(
+                {"ok": True, "forgotten": removed,
+                 "sessions": len(self.sessions)}
+            )
+        if one is not None:
+            flushed = self.sessions.sync_one(one)
+            # `known` lets the drain distinguish "no live state to
+            # move" (expired/unknown here — nothing to lose) from a
+            # flush FAILURE (state exists but did not land — abort)
+            known = self.sessions.get(one) is not None
+            return 200, _JSON, _json_body(
+                {"ok": flushed, "known": known,
+                 "sessions": len(self.sessions)}
+            )
+        flushed = self.sessions.sync_all()
+        return 200, _JSON, _json_body(
+            {"ok": flushed, "sessions": len(self.sessions)}
+        )
+
+    # -- chaos seams (resilience/inject.py stall_/slow_replica) ------------
+
+    def slow(self, ms: float) -> None:
+        """Persistent latency injection (``slow_replica``): every act
+        from now on pays an extra ``ms`` — a degraded device, not a
+        wedge; health checks answer at full speed, so detection must
+        come from the latency metrics (p99 breach → autoscale/evict)."""
+        self._slow_ms = float(ms)
 
     def stall(self, seconds: float) -> None:
         """Make every act on this replica sleep until ``seconds`` from
@@ -404,6 +478,8 @@ class PolicyServer:
         self._stall_until = time.monotonic() + float(seconds)
 
     def _maybe_stall(self) -> None:
+        if self._slow_ms > 0:
+            time.sleep(self._slow_ms / 1e3)
         delay = self._stall_until - time.monotonic()
         if delay > 0:
             time.sleep(delay)

@@ -690,6 +690,55 @@ class SessionStore:
         if self.journal is not None:
             self.journal.forget(sid)
 
+    def sync_one(self, session_id: str, timeout: float = 10.0) -> bool:
+        """Targeted drain-protocol snapshot: journal ONE session now
+        (under its lock) and block until flushed. The per-session
+        migration path uses this — journaling the whole store once per
+        migrated session would make a drain O(sessions²). False =
+        unknown session, journal off, or the flush did not land."""
+        if self.journal is None:
+            return False
+        with self._lock:
+            sess = self._sessions.get(session_id)
+        if sess is None:
+            return False
+        with sess.lock:
+            self.journal_session(session_id, sess)
+        return self.journal.drain(timeout)
+
+    def sync_all(self, timeout: float = 10.0) -> bool:
+        """Drain-protocol snapshot (ISSUE 12): journal EVERY live
+        session NOW — regardless of the ``sync_every`` cadence — and
+        block until the write-behind drain has flushed to disk, so a
+        reader of the journal file sees every session's CURRENT carry.
+        Each snapshot is taken under its session's lock (no torn
+        steps/carry pair vs a concurrent act). True when the flush
+        landed within ``timeout``; False (journal off, or the writer
+        wedged) means the caller must NOT treat the file as current."""
+        if self.journal is None:
+            return False
+        with self._lock:
+            live = list(self._sessions.items())
+        for sid, sess in live:
+            with sess.lock:
+                self.journal_session(sid, sess)
+        return self.journal.drain(timeout)
+
+    def remove(self, session_id: str) -> bool:
+        """Drop one session the caller has RESUMED ELSEWHERE (the drain
+        protocol's forget step): removed from the store and its journal
+        entry tombstoned — a later failover must resume from the
+        survivor's journal, never this replica's stale copy. Silent (no
+        ``session`` event): the migration itself already emitted
+        ``session:drained``; an eviction event here would double-count
+        the move as a loss."""
+        with self._lock:
+            sess = self._sessions.pop(session_id, None)
+        if sess is None:
+            return False
+        self._forget_journal(session_id)
+        return True
+
     def get(self, session_id: str) -> Optional[_Session]:
         """The live session, refreshed to most-recently-used — or None
         (unknown, or just now found expired and dropped)."""
