@@ -572,9 +572,13 @@ def main(argv=None) -> int:
     from trpo_tpu.serve import Autoscaler
 
     class _SlowEngine:
-        """A 50 ms GIL-free act cost on top of the real engine:
-        capacity-limited replicas, the regime where elasticity pays
-        (the serving_scale bench's SimulatedCostEngine calibration)."""
+        """A 50 ms GIL-free per-DISPATCH cost on top of the real
+        engine: capacity-limited replicas, the regime where elasticity
+        pays (the serving_scale bench's SimulatedCostEngine
+        calibration). Worn by BOTH stepping paths — the server now
+        dispatches session acts through the batched epoch plane
+        (ISSUE 13), so the cost must ride step_batch or the storm
+        would run against a free engine."""
 
         def __init__(self, inner, sleep_s=0.05):
             self._inner = inner
@@ -583,6 +587,12 @@ def main(argv=None) -> int:
         def step(self, carry, obs, return_step=False):
             time.sleep(self._sleep)
             return self._inner.step(carry, obs, return_step=return_step)
+
+        def step_batch(self, carries, obs, return_step=False):
+            time.sleep(self._sleep)
+            return self._inner.step_batch(
+                carries, obs, return_step=return_step
+            )
 
         def __getattr__(self, name):
             return getattr(self._inner, name)

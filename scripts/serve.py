@@ -218,6 +218,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="bounded session store size per replica (default 1024)",
     )
     p.add_argument(
+        "--session-batch-shapes",
+        help="comma-separated AOT session-step rung ladder (default: "
+        "config's, 1,8,64): concurrent sessions' carries gather into "
+        "ONE (N, carry) dispatch padded up to the nearest rung "
+        "(continuous batching) instead of serializing batch-1 steps",
+    )
+    p.add_argument(
+        "--session-deadline-ms", type=float,
+        help="session epoch coalescing budget (default 3): an epoch "
+        "dispatches when it reaches the top session rung or when the "
+        "oldest queued act has waited half of this",
+    )
+    p.add_argument(
         "--carry-sync-every", type=int,
         help="journal a session's carry every N applied steps (default "
         "1 = lossless failover whenever the write-behind drain has "
@@ -360,6 +373,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         updates["serve_replica_restarts"] = args.replica_restarts
     if args.max_inflight is not None:
         updates["serve_max_inflight"] = args.max_inflight
+    if args.session_batch_shapes:
+        updates["serve_session_batch_shapes"] = tuple(
+            int(s)
+            for s in args.session_batch_shapes.split(",")
+            if s.strip()
+        )
+    if args.session_deadline_ms is not None:
+        updates["serve_session_deadline_ms"] = args.session_deadline_ms
     if args.session_ttl is not None:
         updates["serve_session_ttl"] = args.session_ttl
     if args.max_sessions is not None:
@@ -529,6 +550,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             managed_reload=canary,
             initial_step=incumbent["step"],
             injector=injector,
+            session_deadline_ms=cfg.serve_session_deadline_ms,
+            session_adaptive_deadline=cfg.serve_adaptive_deadline,
         )
         closers = ([batcher] if batcher is not None else []) + [
             checkpointer

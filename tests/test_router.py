@@ -781,12 +781,17 @@ def test_serve_cli_replica_and_session_flags():
         "--max-inflight", "8", "--health-interval", "0.2",
         "--replica-restarts", "5",
         "--run-descriptor", "/tmp/run.json",
+        "--session-batch-shapes", "1,8,32",
+        "--session-deadline-ms", "2.5",
     ])
     assert args.replicas == 3
     assert args.policy_gru == 16 and args.policy_cell == "lstm"
     assert args.session_ttl == 30.0 and args.max_sessions == 64
     assert args.max_inflight == 8 and args.replica_restarts == 5
     assert args.run_descriptor == "/tmp/run.json"
+    # continuous-batching flags (ISSUE 13) parse into the config fields
+    assert args.session_batch_shapes == "1,8,32"
+    assert args.session_deadline_ms == 2.5
 
 
 @pytest.mark.slow  # spawns a real serve.py subprocess (jax import ~10s);

@@ -1,0 +1,654 @@
+"""Continuous batching for recurrent serving (ISSUE 13).
+
+Contracts pinned here:
+
+* **Bit-exactness**: N sessions stepped through batched gather/scatter
+  epochs produce IDENTICAL actions and carries to the same sessions
+  stepped sequentially at batch 1 — including epochs that pad to a
+  rung (padding rows are masked by construction: row i is a pure
+  function of row i) and a mid-stream checkpoint hot reload. The
+  mechanism: the wide torso/cell matmuls are batch-width-invariant
+  per row, and the narrow action head — the one width-sensitive op —
+  is recomputed per row inside the program as the exact batch-1
+  head the training act path runs (``models/recurrent.py``'s exposed
+  ``head``).
+* **Zero steady-state retraces** across every epoch-width change and
+  a hot swap (the AOT rung ladder — the recompile-monitor pin the
+  feedforward engine already carries).
+* **SessionBatcher semantics**: one sid never rides twice in one
+  epoch (holdback preserves arrival order), errors fail exactly the
+  dispatched epoch, the latency window stays BOUNDED no matter how
+  many requests pass (the MicroBatcher fix rides along), and the
+  epoch gauges are on ``/metrics``.
+* **Failover interplay**: a replica killed MID-EPOCH (engine wedged
+  with acts in flight) journals nothing torn — the journal resumes
+  the pre-epoch state and the retried acts replay bit-exact; a drain
+  (``sync_all``) under concurrent batched stepping flushes every
+  live session's current carry.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.serve import (
+    MicroBatcher,
+    PolicyServer,
+    SessionBatcher,
+    SimulatedCostSessionEngine,
+)
+from trpo_tpu.serve.session import read_carry_journal
+
+_CFG = dict(
+    n_envs=4, batch_timesteps=32, cg_iters=2, vf_train_steps=2,
+    policy_hidden=(8,), vf_hidden=(8,), seed=11, policy_gru=8,
+    serve_session_batch_shapes=(1, 4),
+)
+
+
+@pytest.fixture(scope="module")
+def rec():
+    agent = TRPOAgent("pendulum", TRPOConfig(**_CFG))
+    state = agent.init_state(seed=0)
+    return agent, state
+
+
+def _post(url, payload=None, timeout=30.0):
+    data = b"" if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _sequential_reference(engine, obs_per_session):
+    """Each session stepped alone at batch 1 through the SAME engine —
+    the serialized baseline the batched epoch must match bit-for-bit."""
+    out = []
+    for obs_seq in obs_per_session:
+        carry = engine.initial_carry()
+        acts = []
+        for o in obs_seq:
+            a, carry = engine.step(carry, o)
+            acts.append(np.asarray(a))
+        out.append((acts, carry))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# engine: batched step ladder
+# ---------------------------------------------------------------------------
+
+
+def test_batched_epoch_bit_exact_vs_sequential_with_hot_reload(rec):
+    """The ISSUE 13 acceptance pin: 5 sessions (padding rung 4 twice —
+    widths 5 → [4, 1]... exercised as one width-5 call chunking at the
+    top rung AND a width-3 call padding to 4), stepped through batched
+    epochs, match sequential batch-1 stepping exactly — actions AND
+    carries — including across a mid-stream checkpoint hot reload."""
+    agent, state = rec
+    engine = agent.serve_session_engine()
+    engine.load(state.policy_params, state.obs_norm, step=0)
+    state2 = agent.init_state(seed=7)
+
+    rng = np.random.RandomState(0)
+    S, T = 5, 6
+    obs = [
+        [rng.randn(*agent.obs_shape).astype(np.float32) for _ in range(T)]
+        for _ in range(S)
+    ]
+    swap_at = 3
+
+    # batched: one (S, carry) epoch per timestep, hot swap mid-stream
+    carries = np.stack([engine.initial_carry() for _ in range(S)])
+    batched_acts = [[] for _ in range(S)]
+    for t in range(T):
+        if t == swap_at:
+            engine.load(state2.policy_params, state2.obs_norm, step=1)
+        stacked = np.stack([obs[i][t] for i in range(S)])
+        acts, carries, step = engine.step_batch(
+            carries, stacked, return_step=True
+        )
+        assert step == (0 if t < swap_at else 1)
+        for i in range(S):
+            batched_acts[i].append(np.asarray(acts[i]))
+
+    # sequential reference: same engine, batch-1, same swap point
+    seq_acts = [[] for _ in range(S)]
+    seq_carries = []
+    for i in range(S):
+        engine.load(state.policy_params, state.obs_norm, step=0)
+        carry = engine.initial_carry()
+        for t in range(T):
+            if t == swap_at:
+                engine.load(state2.policy_params, state2.obs_norm, step=1)
+            a, carry = engine.step(carry, obs[i][t])
+            seq_acts[i].append(np.asarray(a))
+        seq_carries.append(carry)
+
+    for i in range(S):
+        for t in range(T):
+            np.testing.assert_array_equal(
+                batched_acts[i][t], seq_acts[i][t],
+                err_msg=f"session {i} step {t}",
+            )
+        np.testing.assert_array_equal(carries[i], seq_carries[i])
+    # widths 5 (chunk: 4+1) were exercised against the rung-4 program
+    assert engine.shape_counts.get(4, 0) > 0
+    assert engine.shape_counts.get(1, 0) > 0
+
+
+def test_padding_rows_are_masked(rec):
+    """Row i of a padded epoch is independent of the co-batched rows
+    AND of the zero padding — the same rung with different companions
+    gives bit-identical per-row results."""
+    agent, state = rec
+    engine = agent.serve_session_engine()
+    engine.load(state.policy_params, state.obs_norm, step=0)
+    rng = np.random.RandomState(1)
+    c = rng.randn(4, engine.state_size).astype(np.float32)
+    o = rng.randn(4, *agent.obs_shape).astype(np.float32)
+    a_pad, c_pad, _ = engine.step_batch(c[:2], o[:2], return_step=True)
+    a_full, c_full, _ = engine.step_batch(c, o, return_step=True)
+    np.testing.assert_array_equal(np.asarray(a_pad), np.asarray(a_full)[:2])
+    np.testing.assert_array_equal(c_pad, c_full[:2])
+
+
+def test_step_batch_rejects_bad_shapes(rec):
+    agent, state = rec
+    engine = agent.serve_session_engine()
+    engine.load(state.policy_params, state.obs_norm, step=0)
+    good_c = np.zeros((2, engine.state_size), np.float32)
+    good_o = np.zeros((2,) + engine.obs_shape, np.float32)
+    with pytest.raises(ValueError, match="carries must be"):
+        engine.step_batch(np.zeros((2, 99), np.float32), good_o)
+    with pytest.raises(ValueError, match="obs must be"):
+        engine.step_batch(good_c, np.zeros((2, 99), np.float32))
+    with pytest.raises(ValueError, match="disagree"):
+        engine.step_batch(good_c, np.zeros((3,) + engine.obs_shape,
+                                           np.float32))
+    with pytest.raises(ValueError, match="at least one session"):
+        engine.step_batch(
+            np.zeros((0, engine.state_size), np.float32),
+            np.zeros((0,) + engine.obs_shape, np.float32),
+        )
+    with pytest.raises(ValueError, match="batch_shapes"):
+        agent.serve_session_engine(batch_shapes=(0, 4))
+
+
+def test_zero_retraces_across_epoch_widths_and_hot_swap(rec):
+    from trpo_tpu.obs.recompile import RecompileMonitor
+
+    agent, state = rec
+    engine = agent.serve_session_engine()
+    rng = np.random.RandomState(3)
+    mon = RecompileMonitor()
+    with mon:
+        engine.load(state.policy_params, state.obs_norm, step=0)
+        mon.mark_steady()  # the AOT rung ladder is the ONLY compilation
+        for _ in range(2):
+            for n in (1, 2, 3, 4, 5, 9):  # every width class incl. chunking
+                engine.step_batch(
+                    rng.randn(n, engine.state_size).astype(np.float32),
+                    rng.randn(n, *agent.obs_shape).astype(np.float32),
+                )
+        state2 = agent.init_state(seed=2)
+        engine.load(state2.policy_params, state2.obs_norm, step=1)
+        engine.step_batch(
+            rng.randn(3, engine.state_size).astype(np.float32),
+            rng.randn(3, *agent.obs_shape).astype(np.float32),
+        )
+    assert mon.unexpected_retraces() == {}
+    assert engine.loaded_step == 1
+
+
+# ---------------------------------------------------------------------------
+# SessionBatcher (no HTTP)
+# ---------------------------------------------------------------------------
+
+
+def test_session_batcher_gathers_and_scatters(rec):
+    from trpo_tpu.obs.events import EventBus, validate_event
+
+    agent, state = rec
+    engine = agent.serve_session_engine()
+    engine.load(state.policy_params, state.obs_norm, step=0)
+    events = []
+    bus = EventBus(lambda r: events.append(r))
+    batcher = SessionBatcher(engine, deadline_ms=20.0, bus=bus)
+    try:
+        rng = np.random.RandomState(5)
+        obs = [rng.randn(*agent.obs_shape).astype(np.float32)
+               for _ in range(4)]
+        futures = [
+            batcher.submit(f"s{i}", engine.initial_carry(), obs[i])
+            for i in range(4)
+        ]
+        results = [f.result(timeout=30.0) for f in futures]
+        ref = _sequential_reference(engine, [[o] for o in obs])
+        for i, (action, carry, step) in enumerate(results):
+            assert step == 0
+            np.testing.assert_array_equal(action, ref[i][0][0])
+            np.testing.assert_array_equal(carry, ref[i][1])
+        assert batcher.epochs_total >= 1
+        assert batcher.epoch_width_last >= 1
+        assert batcher.requests_total == 4
+        # the epoch emits the SAME schema-valid `serve` record the
+        # stateless micro-batcher does — which is what routes a
+        # session-batched run through the EXISTING analyze/compare
+        # serving gate (p50/p99 time-like, actions/s rate-like)
+        serve_events = [e for e in events if e["kind"] == "serve"]
+        assert serve_events
+        for e in serve_events:
+            assert validate_event(e) == [], e
+        assert sum(e["requests"] for e in serve_events) == 4
+    finally:
+        batcher.close()
+
+
+def test_session_batcher_same_sid_never_shares_an_epoch(rec):
+    """Two waiting entries for ONE session must land in different
+    epochs in arrival order (the second would read a stale carry
+    inside one program)."""
+    agent, state = rec
+    engine = agent.serve_session_engine()
+    engine.load(state.policy_params, state.obs_norm, step=0)
+    # long deadline: both submissions are queued before dispatch
+    batcher = SessionBatcher(engine, deadline_ms=500.0)
+    try:
+        rng = np.random.RandomState(6)
+        o1 = rng.randn(*agent.obs_shape).astype(np.float32)
+        o2 = rng.randn(*agent.obs_shape).astype(np.float32)
+        c0 = engine.initial_carry()
+        f1 = batcher.submit("dup", c0, o1)
+        f2 = batcher.submit("dup", c0, o2)
+        # fill to the top rung so the first epoch dispatches on FULL
+        fillers = [
+            batcher.submit(f"f{i}", engine.initial_carry(), o1)
+            for i in range(3)
+        ]
+        a1, c1, _ = f1.result(timeout=30.0)
+        a2, c2, _ = f2.result(timeout=30.0)
+        for f in fillers:
+            f.result(timeout=30.0)
+        # both resolved from c0 (the CALLER owns carry threading; the
+        # batcher's job is only that they never shared a dispatch)
+        ref1 = _sequential_reference(engine, [[o1]])[0]
+        ref2 = _sequential_reference(engine, [[o2]])[0]
+        np.testing.assert_array_equal(a1, ref1[0][0])
+        np.testing.assert_array_equal(a2, ref2[0][0])
+        assert batcher.holdbacks_total >= 1
+        assert batcher.epochs_total >= 2
+    finally:
+        batcher.close()
+
+
+def test_session_batcher_error_fails_only_that_epoch(rec):
+    agent, state = rec
+    engine = agent.serve_session_engine()  # NOTHING loaded: step raises
+    batcher = SessionBatcher(engine, deadline_ms=5.0)
+    try:
+        f = batcher.submit(
+            "s0",
+            np.zeros(engine.state_size, np.float32),
+            np.zeros((3,), np.float32),
+        )
+        with pytest.raises(RuntimeError, match="no params snapshot"):
+            f.result(timeout=30.0)
+        assert batcher.errors_total == 1
+        # the dispatcher survived: a later epoch still serves
+        engine.load(state.policy_params, state.obs_norm, step=0)
+        f2 = batcher.submit(
+            "s0",
+            engine.initial_carry(),
+            np.zeros((3,), np.float32),
+        )
+        action, carry, step = f2.result(timeout=30.0)
+        assert step == 0 and carry.shape == (engine.state_size,)
+    finally:
+        batcher.close()
+
+
+def test_submit_queue_wait_times_out_on_wedged_engine(rec):
+    """A wedged dispatcher backs the queue up; a bounded submit must
+    raise concurrent.futures.TimeoutError instead of parking the
+    caller (an HTTP handler thread holding a session lock) forever —
+    the entry was never admitted, so a retry is safe."""
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    agent, state = rec
+    engine = agent.serve_session_engine()
+    engine.load(state.policy_params, state.obs_norm, step=0)
+    entered = threading.Event()
+    release = threading.Event()
+
+    class _Wedged:
+        def __getattr__(self, name):
+            return getattr(engine, name)
+
+        def step_batch(self, carries, obs, return_step=False):
+            entered.set()
+            release.wait(30.0)
+            return engine.step_batch(carries, obs, return_step=return_step)
+
+    batcher = SessionBatcher(_Wedged(), deadline_ms=1.0, max_queue=2)
+    try:
+        o = np.zeros((3,), np.float32)
+        c = engine.initial_carry()
+        f0 = batcher.submit("s0", c, o)
+        assert entered.wait(10.0)  # the dispatcher is now wedged
+        fills = [batcher.submit(f"s{i + 1}", c, o) for i in range(2)]
+        with pytest.raises(FutTimeout, match="queue full"):
+            batcher.submit("late", c, o, timeout=0.3)
+        release.set()  # un-wedge: every ADMITTED entry still resolves
+        for f in [f0] + fills:
+            f.result(timeout=30.0)
+    finally:
+        release.set()
+        batcher.close()
+
+
+def test_latency_window_is_bounded_not_request_proportional(rec):
+    """The ISSUE 13 fix pin: quantile sample memory is a BOUND
+    (latency_window), not a buffer growing with requests_total —
+    for both batcher families."""
+    agent, state = rec
+    engine = agent.serve_session_engine()
+    engine.load(state.policy_params, state.obs_norm, step=0)
+    batcher = SessionBatcher(engine, deadline_ms=1.0, latency_window=8)
+    try:
+        o = np.zeros((3,), np.float32)
+        for i in range(30):
+            batcher.submit(f"s{i % 3}", engine.initial_carry(), o).result(
+                timeout=30.0
+            )
+        assert batcher.requests_total == 30
+        assert batcher.latency_samples <= 8
+        assert batcher.latency_quantiles_ms((0.5,))  # still answers
+    finally:
+        batcher.close()
+    # the feedforward MicroBatcher carries the same bound
+    ff = TRPOAgent(
+        "pendulum", TRPOConfig(**{
+            k: v for k, v in _CFG.items() if k != "policy_gru"
+        })
+    )
+    ff_state = ff.init_state(seed=0)
+    ff_engine = ff.serve_engine(batch_shapes=(1, 2))
+    ff_engine.load(ff_state.policy_params, ff_state.obs_norm, step=0)
+    mb = MicroBatcher(ff_engine, deadline_ms=1.0, latency_window=8)
+    try:
+        for _ in range(20):
+            mb.submit(np.zeros(ff.obs_shape, np.float32)).result(
+                timeout=30.0
+            )
+        assert mb.requests_total == 20
+        assert mb.latency_samples <= 8
+    finally:
+        mb.close()
+
+
+# ---------------------------------------------------------------------------
+# server: concurrent sessions through the epoch plane
+# ---------------------------------------------------------------------------
+
+
+def test_server_concurrent_sessions_bit_exact_and_gauges(rec):
+    """Concurrent HTTP sessions through the server's SessionBatcher:
+    every session's action stream matches driving agent.act by hand,
+    seq-dedupe still answers from the cache, and the epoch gauges are
+    on /metrics."""
+    agent, state = rec
+    engine = agent.serve_session_engine()
+    engine.load(state.policy_params, state.obs_norm, step=0)
+    server = PolicyServer(engine, None, port=0, session_deadline_ms=2.0)
+    try:
+        S, T = 6, 5
+        sids = []
+        for _ in range(S):
+            status, out = _post(server.url + "/session")
+            assert status == 200
+            sids.append(out["session"])
+        results = {}
+        errors = []
+
+        def client(k):
+            r = np.random.RandomState(50 + k)
+            mine = []
+            try:
+                for t in range(T):
+                    o = r.randn(*agent.obs_shape).astype(np.float32)
+                    status, out = _post(
+                        f"{server.url}/session/{sids[k]}/act",
+                        {"obs": o.tolist(), "seq": t},
+                    )
+                    assert status == 200, out
+                    mine.append((o, out["action"]))
+            except Exception as e:  # surfaced, never swallowed
+                errors.append(repr(e))
+            results[k] = mine
+
+        threads = [
+            threading.Thread(target=client, args=(k,), daemon=True)
+            for k in range(S)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors, errors
+        for k in range(S):
+            carry = None
+            for o, a in results[k]:
+                a_d, _d, carry = agent.act(
+                    state, o, eval_mode=True, policy_carry=carry
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(a, np.float32).ravel(),
+                    np.asarray(a_d, np.float32).ravel(),
+                    err_msg=f"session {k}",
+                )
+        sb = server.session_batcher
+        assert sb.requests_total == S * T
+        assert sb.epochs_total <= S * T  # coalescing never inflates
+        # a replayed seq is answered from the dedupe cache, not an epoch
+        epochs_before = sb.epochs_total
+        status, out = _post(
+            f"{server.url}/session/{sids[0]}/act",
+            {"obs": results[0][-1][0].tolist(), "seq": T - 1},
+        )
+        assert status == 200 and out.get("deduped") is True
+        assert sb.epochs_total == epochs_before
+        with urllib.request.urlopen(server.url + "/metrics") as r:
+            metrics = r.read().decode()
+        for gauge in (
+            "trpo_serve_session_queue_depth",
+            "trpo_serve_session_epochs_total",
+            "trpo_serve_session_epoch_width",
+            "trpo_serve_session_epoch_width_mean",
+            "trpo_serve_batch_shape_total",
+            "trpo_serve_session_latency_ms",
+        ):
+            assert gauge in metrics, gauge
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# failover interplay (ISSUE 11/12 contracts under the batched engine)
+# ---------------------------------------------------------------------------
+
+
+def test_mid_epoch_kill_journals_pre_epoch_state(rec, tmp_path):
+    """A replica dying MID-EPOCH (engine wedged with acts in flight)
+    must journal nothing torn: the in-flight epoch never applied, so
+    the journal resumes the PRE-epoch state and a retry replays the
+    act bit-exact — the write-behind window contract extended to the
+    epoch dispatch."""
+    agent, state = rec
+
+    class _WedgeEngine:
+        """Delegates until wedged; a wedged step_batch blocks until
+        released (the injected mid-epoch death window)."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.wedge = threading.Event()
+            self.entered = threading.Event()
+            self.release = threading.Event()
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def step_batch(self, carries, obs, return_step=False):
+            if self.wedge.is_set():
+                self.entered.set()
+                assert self.release.wait(30.0)
+            return self._inner.step_batch(
+                carries, obs, return_step=return_step
+            )
+
+    inner = agent.serve_session_engine()
+    inner.load(state.policy_params, state.obs_norm, step=0)
+    engine = _WedgeEngine(inner)
+    jdir = str(tmp_path / "carry")
+    server = PolicyServer(
+        engine, None, port=0, session_deadline_ms=2.0,
+        carry_journal_dir=jdir, replica_name="victim",
+        act_timeout_s=3.0,
+    )
+    from trpo_tpu.serve.session import journal_path
+
+    jpath = journal_path(jdir, "victim")
+    try:
+        status, out = _post(server.url + "/session")
+        sid = out["session"]
+        rng = np.random.RandomState(9)
+        obs = [rng.randn(*agent.obs_shape).astype(np.float32)
+               for _ in range(5)]
+        for t in range(3):
+            status, out = _post(
+                f"{server.url}/session/{sid}/act",
+                {"obs": obs[t].tolist(), "seq": t},
+            )
+            assert status == 200
+        assert server.sessions.journal.drain(10.0)
+        # wedge the engine and fire the act that will be IN FLIGHT
+        engine.wedge.set()
+        inflight = {}
+
+        def fire():
+            inflight["resp"] = _post(
+                f"{server.url}/session/{sid}/act",
+                {"obs": obs[3].tolist(), "seq": 3},
+                timeout=30.0,
+            )
+
+        th = threading.Thread(target=fire, daemon=True)
+        th.start()
+        assert engine.entered.wait(10.0)
+        # the replica "dies" now: journal reflects only APPLIED steps
+        entries = read_carry_journal(jpath)
+        assert entries[sid]["steps"] == 3
+        # sequential reference for the whole stream
+        carry = None
+        ref = []
+        for o in obs:
+            a, _d, carry = agent.act(
+                state, o, eval_mode=True, policy_carry=carry
+            )
+            ref.append(np.asarray(a, np.float64))
+        # a resumed incarnation continues from the journaled carry:
+        # steps 3 and 4 replay/advance bit-exact
+        entry = entries[sid]
+        carry_resumed = np.asarray(entry["carry"], np.float32)
+        a3, c4 = inner.step(carry_resumed, obs[3])
+        np.testing.assert_array_equal(np.asarray(a3, np.float64), ref[3])
+        a4, _c5 = inner.step(c4, obs[4])
+        np.testing.assert_array_equal(np.asarray(a4, np.float64), ref[4])
+        # unwedge; the stuck act either timed out (504) or completed —
+        # both are safe: the retry above replayed from the journal
+        engine.release.set()
+        th.join(timeout=30.0)
+        assert inflight["resp"][0] in (200, 504)
+    finally:
+        engine.release.set()
+        server.close()
+
+
+def test_drain_sync_all_current_under_concurrent_batched_load(
+    rec, tmp_path
+):
+    """The autoscaler's lossless-drain contract with the batched
+    engine: sync_all during concurrent epoch stepping flushes every
+    live session's CURRENT carry (no torn steps/carry pairs)."""
+    agent, state = rec
+    engine = agent.serve_session_engine()
+    engine.load(state.policy_params, state.obs_norm, step=0)
+    jdir = str(tmp_path / "carry")
+    server = PolicyServer(
+        engine, None, port=0, session_deadline_ms=2.0,
+        carry_journal_dir=jdir, replica_name="drainee",
+        carry_sync_every=10_000,  # journal ONLY via the drain
+    )
+    from trpo_tpu.serve.session import journal_path
+
+    try:
+        S, T = 4, 6
+        sids = []
+        for _ in range(S):
+            _s, out = _post(server.url + "/session")
+            sids.append(out["session"])
+        stop = threading.Event()
+        counts = [0] * S
+        errors = []
+
+        def client(k):
+            r = np.random.RandomState(70 + k)
+            while not stop.is_set() and counts[k] < T:
+                o = r.randn(*agent.obs_shape).astype(np.float32)
+                status, out = _post(
+                    f"{server.url}/session/{sids[k]}/act",
+                    {"obs": o.tolist()},
+                )
+                if status != 200:
+                    errors.append(out)
+                    return
+                counts[k] += 1
+
+        threads = [
+            threading.Thread(target=client, args=(k,), daemon=True)
+            for k in range(S)
+        ]
+        for th in threads:
+            th.start()
+        # drain mid-load: a snapshot taken while epochs are in flight
+        status, out = _post(server.url + "/drain", {})
+        assert status == 200 and out["ok"] is True
+        for th in threads:
+            th.join(timeout=60.0)
+        assert not errors, errors
+        # final drain: the journal must now hold every session at its
+        # FINAL applied step with the live carry
+        status, out = _post(server.url + "/drain", {})
+        assert status == 200 and out["ok"] is True
+        entries = read_carry_journal(journal_path(jdir, "drainee"))
+        for k, sid in enumerate(sids):
+            assert entries[sid]["steps"] == counts[k]
+            live = server.sessions.get(sid)
+            np.testing.assert_array_equal(
+                np.asarray(entries[sid]["carry"], np.float32),
+                live.carry,
+            )
+    finally:
+        server.close()

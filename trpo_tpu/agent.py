@@ -749,17 +749,21 @@ class TRPOAgent:
             obs_dtype=obs_dtype if obs_dtype is not None else jnp.float32,
         )
 
-    def serve_session_engine(self, obs_dtype=None):
+    def serve_session_engine(self, obs_dtype=None, batch_shapes=None):
         """The recurrent twin of :meth:`serve_engine`
         (``serve/session.RecurrentServeEngine``): the eval-mode
-        ``policy.step`` AOT-compiled at batch 1 over ``(carry, obs)``,
+        ``policy.step`` AOT-compiled over ``(carry, obs)`` at a fixed
+        rung ladder (``cfg.serve_session_batch_shapes`` by default —
+        ISSUE 13 continuous batching: concurrent sessions gather into
+        ONE ``(N, carry)`` dispatch padded to the nearest rung),
         donation-free and snapshot-swappable, for the ``POST /session``
         protocol — the carry lives server-side next to the engine
         (``serve/session.SessionStore``), threaded by session id.
-        Stepping a session through this engine is bit-exact with
-        ``act(..., eval_mode=True, policy_carry=...)``. Recurrent
-        policies only: a feedforward policy has no carry to thread —
-        serve it through the stateless :meth:`serve_engine`."""
+        Stepping a session through this engine — alone or inside any
+        batched epoch — is bit-exact with ``act(..., eval_mode=True,
+        policy_carry=...)``. Recurrent policies only: a feedforward
+        policy has no carry to thread — serve it through the stateless
+        :meth:`serve_engine`."""
         from trpo_tpu.serve.session import RecurrentServeEngine
 
         if not self.is_recurrent:
@@ -775,6 +779,11 @@ class TRPOAgent:
             self.obs_shape,
             with_obs_norm=self._obs_norm_on_device or self._obs_norm_host,
             obs_dtype=obs_dtype if obs_dtype is not None else jnp.float32,
+            batch_shapes=tuple(
+                batch_shapes
+                if batch_shapes is not None
+                else self.cfg.serve_session_batch_shapes
+            ),
         )
 
     # ------------------------------------------------------------------

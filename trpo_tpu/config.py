@@ -517,6 +517,28 @@ class TRPOConfig:
     #                                Checkpointer.latest_step() polls;
     #                                the marker gate means a torn save is
     #                                never offered for loading
+    serve_session_batch_shapes: Tuple[int, ...] = (1, 8, 64)  # AOT
+    #                                session-step rung ladder (ISSUE 13,
+    #                                serve/session.RecurrentServeEngine):
+    #                                concurrent sessions' carries+obs
+    #                                gather into ONE (N, carry) dispatch
+    #                                padded up to the nearest rung, so N
+    #                                live sessions share the device
+    #                                instead of serializing batch-1
+    #                                steps; zero steady-state retraces
+    #                                across epoch-width changes
+    serve_session_deadline_ms: float = 3.0  # epoch coalescing budget
+    #                                (serve/batcher.SessionBatcher): an
+    #                                epoch dispatches when it reaches the
+    #                                top session rung OR when the oldest
+    #                                queued act has waited HALF this
+    #                                budget (adaptive shrink applies,
+    #                                like the stateless micro-batcher).
+    #                                Smaller than serve_deadline_ms:
+    #                                session acts arrive in closed loops
+    #                                (one per env step), so the natural
+    #                                coalescing window is the inter-step
+    #                                gap, not a burst buffer
 
     # --- replicated serving (serve/{replicaset,router} — ISSUE 9) --------
     serve_replicas: int = 1        # N serving replicas behind one router
@@ -807,6 +829,19 @@ class TRPOConfig:
             raise ValueError(
                 "serve_poll_interval must be > 0, got "
                 f"{self.serve_poll_interval}"
+            )
+        if not self.serve_session_batch_shapes or any(
+            not isinstance(b, int) or isinstance(b, bool) or b < 1
+            for b in self.serve_session_batch_shapes
+        ):
+            raise ValueError(
+                "serve_session_batch_shapes must be a non-empty tuple of "
+                f"positive ints, got {self.serve_session_batch_shapes!r}"
+            )
+        if self.serve_session_deadline_ms <= 0:
+            raise ValueError(
+                "serve_session_deadline_ms must be > 0, got "
+                f"{self.serve_session_deadline_ms}"
             )
         if self.serve_replicas < 1:
             raise ValueError(

@@ -84,6 +84,14 @@ class RecurrentPolicy(NamedTuple):
     hidden_size: int     # the cell's H
     state_size: int = 0  # carried-state width: H (GRU) or 2H (LSTM [h|c]);
     #                      0 is a pre-state_size default, see make_*
+    head: Any = None     # (params, state (..., S)) -> dist params — the
+    #                      state→dist head alone, exposed so the serving
+    #                      engine (serve/session.py) can recompute it
+    #                      PER ROW inside a batched epoch: the narrow
+    #                      head matmul is the one op whose XLA lowering
+    #                      varies with batch width, so a row-mapped head
+    #                      is what keeps epoch-batched actions bit-exact
+    #                      with batch-1 stepping at every rung
 
 
 def init_gru(key, in_dim: int, hidden: int):
@@ -311,4 +319,5 @@ def make_recurrent_policy(
         step=step,
         hidden_size=gru_size,
         state_size=gru_size * state_mult,
+        head=_head,
     )

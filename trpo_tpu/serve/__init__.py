@@ -34,7 +34,12 @@ the "millions of users" scale leg:
   only when ALL replicas are saturated, aggregated
   ``/status``/``/metrics`` (``trpo_router_*``).
 * :mod:`trpo_tpu.serve.session` — the session protocol for RECURRENT
-  policies: :class:`RecurrentServeEngine` (AOT batch-1 ``step``) +
+  policies: :class:`RecurrentServeEngine` (the AOT ``step`` compiled
+  at a rung LADDER — ISSUE 13 continuous batching: a
+  :class:`SessionBatcher` gathers N concurrent sessions' carries and
+  observations into ONE padded ``(N, carry)`` dispatch per epoch and
+  scatters actions/carries back, so device throughput scales with
+  concurrency instead of serializing batch-1 steps) +
   :class:`SessionStore` (bounded, TTL-evicting, server-side carry);
   the router adds session→replica affinity and re-establishes a
   session from a fresh carry when its replica dies.
@@ -60,7 +65,7 @@ SLOs.
 """
 
 from trpo_tpu.serve.autoscaler import Autoscaler
-from trpo_tpu.serve.batcher import MicroBatcher
+from trpo_tpu.serve.batcher import MicroBatcher, SessionBatcher
 from trpo_tpu.serve.engine import InferenceEngine
 from trpo_tpu.serve.replicaset import (
     CanaryController,
@@ -75,6 +80,7 @@ from trpo_tpu.serve.session import (
     CarryJournal,
     RecurrentServeEngine,
     SessionStore,
+    SimulatedCostSessionEngine,
     journal_path,
     read_carry_journal,
 )
@@ -82,8 +88,10 @@ from trpo_tpu.serve.session import (
 __all__ = [
     "InferenceEngine",
     "MicroBatcher",
+    "SessionBatcher",
     "PolicyServer",
     "RecurrentServeEngine",
+    "SimulatedCostSessionEngine",
     "SessionStore",
     "CarryJournal",
     "journal_path",

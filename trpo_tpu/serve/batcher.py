@@ -1,28 +1,43 @@
-"""Request micro-batcher: coalesce concurrent ``act`` requests under a
-latency deadline.
+"""Request micro-batchers: coalesce concurrent requests under a latency
+deadline.
 
 A TPU answers a padded batch-8 inference in essentially the time of a
 batch-1 — the way to serve traffic is to NOT dispatch each request
-alone. The batcher is a bounded queue plus one dispatcher thread:
+alone. Two batchers share one scaffold (:class:`_DeadlineBatcher` —
+bounded queue, one dispatcher thread, deadline/full dispatch rule,
+adaptive deadline, bounded latency window):
 
-* requests enqueue with their arrival time and a ``Future``;
-* the dispatcher sends a batch when the queue reaches the engine's top
-  rung (**full**) or when the oldest request has spent HALF its
-  ``deadline_ms`` budget waiting (**deadline**) — half, because the
-  inference itself still has to fit inside the other half;
-* the batch pads up to the engine ladder's nearest rung
-  (``serve/engine.py``), per-request actions come back through the
-  futures, and one ``serve`` event (requests coalesced, padded rung,
-  queue depth left behind, oldest-request latency) goes on the run-event
-  bus — the same JSONL stream training emits, so
-  ``scripts/analyze_run.py --compare`` judges serving runs too.
+* :class:`MicroBatcher` — the stateless plane (ISSUE 6): observations
+  in front of an :class:`~trpo_tpu.serve.engine.InferenceEngine`,
+  futures resolving to ``(action, step)``.
+* :class:`SessionBatcher` — the recurrent plane (ISSUE 13, continuous
+  batching): session-keyed ``(sid, carry, obs)`` entries in front of a
+  :class:`~trpo_tpu.serve.session.RecurrentServeEngine`. One dispatch
+  GATHERS up to ``engine.max_batch`` concurrently-waiting sessions'
+  carries and observations, stacks them into ONE ``(N, carry)``/
+  ``(N, obs)`` call through the engine's AOT rung ladder, and SCATTERS
+  per-session ``(action, new_carry, step)`` back through the futures —
+  the gather/scatter epoch that replaces per-session batch-1
+  serialization on the device. Two entries for the SAME session never
+  share an epoch (the later one is held back — within one program the
+  second step would read the first one's stale carry); the HTTP front
+  end's per-session lock already serializes same-session acts, so the
+  holdback is a defensive invariant, not the common path.
+
+Shared dispatch rule: a batch goes when the queue reaches the engine's
+top rung (**full**) or when the oldest request has spent HALF its
+``deadline_ms`` budget waiting (**deadline**) — half, because the
+inference itself still has to fit inside the other half.
 
 Backpressure: the queue is bounded (``max_queue``); ``submit`` blocks
 when it is full, so a traffic spike turns into client latency instead
 of unbounded process memory — the same bound-not-buffer policy as the
-PR 5 ``StatsDrain``. An engine failure fails exactly the requests in
-that batch (their futures carry the exception); the dispatcher thread
-survives and keeps serving.
+PR 5 ``StatsDrain``. The same policy bounds observability: the
+per-request latency window is a fixed-size deque (``latency_window``
+samples — memory does NOT grow with request count; pinned in
+``tests/test_session_batch.py``). An engine failure fails exactly the
+requests in that batch (their futures carry the exception); the
+dispatcher thread survives and keeps serving.
 
 Adaptive deadline (``adaptive_deadline=True``, the ROADMAP follow-on):
 the fixed half-budget is tuned for the inference cost it must leave
@@ -35,6 +50,13 @@ half-budget — the deadline stays the upper bound, adaptivity only
 shrinks the idle): under a slow request rate p50 drops to roughly the
 dispatch cost itself (test-pinned), while a fast model under burst
 load still coalesces within its (tiny) natural batching window.
+
+Both batchers emit one schema-valid ``serve`` event per dispatch
+(requests coalesced, padded rung, queue depth left behind, oldest
+latency) on the run-event bus — the same JSONL stream training emits,
+so ``scripts/analyze_run.py --compare`` regression-gates a session-
+batched serving run's p50/p99 (time-like) and actions/s (rate-like)
+through the EXISTING serving gate.
 """
 
 from __future__ import annotations
@@ -43,11 +65,12 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeoutError
 from typing import Optional
 
 import numpy as np
 
-__all__ = ["MicroBatcher"]
+__all__ = ["MicroBatcher", "SessionBatcher"]
 
 
 class _Pending:
@@ -59,9 +82,26 @@ class _Pending:
         self.future: Future = Future()
 
 
-class MicroBatcher:
-    """Deadline-bounded request coalescing in front of an
-    :class:`~trpo_tpu.serve.engine.InferenceEngine`."""
+class _SessionPending:
+    __slots__ = ("sid", "carry", "obs", "t", "future")
+
+    def __init__(self, sid: str, carry, obs, t: float):
+        self.sid = sid
+        self.carry = carry
+        self.obs = obs
+        self.t = t
+        self.future: Future = Future()
+
+
+class _DeadlineBatcher:
+    """Shared scaffold: bounded queue + dispatcher thread + deadline/full
+    dispatch rule + adaptive deadline + bounded latency window.
+
+    Subclasses implement :meth:`_dispatch` (consume one batch of pending
+    entries, resolve their futures) and may override
+    :meth:`_take_batch_locked` (called under the condition lock) to
+    shape which queued entries one batch may take.
+    """
 
     def __init__(
         self,
@@ -73,6 +113,7 @@ class MicroBatcher:
         adaptive_deadline: bool = False,
         adaptive_headroom: float = 2.0,
         cost_ema_alpha: float = 0.2,
+        thread_name: str = "serve-batcher",
     ):
         if deadline_ms <= 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
@@ -99,40 +140,45 @@ class MicroBatcher:
         self._closed = False
         # observability (read by the /metrics handler): counters under
         # _cond, the latency window under its own lock so a metrics
-        # scrape never contends with submit/dispatch
+        # scrape never contends with submit/dispatch. The window is a
+        # BOUND (maxlen), never a request-count-proportional buffer.
         self.requests_total = 0
         self.batches_total = 0
         self.errors_total = 0
         self.queue_high_water = 0
+        self.latency_window = int(latency_window)
         self._lat_lock = threading.Lock()
-        self._latencies_ms: deque = deque(maxlen=latency_window)
+        self._latencies_ms: deque = deque(maxlen=self.latency_window)
         self._thread = threading.Thread(
-            target=self._loop, name="serve-batcher", daemon=True
+            target=self._loop, name=thread_name, daemon=True
         )
         self._thread.start()
 
     # -- client side -------------------------------------------------------
 
-    def submit(self, obs) -> Future:
-        """Enqueue ONE observation; the returned future resolves to
-        ``(action, step)`` — the action and the checkpoint step of the
-        snapshot that actually computed it (captured inside the engine
-        call, so a hot swap racing the response can never mislabel an
-        old snapshot's action with the new step). Blocks while the queue
-        is at its bound (backpressure); raises ``RuntimeError`` after
-        :meth:`close`."""
-        obs = np.asarray(obs, self.engine.obs_dtype)
-        if obs.shape != self.engine.obs_shape:
-            raise ValueError(
-                f"obs must have shape {self.engine.obs_shape}, "
-                f"got {obs.shape}"
-            )
-        pending = _Pending(obs, time.perf_counter())
+    def _enqueue(self, pending, timeout: Optional[float] = None) -> Future:
+        """Admit one pending entry (backpressure-bounded); raises
+        ``RuntimeError`` after :meth:`close`. With ``timeout``, a queue
+        that stays full past it raises ``concurrent.futures
+        .TimeoutError`` instead of blocking the caller forever — a
+        wedged dispatcher must turn into a typed client error, not an
+        unbounded pile of blocked handler threads (the entry was never
+        admitted, so the step never ran and a retry is safe)."""
+        deadline = (
+            None if timeout is None else time.perf_counter() + timeout
+        )
         with self._cond:
             while len(self._queue) >= self.max_queue and not self._closed:
+                if (
+                    deadline is not None
+                    and time.perf_counter() >= deadline
+                ):
+                    raise _FutureTimeoutError(
+                        f"{type(self).__name__} queue full for {timeout}s"
+                    )
                 self._cond.wait(0.05)
             if self._closed:
-                raise RuntimeError("MicroBatcher is closed")
+                raise RuntimeError(f"{type(self).__name__} is closed")
             self._queue.append(pending)
             self.requests_total += 1
             self.queue_high_water = max(
@@ -145,6 +191,13 @@ class MicroBatcher:
     def queue_depth(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    @property
+    def latency_samples(self) -> int:
+        """Samples currently in the (bounded) latency window — at most
+        ``latency_window`` no matter how many requests were served."""
+        with self._lat_lock:
+            return len(self._latencies_ms)
 
     def latency_quantiles_ms(self, qs=(0.5, 0.99)) -> dict:
         """Nearest-rank quantiles over the recent per-request latency
@@ -167,6 +220,16 @@ class MicroBatcher:
         with self._lat_lock:
             return self._cost_ema_ms
 
+    def _observe_dispatch(self, cost_ms: float, lats) -> None:
+        with self._lat_lock:
+            self._latencies_ms.extend(lats)
+            self._cost_ema_ms = (
+                cost_ms
+                if self._cost_ema_ms is None
+                else self._cost_alpha * cost_ms
+                + (1.0 - self._cost_alpha) * self._cost_ema_ms
+            )
+
     def _effective_half_budget_ms(self) -> float:
         """The wait budget the dispatcher actually honors: the fixed
         half-deadline, shrunk — when ``adaptive_deadline`` — to
@@ -183,6 +246,13 @@ class MicroBatcher:
         return min(half, max(self.adaptive_headroom * ema, 0.1))
 
     # -- dispatcher --------------------------------------------------------
+
+    def _take_batch_locked(self, full: int) -> list:
+        """Pop the batch one dispatch takes (called under ``_cond``)."""
+        return [
+            self._queue.popleft()
+            for _ in range(min(full, len(self._queue)))
+        ]
 
     def _loop(self) -> None:
         full = self.engine.max_batch
@@ -203,40 +273,22 @@ class MicroBatcher:
                 ):
                     self._cond.wait(budget_ms / 1e3)
                     continue  # re-evaluate: more requests may have landed
-                batch = [
-                    self._queue.popleft()
-                    for _ in range(min(full, len(self._queue)))
-                ]
+                batch = self._take_batch_locked(full)
                 depth_after = len(self._queue)
                 self._cond.notify_all()  # wake submitters blocked on space
             self._dispatch(batch, depth_after)
 
-    def _dispatch(self, batch, depth_after: int) -> None:
-        obs = np.stack([p.obs for p in batch], axis=0)
-        rung = self.engine.padded_shape(len(batch))
-        t_infer = time.perf_counter()
-        try:
-            actions, step = self.engine.infer(obs, return_step=True)
-        except Exception as e:
-            # fail THESE requests; the dispatcher survives for the next
-            with self._cond:
-                self.errors_total += len(batch)
-            for p in batch:
-                p.future.set_exception(e)
-            return
-        done = time.perf_counter()
-        cost_ms = (done - t_infer) * 1e3
-        lats = [(done - p.t) * 1e3 for p in batch]
-        with self._lat_lock:
-            self._latencies_ms.extend(lats)
-            self._cost_ema_ms = (
-                cost_ms
-                if self._cost_ema_ms is None
-                else self._cost_alpha * cost_ms
-                + (1.0 - self._cost_alpha) * self._cost_ema_ms
-            )
-        for p, action in zip(batch, actions):
-            p.future.set_result((np.asarray(action), step))
+    def _dispatch(self, batch, depth_after: int) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def _fail_batch(self, batch, exc: Exception) -> None:
+        """Fail THESE requests; the dispatcher survives for the next."""
+        with self._cond:
+            self.errors_total += len(batch)
+        for p in batch:
+            p.future.set_exception(exc)
+
+    def _emit_dispatch(self, batch, rung: int, depth_after: int, lats):
         with self._cond:
             self.batches_total += 1
         if self.bus is not None:
@@ -257,3 +309,155 @@ class MicroBatcher:
             self._closed = True
             self._cond.notify_all()
         self._thread.join(timeout=30.0)
+
+
+class MicroBatcher(_DeadlineBatcher):
+    """Deadline-bounded request coalescing in front of an
+    :class:`~trpo_tpu.serve.engine.InferenceEngine` (stateless /act)."""
+
+    def submit(self, obs) -> Future:
+        """Enqueue ONE observation; the returned future resolves to
+        ``(action, step)`` — the action and the checkpoint step of the
+        snapshot that actually computed it (captured inside the engine
+        call, so a hot swap racing the response can never mislabel an
+        old snapshot's action with the new step). Blocks while the queue
+        is at its bound (backpressure); raises ``RuntimeError`` after
+        :meth:`close`."""
+        obs = np.asarray(obs, self.engine.obs_dtype)
+        if obs.shape != self.engine.obs_shape:
+            raise ValueError(
+                f"obs must have shape {self.engine.obs_shape}, "
+                f"got {obs.shape}"
+            )
+        return self._enqueue(_Pending(obs, time.perf_counter()))
+
+    def _dispatch(self, batch, depth_after: int) -> None:
+        obs = np.stack([p.obs for p in batch], axis=0)
+        rung = self.engine.padded_shape(len(batch))
+        t_infer = time.perf_counter()
+        try:
+            actions, step = self.engine.infer(obs, return_step=True)
+        except Exception as e:
+            self._fail_batch(batch, e)
+            return
+        done = time.perf_counter()
+        lats = [(done - p.t) * 1e3 for p in batch]
+        self._observe_dispatch((done - t_infer) * 1e3, lats)
+        for p, action in zip(batch, actions):
+            p.future.set_result((np.asarray(action), step))
+        self._emit_dispatch(batch, rung, depth_after, lats)
+
+
+class SessionBatcher(_DeadlineBatcher):
+    """Continuous batching for recurrent sessions (ISSUE 13): gather up
+    to ``engine.max_batch`` waiting sessions' ``(carry, obs)`` pairs
+    into ONE rung-padded ``step_batch`` dispatch, scatter per-session
+    ``(action, new_carry, step)`` back through the futures.
+
+    The epoch invariant: one session appears AT MOST once per epoch —
+    a second entry for a sid already gathered is held back to the next
+    epoch in arrival order (two steps of one session inside one program
+    would hand the second step a stale carry). The front end's
+    per-session lock already serializes same-session acts, so holdback
+    is defense in depth for direct users of this class.
+    """
+
+    def __init__(self, engine, deadline_ms: float = 3.0, **kw):
+        kw.setdefault("thread_name", "serve-session-batcher")
+        super().__init__(engine, deadline_ms=deadline_ms, **kw)
+        # epoch-shape observability (the ISSUE 13 /metrics satellite):
+        # updated under _cond with the other counters
+        self.epoch_width_last = 0
+        self.epoch_width_sum = 0
+        self.holdbacks_total = 0
+
+    @property
+    def epochs_total(self) -> int:
+        """Alias: one batch IS one gather/scatter epoch."""
+        return self.batches_total
+
+    @property
+    def epoch_width_mean(self) -> Optional[float]:
+        with self._cond:
+            if not self.batches_total:
+                return None
+            return self.epoch_width_sum / self.batches_total
+
+    def submit(
+        self, sid: str, carry, obs, timeout: Optional[float] = None
+    ) -> Future:
+        """Enqueue ONE session step; the future resolves to ``(action,
+        new_carry, step)``. The caller owns the carry read-modify-write
+        ordering (the HTTP front end holds the session lock across
+        submit → result); the batcher only guarantees a sid never
+        rides twice in one epoch. ``timeout`` bounds the QUEUE wait: a
+        wedged engine backs the queue up, and the front end must answer
+        its act-timeout 504 instead of parking one handler thread per
+        retry forever (raises ``concurrent.futures.TimeoutError``; the
+        step never entered an epoch, so the carry is unadvanced and a
+        retry is safe)."""
+        if not isinstance(sid, str) or not sid:
+            raise ValueError(f"sid must be a non-empty string, got {sid!r}")
+        carry = np.asarray(carry, np.float32)
+        if carry.shape != (self.engine.state_size,):
+            raise ValueError(
+                f"carry must have shape ({self.engine.state_size},), "
+                f"got {carry.shape}"
+            )
+        obs = np.asarray(obs, self.engine.obs_dtype)
+        if obs.shape != self.engine.obs_shape:
+            raise ValueError(
+                f"obs must have shape {self.engine.obs_shape}, "
+                f"got {obs.shape}"
+            )
+        return self._enqueue(
+            _SessionPending(sid, carry, obs, time.perf_counter()),
+            timeout=timeout,
+        )
+
+    def _take_batch_locked(self, full: int) -> list:
+        """Gather one epoch: scan the queue in arrival order, take each
+        session's FIRST waiting entry, hold later duplicates back (they
+        keep their arrival order for the next epoch)."""
+        batch: list = []
+        seen: set = set()
+        held: list = []
+        while self._queue and len(batch) < full:
+            p = self._queue.popleft()
+            if p.sid in seen:
+                held.append(p)
+                continue
+            seen.add(p.sid)
+            batch.append(p)
+        if held:
+            self.holdbacks_total += len(held)
+            self._queue.extendleft(reversed(held))
+        return batch
+
+    def _dispatch(self, batch, depth_after: int) -> None:
+        carries = np.stack([p.carry for p in batch], axis=0)
+        obs = np.stack([p.obs for p in batch], axis=0)
+        rung = self.engine.padded_shape(len(batch))
+        t_infer = time.perf_counter()
+        try:
+            actions, new_carries, step = self.engine.step_batch(
+                carries, obs, return_step=True
+            )
+        except Exception as e:
+            self._fail_batch(batch, e)
+            return
+        done = time.perf_counter()
+        lats = [(done - p.t) * 1e3 for p in batch]
+        self._observe_dispatch((done - t_infer) * 1e3, lats)
+        for i, p in enumerate(batch):
+            p.future.set_result(
+                (
+                    np.asarray(actions[i]),
+                    np.asarray(new_carries[i], np.float32),
+                    step,
+                )
+            )
+        with self._cond:
+            self.epoch_width_last = len(batch)
+            self.epoch_width_sum += len(batch)
+        self._emit_dispatch(batch, rung, depth_after, lats)

@@ -81,9 +81,14 @@ class PolicyServer:
     :class:`~trpo_tpu.serve.engine.InferenceEngine` (``batcher``
     required; ``/act`` active) or a
     :class:`~trpo_tpu.serve.session.RecurrentServeEngine` (``batcher``
-    must be ``None`` — session steps are per-session batch-1, carry
-    threading has nothing to coalesce; the session routes are active
-    and ``/act`` answers the typed 409).
+    must be ``None`` — the server owns its own
+    :class:`~trpo_tpu.serve.batcher.SessionBatcher` (ISSUE 13): every
+    session act is gathered with its concurrent peers into ONE
+    rung-padded ``(N, carry)`` epoch through the engine's AOT ladder
+    instead of serializing batch-1 steps on the device; the session
+    routes are active and ``/act`` answers the typed 409).
+    ``session_deadline_ms`` is the epoch-coalescing budget
+    (``cfg.serve_session_deadline_ms``).
 
     **Managed reload** (ISSUE 11, the canary seam):
     ``managed_reload=True`` stops the watcher from auto-swapping to
@@ -129,6 +134,8 @@ class PolicyServer:
         managed_reload: bool = False,
         initial_step: Optional[int] = None,
         injector=None,
+        session_deadline_ms: float = 3.0,
+        session_adaptive_deadline: bool = True,
     ):
         if (checkpointer is None) != (template is None):
             raise ValueError(
@@ -142,8 +149,9 @@ class PolicyServer:
         self.is_recurrent = bool(getattr(engine, "is_recurrent", False))
         if self.is_recurrent and batcher is not None:
             raise ValueError(
-                "a recurrent engine takes no micro-batcher: session "
-                "steps are per-session batch-1 (pass batcher=None)"
+                "a recurrent engine takes no micro-batcher: the server "
+                "owns its own SessionBatcher for the carry-threading "
+                "epoch dispatch (pass batcher=None)"
             )
         if not self.is_recurrent and batcher is None:
             raise ValueError(
@@ -180,7 +188,9 @@ class PolicyServer:
         self._stall_until = 0.0  # chaos: acts sleep past this deadline
         self._slow_ms = 0.0      # chaos: persistent per-act latency
         self.sessions = None
+        self.session_batcher = None
         if self.is_recurrent:
+            from trpo_tpu.serve.batcher import SessionBatcher
             from trpo_tpu.serve.session import (
                 CarryJournal,
                 SessionStore,
@@ -201,6 +211,16 @@ class PolicyServer:
                 replica=replica_name,
                 journal=journal,
                 sync_every=carry_sync_every,
+            )
+            # the continuous-batching data plane (ISSUE 13): every
+            # session act below goes through ONE gather/scatter epoch
+            # per coalescing window instead of a per-session batch-1
+            # device dispatch
+            self.session_batcher = SessionBatcher(
+                engine,
+                deadline_ms=session_deadline_ms,
+                bus=bus,
+                adaptive_deadline=session_adaptive_deadline,
             )
 
         if checkpointer is not None:
@@ -720,8 +740,22 @@ class PolicyServer:
                             "deduped": True,
                         }
                     )
-                action, carry_new, step = self.engine.step(
-                    sess.carry, obs, return_step=True
+                # submit into the gather/scatter epoch (ISSUE 13): the
+                # batcher stacks this session's (carry, obs) with every
+                # concurrently-waiting peer into ONE rung-padded
+                # step_batch dispatch. Blocking on the future HERE —
+                # under the session lock — is what keeps the carry
+                # read-modify-write serialized per session while
+                # different sessions share the device dispatch.
+                # the timeout covers BOTH waits: queue admission (a
+                # wedged engine backs the queue up — without it every
+                # retry parks a handler thread forever) and the epoch
+                # result
+                future = self.session_batcher.submit(
+                    sid, sess.carry, obs, timeout=self.act_timeout_s
+                )
+                action, carry_new, step = future.result(
+                    timeout=self.act_timeout_s
                 )
                 sess.carry = carry_new
                 if seq is not None:
@@ -732,6 +766,14 @@ class PolicyServer:
                 # write-behind carry snapshot (copies taken here, under
                 # the session lock; the disk write happens elsewhere)
                 self.sessions.journal_step(sid, sess)
+        except _FutureTimeout:
+            # the epoch never came back (wedged engine): the carry was
+            # NOT advanced — a timed-out act is safe to retry
+            with self._counter_lock:
+                self.session_act_errors_total += 1
+            return 504, _JSON, _json_body(
+                {"error": f"inference exceeded {self.act_timeout_s}s"}
+            )
         except Exception as e:
             with self._counter_lock:
                 self.session_act_errors_total += 1
@@ -828,6 +870,60 @@ class PolicyServer:
                 "retries that must not double-step)",
                 [("", s.deduped_total)],
             )
+            # the continuous-batching epoch gauges (ISSUE 13): queue
+            # depth and epoch width say whether concurrent sessions are
+            # actually sharing dispatches or trickling through at
+            # width 1
+            sb = self.session_batcher
+            fam(
+                "trpo_serve_session_queue_depth", "gauge",
+                "session acts waiting in the epoch batcher",
+                [("", sb.queue_depth)],
+            )
+            fam(
+                "trpo_serve_session_epochs_total", "counter",
+                "gather/scatter epochs dispatched",
+                [("", sb.epochs_total)],
+            )
+            fam(
+                "trpo_serve_session_epoch_width", "gauge",
+                "sessions gathered into the most recent epoch",
+                [("", sb.epoch_width_last)],
+            )
+            fam(
+                "trpo_serve_session_epoch_width_mean", "gauge",
+                "mean sessions per dispatched epoch",
+                [("", sb.epoch_width_mean)],
+            )
+            fam(
+                "trpo_serve_session_epoch_holdbacks_total", "counter",
+                "same-session entries deferred to a later epoch (one "
+                "sid never rides twice in one dispatch)",
+                [("", sb.holdbacks_total)],
+            )
+            fam(
+                "trpo_serve_batch_shape_total", "counter",
+                "epoch dispatches per padded session-batch rung",
+                [
+                    # dict() snapshot: a concurrent first dispatch at a
+                    # new rung inserts a key — iterating the live dict
+                    # could fail the scrape mid-sort
+                    (f'{{shape="{rung}"}}', count)
+                    for rung, count in sorted(
+                        dict(self.engine.shape_counts).items()
+                    )
+                ],
+            )
+            q = sb.latency_quantiles_ms((0.5, 0.99))
+            fam(
+                "trpo_serve_session_latency_ms", "gauge",
+                "per-act latency quantiles over the recent (bounded) "
+                "window",
+                [
+                    (f'{{quantile="{qq}"}}', _finite_or_none(v))
+                    for qq, v in sorted(q.items())
+                ],
+            )
             fam(
                 "trpo_serve_checkpoint_step", "gauge",
                 "checkpoint step currently served",
@@ -865,9 +961,10 @@ class PolicyServer:
             "trpo_serve_batch_shape_total", "counter",
             "dispatches per padded batch rung",
             [
+                # dict() snapshot: see the session twin above
                 (f'{{shape="{rung}"}}', count)
                 for rung, count in sorted(
-                    self.engine.shape_counts.items()
+                    dict(self.engine.shape_counts).items()
                 )
             ],
         )
@@ -915,5 +1012,8 @@ class PolicyServer:
         httpd, self._httpd = self._httpd, None
         if httpd is not None:
             httpd.close()
+        if self.session_batcher is not None:
+            # after the front end: already-accepted epochs still resolve
+            self.session_batcher.close()
         if self.sessions is not None:
             self.sessions.close(flush=not abrupt)

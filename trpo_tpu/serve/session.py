@@ -7,14 +7,23 @@ don't carry it. This module makes that a first-class protocol instead
 of a refusal:
 
 * :class:`RecurrentServeEngine` — the eval-mode ``policy.step``
-  (argmax/mode, keyless) AOT-compiled at batch 1 over ``(params,
-  obs_norm, carry, obs)`` → ``(action, new_carry)``. Same snapshot
-  contract as the feedforward engine: donation-free, swapped by
-  reference on hot reload, ZERO steady-state retraces after
-  :meth:`load`. Determinism contract: stepping a session through this
-  engine is BIT-EXACT with driving ``agent.act(..., eval_mode=True,
-  policy_carry=...)`` by hand (pinned in ``tests/test_router.py``) —
-  the session API is the training-time act path, not an approximation.
+  (argmax/mode, keyless) AOT-compiled over ``(params, obs_norm,
+  carry, obs)`` → ``(action, new_carry)`` at a LADDER of fixed batch
+  rungs (ISSUE 13 — the recurrent twin of the feedforward engine's
+  pad-to-rung contract): :meth:`step_batch` advances N independent
+  sessions in ONE ``(N, carry)``/``(N, obs)`` dispatch, padding up to
+  the nearest rung with zero rows whose outputs are sliced off (row i
+  of the result is a pure function of row i of the inputs — padding
+  rows are masked by construction, pinned in
+  ``tests/test_session_batch.py``). Same snapshot contract as the
+  feedforward engine: donation-free, swapped by reference on hot
+  reload, ZERO steady-state retraces after :meth:`load` across every
+  epoch-width change. Determinism contract: stepping a session
+  through this engine — batch-1 or inside any batched epoch — is
+  BIT-EXACT with driving ``agent.act(..., eval_mode=True,
+  policy_carry=...)`` by hand (pinned in ``tests/test_router.py`` and
+  ``tests/test_session_batch.py``) — the session API is the
+  training-time act path, not an approximation.
 * :class:`SessionStore` — a bounded, thread-safe map ``session id →
   carry`` with TTL eviction (idle sessions expire; a sweep thread and
   lazy access checks both enforce it) and LRU capacity eviction (the
@@ -65,6 +74,7 @@ import numpy as np
 
 __all__ = [
     "RecurrentServeEngine",
+    "SimulatedCostSessionEngine",
     "SessionStore",
     "CarryJournal",
     "read_carry_journal",
@@ -84,11 +94,14 @@ class RecurrentServeEngine:
     """AOT-compiled eval-mode ``step`` over a swappable params snapshot.
 
     The recurrent twin of :class:`~trpo_tpu.serve.engine.InferenceEngine`:
-    one session's step is a batch-1 program ``(carry, obs) → (action,
-    new_carry)`` compiled ahead-of-time at :meth:`load`, so the
-    steady-state request path never traces. ``with_obs_norm`` folds
-    ``normalize(stats, obs)`` in front of the torso exactly as the
-    training act path does — clients always send RAW observations.
+    the session-step program ``(carry, obs) → (action, new_carry)`` is
+    compiled ahead-of-time at :meth:`load` at a small LADDER of fixed
+    batch rungs (``batch_shapes``), so the steady-state request path
+    never traces at ANY epoch width — :meth:`step_batch` pads a batch
+    of N independent sessions up to the nearest rung and slices the
+    padding back off. ``with_obs_norm`` folds ``normalize(stats, obs)``
+    in front of the torso exactly as the training act path does —
+    clients always send RAW observations.
 
     ``is_recurrent`` is the protocol discriminator the HTTP front end
     and the router read: engines with it set serve ``/session``, engines
@@ -104,6 +117,7 @@ class RecurrentServeEngine:
         obs_shape: Tuple[int, ...],
         with_obs_norm: bool = False,
         obs_dtype=jnp.float32,
+        batch_shapes: Tuple[int, ...] = (1,),
     ):
         if not hasattr(policy, "step") or not hasattr(
             policy, "initial_state"
@@ -113,11 +127,21 @@ class RecurrentServeEngine:
                 "(step/initial_state) — serve a feedforward policy "
                 "through the stateless InferenceEngine instead"
             )
+        if not batch_shapes or any(
+            not isinstance(b, int) or b < 1 for b in batch_shapes
+        ):
+            raise ValueError(
+                f"batch_shapes must be positive ints, got {batch_shapes!r}"
+            )
         self.policy = policy
         self.obs_shape = tuple(obs_shape)
         self.state_size = int(policy.state_size or policy.hidden_size)
         self.with_obs_norm = bool(with_obs_norm)
         self.obs_dtype = np.dtype(obs_dtype)
+        self.batch_shapes = tuple(sorted(set(int(b) for b in batch_shapes)))
+        self.max_batch = self.batch_shapes[-1]
+
+        head = getattr(policy, "head", None)
 
         def _step(params, obs_norm, carry, obs):
             if self.with_obs_norm:
@@ -125,15 +149,36 @@ class RecurrentServeEngine:
 
                 obs = normalize(obs_norm, obs)
             carry_new, dist = policy.step(params, carry, obs)
+            if head is not None:
+                # Bit-exactness across epoch widths (ISSUE 13): the
+                # torso/cell matmuls are WIDE (gates·H columns) and
+                # their per-row results are batch-width-invariant on
+                # this stack (test-pinned), but the NARROW action head
+                # ((H, act_dim) — act_dim is 1 for Pendulum) lowers to
+                # a different reduction order per batch width, drifting
+                # actions by ~1 ulp between rungs. Recompute the head
+                # PER ROW as the exact (1, H) program the training act
+                # path runs — same lowering at every rung, so a session
+                # gets bit-identical actions whether it steps alone or
+                # inside any batched epoch. O(N·H·act_dim) — noise next
+                # to the batched cell; the batched head above is dead
+                # code XLA eliminates.
+                dist = jax.lax.map(
+                    lambda h: jax.tree_util.tree_map(
+                        lambda x: x[0], head(params, h[None])
+                    ),
+                    carry_new,
+                )
             return policy.dist.mode(dist), carry_new
 
         self._step_fn = _step
-        self._compiled = None          # AOT executable (batch 1)
+        self._compiled: dict = {}      # rung -> AOT executable
         self._snapshot = None          # (params, obs_norm, step) — swapped
         #                                atomically by reference
         self._prev_snapshot = None     # one-deep history for rollback()
         self._lock = threading.Lock()  # counters only, never the hot path
         self.steps_total = 0
+        self.shape_counts: Dict[int, int] = {}  # rung -> dispatches
 
     # -- snapshot lifecycle (the InferenceEngine contract) -----------------
 
@@ -148,8 +193,9 @@ class RecurrentServeEngine:
 
     def load(self, params, obs_norm=None, step: Optional[int] = None) -> None:
         """Install a params snapshot; the FIRST load AOT-compiles the
-        batch-1 step program, every later load is a pure reference swap
-        (hot reload — in-flight steps finish on the old params)."""
+        step program at every ladder rung, every later load is a pure
+        reference swap (hot reload — in-flight steps finish on the old
+        params)."""
         if self.with_obs_norm and obs_norm is None:
             raise ValueError(
                 "engine was built with with_obs_norm=True but load() got "
@@ -162,27 +208,27 @@ class RecurrentServeEngine:
                 "got obs-norm statistics — rebuild the engine with "
                 "with_obs_norm=True to serve a normalized policy"
             )
-        if self._compiled is None:
+        if not self._compiled:
             abstract = lambda tree: jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(
                     jnp.shape(x), jnp.asarray(x).dtype
                 ),
                 tree,
             )
-            self._compiled = (
-                jax.jit(self._step_fn)
-                .lower(
-                    abstract(params),
-                    abstract(obs_norm) if self.with_obs_norm else None,
+            params_sds = abstract(params)
+            norm_sds = abstract(obs_norm) if self.with_obs_norm else None
+            fn = jax.jit(self._step_fn)
+            for rung in self.batch_shapes:
+                self._compiled[rung] = fn.lower(
+                    params_sds,
+                    norm_sds,
                     jax.ShapeDtypeStruct(
-                        (1, self.state_size), jnp.float32
+                        (rung, self.state_size), jnp.float32
                     ),
                     jax.ShapeDtypeStruct(
-                        (1,) + self.obs_shape, self.obs_dtype
+                        (rung,) + self.obs_shape, self.obs_dtype
                     ),
-                )
-                .compile()
-            )
+                ).compile()
         self._prev_snapshot = self._snapshot
         self._snapshot = (params, obs_norm, step)
 
@@ -213,12 +259,54 @@ class RecurrentServeEngine:
         installs and what a re-established session restarts from."""
         return np.zeros((self.state_size,), np.float32)
 
+    def padded_shape(self, n: int) -> int:
+        """The rung a batch of ``n`` sessions dispatches at: the
+        smallest ladder shape ≥ n, or the top rung (over-sized epochs
+        chunk)."""
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        for rung in self.batch_shapes:
+            if n <= rung:
+                return rung
+        return self.max_batch
+
     def step(self, carry, obs, return_step: bool = False):
         """Advance ONE session: ``(carry (S,), obs (*obs_shape))`` →
         ``(action, new_carry)`` — or ``(action, new_carry, step)`` with
         the checkpoint step of the snapshot THIS call used (captured
         before the call, so a concurrent hot swap can never mislabel the
-        action's provenance)."""
+        action's provenance). A batch-1 view of :meth:`step_batch` —
+        the single-session and epoch-batched paths run the SAME
+        executables, so parity between them is structural."""
+        carry = np.asarray(carry, np.float32)
+        if carry.shape != (self.state_size,):
+            raise ValueError(
+                f"carry must have shape ({self.state_size},), "
+                f"got {carry.shape}"
+            )
+        obs = np.asarray(obs, self.obs_dtype)
+        if obs.shape != self.obs_shape:
+            raise ValueError(
+                f"obs must have shape {self.obs_shape}, got {obs.shape}"
+            )
+        action, carry_new, ck_step = self.step_batch(
+            carry[None], obs[None], return_step=True
+        )
+        out = (action[0], carry_new[0])
+        return out + (ck_step,) if return_step else out
+
+    def step_batch(self, carries, obs, return_step: bool = False):
+        """Advance N independent sessions in ONE device dispatch:
+        ``(carries (n, S), obs (n, *obs_shape))`` → ``(actions,
+        new_carries)`` — or ``(..., step)`` with the snapshot's
+        checkpoint step. Pads up to the nearest compiled rung with zero
+        rows and slices them back off (row i of every output is a pure
+        function of row i of the inputs — GRU/LSTM steps have no
+        cross-batch coupling, so padding rows are masked by
+        construction and per-row results are BIT-EXACT vs batch-1
+        stepping); over-sized epochs chunk at the top rung. The
+        executables are AOT-compiled at :meth:`load`, so this call
+        never traces."""
         snap = self._snapshot
         if snap is None:
             raise RuntimeError(
@@ -226,27 +314,126 @@ class RecurrentServeEngine:
                 "server at a checkpoint directory) before serving"
             )
         params, obs_norm, ck_step = snap
+        carries = np.asarray(carries, np.float32)
         obs = np.asarray(obs, self.obs_dtype)
-        if obs.shape != self.obs_shape:
+        if (
+            carries.ndim != 2
+            or carries.shape[1] != self.state_size
+        ):
             raise ValueError(
-                f"obs must have shape {self.obs_shape}, got {obs.shape}"
+                f"carries must be (n, {self.state_size}), "
+                f"got shape {carries.shape}"
             )
-        carry = np.asarray(carry, np.float32)
-        if carry.shape != (self.state_size,):
+        if obs.ndim != 1 + len(self.obs_shape) or (
+            obs.shape[1:] != self.obs_shape
+        ):
             raise ValueError(
-                f"carry must have shape ({self.state_size},), "
-                f"got {carry.shape}"
+                f"obs must be (n, {', '.join(map(str, self.obs_shape))}), "
+                f"got shape {obs.shape}"
             )
-        action, carry_new = self._compiled(
-            params, obs_norm, carry[None], obs[None]
-        )
+        if carries.shape[0] != obs.shape[0]:
+            raise ValueError(
+                f"carries and obs disagree on the session count: "
+                f"{carries.shape[0]} vs {obs.shape[0]}"
+            )
+        n = obs.shape[0]
+        if n < 1:
+            raise ValueError("step_batch needs at least one session row")
+        act_outs = []
+        carry_outs = []
+        i = 0
+        while i < n:
+            c_chunk = carries[i : i + self.max_batch]
+            o_chunk = obs[i : i + self.max_batch]
+            width = o_chunk.shape[0]
+            rung = self.padded_shape(width)
+            if width != rung:
+                c_chunk = np.concatenate(
+                    [
+                        c_chunk,
+                        np.zeros(
+                            (rung - width, self.state_size), np.float32
+                        ),
+                    ],
+                    axis=0,
+                )
+                o_chunk = np.concatenate(
+                    [
+                        o_chunk,
+                        np.zeros(
+                            (rung - width,) + self.obs_shape,
+                            self.obs_dtype,
+                        ),
+                    ],
+                    axis=0,
+                )
+            action, carry_new = self._compiled[rung](
+                params, obs_norm, c_chunk, o_chunk
+            )
+            act_outs.append(np.asarray(action)[:width])
+            carry_outs.append(np.asarray(carry_new, np.float32)[:width])
+            with self._lock:
+                self.shape_counts[rung] = (
+                    self.shape_counts.get(rung, 0) + 1
+                )
+            i += self.max_batch
         with self._lock:
-            self.steps_total += 1
-        out = (
-            np.asarray(action)[0],
-            np.asarray(carry_new, np.float32)[0],
+            self.steps_total += n
+        actions = (
+            act_outs[0]
+            if len(act_outs) == 1
+            else np.concatenate(act_outs, axis=0)
         )
+        new_carries = (
+            carry_outs[0]
+            if len(carry_outs) == 1
+            else np.concatenate(carry_outs, axis=0)
+        )
+        out = (actions, new_carries)
         return out + (ck_step,) if return_step else out
+
+
+class SimulatedCostSessionEngine:
+    """A recurrent-engine wrapper charging a fixed per-DISPATCH cost —
+    the session twin of :class:`~trpo_tpu.serve.engine.SimulatedCostEngine`.
+
+    The device is ONE serial resource: it runs one step program at a
+    time whether that program advances 1 session or 64. So the wrapper
+    serializes dispatches behind a lock and sleeps ``cost_ms`` (GIL-
+    free) per dispatch, batch-1 or batched — which is exactly the
+    economics continuous batching exploits: N serialized batch-1 steps
+    cost N × ``cost_ms``, one ``(N, carry)`` epoch costs ~1 ×. The
+    calibrated CPU bench (``bench.py serving_sessions``) and the
+    check.sh smoke measure the BATCHER/epoch control plane against this
+    capacity model instead of this host's core count; production paths
+    never wear it.
+    """
+
+    def __init__(self, engine, cost_ms: float):
+        if cost_ms < 0:
+            raise ValueError(f"cost_ms must be >= 0, got {cost_ms}")
+        self._engine = engine
+        self.cost_ms = float(cost_ms)
+        self._dispatch_lock = threading.Lock()
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+    def _charge(self):
+        if self.cost_ms > 0:
+            time.sleep(self.cost_ms / 1e3)
+
+    def step(self, carry, obs, return_step: bool = False):
+        with self._dispatch_lock:  # the device is serial: one program
+            self._charge()         # in flight at a time
+            return self._engine.step(carry, obs, return_step=return_step)
+
+    def step_batch(self, carries, obs, return_step: bool = False):
+        with self._dispatch_lock:
+            self._charge()
+            return self._engine.step_batch(
+                carries, obs, return_step=return_step
+            )
 
 
 class _Session:
