@@ -44,6 +44,10 @@ echo "== tier-1: elastic autoscaler (hysteresis, drain, admission, storms) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_autoscaler.py -q \
     -m 'not slow'
 
+echo "== tier-1: multi-host serving (transport, leases, write fencing) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_multihost_serve.py -q \
+    -m 'not slow'
+
 echo "== tier-1: env fleet (chunked rollouts, wide-N presets, env-steps/s) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_env_fleet.py -q \
     -m 'not slow'
@@ -222,6 +226,27 @@ ROUTER_TMP=$(mktemp -d)
 JAX_PLATFORMS=cpu python scripts/router_smoke.py --tmp "$ROUTER_TMP"
 python scripts/validate_events.py "$ROUTER_TMP/router_events.jsonl"
 python scripts/analyze_run.py "$ROUTER_TMP/router_events.jsonl"
+
+echo "== partition smoke: 2-host set, 10 s partition, lease-fenced zombie =="
+# the ISSUE 14 acceptance scenario: a 2-host recurrent replica set
+# (real serve.py children behind a local TemplateTransport — the exact
+# seam an ssh/kubectl template plugs into) under concurrent session
+# load has one host partitioned for 10 s (transport blackholed both
+# ways; the child PROCESSES keep running). Every session pinned there
+# must resume BIT-EXACT on the survivor from the carry journal
+# (`resumed: true`, seq continuity preserved) with zero client-visible
+# errors beyond typed 503s; the partitioned replica must be evicted
+# via LEASE EXPIRY (never a failed-poll misread) and relaunched on the
+# surviving host; and the partitioned-but-alive zombie's post-takeover
+# journal write for the migrated session must be REFUSED (the fence),
+# recorded in the zombie's own event log. All logs must validate
+# (partition matched by lease_expired + session resumed; expired
+# leases resolved) and the router log must analyze (host/lease rows).
+PART_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu python scripts/partition_smoke.py --tmp "$PART_TMP"
+python scripts/validate_events.py "$PART_TMP/partition_events.jsonl" \
+    "$PART_TMP"/child-*.jsonl
+python scripts/analyze_run.py "$PART_TMP/partition_events.jsonl"
 
 echo "== session batching smoke: 16 concurrent sessions, parity + >=4x =="
 # ISSUE 13 acceptance: (a) a recurrent replica under >= 16 CONCURRENT

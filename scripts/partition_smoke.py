@@ -1,0 +1,355 @@
+#!/usr/bin/env python
+"""Multi-host partition smoke (``check.sh``): the ISSUE 14 acceptance.
+
+    python scripts/partition_smoke.py --tmp DIR
+
+One scenario, end to end, with REAL ``scripts/serve.py`` subprocess
+children standing in for remote hosts (a local
+:class:`~trpo_tpu.serve.transport.TemplateTransport` over two named
+hosts — the exact seam an ssh/kubectl template plugs into, minus the
+network):
+
+1. **2-host recurrent set** — hosts ``hostA``/``hostB``, one replica
+   each, launched through the ``{host}``/``{replica}``-rendered
+   template, discovered via their run.json descriptors over the
+   bounded-retry transport path, leases armed.
+2. **Concurrent session load** — sessions created through the router
+   (each pinned per placement), every action checked BIT-EXACT against
+   driving ``agent.act(..., policy_carry=...)`` by hand.
+3. **Partition** — ``partition_host@request=K:host=<pinned>:seconds=10``
+   injected through the chaos grammar: the pinned host's transport is
+   blackholed both ways while its child PROCESSES keep running.
+   Every session pinned there must answer its next act ``resumed:
+   true`` from the carry journal on the survivor, continuation
+   BIT-EXACT, with zero client-visible errors beyond typed 503s.
+4. **Lease-fenced liveness** — the partitioned replica must be evicted
+   via LEASE EXPIRY (``lease:expired`` in the log — never a
+   failed-poll misread), its relaunch placed on the surviving host,
+   and the host marked ``suspect`` on the way down.
+5. **Zombie fencing** — the partitioned-but-alive child (the gated
+   kill leaves it running — exactly what a real partition does) is
+   poked DIRECTLY (a split-brain client): it answers, but its journal
+   write for the migrated session must be REFUSED — the journal file
+   still holds the takeover-time snapshot, and the child's own event
+   log records ``lease:fenced_write_refused``.
+6. Every event log (the router's and each child's) must pass
+   ``scripts/validate_events.py`` — including the partition fault's
+   detection pairing (lease_expired on that host + session resumed) —
+   and the router log must analyze (host/lease rows).
+
+Exit 0 on success; any assertion failure exits nonzero with the reason.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _post(url, payload=None, timeout=30.0):
+    data = b"" if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="partition_smoke.py")
+    p.add_argument("--tmp", required=True, help="scratch directory")
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.obs.events import EventBus, JsonlSink, manifest_fields
+    from trpo_tpu.resilience.inject import FaultInjector
+    from trpo_tpu.serve import (
+        ReplicaSet,
+        Router,
+        TemplateTransport,
+        journal_path,
+        read_carry_journal,
+    )
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    os.makedirs(args.tmp, exist_ok=True)
+    events_path = os.path.join(args.tmp, "partition_events.jsonl")
+    bus = EventBus(JsonlSink(events_path))
+    bus.emit(
+        "run_manifest",
+        **manifest_fields(None, extra={"driver": "partition_smoke"}),
+    )
+
+    # the checkpoint the children serve: a tiny recurrent pendulum
+    # policy (recurrent = the session protocol + carry journal are in
+    # play, which is what a partition endangers)
+    cfg = TRPOConfig(
+        n_envs=4, batch_timesteps=32, cg_iters=2, vf_train_steps=2,
+        policy_hidden=(8,), vf_hidden=(8,), seed=5, policy_gru=8,
+    )
+    agent = TRPOAgent("pendulum", cfg)
+    state = agent.init_state(seed=0)
+    ck_dir = os.path.join(args.tmp, "ck")
+    trainer_ck = Checkpointer(ck_dir)
+    trainer_ck.save(1, state)
+    trainer_ck.close()
+
+    jdir = os.path.join(args.tmp, "carry_journal")
+    template = (
+        f"{sys.executable} {os.path.join(_REPO, 'scripts', 'serve.py')} "
+        "--checkpoint-dir {checkpoint} --port 0 --platform cpu "
+        "--preset pendulum --policy-hidden 8 --vf-hidden 8 --n-envs 4 "
+        "--policy-gru 8 --serve-seconds 600 "
+        f"--carry-journal-dir {jdir} "
+        "--replica-name {replica} "
+        f"--metrics-jsonl {args.tmp}/child-{{replica}}.jsonl"
+    )
+    transport = TemplateTransport(
+        template,
+        ("hostA", "hostB"),
+        checkpoint=ck_dir,
+        replica_root=os.path.join(args.tmp, "replicas"),
+    )
+    rs = ReplicaSet(
+        None, 2,
+        transport=transport,
+        lease_ttl=3.0,
+        health_interval=0.3,
+        backoff=0.3,
+        max_restarts=3,
+        start_timeout=180.0,
+        bus=bus,
+    )
+    rs.start()
+    assert rs.wait_healthy(2, timeout=180.0), rs.snapshot()
+    router = Router(rs, port=0, bus=bus, journal_dir=jdir)
+    try:
+        snap = rs.snapshot()
+        hosts = {rid: row["host"] for rid, row in snap["replicas"].items()}
+        assert set(hosts.values()) == {"hostA", "hostB"}, hosts
+
+        # -- concurrent sessions, each checked bit-exact -----------------
+        n_probe, T = 4, 6
+        sessions = []
+        for k in range(n_probe):
+            status, out = _post(router.url + "/session")
+            assert status == 200, out
+            obs_seq = [
+                np.random.RandomState(1000 + 31 * k + i)
+                .randn(*agent.obs_shape).astype(np.float32)
+                for i in range(T + 6)
+            ]
+            carry = None
+            direct = []
+            for o in obs_seq:
+                a, _d, carry = agent.act(
+                    state, o, eval_mode=True, policy_carry=carry
+                )
+                direct.append(np.asarray(a, np.float64))
+            sessions.append({
+                "sid": out["session"], "pinned": out["replica"],
+                "obs": obs_seq, "direct": direct, "t": 0,
+            })
+        # sequential creates pin least-inflight (ties by id), so the
+        # probes share a replica — the partition below targets exactly
+        # that host, so every probe session crosses the host boundary
+
+        sheds = [0]
+
+        def step(sess, expect_resumed=None):
+            """One probe act, absorbing only typed 503 sheds."""
+            t = sess["t"]
+            for _ in range(100):
+                status, out = _post(
+                    router.url + f"/session/{sess['sid']}/act",
+                    {"obs": sess["obs"][t].tolist()},
+                )
+                if status == 503:
+                    sheds[0] += 1
+                    time.sleep(0.1)
+                    continue
+                assert status == 200, (status, out)
+                if expect_resumed is True:
+                    assert out.get("resumed") is True, out
+                    assert out.get("resumed_steps") == t, out
+                elif expect_resumed is False:
+                    assert "resumed" not in out, out
+                assert np.array_equal(
+                    np.asarray(out["action"], np.float64),
+                    sess["direct"][t],
+                ), f"session {sess['sid']} diverged at step {t}"
+                sess["t"] = t + 1
+                return out
+            raise AssertionError("act shed past every retry")
+
+        # background load keeps flowing through the whole scenario
+        stop = threading.Event()
+        bg_errors: list = []
+
+        def bg_load(seed: int) -> None:
+            s_, o_ = _post(router.url + "/session")
+            if s_ != 200:
+                bg_errors.append((s_, o_))
+                return
+            bsid = o_["session"]
+            r = np.random.RandomState(seed)
+            while not stop.is_set():
+                try:
+                    s_, o_ = _post(
+                        router.url + f"/session/{bsid}/act",
+                        {"obs": r.randn(*agent.obs_shape).tolist()},
+                    )
+                    if s_ == 503:
+                        sheds[0] += 1
+                    elif s_ != 200:
+                        bg_errors.append((s_, o_))
+                except Exception as e:  # noqa: BLE001 — collected
+                    bg_errors.append(repr(e))
+                time.sleep(0.1)
+
+        bg = [
+            threading.Thread(target=bg_load, args=(i,), daemon=True)
+            for i in range(2)
+        ]
+        for th in bg:
+            th.start()
+
+        for sess in sessions:
+            for _ in range(4):
+                step(sess, expect_resumed=False)
+
+        # journals current before the cut (carry_sync_every=1 +
+        # write-behind: give the children's drains a beat)
+        time.sleep(1.0)
+
+        # -- partition the first session's host for 10 s -----------------
+        victim_sess = sessions[0]
+        victim_rid = victim_sess["pinned"]
+        victim_host = hosts[victim_rid]
+        zombie_url = rs.replicas[victim_rid].url
+        partition_secs = 10.0
+        router.injector = FaultInjector.from_spec(
+            f"partition_host@request=1:host={victim_host}"
+            f":seconds={partition_secs:g}",
+            bus=bus,
+        )
+        t_cut = time.monotonic()
+        # the act that trips the injector is also the act that fails
+        # over: resumed from the journal on the survivor, bit-exact
+        step(victim_sess, expect_resumed=True)
+        assert router.injector.all_fired
+        # every OTHER session pinned to the same host must also resume
+        for sess in sessions[1:]:
+            if hosts[sess["pinned"]] == victim_host:
+                step(sess, expect_resumed=True)
+            else:
+                step(sess, expect_resumed=False)
+
+        # -- zombie fencing ---------------------------------------------
+        # the partitioned child is alive (the gated kill cannot reach
+        # it). A split-brain client stepping it directly gets answers —
+        # but its journal write for the migrated session is REFUSED.
+        jp = journal_path(jdir, victim_rid, host=victim_host)
+        pre = read_carry_journal(jp)[victim_sess["sid"]]["steps"]
+        status, out = _post(
+            zombie_url + f"/session/{victim_sess['sid']}/act",
+            {"obs": victim_sess["obs"][victim_sess["t"]].tolist()},
+            timeout=30.0,
+        )
+        assert status == 200, (status, out)  # split-brain answers —
+        #                                      the JOURNAL is the fence
+        time.sleep(1.5)  # let the zombie's write-behind attempt flush
+        post = read_carry_journal(jp)[victim_sess["sid"]]["steps"]
+        assert post == pre, (
+            f"zombie clobbered the migrated session's journal: "
+            f"{pre} -> {post}"
+        )
+
+        # -- lease expiry evicts; relaunch lands on the survivor ---------
+        deadline = time.monotonic() + partition_secs + 60.0
+        relaunched = False
+        while time.monotonic() < deadline:
+            rec = rs.replicas[victim_rid]
+            with rs.lock:
+                ok = rec.state == "healthy" and rec.restarts >= 1
+            if ok:
+                relaunched = True
+                break
+            time.sleep(0.2)
+        assert relaunched, rs.snapshot()
+        other_host = "hostB" if victim_host == "hostA" else "hostA"
+        assert rs.replicas[victim_rid].host == other_host, rs.snapshot()
+
+        # -- post-heal continuation stays bit-exact ----------------------
+        remaining = partition_secs - (time.monotonic() - t_cut)
+        if remaining > 0:
+            time.sleep(remaining + 0.5)
+        for sess in sessions:
+            for _ in range(2):
+                step(sess)
+
+        stop.set()
+        for th in bg:
+            th.join(timeout=30.0)
+            assert not th.is_alive(), "background session hung"
+        assert not bg_errors, (
+            f"{len(bg_errors)} non-typed client errors: {bg_errors[:5]}"
+        )
+        resumed_count = router.sessions_resumed_total
+        print(
+            f"partition smoke: host {victim_host} partitioned "
+            f"{partition_secs:g}s -> {resumed_count} sessions resumed "
+            "bit-exact on the survivor (journal-backed), lease expiry "
+            "evicted the partitioned replica (relaunched on "
+            f"{rs.replicas[victim_rid].host}), zombie journal write "
+            f"REFUSED (steps held at {pre}), {sheds[0]} typed 503 "
+            "sheds, zero other client-visible errors"
+        )
+    finally:
+        router.close()
+        rs.close()
+        bus.close()
+
+    # the zombie's own event log must record the fencing refusal
+    child_logs = sorted(glob.glob(os.path.join(args.tmp, "child-*.jsonl")))
+    assert child_logs, "children wrote no event logs"
+    zombie_log = os.path.join(
+        args.tmp, f"child-{victim_host}--{victim_rid}.jsonl"
+    )
+    with open(zombie_log) as f:
+        fenced = [
+            json.loads(line) for line in f
+            if '"fenced_write_refused"' in line
+        ]
+    assert fenced, (
+        f"zombie log {zombie_log} has no fenced_write_refused record"
+    )
+    print(
+        f"partition smoke OK — events at {events_path} + "
+        f"{len(child_logs)} child logs (zombie refusal recorded in "
+        f"{os.path.basename(zombie_log)})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -197,6 +197,25 @@ def build_parser() -> argparse.ArgumentParser:
         "model/session flags",
     )
     p.add_argument(
+        "--hosts",
+        help="comma-separated host names for MULTI-HOST replica "
+        "placement (requires --replica-cmd; the template's {host} is "
+        "the ssh/kubectl target, e.g. 'ssh {host} python .../serve.py "
+        "--port 0 ...'): replicas place round-robin across hosts, "
+        "suspect hosts are avoided, and liveness switches to "
+        "lease-fenced mode — eviction on lease expiry, not on a "
+        "failed poll, so a partitioned host's sessions resume "
+        "losslessly on survivors while its zombies' journal writes "
+        "are fenced",
+    )
+    p.add_argument(
+        "--lease-ttl", type=float,
+        help="replica lease TTL seconds (must exceed "
+        "--health-interval; default 3): each answered healthz renews "
+        "the lease, and only EXPIRY evicts — also armable without "
+        "--hosts to get lease semantics on a local set",
+    )
+    p.add_argument(
         "--health-interval", type=float,
         help="replica supervisor /healthz poll seconds (default 0.5)",
     )
@@ -317,6 +336,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ReplicaSet,
         Router,
         SubprocessReplica,
+        TemplateTransport,
         render_launch_argv,
     )
     from trpo_tpu.utils.checkpoint import Checkpointer
@@ -367,6 +387,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         updates["serve_drain_timeout"] = args.drain_timeout
     if args.replica_cmd is not None:
         updates["serve_replica_cmd"] = args.replica_cmd
+    if args.hosts:
+        updates["serve_hosts"] = tuple(
+            h.strip() for h in args.hosts.split(",") if h.strip()
+        )
+    if args.lease_ttl is not None:
+        updates["serve_lease_ttl"] = args.lease_ttl
     if args.health_interval is not None:
         updates["serve_health_interval"] = args.health_interval
     if args.replica_restarts is not None:
@@ -420,6 +446,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "error: --min-replicas only bounds the elastic autoscaler "
             "— pass --max-replicas to arm it (a floor without a "
             "ceiling would silently do nothing).",
+            file=sys.stderr,
+        )
+        return 2
+    if cfg.serve_hosts and not cfg.serve_replica_cmd:
+        # the PR 12 arming contract, extended across the host
+        # boundary: hosts are PLACEMENT TARGETS for the launch
+        # template — without one there is nothing that can launch on
+        # them, and silently serving in-process would fake a
+        # multi-host set on one machine
+        print(
+            "error: --hosts places replicas through the --replica-cmd "
+            "launch template — pass --replica-cmd with a {host} target "
+            "(e.g. 'ssh {host} python .../scripts/serve.py --port 0 "
+            "--checkpoint-dir {checkpoint} ... --replica-name "
+            "{replica}') or drop --hosts.",
             file=sys.stderr,
         )
         return 2
@@ -562,7 +603,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     server = None
     closers: list = []
     if cfg.serve_replicas > 1:
-        if cfg.serve_replica_cmd:
+        transport = None
+        launcher = None
+        if cfg.serve_hosts:
+            # multi-host (ISSUE 14): the TemplateTransport owns
+            # placement (round-robin, suspect hosts avoided), renders
+            # {host}/{replica} into the template, and discovers each
+            # child's descriptor under the bounded retry budget;
+            # lease-fenced liveness is armed below
+            transport = TemplateTransport(
+                cfg.serve_replica_cmd,
+                cfg.serve_hosts,
+                checkpoint=os.path.abspath(args.checkpoint_dir),
+                replica_root=os.path.join(
+                    os.path.abspath(args.checkpoint_dir), "replicas"
+                ),
+            )
+        elif cfg.serve_replica_cmd:
             # templated subprocess children (cfg.serve_replica_cmd):
             # the rendered command owns the child's flags; each child
             # is discovered via the appended --run-descriptor — the
@@ -587,12 +644,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 return InProcessReplica(
                     lambda: build_replica(rid, port=0)
                 )
+        # lease liveness: always armed across hosts (a failed poll
+        # proves nothing through a partition); opt-in locally via an
+        # explicit --lease-ttl
+        lease_ttl = (
+            cfg.serve_lease_ttl
+            if (cfg.serve_hosts or args.lease_ttl is not None)
+            else None
+        )
         replicaset = ReplicaSet(
             launcher,
             cfg.serve_replicas,
             health_interval=cfg.serve_health_interval,
             max_restarts=cfg.serve_replica_restarts,
             bus=bus,
+            transport=transport,
+            lease_ttl=lease_ttl,
         )
         replicaset.start()
         router = Router(

@@ -43,7 +43,19 @@ half-retired replica), and the storm faults must each be matched:
 ``overload_storm`` by a scale/shed reaction (``autoscale``
 ``scale_out``/``shed``), ``slow_replica`` by a scale/shed reaction OR
 the targeted replica's eviction, ``flap_replica`` by the targeted
-replica's died/evicted records.
+replica's died/evicted records; and — ISSUE 14 — every ``lease``
+record with ``event="expired"`` must be FOLLOWED by the same
+replica's ``died``/``evicted`` resolution or a re-granted lease (an
+expiry nothing acted on means the lease-liveness loop is broken),
+and the partition faults must each be matched:
+``partition_host`` by a lease EXPIRY on the partitioned host AND a
+session resumed on a survivor (detection must come from the lease,
+and the takeover must be journal-backed), ``slow_network`` by a
+scale/shed reaction, the slow host's lease expiry, or an eviction of
+one of ITS replicas (host-filtered — unrelated churn must not
+satisfy it), ``lost_descriptor`` by a replica death/failure whose
+reason names the descriptor (the launch failed LOUDLY — a phantom
+``starting`` record is exactly what this matcher would miss).
 Exits non-zero with per-line diagnostics on any failure; prints a
 per-kind count summary on success. Used by ``scripts/check.sh`` against
 both a training run's ``--metrics-jsonl`` output and ``bench.py``'s
@@ -146,6 +158,50 @@ def _fault_matcher(fault_rec: dict):
             and rec.get("event") == "rolled_back"
             and rec.get("step") == at
         )
+    if fault_kind in ("partition_host", "slow_network"):
+        host = fault_rec.get("host")
+
+        def _lease_expired(rec):
+            return (
+                rec.get("kind") == "lease"
+                and rec.get("event") == "expired"
+                and (host is None or rec.get("host") == host)
+            )
+
+        if fault_kind == "partition_host":
+            # detection MUST come from lease expiry on the partitioned
+            # host (a failed poll proves nothing across a partition);
+            # the session-resumed half of the pairing is enforced by a
+            # dedicated check in validate_file (a single-predicate
+            # matcher cannot require two distinct records)
+            return _lease_expired
+        # slow_network: the metrics reacted (scale/shed), the slow
+        # host's lease starved out, or one of ITS replicas was
+        # evicted — host-filtered, or any chaos run's unrelated churn
+        # (a retried request, some other replica's death) would
+        # satisfy the matcher vacuously
+        return lambda rec: (
+            _lease_expired(rec)
+            or (
+                rec.get("kind") == "autoscale"
+                and rec.get("event") in ("scale_out", "shed")
+            )
+            or (
+                rec.get("kind") == "router"
+                and rec.get("scope") == "replica"
+                and rec.get("state") in ("died", "evicted")
+                and (host is None or rec.get("host") == host)
+            )
+        )
+    if fault_kind == "lost_descriptor":
+        # the launch must fail LOUDLY: a died/failed record naming the
+        # descriptor — never a phantom `starting` record
+        return lambda rec: (
+            rec.get("kind") == "router"
+            and rec.get("scope") == "replica"
+            and rec.get("state") in ("died", "failed")
+            and "descriptor" in str(rec.get("reason", ""))
+        )
     if fault_kind == "drop_carry_journal":
         # losing the journal must surface as the loud fresh-carry
         # fallback, never as a silent wrong resume. (The reestablished
@@ -226,6 +282,22 @@ def validate_file(path: str) -> list:
                 f"{path}:{n}: fault_injected ({rec.get('spec')}) has no "
                 "matching detection/recovery record after it"
             )
+        if rec.get("fault") == "partition_host":
+            # the second half of the partition pairing (ISSUE 14): the
+            # lease-evicted host's sessions must have RESUMED on a
+            # survivor from the carry journal — a partition whose
+            # takeover was not journal-backed lost state silently
+            if not any(
+                later.get("kind") == "session"
+                and later.get("event") == "resumed"
+                for _, later in records[idx + 1:]
+            ):
+                errs.append(
+                    f"{path}:{n}: fault_injected ({rec.get('spec')}) "
+                    "has no session:resumed record after it — the "
+                    "partitioned host's sessions never resumed on a "
+                    "survivor"
+                )
     # ISSUE 8 solver-precision contract (same pattern as the
     # fault-matching rule): a rise in the run-cumulative `fallbacks`
     # counter means an audit failed and the update fell back — the
@@ -318,6 +390,33 @@ def validate_file(path: str) -> list:
             errs.append(
                 f"{path}:{n}: canary for step {step} started with no "
                 "matching promoted/rolled_back terminal record after it"
+            )
+    # ISSUE 14 lease contract (the replica `died` pattern): an expired
+    # lease the supervisor neither evicted on nor re-granted means the
+    # lease-liveness loop is broken — a partitioned host's replicas
+    # would hold their rotation slots (and their sessions) forever
+    for idx, (n, rec) in enumerate(records):
+        if rec.get("kind") != "lease" or rec.get("event") != "expired":
+            continue
+        replica = rec.get("replica")
+        if not any(
+            (
+                later.get("kind") == "router"
+                and later.get("scope") == "replica"
+                and later.get("replica") == replica
+                and later.get("state") in ("died", "evicted")
+            )
+            or (
+                later.get("kind") == "lease"
+                and later.get("replica") == replica
+                and later.get("event") == "granted"
+            )
+            for _, later in records[idx + 1:]
+        ):
+            errs.append(
+                f"{path}:{n}: lease for replica {replica!r} expired "
+                "with no matching died/evicted resolution (or "
+                "re-granted lease) record after it"
             )
     # ISSUE 12 drain contract (the canary `started` pattern): a drain
     # that started with no later same-replica completed/aborted

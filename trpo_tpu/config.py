@@ -636,6 +636,28 @@ class TRPOConfig:
     #                                deadline admission) will act on it
     #                                — a 3-request "p99" is noise, not
     #                                a signal
+    # --- multi-host serving (serve/transport — ISSUE 14) ------------------
+    serve_hosts: Optional[Tuple[str, ...]] = None  # named hosts the
+    #                                replica launch template places
+    #                                replicas on (serve.py --hosts,
+    #                                round-robin, suspect hosts
+    #                                avoided); requires
+    #                                serve_replica_cmd (the template's
+    #                                {host} is the ssh/kubectl target).
+    #                                None (default) = single-host
+    #                                local launch, behavior-pinned.
+    #                                Arming hosts also arms LEASE
+    #                                liveness: eviction on lease
+    #                                expiry, not on a failed poll — a
+    #                                partitioned host's replicas are
+    #                                alive, just unreachable
+    serve_lease_ttl: float = 3.0   # replica lease TTL seconds: renewed
+    #                                by every answered healthz
+    #                                exchange; expiry is the eviction
+    #                                trigger for multi-host sets. Must
+    #                                exceed serve_health_interval (a
+    #                                lease shorter than its renewal
+    #                                cadence expires between polls)
     serve_replica_cmd: Optional[str] = None  # replica launch template
     #                                (serve.py --replica-cmd, rendered
     #                                by replicaset.render_launch_argv):
@@ -941,6 +963,33 @@ class TRPOConfig:
                 "serve_replica_cmd must be a non-empty command template "
                 "(or None for the local scripts/serve.py child)"
             )
+        if self.serve_hosts is not None and (
+            self.serve_lease_ttl <= self.serve_health_interval
+        ):
+            # judged only when leases are ARMED (multi-host): a config
+            # that never serves multi-host must not fail over a lease
+            # default it never uses (ReplicaSet re-validates whenever a
+            # lease_ttl is actually passed, covering --lease-ttl-only
+            # arming)
+            raise ValueError(
+                "serve_lease_ttl must exceed serve_health_interval (a "
+                "lease shorter than its renewal cadence expires between "
+                f"polls), got ttl={self.serve_lease_ttl} "
+                f"interval={self.serve_health_interval}"
+            )
+        if self.serve_hosts is not None:
+            hosts = tuple(self.serve_hosts)
+            if not hosts or any(
+                not isinstance(h, str) or not h for h in hosts
+            ):
+                raise ValueError(
+                    "serve_hosts must be a non-empty tuple of host "
+                    f"names, got {self.serve_hosts!r}"
+                )
+            if len(set(hosts)) != len(hosts):
+                raise ValueError(
+                    f"serve_hosts has duplicate names: {self.serve_hosts!r}"
+                )
         if self.inject_faults:
             # fail at construction: a chaos run with an unparseable spec
             # would otherwise "pass" by injecting nothing

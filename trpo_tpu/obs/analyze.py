@@ -43,6 +43,13 @@ Comparison semantics (:func:`compare_runs`):
   ``drain_aborted`` is a strict counter (a drain that could not move
   its sessions losslessly is never noise), drain duration time-like,
   shed totals grow-is-worse;
+* multi-host liveness (ISSUE 14, ``lease`` + ``router scope="host"``
+  events): the per-host replica table, lease grant/renew/expire
+  counts, fenced journal-write refusals, and injected partition
+  durations; ``lease_expired`` and ``fenced_write_refused`` are
+  strict counters between clean runs — a lease expiring (or a
+  split-brain writer being refused) where the base run had none is a
+  liveness event, never noise;
 * phases below ``min_ms`` in BOTH runs are skipped (a 0.1 ms phase
   doubling is scheduler noise, not a regression), as are metrics absent
   from either run (no silent verdict about unmeasured things — they are
@@ -197,7 +204,19 @@ def _summarize_router(records: list) -> Optional[dict]:
     sessions = [r for r in records if r.get("kind") == "session"]
     canary = [r for r in records if r.get("kind") == "canary"]
     autoscale = [r for r in records if r.get("kind") == "autoscale"]
-    if not reqs and not lifecycle:
+    lease = [r for r in records if r.get("kind") == "lease"]
+    host_recs = [
+        r for r in records
+        if r.get("kind") == "router" and r.get("scope") == "host"
+    ]
+    partitions = [
+        r for r in records
+        if r.get("kind") == "fault_injected"
+        and r.get("fault") == "partition_host"
+    ]
+    if not reqs and not lifecycle and not lease:
+        # lease-only logs (a fenced zombie's own event file) still get
+        # a summary — the fencing refusals are the story there
         return None
     ok_reqs = [r for r in reqs if r.get("ok")]
     lats = [r.get("ms") for r in ok_reqs]
@@ -273,6 +292,85 @@ def _summarize_router(records: list) -> Optional[dict]:
         "failover": _failover_rows(sessions),
         "canary": _canary_rows(canary),
         "autoscale": _autoscale_rows(autoscale),
+        "hosts": _host_rows(lifecycle, lease, host_recs),
+        "lease": _lease_rows(lease, partitions),
+    }
+
+
+def _host_rows(lifecycle: list, lease: list, host_recs: list):
+    """Per-host replica table (ISSUE 14): which replicas ran where,
+    deaths and lease expiries per host, and the host's last recorded
+    health state. None for single-host logs that never stamped a host
+    on anything."""
+    hosts: dict = {}
+
+    def _row(host):
+        return hosts.setdefault(
+            str(host),
+            {"replicas": set(), "deaths": 0, "lease_expired": 0,
+             "last_state": None},
+        )
+
+    for r in lifecycle:
+        host = r.get("host")
+        if host is None:
+            continue
+        row = _row(host)
+        if isinstance(r.get("replica"), str):
+            row["replicas"].add(r["replica"])
+        if r.get("state") == "died":
+            row["deaths"] += 1
+    for r in lease:
+        host = r.get("host")
+        if host is None:
+            continue
+        row = _row(host)
+        if isinstance(r.get("replica"), str):
+            row["replicas"].add(r["replica"])
+        if r.get("event") == "expired":
+            row["lease_expired"] += 1
+    for r in host_recs:
+        host = r.get("host")
+        if host is None:
+            continue
+        state = r.get("state")
+        _row(host)["last_state"] = (
+            state if isinstance(state, str) else "unknown"
+        )
+    if not hosts:
+        return None
+    return {
+        host: {**row, "replicas": sorted(row["replicas"])}
+        for host, row in sorted(hosts.items())
+    }
+
+
+def _lease_rows(lease: list, partitions: list):
+    """Lease-liveness summary (ISSUE 14): grant/renew/expire counts,
+    fenced journal-write refusals (count + distinct sessions — the
+    split-brain writers the fence silenced), and the injected
+    partition durations. None for logs with neither lease records nor
+    partitions."""
+    if not lease and not partitions:
+        return None
+    counts = Counter(r.get("event") for r in lease)
+    fenced_sessions = {
+        r.get("session") for r in lease
+        if r.get("event") == "fenced_write_refused"
+        and isinstance(r.get("session"), str)
+    }
+    durations = [
+        r.get("seconds") for r in partitions
+        if _finite(r.get("seconds")) is not None
+    ]
+    return {
+        "granted": counts.get("granted", 0),
+        "renewed": counts.get("renewed", 0),
+        "expired": counts.get("expired", 0),
+        "fenced_write_refused": counts.get("fenced_write_refused", 0),
+        "fenced_sessions": len(fenced_sessions),
+        "partitions_injected": len(partitions),
+        "partition_seconds_max": max(durations) if durations else None,
     }
 
 
@@ -839,6 +937,26 @@ def compare_runs(
             )
             shed_row["direction"] = "count"
             verdicts.append(shed_row)
+        # multi-host liveness verdicts (ISSUE 14): lease expiries and
+        # fenced (split-brain) journal writes are STRICT counters —
+        # the drain_aborted pattern: between two supposedly-clean runs
+        # a lease expiring, or a zombie writer needing to be refused,
+        # is a liveness event no noise threshold excuses
+        b_ls = b_rt.get("lease") or {}
+        n_ls = n_rt.get("lease") or {}
+        if b_ls or n_ls:
+            for key in ("expired", "fenced_write_refused"):
+                b_v = b_ls.get(key) or 0
+                n_v = n_ls.get(key) or 0
+                verdicts.append({
+                    "metric": f"router/lease_{key}"
+                    if key == "expired" else f"router/{key}",
+                    "base": b_v,
+                    "new": n_v,
+                    "direction": "count",
+                    "delta_pct": None,
+                    "verdict": "regressed" if n_v > b_v else "ok",
+                })
 
     # solver-precision counters (ISSUE 8) — only when at least one run
     # carried the ladder. `fallbacks` is judged as a strict counter: ANY
@@ -1079,6 +1197,31 @@ def render_summary(summary: dict) -> str:
                     if reasons else ""
                 )
                 + f" drain_max={_fmt(asr.get('drain_duration_max_s'))}s"
+            )
+        hosts = rt.get("hosts") or {}
+        if hosts:
+            out.append(format_table(
+                [
+                    [host, ",".join(row.get("replicas") or []) or "-",
+                     row.get("deaths"), row.get("lease_expired"),
+                     row.get("last_state") or "-"]
+                    for host, row in sorted(hosts.items())
+                ],
+                ["host", "replicas", "deaths", "lease_expired", "state"],
+            ))
+        ls = rt.get("lease") or {}
+        if ls:
+            out.append(
+                f"lease: granted={ls.get('granted')}"
+                f" renewed={ls.get('renewed')}"
+                f" expired={ls.get('expired')}"
+                f" fenced_writes={ls.get('fenced_write_refused')}"
+                f" (sessions={ls.get('fenced_sessions')})"
+                + (
+                    f"  partitions={ls.get('partitions_injected')}"
+                    f" (max {_fmt(ls.get('partition_seconds_max'))}s)"
+                    if ls.get("partitions_injected") else ""
+                )
             )
         cn = rt.get("canary") or {}
         if cn:

@@ -68,11 +68,29 @@ Kinds:
   evicted / restarted / failed) as seen by the replica supervisor;
   ``scope="request"`` is one client request through the routing front
   end (end-to-end ``ms``, whether it succeeded, whether it took the
-  transparent one-shot retry after a replica died mid-request). The
+  transparent one-shot retry after a replica died mid-request);
+  ``scope="host"`` (ISSUE 14) is one HOST health transition
+  (``ROUTER_HOST_STATES``: ``suspect`` — transport strikes
+  accumulating, the host's replicas held out of new session placement
+  — / ``healthy``) from the multi-host degradation ladder. The
   log is self-auditing: ``scripts/validate_events.py`` checks every
   ``died`` replica has a later ``restarted``/``evicted`` resolution —
   a death the supervisor never acted on means the replica-restart
   loop is broken.
+* ``lease`` — one lease-liveness transition in the multi-host serving
+  plane (ISSUE 14: ``serve/replicaset.py`` grants/renews/expires;
+  ``serve/session.CarryJournal`` refuses fenced writes):
+  ``LEASE_EVENTS`` — ``granted`` (a replica's first answered healthz
+  of an incarnation opens an epoch-numbered lease), ``renewed``
+  (throttled), ``expired`` (renewals starved past the TTL — the
+  eviction trigger for a partitioned host, since a failed poll alone
+  proves nothing there), and ``fenced_write_refused`` (a
+  partitioned-but-alive ZOMBIE tried to journal a session the router
+  already resumed elsewhere — the write was dropped; carries the
+  ``session``). Self-auditing: the validator FAILS an ``expired``
+  lease with no later same-replica died/evicted resolution (or
+  re-grant) — an expiry nothing acted on means the liveness loop is
+  broken.
 * ``session`` — one session lifecycle transition in the recurrent
   serving protocol (``serve/session.py`` stores on the replicas,
   ``serve/router.py`` affinity): ``SESSION_EVENTS`` — ``created``
@@ -132,9 +150,11 @@ __all__ = [
     "EVENT_KINDS",
     "FLEET_STATES",
     "ROUTER_REPLICA_STATES",
+    "ROUTER_HOST_STATES",
     "SESSION_EVENTS",
     "CANARY_EVENTS",
     "AUTOSCALE_EVENTS",
+    "LEASE_EVENTS",
     "EventBus",
     "JsonlSink",
     "ConsoleSink",
@@ -190,6 +210,20 @@ AUTOSCALE_EVENTS = (
     "scale_out", "drain_started", "drain_completed", "drain_aborted",
     "shed",
 )
+
+# host health transitions in the multi-host serving plane (ISSUE 14:
+# the state machine lives in serve/replicaset.py; vocabulary HERE so
+# the validator needs no serve import — the FLEET_STATES pattern).
+# `suspect` = transport strikes accumulated: the host's replicas are
+# held out of NEW session placement while the lease decides.
+ROUTER_HOST_STATES = ("suspect", "healthy")
+
+# lease-liveness transitions (ISSUE 14: serve/replicaset.py grants/
+# renews/expires; serve/session.CarryJournal emits the fencing
+# refusals). `expired` must resolve to the replica's died/evicted (or
+# a re-grant after the partition heals) — the died-needs-terminal
+# pattern.
+LEASE_EVENTS = ("granted", "renewed", "expired", "fenced_write_refused")
 
 _SCALAR = (bool, int, float, str, type(None))
 
@@ -272,9 +306,18 @@ _REQUIRED = {
     },
     "router": {
         # scope-discriminated (like `memory`): "replica" lifecycle
-        # transitions vs per-"request" routing records — the per-scope
-        # required fields live in _ROUTER_SCOPED below
-        "scope": lambda v: v in ("replica", "request"),
+        # transitions vs per-"request" routing records vs per-"host"
+        # health transitions (ISSUE 14) — the per-scope required
+        # fields live in _ROUTER_SCOPED below
+        "scope": lambda v: v in ("replica", "request", "host"),
+    },
+    "lease": {
+        # one lease-liveness transition (ISSUE 14); per-event required
+        # fields (epoch on lifecycle records, session on fencing
+        # refusals) live in _LEASE_SCOPED below. `host` rides along as
+        # an optional field on multi-host records.
+        "replica": lambda v: isinstance(v, str) and v,
+        "event": lambda v: v in LEASE_EVENTS,
     },
     "session": {
         # one session lifecycle transition (serve/session.py store,
@@ -335,6 +378,24 @@ _ROUTER_SCOPED = {
         "ok": lambda v: isinstance(v, bool),
         "retried": lambda v: isinstance(v, bool),
     },
+    "host": {
+        "host": lambda v: isinstance(v, str) and v,
+        "state": lambda v: v in ROUTER_HOST_STATES,
+    },
+}
+
+_INT = lambda v: isinstance(v, int) and not isinstance(v, bool)
+
+# lease events are EVENT-discriminated (the autoscale pattern): the
+# lifecycle records carry the lease's epoch number; a fencing refusal
+# names the session whose write was dropped
+_LEASE_SCOPED = {
+    "granted": {"epoch": _INT},
+    "renewed": {"epoch": _INT},
+    "expired": {"epoch": _INT},
+    "fenced_write_refused": {
+        "session": lambda v: isinstance(v, str) and v,
+    },
 }
 
 # autoscale events are EVENT-discriminated the same way: scale/drain
@@ -383,6 +444,7 @@ def validate_event(rec: Any) -> list:
         ("memory", "scope", _MEMORY_SCOPED),
         ("router", "scope", _ROUTER_SCOPED),
         ("autoscale", "event", _AUTOSCALE_SCOPED),
+        ("lease", "event", _LEASE_SCOPED),
     ):
         if kind != scoped_kind:
             continue

@@ -59,6 +59,27 @@ router's overload admission control:
   shape that makes an unbudgeted retry path DOUBLE traffic on the
   survivors (the router's retry token bucket is what bounds it).
 
+The PARTITION grammar (ISSUE 14) drives the multi-host transport
+(``serve/transport.py``) — faults where the network lies while every
+process stays healthy:
+
+* ``partition_host@request=K:host=H:seconds=S`` — blackhole the
+  transport to host ``H`` both ways for S seconds. The replica
+  processes there keep running (the injector never reaches around the
+  transport): detection MUST come from lease expiry
+  (``lease:expired`` → eviction → journal-backed session resume on a
+  survivor — the validator enforces exactly that pairing), and the
+  partitioned-but-alive zombies' later journal writes must be FENCED.
+* ``slow_network@request=K:host=H:ms=M`` — add M ms to every exchange
+  with host ``H`` (healthz polls and routed forwards alike): a
+  degraded link the latency metrics (scale/shed), the retry path, or
+  — when slow enough to starve renewals — lease expiry must catch.
+* ``lost_descriptor@request=K:host=H`` — from then on, launches on
+  host ``H`` land but their run.json never becomes readable: the
+  bounded discovery budget must fail the launch LOUDLY (a ``died``
+  record naming the descriptor), never leave a phantom ``starting``
+  record holding the autoscaler's warming gate.
+
 Specs are ``;``-separated; each fires EXACTLY ONCE (a recovery that
 re-runs the target iteration re-runs it clean — which is what lets the
 chaos suite pin bit-exact continuation against an unfaulted run). Every
@@ -95,7 +116,14 @@ _KINDS = {
     "overload_storm": ("request", "serve"),
     "slow_replica": ("request", "serve"),
     "flap_replica": ("request", "serve"),
+    "partition_host": ("request", "serve"),
+    "slow_network": ("request", "serve"),
+    "lost_descriptor": ("request", "serve"),
 }
+
+# faults that target a HOST (the multi-host transport) rather than a
+# replica — host= is required for these
+_HOST_KINDS = ("partition_host", "slow_network", "lost_descriptor")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,13 +140,19 @@ class FaultSpec:
     seconds: float = 0.25
     replica: int = 0
     rps: float = 10.0     # overload_storm: synthetic request rate
-    ms: float = 100.0     # slow_replica: per-act latency injection
+    ms: float = 100.0     # slow_replica/slow_network: latency injection
     times: int = 2        # flap_replica: total kills
+    host: str = ""        # partition_host/slow_network/lost_descriptor
 
     def __post_init__(self):
         if self.kind not in _KINDS:
             raise ValueError(
                 f"unknown fault kind {self.kind!r}; have {sorted(_KINDS)}"
+            )
+        if self.kind in _HOST_KINDS and not self.host:
+            raise ValueError(
+                f"{self.kind}: needs host=NAME (the transport host to "
+                "target)"
             )
         if self.at < 1:
             raise ValueError(
@@ -168,6 +202,12 @@ class FaultSpec:
             extra = f":replica={self.replica}:ms={self.ms:g}"
         elif self.kind == "flap_replica":
             extra = f":replica={self.replica}:times={self.times}"
+        elif self.kind == "partition_host":
+            extra = f":host={self.host}:seconds={self.seconds:g}"
+        elif self.kind == "slow_network":
+            extra = f":host={self.host}:ms={self.ms:g}"
+        elif self.kind == "lost_descriptor":
+            extra = f":host={self.host}"
         return f"{self.kind}@{key}={self.at}{extra}"
 
 
@@ -222,15 +262,19 @@ def parse_fault_specs(spec: str) -> Tuple[FaultSpec, ...]:
             rps = float(fields.pop("rps", 10.0))
             ms = float(fields.pop("ms", 100.0))
             times = int(fields.pop("times", 2))
+            host = str(fields.pop("host", ""))
         except ValueError as e:
             raise ValueError(f"fault spec {frag!r}: {e}") from None
         if fields:
             raise ValueError(
                 f"fault spec {frag!r}: unknown keys {sorted(fields)}"
             )
-        out.append(FaultSpec(kind=kind, at=at, worker=worker,
-                             seconds=seconds, replica=replica,
-                             rps=rps, ms=ms, times=times))
+        try:
+            out.append(FaultSpec(kind=kind, at=at, worker=worker,
+                                 seconds=seconds, replica=replica,
+                                 rps=rps, ms=ms, times=times, host=host))
+        except ValueError as e:
+            raise ValueError(f"fault spec {frag!r}: {e}") from None
     if not out:
         raise ValueError(f"fault spec {spec!r} contains no faults")
     return tuple(out)
@@ -363,7 +407,7 @@ class FaultInjector:
 
     def on_serve_request(
         self, request_idx: int, replicaset=None, journal_dir=None,
-        router=None, path=None, body=None,
+        router=None, path=None, body=None, transport=None,
     ) -> None:
         """Fire request-clocked serving faults due at the
         ``request_idx``-th routed client request (1-based, counted by
@@ -372,7 +416,11 @@ class FaultInjector:
         the kill/stall/slow/flap specs target; ``journal_dir`` is
         where ``drop_carry_journal`` finds its victim file; ``router``
         + the triggering request's ``path``/``body`` are what an
-        ``overload_storm`` replays realistic traffic through."""
+        ``overload_storm`` replays realistic traffic through;
+        ``transport`` is the host/replica transport
+        (``serve/transport.py``) the partition grammar blackholes/
+        slows — the fault lands on the NETWORK model, never on the
+        replica processes themselves."""
         due = []
         with self._lock:
             for i, s in enumerate(self.specs):
@@ -391,6 +439,7 @@ class FaultInjector:
                 self._fire_serve_fault(
                     s, replicaset, journal_dir,
                     router=router, path=path, body=body,
+                    transport=transport,
                 )
             except Exception as e:
                 # a fault that could not execute (bad replica index,
@@ -408,12 +457,15 @@ class FaultInjector:
             raise first_error
 
     def _fire_serve_fault(self, s, replicaset, journal_dir,
-                          router=None, path=None, body=None) -> None:
+                          router=None, path=None, body=None,
+                          transport=None) -> None:
         # emit BEFORE executing: concurrent request threads may detect
         # the failure (report_failure -> died/evicted records) within
         # microseconds of the kill, and the validator's matched-by-
         # detection rule requires the detection AFTER the injection
-        if s.kind == "kill_replica":
+        if s.kind in _HOST_KINDS:
+            self._fire_host_fault(s, transport)
+        elif s.kind == "kill_replica":
             rec = (
                 replicaset.replicas.get(s.replica_id)
                 if replicaset is not None else None
@@ -468,6 +520,34 @@ class FaultInjector:
             except OSError:
                 pass  # never journaled anything yet: same outcome —
                 #       the failover finds nothing and says so
+
+    def _fire_host_fault(self, s, transport) -> None:
+        """The partition grammar (ISSUE 14): every fault lands on the
+        TRANSPORT's network model — the replica processes stay exactly
+        as healthy as they were, which is the whole point (detection
+        must come from lease expiry / bounded discovery / latency
+        metrics, never from the injector doing the supervisor's job)."""
+        if transport is None:
+            raise ValueError(
+                f"fault {s}: needs the host transport hook "
+                "(transport=None — is the router running over a "
+                "serve/transport.py transport?)"
+            )
+        hosts = getattr(transport, "hosts", ())
+        if s.host not in hosts:
+            raise ValueError(
+                f"fault {s}: transport has no host {s.host!r} "
+                f"(have {list(hosts)})"
+            )
+        if s.kind == "partition_host":
+            self._emit(s, host=s.host, seconds=s.seconds)
+            transport.partition(s.host, s.seconds)
+        elif s.kind == "slow_network":
+            self._emit(s, host=s.host, ms=s.ms)
+            transport.slow(s.host, s.ms)
+        elif s.kind == "lost_descriptor":
+            self._emit(s, host=s.host)
+            transport.lose_descriptors(s.host)
 
     def _start_storm(self, s, router, path, body) -> None:
         """Launch the overload-storm generator: background workers

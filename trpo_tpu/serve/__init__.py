@@ -57,9 +57,26 @@ robustness rung:
   typed 503s, and a documented shed order (stateless before session
   traffic).
 
+The multi-host control plane (ISSUE 14) crosses the machine boundary
+as a robustness contract:
+
+* :mod:`trpo_tpu.serve.transport` — the pluggable host/replica
+  transport: :class:`LocalExecTransport` (today's Popen path,
+  behavior-pinned default) and :class:`TemplateTransport`
+  (ssh/kubectl-shaped launch templates over named hosts, round-robin
+  placement avoiding suspect hosts, bounded-retry descriptor
+  discovery that fails a launch LOUDLY). Replicas hold epoch-numbered
+  LEASES renewed by their healthz exchanges — lease expiry, not a
+  failed poll, evicts across a partition — and the carry journal
+  grows per-session write FENCING so a partitioned-but-alive zombie
+  can never clobber a migrated session's recovery point. The
+  partition chaos grammar (``partition_host``/``slow_network``/
+  ``lost_descriptor``) injects all of it deterministically.
+
 ``scripts/serve.py`` is the CLI (``--replicas N`` = replicas + router
 in one process, ``--min-replicas/--max-replicas/--slo-p99-ms`` arm
-the autoscaler); ``bench.py``'s ``serving``/``serving_scale`` blocks
+the autoscaler, ``--hosts/--lease-ttl`` arm the multi-host plane);
+``bench.py``'s ``serving``/``serving_scale`` blocks
 and ``scripts/analyze_run.py --compare`` carry the latency/throughput
 SLOs.
 """
@@ -81,8 +98,16 @@ from trpo_tpu.serve.session import (
     RecurrentServeEngine,
     SessionStore,
     SimulatedCostSessionEngine,
+    fence_path,
+    fence_session,
     journal_path,
     read_carry_journal,
+    read_fences,
+)
+from trpo_tpu.serve.transport import (
+    LocalExecTransport,
+    TemplateTransport,
+    TransportPartitioned,
 )
 
 __all__ = [
@@ -96,6 +121,9 @@ __all__ = [
     "CarryJournal",
     "journal_path",
     "read_carry_journal",
+    "fence_path",
+    "fence_session",
+    "read_fences",
     "InProcessReplica",
     "SubprocessReplica",
     "render_launch_argv",
@@ -103,4 +131,7 @@ __all__ = [
     "Router",
     "CanaryController",
     "Autoscaler",
+    "LocalExecTransport",
+    "TemplateTransport",
+    "TransportPartitioned",
 ]

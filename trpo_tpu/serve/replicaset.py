@@ -40,6 +40,23 @@ on the bus (``obs/events.ROUTER_REPLICA_STATES``), and
 ``scripts/validate_events.py`` enforces that a ``died`` record has a
 later ``restarted``/``evicted`` resolution — a silent death means this
 loop is broken.
+
+**Multi-host liveness (ISSUE 14).** Every replica is placed on a HOST
+through a pluggable transport (``serve/transport.py``;
+``LocalExecTransport`` — the behavior-pinned default — keeps today's
+local launcher path). Crossing the host boundary breaks the "failed
+poll = dead replica" assumption: a partitioned host's replicas are
+alive and running, only unreachable. With ``lease_ttl`` armed, each
+replica holds an epoch-numbered LEASE renewed by every answered
+``/healthz`` exchange, and lease EXPIRY — not a failed poll — is the
+eviction trigger (``lease`` events: granted / renewed / expired;
+the expiry then walks the normal died→evicted path, with relaunch
+PLACED on a non-suspect host so replacement capacity lands where the
+network works). Transport errors first mark the host *suspect*
+(``router`` ``scope="host"`` events): its replicas are held out of
+NEW session placement while the lease decides — the degradation
+ladder: transport error → bounded retry → host suspect → lease
+expiry → eviction + journal-backed session resume on survivors.
 """
 
 from __future__ import annotations
@@ -77,13 +94,16 @@ RECORD_STATES = (
 
 def render_launch_argv(
     template: str, port, checkpoint, replica: Optional[str] = None,
+    host: Optional[str] = None,
 ) -> List[str]:
     """Render ``cfg.serve_replica_cmd`` into a launch argv: the template
     is shell-split (POSIX rules) and every ``{port}``/``{checkpoint}``
-    (and, when given, ``{replica}``) placeholder substituted — the seam
-    that lets scale-out target a non-local launcher (ssh wrapper,
-    kubectl run, …) while the default stays the local
-    ``scripts/serve.py`` child. The rendered argv is what
+    (and, when given, ``{replica}``/``{host}``) placeholder substituted
+    — the seam that lets scale-out target a non-local launcher (ssh
+    wrapper, kubectl run, …) while the default stays the local
+    ``scripts/serve.py`` child. ``{host}`` is what a multi-host
+    template (``serve.py --hosts``, ``serve/transport.TemplateTransport``)
+    wires into its ssh/kubectl target. The rendered argv is what
     :class:`SubprocessReplica` takes as ``command``; ``scripts/serve.py
     --replica-cmd`` wires it as the replica launcher."""
     import shlex
@@ -97,6 +117,8 @@ def render_launch_argv(
         )
         if replica is not None:
             arg = arg.replace("{replica}", replica)
+        if host is not None:
+            arg = arg.replace("{host}", host)
         out.append(arg)
     return out
 
@@ -270,6 +292,13 @@ class ReplicaRecord:
         #                            router routes a fraction of
         #                            stateless traffic here and keeps
         #                            sessions away)
+        # multi-host liveness (ISSUE 14)
+        self.host = "local"        # transport placement
+        self.lease_epoch = 0       # grants this incarnation + earlier ones
+        self.lease_expires: Optional[float] = None  # monotonic; None =
+        #                            no live lease (never granted, or
+        #                            consumed by expiry/relaunch)
+        self.lease_renewed_emit = 0.0  # throttle for `renewed` events
 
     def row(self) -> dict:
         return {
@@ -280,6 +309,8 @@ class ReplicaRecord:
             "loaded_step": self.loaded_step,
             "sessions": self.sessions,
             "canary": self.canary,
+            "host": self.host,
+            "lease_epoch": self.lease_epoch,
         }
 
 
@@ -296,7 +327,7 @@ class ReplicaSet:
 
     def __init__(
         self,
-        launcher: Callable[[str], object],
+        launcher: Optional[Callable[[str], object]],
         n_replicas: int,
         health_interval: float = 0.5,
         health_timeout: float = 2.0,
@@ -306,6 +337,10 @@ class ReplicaSet:
         backoff_cap: float = 30.0,
         start_timeout: float = 120.0,
         bus=None,
+        transport=None,
+        lease_ttl: Optional[float] = None,
+        suspect_after: int = 2,
+        suspect_decay_s: float = 30.0,
     ):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
@@ -322,7 +357,32 @@ class ReplicaSet:
                 f"need 0 <= backoff <= backoff_cap, got "
                 f"{backoff}/{backoff_cap}"
             )
-        self.launcher = launcher
+        if lease_ttl is not None and lease_ttl <= health_interval:
+            raise ValueError(
+                "lease_ttl must exceed health_interval (a lease shorter "
+                "than the renewal cadence expires between polls), got "
+                f"ttl={lease_ttl} interval={health_interval}"
+            )
+        if suspect_after < 1:
+            raise ValueError(
+                f"suspect_after must be >= 1, got {suspect_after}"
+            )
+        if suspect_decay_s <= 0:
+            raise ValueError(
+                f"suspect_decay_s must be > 0, got {suspect_decay_s}"
+            )
+        if transport is None:
+            from trpo_tpu.serve.transport import LocalExecTransport
+
+            transport = LocalExecTransport(launcher)
+        # no `self.launcher`: every launch goes through the transport
+        # (LocalExecTransport wraps the callable) — keeping a direct
+        # handle around would invite a path that bypasses placement
+        # and the chaos gates
+        self.transport = transport
+        self.lease_ttl = None if lease_ttl is None else float(lease_ttl)
+        self.suspect_after = int(suspect_after)
+        self.suspect_decay_s = float(suspect_decay_s)
         self.health_interval = float(health_interval)
         self.health_timeout = float(health_timeout)
         self.health_fail_threshold = int(health_fail_threshold)
@@ -331,6 +391,15 @@ class ReplicaSet:
         self.backoff_cap = float(backoff_cap)
         self.start_timeout = float(start_timeout)
         self.bus = bus
+        # host health (the degradation ladder's suspect rung): tracked
+        # only when the topology can benefit — lease armed or a real
+        # multi-host transport — so single-host logs stay unchanged.
+        # `_suspect` maps host -> suspected-at (monotonic): a host all
+        # of whose replicas relaunched elsewhere gets no more probes,
+        # so suspicion DECAYS after `suspect_decay_s` (circuit-breaker
+        # half-open: the next launch there either works or re-strikes)
+        self._host_fails: Dict[str, int] = {}
+        self._suspect: Dict[str, float] = {}
         self.lock = threading.Lock()
         self.replicas: Dict[str, ReplicaRecord] = {
             f"r{i}": ReplicaRecord(f"r{i}") for i in range(n_replicas)
@@ -350,6 +419,14 @@ class ReplicaSet:
     def _emit(self, replica_id: str, state: str, **extra) -> None:
         if self.bus is None:
             return
+        rec = self.replicas.get(replica_id)
+        if (
+            rec is not None and rec.host != "local"
+            and "host" not in extra
+        ):
+            # every multi-host lifecycle record names its host, so the
+            # per-host table (obs/analyze) can attribute deaths/evicts
+            extra["host"] = rec.host
         try:
             self.bus.emit(
                 "router", scope="replica", replica=replica_id,
@@ -361,12 +438,19 @@ class ReplicaSet:
     def _launch(self, rec: ReplicaRecord) -> None:
         rec.state = "starting"
         rec.health_fails = 0
+        rec.lease_expires = None  # a fresh incarnation earns its lease
+        #                           on its first answered healthz
         # stamped BEFORE the (slow — AOT compile) launch: a tick
         # racing add_replica must never read a zero start time and
         # declare the replica start_timeout-expired
         rec.started_at = time.monotonic()
-        rec.handle = self.launcher(rec.id)
+        # place AWAY from suspect hosts: replacement capacity must land
+        # where the network works (the single-host default always
+        # places "local")
+        rec.host = self.transport.place(avoid=self.suspect_hosts())
+        rec.handle = self.transport.launch(rec.host, rec.id)
         rec.url = getattr(rec.handle, "url", None)
+        # _emit stamps rec.host on every multi-host lifecycle record
         self._emit(rec.id, "started", attempt=rec.restarts + 1)
 
     def start(self) -> None:
@@ -386,10 +470,150 @@ class ReplicaSet:
             except Exception:  # pragma: no cover — must never die
                 pass
 
+    # -- host health + leases (ISSUE 14) -----------------------------------
+
+    def _hosts_tracked(self) -> bool:
+        """Host suspect accounting is armed only when it can matter —
+        leases on, or a genuinely multi-host transport — so a vanilla
+        local set's event log is byte-identical to before."""
+        return self.lease_ttl is not None or len(
+            getattr(self.transport, "hosts", ("local",))
+        ) > 1
+
+    def suspect_hosts(self) -> frozenset:
+        """Currently-suspect hosts, with decay: a host whose replicas
+        all relaunched elsewhere gets no more health exchanges, so
+        nothing could ever clear it — after ``suspect_decay_s`` the
+        suspicion lapses (half-open) and placement may try the host
+        again; a still-bad host immediately re-strikes its way back."""
+        now = time.monotonic()
+        with self.lock:
+            lapsed = [
+                h for h, t0 in self._suspect.items()
+                if now - t0 >= self.suspect_decay_s
+            ]
+            for h in lapsed:
+                del self._suspect[h]
+                self._host_fails.pop(h, None)
+            out = frozenset(self._suspect)
+        for h in lapsed:
+            self._emit_host(h, "healthy")
+        return out
+
+    def host_of(self, replica_id: str) -> str:
+        rec = self.replicas.get(replica_id)
+        return rec.host if rec is not None else "local"
+
+    def _emit_host(self, host: str, state: str) -> None:
+        if self.bus is None:
+            return
+        try:
+            self.bus.emit("router", scope="host", host=host, state=state)
+        except Exception:
+            pass
+
+    def note_transport_failure(self, host: str) -> None:
+        """One failed exchange with ``host`` (healthz poll, routed
+        forward): a strike toward *suspect*. Suspect hosts' replicas
+        are held out of NEW session placement (``Router._pick``) and
+        avoided by launch placement; the LEASE still owns eviction."""
+        if not self._hosts_tracked():
+            return
+        with self.lock:
+            fails = self._host_fails.get(host, 0) + 1
+            self._host_fails[host] = fails
+            newly = (
+                fails >= self.suspect_after and host not in self._suspect
+            )
+            if fails >= self.suspect_after:
+                # (re)stamp: continued strikes keep the decay window
+                # open — only a strike-free decay period clears it
+                self._suspect[host] = time.monotonic()
+        if newly:
+            self._emit_host(host, "suspect")
+
+    def _note_transport_ok(self, host: str) -> None:
+        if not self._hosts_tracked():
+            return
+        with self.lock:
+            self._host_fails.pop(host, None)
+            healed = self._suspect.pop(host, None) is not None
+        if healed:
+            self._emit_host(host, "healthy")
+
+    def _emit_lease(self, rec: ReplicaRecord, event: str, **extra) -> None:
+        if self.bus is None:
+            return
+        try:
+            fields = {
+                "replica": rec.id, "event": event,
+                "epoch": rec.lease_epoch,
+            }
+            if rec.host != "local":
+                fields["host"] = rec.host
+            self.bus.emit("lease", **{**fields, **extra})
+        except Exception:
+            pass
+
+    def _renew_lease(self, rec: ReplicaRecord) -> None:
+        """An answered healthz exchange IS the renewal: the lease
+        measures transport-level reachability, not snapshot readiness.
+        The first answer of an incarnation GRANTS a new epoch."""
+        if self.lease_ttl is None:
+            return
+        now = time.monotonic()
+        with self.lock:
+            granted = rec.lease_expires is None
+            rec.lease_expires = now + self.lease_ttl
+            if granted:
+                rec.lease_epoch += 1
+                rec.lease_renewed_emit = now
+        if granted:
+            self._emit_lease(rec, "granted", ttl=self.lease_ttl)
+        elif now - rec.lease_renewed_emit >= self.lease_ttl / 2.0:
+            rec.lease_renewed_emit = now
+            self._emit_lease(rec, "renewed")
+
+    def _lease_expired(self, rec: ReplicaRecord) -> bool:
+        with self.lock:
+            return (
+                rec.lease_expires is not None
+                and time.monotonic() >= rec.lease_expires
+            )
+
+    def _expire_lease(self, rec: ReplicaRecord, detail: str) -> None:
+        """Lease expiry → the normal died/evicted path. The expiry
+        event is emitted exactly once (the expires cell is consumed
+        under the lock) even when the supervisor tick and a router
+        ``report_failure`` race to observe it."""
+        with self.lock:
+            if rec.state in ("evicted", "failed"):
+                return
+            if rec.lease_expires is None:
+                return
+            if time.monotonic() < rec.lease_expires:
+                return
+            rec.lease_expires = None  # consumed: one expiry per grant
+        self._emit_lease(rec, "expired", ttl=self.lease_ttl)
+        self._mark_died(
+            rec,
+            reason=(
+                f"lease expired (epoch {rec.lease_epoch}, "
+                f"ttl {self.lease_ttl:g}s; {detail})"
+            ),
+        )
+
     # -- supervision -------------------------------------------------------
 
-    def _healthz(self, url: str) -> Optional[dict]:
+    def _healthz(
+        self, url: str, host: Optional[str] = None
+    ) -> Optional[dict]:
         try:
+            if host is not None:
+                # the transport gate models the network leg of the
+                # exchange: a partitioned host raises (= the poll never
+                # arrives), a slow host pays its injected latency
+                self.transport.gate(host)
             with urllib.request.urlopen(
                 url + "/healthz", timeout=self.health_timeout
             ) as r:
@@ -426,7 +650,17 @@ class ReplicaSet:
                     self._relaunch(rec)
                 continue
             if url is None:  # subprocess still binding: discover
-                url = getattr(handle, "discover", lambda: None)()
+                try:
+                    url = getattr(handle, "discover", lambda: None)()
+                except Exception as e:
+                    # the transport's bounded discovery budget is spent:
+                    # the launch failed LOUDLY (crash budget, relaunch on
+                    # a healthier host) — never a phantom `starting`
+                    # record wedging the supervisor
+                    self._mark_died(
+                        rec, reason=f"descriptor discovery failed: {e}"
+                    )
+                    continue
                 if url is not None:
                     with self.lock:
                         rec.url = url
@@ -436,23 +670,51 @@ class ReplicaSet:
                 ):
                     self._mark_died(rec, reason="never became reachable")
                 continue
-            health = self._healthz(url)
+            health = self._healthz(url, host=rec.host)
             if health is None:
                 alive = handle.alive() if handle is not None else False
                 rec.health_fails += 1
-                if (
-                    not alive
-                    or rec.health_fails >= self.health_fail_threshold
-                ):
+                self.note_transport_failure(rec.host)
+                if not alive:
+                    # the process is PROVABLY gone (a local handle, or
+                    # an unpartitioned transport watching it): no lease
+                    # can save a corpse
+                    self._mark_died(rec, reason="process exited")
+                elif self.lease_ttl is not None:
+                    # lease-armed: a failed poll merely stops renewal —
+                    # a partitioned host's replicas are alive, just
+                    # unreachable; only EXPIRY evicts, and only once
+                    # the failure is PERSISTENT (threshold consecutive
+                    # failed polls): a slow-network tick that starved
+                    # another host's renewal past its TTL must not turn
+                    # one transient blip there into an instant
+                    # eviction. A replica that never earned a lease is
+                    # bounded by start_timeout.
+                    if (
+                        rec.health_fails >= self.health_fail_threshold
+                        and self._lease_expired(rec)
+                    ):
+                        self._expire_lease(
+                            rec,
+                            f"{rec.health_fails} failed health polls",
+                        )
+                    elif (
+                        rec.lease_expires is None
+                        and now - rec.started_at > self.start_timeout
+                    ):
+                        self._mark_died(
+                            rec,
+                            reason="no lease within start_timeout",
+                        )
+                elif rec.health_fails >= self.health_fail_threshold:
                     self._mark_died(
                         rec,
-                        reason=(
-                            "process exited" if not alive
-                            else f"{rec.health_fails} failed health polls"
-                        ),
+                        reason=f"{rec.health_fails} failed health polls",
                     )
                 continue
             rec.health_fails = 0
+            self._note_transport_ok(rec.host)
+            self._renew_lease(rec)
             rec.loaded_step = health.get("step")
             rec.sessions = int(health.get("sessions") or 0)
             if not health.get("ok"):
@@ -517,9 +779,14 @@ class ReplicaSet:
             rec.restarts += 1
             rec.state = "starting"
             rec.url = None
+            rec.lease_expires = None
         self._emit(rec.id, "restarted", attempt=rec.restarts + 1)
         try:
-            handle = self.launcher(rec.id)
+            # placement re-decides per relaunch: a replica lease-evicted
+            # off a partitioned host comes back on a host the transport
+            # can still reach (replacement capacity on healthy hosts)
+            host = self.transport.place(avoid=self.suspect_hosts())
+            handle = self.transport.launch(host, rec.id)
         except Exception:
             # a failed relaunch burns the budget exactly like a death:
             # a persistently-unlaunchable replica (port exhaustion, bad
@@ -544,6 +811,7 @@ class ReplicaSet:
             return
         with self.lock:
             rec.handle = handle
+            rec.host = host
             rec.url = getattr(handle, "url", None)
             rec.health_fails = 0
             rec.started_at = time.monotonic()
@@ -551,13 +819,25 @@ class ReplicaSet:
     def report_failure(self, replica_id: str) -> None:
         """The router observed a transport-level failure mid-request:
         evict NOW instead of waiting for the next poll tick (the router
-        already retried the request elsewhere)."""
+        already retried the request elsewhere).
+
+        Lease-armed sets instead treat it as a transport STRIKE: across
+        a host boundary the failure says nothing about the replica
+        process (a partition looks identical to a crash from here), so
+        the host is marked toward suspect and the supervisor's lease
+        machinery owns the eviction — one mid-request blip against a
+        coincidentally-stale lease (a slow tick can starve renewals)
+        must never evict on its own; the next tick (≤ health_interval
+        away) expires it if the failure is persistent."""
         rec = self.replicas.get(replica_id)
         if rec is None:
             return
         with self.lock:
             if rec.state in ("evicted", "failed", "starting"):
                 return
+        self.note_transport_failure(rec.host)
+        if self.lease_ttl is not None:
+            return
         self._mark_died(rec, reason="router observed transport failure")
 
     # -- elastic scale (ISSUE 12: serve/autoscaler.py drives these) --------
@@ -710,6 +990,12 @@ class ReplicaSet:
                     rec.handle.close()
                 except Exception:
                     pass
+        # reap transport-launched leftovers: a partition's gated kill
+        # leaves a live zombie behind by design — teardown must not
+        try:
+            self.transport.close()
+        except Exception:
+            pass
 
 
 class CanaryController:

@@ -108,12 +108,19 @@ def _body(obj) -> bytes:
 
 class _Affinity:
     __slots__ = (
-        "replica", "last_used", "seq", "acts", "lock",
+        "replica", "host", "last_used", "seq", "acts", "lock",
         "pending_resumed_steps",
     )
 
-    def __init__(self, replica: str, now: float):
+    def __init__(self, replica: str, now: float, host: str = "local"):
         self.replica = replica
+        # the host the pinned replica journals UNDER, recorded at pin
+        # time (ISSUE 14): a lease-evicted replica may relaunch on a
+        # DIFFERENT host under the same id, so the record's current
+        # host is the wrong key for the journal this session's carries
+        # actually live in — a late-arriving act must still resume
+        # from (and fence) the incarnation it was pinned to
+        self.host = host
         self.last_used = now
         self.seq = 0   # per-session act sequence (the dedupe stamp)
         self.acts = 0  # acts the router saw succeed (journal-lag probe)
@@ -183,6 +190,12 @@ class Router:
                 f"got {retry_budget}/{retry_refill_per_sec}"
             )
         self.replicaset = replicaset
+        # the host/replica transport (ISSUE 14): every router→replica
+        # exchange runs through its gate, so a partitioned host's
+        # replicas fail from HERE exactly as they do from the
+        # supervisor — and the chaos grammar has one seam for both.
+        # None (a test fake replicaset) = ungated, today's behavior.
+        self.transport = getattr(replicaset, "transport", None)
         self.max_inflight = int(max_inflight)
         self.act_timeout_s = float(act_timeout_s)
         self.session_ttl_s = float(session_ttl_s)
@@ -298,11 +311,23 @@ class Router:
         Shed order (ISSUE 12): under sustained saturation (a 503/shed
         within the last second), stateless requests stop being
         admitted ``_session_headroom`` slots before the hard bound —
-        stateless traffic sheds BEFORE session traffic."""
+        stateless traffic sheds BEFORE session traffic.
+
+        Host health (ISSUE 14): replicas on SUSPECT hosts (transport
+        strikes accumulating toward lease expiry) are avoided — one
+        policy for session placement and stateless routing alike: a
+        long-lived pin must not land behind a flaky network, and a
+        stateless request routed there would just burn its retry.
+        When ONLY suspect-host replicas remain they still serve, since
+        degraded beats dropped. With no suspect hosts (every single-
+        host set) the pick is byte-identical to before."""
         bound = self.max_inflight
         if self._headroom_active(stateless):
             bound = self.max_inflight - self._session_headroom
         rotation = self.replicaset.in_rotation()
+        suspect = getattr(
+            self.replicaset, "suspect_hosts", frozenset
+        )()
         with self.replicaset.lock:
             candidates = [
                 r for r in rotation
@@ -310,6 +335,12 @@ class Router:
             ]
             if not candidates:
                 return None
+            if suspect:
+                trusted = [
+                    r for r in candidates
+                    if getattr(r, "host", "local") not in suspect
+                ]
+                candidates = trusted or candidates
             canary = [
                 r for r in candidates if getattr(r, "canary", False)
             ]
@@ -396,6 +427,12 @@ class Router:
         url = rec.url if rec is not None else None
         if url is None:
             raise ConnectionError(f"replica {replica_id} has no URL")
+        if self.transport is not None:
+            # the transport gate models the network leg (ISSUE 14): a
+            # partitioned host raises here — indistinguishable from a
+            # dropped connection, which is the point — and a slow host
+            # pays its injected per-exchange latency
+            self.transport.gate(getattr(rec, "host", "local"))
         netloc = urllib.parse.urlsplit(url).netloc
         key, conn = self._conn(replica_id, netloc)
         try:
@@ -683,6 +720,7 @@ class Router:
                 idx, replicaset=self.replicaset,
                 journal_dir=self.journal_dir,
                 router=self, path=path, body=body,
+                transport=self.transport,
             )
         except Exception:
             pass
@@ -827,7 +865,9 @@ class Router:
         if status != 200:
             return status, ctype, payload  # 409 wrong_protocol, 503, …
         with self._lock:
-            self._affinity[sid] = _Affinity(rid, time.monotonic())
+            self._affinity[sid] = _Affinity(
+                rid, time.monotonic(), host=self._host_of(rid)
+            )
             self.sessions_created_total += 1
         out = json.loads(payload)
         out["replica"] = rid
@@ -843,7 +883,38 @@ class Router:
             if now - aff.last_used > self.session_ttl_s:
                 del self._affinity[sid]
 
-    def _journal_lookup(self, replica_id: str, sid: str):
+    def _host_of(self, replica_id: str) -> str:
+        return getattr(self.replicaset, "host_of", lambda _r: "local")(
+            replica_id
+        )
+
+    def _journal_paths(self, replica_id: str,
+                       pinned_host: Optional[str] = None):
+        """The candidate journal files for one replica, preference-
+        ordered: the PIN-TIME host's namespaced name first (ISSUE 14 —
+        the incarnation this session was actually journaled under; a
+        relaunch may have moved the id to another host since), then
+        the record's current host, then the legacy flat name as the
+        compat fallback for journals written before host namespacing
+        (or by single-host layouts)."""
+        from trpo_tpu.serve.session import journal_path
+
+        hosts = []
+        if pinned_host is not None:
+            hosts.append(pinned_host)
+        hosts.append(self._host_of(replica_id))
+        paths = []
+        for host in hosts:
+            p = journal_path(self.journal_dir, replica_id, host=host)
+            if p not in paths:
+                paths.append(p)
+        legacy = journal_path(self.journal_dir, replica_id)
+        if legacy not in paths:
+            paths.append(legacy)
+        return paths
+
+    def _journal_lookup(self, replica_id: str, sid: str,
+                        pinned_host: Optional[str] = None):
         """The newest journaled entry for one session from one replica's
         carry journal — read fresh from disk (failover is rare; the
         file is the crash-surviving source of truth). None when
@@ -851,14 +922,35 @@ class Router:
         the session was never journaled."""
         if self.journal_dir is None:
             return None
-        from trpo_tpu.serve.session import journal_path, read_carry_journal
+        from trpo_tpu.serve.session import read_carry_journal
 
-        try:
-            return read_carry_journal(
-                journal_path(self.journal_dir, replica_id)
-            ).get(sid)
-        except Exception:
-            return None
+        for path in self._journal_paths(replica_id, pinned_host):
+            try:
+                entry = read_carry_journal(path).get(sid)
+            except Exception:
+                entry = None
+            if entry is not None:
+                return entry
+        return None
+
+    def _fence_takeover(self, replica_id: str, sid: str,
+                        pinned_host: Optional[str] = None) -> None:
+        """Fence one session in the lost replica's journal (ISSUE 14):
+        the router is about to resume/re-establish it elsewhere, and a
+        partitioned-but-alive ZOMBIE incarnation of that replica must
+        not journal the session ever again (its stale snapshot would
+        clobber the migrated session's recovery point). Best-effort —
+        the fence hardens recovery metadata; seq-dedupe remains the
+        client-visible exactly-once backstop."""
+        if self.journal_dir is None:
+            return
+        from trpo_tpu.serve.session import fence_session
+
+        for path in self._journal_paths(replica_id, pinned_host):
+            try:
+                fence_session(path, sid)
+            except Exception:
+                pass
 
     def _reestablish(self, sid: str, aff, entry, strict: bool = False,
                      drain: bool = False):
@@ -902,6 +994,8 @@ class Router:
             )
         with self._lock:
             aff.replica = rid
+            aff.host = self._host_of(rid)  # the journal key moves with
+            #                                the pin (ISSUE 14)
             aff.last_used = time.monotonic()
             if drain:
                 self.sessions_drained_total += 1
@@ -1003,7 +1097,9 @@ class Router:
             flushed = self._flush_replica_journal(from_replica, sid)
             if flushed is False:
                 return False
-            entry = self._journal_lookup(from_replica, sid)
+            entry = self._journal_lookup(
+                from_replica, sid, pinned_host=aff.host
+            )
             if entry is None:
                 if flushed is None:
                     # no live state on the victim AND nothing journaled:
@@ -1094,20 +1190,40 @@ class Router:
             except ValueError:
                 unknown = False
             if unknown:
-                entry = self._journal_lookup(pinned, sid)
+                entry = self._journal_lookup(
+                    pinned, sid, pinned_host=aff.host
+                )
                 lost_pin = entry is not None
         if lost_pin:
             # the pinned replica is gone (left rotation, died on the
             # forward, or restarted without the session): resume from
             # its carry journal when an entry exists, re-establish from
             # a fresh carry otherwise — never fail the client
+            pinned_host = aff.host  # _reestablish re-points aff.host
             if entry is None:
-                entry = self._journal_lookup(pinned, sid)
+                entry = self._journal_lookup(
+                    pinned, sid, pinned_host=pinned_host
+                )
             ok, rid, resumed = self._reestablish(sid, aff, entry)
             if ok is not True:
+                # the takeover did NOT land: the session stays pinned
+                # where it was, so its journal must NOT be fenced — a
+                # transient total-saturation blip would otherwise
+                # permanently refuse a live replica's journal writes
+                # for this session (no create ever runs to reclaim)
                 if ok is not None:
                     return ok  # the create's upstream error, verbatim
                 return self._unrouted(rid, retried, "session_act")
+            # the takeover LANDED elsewhere: fence the old incarnation
+            # so a partitioned-but-alive zombie still holding this
+            # session can never journal it again (ISSUE 14) — keyed by
+            # the PIN-TIME host, so a same-id relaunch on another host
+            # can never misdirect the fence. The µs window between the
+            # survivor's create and this append is covered by file
+            # order: the create's restore snapshot is journaled on the
+            # SURVIVOR, and the old journal leaves the lookup path
+            # with the re-pin.
+            self._fence_takeover(pinned, sid, pinned_host=pinned_host)
             reestablished = not resumed
             result, rid, _ = self._dispatch(
                 body=body, path=f"/session/{sid}/act",

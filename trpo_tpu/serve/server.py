@@ -202,7 +202,13 @@ class PolicyServer:
                 journal = CarryJournal(
                     journal_path(
                         carry_journal_dir, replica_name or "solo"
-                    )
+                    ),
+                    # the fencing refusals (ISSUE 14) must be
+                    # observable from THIS process's log — the zombie
+                    # side of a partition is exactly the replica the
+                    # router can no longer see
+                    bus=bus,
+                    replica=replica_name or "solo",
                 )
             self.sessions = SessionStore(
                 ttl_s=session_ttl_s,

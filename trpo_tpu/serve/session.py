@@ -56,6 +56,32 @@ Staleness bound: a resumed session is at most
 ``cfg.serve_carry_sync_every - 1`` steps behind the dead replica's
 live carry, plus whatever the write-behind drain had not flushed at
 the instant of death.
+
+**Write fencing** (ISSUE 14). A multi-host partition creates a
+split-brain WRITER: the router declares a replica's lease expired and
+resumes its sessions elsewhere, while the partitioned-but-alive zombie
+keeps running — an act still in flight there would happily journal a
+stale carry AFTER the session moved, clobbering the migrated session's
+recovery point. The journal therefore carries a per-session FENCE: a
+sidecar file (``<journal>.fence``) the router appends a session id to
+at every journal-based takeover (:func:`fence_session`), and which the
+journal's writer re-reads before every flush — a write for a fenced
+session is REFUSED (dropped, counted in ``fenced_writes_total``, and
+emitted as a ``lease`` ``fenced_write_refused`` event so split-brain
+refusals are observable, never silent). The fence is lifted per
+session only by an explicit :meth:`SessionStore.create` on this
+replica (:meth:`CarryJournal.reclaim`) — the router re-placing the
+session HERE is the one legitimate way this journal becomes its owner
+again; a zombie that nobody re-placed anything on stays fenced
+forever. Client-visible correctness never depends on the fence alone:
+seq-dedupe remains the exactly-once backstop.
+
+**Host namespacing**. Journal files are keyed by (host, replica):
+``journal_path(dir, "r0", host="hostA")`` →
+``<dir>/hostA--r0.carry.jsonl`` — two hosts minting the same replica
+id can never share a journal file (the cross-host collision latent in
+the flat ``<replica>.carry.jsonl`` layout). Readers keep a compat
+fallback to the legacy flat name.
 """
 
 from __future__ import annotations
@@ -79,6 +105,9 @@ __all__ = [
     "CarryJournal",
     "read_carry_journal",
     "journal_path",
+    "fence_path",
+    "fence_session",
+    "read_fences",
     "mint_session_id",
 ]
 
@@ -463,11 +492,82 @@ class _Session:
 _DROPPED = object()
 
 
-def journal_path(journal_dir: str, replica_id: str) -> str:
+def journal_path(
+    journal_dir: str, replica_id: str, host: Optional[str] = None
+) -> str:
     """The one naming convention both halves share: the replica WRITES
-    ``<dir>/<replica_id>.carry.jsonl``; the router READS the same path
-    when that replica dies."""
+    this file; the router READS the same path when that replica dies.
+
+    ``host`` namespaces the filename (ISSUE 14):
+    ``journal_path(d, "r0", host="hostA")`` → ``<d>/hostA--r0.carry.jsonl``
+    — identical to ``journal_path(d, "hostA--r0")``, which is exactly
+    what a multi-host launch template produces by rendering
+    ``--replica-name {replica}`` with the host-namespaced name
+    (``TemplateTransport.replica_name``). Two hosts minting the same
+    replica id therefore never collide on a journal file; ``host`` in
+    (None, "", "local") keeps the legacy flat name (single-host
+    layouts, and the compat fallback readers try second)."""
+    if host and host != "local":
+        replica_id = f"{host}--{replica_id}"
     return os.path.join(journal_dir, f"{replica_id}.carry.jsonl")
+
+
+def fence_path(path: str) -> str:
+    """The journal's fence sidecar: one JSON line per fenced session,
+    appended by the ROUTER at journal-based takeover and re-read by the
+    journal's writer before every flush."""
+    return path + ".fence"
+
+
+def fence_session(path: str, session_id: str) -> None:
+    """Fence one session in the journal at ``path``: any holder of
+    that journal which has NOT since re-created the session (an
+    explicit :meth:`SessionStore.create` → :meth:`CarryJournal.reclaim`)
+    must refuse to journal it. Called by the router the moment it
+    resumes a session out of a dead/partitioned replica's journal —
+    the single-writer side of the fencing protocol (only the one
+    router appends here, so a plain append is safe)."""
+    with open(fence_path(path), "a") as f:
+        f.write(
+            json.dumps({"session": session_id, "t": time.time()}) + "\n"
+        )
+        f.flush()
+
+
+def _load_fence_lines(path: str):
+    """``({session_id: last 1-based fence-line index}, total_lines)``
+    for the journal at ``path`` — the line index is the fencing
+    ORDER, which is what lets a reclaim lift exactly the fences that
+    existed when it happened and nothing later. Torn/corrupt lines are
+    skipped (they still count a line, keeping indices stable) — a torn
+    fence reads as absent, and seq-dedupe remains the client-visible
+    backstop."""
+    fenced: Dict[str, int] = {}
+    total = 0
+    try:
+        f = open(fence_path(path), "rb")
+    except OSError:
+        return fenced, 0
+    with f:
+        for line in f:
+            total += 1
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            sid = rec.get("session") if isinstance(rec, dict) else None
+            if isinstance(sid, str) and sid:
+                fenced[sid] = total
+    return fenced, total
+
+
+def read_fences(path: str) -> set:
+    """The fenced session-id set for the journal at ``path`` (empty
+    when no fence file exists)."""
+    return set(_load_fence_lines(path)[0])
 
 
 def read_carry_journal(path: str) -> Dict[str, dict]:
@@ -530,10 +630,31 @@ class CarryJournal:
         compact_factor: int = 4,
         min_compact: int = 256,
         poll_interval: float = 0.5,
+        bus=None,
+        replica: Optional[str] = None,
     ):
         from trpo_tpu.utils.metrics import repair_jsonl_tail
 
         self.path = path
+        self.bus = bus
+        self.replica = replica
+        # write fencing (ISSUE 14): sessions the router has taken over
+        # (resumed elsewhere after this journal's owner was declared
+        # gone) — writes for them are refused until an explicit
+        # re-create on this replica reclaims them. The sidecar is
+        # re-read before every flush (size-gated stat, so the hot path
+        # stays one dict assignment); a zombie behind a partition
+        # re-reads it the same way through the shared directory.
+        # `_fenced` maps sid -> last fence-line index; `_reclaimed`
+        # maps sid -> the fence-line WATERMARK at reclaim time, so a
+        # reclaim lifts exactly the fences that existed then — a LATER
+        # fence (the router taking the session over again) re-fences.
+        self._fenced: Dict[str, int] = {}
+        self._reclaimed: Dict[str, int] = {}
+        self._fence_lines = 0
+        self._fence_size = -1
+        self._fence_emitted: set = set()
+        self.fenced_writes_total = 0
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         repair_jsonl_tail(path)
         # a restarted replica inherits its previous incarnation's
@@ -562,6 +683,8 @@ class CarryJournal:
         self.writes_total = 0
         self.compactions_total = 0
         self._f = open(path, "a")
+        self._refresh_fences()  # after the lock exists; the writer
+        #                         re-reads (size-gated) before every flush
         self._writer = threading.Thread(
             target=self._loop, name="carry-journal-writer", daemon=True
         )
@@ -605,6 +728,59 @@ class CarryJournal:
             hit = self._latest.get(session_id)
             return dict(hit) if hit is not None else None
 
+    # -- write fencing (ISSUE 14) ------------------------------------------
+
+    def reclaim(self, session_id: str) -> None:
+        """An explicit (re-)create of this session on THIS replica: the
+        router placed it here on purpose, so this journal is its
+        legitimate owner again — for the fences that exist RIGHT NOW.
+        The sidecar is refreshed first so the watermark covers every
+        fence already on disk; a fence appended later (the router
+        taking the session over AGAIN) re-fences past the watermark. A
+        zombie nobody re-placed anything on never reclaims."""
+        self._refresh_fences()
+        with self._lock:
+            self._reclaimed[session_id] = self._fence_lines
+
+    def fenced(self, session_id: str) -> bool:
+        with self._lock:
+            idx = self._fenced.get(session_id)
+            if idx is None:
+                return False
+            return idx > self._reclaimed.get(session_id, 0)
+
+    def _refresh_fences(self) -> None:
+        """Size-gated re-read of the fence sidecar (called on open and
+        before every write batch — the fence must be honored across
+        PROCESSES, the zombie's included, so it cannot be cached
+        forever)."""
+        try:
+            size = os.stat(fence_path(self.path)).st_size
+        except OSError:
+            size = 0
+        if size == self._fence_size:
+            return
+        fenced, total = _load_fence_lines(self.path)
+        with self._lock:
+            self._fenced = fenced
+            self._fence_lines = total
+            self._fence_size = size
+
+    def _refuse_fenced(self, sid: str) -> None:
+        self.fenced_writes_total += 1
+        if self.bus is None or sid in self._fence_emitted:
+            return
+        self._fence_emitted.add(sid)
+        try:
+            self.bus.emit(
+                "lease",
+                event="fenced_write_refused",
+                session=sid,
+                replica=self.replica or "unknown",
+            )
+        except Exception:
+            pass
+
     # -- writer side --------------------------------------------------------
 
     def _loop(self) -> None:
@@ -641,6 +817,16 @@ class CarryJournal:
         }
 
     def _write_batch(self, pending: Dict[str, object]) -> None:
+        # honor the fence BEFORE touching the file: a partitioned
+        # zombie's stale snapshot must not clobber a migrated session's
+        # recovery point (the refusal is counted and emitted, never
+        # silent — and an explicit re-create on this replica reclaims)
+        self._refresh_fences()
+        for sid in [s for s in pending if self.fenced(s)]:
+            pending.pop(sid)
+            self._refuse_fenced(sid)
+        if not pending:
+            return
         for sid, entry in pending.items():
             if entry is _DROPPED:
                 self._f.write(
@@ -824,6 +1010,12 @@ class SessionStore:
             self._forget_journal(evicted)
             self._emit("evicted", evicted)
         self._emit("created", sid)
+        if self.journal is not None:
+            # an explicit create makes THIS replica the session's
+            # legitimate journal owner again: lift any write fence the
+            # router left from a previous takeover (ISSUE 14) — the
+            # restore/tombstone writes below must land
+            self.journal.reclaim(sid)
         if steps and self.journal is not None:
             # journal the restored state immediately: a SECOND failover
             # before this session's next act must still find its carry.
