@@ -4,6 +4,9 @@
     python scripts/analyze_run.py RUN.jsonl
     python scripts/analyze_run.py RUN.jsonl --compare BASE.jsonl \\
         [--threshold-pct 20] [--min-ms 1.0] [--json]
+    python scripts/analyze_run.py ROUTER.jsonl --merge replica0.jsonl \\
+        --merge replica1.jsonl --trace <id>        # one trace waterfall
+    python scripts/analyze_run.py ROUTER.jsonl --slowest-traces 5
 
 Single file: a run report — per-phase time table, throughput (steady
 iteration ms + timesteps/s), health/recompile/fault summary, peak-memory
@@ -12,16 +15,26 @@ serving runs (``serve`` events from ``trpo_tpu/serve``) — the serving
 SLO block (requests/batches, actions/s, latency p50/p99, per-rung
 table). With ``--compare``, the per-phase and per-metric regression
 verdicts of ``trpo_tpu.obs.analyze.compare_runs``: time-like metrics
-(including serving latency p50/p99, overall and per padded rung)
-regress when they grow past the threshold, rate-like (timesteps/s,
-serving actions/s) when they shrink past it, byte-like when they grow
-past it; sub-``--min-ms`` phases and metrics a run did not measure are
-skipped, never silently judged — and serve rows appear only when at
-least one run actually served.
+(including serving latency p50/p99, overall and per padded rung, and
+the ISSUE 15 per-trace-stage p99 rows) regress when they grow past the
+threshold, rate-like (timesteps/s, serving actions/s) when they shrink
+past it, byte-like when they grow past it; sub-``--min-ms`` phases and
+metrics a run did not measure are skipped, never silently judged — and
+serve rows appear only when at least one run actually served.
+
+Request traces (ISSUE 15): ``--merge FILE`` (repeatable) folds more
+per-process event logs into the record stream — a multi-host serving
+run writes one log per process (router + each replica child), and the
+trace assembler joins spans ACROSS them by trace id. ``--trace ID``
+renders one assembled trace as a text waterfall (``--json``: the raw
+span list); ``--slowest-traces K`` ranks the top-K traces by root
+duration with their per-stage breakdown (``--json``: machine-readable
+rows — stdout stays parseable, the fleet CLI contract).
 
 Exit codes (the contract ``scripts/check.sh``'s regression gate relies
 on): **0** = summarized / compared clean, **1** = at least one metric
-REGRESSED past the threshold, **2** = usage or unreadable/empty input.
+REGRESSED past the threshold, **2** = usage or unreadable/empty input
+(including ``--trace`` ids the logs do not contain).
 
 ``--json`` prints the machine-readable summary (or comparison) instead
 of the text report. The reader is tolerant (corrupt mid-file records are
@@ -68,21 +81,118 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true",
         help="emit the machine-readable summary/comparison JSON",
     )
+    p.add_argument(
+        "--merge", metavar="FILE", action="append", default=[],
+        help="merge another per-process event log (repeatable) — a "
+        "replicated run's traces span the router's log AND each "
+        "replica's; the assembler joins them by trace id",
+    )
+    p.add_argument(
+        "--trace", metavar="ID",
+        help="render ONE assembled trace as a waterfall (exit 2 when "
+        "the logs have no spans for it)",
+    )
+    p.add_argument(
+        "--slowest-traces", metavar="K", type=int,
+        help="rank the top-K assembled traces by root duration with "
+        "their per-stage breakdown",
+    )
     return p
 
 
-def _load_summary(path: str):
-    from trpo_tpu.obs.analyze import load_events, summarize_run
+def _load_records(path: str):
+    from trpo_tpu.obs.analyze import load_events
 
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         records = load_events(path)
     for w in caught:
         print(f"WARN     {w.message}", file=sys.stderr)
+    return records
+
+
+def _load_summary(path: str, merge=()):
+    from trpo_tpu.obs.analyze import summarize_run
+
+    records = _load_records(path)
     if not records:
         print(f"ERROR    {path}: no readable records", file=sys.stderr)
         return None
+    for extra in merge:
+        try:
+            records = records + _load_records(extra)
+        except OSError as e:
+            # name the MERGE file, not the primary run, in the error
+            print(
+                f"ERROR    {extra}: unreadable ({e})", file=sys.stderr
+            )
+            return None
     return summarize_run(records)
+
+
+def _trace_views(args) -> int:
+    """``--trace`` / ``--slowest-traces``: assemble spans across the
+    run log plus every ``--merge`` file, then render."""
+    from trpo_tpu.obs.analyze import (
+        assemble_traces,
+        render_waterfall,
+        trace_breakdown,
+    )
+
+    records = []
+    for path in [args.run] + list(args.merge):
+        try:
+            records.extend(_load_records(path))
+        except OSError as e:
+            print(f"ERROR    {path}: unreadable ({e})", file=sys.stderr)
+            return 2
+    traces = assemble_traces(records)
+    if args.trace is not None:
+        spans = traces.get(args.trace)
+        if not spans:
+            print(
+                f"ERROR    no spans for trace {args.trace!r} in "
+                f"{1 + len(args.merge)} log(s) "
+                f"({len(traces)} traces present)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.json:
+            print(json.dumps({"trace": args.trace, "spans": spans}))
+        else:
+            print(render_waterfall(spans))
+        return 0
+    rows = sorted(
+        (
+            b for b in (
+                trace_breakdown(s) for s in traces.values()
+            )
+            if b is not None
+        ),
+        key=lambda b: -b["root_ms"],
+    )[: max(0, args.slowest_traces)]
+    if args.json:
+        print(json.dumps({"slowest": rows}))
+        return 0
+    if not rows:
+        print("no assembled traces (did the run sample any?)")
+        return 0
+    from trpo_tpu.obs.analyze import format_table
+
+    print(format_table(
+        [
+            [
+                b["trace"], b["root"], f"{b['root_ms']:.2f}",
+                b["spans"],
+                ", ".join(
+                    f"{k}={v:.1f}" for k, v in b["stages"].items()
+                ),
+            ]
+            for b in rows
+        ],
+        ["trace", "root", "root_ms", "spans", "stage breakdown (ms)"],
+    ))
+    return 0
 
 
 def main(argv=None) -> int:
@@ -93,8 +203,18 @@ def main(argv=None) -> int:
         render_summary,
     )
 
+    if args.trace is not None or args.slowest_traces is not None:
+        if args.compare:
+            print(
+                "ERROR    --trace/--slowest-traces and --compare are "
+                "different views — run them separately",
+                file=sys.stderr,
+            )
+            return 2
+        return _trace_views(args)
+
     try:
-        run = _load_summary(args.run)
+        run = _load_summary(args.run, merge=args.merge)
     except OSError as e:
         print(f"ERROR    {args.run}: unreadable ({e})", file=sys.stderr)
         return 2

@@ -48,6 +48,10 @@ echo "== tier-1: multi-host serving (transport, leases, write fencing) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_multihost_serve.py -q \
     -m 'not slow'
 
+echo "== tier-1: request tracing (spans, propagation, assembly, contracts) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q \
+    -m 'not slow'
+
 echo "== tier-1: env fleet (chunked rollouts, wide-N presets, env-steps/s) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_env_fleet.py -q \
     -m 'not slow'
@@ -242,11 +246,23 @@ echo "== partition smoke: 2-host set, 10 s partition, lease-fenced zombie =="
 # recorded in the zombie's own event log. All logs must validate
 # (partition matched by lease_expired + session resumed; expired
 # leases resolved) and the router log must analyze (host/lease rows).
+# ISSUE 15: the smoke runs TRACED end to end (trace_sample_rate=1.0 on
+# the router and both children) — it asserts the partition-era request
+# assembles ACROSS the three process logs into one trace carrying the
+# router root, the survivor's replica/queue/epoch spans, and the
+# router.takeover span (resumed, journal-backed); the logs then pass
+# the validator's trace contracts (orphans, unterminated roots,
+# retried-needs-retry-span, traced-partition-needs-takeover-span), and
+# the analyze CLI renders the cross-log critical path.
 PART_TMP=$(mktemp -d)
 JAX_PLATFORMS=cpu python scripts/partition_smoke.py --tmp "$PART_TMP"
 python scripts/validate_events.py "$PART_TMP/partition_events.jsonl" \
     "$PART_TMP"/child-*.jsonl
 python scripts/analyze_run.py "$PART_TMP/partition_events.jsonl"
+PART_MERGE=()
+for f in "$PART_TMP"/child-*.jsonl; do PART_MERGE+=(--merge "$f"); done
+python scripts/analyze_run.py "$PART_TMP/partition_events.jsonl" \
+    "${PART_MERGE[@]}" --slowest-traces 5
 
 echo "== session batching smoke: 16 concurrent sessions, parity + >=4x =="
 # ISSUE 13 acceptance: (a) a recurrent replica under >= 16 CONCURRENT

@@ -32,10 +32,21 @@ network):
    write for the migrated session must be REFUSED — the journal file
    still holds the takeover-time snapshot, and the child's own event
    log records ``lease:fenced_write_refused``.
-6. Every event log (the router's and each child's) must pass
+6. **Request tracing** (ISSUE 15) — the router and both children run
+   with ``trace_sample_rate=1.0``; the partition-era act that fails
+   over carries a caller-supplied ``X-Trace-Id``, and after teardown
+   the trace is ASSEMBLED across the router's log plus the children's
+   logs: it must contain the router root + dispatch, the replica
+   handler, the batcher queue-wait, the shared ``engine.step_batch``
+   epoch span, and — because this is the partition-era request — the
+   ``router.takeover`` span on the survivor (``resumed=True``,
+   journal-backed), with a critical-path breakdown attributing queue/
+   epoch/network stages.
+7. Every event log (the router's and each child's) must pass
    ``scripts/validate_events.py`` — including the partition fault's
-   detection pairing (lease_expired on that host + session resumed) —
-   and the router log must analyze (host/lease rows).
+   detection pairing (lease_expired on that host + session resumed +
+   the traced-log takeover-span contract) — and the router log must
+   analyze (host/lease rows).
 
 Exit 0 on success; any assertion failure exits nonzero with the reason.
 """
@@ -59,11 +70,11 @@ sys.path.insert(
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _post(url, payload=None, timeout=30.0):
+def _post(url, payload=None, timeout=30.0, headers=None):
     data = b"" if payload is None else json.dumps(payload).encode()
-    req = urllib.request.Request(
-        url, data=data, headers={"Content-Type": "application/json"}
-    )
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    req = urllib.request.Request(url, data=data, headers=h)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, json.loads(r.read())
@@ -81,6 +92,7 @@ def main(argv=None) -> int:
     from trpo_tpu.agent import TRPOAgent
     from trpo_tpu.config import TRPOConfig
     from trpo_tpu.obs.events import EventBus, JsonlSink, manifest_fields
+    from trpo_tpu.obs.trace import Tracer, mint_trace_id
     from trpo_tpu.resilience.inject import FaultInjector
     from trpo_tpu.serve import (
         ReplicaSet,
@@ -121,6 +133,7 @@ def main(argv=None) -> int:
         "--policy-gru 8 --serve-seconds 600 "
         f"--carry-journal-dir {jdir} "
         "--replica-name {replica} "
+        "--trace-sample-rate 1.0 "
         f"--metrics-jsonl {args.tmp}/child-{{replica}}.jsonl"
     )
     transport = TemplateTransport(
@@ -141,7 +154,11 @@ def main(argv=None) -> int:
     )
     rs.start()
     assert rs.wait_healthy(2, timeout=180.0), rs.snapshot()
-    router = Router(rs, port=0, bus=bus, journal_dir=jdir)
+    # tracing at rate 1.0: every probe has an assembled trace; the
+    # children run the same rate via the template flag above
+    tracer = Tracer(bus, 1.0, process="router")
+    router = Router(rs, port=0, bus=bus, journal_dir=jdir,
+                    tracer=tracer)
     try:
         snap = rs.snapshot()
         hosts = {rid: row["host"] for rid, row in snap["replicas"].items()}
@@ -175,13 +192,16 @@ def main(argv=None) -> int:
 
         sheds = [0]
 
-        def step(sess, expect_resumed=None):
+        def step(sess, expect_resumed=None, trace_id=None):
             """One probe act, absorbing only typed 503 sheds."""
             t = sess["t"]
             for _ in range(100):
                 status, out = _post(
                     router.url + f"/session/{sess['sid']}/act",
                     {"obs": sess["obs"][t].tolist()},
+                    headers=(
+                        {"X-Trace-Id": trace_id} if trace_id else None
+                    ),
                 )
                 if status == 503:
                     sheds[0] += 1
@@ -254,8 +274,11 @@ def main(argv=None) -> int:
         )
         t_cut = time.monotonic()
         # the act that trips the injector is also the act that fails
-        # over: resumed from the journal on the survivor, bit-exact
-        step(victim_sess, expect_resumed=True)
+        # over: resumed from the journal on the survivor, bit-exact —
+        # and it carries a caller-supplied trace id, so the assembled
+        # trace below is THE partition-era request end to end
+        takeover_tid = mint_trace_id()
+        step(victim_sess, expect_resumed=True, trace_id=takeover_tid)
         assert router.injector.all_fired
         # every OTHER session pinned to the same host must also resume
         for sess in sessions[1:]:
@@ -326,6 +349,7 @@ def main(argv=None) -> int:
         )
     finally:
         router.close()
+        tracer.close()  # flush pending spans before the bus closes
         rs.close()
         bus.close()
 
@@ -343,6 +367,58 @@ def main(argv=None) -> int:
     assert fenced, (
         f"zombie log {zombie_log} has no fenced_write_refused record"
     )
+
+    # -- the assembled multi-host trace (ISSUE 15) -----------------------
+    # one trace, three processes: the router's log + both children's.
+    # The partition-era request must show the WHOLE detour: router root
+    # -> takeover (journal-backed resume on the survivor) -> dispatch
+    # -> the survivor's handler -> queue wait -> the shared epoch span.
+    from trpo_tpu.obs.analyze import (
+        assemble_traces,
+        load_events,
+        render_waterfall,
+        trace_breakdown,
+    )
+
+    records = load_events(events_path)
+    for cl in child_logs:
+        records += load_events(cl)
+    traces = assemble_traces(records)
+    assert takeover_tid in traces, (
+        f"partition-era trace {takeover_tid} not assembled "
+        f"({len(traces)} traces present)"
+    )
+    spans = traces[takeover_tid]
+    names = {s.get("name") for s in spans}
+    required = {
+        "router.session_act", "router.takeover", "router.fence",
+        "router.dispatch", "replica.session_act", "batch.queue_wait",
+        "engine.step_batch",
+    }
+    assert required <= names, (required - names, sorted(names))
+    takeover = [s for s in spans if s["name"] == "router.takeover"][0]
+    assert takeover.get("resumed") is True, takeover
+    assert takeover.get("journal_backed") is True, takeover
+    assert takeover.get("from_host") == victim_host, takeover
+    survivor_spans = [
+        s for s in spans
+        if s["name"] == "replica.session_act"
+        and s.get("host") == other_host
+    ]
+    assert survivor_spans, (
+        "the partition-era handler span is not on the survivor host"
+    )
+    b = trace_breakdown(spans)
+    assert b is not None and {"queue", "epoch", "takeover"} <= set(
+        b["stages"]
+    ), b
+    print(
+        f"partition-era trace assembled across 1+{len(child_logs)} "
+        f"process logs: {len(spans)} spans, root "
+        f"{b['root_ms']:.1f} ms, stages "
+        + ", ".join(f"{k}={v:.1f}ms" for k, v in b["stages"].items())
+    )
+    print(render_waterfall(spans))
     print(
         f"partition smoke OK — events at {events_path} + "
         f"{len(child_logs)} child logs (zombie refusal recorded in "

@@ -290,6 +290,17 @@ def build_parser() -> argparse.ArgumentParser:
         "wedge_reload@step=N, drop_carry_journal@request=K:replica=R",
     )
     p.add_argument(
+        "--trace-sample-rate", type=float,
+        help="request tracing (default 0 = off; needs --metrics-jsonl "
+        "— spans ride the event bus): each request gets a 128-bit "
+        "trace id (minted at the edge, or taken from the client's "
+        "X-Trace-Id header), sampled head-based at this rate, "
+        "propagated to every replica hop as headers; retried/failed/"
+        "resumed/chaos-fired requests are ALWAYS traced. Assemble "
+        "with scripts/analyze_run.py --trace <id> (merge the per-"
+        "process logs with --merge)",
+    )
+    p.add_argument(
         "--run-descriptor",
         help="write an atomic run.json here at startup (pid, bound "
         "port, url, endpoints) — tooling discovery without stdout "
@@ -417,6 +428,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         updates["serve_canary_fraction"] = args.canary_fraction
     if args.canary_window is not None:
         updates["serve_canary_window"] = args.canary_window
+    if args.trace_sample_rate is not None:
+        updates["trace_sample_rate"] = args.trace_sample_rate
     if updates:
         cfg = cfg.replace(**updates)
 
@@ -532,6 +545,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # wearing the unvalidated step
     incumbent = {"step": None}
 
+    if cfg.trace_sample_rate > 0 and not args.metrics_jsonl:
+        print(
+            "error: --trace-sample-rate emits spans on the event bus "
+            "— pass --metrics-jsonl so they land somewhere.",
+            file=sys.stderr,
+        )
+        return 2
+
     bus = None
     if args.metrics_jsonl:
         bus = EventBus(JsonlSink(args.metrics_jsonl))
@@ -551,6 +572,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
     if injector is not None:
         injector.bus = bus
+
+    # request tracing (ISSUE 15): one Tracer per process role (the
+    # router front end + each in-process replica), all draining to the
+    # one bus — cached by name so a replica RELAUNCH reuses its tracer
+    # instead of leaking a writer thread per restart. Subprocess
+    # children arm their own via the template's --trace-sample-rate.
+    _tracers: dict = {}
+
+    def make_tracer(name: str):
+        if bus is None or cfg.trace_sample_rate <= 0:
+            return None
+        if name not in _tracers:
+            from trpo_tpu.obs.trace import Tracer
+
+            # a host-namespaced replica name ("hostA--r0", the
+            # TemplateTransport convention journal_path shares) tells
+            # this child which host it runs on — stamp it so the
+            # assembler can place cross-host spans without guessing
+            host = name.split("--", 1)[0] if "--" in name else None
+            _tracers[name] = Tracer(
+                bus, cfg.trace_sample_rate, process=name, host=host
+            )
+        return _tracers[name]
 
     def build_replica(replica_name: Optional[str], port: int):
         """One complete serving stack: the right engine for the model
@@ -593,6 +637,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             injector=injector,
             session_deadline_ms=cfg.serve_session_deadline_ms,
             session_adaptive_deadline=cfg.serve_adaptive_deadline,
+            tracer=make_tracer(replica_name or "solo"),
         )
         closers = ([batcher] if batcher is not None else []) + [
             checkpointer
@@ -674,6 +719,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             canary_fraction=cfg.serve_canary_fraction,
             injector=injector,
             min_latency_samples=cfg.serve_autoscale_min_samples,
+            tracer=make_tracer("router"),
         )
         if canary:
             canary_ck = Checkpointer(
@@ -763,6 +809,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             server.close()
         for c in closers:
             c.close()
+        for t in _tracers.values():
+            t.close()  # flush pending spans BEFORE the bus closes
         if injector is not None and injector.unfired:
             # a chaos run whose faults never fired tested NOTHING —
             # same loud-completion contract as the training injector

@@ -55,7 +55,18 @@ scale/shed reaction, the slow host's lease expiry, or an eviction of
 one of ITS replicas (host-filtered — unrelated churn must not
 satisfy it), ``lost_descriptor`` by a replica death/failure whose
 reason names the descriptor (the launch failed LOUDLY — a phantom
-``starting`` record is exactly what this matcher would miss).
+``starting`` record is exactly what this matcher would miss); and —
+ISSUE 15 — the request-trace contracts: an ORPHAN span (a non-remote
+``parent`` id never emitted in the same file — cross-process parents
+are marked ``remote`` and skipped) FAILS, an UNTERMINATED root span
+(no parent, not remote, ``dur_ms`` null) FAILS, a ``router``
+request record with ``retried=true`` that names its ``trace`` must
+have a ``router.retry`` span somewhere in the file (a retried
+request whose trace hides the retry defeats the always-trace-
+anomalies policy), and — in a log that carries spans at all — a
+``partition_host`` fault must be matched by a ``router.takeover``
+span (the trace must SHOW the detour the partition forced, not just
+the lease bookkeeping).
 Exits non-zero with per-line diagnostics on any failure; prints a
 per-kind count summary on success. Used by ``scripts/check.sh`` against
 both a training run's ``--metrics-jsonl`` output and ``bench.py``'s
@@ -269,6 +280,10 @@ def validate_file(path: str) -> list:
                     f"{path}:{n}: iteration event missing "
                     f"device-accumulated counter {key!r}"
                 )
+    # whether this log carries request-trace spans at all (ISSUE 15):
+    # computed ONCE — the partition-takeover matcher below and the
+    # per-span contracts further down share it
+    has_spans = any(rec.get("kind") == "span" for _, rec in records)
     # ISSUE 4 chaos contract: every injected fault must have produced a
     # matching detection/recovery record later in the stream
     for idx, (n, rec) in enumerate(records):
@@ -297,6 +312,20 @@ def validate_file(path: str) -> list:
                     "has no session:resumed record after it — the "
                     "partitioned host's sessions never resumed on a "
                     "survivor"
+                )
+            # the trace half (ISSUE 15): in a log that carries spans
+            # at all (the trace layer was armed — chaos requests are
+            # always sampled), the detour itself must be visible as a
+            # router.takeover span, not just lease bookkeeping
+            if has_spans and not any(
+                later.get("kind") == "span"
+                and later.get("name") == "router.takeover"
+                for _, later in records[idx + 1:]
+            ):
+                errs.append(
+                    f"{path}:{n}: fault_injected ({rec.get('spec')}) "
+                    "in a traced log with no router.takeover span "
+                    "after it — no trace shows the partition's detour"
                 )
     # ISSUE 8 solver-precision contract (same pattern as the
     # fault-matching rule): a rise in the run-cumulative `fallbacks`
@@ -418,6 +447,65 @@ def validate_file(path: str) -> list:
                 "with no matching died/evicted resolution (or "
                 "re-granted lease) record after it"
             )
+    # ISSUE 15 trace contracts. (1) orphan span: a non-remote parent id
+    # never emitted in THIS file means the emitter lost a span (or
+    # forgot the remote mark on a cross-process edge) — the assembled
+    # tree would silently dangle. (2) unterminated root: dur_ms null on
+    # a root span means a request's trace was flushed without its edge
+    # ever ending — the end-to-end number every breakdown divides by is
+    # missing. Spans flush through a write-behind writer, so parents
+    # may land AFTER children — both checks are whole-file, not ordered.
+    span_ids = {
+        rec.get("span") for _, rec in records
+        if rec.get("kind") == "span"
+    }
+    for n, rec in records:
+        if rec.get("kind") != "span":
+            continue
+        parent = rec.get("parent")
+        if (
+            parent is not None
+            and not rec.get("remote")
+            and parent not in span_ids
+        ):
+            errs.append(
+                f"{path}:{n}: orphan span {rec.get('span')!r} "
+                f"({rec.get('name')}): parent {parent!r} never emitted "
+                "in this file (cross-process parents must be marked "
+                "remote)"
+            )
+        if (
+            parent is None
+            and not rec.get("remote")
+            and rec.get("dur_ms") is None
+        ):
+            errs.append(
+                f"{path}:{n}: unterminated root span "
+                f"{rec.get('span')!r} ({rec.get('name')}): the trace "
+                "was flushed without its edge span ever ending"
+            )
+    # (3) a retried request that names its trace must have the retry
+    # visible IN that trace — anomalies are always-sampled precisely so
+    # the trace shows what the latency bought
+    if has_spans:
+        retry_traces = {
+            rec.get("trace") for _, rec in records
+            if rec.get("kind") == "span"
+            and rec.get("name") == "router.retry"
+        }
+        for n, rec in records:
+            if (
+                rec.get("kind") == "router"
+                and rec.get("scope") == "request"
+                and rec.get("retried") is True
+                and isinstance(rec.get("trace"), str)
+                and rec["trace"] not in retry_traces
+            ):
+                errs.append(
+                    f"{path}:{n}: retried request's trace "
+                    f"{rec['trace']!r} has no router.retry span in "
+                    "this file — the trace hides the retry"
+                )
     # ISSUE 12 drain contract (the canary `started` pattern): a drain
     # that started with no later same-replica completed/aborted
     # terminal may have stranded sessions on a half-retired replica —

@@ -58,6 +58,9 @@ def test_save_restore_roundtrip(tmp_path):
     _assert_tree_equal(state, restored)
 
 
+@pytest.mark.slow  # tier-1 budget guard (ISSUE 15): >10 s singleton —
+# the save/restore round-trip itself is pinned by the fast tests above;
+# still runs in check.sh's slow tier
 def test_resume_continues_identically(tmp_path):
     agent = _tiny_agent()
     state = agent.init_state()
@@ -124,6 +127,7 @@ def test_restore_preserves_mesh_sharding(tmp_path):
     _assert_tree_equal(cont_a, cont_b)
 
 
+@pytest.mark.slow  # tier-1 budget guard (ISSUE 15): >10 s singleton
 def test_max_to_keep_prunes(tmp_path):
     agent = _tiny_agent()
     state = agent.init_state()
@@ -138,6 +142,7 @@ def test_max_to_keep_prunes(tmp_path):
         ckpt.close()
 
 
+@pytest.mark.slow  # tier-1 budget guard (ISSUE 15): >10 s singleton
 def test_checkpoint_restores_recurrent_state(tmp_path):
     """TrainState with GRU memory in the carry (device env: scan carry;
     host env: (h, prev_done)) round-trips through Orbax and training
@@ -163,6 +168,7 @@ def test_checkpoint_restores_recurrent_state(tmp_path):
     _assert_tree_equal(s1, s2)
 
 
+@pytest.mark.slow  # tier-1 budget guard (ISSUE 15): >10 s singleton
 def test_restore_across_adaptive_damping_flip(tmp_path):
     """TrainState.cg_damping is a f32 scalar iff cfg.adaptive_damping, so
     flipping the flag between save and restore changes the pytree

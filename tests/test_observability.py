@@ -451,6 +451,7 @@ def test_async_driver_emits_same_iteration_events(tmp_path):
     assert recs[0]["driver"] == "async"
 
 
+@pytest.mark.slow  # tier-1 budget guard (ISSUE 15): >10 s singleton
 def test_profile_iteration_window_writes_trace(tmp_path):
     """--profile-dir + --profile-iteration captures a windowed trace
     around the requested iteration (not the whole run)."""

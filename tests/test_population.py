@@ -52,6 +52,8 @@ def test_population_member_matches_solo_run():
     )
 
 
+@pytest.mark.slow  # tier-1 budget guard (ISSUE 15): >10 s singleton —
+# the member==solo and fused-equality pins above keep the fast coverage
 def test_population_sharded_matches_unsharded():
     from trpo_tpu.parallel import make_mesh
 

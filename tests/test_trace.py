@@ -1,0 +1,890 @@
+"""End-to-end request tracing for the serving plane (ISSUE 15).
+
+Contracts pinned here:
+
+* trace/span ids mint well-formed, client-supplied ids validate, and
+  the head-sampling decision is a PURE function of the trace id (every
+  process agrees with no coordination);
+* the :class:`~trpo_tpu.obs.trace.Tracer` write-behind emits
+  schema-valid ``span`` records through the bus, drops (and COUNTS)
+  spans past its bound, and forced (anomaly) contexts emit regardless
+  of the head sample;
+* every serving stage emits its span — router root/dispatch, replica
+  handler, batcher queue-wait, the SHARED epoch span (N coalesced
+  sessions point at ONE ``engine.step_batch`` span id), journal sync,
+  and the failover ``router.takeover``/``router.fence`` pair;
+* sampling is ALWAYS-on for anomalies: at rate 0, a retried/resumed
+  act still emits a trace containing the retry/takeover span, and the
+  request event names its trace;
+* the validator FAILS an orphan span, an unterminated root span, a
+  retried request whose trace lacks a retry span, and a traced
+  partition log with no takeover span;
+* cross-process assembly joins spans from 2+ per-process logs into one
+  tree, the breakdown attributes stages (network = hop minus remote
+  handler), the waterfall renders, and ``compare_runs`` judges
+  per-stage p99 time-like;
+* ``analyze_run.py --trace/--slowest-traces`` keep stdout
+  machine-parseable under ``--json``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trpo_tpu.obs.events import EventBus, validate_event
+from trpo_tpu.obs.trace import (
+    PARENT_HEADER,
+    SAMPLED_HEADER,
+    TRACE_HEADER,
+    TraceContext,
+    Tracer,
+    head_sampled,
+    mint_span_id,
+    mint_trace_id,
+    valid_trace_id,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# ids + sampling
+# ---------------------------------------------------------------------------
+
+
+def test_mint_ids_well_formed():
+    tid, sid = mint_trace_id(), mint_span_id()
+    assert len(tid) == 32 and int(tid, 16) >= 0
+    assert len(sid) == 16 and int(sid, 16) >= 0
+    assert mint_trace_id() != tid  # 128-bit: collisions are a bug
+    assert valid_trace_id(tid)
+    assert valid_trace_id("deadbeef")
+    assert not valid_trace_id("xyz")          # non-hex
+    assert not valid_trace_id("abc")          # too short
+    assert not valid_trace_id("a" * 65)       # too long
+    assert not valid_trace_id(None)
+    # int(x, 16) look-alikes that are NOT canonical hex digit strings
+    assert not valid_trace_id("0xDEADBEEF")
+    assert not valid_trace_id("dead_beef")
+    assert not valid_trace_id("+deadbeef")
+    assert not valid_trace_id(" deadbeef")
+
+
+def test_head_sampling_is_deterministic_and_monotone():
+    ids = [mint_trace_id() for _ in range(256)]
+    for tid in ids[:8]:
+        assert head_sampled(tid, 1.0)
+        assert not head_sampled(tid, 0.0)
+        # pure function: every process reaches the same verdict
+        assert head_sampled(tid, 0.3) == head_sampled(tid, 0.3)
+        # monotone in the rate: sampled at r stays sampled at r' > r
+        if head_sampled(tid, 0.3):
+            assert head_sampled(tid, 0.8)
+    frac = sum(head_sampled(t, 0.5) for t in ids) / len(ids)
+    assert 0.3 < frac < 0.7  # hash-uniform, not all-or-nothing
+
+
+# ---------------------------------------------------------------------------
+# tracer write-behind
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_emits_schema_valid_spans():
+    recs = []
+    bus = EventBus(lambda r: recs.append(r))
+    tracer = Tracer(bus, 1.0, process="p0", host="h0")
+    ctx = tracer.begin()
+    root = ctx.span("router.act")
+    child = ctx.span("router.dispatch", parent=root, replica="r0")
+    child.end(status=200)
+    root.end(status=200)
+    assert tracer.finish(ctx) is True
+    tracer.drain()
+    spans = [r for r in recs if r["kind"] == "span"]
+    assert len(spans) == 2
+    assert all(not validate_event(s) for s in spans)
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["router.dispatch"]["parent"] == (
+        by_name["router.act"]["span"]
+    )
+    assert all(
+        s["process"] == "p0" and s["host"] == "h0" for s in spans
+    )
+    assert tracer.sampled_total == 1
+    assert tracer.spans_total == 2
+    assert tracer.dropped_total == 0
+    tracer.close()
+    bus.close()
+
+
+def test_unsampled_context_drops_and_forced_emits():
+    recs = []
+    bus = EventBus(lambda r: recs.append(r))
+    tracer = Tracer(bus, 0.0)
+    ctx = tracer.begin()
+    ctx.span("router.act").end()
+    assert tracer.finish(ctx) is False  # head said no, nothing forced
+    forced = tracer.begin()
+    forced.span("router.act").end()
+    forced.force()  # the anomaly path: always emitted
+    assert tracer.finish(forced) is True
+    tracer.drain()
+    spans = [r for r in recs if r["kind"] == "span"]
+    assert len(spans) == 1 and spans[0]["trace"] == forced.trace_id
+    tracer.close()
+    bus.close()
+
+
+def test_writer_backpressure_drops_whole_contexts_counted():
+    gate = threading.Event()
+    emitted = []
+
+    def blocking_sink(rec):
+        gate.wait(10.0)
+        emitted.append(rec)
+
+    bus = EventBus(blocking_sink)
+    tracer = Tracer(bus, 1.0, max_pending=3, poll_interval=0.01)
+    # wedge the writer on the first span so the pending bound fills
+    first = tracer.begin()
+    first.span("x").end()
+    tracer.finish(first)
+    time.sleep(0.1)  # writer now blocked inside the sink
+    big = tracer.begin()
+    for i in range(6):
+        big.span(f"s{i}").end()
+    # the WHOLE context drops (a span-tail drop would orphan children
+    # and fail the validator's per-file consistency contract)
+    assert tracer.finish(big) is False
+    assert tracer.dropped_total == 6
+    # a FORCED (anomaly) context overshoots the bound instead: its
+    # request event already named the trace, so its spans must exist
+    forced = tracer.begin()
+    for i in range(5):
+        forced.span(f"f{i}").end()
+    forced.force()
+    assert tracer.finish(forced) is True
+    assert tracer.dropped_total == 6  # unchanged
+    gate.set()
+    tracer.drain()
+    tracer.close()
+    bus.close()
+    assert len(emitted) == 6  # first span + the forced context's 5
+    assert not any(r["trace"] == big.trace_id for r in emitted)
+
+
+def test_headers_propagate_verdict_and_parent():
+    recs = []
+    bus = EventBus(lambda r: recs.append(r))
+    tracer = Tracer(bus, 0.0)
+    ctx = tracer.begin()
+    root = ctx.span("router.act")
+    headers = Tracer.headers_for(ctx, root)
+    assert headers[TRACE_HEADER] == ctx.trace_id
+    assert headers[PARENT_HEADER] == root.span_id
+    assert SAMPLED_HEADER not in headers  # unsampled, unforced
+    ctx.force()
+    assert Tracer.headers_for(ctx, root)[SAMPLED_HEADER] == "1"
+    # the replica side joins on the propagated verdict even at rate 0
+    joined = tracer.join(
+        {TRACE_HEADER: ctx.trace_id, SAMPLED_HEADER: "1",
+         PARENT_HEADER: root.span_id}
+    )
+    assert joined is not None and joined.sampled
+    assert tracer.parent_from({PARENT_HEADER: "abc"}) == "abc"
+    # no headers at all: this process is the edge and keeps a context
+    assert tracer.join(None) is not None
+    # a propagated-but-unsampled trace STILL gets a context: a
+    # replica-side anomaly must be able to force its spans out
+    unsampled = tracer.join({TRACE_HEADER: mint_trace_id()})
+    assert unsampled is not None and not unsampled.sampled
+    unsampled.span("replica.act").end(status=500)
+    unsampled.force()
+    assert tracer.finish(unsampled) is True
+    tracer.close()
+    bus.close()
+
+
+def test_httpd_exposes_request_headers():
+    from trpo_tpu.utils.httpd import BackgroundHTTPServer, request_headers
+
+    seen = {}
+
+    def handler(body):
+        seen["trace"] = request_headers().get(TRACE_HEADER)
+        return 200, "application/json", b"{}"
+
+    srv = BackgroundHTTPServer(0, post={"/x": handler})
+    req = urllib.request.Request(
+        srv.url + "/x", data=b"{}",
+        headers={TRACE_HEADER: "feedc0de"},
+    )
+    urllib.request.urlopen(req, timeout=10).read()
+    srv.close()
+    assert seen["trace"] == "feedc0de"
+    assert request_headers() is None  # outside a handler
+
+
+# ---------------------------------------------------------------------------
+# the shared epoch span (batcher-level, fake engine — no jax)
+# ---------------------------------------------------------------------------
+
+
+class _FakeSessionEngine:
+    state_size = 4
+    obs_shape = (3,)
+    obs_dtype = np.dtype(np.float32)
+    max_batch = 8
+
+    def padded_shape(self, n):
+        return self.max_batch
+
+    def step_batch(self, carries, obs, return_step=False):
+        n = obs.shape[0]
+        out = (np.zeros((n, 1)), np.asarray(carries) + 1.0)
+        return out + (7,) if return_step else out
+
+
+def test_shared_epoch_span_across_coalesced_sessions():
+    from trpo_tpu.serve.batcher import SessionBatcher
+
+    recs = []
+    bus = EventBus(lambda r: recs.append(r))
+    tracer = Tracer(bus, 1.0)
+    engine = _FakeSessionEngine()
+    batcher = SessionBatcher(engine, deadline_ms=200.0, bus=bus)
+    n = 5
+    ctxs = [tracer.begin() for _ in range(n)]
+    parents = [c.span(f"replica.session_act") for c in ctxs]
+    futures = [
+        batcher.submit(
+            f"s{i}", np.zeros(4, np.float32), np.zeros(3, np.float32),
+            trace=(ctxs[i], parents[i].span_id),
+        )
+        for i in range(n)
+    ]
+    for f in futures:
+        f.result(timeout=10)
+    batcher.close()
+    for c, p in zip(ctxs, parents):
+        p.end()
+        tracer.finish(c)
+    tracer.drain()
+    spans = [r for r in recs if r["kind"] == "span"]
+    epochs = [s for s in spans if s["name"] == "engine.step_batch"]
+    waits = [s for s in spans if s["name"] == "batch.queue_wait"]
+    # every coalesced session's trace carries the dispatch span — and
+    # it is ONE span: the same span id in all n traces (this is what
+    # makes epoch-induced tail latency attributable)
+    assert len(epochs) == n and len(waits) == n
+    assert len({s["span"] for s in epochs}) == 1
+    assert len({s["trace"] for s in epochs}) == n
+    assert all(s["width"] == n and s["rung"] == 8 for s in epochs)
+    # chain: handler -> queue_wait -> epoch
+    by_trace = {s["trace"]: s for s in epochs}
+    for w in waits:
+        assert by_trace[w["trace"]]["parent"] == w["span"]
+    assert all(not validate_event(s) for s in spans)
+    tracer.close()
+    bus.close()
+
+
+def test_engine_failure_forces_the_trace():
+    from trpo_tpu.serve.batcher import SessionBatcher
+
+    class _Broken(_FakeSessionEngine):
+        def step_batch(self, carries, obs, return_step=False):
+            raise RuntimeError("wedged")
+
+    recs = []
+    bus = EventBus(lambda r: recs.append(r))
+    tracer = Tracer(bus, 0.0)  # head sample says NO
+    batcher = SessionBatcher(_Broken(), deadline_ms=1.0, bus=bus)
+    ctx = tracer.begin()
+    parent = ctx.span("replica.session_act")
+    f = batcher.submit(
+        "s0", np.zeros(4, np.float32), np.zeros(3, np.float32),
+        trace=(ctx, parent.span_id),
+    )
+    with pytest.raises(RuntimeError):
+        f.result(timeout=10)
+    batcher.close()
+    parent.end(status=500)
+    assert ctx.forced  # the failure forced the anomaly path
+    assert tracer.finish(ctx) is True
+    tracer.close()
+    bus.close()
+
+
+# ---------------------------------------------------------------------------
+# validator contracts (synthetic logs)
+# ---------------------------------------------------------------------------
+
+
+def _manifest():
+    import jax
+
+    return {
+        "v": 1, "t": time.time(), "kind": "run_manifest",
+        "schema": "trpo-tpu-events", "jax_version": jax.__version__,
+        "backend": "cpu", "config_hash": "deadbeefdeadbeef",
+        "config": None,
+    }
+
+
+def _span(trace, span, name, parent=None, remote=False, dur=1.0,
+          **extra):
+    rec = {
+        "v": 1, "t": time.time(), "kind": "span", "trace": trace,
+        "span": span, "name": name, "start": time.time(),
+        "dur_ms": dur,
+    }
+    if parent is not None:
+        rec["parent"] = parent
+    if remote:
+        rec["remote"] = True
+    rec.update(extra)
+    return rec
+
+
+def _write_log(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def _validate(path):
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    import validate_events
+
+    return validate_events.validate_file(str(path))
+
+
+def test_validator_orphan_span_fails(tmp_path):
+    tid = "ab" * 16
+    good = tmp_path / "good.jsonl"
+    _write_log(good, [
+        _manifest(),
+        _span(tid, "a" * 16, "router.act"),
+        _span(tid, "b" * 16, "router.dispatch", parent="a" * 16),
+    ])
+    assert _validate(good) == []
+    bad = tmp_path / "bad.jsonl"
+    _write_log(bad, [
+        _manifest(),
+        _span(tid, "b" * 16, "router.dispatch", parent="f" * 16),
+    ])
+    errs = _validate(bad)
+    assert any("orphan span" in e for e in errs), errs
+    # the SAME missing parent marked remote is a cross-process edge
+    ok = tmp_path / "remote.jsonl"
+    _write_log(ok, [
+        _manifest(),
+        _span(tid, "b" * 16, "replica.act", parent="f" * 16,
+              remote=True),
+    ])
+    assert _validate(ok) == []
+
+
+def test_validator_unterminated_root_fails(tmp_path):
+    tid = "cd" * 16
+    bad = tmp_path / "bad.jsonl"
+    _write_log(bad, [
+        _manifest(),
+        _span(tid, "a" * 16, "router.act", dur=None),
+    ])
+    errs = _validate(bad)
+    assert any("unterminated root" in e for e in errs), errs
+    # an unterminated NON-root (remote handler) is tolerated: only the
+    # edge's end-to-end number is load-bearing
+    ok = tmp_path / "ok.jsonl"
+    _write_log(ok, [
+        _manifest(),
+        _span(tid, "b" * 16, "replica.act", parent="f" * 16,
+              remote=True, dur=None),
+    ])
+    assert _validate(ok) == []
+
+
+def test_validator_retried_request_needs_retry_span(tmp_path):
+    tid = "ef" * 16
+
+    def _request(trace=None, retried=True):
+        rec = {
+            "v": 1, "t": time.time(), "kind": "router",
+            "scope": "request", "ms": 5.0, "ok": True,
+            "retried": retried,
+        }
+        if trace is not None:
+            rec["trace"] = trace
+        return rec
+
+    bad = tmp_path / "bad.jsonl"
+    _write_log(bad, [
+        _manifest(),
+        _span(tid, "a" * 16, "router.act"),
+        _request(trace=tid),
+    ])
+    errs = _validate(bad)
+    assert any("no router.retry span" in e for e in errs), errs
+    good = tmp_path / "good.jsonl"
+    _write_log(good, [
+        _manifest(),
+        _span(tid, "a" * 16, "router.act"),
+        _span(tid, "b" * 16, "router.retry", parent="a" * 16),
+        _request(trace=tid),
+    ])
+    assert _validate(good) == []
+    # an untraced retried request (rate 0, layer off) is not judged
+    legacy = tmp_path / "legacy.jsonl"
+    _write_log(legacy, [_manifest(), _request(trace=None)])
+    assert _validate(legacy) == []
+
+
+def test_validator_traced_partition_needs_takeover_span(tmp_path):
+    tid = "09" * 16
+
+    def _partition_records(with_takeover):
+        recs = [
+            _manifest(),
+            {
+                "v": 1, "t": time.time(), "kind": "fault_injected",
+                "fault": "partition_host", "at": 1,
+                "spec": "partition_host@request=1:host=h:seconds=5",
+                "host": "h",
+            },
+            {
+                "v": 1, "t": time.time(), "kind": "lease",
+                "replica": "r0", "event": "expired", "epoch": 1,
+                "host": "h",
+            },
+            {
+                "v": 1, "t": time.time(), "kind": "router",
+                "scope": "replica", "replica": "r0", "state": "died",
+            },
+            {
+                "v": 1, "t": time.time(), "kind": "router",
+                "scope": "replica", "replica": "r0",
+                "state": "restarted",
+            },
+            {
+                "v": 1, "t": time.time(), "kind": "session",
+                "session": "s0", "event": "resumed", "steps": 3,
+                "lag": 0,
+            },
+            _span(tid, "a" * 16, "router.session_act"),
+        ]
+        if with_takeover:
+            recs.append(
+                _span(tid, "b" * 16, "router.takeover",
+                      parent="a" * 16, resumed=True)
+            )
+        return recs
+
+    bad = tmp_path / "bad.jsonl"
+    _write_log(bad, _partition_records(with_takeover=False))
+    errs = _validate(bad)
+    assert any("router.takeover" in e for e in errs), errs
+    good = tmp_path / "good.jsonl"
+    _write_log(good, _partition_records(with_takeover=True))
+    assert _validate(good) == []
+
+
+# ---------------------------------------------------------------------------
+# assembly + breakdown + waterfall + compare
+# ---------------------------------------------------------------------------
+
+
+def _two_process_trace(tid):
+    """A synthetic router log + replica log for one traced session act
+    (durations chosen so every stage is distinguishable)."""
+    router = [
+        _manifest(),
+        _span(tid, "r" * 16, "router.session_act", dur=20.0,
+              process="router"),
+        _span(tid, "d" * 16, "router.dispatch", parent="r" * 16,
+              dur=18.0, process="router", replica="r0"),
+    ]
+    replica = [
+        _manifest(),
+        _span(tid, "h" * 16, "replica.session_act", parent="d" * 16,
+              remote=True, dur=12.0, process="r0"),
+        _span(tid, "q" * 16, "batch.queue_wait", parent="h" * 16,
+              dur=4.0, process="r0"),
+        _span(tid, "e" * 16, "engine.step_batch", parent="q" * 16,
+              dur=6.0, width=3, rung=8, process="r0"),
+        _span(tid, "j" * 16, "journal.sync", parent="h" * 16,
+              dur=0.5, process="r0"),
+    ]
+    return router, replica
+
+
+def test_assembly_and_breakdown_across_logs():
+    from trpo_tpu.obs.analyze import assemble_traces, trace_breakdown
+
+    tid = "12" * 16
+    router, replica = _two_process_trace(tid)
+    traces = assemble_traces(router + replica)
+    assert set(traces) == {tid}
+    assert len(traces[tid]) == 6
+    b = trace_breakdown(traces[tid])
+    assert b["root"] == "router.session_act"
+    assert b["root_ms"] == pytest.approx(20.0)
+    # network = hop (18) minus the remote handler nested under it (12)
+    assert b["stages"]["network"] == pytest.approx(6.0)
+    assert b["stages"]["queue"] == pytest.approx(4.0)
+    assert b["stages"]["epoch"] == pytest.approx(6.0)
+    assert b["stages"]["journal"] == pytest.approx(0.5)
+    # a replica-only fragment has no root to attribute against
+    assert trace_breakdown(traces[tid][2:]) is None or True
+    frag = assemble_traces(replica)
+    assert trace_breakdown(frag[tid]) is None
+
+
+def test_summary_and_waterfall_and_compare():
+    from trpo_tpu.obs.analyze import (
+        compare_runs,
+        render_summary,
+        render_waterfall,
+        summarize_run,
+    )
+
+    tid = "34" * 16
+    router, replica = _two_process_trace(tid)
+    summary = summarize_run(router + replica)
+    tr = summary["traces"]
+    assert tr["count"] == 1 and tr["assembled"] == 1
+    assert tr["root_p99_ms"] == pytest.approx(20.0)
+    assert tr["stages"]["epoch"]["p99_ms"] == pytest.approx(6.0)
+    assert tr["slowest"][0]["trace"] == tid
+    text = render_summary(summary)
+    assert "traces:" in text and "epoch" in text
+    wf = render_waterfall(sorted(
+        router[1:] + replica[1:], key=lambda s: s["start"]
+    ))
+    assert "router.session_act" in wf and "#" in wf
+    # per-stage p99 rows judge time-like: 10x epoch growth regresses
+    slow_router, slow_replica = _two_process_trace("56" * 16)
+    slow_replica[3]["dur_ms"] = 60.0  # the epoch span
+    slow = summarize_run(slow_router + slow_replica)
+    result = compare_runs(summary, slow, threshold_pct=50.0)
+    rows = {v["metric"]: v["verdict"] for v in result["verdicts"]}
+    assert rows["trace/stage_epoch_p99_ms"] == "regressed"
+    assert rows["trace/stage_queue_p99_ms"] == "ok"
+    clean = compare_runs(summary, summary, threshold_pct=50.0)
+    assert not clean["regressed"]
+
+
+def test_analyze_cli_trace_views(tmp_path):
+    tid = "78" * 16
+    router, replica = _two_process_trace(tid)
+    rlog = tmp_path / "router.jsonl"
+    clog = tmp_path / "replica.jsonl"
+    _write_log(rlog, router)
+    _write_log(clog, replica)
+    script = os.path.join(_REPO, "scripts", "analyze_run.py")
+    out = subprocess.run(
+        [sys.executable, script, str(rlog), "--merge", str(clog),
+         "--trace", tid],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "engine.step_batch" in out.stdout
+    out = subprocess.run(
+        [sys.executable, script, str(rlog), "--merge", str(clog),
+         "--slowest-traces", "3", "--json"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    rows = json.loads(out.stdout)["slowest"]  # machine-parseable
+    assert rows[0]["trace"] == tid
+    assert rows[0]["stages"]["network"] == pytest.approx(6.0)
+    out = subprocess.run(
+        [sys.executable, script, str(rlog), "--trace", "00" * 16],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 2  # unknown trace is a usage error
+
+
+def test_config_rejects_bad_sample_rate():
+    from trpo_tpu.config import TRPOConfig
+
+    with pytest.raises(ValueError, match="trace_sample_rate"):
+        TRPOConfig(trace_sample_rate=-0.1)
+    with pytest.raises(ValueError, match="trace_sample_rate"):
+        TRPOConfig(trace_sample_rate=1.01)
+    TRPOConfig(trace_sample_rate=0.25)  # valid
+
+
+# ---------------------------------------------------------------------------
+# e2e: the routed serving stack (engine-backed)
+# ---------------------------------------------------------------------------
+
+_REC_CFG = dict(
+    n_envs=4, batch_timesteps=32, cg_iters=2, vf_train_steps=2,
+    policy_hidden=(8,), vf_hidden=(8,), seed=11, policy_gru=8,
+    serve_session_batch_shapes=(1, 8),
+)
+
+
+@pytest.fixture(scope="module")
+def rec_stack():
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+
+    agent = TRPOAgent("pendulum", TRPOConfig(**_REC_CFG))
+    state = agent.init_state(seed=0)
+    return agent, state
+
+
+def _post(url, payload=None, headers=None, timeout=30.0):
+    import urllib.error
+
+    data = b"" if payload is None else json.dumps(payload).encode()
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    req = urllib.request.Request(url, data=data, headers=h)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _rec_router(rec_stack, tmp_path, bus, tracer, n=2, rate=1.0):
+    from trpo_tpu.serve import (
+        InProcessReplica,
+        PolicyServer,
+        ReplicaSet,
+        Router,
+    )
+
+    agent, state = rec_stack
+    jdir = str(tmp_path / "cj")
+
+    def factory(rid):
+        def build():
+            engine = agent.serve_session_engine()
+            engine.load(state.policy_params, state.obs_norm, step=1)
+            server = PolicyServer(
+                engine, None, port=0, bus=bus, tracer=tracer,
+                replica_name=rid, carry_journal_dir=jdir,
+            )
+            return server, []
+
+        return build
+
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(factory(rid)), n, bus=bus,
+        health_interval=60.0, backoff=0.05, health_fail_threshold=1,
+        max_restarts=2,
+    )
+    assert rs.wait_healthy(n, timeout=60.0), rs.snapshot()
+    router = Router(
+        rs, port=0, bus=bus, journal_dir=jdir, tracer=tracer,
+    )
+    return rs, router
+
+
+def test_every_stage_emits_its_span(rec_stack, tmp_path):
+    """One traced session act through the full routed stack shows the
+    whole taxonomy: router root + dispatch, replica handler, queue
+    wait, epoch, journal sync — with cross-process parentage intact
+    (here both sides share one tracer, but parent ids still travel by
+    header)."""
+    recs = []
+    bus = EventBus(lambda r: recs.append(r))
+    tracer = Tracer(bus, 1.0, process="test")
+    rs, router = _rec_router(rec_stack, tmp_path, bus, tracer)
+    try:
+        tid = mint_trace_id()
+        status, out = _post(
+            router.url + "/session", headers={TRACE_HEADER: tid}
+        )
+        assert status == 200, out
+        sid = out["session"]
+        agent, _ = rec_stack
+        obs = np.zeros(agent.obs_shape, np.float32)
+        tid2 = mint_trace_id()
+        status, out = _post(
+            f"{router.url}/session/{sid}/act",
+            {"obs": obs.tolist()},
+            headers={TRACE_HEADER: tid2},
+        )
+        assert status == 200, out
+        tracer.drain()
+        spans = [
+            r for r in recs
+            if r["kind"] == "span" and r["trace"] == tid2
+        ]
+        names = {s["name"] for s in spans}
+        assert {
+            "router.session_act", "router.dispatch",
+            "replica.session_act", "batch.queue_wait",
+            "engine.step_batch", "journal.sync",
+        } <= names, names
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["replica.session_act"]["remote"] is True
+        assert by_name["replica.session_act"]["parent"] == (
+            by_name["router.dispatch"]["span"]
+        )
+        assert all(not validate_event(s) for s in spans)
+        # the request event names its trace (the analyze join key)
+        req = [
+            r for r in recs
+            if r["kind"] == "router" and r.get("scope") == "request"
+            and r.get("endpoint") == "session_act"
+        ]
+        assert req and req[-1]["trace"] == tid2
+    finally:
+        router.close()
+        rs.close()
+        tracer.close()
+        bus.close()
+
+
+@pytest.mark.slow  # e2e trace leg (ISSUE 15 budget rule): the fast
+# representative above pins the span taxonomy; this one drives the
+# anomaly path (kill -> journal takeover) at rate 0
+def test_failover_is_always_traced_at_rate_zero(rec_stack, tmp_path):
+    recs = []
+    bus = EventBus(lambda r: recs.append(r))
+    tracer = Tracer(bus, 0.0, process="test")  # head sample: never
+    rs, router = _rec_router(rec_stack, tmp_path, bus, tracer)
+    try:
+        agent, state = rec_stack
+        status, out = _post(router.url + "/session")
+        assert status == 200, out
+        sid, pinned = out["session"], out["replica"]
+        obs = np.zeros(agent.obs_shape, np.float32)
+        status, out = _post(
+            f"{router.url}/session/{sid}/act", {"obs": obs.tolist()}
+        )
+        assert status == 200, out
+        # give the write-behind journal a beat, then kill the pin
+        time.sleep(0.8)
+        rs.replicas[pinned].handle.kill()
+        status, out = _post(
+            f"{router.url}/session/{sid}/act", {"obs": obs.tolist()}
+        )
+        assert status == 200 and out.get("resumed") is True, out
+        tracer.drain()
+        spans = [r for r in recs if r["kind"] == "span"]
+        assert spans, "rate-0 failover must still emit a trace"
+        names = {s["name"] for s in spans}
+        assert "router.takeover" in names, names
+        assert "router.fence" in names, names
+        takeover = [
+            s for s in spans if s["name"] == "router.takeover"
+        ][-1]
+        assert takeover["from_replica"] == pinned
+        assert takeover["resumed"] is True
+        assert takeover["journal_backed"] is True
+        assert takeover["landed"] is True
+        # the sampled-ONLY-on-anomaly policy: the healthy acts before
+        # the kill emitted nothing
+        healthy = [
+            s for s in spans
+            if s["name"] == "router.session_act"
+            and s.get("status") == 200
+        ]
+        assert len(healthy) == 1  # just the failover act's root
+    finally:
+        router.close()
+        rs.close()
+        tracer.close()
+        bus.close()
+
+
+@pytest.mark.slow  # e2e trace leg (ISSUE 15 budget rule): full
+# two-process-log round trip through the validator + assembler
+def test_cross_process_logs_validate_and_assemble(rec_stack, tmp_path):
+    from trpo_tpu.obs.events import JsonlSink, manifest_fields
+
+    rlog = str(tmp_path / "router.jsonl")
+    clog = str(tmp_path / "replica.jsonl")
+    rbus = EventBus(JsonlSink(rlog))
+    rbus.emit(
+        "run_manifest",
+        **manifest_fields(None, extra={"driver": "test"}),
+    )
+    cbus = EventBus(JsonlSink(clog))
+    cbus.emit(
+        "run_manifest",
+        **manifest_fields(None, extra={"driver": "test"}),
+    )
+    rtracer = Tracer(rbus, 1.0, process="router")
+    ctracer = Tracer(cbus, 1.0, process="replica", host="hostA")
+
+    from trpo_tpu.serve import (
+        InProcessReplica,
+        PolicyServer,
+        ReplicaSet,
+        Router,
+    )
+
+    agent, state = rec_stack
+    jdir = str(tmp_path / "cj")
+
+    def factory(rid):
+        def build():
+            engine = agent.serve_session_engine()
+            engine.load(state.policy_params, state.obs_norm, step=1)
+            server = PolicyServer(
+                engine, None, port=0, bus=cbus, tracer=ctracer,
+                replica_name=rid, carry_journal_dir=jdir,
+            )
+            return server, []
+
+        return build
+
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(factory(rid)), 2, bus=rbus,
+        health_interval=60.0, backoff=0.05, health_fail_threshold=1,
+        max_restarts=2,
+    )
+    assert rs.wait_healthy(2, timeout=60.0), rs.snapshot()
+    router = Router(rs, port=0, bus=rbus, journal_dir=jdir,
+                    tracer=rtracer)
+    tid = mint_trace_id()
+    try:
+        status, out = _post(
+            router.url + "/session", headers={TRACE_HEADER: tid}
+        )
+        assert status == 200, out
+        obs = np.zeros(agent.obs_shape, np.float32)
+        status, out = _post(
+            f"{router.url}/session/{out['session']}/act",
+            {"obs": obs.tolist()}, headers={TRACE_HEADER: tid},
+        )
+        assert status == 200, out
+    finally:
+        router.close()
+        rs.close()
+        rtracer.close()
+        ctracer.close()
+        rbus.close()
+        cbus.close()
+    # each per-process log is self-consistent under the validator
+    assert _validate(rlog) == []
+    assert _validate(clog) == []
+    # and the assembler joins them into one tree with a breakdown
+    from trpo_tpu.obs.analyze import (
+        assemble_traces,
+        load_events,
+        trace_breakdown,
+    )
+
+    records = load_events(rlog) + load_events(clog)
+    traces = assemble_traces(records)
+    assert tid in traces
+    b = trace_breakdown(traces[tid])
+    assert b is not None and b["root"].startswith("router.")
+    assert {"queue", "epoch", "network"} <= set(b["stages"])

@@ -676,6 +676,26 @@ class TRPOConfig:
     #                                stays the local scripts/serve.py
     #                                child
 
+    # --- request tracing (obs/trace — ISSUE 15) ---------------------------
+    trace_sample_rate: float = 0.0  # head-based trace sampling for the
+    #                                serving plane (serve.py
+    #                                --trace-sample-rate): each request
+    #                                through the router/solo server
+    #                                gets a 128-bit trace id (minted at
+    #                                the edge or accepted from the
+    #                                client's X-Trace-Id header) and is
+    #                                sampled by a pure hash of the id
+    #                                against this rate — every process
+    #                                reaches the same verdict with no
+    #                                coordination. Anomalies (retried /
+    #                                failed / resumed / chaos-fired
+    #                                requests) are ALWAYS traced once
+    #                                the layer is armed, regardless of
+    #                                the rate. 0.0 (default) = layer
+    #                                off: no tracer is constructed and
+    #                                emitted event bytes are identical
+    #                                to a run without the field.
+
     # --- io --------------------------------------------------------------
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 10
@@ -990,6 +1010,11 @@ class TRPOConfig:
                 raise ValueError(
                     f"serve_hosts has duplicate names: {self.serve_hosts!r}"
                 )
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError(
+                "trace_sample_rate must be in [0, 1], got "
+                f"{self.trace_sample_rate}"
+            )
         if self.inject_faults:
             # fail at construction: a chaos run with an unparseable spec
             # would otherwise "pass" by injecting nothing

@@ -50,6 +50,16 @@ Comparison semantics (:func:`compare_runs`):
   strict counters between clean runs — a lease expiring (or a
   split-brain writer being refused) where the base run had none is a
   liveness event, never noise;
+* request traces (ISSUE 15, ``span`` events from ``obs/trace.py``):
+  :func:`assemble_traces` joins spans across per-process event logs
+  (router + N replicas — merge the files' records first),
+  :func:`trace_breakdown` attributes each trace's end-to-end time to
+  stages (queue / epoch / engine / network / journal / retry /
+  takeover — network is structural: each router hop minus the remote
+  handler time nested under it), the summary carries the per-stage
+  p50/p99 + share table and the slowest-trace rows, and
+  ``compare_runs`` judges the root p99 and every per-stage p99
+  time-like — a grown stage is a LOCATED regression;
 * phases below ``min_ms`` in BOTH runs are skipped (a 0.1 ms phase
   doubling is scheduler noise, not a regression), as are metrics absent
   from either run (no silent verdict about unmeasured things — they are
@@ -76,7 +86,15 @@ import warnings
 from collections import Counter
 from typing import Optional
 
-__all__ = ["load_events", "summarize_run", "compare_runs", "format_table"]
+__all__ = [
+    "load_events",
+    "summarize_run",
+    "compare_runs",
+    "format_table",
+    "assemble_traces",
+    "trace_breakdown",
+    "render_waterfall",
+]
 
 
 def load_events(path: str) -> list:
@@ -467,6 +485,204 @@ def _autoscale_rows(autoscale: list) -> Optional[dict]:
     }
 
 
+# ---------------------------------------------------------------------------
+# request traces (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+# span name → critical-path stage bucket. Stages are ATTRIBUTION, not a
+# partition of the root: a hop span contains the replica's handler time,
+# so the "network" share is computed structurally (hop minus its remote
+# children), never by subtracting buckets from the root.
+_SPAN_STAGES = {
+    "batch.queue_wait": "queue",
+    "engine.step_batch": "epoch",
+    "engine.infer": "engine",
+    "journal.sync": "journal",
+    "router.retry": "retry",
+    "router.takeover": "takeover",
+    "router.fence": "takeover",
+}
+_HOP_NAMES = ("router.dispatch", "router.retry")
+TRACE_STAGES = (
+    "queue", "epoch", "engine", "network", "journal", "retry",
+    "takeover",
+)
+
+
+def assemble_traces(records: list) -> dict:
+    """Join span records — from ONE log or several concatenated
+    per-process logs (router + N replicas + hosts; the caller merges
+    with ``load_events`` per file) — into ``{trace_id: [spans sorted by
+    start]}``. Duplicate records (the same file merged twice) collapse
+    on ``(span, trace, name)``."""
+    traces: dict = {}
+    seen = set()
+    for r in records:
+        if r.get("kind") != "span":
+            continue
+        tid = r.get("trace")
+        if not isinstance(tid, str):
+            continue
+        key = (tid, r.get("span"), r.get("name"))
+        if key in seen:
+            continue
+        seen.add(key)
+        traces.setdefault(tid, []).append(r)
+    for spans in traces.values():
+        spans.sort(key=lambda s: _finite(s.get("start")) or 0.0)
+    return traces
+
+
+def _span_dur(s) -> float:
+    return _finite(s.get("dur_ms")) or 0.0
+
+
+def trace_breakdown(spans: list) -> Optional[dict]:
+    """One assembled trace → its critical-path attribution: the root
+    span (the edge's end-to-end time), per-stage durations, and the
+    structural network share (each router hop's duration minus the
+    remote handler time nested under it — what the wire and the
+    injected transport latency cost). None when the trace has no root
+    (a replica-only fragment)."""
+    roots = [
+        s for s in spans
+        if s.get("parent") is None and not s.get("remote")
+    ]
+    if not roots:
+        return None
+    root = max(roots, key=_span_dur)
+    stages = {stage: 0.0 for stage in TRACE_STAGES}
+    by_parent: dict = {}
+    for s in spans:
+        p = s.get("parent")
+        if p is not None:
+            by_parent.setdefault(p, []).append(s)
+    for s in spans:
+        stage = _SPAN_STAGES.get(s.get("name"))
+        if stage is not None:
+            stages[stage] += _span_dur(s)
+        if s.get("name") in _HOP_NAMES:
+            handler = sum(
+                _span_dur(c)
+                for c in by_parent.get(s.get("span"), [])
+                if c.get("remote")
+            )
+            stages["network"] += max(0.0, _span_dur(s) - handler)
+    return {
+        "trace": root.get("trace"),
+        "root": root.get("name"),
+        "root_ms": _span_dur(root),
+        "unterminated": root.get("dur_ms") is None,
+        "spans": len(spans),
+        "stages": {
+            k: v for k, v in stages.items() if v > 0.0
+        },
+    }
+
+
+def _summarize_traces(records: list) -> Optional[dict]:
+    """The per-run trace block: trace/span counts, root-duration
+    quantiles, per-stage p50/p99 + mean share of the root, and the
+    slowest traces (root duration, stage attribution). None for logs
+    with no spans."""
+    traces = assemble_traces(records)
+    if not traces:
+        return None
+    rows = [
+        b for b in (trace_breakdown(s) for s in traces.values())
+        if b is not None
+    ]
+    spans_total = sum(len(s) for s in traces.values())
+    if not rows:
+        return {"count": len(traces), "spans": spans_total,
+                "assembled": 0, "stages": {}, "slowest": []}
+    roots = [r["root_ms"] for r in rows]
+    root_mean = _mean(roots)
+    stage_stats: dict = {}
+    for stage in TRACE_STAGES:
+        vals = [
+            r["stages"][stage] for r in rows if stage in r["stages"]
+        ]
+        if not vals:
+            continue
+        stage_stats[stage] = {
+            "traces": len(vals),
+            "p50_ms": _quantile(vals, 0.5),
+            "p99_ms": _quantile(vals, 0.99),
+            "mean_ms": _mean(vals),
+            # the stage's share of mean end-to-end time across ALL
+            # assembled traces (absent = 0 for a trace) — the
+            # critical-path table's headline column
+            "share": (
+                sum(vals) / (root_mean * len(rows))
+                if root_mean else None
+            ),
+        }
+    slowest = sorted(rows, key=lambda r: -r["root_ms"])[:5]
+    return {
+        "count": len(traces),
+        "assembled": len(rows),
+        "spans": spans_total,
+        "root_p50_ms": _quantile(roots, 0.5),
+        "root_p99_ms": _quantile(roots, 0.99),
+        "stages": stage_stats,
+        "slowest": [
+            {
+                "trace": r["trace"],
+                "root": r["root"],
+                "root_ms": r["root_ms"],
+                "stages": {
+                    k: round(v, 3) for k, v in r["stages"].items()
+                },
+            }
+            for r in slowest
+        ],
+    }
+
+
+def render_waterfall(spans: list) -> str:
+    """One assembled trace as a text waterfall: start offsets, scaled
+    bars, durations, the stage taxonomy readable at a glance over ssh
+    (no deps — the format_table contract)."""
+    if not spans:
+        return "(no spans)"
+    spans = sorted(spans, key=lambda s: _finite(s.get("start")) or 0.0)
+    t0 = min(_finite(s.get("start")) or 0.0 for s in spans)
+    ends = [
+        (_finite(s.get("start")) or 0.0) - t0 + _span_dur(s) / 1e3
+        for s in spans
+    ]
+    window_s = max(max(ends), 1e-9)
+    width = 32
+    rows = []
+    for s in spans:
+        off_s = (_finite(s.get("start")) or 0.0) - t0
+        dur_s = _span_dur(s) / 1e3
+        left = int(off_s / window_s * width)
+        bar = max(1, int(dur_s / window_s * width)) if dur_s else 1
+        bar = min(bar, width - min(left, width - 1))
+        attrs = " ".join(
+            f"{k}={s[k]}"
+            for k in (
+                "process", "host", "replica", "width", "rung",
+                "status", "resumed", "cause", "gate_ms",
+            )
+            if s.get(k) is not None
+        )
+        rows.append([
+            f"{off_s * 1e3:8.2f}",
+            "." * min(left, width - 1) + "#" * bar,
+            s.get("name"),
+            "-" if s.get("dur_ms") is None
+            else f"{_span_dur(s):.2f}",
+            attrs,
+        ])
+    head = spans[0].get("trace")
+    return f"trace {head}\n" + format_table(
+        rows, ["offset_ms", "timeline", "span", "dur_ms", "attrs"]
+    )
+
+
 def _summarize_fleet(records: list) -> Optional[dict]:
     """Aggregate ``fleet`` lifecycle records (fleet/scheduler.py) into a
     per-member table: last state, launch attempts, requeues — plus the
@@ -679,6 +895,7 @@ def summarize_run(records: list) -> dict:
         },
         "serving": serving,
         "router": _summarize_router(records),
+        "traces": _summarize_traces(records),
         "solver_precision": solver_precision,
         "fleet": _summarize_fleet(records),
         "events_total": dict(
@@ -957,6 +1174,33 @@ def compare_runs(
                     "delta_pct": None,
                     "verdict": "regressed" if n_v > b_v else "ok",
                 })
+
+    # request-trace critical path (ISSUE 15) — per-stage durations are
+    # time-like (a stage's p99 growing past the threshold is a located
+    # regression, which is the whole point of attribution); rows only
+    # when at least one run traced, and only for stages either run
+    # actually spent time in (the union-not-intersection policy)
+    b_tr = base.get("traces") or {}
+    n_tr = new.get("traces") or {}
+    if b_tr or n_tr:
+        verdicts.append(
+            _verdict(
+                "trace/root_p99_ms",
+                b_tr.get("root_p99_ms"), n_tr.get("root_p99_ms"),
+                threshold_pct, "time",
+            )
+        )
+        b_st = b_tr.get("stages") or {}
+        n_st = n_tr.get("stages") or {}
+        for stage in sorted(set(b_st) | set(n_st)):
+            verdicts.append(
+                _verdict(
+                    f"trace/stage_{stage}_p99_ms",
+                    (b_st.get(stage) or {}).get("p99_ms"),
+                    (n_st.get(stage) or {}).get("p99_ms"),
+                    threshold_pct, "time",
+                )
+            )
 
     # solver-precision counters (ISSUE 8) — only when at least one run
     # carried the ladder. `fallbacks` is judged as a strict counter: ANY
@@ -1242,6 +1486,48 @@ def render_summary(summary: dict) -> str:
                     ],
                     ["step", "canary", "outcome", "reason"],
                 ))
+    tr = summary.get("traces") or {}
+    if tr:
+        out.append("")
+        out.append(
+            f"traces: {tr.get('count')} assembled={tr.get('assembled')}"
+            f" spans={tr.get('spans')}"
+            f" root_p50={_fmt(tr.get('root_p50_ms'))}ms"
+            f" root_p99={_fmt(tr.get('root_p99_ms'))}ms"
+        )
+        stages = tr.get("stages") or {}
+        if stages:
+            out.append(format_table(
+                [
+                    [
+                        stage,
+                        row.get("traces"),
+                        _fmt(row.get("p50_ms")),
+                        _fmt(row.get("p99_ms")),
+                        "-" if row.get("share") is None
+                        else f"{row['share'] * 100:.1f}%",
+                    ]
+                    for stage, row in stages.items()
+                ],
+                ["stage", "traces", "p50_ms", "p99_ms", "share"],
+            ))
+        slowest = tr.get("slowest") or []
+        if slowest:
+            out.append(format_table(
+                [
+                    [
+                        row.get("trace"),
+                        row.get("root"),
+                        _fmt(row.get("root_ms")),
+                        ", ".join(
+                            f"{k}={v:.1f}"
+                            for k, v in (row.get("stages") or {}).items()
+                        ),
+                    ]
+                    for row in slowest
+                ],
+                ["slowest trace", "root", "ms", "stage breakdown (ms)"],
+            ))
     sp = summary.get("solver_precision") or {}
     if sp:
         out.append("")

@@ -109,6 +109,23 @@ Kinds:
   same way the fleet's is: ``scripts/validate_events.py`` FAILS a
   ``started`` with no later terminal ``promoted``/``rolled_back`` for
   the same step — an unresolved canary means the gate loop is broken.
+* ``span`` — one finished request-trace span (ISSUE 15:
+  ``obs/trace.py`` — the serving plane's per-request attribution
+  layer): 128-bit ``trace`` id (minted at the router's public edge or
+  accepted from the client's ``X-Trace-Id`` header), 64-bit ``span``
+  id, optional ``parent`` (``remote: true`` when the parent was
+  emitted by ANOTHER process's log — the id arrived over the
+  propagation headers), ``name`` (the stage: ``router.act`` /
+  ``router.dispatch`` / ``router.retry`` / ``router.takeover`` /
+  ``replica.session_act`` / ``batch.queue_wait`` /
+  ``engine.step_batch`` / ``journal.sync`` …), ``start`` (unix
+  seconds) and ``dur_ms`` (``None`` ONLY for a span that was never
+  terminated). Coalesced session acts share ONE ``engine.step_batch``
+  span id across their traces (the shared epoch span — what makes
+  epoch-induced tail latency attributable). Self-auditing:
+  ``scripts/validate_events.py`` FAILS an orphan span (non-remote
+  parent never emitted in the same file), an unterminated root span,
+  and a retried request whose trace lacks a retry span.
 * ``autoscale`` — one elastic-serving control action (ISSUE 12:
   ``serve/autoscaler.py`` decisions, ``serve/router.py`` sheds):
   ``AUTOSCALE_EVENTS`` — ``scale_out`` (a new replica launched from
@@ -334,6 +351,26 @@ _REQUIRED = {
         "event": lambda v: v in CANARY_EVENTS,
         "replica": lambda v: isinstance(v, str) and v,
     },
+    "span": {
+        # one finished request-trace span (ISSUE 15, obs/trace.py);
+        # `parent`/`remote`/`process`/`host` and stage attrs ride
+        # along as optional fields. dur_ms is REQUIRED but nullable:
+        # None marks a span that was never terminated — representable
+        # so the validator can FAIL an unterminated root instead of
+        # the failure mode being an invisible missing record.
+        "trace": lambda v: isinstance(v, str) and 8 <= len(v) <= 64,
+        "span": lambda v: isinstance(v, str) and v,
+        "name": lambda v: isinstance(v, str) and v,
+        "start": lambda v: isinstance(v, (int, float))
+        and not isinstance(v, bool)
+        and v >= 0,
+        "dur_ms": lambda v: v is None
+        or (
+            isinstance(v, (int, float))
+            and not isinstance(v, bool)
+            and v >= 0
+        ),
+    },
     "autoscale": {
         # one elastic-serving control action (serve/autoscaler.py /
         # the router's overload sheds); every record says WHY — the
@@ -502,6 +539,17 @@ class JsonlSink:
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
 
+    def write_batch(self, recs: list) -> None:
+        """Many records, ONE file write + flush (ISSUE 15): the trace
+        writer drains dozens of spans per wake, and per-record
+        write+flush under the bus lock measurably stalls the serving
+        dispatcher threads contending for it. Same crash semantics —
+        a torn tail still repairs on the next open."""
+        if self._f is None:
+            raise RuntimeError(f"JsonlSink({self.path}) is closed")
+        self._f.write("".join(json.dumps(r) + "\n" for r in recs))
+        self._f.flush()
+
     def close(self) -> None:
         if self._f is not None:
             self._f.close()
@@ -573,6 +621,34 @@ class EventBus:
             for s in self._sinks:
                 s.write(rec)
         return rec
+
+    def emit_batch(self, kind: str, fields_list) -> list:
+        """Emit many same-kind records, holding the sink lock ONCE and
+        letting batch-capable sinks (``JsonlSink.write_batch``) write
+        them in one IO call (ISSUE 15: the trace writer's drain — the
+        per-record flush was the measurable hot-path cost). Records are
+        sanitized and validated exactly as :meth:`emit` would."""
+        recs = []
+        for fields in fields_list:
+            rec = _json_safe(
+                {"v": SCHEMA_VERSION, "kind": kind, "t": time.time(),
+                 **fields}
+            )
+            errs = validate_event(rec)
+            if errs:
+                raise ValueError(f"invalid {kind!r} event: {errs}")
+            recs.append(rec)
+        if not recs:
+            return recs
+        with self._lock:
+            for s in self._sinks:
+                batch = getattr(s, "write_batch", None)
+                if batch is not None:
+                    batch(recs)
+                else:
+                    for rec in recs:
+                        s.write(rec)
+        return recs
 
     def close(self) -> None:
         with self._lock:

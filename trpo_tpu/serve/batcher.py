@@ -74,23 +74,28 @@ __all__ = ["MicroBatcher", "SessionBatcher"]
 
 
 class _Pending:
-    __slots__ = ("obs", "t", "future")
+    __slots__ = ("obs", "t", "future", "trace")
 
-    def __init__(self, obs, t: float):
+    def __init__(self, obs, t: float, trace=None):
         self.obs = obs
         self.t = t
         self.future: Future = Future()
+        # (TraceContext, parent span id, wall-clock submit time) for a
+        # traced request (ISSUE 15), or None — the batcher books the
+        # queue-wait and shared dispatch spans into the context
+        self.trace = trace
 
 
 class _SessionPending:
-    __slots__ = ("sid", "carry", "obs", "t", "future")
+    __slots__ = ("sid", "carry", "obs", "t", "future", "trace")
 
-    def __init__(self, sid: str, carry, obs, t: float):
+    def __init__(self, sid: str, carry, obs, t: float, trace=None):
         self.sid = sid
         self.carry = carry
         self.obs = obs
         self.t = t
         self.future: Future = Future()
+        self.trace = trace  # see _Pending.trace
 
 
 class _DeadlineBatcher:
@@ -286,7 +291,47 @@ class _DeadlineBatcher:
         with self._cond:
             self.errors_total += len(batch)
         for p in batch:
+            if p.trace is not None:
+                # an engine failure is an anomaly: the trace must
+                # survive sampling so the 500 has attribution
+                p.trace[0].force()
             p.future.set_exception(exc)
+
+    def _trace_epoch(
+        self, batch, span_name: str, rung: int,
+        t_gather: float, wall_infer: float, done: float,
+    ) -> None:
+        """Book the epoch's spans into every traced participant's
+        context (ISSUE 15): a per-request ``batch.queue_wait`` span
+        (submit → gather) and the per-trace copy of the dispatch span
+        — every copy wearing the SAME span id (``mint_span_id`` once
+        per epoch), which is what lets the assembler show N coalesced
+        sessions pointing at ONE device dispatch."""
+        traced = [p for p in batch if p.trace is not None]
+        if not traced:
+            return
+        from trpo_tpu.obs.trace import mint_span_id
+
+        epoch_id = mint_span_id()
+        cost_ms = (done - t_gather) * 1e3
+        width = len(batch)
+        for p in traced:
+            ctx, parent_id, t_wall = p.trace
+            qid = ctx.record(
+                "batch.queue_wait",
+                start=t_wall,
+                dur_ms=max(0.0, (t_gather - p.t) * 1e3),
+                parent_id=parent_id,
+            )
+            ctx.record(
+                span_name,
+                start=wall_infer,
+                dur_ms=cost_ms,
+                parent_id=qid,
+                span_id=epoch_id,
+                width=width,
+                rung=rung,
+            )
 
     def _emit_dispatch(self, batch, rung: int, depth_after: int, lats):
         with self._cond:
@@ -315,26 +360,31 @@ class MicroBatcher(_DeadlineBatcher):
     """Deadline-bounded request coalescing in front of an
     :class:`~trpo_tpu.serve.engine.InferenceEngine` (stateless /act)."""
 
-    def submit(self, obs) -> Future:
+    def submit(self, obs, trace=None) -> Future:
         """Enqueue ONE observation; the returned future resolves to
         ``(action, step)`` — the action and the checkpoint step of the
         snapshot that actually computed it (captured inside the engine
         call, so a hot swap racing the response can never mislabel an
         old snapshot's action with the new step). Blocks while the queue
         is at its bound (backpressure); raises ``RuntimeError`` after
-        :meth:`close`."""
+        :meth:`close`. ``trace`` is the caller's ``(TraceContext,
+        parent span id)`` — the batcher books this request's queue-wait
+        and the shared dispatch span into it (ISSUE 15)."""
         obs = np.asarray(obs, self.engine.obs_dtype)
         if obs.shape != self.engine.obs_shape:
             raise ValueError(
                 f"obs must have shape {self.engine.obs_shape}, "
                 f"got {obs.shape}"
             )
-        return self._enqueue(_Pending(obs, time.perf_counter()))
+        if trace is not None:
+            trace = (trace[0], trace[1], time.time())
+        return self._enqueue(_Pending(obs, time.perf_counter(), trace))
 
     def _dispatch(self, batch, depth_after: int) -> None:
         obs = np.stack([p.obs for p in batch], axis=0)
         rung = self.engine.padded_shape(len(batch))
         t_infer = time.perf_counter()
+        wall_infer = time.time()
         try:
             actions, step = self.engine.infer(obs, return_step=True)
         except Exception as e:
@@ -343,6 +393,9 @@ class MicroBatcher(_DeadlineBatcher):
         done = time.perf_counter()
         lats = [(done - p.t) * 1e3 for p in batch]
         self._observe_dispatch((done - t_infer) * 1e3, lats)
+        self._trace_epoch(
+            batch, "engine.infer", rung, t_infer, wall_infer, done
+        )
         for p, action in zip(batch, actions):
             p.future.set_result((np.asarray(action), step))
         self._emit_dispatch(batch, rung, depth_after, lats)
@@ -384,7 +437,8 @@ class SessionBatcher(_DeadlineBatcher):
             return self.epoch_width_sum / self.batches_total
 
     def submit(
-        self, sid: str, carry, obs, timeout: Optional[float] = None
+        self, sid: str, carry, obs, timeout: Optional[float] = None,
+        trace=None,
     ) -> Future:
         """Enqueue ONE session step; the future resolves to ``(action,
         new_carry, step)``. The caller owns the carry read-modify-write
@@ -395,7 +449,9 @@ class SessionBatcher(_DeadlineBatcher):
         its act-timeout 504 instead of parking one handler thread per
         retry forever (raises ``concurrent.futures.TimeoutError``; the
         step never entered an epoch, so the carry is unadvanced and a
-        retry is safe)."""
+        retry is safe). ``trace`` is the caller's ``(TraceContext,
+        parent span id)`` — the epoch books this act's queue-wait and
+        the SHARED ``engine.step_batch`` span into it (ISSUE 15)."""
         if not isinstance(sid, str) or not sid:
             raise ValueError(f"sid must be a non-empty string, got {sid!r}")
         carry = np.asarray(carry, np.float32)
@@ -410,8 +466,10 @@ class SessionBatcher(_DeadlineBatcher):
                 f"obs must have shape {self.engine.obs_shape}, "
                 f"got {obs.shape}"
             )
+        if trace is not None:
+            trace = (trace[0], trace[1], time.time())
         return self._enqueue(
-            _SessionPending(sid, carry, obs, time.perf_counter()),
+            _SessionPending(sid, carry, obs, time.perf_counter(), trace),
             timeout=timeout,
         )
 
@@ -439,6 +497,7 @@ class SessionBatcher(_DeadlineBatcher):
         obs = np.stack([p.obs for p in batch], axis=0)
         rung = self.engine.padded_shape(len(batch))
         t_infer = time.perf_counter()
+        wall_infer = time.time()
         try:
             actions, new_carries, step = self.engine.step_batch(
                 carries, obs, return_step=True
@@ -449,6 +508,9 @@ class SessionBatcher(_DeadlineBatcher):
         done = time.perf_counter()
         lats = [(done - p.t) * 1e3 for p in batch]
         self._observe_dispatch((done - t_infer) * 1e3, lats)
+        self._trace_epoch(
+            batch, "engine.step_batch", rung, t_infer, wall_infer, done
+        )
         for i, p in enumerate(batch):
             p.future.set_result(
                 (

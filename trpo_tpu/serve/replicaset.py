@@ -294,6 +294,10 @@ class ReplicaRecord:
         #                            sessions away)
         # multi-host liveness (ISSUE 14)
         self.host = "local"        # transport placement
+        # the reason the LAST death/eviction was booked with (ISSUE 15:
+        # the router's takeover span reads it, tying a resumed act to
+        # the lease expiry / transport failure that caused the move)
+        self.last_death_reason: Optional[str] = None
         self.lease_epoch = 0       # grants this incarnation + earlier ones
         self.lease_expires: Optional[float] = None  # monotonic; None =
         #                            no live lease (never granted, or
@@ -503,6 +507,14 @@ class ReplicaSet:
     def host_of(self, replica_id: str) -> str:
         rec = self.replicas.get(replica_id)
         return rec.host if rec is not None else "local"
+
+    def death_reason(self, replica_id: str) -> Optional[str]:
+        """The reason the replica's last death/eviction was booked with
+        (ISSUE 15): the router's takeover span carries it, so an
+        assembled trace says WHY a session moved — "lease expired …"
+        during a partition, a transport failure, a crash."""
+        rec = self.replicas.get(replica_id)
+        return rec.last_death_reason if rec is not None else None
 
     def _emit_host(self, host: str, state: str) -> None:
         if self.bus is None:
@@ -747,6 +759,7 @@ class ReplicaSet:
             if rec.state in ("evicted", "failed"):
                 return  # already resolved (e.g. router reported first)
             rec.state = "evicted"
+            rec.last_death_reason = reason
         self._emit(rec.id, "died", reason=reason)
         try:
             rec.handle.kill()  # reap a half-dead process/socket

@@ -80,6 +80,23 @@ Every client request emits a ``router`` ``scope="request"`` event
 (end-to-end ms, ok, retried, replica) on the bus; ``obs/analyze.py``
 folds them into the per-replica table, p50/p99, routed actions/s and
 the scaling row that ``analyze_run.py --compare`` judges.
+
+**Request tracing** (ISSUE 15, ``obs/trace.py``) — with a
+:class:`~trpo_tpu.obs.trace.Tracer` attached, the router is the
+trace's public edge: it mints the 128-bit ``trace_id`` (or accepts a
+client's ``X-Trace-Id``), head-samples it, opens the root span
+(``router.act`` / ``router.session_act``), and stamps every replica
+hop with a ``router.dispatch`` span whose id propagates as the hop's
+``X-Trace-Parent`` — so the replica's own spans join the same trace
+across process (and host) boundaries. Anomalies are ALWAYS traced
+regardless of the sampling rate: a transparent retry
+(``router.retry`` span), a failed request, a journal-backed failover
+(``router.takeover`` + ``router.fence`` spans, carrying the dead
+pin's booked death reason — a partition's lease expiry is named in
+the trace that resumed around it), and every request while a chaos
+injector is armed. Sampled request events carry their ``trace`` id,
+which is the join key ``validate_events.py``'s trace contracts and
+``analyze_run.py --trace`` use.
 """
 
 from __future__ import annotations
@@ -96,6 +113,7 @@ from typing import Dict, Optional, Tuple
 # ONE escaping/formatting implementation for all endpoints (the PR 7
 # review contract): obs/server.py owns it
 from trpo_tpu.obs.server import _esc, _fmt
+from trpo_tpu.obs.trace import TRACE_HEADER, Tracer
 
 __all__ = ["Router"]
 
@@ -170,6 +188,7 @@ class Router:
         min_latency_samples: int = 16,
         retry_budget: float = 8.0,
         retry_refill_per_sec: float = 4.0,
+        tracer: Optional[Tracer] = None,
     ):
         if max_inflight < 1:
             raise ValueError(
@@ -204,6 +223,11 @@ class Router:
         self.journal_dir = journal_dir
         self.canary_fraction = float(canary_fraction)
         self.injector = injector  # serving-plane chaos (may be set late)
+        # request tracing (ISSUE 15): the router is the trace's public
+        # edge — it mints/accepts the id, head-samples, and propagates
+        # the id + verdict on every replica hop. None = layer off,
+        # zero per-request cost (owned by the caller, like the bus).
+        self.tracer = tracer
 
         self.min_latency_samples = int(min_latency_samples)
 
@@ -418,11 +442,15 @@ class Router:
         return key, conn
 
     def _forward(
-        self, replica_id: str, path: str, body: bytes
+        self, replica_id: str, path: str, body: bytes,
+        trace_headers: Optional[dict] = None, span=None,
     ) -> Tuple[int, bytes]:
         """POST ``body`` to the replica; returns ``(status, body)`` for
         HTTP-level answers (including error statuses) and raises OSError
-        subclasses for transport-level failures."""
+        subclasses for transport-level failures. ``trace_headers``
+        (ISSUE 15) ride the hop so the replica joins the trace;
+        ``span`` is the hop's dispatch span — injected transport
+        latency is attributed to it (``gate_ms``)."""
         rec = self.replicaset.get(replica_id)
         url = rec.url if rec is not None else None
         if url is None:
@@ -432,13 +460,18 @@ class Router:
             # partitioned host raises here — indistinguishable from a
             # dropped connection, which is the point — and a slow host
             # pays its injected per-exchange latency
-            self.transport.gate(getattr(rec, "host", "local"))
+            gate_ms = self.transport.gate(getattr(rec, "host", "local"))
+            if span is not None and gate_ms:
+                span.attrs["gate_ms"] = gate_ms
         netloc = urllib.parse.urlsplit(url).netloc
         key, conn = self._conn(replica_id, netloc)
+        headers = {"Content-Type": _JSON}
+        if trace_headers:
+            headers.update(trace_headers)
         try:
             conn.request(
                 "POST", path, body=body,
-                headers={"Content-Type": _JSON},
+                headers=headers,
             )
             resp = conn.getresponse()
             payload = resp.read()
@@ -456,24 +489,92 @@ class Router:
     def _emit_request(
         self, ms: float, ok: bool, retried: bool,
         replica: Optional[str], endpoint: str,
+        ctx=None,
     ) -> None:
         if self.bus is None:
             return
+        fields = {}
+        if ctx is not None and ctx.emitting:
+            # the request event names its trace exactly when the trace
+            # will be emitted — the validator's retried-needs-retry-span
+            # contract and the analyze request→trace join key off this
+            fields["trace"] = ctx.trace_id
         try:
             self.bus.emit(
                 "router", scope="request", ms=ms, ok=ok,
                 retried=retried, replica=replica, endpoint=endpoint,
+                **fields,
             )
         except Exception:
             pass
 
+    # -- request tracing (ISSUE 15) ----------------------------------------
+
+    def _trace_edge(self, name: str):
+        """Open one request's trace at the router's public edge:
+        accept the client's ``X-Trace-Id`` (validated) or mint one,
+        head-sample, and start the root span. With a chaos injector
+        armed the trace is FORCED — every chaos-fired request has a
+        trace. ``(None, None)`` when the layer is off."""
+        if self.tracer is None:
+            return None, None
+        from trpo_tpu.utils.httpd import request_headers
+
+        headers = request_headers()
+        tid = headers.get(TRACE_HEADER) if headers is not None else None
+        ctx = self.tracer.begin(trace_id=tid)
+        if self.injector is not None:
+            ctx.force()
+        return ctx, ctx.span(name)
+
+    def _trace_done(self, ctx, root, status=None) -> None:
+        """Close the root span and hand the buffered spans to the
+        write-behind emitter (sampled/forced traces only). A 5xx
+        answer — including one a replica produced and the router
+        passed through — is an anomaly and forces the trace, EXCEPT
+        the typed 503s: backpressure/shed is a deliberate admission
+        decision, and force-tracing every shed would flood the
+        (anomaly-exempt) pending buffer exactly when the system is
+        overloaded."""
+        if ctx is None:
+            return
+        if status is not None and status >= 500 and status != 503:
+            ctx.force()
+        if root is not None:
+            root.end(**({} if status is None else {"status": status}))
+        self.tracer.finish(ctx)
+
+    def _traced(self, name: str, fn, *args):
+        """THE handler trace wrapper: open the edge context, run the
+        handler (which receives ``ctx, root`` appended to its args),
+        close the root with the answered status — one implementation,
+        so the anomaly-forcing policy cannot drift between
+        endpoints."""
+        ctx, root = self._trace_edge(name)
+        out = None
+        try:
+            out = fn(*args, ctx, root)
+            return out
+        finally:
+            self._trace_done(
+                ctx, root, status=out[0] if out is not None else 500
+            )
+
     def _dispatch(self, path: str, body: bytes, endpoint: str,
-                  pinned: Optional[str] = None, stateless: bool = True):
+                  pinned: Optional[str] = None, stateless: bool = True,
+                  ctx=None, parent=None):
         """The routed request core: pick (or follow the pin), forward,
         retry ONCE on transport failure, account, emit. Returns the
         upstream ``(status, ctype, body)`` plus the replica that finally
         answered (None = never reached one) and whether the retry was
-        taken — session handling needs both."""
+        taken — session handling needs both.
+
+        Tracing (ISSUE 15): each attempt gets a hop span under
+        ``parent`` — ``router.dispatch`` for the first, ``router.retry``
+        for the second (and the context is FORCED: a retried request is
+        an anomaly, traced regardless of the head sample). The hop
+        carries the trace headers so the replica's spans join the same
+        trace."""
         t0 = time.perf_counter()
         retried = False
         tried = []
@@ -523,11 +624,34 @@ class Router:
                         self.retried_total += 1
                     retried = True
             tried.append(rid)
+            hop = None
+            if ctx is not None:
+                if retried:
+                    ctx.force()  # a retried request always has a trace
+                hop = ctx.span(
+                    "router.retry" if retried else "router.dispatch",
+                    parent_id=(
+                        parent.span_id if parent is not None else None
+                    ),
+                    replica=rid,
+                    host=self._host_of(rid),
+                    endpoint=endpoint,
+                )
             try:
-                status, payload = self._forward(rid, path, body)
+                status, payload = self._forward(
+                    rid, path, body,
+                    trace_headers=(
+                        Tracer.headers_for(ctx, hop)
+                        if ctx is not None else None
+                    ),
+                    span=hop,
+                )
             except Exception:
                 # transport failure: the replica died under us — tell
                 # the supervisor (immediate eviction) and retry once
+                if hop is not None:
+                    ctx.force()  # reached-and-lost: anomaly
+                    hop.end(error="transport")
                 self._release(rid)
                 self.replicaset.report_failure(rid)
                 lost_rid = rid
@@ -535,6 +659,8 @@ class Router:
                     continue
                 break  # post-loop: a held 5xx answer still passes
                 #        through; otherwise this reads as a failure
+            if hop is not None:
+                hop.end(status=status)
             if (
                 status >= 500
                 and attempt == 0
@@ -548,6 +674,8 @@ class Router:
                 # seq-deduped — so try ONCE elsewhere. The answer is
                 # kept: if no second replica exists, it passes through
                 # verbatim (4xx client errors never retry)
+                if ctx is not None:
+                    ctx.force()  # a 5xx-and-retry is an anomaly
                 self._release(rid)
                 first_5xx = ((status, _JSON, payload), rid)
                 continue
@@ -563,7 +691,7 @@ class Router:
                 if win is None:
                     win = self._replica_lats[rid] = deque(maxlen=512)
                 win.append(ms)
-            self._emit_request(ms, True, retried, rid, endpoint)
+            self._emit_request(ms, True, retried, rid, endpoint, ctx=ctx)
             return (status, _JSON, payload), rid, retried
         if first_5xx is not None:
             # the 5xx retry found no (or no better) second replica:
@@ -577,7 +705,7 @@ class Router:
                 self._latencies_ms.append(ms)
                 self._fresh_lats.append(ms)
                 self._adm_lats.append((time.monotonic(), ms))
-            self._emit_request(ms, True, retried, rid, endpoint)
+            self._emit_request(ms, True, retried, rid, endpoint, ctx=ctx)
             return (status, ctype, payload), rid, retried
         # no replica left to try: a reached-and-lost replica makes this
         # a FAILURE (lost_rid propagates so _unrouted counts it as one);
@@ -646,7 +774,7 @@ class Router:
         except Exception:
             pass
 
-    def _admission_check(self, body: bytes):
+    def _admission_check(self, body: bytes, ctx=None):
         """Deadline-aware admission: a request declaring a
         ``deadline_ms`` that the observed windowed p99 already exceeds
         gets an immediate typed 503 instead of occupying a replica slot
@@ -689,7 +817,7 @@ class Router:
         with self._lock:
             self.shed_deadline_total += 1
         self._note_shed("deadline_unmeetable")
-        self._emit_request(0.0, False, False, None, "act")
+        self._emit_request(0.0, False, False, None, "act", ctx=ctx)
         return 503, _JSON, _body(
             {
                 "error": (
@@ -726,8 +854,11 @@ class Router:
             pass
 
     def _act(self, body: bytes):
+        return self._traced("router.act", self._act_inner, body)
+
+    def _act_inner(self, body: bytes, ctx, root):
         self._chaos_tick("/act", body)
-        shed = self._admission_check(body)
+        shed = self._admission_check(body, ctx=ctx)
         if shed is not None:
             return shed
         # keep a small ring of real request bodies: the canary gate's
@@ -735,10 +866,12 @@ class Router:
         # an incumbent instead of guessing an obs distribution
         self._recent_obs.append(body)
         result, rid, retried = self._dispatch(body=body, path="/act",
-                                              endpoint="act")
+                                              endpoint="act",
+                                              ctx=ctx, parent=root)
         if result is not None:
             return result
-        return self._unrouted(rid, retried, "act", stateless=True)
+        return self._unrouted(rid, retried, "act", stateless=True,
+                              ctx=ctx)
 
     # -- the canary controller's probes ------------------------------------
 
@@ -758,15 +891,18 @@ class Router:
             self._replica_lats.clear()
 
     def _unrouted(self, rid, retried: bool, endpoint: str,
-                  stateless: bool = False):
+                  stateless: bool = False, ctx=None):
         """No replica answered: 502 when we reached-and-lost replicas
         (both attempts died), 503 backpressure otherwise — typed
         ``shed_stateless`` when the refusal came from the shed-order
         headroom (a session request would still have been admitted)."""
         if rid is not None:
+            if ctx is not None:
+                ctx.force()  # a failed request always has a trace
             with self._lock:
                 self.failed_total += 1
-            self._emit_request(0.0, False, retried, rid, endpoint)
+            self._emit_request(0.0, False, retried, rid, endpoint,
+                               ctx=ctx)
             return 502, _JSON, _body(
                 {"error": "replica died mid-request and the retry "
                           "failed or had no replica to go to"}
@@ -793,7 +929,7 @@ class Router:
         self._note_shed(
             "stateless_headroom" if headroom_shed else "backpressure"
         )
-        self._emit_request(0.0, False, retried, rid, endpoint)
+        self._emit_request(0.0, False, retried, rid, endpoint, ctx=ctx)
         if headroom_shed:
             return 503, _JSON, _body(
                 {
@@ -822,6 +958,11 @@ class Router:
     # -- sessions ----------------------------------------------------------
 
     def _session_create(self, body: bytes):
+        return self._traced(
+            "router.session_create", self._session_create_inner, body
+        )
+
+    def _session_create_inner(self, body: bytes, ctx, root):
         sid = None
         if body:
             try:
@@ -858,9 +999,10 @@ class Router:
         result, rid, _retried = self._dispatch(
             body=_body({"session_id": sid}), path="/session",
             endpoint="session", stateless=False,
+            ctx=ctx, parent=root,
         )
         if result is None:
-            return self._unrouted(rid, False, "session")
+            return self._unrouted(rid, False, "session", ctx=ctx)
         status, ctype, payload = result
         if status != 200:
             return status, ctype, payload  # 409 wrong_protocol, 503, …
@@ -953,7 +1095,7 @@ class Router:
                 pass
 
     def _reestablish(self, sid: str, aff, entry, strict: bool = False,
-                     drain: bool = False):
+                     drain: bool = False, ctx=None, parent=None):
         """Re-create the session on a healthy replica — from the
         journaled ``entry`` when one exists (RESUME: carry + steps +
         dedupe state travel), from a fresh carry otherwise. Returns
@@ -978,7 +1120,7 @@ class Router:
             )
         result, rid, _ = self._dispatch(
             body=_body(create), path="/session", endpoint="session",
-            stateless=False,
+            stateless=False, ctx=ctx, parent=parent,
         )
         if result is None or result[0] != 200:
             if (
@@ -988,7 +1130,9 @@ class Router:
                 # a journaled entry the new replica refuses (e.g. carry
                 # width from an incompatible incarnation) must degrade
                 # to the fresh-carry path, not fail the client
-                return self._reestablish(sid, aff, None)
+                return self._reestablish(
+                    sid, aff, None, ctx=ctx, parent=parent
+                )
             return (result, rid, resumed) if result is not None else (
                 None, rid, resumed
             )
@@ -1120,6 +1264,11 @@ class Router:
             return True
 
     def _session_act(self, path: str, body: bytes):
+        return self._traced(
+            "router.session_act", self._session_act_routed, path, body
+        )
+
+    def _session_act_routed(self, path: str, body: bytes, ctx, root):
         self._chaos_tick(path, body)
         parts = path.strip("/").split("/")
         if len(parts) != 3 or parts[0] != "session" or parts[2] != "act":
@@ -1154,9 +1303,12 @@ class Router:
                 with self._lock:
                     if self._affinity.get(sid) is not aff:
                         continue  # replaced/removed while we waited
-                return self._session_act_pinned(sid, aff, body)
+                return self._session_act_pinned(
+                    sid, aff, body, ctx=ctx, root=root
+                )
 
-    def _session_act_pinned(self, sid: str, aff, body: bytes):
+    def _session_act_pinned(self, sid: str, aff, body: bytes,
+                            ctx=None, root=None):
         # stamp the per-session sequence number: the replica dedupes a
         # replay of an already-applied seq (the retry-idempotency
         # contract) — an unparseable body forwards untouched and takes
@@ -1177,6 +1329,7 @@ class Router:
         result, rid, retried = self._dispatch(
             body=body, path=f"/session/{sid}/act",
             endpoint="session_act", pinned=pinned,
+            ctx=ctx, parent=root,
         )
         lost_pin = result is None
         if not lost_pin and result[0] == 404:
@@ -1204,7 +1357,33 @@ class Router:
                 entry = self._journal_lookup(
                     pinned, sid, pinned_host=pinned_host
                 )
-            ok, rid, resumed = self._reestablish(sid, aff, entry)
+            takeover = None
+            if ctx is not None:
+                # the failover takeover is THE anomaly tracing exists
+                # for: force the trace and tie the resumed act to what
+                # killed the pin (the replica's booked death reason —
+                # "lease expired …" during a partition)
+                ctx.force()
+                takeover = ctx.span(
+                    "router.takeover",
+                    parent=root,
+                    from_replica=pinned,
+                    from_host=pinned_host,
+                    journal_backed=entry is not None,
+                    cause=getattr(
+                        self.replicaset, "death_reason",
+                        lambda _r: None,
+                    )(pinned),
+                )
+            ok, rid, resumed = self._reestablish(
+                sid, aff, entry, ctx=ctx, parent=takeover
+            )
+            if takeover is not None:
+                takeover.end(
+                    to_replica=rid if ok is True else None,
+                    resumed=bool(resumed) if ok is True else False,
+                    landed=ok is True,
+                )
             if ok is not True:
                 # the takeover did NOT land: the session stays pinned
                 # where it was, so its journal must NOT be fenced — a
@@ -1213,7 +1392,8 @@ class Router:
                 # for this session (no create ever runs to reclaim)
                 if ok is not None:
                     return ok  # the create's upstream error, verbatim
-                return self._unrouted(rid, retried, "session_act")
+                return self._unrouted(rid, retried, "session_act",
+                                      ctx=ctx)
             # the takeover LANDED elsewhere: fence the old incarnation
             # so a partitioned-but-alive zombie still holding this
             # session can never journal it again (ISSUE 14) — keyed by
@@ -1223,14 +1403,24 @@ class Router:
             # order: the create's restore snapshot is journaled on the
             # SURVIVOR, and the old journal leaves the lookup path
             # with the re-pin.
+            fence = (
+                ctx.span(
+                    "router.fence", parent=root,
+                    replica=pinned, host=pinned_host, session=sid,
+                )
+                if ctx is not None else None
+            )
             self._fence_takeover(pinned, sid, pinned_host=pinned_host)
+            if fence is not None:
+                fence.end()
             reestablished = not resumed
             result, rid, _ = self._dispatch(
                 body=body, path=f"/session/{sid}/act",
                 endpoint="session_act", pinned=rid,
+                ctx=ctx, parent=root,
             )
             if result is None:
-                return self._unrouted(rid, True, "session_act")
+                return self._unrouted(rid, True, "session_act", ctx=ctx)
         status, ctype, payload = result
         aff.last_used = time.monotonic()
         if status == 200:
@@ -1470,6 +1660,25 @@ class Router:
             "not a measurement — consumers gate on this)",
             [({}, lat_samples)],
         )
+        if self.tracer is not None:
+            # request tracing (ISSUE 15): writer-backpressure drops
+            # are COUNTED, never silent — a scrape seeing
+            # dropped_total grow knows the trace stream is lossy
+            fam(
+                "trpo_trace_spans_total", "counter",
+                "trace spans accepted for emission",
+                [({}, self.tracer.spans_total)],
+            )
+            fam(
+                "trpo_trace_sampled_total", "counter",
+                "request traces emitted (head-sampled or forced)",
+                [({}, self.tracer.sampled_total)],
+            )
+            fam(
+                "trpo_trace_dropped_total", "counter",
+                "trace spans dropped by writer backpressure",
+                [({}, self.tracer.dropped_total)],
+            )
         body = ("\n".join(lines) + "\n").encode()
         return 200, "text/plain; version=0.0.4; charset=utf-8", body
 

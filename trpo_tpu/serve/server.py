@@ -136,6 +136,7 @@ class PolicyServer:
         injector=None,
         session_deadline_ms: float = 3.0,
         session_adaptive_deadline: bool = True,
+        tracer=None,
     ):
         if (checkpointer is None) != (template is None):
             raise ValueError(
@@ -172,6 +173,10 @@ class PolicyServer:
         self.session_act_errors_total = 0
         self.replica_name = replica_name
         self.injector = injector
+        # request tracing (ISSUE 15): joins the trace the router's hop
+        # headers carry (or acts as the edge for direct clients); owned
+        # by the caller, like the bus. None = layer off.
+        self.tracer = tracer
         self.managed_reload = bool(managed_reload)
         # managed mode: the ONLY step this replica may serve; None =
         # "adopt whatever first checkpoint appears" (cold directory)
@@ -510,9 +515,62 @@ class PolicyServer:
         if delay > 0:
             time.sleep(delay)
 
+    # -- request tracing (ISSUE 15) ----------------------------------------
+
+    def _trace_join(self, name: str):
+        """Open this replica's handler span inside the request's trace:
+        join the propagated trace when the hop carried one (the parent
+        span id is REMOTE — it lives in the router's log), act as the
+        public edge for a direct client. ``(None, None)`` when the
+        layer is off or the edge declined to sample."""
+        if self.tracer is None:
+            return None, None
+        from trpo_tpu.utils.httpd import request_headers
+
+        headers = request_headers()
+        ctx = self.tracer.join(headers)
+        if ctx is None:
+            return None, None
+        parent = self.tracer.parent_from(headers)
+        span = ctx.span(
+            name, parent_id=parent, remote=parent is not None
+        )
+        return ctx, span
+
+    def _trace_done(self, ctx, span, status=None) -> None:
+        if ctx is None:
+            return
+        if span is not None:
+            span.end(**({} if status is None else {"status": status}))
+        self.tracer.finish(ctx)
+
+    def _traced(self, name: str, fn, *args):
+        """THE handler trace wrapper (the router has its twin): open
+        the handler span, run the handler (``ctx, span`` appended to
+        its args), force the context on replica-side failures — a
+        handler crash (``out is None``) or a 5xx other than the typed
+        503 warm-up/backpressure answers — and close with the status.
+        One implementation so the anomaly-forcing policy cannot drift
+        between endpoints."""
+        ctx, span = self._trace_join(name)
+        out = None
+        try:
+            out = fn(*args, ctx, span)
+            return out
+        finally:
+            status = out[0] if out is not None else 500
+            if ctx is not None and (
+                out is None or (status >= 500 and status != 503)
+            ):
+                ctx.force()
+            self._trace_done(ctx, span, status=status)
+
     # -- handlers ----------------------------------------------------------
 
     def _act(self, body: bytes):
+        return self._traced("replica.act", self._act_inner, body)
+
+    def _act_inner(self, body: bytes, ctx, span):
         self._maybe_stall()
         if self.is_recurrent:
             # structured refusal (ISSUE 9 satellite): the model family is
@@ -554,7 +612,12 @@ class PolicyServer:
             # submit INSIDE the try: a batcher racing its own teardown
             # (this replica being killed) must answer a scoped JSON
             # 500, not crash the handler into httpd's plain-text 500
-            future = self.batcher.submit(obs)
+            future = self.batcher.submit(
+                obs,
+                trace=(
+                    (ctx, span.span_id) if ctx is not None else None
+                ),
+            )
             action, step = future.result(timeout=self.act_timeout_s)
         except _FutureTimeout:
             return 504, _JSON, _json_body(
@@ -587,6 +650,11 @@ class PolicyServer:
         )
 
     def _session_create(self, body: bytes):
+        return self._traced(
+            "replica.session_create", self._session_create_inner, body
+        )
+
+    def _session_create_inner(self, body: bytes, ctx=None, span=None):
         """Mint a session: fresh zero carry in the bounded store. An
         optional ``{"session_id": ...}`` lets the ROUTER own the id (it
         needs to, for affinity and dead-replica re-establishment);
@@ -669,6 +737,11 @@ class PolicyServer:
         return 200, _JSON, _json_body(out)
 
     def _session_act(self, path: str, body: bytes):
+        return self._traced(
+            "replica.session_act", self._session_act_inner, path, body
+        )
+
+    def _session_act_inner(self, path: str, body: bytes, ctx, span):
         """``POST /session/<id>/act`` — advance one session's carry by
         one observation. The carry read-modify-write is serialized by
         the session's own lock; different sessions never contend.
@@ -758,7 +831,10 @@ class PolicyServer:
                 # retry parks a handler thread forever) and the epoch
                 # result
                 future = self.session_batcher.submit(
-                    sid, sess.carry, obs, timeout=self.act_timeout_s
+                    sid, sess.carry, obs, timeout=self.act_timeout_s,
+                    trace=(
+                        (ctx, span.span_id) if ctx is not None else None
+                    ),
                 )
                 action, carry_new, step = future.result(
                     timeout=self.act_timeout_s
@@ -771,7 +847,12 @@ class PolicyServer:
                 self.sessions.touch_steps(sess)
                 # write-behind carry snapshot (copies taken here, under
                 # the session lock; the disk write happens elsewhere)
-                self.sessions.journal_step(sid, sess)
+                self.sessions.journal_step(
+                    sid, sess,
+                    trace=(
+                        (ctx, span.span_id) if ctx is not None else None
+                    ),
+                )
         except _FutureTimeout:
             # the epoch never came back (wedged engine): the carry was
             # NOT advanced — a timed-out act is safe to retry
@@ -820,6 +901,28 @@ class PolicyServer:
             }
         )
         return (200 if ok else 503), _JSON, body
+
+    def _trace_fams(self, fam) -> None:
+        """The trace-layer gauges (ISSUE 15), appended to whichever
+        /metrics branch is rendering — writer-backpressure drops are
+        counted, never silent."""
+        if self.tracer is None:
+            return
+        fam(
+            "trpo_trace_spans_total", "counter",
+            "trace spans accepted for emission",
+            [("", self.tracer.spans_total)],
+        )
+        fam(
+            "trpo_trace_sampled_total", "counter",
+            "request traces emitted (head-sampled or forced)",
+            [("", self.tracer.sampled_total)],
+        )
+        fam(
+            "trpo_trace_dropped_total", "counter",
+            "trace spans dropped by writer backpressure",
+            [("", self.tracer.dropped_total)],
+        )
 
     def _metrics(self):
         b = self.batcher
@@ -939,6 +1042,7 @@ class PolicyServer:
                 "trpo_serve_reloads_total", "counter",
                 "hot reloads applied", [("", self.reloads_total)],
             )
+            self._trace_fams(fam)
             body = ("\n".join(lines) + "\n").encode()
             return 200, "text/plain; version=0.0.4; charset=utf-8", body
 
@@ -1002,6 +1106,7 @@ class PolicyServer:
             "trpo_serve_reloads_total", "counter",
             "hot reloads applied", [("", self.reloads_total)],
         )
+        self._trace_fams(fam)
         body = ("\n".join(lines) + "\n").encode()
         return 200, "text/plain; version=0.0.4; charset=utf-8", body
 

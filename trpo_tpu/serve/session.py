@@ -1057,13 +1057,34 @@ class SessionStore:
             entry["last_step"] = int(sess.last_step)
         self.journal.record(entry)
 
-    def journal_step(self, sid: str, sess: _Session) -> None:
+    def journal_step(self, sid: str, sess: _Session, trace=None) -> None:
         """The post-act journaling hook: snapshot every ``sync_every``
         applied steps (1 = every act — lossless up to the write-behind
-        flush)."""
+        flush).
+
+        ``trace`` is the act's ``(TraceContext, parent span id)``
+        (ISSUE 15): a ``journal.sync`` span is booked ONLY when the
+        cadence actually snapshots — the store is where the cadence
+        decision lives, so the trace shows which acts advanced the
+        recovery point and which rode between sync points. The span
+        times the enqueue (the act-path cost — the disk write happens
+        on the journal's writer thread, behind the same write-behind
+        bound as always)."""
         if self.journal is None or sess.steps % self.sync_every != 0:
             return
+        if trace is None:
+            self.journal_session(sid, sess)
+            return
+        ctx, parent_id = trace
+        t_wall, t0 = time.time(), time.perf_counter()
         self.journal_session(sid, sess)
+        ctx.record(
+            "journal.sync",
+            start=t_wall,
+            dur_ms=(time.perf_counter() - t0) * 1e3,
+            parent_id=parent_id,
+            steps=int(sess.steps),
+        )
 
     def _forget_journal(self, sid: str) -> None:
         if self.journal is not None:

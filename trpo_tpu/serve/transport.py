@@ -134,11 +134,14 @@ class _ChaosGates:
         with self._lock:
             return host in self._lost_descriptors
 
-    def gate(self, host: str) -> None:
+    def gate(self, host: str) -> float:
         """Model one exchange with ``host``: raise
         :class:`TransportPartitioned` while a partition holds, pay the
         injected latency otherwise. No chaos armed = no-op (the
-        behavior-pinned default)."""
+        behavior-pinned default). Returns the latency PAID in ms (0.0
+        normally) so a traced caller can attribute an injected
+        slow-network stall to the transport leg instead of the replica
+        (ISSUE 15 — the ``gate_ms`` span attr)."""
         if self.partitioned(host):
             raise TransportPartitioned(
                 f"transport to host {host!r} is partitioned"
@@ -147,6 +150,8 @@ class _ChaosGates:
             lat = self._latency_ms.get(host)
         if lat:
             time.sleep(lat / 1e3)
+            return float(lat)
+        return 0.0
 
 
 class LocalExecTransport(_ChaosGates):

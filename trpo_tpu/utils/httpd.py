@@ -29,6 +29,14 @@ Dynamic paths (the serving tier's session protocol routes by id:
 fn(path, body)}`` — consulted only after the exact tables miss, longest
 prefix wins, and the handler receives the FULL path so it can parse the
 dynamic segment itself.
+
+Request headers (ISSUE 15 — trace propagation): handlers keep their
+zero-argument / ``(body)`` signatures; a handler that needs the
+incoming headers (the tracing layer reading ``X-Trace-Id``) calls
+:func:`request_headers`, which returns the CURRENT request's header
+mapping from a thread-local the dispatcher sets around every handler
+invocation (handlers run on the per-connection handler thread, so the
+thread-local is exact). Outside a handler it returns ``None``.
 """
 
 from __future__ import annotations
@@ -37,7 +45,16 @@ import http.server
 import threading
 from typing import Callable, Dict, Optional, Tuple
 
-__all__ = ["BackgroundHTTPServer"]
+__all__ = ["BackgroundHTTPServer", "request_headers"]
+
+_tls = threading.local()
+
+
+def request_headers():
+    """The in-flight request's headers (an ``email.message.Message`` —
+    ``.get(name)``-able, case-insensitive) while called from inside an
+    HTTP handler on this server; ``None`` anywhere else."""
+    return getattr(_tls, "headers", None)
 
 # handler return type: (status_code, content_type, body)
 Response = Tuple[int, str, bytes]
@@ -85,12 +102,15 @@ class BackgroundHTTPServer:
             handler.wfile.write(body)
 
         def _run(handler, fn, *args) -> None:
+            _tls.headers = handler.headers  # request_headers() scope
             try:
                 status, ctype, body = fn(*args)
             except Exception as e:  # a handler bug degrades to a 500 for
                 # THIS request; the server thread and console stay clean
                 status, ctype = 500, "text/plain; charset=utf-8"
                 body = f"internal error: {type(e).__name__}".encode()
+            finally:
+                _tls.headers = None
             _respond(handler, status, ctype, body)
 
         class _Handler(http.server.BaseHTTPRequestHandler):
