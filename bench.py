@@ -2066,6 +2066,412 @@ def serving_sessions_bench(
     }
 
 
+# closed-loop client worker for serving_wire_bench: numpy-only (wire.py
+# is loaded by file path so the trpo_tpu package — and jax — never
+# imports), N client threads, observations pre-generated so the
+# measured loops time the PROTOCOL, not np.random. The baseline leg
+# speaks the pre-wire client idiom — one JSON POST per fresh
+# connection, Connection: close, exactly what every script and test in
+# this repo did through PR 15 — while the native leg holds one
+# persistent connection streaming binary frames. Two measured phases
+# separated by stdio barriers (READY → GO → DONE1 → GO2 → result):
+# phase 1 untraced (the throughput row), phase 2 with the router
+# tracing at rate 1.0 (the per-stage p99 rows + the parity actions).
+_WIRE_WORKER_SRC = r"""
+import http.client, importlib.util, json, sys, threading, time
+import numpy as np
+
+cfg = json.loads(sys.argv[1])
+spec = importlib.util.spec_from_file_location("twire", cfg["wire_path"])
+wire = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(wire)
+W = wire.WIRE_CONTENT_TYPE
+
+def make_obs(seed):
+    return np.random.RandomState(seed).randn(
+        *cfg["obs_shape"]).astype(np.float32)
+
+def act_keepalive(conn, o):
+    frame = wire.encode_frame(None, {"obs": o})
+    for attempt in (0, 1):
+        try:
+            conn[0].request("POST", "/act", body=frame,
+                            headers={"Content-Type": W, "Accept": W})
+            r = conn[0].getresponse()
+            body = r.read()
+            assert r.status == 200, (r.status, body[:200])
+            return np.asarray(
+                wire.decode_frame(body)[1]["action"], np.float64)
+        except (ConnectionError, http.client.HTTPException):
+            if attempt:
+                raise
+            conn[0].close()
+            conn[0] = http.client.HTTPConnection(
+                cfg["netloc"], timeout=30.0)
+
+def act_oneshot(o):
+    conn = http.client.HTTPConnection(cfg["netloc"], timeout=30.0)
+    try:
+        conn.request("POST", "/act",
+                     body=json.dumps({"obs": o.tolist()}).encode(),
+                     headers={"Content-Type": "application/json",
+                              "Connection": "close"})
+        r = conn.getresponse()
+        body = r.read()
+        assert r.status == 200, (r.status, body[:200])
+        return np.asarray(json.loads(body)["action"], np.float64)
+    finally:
+        conn.close()
+
+barrier = threading.Barrier(len(cfg["clients"]) + 1)
+lock = threading.Lock()
+lats1, lats2, acts, errors = [], [], {}, []
+
+def run(k):
+    warm_obs = [make_obs(9000 + 97 * k + i) for i in range(cfg["warm"])]
+    obs = [make_obs(5000 + 97 * k + i) for i in range(cfg["acts"])]
+    keep = cfg["keepalive"]
+    conn = [http.client.HTTPConnection(cfg["netloc"], timeout=30.0)]
+    step = (lambda o: act_keepalive(conn, o)) if keep else act_oneshot
+    try:
+        for o in warm_obs:
+            step(o)
+        barrier.wait()  # warmup done
+        barrier.wait()  # GO: phase 1 (untraced throughput)
+        mine1 = []
+        for o in obs:
+            t0 = time.perf_counter()
+            step(o)
+            mine1.append((time.perf_counter() - t0) * 1e3)
+        barrier.wait()  # phase 1 done
+        barrier.wait()  # GO2: phase 2 (traced stages + parity)
+        mine2, out = [], []
+        for o in obs:
+            t0 = time.perf_counter()
+            a = step(o)
+            mine2.append((time.perf_counter() - t0) * 1e3)
+            out.append(a.tolist())
+        with lock:
+            lats1.extend(mine1)
+            lats2.extend(mine2)
+            acts[str(k)] = out
+    except Exception as e:
+        with lock:
+            errors.append(repr(e))
+        barrier.abort()
+    finally:
+        conn[0].close()
+
+threads = [threading.Thread(target=run, args=(k,), daemon=True)
+           for k in cfg["clients"]]
+for t in threads:
+    t.start()
+try:
+    barrier.wait()
+    print("READY", flush=True)
+    sys.stdin.readline()
+    barrier.wait()  # GO
+    barrier.wait()  # phase 1 done
+    print("DONE1", flush=True)
+    sys.stdin.readline()
+    barrier.wait()  # GO2
+except threading.BrokenBarrierError:
+    pass
+for t in threads:
+    t.join()
+print(json.dumps({"errors": errors, "lats1": lats1, "lats2": lats2,
+                  "acts": acts}), flush=True)
+"""
+
+
+def serving_wire_bench(
+    concurrency: int = 16,
+    acts_per_client: int = 25,
+    warmup_acts: int = 4,
+    n_replicas: int = 2,
+    deadline_ms: float = 3.0,
+    events_dir=None,
+):
+    """Native-speed serving data plane (ISSUE 16): JSON/TCP/thread vs
+    binary/UDS/asyncio through the SAME router+replica stack, traced at
+    rate 1.0 so the win is attributed per stage, not just asserted.
+
+    Both legs run the identical tiny feed-forward engine (cartpole,
+    hidden (8,)) behind the production ``MicroBatcher`` — device time is
+    a real sub-millisecond dispatch, so the measurement is
+    protocol-dominated by construction: what differs between the legs
+    is ONLY the wire codec (JSON text vs the length-prefixed binary
+    frame on BOTH hops), the router→replica transport (TCP loopback vs
+    AF_UNIX), and the router core (thread-per-request vs the asyncio
+    loop). S closed-loop clients drive keep-alive connections; every
+    request is traced end-to-end (router root → dispatch hop → replica
+    queue-wait → engine dispatch), and the per-stage p99s come from the
+    same ``analyze`` assembler the ops tooling uses — the ``network``
+    stage is the hop minus the remote handler, the ``queue`` stage is
+    the batcher's gather wait. The deadline batcher AMPLIFIES protocol
+    jitter honestly: spread arrivals miss the rung-fill fast path and
+    stall toward the deadline, clustered arrivals fill the rung and
+    dispatch early — exactly the production economics the binary plane
+    exists to win. Actions must be BIT-EXACT across legs (same seeded
+    obs streams, same loaded snapshot). The S=16 row is the ISSUE 16
+    acceptance gate: native >= 2x actions/s at equal-or-better p99 with
+    stage network AND queue p99 BOTH strictly smaller. With
+    ``events_dir`` the four per-leg event logs (router + replicas per
+    leg) are left on disk for ``validate_events.py`` — the check.sh
+    smoke leg runs the validator over them.
+    """
+    import shutil as _shutil
+    import subprocess as _subprocess
+    import tempfile as _tempfile
+    import urllib.parse as _urlparse
+
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.obs.analyze import _summarize_traces, load_events
+    from trpo_tpu.obs.events import EventBus, JsonlSink, manifest_fields
+    from trpo_tpu.obs.trace import Tracer
+    from trpo_tpu.serve import (
+        InProcessReplica,
+        MicroBatcher,
+        PolicyServer,
+        ReplicaSet,
+        Router,
+    )
+    from trpo_tpu.serve import wire as _wire
+    from trpo_tpu.utils.metrics import quantile_nearest_rank as _q
+
+    # humanoid-sim: the 376-float observation is the point — the codec
+    # has real bytes to win on (an /act body is ~8 KB of JSON text vs
+    # ~1.6 KB of raw little-endian f32), while the tiny hidden layer
+    # keeps device time sub-millisecond
+    agent = TRPOAgent(
+        "humanoid-sim",
+        TRPOConfig(
+            n_envs=4, batch_timesteps=32, policy_hidden=(8,),
+            vf_hidden=(8,), seed=0,
+            serve_batch_shapes=(1, max(2, concurrency // n_replicas)),
+        ),
+    )
+    state = agent.init_state(seed=0)
+    obs_shape = list(agent.obs_shape)
+
+    evdir = events_dir or _tempfile.mkdtemp(prefix="wirebench-")
+    os.makedirs(evdir, exist_ok=True)
+
+    def _leg(tag: str, core: str, use_uds: bool, binary: bool):
+        """One full stack + client fleet; returns (row, actions)."""
+        rlog = os.path.join(evdir, f"{tag}_router.jsonl")
+        clog = os.path.join(evdir, f"{tag}_replicas.jsonl")
+        rbus = EventBus(JsonlSink(rlog))
+        cbus = EventBus(JsonlSink(clog))
+        for bus in (rbus, cbus):
+            bus.emit(
+                "run_manifest",
+                **manifest_fields(
+                    None, extra={"driver": "bench.serving_wire"}
+                ),
+            )
+        # the router head-samples at rate 0 until warmup is done, so
+        # the per-stage p99s cover exactly the measured phase; the
+        # replica tracer stays at rate 0 and joins ONLY the router's
+        # propagated X-Trace-Sampled verdict (its own head sample
+        # would trace warmup hops too)
+        rtracer = Tracer(rbus, 0.0, process="router")
+        ctracer = Tracer(cbus, 0.0, process="replica")
+        # AF_UNIX sockaddr_un caps paths at ~107 bytes — sockets live
+        # under a short /tmp dir, never under a deep events dir
+        udsdir = (
+            _tempfile.mkdtemp(prefix="tw-", dir="/tmp")
+            if use_uds else None
+        )
+
+        def factory(rid):
+            def build():
+                engine = agent.serve_engine()
+                engine.load(state.policy_params, state.obs_norm, step=1)
+                batcher = MicroBatcher(engine, deadline_ms=deadline_ms)
+                server = PolicyServer(
+                    engine, batcher, port=0, bus=cbus, tracer=ctracer,
+                    replica_name=rid,
+                    uds_path=(
+                        os.path.join(udsdir, f"{rid}.sock")
+                        if udsdir else None
+                    ),
+                )
+                return server, [batcher]
+
+            return build
+
+        rs = ReplicaSet(
+            lambda rid: InProcessReplica(factory(rid)), n_replicas,
+            bus=rbus, health_interval=60.0, backoff=0.05,
+            health_fail_threshold=1, max_restarts=2,
+        )
+        assert rs.wait_healthy(n_replicas, timeout=120.0), rs.snapshot()
+        router = Router(rs, port=0, bus=rbus, tracer=rtracer, core=core)
+        netloc = _urlparse.urlsplit(router.url).netloc
+
+        # the client fleet runs OUT of process (numpy-only workers —
+        # no jax import): in-process client threads would share the
+        # server's GIL and the contention, not the protocol, would
+        # dominate what the bench measures
+        n_workers = max(1, min(4, concurrency))
+        procs = []
+        try:
+            for w in range(n_workers):
+                cfg = {
+                    "netloc": netloc,
+                    "keepalive": binary,
+                    "clients": list(range(w, concurrency, n_workers)),
+                    "acts": acts_per_client,
+                    "warm": warmup_acts,
+                    "obs_shape": obs_shape,
+                    "wire_path": _wire.__file__,
+                }
+                procs.append(_subprocess.Popen(
+                    [sys.executable, "-c", _WIRE_WORKER_SRC,
+                     json.dumps(cfg)],
+                    stdin=_subprocess.PIPE, stdout=_subprocess.PIPE,
+                    text=True, bufsize=1,
+                ))
+            for p in procs:
+                line = p.stdout.readline().strip()
+                assert line == "READY", f"worker failed before GO: {line!r}"
+            # phase 1: untraced closed-loop throughput (the headline)
+            t_start = time.perf_counter()
+            for p in procs:
+                p.stdin.write("GO\n")
+                p.stdin.flush()
+            for p in procs:
+                line = p.stdout.readline().strip()
+                assert line == "DONE1", f"worker died in phase 1: {line!r}"
+            wall = time.perf_counter() - t_start
+            # phase 2: same obs streams with the router tracing at
+            # rate 1.0 (head-sampling reads the rate per request) —
+            # the per-stage p99 rows and the parity actions
+            rtracer.sample_rate = 1.0
+            t2_start = time.perf_counter()
+            for p in procs:
+                p.stdin.write("GO2\n")
+                p.stdin.flush()
+            outs = [json.loads(p.stdout.readline()) for p in procs]
+            wall2 = time.perf_counter() - t2_start
+            for p in procs:
+                p.wait(timeout=30.0)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            router.close()
+            rs.close()
+            rtracer.close()
+            ctracer.close()
+            rbus.close()
+            cbus.close()
+            if udsdir is not None:
+                _shutil.rmtree(udsdir, ignore_errors=True)
+
+        errors = [e for o in outs for e in o["errors"]]
+        assert not errors, errors[:3]
+        lats = [ms for o in outs for ms in o["lats1"]]
+        lats2 = [ms for o in outs for ms in o["lats2"]]
+        acts = {
+            int(k): v for o in outs for k, v in o["acts"].items()
+        }
+
+        summary = _summarize_traces(
+            load_events(rlog) + load_events(clog)
+        )
+        stages = (summary or {}).get("stages", {})
+        wire_groups = (summary or {}).get("wire", {})
+        n_acts = concurrency * acts_per_client
+        row = {
+            "leg": tag,
+            "core": core,
+            "codec": "binary" if binary else "json",
+            "transport": "uds" if use_uds else "tcp",
+            "connections": "keepalive" if binary else "oneshot",
+            "actions_per_sec": round(n_acts / wall, 1),
+            "p50_ms": round(_q(lats, 0.5), 3),
+            "p99_ms": round(_q(lats, 0.99), 3),
+            # the traced phase: same streams, router tracing at 1.0 —
+            # slower in absolute terms (span bookkeeping shares the
+            # core), quoted separately so the throughput row stays an
+            # untraced measurement
+            "traced_actions_per_sec": round(n_acts / wall2, 1),
+            "traced_p99_ms": round(_q(lats2, 0.99), 3),
+            "network_p99_ms": (stages.get("network") or {}).get("p99_ms"),
+            "queue_p99_ms": (stages.get("queue") or {}).get("p99_ms"),
+            "wire": {
+                k: {
+                    "hops": v["hops"],
+                    "network_p99_ms": v["network_p99_ms"],
+                }
+                for k, v in wire_groups.items()
+            },
+            "events": [rlog, clog],
+        }
+        return row, acts
+
+    base_row, base_acts = _leg("baseline", "thread", False, False)
+    native_row, native_acts = _leg("native", "async", True, True)
+
+    parity = sorted(base_acts) == sorted(native_acts) and all(
+        np.array_equal(
+            np.asarray(base_acts[k]), np.asarray(native_acts[k])
+        )
+        for k in base_acts
+    )
+    speedup = round(
+        native_row["actions_per_sec"] / base_row["actions_per_sec"], 2
+    )
+    if events_dir is None:
+        _shutil.rmtree(evdir, ignore_errors=True)
+        for row in (base_row, native_row):
+            row.pop("events")
+
+    dev = jax.devices()[0]
+    gates = {
+        "speedup_ge_2x": speedup >= 2.0,
+        "p99_not_worse": native_row["p99_ms"] <= base_row["p99_ms"],
+        "network_p99_smaller": (
+            native_row["network_p99_ms"] is not None
+            and base_row["network_p99_ms"] is not None
+            and native_row["network_p99_ms"] < base_row["network_p99_ms"]
+        ),
+        "queue_p99_smaller": (
+            native_row["queue_p99_ms"] is not None
+            and base_row["queue_p99_ms"] is not None
+            and native_row["queue_p99_ms"] < base_row["queue_p99_ms"]
+        ),
+        "action_parity": parity,
+    }
+    return {
+        "metric": "serving_wire_s16",
+        "concurrency": concurrency,
+        "acts_per_client": acts_per_client,
+        "n_replicas": n_replicas,
+        "deadline_ms": deadline_ms,
+        "backend": dev.platform,
+        "note": (
+            "same tiny ff engine + MicroBatcher both legs (real "
+            "sub-ms dispatches — protocol-dominated by construction); "
+            "baseline = the pre-wire plane exactly as clients used it "
+            "(one JSON POST per fresh TCP connection through the "
+            "thread-per-request router core), native = binary wire "
+            "frames on persistent connections over same-host AF_UNIX "
+            "through the asyncio core; throughput from the untraced "
+            "phase, per-stage p99s from a second rate-1.0-traced "
+            "phase via the analyze assembler; actions bit-exact "
+            "across legs"
+        ),
+        "rows": [base_row, native_row],
+        "speedup": speedup,
+        "action_parity": parity,
+        "gates": gates,
+    }
+
+
 _FLEET_DEFAULTS = {
     # family -> (batch_timesteps, N ladder, K iterations per timed rep).
     # The batch holds T·N constant across the family's ladder (each N
@@ -2753,6 +3159,26 @@ def main():
                 f"serving sessions bench failed ({type(e).__name__}: {e})"
             )
 
+    # Native-speed data plane (ISSUE 16): JSON/TCP/thread vs
+    # binary/UDS/asyncio through the same stack, bit-exact, with
+    # per-stage p99 attribution from rate-1.0 traces —
+    # BENCH_SERVING_WIRE=0 skips (follows BENCH_SERVING).
+    serving_wire = None
+    if (
+        os.environ.get("BENCH_SERVING", "1") != "0"
+        and os.environ.get("BENCH_SERVING_WIRE", "1") != "0"
+    ):
+        try:
+            _progress(
+                "serving wire bench (binary/UDS/async vs "
+                "JSON/TCP/thread)"
+            )
+            serving_wire = serving_wire_bench()
+        except Exception as e:
+            _progress(
+                f"serving wire bench failed ({type(e).__name__}: {e})"
+            )
+
     # Env fleet scale-out (ISSUE 10): env-steps/s across the wide-N
     # ladder of the device-env families + rollout-memory-vs-chunk study
     # — BENCH_ENV_FLEET=0 skips (the families/Ns/K scale via
@@ -3027,6 +3453,13 @@ def main():
                 #    (ISSUE 13): sessions/s + p50/p99 ladder over
                 #    concurrency, batched epochs vs serialized batch-1
                 "serving_sessions": serving_sessions,
+                # -- native-speed data plane (ISSUE 16): closed-loop
+                #    S=16 actions/s + p99, JSON/TCP/thread (one-shot
+                #    connections, the pre-wire client idiom) vs
+                #    binary/UDS/asyncio (persistent connections), with
+                #    traced stage_network/stage_queue p99 rows and
+                #    bit-exact action parity across legs --
+                "serving_wire": serving_wire,
                 # -- replica-scaling SLOs (ISSUE 9): closed-loop
                 #    actions/s + p50/p99 through the router at 1/2/4
                 #    replicas; scaling_efficiency = aps_N/(N·aps_1),
@@ -3196,6 +3629,26 @@ def _emit_bench_events(artifact, tail_breakdown, host_pipe) -> None:
                     name=f"serving_sessions/s{s_conc}_batched_ms_per_step",
                     ms=1e3 / bat["steps_per_sec"],
                     steps_per_sec=bat["steps_per_sec"],
+                )
+        # wire-plane rows (ISSUE 16): per leg, closed-loop p99 plus a
+        # ms-per-act phase (time-like: an actions/s collapse trips the
+        # gate), with the traced stage p99s riding as extra fields so
+        # compare_runs can regress the located rows, not just the
+        # aggregate
+        for row in (artifact.get("serving_wire") or {}).get("rows", []):
+            bus.emit(
+                "phase",
+                name=f"serving_wire/{row['leg']}_p99",
+                ms=row["p99_ms"],
+                network_p99_ms=row["network_p99_ms"],
+                queue_p99_ms=row["queue_p99_ms"],
+            )
+            if row["actions_per_sec"]:
+                bus.emit(
+                    "phase",
+                    name=f"serving_wire/{row['leg']}_ms_per_act",
+                    ms=1e3 / row["actions_per_sec"],
+                    actions_per_sec=row["actions_per_sec"],
                 )
         # env-fleet ladder rows (ISSUE 10): one phase record per
         # (family, N) rung with the throughput riding as extra fields —

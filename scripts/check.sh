@@ -376,6 +376,75 @@ print(
 )
 PYEOF
 
+echo "== serving data plane smoke: binary/UDS/async vs JSON/TCP/thread, located p99 =="
+# ISSUE 16 acceptance: on the calibrated CPU serving bench at S=16
+# (humanoid-sim obs so the codec has real bytes to move), the native
+# plane — binary wire frames + Unix-socket replica hops + the asyncio
+# router core — must beat the pre-wire plane (one JSON POST per fresh
+# TCP connection through the thread-per-request core, the client idiom
+# every repo tool used through PR 15) by >= 2x actions/s at
+# equal-or-better end-to-end p99, with the traced stage_network AND
+# stage_queue p99 rows BOTH strictly smaller (the win must be located
+# in the protocol stages, not smeared), bit-exact actions across both
+# planes, and validator-clean router+replica trace logs from the
+# rate-1.0 traced phase.
+WIRE_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$WIRE_TMP" <<'PYEOF'
+import sys
+
+import bench
+
+out = bench.serving_wire_bench(events_dir=sys.argv[1])
+base, native = out["rows"]
+gates = out["gates"]
+assert all(gates.values()), gates
+assert out["action_parity"] is True, "planes disagree on actions"
+assert out["speedup"] >= 2.0, out["speedup"]
+assert native["p99_ms"] <= base["p99_ms"], (native, base)
+assert native["network_p99_ms"] < base["network_p99_ms"], (native, base)
+assert native["queue_p99_ms"] < base["queue_p99_ms"], (native, base)
+print(
+    f"data plane gate OK: {out['speedup']}x actions/s "
+    f"({native['actions_per_sec']} vs {base['actions_per_sec']}), "
+    f"p99 {native['p99_ms']} <= {base['p99_ms']} ms, "
+    f"network p99 {native['network_p99_ms']} < {base['network_p99_ms']} ms, "
+    f"queue p99 {native['queue_p99_ms']} < {base['queue_p99_ms']} ms, "
+    f"bit-exact actions on both planes"
+)
+PYEOF
+python scripts/validate_events.py \
+    "$WIRE_TMP/baseline_router.jsonl" "$WIRE_TMP/baseline_replicas.jsonl" \
+    "$WIRE_TMP/native_router.jsonl" "$WIRE_TMP/native_replicas.jsonl"
+# the located-stage assertion AGAIN through the user-facing tool: the
+# analyze_run.py --json summary (router log merged with the replicas')
+# must itself show stage_network and stage_queue p99 strictly smaller
+# on the binary path
+python scripts/analyze_run.py "$WIRE_TMP/baseline_router.jsonl" \
+    --merge "$WIRE_TMP/baseline_replicas.jsonl" --json \
+    > "$WIRE_TMP/base_sum.json"
+python scripts/analyze_run.py "$WIRE_TMP/native_router.jsonl" \
+    --merge "$WIRE_TMP/native_replicas.jsonl" --json \
+    > "$WIRE_TMP/native_sum.json"
+python - "$WIRE_TMP" <<'PYEOF'
+import json
+import os
+import sys
+
+d = sys.argv[1]
+with open(os.path.join(d, "base_sum.json")) as f:
+    b = json.load(f)["traces"]["stages"]
+with open(os.path.join(d, "native_sum.json")) as f:
+    n = json.load(f)["traces"]["stages"]
+assert n["network"]["p99_ms"] < b["network"]["p99_ms"], (n, b)
+assert n["queue"]["p99_ms"] < b["queue"]["p99_ms"], (n, b)
+print(
+    f"analyze_run gate OK: stage_network p99 {n['network']['p99_ms']} < "
+    f"{b['network']['p99_ms']} ms, stage_queue p99 {n['queue']['p99_ms']} "
+    f"< {b['queue']['p99_ms']} ms (binary vs json, analyze_run --json)"
+)
+PYEOF
+rm -rf "$WIRE_TMP"
+
 echo "== env fleet smoke: chunked == unchunked + wide-N beats the N=128 row =="
 # ISSUE 10 acceptance, cartpole-cheap: (a) a rollout_chunk training run
 # must be BITWISE identical to the unchunked twin through 3 full fused

@@ -80,6 +80,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument(
+        "--uds-path",
+        help="ALSO bind a same-host Unix domain socket here (ISSUE 16 "
+        "data plane): the front end answers on both TCP and this path; "
+        "with --replicas each in-process replica binds "
+        "PATH.<replica-id> and the router dials the AF_UNIX socket "
+        "instead of loopback TCP for same-host hops. Keep the path "
+        "short (sockaddr_un is ~107 bytes). The bound path is written "
+        "to the run descriptor as `uds_path`, so a SubprocessReplica "
+        "parent discovers it without stdout parsing",
+    )
+    p.add_argument(
+        "--router-core", choices=("async", "thread"), default="async",
+        help="router front-end concurrency core (ISSUE 16): 'async' "
+        "(default) = one event loop with loop-owned replica connection "
+        "pools; 'thread' = the pre-wire thread-per-request front end "
+        "with per-thread pools (the compatibility fallback and the "
+        "bench baseline)",
+    )
+    p.add_argument(
         "--preset", default="cartpole",
         help="config rung the checkpoint was trained with (model shapes "
         "must match the saved params)",
@@ -596,7 +615,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             )
         return _tracers[name]
 
-    def build_replica(replica_name: Optional[str], port: int):
+    def build_replica(
+        replica_name: Optional[str], port: int,
+        uds_path: Optional[str] = None,
+    ):
         """One complete serving stack: the right engine for the model
         family (recurrent → session protocol; the structured 409s on
         the wrong endpoint come from PolicyServer), its own checkpoint
@@ -638,6 +660,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             session_deadline_ms=cfg.serve_session_deadline_ms,
             session_adaptive_deadline=cfg.serve_adaptive_deadline,
             tracer=make_tracer(replica_name or "solo"),
+            uds_path=uds_path,
         )
         closers = ([batcher] if batcher is not None else []) + [
             checkpointer
@@ -686,8 +709,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 )
         else:
             def launcher(rid):
+                # each replica owns its own AF_UNIX socket next to the
+                # front end's (PATH.<rid>) — the router's _dial_plan
+                # picks it up from the replica record
                 return InProcessReplica(
-                    lambda: build_replica(rid, port=0)
+                    lambda: build_replica(
+                        rid, port=0,
+                        uds_path=(
+                            f"{args.uds_path}.{rid}"
+                            if args.uds_path else None
+                        ),
+                    )
                 )
         # lease liveness: always armed across hosts (a failed poll
         # proves nothing through a partition); opt-in locally via an
@@ -720,6 +752,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             injector=injector,
             min_latency_samples=cfg.serve_autoscale_min_samples,
             tracer=make_tracer("router"),
+            uds_path=args.uds_path,
+            core=args.router_core,
         )
         if canary:
             canary_ck = Checkpointer(
@@ -753,7 +787,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         front_url, endpoints = router.url, list(Router.ENDPOINTS)
         front_port = router.port
     else:
-        server, closers = build_replica(args.replica_name, args.port)
+        server, closers = build_replica(
+            args.replica_name, args.port, uds_path=args.uds_path
+        )
         front_url, endpoints = server.url, list(server.ENDPOINTS)
         front_port = server.port
 
@@ -769,6 +805,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "pid": os.getpid(),
                 "port": front_port,
                 "url": front_url,
+                "uds_path": (
+                    router.uds_path if router is not None
+                    else server.uds_path
+                ),
                 "endpoints": endpoints,
                 "replicas": cfg.serve_replicas,
                 "recurrent": recurrent,

@@ -66,7 +66,11 @@ request whose trace hides the retry defeats the always-trace-
 anomalies policy), and — in a log that carries spans at all — a
 ``partition_host`` fault must be matched by a ``router.takeover``
 span (the trace must SHOW the detour the partition forced, not just
-the lease bookkeeping).
+the lease bookkeeping); and — ISSUE 16 — in a log whose dispatch
+spans carry wire attrs at all, EVERY ``router.dispatch``/
+``router.retry`` span must name ``codec`` (json|binary) and
+``transport`` (tcp|uds), so the per-format p99 breakdown attributes
+every hop.
 Exits non-zero with per-line diagnostics on any failure; prints a
 per-kind count summary on success. Used by ``scripts/check.sh`` against
 both a training run's ``--metrics-jsonl`` output and ``bench.py``'s
@@ -484,6 +488,34 @@ def validate_file(path: str) -> list:
                 f"{rec.get('span')!r} ({rec.get('name')}): the trace "
                 "was flushed without its edge span ever ending"
             )
+    # ISSUE 16 data-plane contract: every dispatch hop span names its
+    # wire format — `codec` in {json, binary} and `transport` in
+    # {tcp, uds} — so the per-format p99 breakdown (analyze.py `wire`
+    # table) attributes every hop instead of silently bucketing
+    # unlabeled ones. Enforced only on logs whose router emits the
+    # attrs at all (any hop span carrying `codec`): a pre-ISSUE-16 log
+    # stays valid, a current log with a half-labeled hop does not.
+    _hop_spans = [
+        (n, rec) for n, rec in records
+        if rec.get("kind") == "span"
+        and rec.get("name") in ("router.dispatch", "router.retry")
+    ]
+    if any("codec" in rec for _, rec in _hop_spans):
+        for n, rec in _hop_spans:
+            codec = rec.get("codec")
+            transport = rec.get("transport")
+            if codec not in ("json", "binary"):
+                errs.append(
+                    f"{path}:{n}: dispatch span {rec.get('span')!r} "
+                    f"({rec.get('name')}) has codec {codec!r} — every "
+                    "hop must name json or binary"
+                )
+            if transport not in ("tcp", "uds"):
+                errs.append(
+                    f"{path}:{n}: dispatch span {rec.get('span')!r} "
+                    f"({rec.get('name')}) has transport {transport!r} "
+                    "— every hop must name tcp or uds"
+                )
     # (3) a retried request that names its trace must have the retry
     # visible IN that trace — anomalies are always-sampled precisely so
     # the trace shows what the latency bought

@@ -1,0 +1,565 @@
+"""Native-speed serving data plane (ISSUE 16): binary wire codec,
+same-host UDS transport, and the async router core.
+
+Contracts pinned here:
+
+* codec round-trip is BIT-EXACT across the dtype allowlist and shapes
+  (scalars, 0-d, empty, 2-d), decode views are zero-copy and
+  read-only, and non-native-endian inputs land little-endian;
+* every malformed frame — truncation, bad magic, version mismatch,
+  manifest/payload disagreement, hostile dtypes — raises
+  ``WireError`` with ``code="bad_frame"``, and the HTTP layer turns
+  it into a typed 400, never a 500;
+* ``restamp`` merges scalar fields without touching one array byte;
+* content negotiation: ``Content-Type`` picks the request codec,
+  ``Accept`` the response codec, and JSON stays the default (curl and
+  old clients see byte-identical behavior);
+* an act over the binary path returns actions BIT-EXACT with the JSON
+  path, at the replica AND through the router (async core default);
+* a replica's UDS listener answers the same routes as its TCP port,
+  the router dials UDS for same-host replicas (``dispatch_transport``
+  counters prove it) while a transport model that says "remote" keeps
+  the hop on TCP — partition/latency gates keep their meaning;
+* lossless journal failover (kill → resume, ``resumed_steps``,
+  seq-dedupe on the replayed window) holds verbatim over binary/UDS.
+"""
+
+import json
+import os
+import socket
+import tempfile
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.serve import (
+    InProcessReplica,
+    MicroBatcher,
+    PolicyServer,
+    ReplicaSet,
+    Router,
+)
+from trpo_tpu.serve import wire
+
+_WIRE = wire.WIRE_CONTENT_TYPE
+_CFG = dict(
+    n_envs=4, batch_timesteps=32, cg_iters=2, vf_train_steps=2,
+    policy_hidden=(8,), vf_hidden=(8,), seed=11,
+    serve_batch_shapes=(1, 2),
+)
+
+
+@pytest.fixture(scope="module")
+def ff():
+    agent = TRPOAgent("cartpole", TRPOConfig(**_CFG))
+    state = agent.init_state(seed=0)
+    return agent, state
+
+
+@pytest.fixture(scope="module")
+def rec():
+    agent = TRPOAgent("pendulum", TRPOConfig(**{**_CFG, "policy_gru": 8}))
+    state = agent.init_state(seed=0)
+    return agent, state
+
+
+def _ff_factory(agent, state, uds_path=None):
+    def factory():
+        engine = agent.serve_engine()
+        engine.load(state.policy_params, state.obs_norm, step=1)
+        batcher = MicroBatcher(engine, deadline_ms=5.0)
+        server = PolicyServer(
+            engine, batcher, port=0, uds_path=uds_path,
+        )
+        return server, [batcher]
+
+    return factory
+
+
+def _rec_factory(agent, state, journal_dir=None, uds_path=None,
+                 replica_name=None):
+    def factory():
+        engine = agent.serve_session_engine()
+        engine.load(state.policy_params, state.obs_norm, step=1)
+        server = PolicyServer(
+            engine, None, port=0, replica_name=replica_name,
+            carry_journal_dir=journal_dir, uds_path=uds_path,
+        )
+        return server, []
+
+    return factory
+
+
+def _replicaset(make, n, **kw):
+    kw.setdefault("health_interval", 60.0)
+    kw.setdefault("backoff", 0.05)
+    kw.setdefault("health_fail_threshold", 1)
+    kw.setdefault("max_restarts", 2)
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(make(rid)), n, **kw
+    )
+    assert rs.wait_healthy(n, timeout=60.0), rs.snapshot()
+    return rs
+
+
+def _uds_dir():
+    # AF_UNIX paths are ~107 bytes max: a deep tmp_path overflows
+    # sockaddr_un, so sockets live under a short /tmp dir instead
+    return tempfile.mkdtemp(prefix="tw-", dir="/tmp")
+
+
+def _post_raw(url, data, ctype=_WIRE, accept=None, timeout=30.0):
+    headers = {"Content-Type": ctype}
+    if accept is not None:
+        headers["Accept"] = accept
+    req = urllib.request.Request(url, data=data, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.headers.get("Content-Type", ""), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type", ""), e.read()
+
+
+def _post_json(url, payload=None, timeout=30.0):
+    data = b"" if payload is None else json.dumps(payload).encode()
+    status, _ctype, body = _post_raw(
+        url, data, ctype="application/json", timeout=timeout
+    )
+    return status, json.loads(body)
+
+
+def _act_binary(url, obs, timeout=30.0, **scalars):
+    """One act over the wire codec; binary response decoded to
+    ``(status, scalars, arrays)`` (error responses are JSON by
+    contract and come back as ``(status, parsed_json, None)``)."""
+    frame = wire.encode_frame(
+        scalars, {"obs": np.asarray(obs, np.float32)}
+    )
+    status, ctype, body = _post_raw(
+        url, frame, ctype=_WIRE, accept=_WIRE, timeout=timeout
+    )
+    if ctype.split(";", 1)[0].strip() == _WIRE:
+        s, arrays = wire.decode_frame(body)
+        return status, s, arrays
+    return status, json.loads(body), None
+
+
+def _get_text(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _direct_actions(agent, state, obs_seq):
+    carry = None
+    out = []
+    for o in obs_seq:
+        a, _d, carry = agent.act(
+            state, o, eval_mode=True, policy_carry=carry
+        )
+        out.append(np.asarray(a, np.float64))
+    return out
+
+
+def _obs_seq(agent, n, start=0):
+    return [
+        np.random.RandomState(start + i)
+        .randn(*agent.obs_shape).astype(np.float32)
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# codec (no HTTP, no jax)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "dtype", ["f2", "f4", "f8", "i1", "i2", "i4", "i8",
+              "u1", "u2", "u4", "u8", "b1"],
+)
+def test_roundtrip_bit_exact_across_dtypes(dtype):
+    rng = np.random.RandomState(3)
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        arr = rng.randn(2, 3).astype(dt)
+    elif dt.kind == "b":
+        arr = (rng.randn(2, 3) > 0)
+    else:
+        arr = rng.randint(0, 100, size=(2, 3)).astype(dt)
+    frame = wire.encode_frame({"seq": 7}, {"x": arr})
+    scalars, arrays = wire.decode_frame(frame)
+    assert scalars == {"seq": 7}
+    out = arrays["x"]
+    assert out.dtype.newbyteorder("=") == dt
+    assert out.shape == arr.shape
+    assert out.tobytes() == np.ascontiguousarray(arr).tobytes()
+
+
+def test_roundtrip_shapes_scalar_empty_and_multi_array():
+    arrays = {
+        "scalar0d": np.float32(2.5),
+        "empty": np.zeros((0, 4), np.float32),
+        "vec": np.arange(5, dtype=np.int32),
+        "cube": np.arange(24, dtype=np.float64).reshape(2, 3, 4),
+    }
+    frame = wire.encode_frame({"a": 1, "b": "s"}, arrays)
+    scalars, out = wire.decode_frame(frame)
+    assert scalars == {"a": 1, "b": "s"}
+    assert list(out) == list(arrays)  # manifest order preserved
+    for name, arr in arrays.items():
+        ref = np.asarray(arr)
+        assert out[name].shape == ref.shape
+        np.testing.assert_array_equal(out[name], ref)
+
+
+def test_big_endian_input_lands_little_endian_bit_exact():
+    arr = np.arange(6, dtype=">f4").reshape(2, 3)
+    _s, out = wire.decode_frame(wire.encode_frame(None, {"x": arr}))
+    assert out["x"].dtype.byteorder in ("<", "=")
+    np.testing.assert_array_equal(out["x"], arr.astype("<f4"))
+
+
+def test_decode_views_are_zero_copy_and_readonly():
+    frame = wire.encode_frame(None, {"x": np.arange(4, dtype=np.float32)})
+    _s, out = wire.decode_frame(frame)
+    assert not out["x"].flags.writeable
+    with pytest.raises((ValueError, RuntimeError)):
+        out["x"][0] = 1.0
+
+
+@pytest.mark.parametrize(
+    "mutate, detail",
+    [
+        (lambda b: b[:4], "truncated"),            # header cut short
+        (lambda b: b"XX" + b[2:], "bad magic"),    # wrong magic
+        (lambda b: b[:2] + bytes([9]) + b[3:], "version_mismatch"),
+        (lambda b: b[:-3], "truncated"),           # body cut short
+        (lambda b: b + b"zz", "oversized"),        # trailing bytes
+    ],
+)
+def test_malformed_frames_raise_typed_bad_frame(mutate, detail):
+    frame = wire.encode_frame(
+        {"seq": 1}, {"obs": np.ones(3, np.float32)}
+    )
+    with pytest.raises(wire.WireError) as ei:
+        wire.decode_frame(mutate(frame))
+    assert ei.value.code == "bad_frame"
+    assert detail in ei.value.detail
+
+
+def test_hostile_manifest_dtype_refused():
+    # a hand-built manifest naming an object dtype must never
+    # instantiate it out of a network payload
+    meta = json.dumps(
+        {"f": {}, "a": [["x", "O8", [1]]]}, separators=(",", ":")
+    ).encode()
+    frame = (
+        b"TW" + bytes([wire.WIRE_VERSION, 0])
+        + len(meta).to_bytes(4, "little") + meta + b"\x00" * 8
+    )
+    with pytest.raises(wire.WireError) as ei:
+        wire.decode_frame(frame)
+    assert ei.value.code == "bad_frame"
+
+
+def test_restamp_merges_scalars_without_touching_arrays():
+    arr = np.random.RandomState(0).randn(4, 2).astype(np.float32)
+    frame = wire.encode_frame({"seq": 1, "keep": "y"}, {"obs": arr})
+    out = wire.restamp(frame, seq=9, resumed=True)
+    scalars, arrays = wire.decode_frame(out)
+    assert scalars == {"seq": 9, "keep": "y", "resumed": True}
+    assert arrays["obs"].tobytes() == arr.tobytes()
+    with pytest.raises(wire.WireError):
+        wire.restamp(b"garbage", seq=1)
+
+
+def test_content_negotiation_defaults_to_json():
+    class H(dict):
+        def get(self, k, d=None):
+            return dict.get(self, k, d)
+
+    assert not wire.is_binary_body(None)
+    assert not wire.wants_binary(H({"Content-Type": "application/json"}))
+    assert wire.is_binary_body(H({"Content-Type": _WIRE + "; v=1"}))
+    # a wire body with no Accept reads what it writes
+    assert wire.wants_binary(H({"Content-Type": _WIRE}))
+    # an explicit Accept wins in both directions
+    assert not wire.wants_binary(
+        H({"Content-Type": _WIRE, "Accept": "application/json"})
+    )
+    assert wire.wants_binary(
+        H({"Accept": f"application/json, {_WIRE};q=0.9"})
+    )
+
+
+def test_dial_plan_uds_same_host_tcp_cross_host():
+    local = SimpleNamespace(
+        uds_path="/tmp/r0.sock", host="local",
+        url="http://127.0.0.1:9",
+    )
+    no_transport = SimpleNamespace(transport=None)
+    assert Router._dial_plan(no_transport, local) == (
+        "uds", "/tmp/r0.sock"
+    )
+    # a transport model that says "remote" keeps the hop on TCP even
+    # when a (stale/shared-fs) socket path is advertised
+    modeled = SimpleNamespace(
+        transport=SimpleNamespace(same_host=lambda host: host == "local")
+    )
+    remote = SimpleNamespace(
+        uds_path="/tmp/r1.sock", host="hostB",
+        url="http://127.0.0.1:9",
+    )
+    assert Router._dial_plan(modeled, remote) == ("tcp", "127.0.0.1:9")
+    assert Router._dial_plan(modeled, local) == ("uds", "/tmp/r0.sock")
+    no_uds = SimpleNamespace(
+        uds_path=None, host="local", url="http://127.0.0.1:9"
+    )
+    assert Router._dial_plan(no_transport, no_uds) == (
+        "tcp", "127.0.0.1:9"
+    )
+
+
+# ---------------------------------------------------------------------------
+# one replica: negotiation, typed 400s, UDS listener
+# ---------------------------------------------------------------------------
+
+
+def test_act_binary_bit_exact_vs_json_and_typed_bad_frame(ff):
+    agent, state = ff
+    server, closers = _ff_factory(agent, state)()
+    try:
+        obs = _obs_seq(agent, 1)[0]
+        status, out = _post_json(
+            server.url + "/act", {"obs": obs.tolist()}
+        )
+        assert status == 200
+        status, scalars, arrays = _act_binary(server.url + "/act", obs)
+        assert status == 200
+        assert scalars["step"] == out["step"]
+        np.testing.assert_array_equal(
+            np.asarray(arrays["action"], np.float64),
+            np.asarray(out["action"], np.float64),
+            err_msg="binary act diverged from the JSON act",
+        )
+        # binary body, JSON reply: Accept wins
+        frame = wire.encode_frame(None, {"obs": obs})
+        status, ctype, body = _post_raw(
+            server.url + "/act", frame, ctype=_WIRE,
+            accept="application/json",
+        )
+        assert status == 200
+        assert ctype.split(";")[0] == "application/json"
+        assert json.loads(body)["action"] == out["action"]
+        # malformed frame: typed 400, never a 500
+        status, ctype, body = _post_raw(
+            server.url + "/act", b"TWxxxx", ctype=_WIRE,
+        )
+        assert status == 400
+        assert json.loads(body)["code"] == "bad_frame"
+        metrics = _get_text(server.url + "/metrics")
+        # three binary bodies: the act, the Accept-json act, and the
+        # malformed frame (counted at negotiation, before decode)
+        assert 'trpo_serve_wire_frames_total{codec="binary"} 3' in metrics
+        assert "trpo_serve_wire_decode_errors_total 1" in metrics
+    finally:
+        server.close()
+        for c in closers:
+            c.close()
+
+
+def test_replica_uds_listener_answers_same_routes(ff):
+    agent, state = ff
+    uds = os.path.join(_uds_dir(), "r.sock")
+    server, closers = _ff_factory(agent, state, uds_path=uds)()
+    try:
+        assert server.uds_path == uds and os.path.exists(uds)
+        obs = _obs_seq(agent, 1)[0]
+        status, _s, arrays = _act_binary(server.url + "/act", obs)
+        assert status == 200
+        frame = wire.encode_frame(None, {"obs": obs})
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(30.0)
+        s.connect(uds)
+        try:
+            s.sendall(
+                (
+                    "POST /act HTTP/1.1\r\nHost: localhost\r\n"
+                    f"Content-Type: {_WIRE}\r\nAccept: {_WIRE}\r\n"
+                    f"Content-Length: {len(frame)}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode() + frame
+            )
+            raw = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                raw += chunk
+        finally:
+            s.close()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        assert b" 200 " in head.split(b"\r\n", 1)[0]
+        _s2, arrays_uds = wire.decode_frame(body)
+        np.testing.assert_array_equal(
+            arrays_uds["action"], arrays["action"],
+            err_msg="UDS act diverged from the TCP act",
+        )
+        metrics = _get_text(server.url + "/metrics")
+        assert 'trpo_serve_transport_requests_total{transport="uds"} 1' \
+            in metrics
+    finally:
+        server.close()
+        for c in closers:
+            c.close()
+    assert not os.path.exists(uds)  # close() reaps the socket file
+
+
+# ---------------------------------------------------------------------------
+# through the router (async core default)
+# ---------------------------------------------------------------------------
+
+
+def test_router_binary_over_uds_bit_exact_and_counted(ff):
+    agent, state = ff
+    udsdir = _uds_dir()
+    rs = _replicaset(
+        lambda rid: _ff_factory(
+            agent, state, uds_path=os.path.join(udsdir, f"{rid}.sock")
+        ),
+        2,
+    )
+    router = Router(rs, port=0)
+    try:
+        obs = _obs_seq(agent, 1)[0]
+        status, out = _post_json(
+            router.url + "/act", {"obs": obs.tolist()}
+        )
+        assert status == 200
+        status, scalars, arrays = _act_binary(router.url + "/act", obs)
+        assert status == 200
+        assert scalars["step"] == out["step"]
+        np.testing.assert_array_equal(
+            np.asarray(arrays["action"], np.float64),
+            np.asarray(out["action"], np.float64),
+            err_msg="binary-over-UDS act diverged from JSON",
+        )
+        # a malformed frame through the router stays a typed 400
+        status, _ctype, body = _post_raw(
+            router.url + "/act", b"TW\x01\x00junk", ctype=_WIRE
+        )
+        assert status == 400
+        assert json.loads(body)["code"] == "bad_frame"
+        data_plane = json.loads(
+            _get_text(router.url + "/status")
+        )["data_plane"]
+        assert data_plane["core"] == "async"
+        assert data_plane["wire_frames_total"]["binary"] >= 1
+        metrics = _get_text(router.url + "/metrics")
+        assert 'trpo_router_wire_frames_total{codec="binary"}' in metrics
+        assert 'trpo_router_wire_frames_total{codec="json"}' in metrics
+        # every replica hop dialed the AF_UNIX socket — none fell
+        # back to TCP
+        with router._lock:
+            transports = dict(router.dispatch_transport_total)
+        assert transports["uds"] >= 2 and transports["tcp"] == 0, (
+            transports
+        )
+        assert (
+            'trpo_router_dispatch_transport_total{transport="uds"}'
+            in metrics
+        )
+    finally:
+        router.close()
+        rs.close()
+
+
+def test_session_binary_seq_dedupe_on_replica(rec):
+    agent, state = rec
+    server, closers = _rec_factory(agent, state)()
+    try:
+        status, out = _post_json(server.url + "/session")
+        assert status == 200
+        sid = out["session"]
+        obs = _obs_seq(agent, 2)
+        url = server.url + f"/session/{sid}/act"
+        status, s1, a1 = _act_binary(url, obs[0], seq=1)
+        assert status == 200 and s1["session_steps"] == 1
+        # replayed seq: same action back, carry NOT advanced
+        status, s2, a2 = _act_binary(url, obs[0], seq=1)
+        assert status == 200
+        assert s2.get("deduped") is True
+        assert s2["session_steps"] == 1
+        np.testing.assert_array_equal(a1["action"], a2["action"])
+        status, s3, _a3 = _act_binary(url, obs[1], seq=2)
+        assert status == 200 and s3["session_steps"] == 2
+    finally:
+        server.close()
+        for c in closers:
+            c.close()
+
+
+@pytest.mark.slow
+def test_binary_uds_failover_resumes_from_journal_bit_exact(
+    rec, tmp_path
+):
+    """The ISSUE 14/15 lossless-failover contract re-pinned over the
+    ISSUE 16 data plane: every client act rides the binary codec, every
+    router→replica hop rides AF_UNIX, and a pinned-replica kill still
+    resumes the session from the journal bit-exact (the resumed/
+    resumed_steps decoration restamped INTO the binary response)."""
+    agent, state = rec
+    jdir = str(tmp_path / "carry")
+    udsdir = _uds_dir()
+    rs = _replicaset(
+        lambda rid: _rec_factory(
+            agent, state, journal_dir=jdir, replica_name=rid,
+            uds_path=os.path.join(udsdir, f"{rid}.sock"),
+        ),
+        2,
+    )
+    router = Router(rs, port=0, journal_dir=jdir)
+    try:
+        status, out = _post_json(router.url + "/session")
+        assert status == 200
+        sid, pinned = out["session"], out["replica"]
+        url = router.url + f"/session/{sid}/act"
+        obs = _obs_seq(agent, 8)
+        direct = _direct_actions(agent, state, obs)
+        for t in range(5):
+            status, scalars, arrays = _act_binary(url, obs[t])
+            assert status == 200, scalars
+            np.testing.assert_array_equal(
+                np.asarray(arrays["action"], np.float64), direct[t]
+            )
+        rs.replicas[pinned].handle.server.sessions.journal.drain()
+        rs.replicas[pinned].handle.kill()
+        status, scalars, arrays = _act_binary(url, obs[5])
+        assert status == 200, scalars
+        assert scalars.get("resumed") is True
+        assert scalars.get("resumed_steps") == 5
+        assert scalars["session_steps"] == 6
+        np.testing.assert_array_equal(
+            np.asarray(arrays["action"], np.float64), direct[5],
+            err_msg="binary resumed act diverged from the "
+            "uninterrupted session",
+        )
+        assert router.sessions_resumed_total == 1
+        assert router.sessions_reestablished_total == 0
+        for t in (6, 7):
+            status, scalars, arrays = _act_binary(url, obs[t])
+            assert status == 200 and "resumed" not in scalars
+            np.testing.assert_array_equal(
+                np.asarray(arrays["action"], np.float64), direct[t]
+            )
+        with router._lock:
+            transports = dict(router.dispatch_transport_total)
+        assert transports["uds"] > 0, transports
+    finally:
+        router.close()
+        rs.close()

@@ -580,6 +580,50 @@ def trace_breakdown(spans: list) -> Optional[dict]:
     }
 
 
+def _summarize_hops(spans: list) -> dict:
+    """Dispatch-hop stats grouped by wire format (ISSUE 16): every
+    ``router.dispatch``/``router.retry`` span carries ``codec``
+    (json|binary) and ``transport`` (tcp|uds) attrs, so the per-group
+    hop p99 — and the structural network share (hop minus the remote
+    handler nested under it) — is exactly the before/after evidence the
+    data-plane bench quotes. Keyed ``codec/transport``; spans from old
+    logs without the attrs group under ``json/tcp`` (the only path that
+    existed before the attrs did)."""
+    by_parent: dict = {}
+    for s in spans:
+        p = s.get("parent")
+        if p is not None:
+            by_parent.setdefault(p, []).append(s)
+    groups: dict = {}
+    for s in spans:
+        if s.get("name") not in _HOP_NAMES:
+            continue
+        key = (
+            f"{s.get('codec') or 'json'}/{s.get('transport') or 'tcp'}"
+        )
+        handler = sum(
+            _span_dur(c)
+            for c in by_parent.get(s.get("span"), [])
+            if c.get("remote")
+        )
+        dur = _span_dur(s)
+        groups.setdefault(key, []).append(
+            (dur, max(0.0, dur - handler))
+        )
+    out = {}
+    for key in sorted(groups):
+        hops = [h for h, _ in groups[key]]
+        net = [n for _, n in groups[key]]
+        out[key] = {
+            "hops": len(hops),
+            "hop_p50_ms": _quantile(hops, 0.5),
+            "hop_p99_ms": _quantile(hops, 0.99),
+            "network_p50_ms": _quantile(net, 0.5),
+            "network_p99_ms": _quantile(net, 0.99),
+        }
+    return out
+
+
 def _summarize_traces(records: list) -> Optional[dict]:
     """The per-run trace block: trace/span counts, root-duration
     quantiles, per-stage p50/p99 + mean share of the root, and the
@@ -593,9 +637,13 @@ def _summarize_traces(records: list) -> Optional[dict]:
         if b is not None
     ]
     spans_total = sum(len(s) for s in traces.values())
+    wire = _summarize_hops(
+        [s for spans in traces.values() for s in spans]
+    )
     if not rows:
         return {"count": len(traces), "spans": spans_total,
-                "assembled": 0, "stages": {}, "slowest": []}
+                "assembled": 0, "stages": {}, "wire": wire,
+                "slowest": []}
     roots = [r["root_ms"] for r in rows]
     root_mean = _mean(roots)
     stage_stats: dict = {}
@@ -626,6 +674,7 @@ def _summarize_traces(records: list) -> Optional[dict]:
         "root_p50_ms": _quantile(roots, 0.5),
         "root_p99_ms": _quantile(roots, 0.99),
         "stages": stage_stats,
+        "wire": wire,
         "slowest": [
             {
                 "trace": r["trace"],
@@ -1201,6 +1250,20 @@ def compare_runs(
                     threshold_pct, "time",
                 )
             )
+        # per-wire-format hop rows (ISSUE 16): a codec/transport group
+        # whose network p99 grew is a located data-plane regression —
+        # same union-not-intersection policy as the stage rows
+        b_w = b_tr.get("wire") or {}
+        n_w = n_tr.get("wire") or {}
+        for key in sorted(set(b_w) | set(n_w)):
+            verdicts.append(
+                _verdict(
+                    f"trace/wire_{key}_network_p99_ms",
+                    (b_w.get(key) or {}).get("network_p99_ms"),
+                    (n_w.get(key) or {}).get("network_p99_ms"),
+                    threshold_pct, "time",
+                )
+            )
 
     # solver-precision counters (ISSUE 8) — only when at least one run
     # carried the ladder. `fallbacks` is judged as a strict counter: ANY
@@ -1510,6 +1573,25 @@ def render_summary(summary: dict) -> str:
                     for stage, row in stages.items()
                 ],
                 ["stage", "traces", "p50_ms", "p99_ms", "share"],
+            ))
+        wire = tr.get("wire") or {}
+        if wire:
+            out.append(format_table(
+                [
+                    [
+                        key,
+                        row.get("hops"),
+                        _fmt(row.get("hop_p50_ms")),
+                        _fmt(row.get("hop_p99_ms")),
+                        _fmt(row.get("network_p50_ms")),
+                        _fmt(row.get("network_p99_ms")),
+                    ]
+                    for key, row in wire.items()
+                ],
+                [
+                    "wire", "hops", "hop_p50", "hop_p99",
+                    "net_p50", "net_p99",
+                ],
             ))
         slowest = tr.get("slowest") or []
         if slowest:

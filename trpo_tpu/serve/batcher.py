@@ -68,6 +68,8 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as _FutureTimeoutError
 from typing import Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["MicroBatcher", "SessionBatcher"]
@@ -415,9 +417,17 @@ class SessionBatcher(_DeadlineBatcher):
     is defense in depth for direct users of this class.
     """
 
-    def __init__(self, engine, deadline_ms: float = 3.0, **kw):
+    def __init__(self, engine, deadline_ms: float = 3.0,
+                 device_carries: bool = True, **kw):
         kw.setdefault("thread_name", "serve-session-batcher")
         super().__init__(engine, deadline_ms=deadline_ms, **kw)
+        # device-resident carries (ISSUE 16): with this on (the
+        # default), epochs stack carries with jnp — after the first
+        # epoch every live session's carry is a device row slice and
+        # the act path never round-trips carry bytes through the host
+        # (the journal's writer thread pays the transfer, at sync
+        # cadence). Off = the PR 13 host path, byte-identical.
+        self.device_carries = bool(device_carries)
         # epoch-shape observability (the ISSUE 13 /metrics satellite):
         # updated under _cond with the other counters
         self.epoch_width_last = 0
@@ -454,7 +464,14 @@ class SessionBatcher(_DeadlineBatcher):
         the SHARED ``engine.step_batch`` span into it (ISSUE 15)."""
         if not isinstance(sid, str) or not sid:
             raise ValueError(f"sid must be a non-empty string, got {sid!r}")
-        carry = np.asarray(carry, np.float32)
+        if isinstance(carry, jax.Array):
+            # device-resident carry (ISSUE 16): validate by metadata —
+            # np.asarray here would round-trip every act's carry
+            # through the host, which is the cost this path removes
+            if carry.dtype != jnp.float32:
+                carry = carry.astype(jnp.float32)
+        else:
+            carry = np.asarray(carry, np.float32)
         if carry.shape != (self.engine.state_size,):
             raise ValueError(
                 f"carry must have shape ({self.engine.state_size},), "
@@ -493,7 +510,20 @@ class SessionBatcher(_DeadlineBatcher):
         return batch
 
     def _dispatch(self, batch, depth_after: int) -> None:
-        carries = np.stack([p.carry for p in batch], axis=0)
+        # device path (ISSUE 16): once ANY session's carry lives on
+        # device, stack the epoch's carries there (jnp.stack uploads
+        # the stragglers — fresh sessions, journal resumes — and the
+        # epoch's new carries come back as device slices, so the
+        # steady state never round-trips a carry through the host)
+        if self.device_carries or any(
+            isinstance(p.carry, jax.Array) for p in batch
+        ):
+            carries = jnp.stack(
+                [jnp.asarray(p.carry, jnp.float32) for p in batch],
+                axis=0,
+            )
+        else:
+            carries = np.stack([p.carry for p in batch], axis=0)
         obs = np.stack([p.obs for p in batch], axis=0)
         rung = self.engine.padded_shape(len(batch))
         t_infer = time.perf_counter()
@@ -511,11 +541,16 @@ class SessionBatcher(_DeadlineBatcher):
         self._trace_epoch(
             batch, "engine.step_batch", rung, t_infer, wall_infer, done
         )
+        carries_on_device = isinstance(new_carries, jax.Array)
         for i, p in enumerate(batch):
             p.future.set_result(
                 (
                     np.asarray(actions[i]),
-                    np.asarray(new_carries[i], np.float32),
+                    # a device-resident epoch hands back device-row
+                    # slices; the host path is byte-identical to before
+                    new_carries[i]
+                    if carries_on_device
+                    else np.asarray(new_carries[i], np.float32),
                     step,
                 )
             )

@@ -133,6 +133,9 @@ class InProcessReplica:
         self._factory = factory
         self.server, self._closers = factory()
         self.url = self.server.url
+        # same-host data plane (ISSUE 16): advertise the replica's Unix
+        # socket so the router's _dial_plan can skip TCP entirely
+        self.uds_path = getattr(self.server, "uds_path", None)
         self._killed = False
 
     def alive(self) -> bool:
@@ -214,6 +217,7 @@ class SubprocessReplica:
             stderr=subprocess.STDOUT,
         )
         self.url: Optional[str] = None
+        self.uds_path: Optional[str] = None
 
     @classmethod
     def _build_command(
@@ -247,6 +251,10 @@ class SubprocessReplica:
         desc = read_descriptor(self.descriptor_path)
         if desc and desc.get("url"):
             self.url = desc["url"]
+            # the child advertises its Unix socket (if it bound one) in
+            # the same atomically-written descriptor, so the parent
+            # never sees a URL without its UDS sibling
+            self.uds_path = desc.get("uds_path")
         return self.url
 
     def alive(self) -> bool:
@@ -279,6 +287,7 @@ class ReplicaRecord:
         self.id = replica_id
         self.handle = None
         self.url: Optional[str] = None
+        self.uds_path: Optional[str] = None  # same-host UDS (ISSUE 16)
         self.state = "starting"
         self.inflight = 0
         self.restarts = 0          # relaunches consumed (crash budget)
@@ -454,6 +463,7 @@ class ReplicaSet:
         rec.host = self.transport.place(avoid=self.suspect_hosts())
         rec.handle = self.transport.launch(rec.host, rec.id)
         rec.url = getattr(rec.handle, "url", None)
+        rec.uds_path = getattr(rec.handle, "uds_path", None)
         # _emit stamps rec.host on every multi-host lifecycle record
         self._emit(rec.id, "started", attempt=rec.restarts + 1)
 
@@ -676,6 +686,7 @@ class ReplicaSet:
                 if url is not None:
                     with self.lock:
                         rec.url = url
+                        rec.uds_path = getattr(handle, "uds_path", None)
                 elif (
                     not handle.alive()
                     or now - rec.started_at > self.start_timeout
@@ -792,6 +803,7 @@ class ReplicaSet:
             rec.restarts += 1
             rec.state = "starting"
             rec.url = None
+            rec.uds_path = None
             rec.lease_expires = None
         self._emit(rec.id, "restarted", attempt=rec.restarts + 1)
         try:
@@ -826,6 +838,7 @@ class ReplicaSet:
             rec.handle = handle
             rec.host = host
             rec.url = getattr(handle, "url", None)
+            rec.uds_path = getattr(handle, "uds_path", None)
             rec.health_fails = 0
             rec.started_at = time.monotonic()
 

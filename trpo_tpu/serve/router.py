@@ -97,10 +97,30 @@ the trace that resumed around it), and every request while a chaos
 injector is armed. Sampled request events carry their ``trace`` id,
 which is the join key ``validate_events.py``'s trace contracts and
 ``analyze_run.py --trace`` use.
+
+**Native-speed data plane** (ISSUE 16) — the hot path no longer costs
+a thread per request. With ``core="async"`` (the default) the router
+front end is one event loop (:class:`~trpo_tpu.utils.httpd.
+AsyncBackgroundServer`): ``/act`` and ``/session/<id>/act`` are
+coroutines, replica connections live in LOOP-OWNED keep-alive pools
+(one pool for the whole router — not one socket per handler thread),
+and same-host replica hops dial the replica's AF_UNIX socket
+(``rec.uds_path``, advertised through the descriptor/handle) while
+cross-host hops stay TCP. Request/response payloads are negotiated
+per-connection between JSON (the default and compat fallback) and the
+binary wire codec (``serve/wire.py`` — the router restamps ``seq``
+into a binary frame without decoding the obs). Every control-plane
+contract above is unchanged and runs through the SAME sync code:
+anomaly paths (journal failover, takeover/fence, drain migration) and
+control routes execute on the server's executor; dispatch spans gain
+``codec=``/``transport=`` attrs so the per-stage trace rows can
+locate what the new plane bought. ``core="thread"`` keeps the
+PR 10-era thread-per-request front end as the measured baseline.
 """
 
 from __future__ import annotations
 
+import asyncio
 import http.client
 import json
 import socket
@@ -109,6 +129,8 @@ import time
 import urllib.parse
 from collections import deque
 from typing import Dict, Optional, Tuple
+
+from trpo_tpu.serve import wire as _wire
 
 # ONE escaping/formatting implementation for all endpoints (the PR 7
 # review contract): obs/server.py owns it
@@ -189,7 +211,13 @@ class Router:
         retry_budget: float = 8.0,
         retry_refill_per_sec: float = 4.0,
         tracer: Optional[Tracer] = None,
+        core: str = "async",
+        uds_path: Optional[str] = None,
     ):
+        if core not in ("async", "thread"):
+            raise ValueError(
+                f"core must be 'async' or 'thread', got {core!r}"
+            )
         if max_inflight < 1:
             raise ValueError(
                 f"max_inflight must be >= 1, got {max_inflight}"
@@ -289,30 +317,64 @@ class Router:
         self._canary_clock = 0.0  # deterministic fraction accumulator
         self._chaos_requests = 0
         self._tls = threading.local()  # per-thread replica conn pool
+        #                                (core="thread" + executor paths)
+        self.core = core
+        # data-plane observability (ISSUE 16): what each dispatch rode
+        self.dispatch_transport_total = {"tcp": 0, "uds": 0}
+        self.wire_frames_total = {"json": 0, "binary": 0}
+        self.wire_decode_errors_total = 0
+        # the async core's loop-owned replica connection pools:
+        # key (replica_id, ("tcp", netloc) | ("uds", path)) -> list of
+        # idle (reader, writer) pairs. Touched ONLY on the loop.
+        self._apool: Dict[tuple, list] = {}
 
-        from trpo_tpu.utils.httpd import BackgroundHTTPServer
-
-        self._httpd = BackgroundHTTPServer(
-            port,
-            host=host,
-            get={
-                "/healthz": self._healthz,
-                "/status": self._status,
-                "/metrics": self._metrics,
-            },
-            post={
-                "/act": self._act,
-                "/session": self._session_create,
-            },
-            post_prefix={"/session/": self._session_act},
-            not_found=(
-                "have POST /act, POST /session, POST /session/<id>/act, "
-                "GET /healthz, GET /status, GET /metrics"
-            ),
-            thread_name="router-http",
+        not_found = (
+            "have POST /act, POST /session, POST /session/<id>/act, "
+            "GET /healthz, GET /status, GET /metrics"
         )
+        if core == "async":
+            from trpo_tpu.utils.httpd import AsyncBackgroundServer
+
+            self._httpd = AsyncBackgroundServer(
+                port,
+                host=host,
+                get={
+                    "/healthz": self._healthz,
+                    "/status": self._status,
+                    "/metrics": self._metrics,
+                },
+                # session create is control-plane-rare: it keeps the
+                # battle-tested sync path (executor)
+                post={"/session": self._session_create},
+                async_post={"/act": self._act_async},
+                async_post_prefix={"/session/": self._session_act_async},
+                not_found=not_found,
+                thread_name="router-http",
+                uds_path=uds_path,
+            )
+        else:
+            from trpo_tpu.utils.httpd import BackgroundHTTPServer
+
+            self._httpd = BackgroundHTTPServer(
+                port,
+                host=host,
+                get={
+                    "/healthz": self._healthz,
+                    "/status": self._status,
+                    "/metrics": self._metrics,
+                },
+                post={
+                    "/act": self._act,
+                    "/session": self._session_create,
+                },
+                post_prefix={"/session/": self._session_act},
+                not_found=not_found,
+                thread_name="router-http",
+                uds_path=uds_path,
+            )
         self.host = host
         self.port = self._httpd.port
+        self.uds_path = getattr(self._httpd, "uds_path", None)
 
     @property
     def url(self) -> str:
@@ -444,13 +506,17 @@ class Router:
     def _forward(
         self, replica_id: str, path: str, body: bytes,
         trace_headers: Optional[dict] = None, span=None,
-    ) -> Tuple[int, bytes]:
-        """POST ``body`` to the replica; returns ``(status, body)`` for
-        HTTP-level answers (including error statuses) and raises OSError
-        subclasses for transport-level failures. ``trace_headers``
-        (ISSUE 15) ride the hop so the replica joins the trace;
-        ``span`` is the hop's dispatch span — injected transport
-        latency is attributed to it (``gate_ms``)."""
+        fwd_headers: Optional[dict] = None,
+    ):
+        """POST ``body`` to the replica; returns ``(status, body,
+        ctype)`` for HTTP-level answers (including error statuses) and
+        raises OSError subclasses for transport-level failures.
+        ``trace_headers`` (ISSUE 15) ride the hop so the replica joins
+        the trace; ``span`` is the hop's dispatch span — injected
+        transport latency is attributed to it (``gate_ms``).
+        ``fwd_headers`` (ISSUE 16) carries the client's negotiated
+        ``Content-Type``/``Accept`` so a binary frame stays binary
+        across the hop (absent = the JSON default)."""
         rec = self.replicaset.get(replica_id)
         url = rec.url if rec is not None else None
         if url is None:
@@ -466,8 +532,12 @@ class Router:
         netloc = urllib.parse.urlsplit(url).netloc
         key, conn = self._conn(replica_id, netloc)
         headers = {"Content-Type": _JSON}
+        if fwd_headers:
+            headers.update(fwd_headers)
         if trace_headers:
             headers.update(trace_headers)
+        with self._lock:
+            self.dispatch_transport_total["tcp"] += 1
         try:
             conn.request(
                 "POST", path, body=body,
@@ -475,7 +545,8 @@ class Router:
             )
             resp = conn.getresponse()
             payload = resp.read()
-            return resp.status, payload
+            ctype = resp.getheader("Content-Type") or _JSON
+            return resp.status, payload, ctype
         except Exception:
             # transport failure OR a stale pooled connection: drop it so
             # the retry (and every later request) dials fresh
@@ -485,6 +556,428 @@ class Router:
             except Exception:
                 pass
             raise
+
+    # -- async dispatch core (ISSUE 16) ------------------------------------
+    #
+    # The hot path with core="async": one event loop owns every replica
+    # connection (keep-alive pools keyed by (replica, address)), replica
+    # hops are coroutines, and same-host replicas are dialed over their
+    # AF_UNIX socket. All CONTROL-plane logic — _pick/_release, retry
+    # budget, admission, affinity bookkeeping, journal failover — is the
+    # exact same sync code the thread core runs (cheap lock-and-go
+    # operations are fine on the loop; the blocking failover tail runs
+    # on the server's executor).
+
+    def _dial_plan(self, rec) -> Tuple[str, str]:
+        """``("uds", path)`` or ``("tcp", netloc)`` for one replica hop.
+        UDS only when the replica advertises a socket path AND lives on
+        this host (no transport model, or the model says local) —
+        cross-host hops stay TCP so the partition/latency gates keep
+        meaning what they meant."""
+        uds = getattr(rec, "uds_path", None)
+        if uds and (
+            self.transport is None
+            or self.transport.same_host(getattr(rec, "host", "local"))
+        ):
+            return "uds", uds
+        return "tcp", urllib.parse.urlsplit(rec.url).netloc
+
+    # loop-owned pool helpers: touched ONLY from the loop thread, so no
+    # lock — the loop IS the serialization
+
+    def _apool_take(self, key):
+        idle = self._apool.get(key)
+        if idle:
+            return idle.pop()
+        # a restarted replica has a NEW address: drop its stale idle
+        # conns, or fds to dead addresses accumulate one per restart
+        rid = key[0]
+        for old in [k for k in self._apool if k[0] == rid and k != key]:
+            for pair in self._apool.pop(old):
+                self._aclose_pair(pair)
+        return None
+
+    def _apool_put(self, key, pair) -> None:
+        self._apool.setdefault(key, []).append(pair)
+
+    def _apool_close_all(self) -> None:
+        for idle in self._apool.values():
+            for pair in idle:
+                self._aclose_pair(pair)
+        self._apool.clear()
+
+    @staticmethod
+    def _aclose_pair(pair) -> None:
+        try:
+            pair[1].close()
+        except Exception:
+            pass
+
+    async def _adial(self, kind: str, addr: str):
+        if kind == "uds":
+            return await asyncio.open_unix_connection(addr)
+        host, _, port = addr.rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            # outgoing TCP_NODELAY, same rationale as _conn
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return reader, writer
+
+    async def _aexchange(self, reader, writer, path: str, body: bytes,
+                         headers: dict):
+        """One HTTP/1.1 POST over an open stream pair. Returns
+        ``(status, payload, ctype, keep)`` — ``keep`` False when the
+        peer asked to close."""
+        req = [f"POST {path} HTTP/1.1", "Host: local",
+               f"Content-Length: {len(body)}"]
+        req.extend(f"{k}: {v}" for k, v in headers.items())
+        req.append("\r\n")
+        writer.write("\r\n".join(req).encode("latin-1") + body)
+        await writer.drain()
+        line = await reader.readline()
+        if not line:
+            raise ConnectionError("connection closed before response")
+        status = int(line.split(None, 2)[1])
+        resp_headers = {}
+        while True:
+            hline = await reader.readline()
+            if hline in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = hline.decode("latin-1").partition(":")
+            resp_headers[name.strip().lower()] = value.strip()
+        n = int(resp_headers.get("content-length") or 0)
+        payload = await reader.readexactly(n) if n else b""
+        keep = (
+            resp_headers.get("connection", "").lower() != "close"
+        )
+        ctype = resp_headers.get("content-type") or _JSON
+        return status, payload, ctype, keep
+
+    async def _aforward(self, replica_id: str, path: str, body: bytes,
+                        trace_headers: Optional[dict] = None, span=None,
+                        fwd_headers: Optional[dict] = None):
+        """The async mirror of :meth:`_forward`: same gate semantics
+        (injected latency becomes ``asyncio.sleep``, a partition raises
+        before any I/O), same header layering, plus the UDS-vs-TCP dial
+        plan. A conn taken from the pool that fails is redialed ONCE
+        transparently (the replica closed its keep-alive side between
+        requests — /act is pure and session acts are seq-deduped, so
+        the replay is safe and a benign stale socket never turns into a
+        spurious ``report_failure`` eviction); a fresh socket's failure
+        is a real transport failure and raises."""
+        rec = self.replicaset.get(replica_id)
+        url = rec.url if rec is not None else None
+        if url is None:
+            raise ConnectionError(f"replica {replica_id} has no URL")
+        if self.transport is not None:
+            gate_ms = self.transport.gate_delay(
+                getattr(rec, "host", "local")
+            )
+            if gate_ms:
+                if span is not None:
+                    span.attrs["gate_ms"] = gate_ms
+                await asyncio.sleep(gate_ms / 1e3)
+        kind, addr = self._dial_plan(rec)
+        if span is not None:
+            span.attrs["transport"] = kind
+        headers = {"Content-Type": _JSON}
+        if fwd_headers:
+            headers.update(fwd_headers)
+        if trace_headers:
+            headers.update(trace_headers)
+        key = (replica_id, (kind, addr))
+        pair = self._apool_take(key)
+        pooled = pair is not None
+        try:
+            if pair is None:
+                pair = await asyncio.wait_for(
+                    self._adial(kind, addr), self.act_timeout_s
+                )
+            out = await asyncio.wait_for(
+                self._aexchange(pair[0], pair[1], path, body, headers),
+                self.act_timeout_s,
+            )
+        except Exception:
+            if pair is not None:
+                self._aclose_pair(pair)
+            if not pooled:
+                raise
+            pair = None
+            try:
+                pair = await asyncio.wait_for(
+                    self._adial(kind, addr), self.act_timeout_s
+                )
+                out = await asyncio.wait_for(
+                    self._aexchange(
+                        pair[0], pair[1], path, body, headers
+                    ),
+                    self.act_timeout_s,
+                )
+            except Exception:
+                if pair is not None:
+                    self._aclose_pair(pair)
+                raise
+        status, payload, ctype, keep = out
+        if keep:
+            self._apool_put(key, pair)
+        else:
+            self._aclose_pair(pair)
+        with self._lock:
+            self.dispatch_transport_total[kind] += 1
+        return status, payload, ctype
+
+    async def _adispatch(self, path: str, body: bytes, endpoint: str,
+                         pinned: Optional[str] = None,
+                         stateless: bool = True,
+                         ctx=None, parent=None,
+                         fwd_headers: Optional[dict] = None):
+        """:meth:`_dispatch`, line for line, on the loop — every
+        decision (pin handling, pick, retry budget, 5xx hold,
+        accounting, emit) is the same sync code; only the forward
+        awaits. ``report_failure`` runs on the executor — with leases
+        off it tears down and relaunches the replica, which must not
+        stall the loop."""
+        loop = asyncio.get_running_loop()
+        t0 = time.perf_counter()
+        retried = False
+        tried = []
+        lost_rid = None
+        first_5xx = None
+        codec = (
+            "binary" if _wire.is_binary_body(fwd_headers) else "json"
+        )
+        for attempt in (0, 1):
+            if pinned is not None and attempt == 0:
+                rid = pinned
+                rec = self.replicaset.get(rid)
+                with self.replicaset.lock:
+                    pinned_ok = (
+                        rec is not None
+                        and rec.state in (
+                            "healthy", "reloading", "draining",
+                        )
+                    )
+                    if pinned_ok:
+                        rec.inflight += 1
+                if not pinned_ok:
+                    return None, None, retried
+            else:
+                rid = self._pick(exclude=tried, stateless=stateless)
+                if rid is None:
+                    break
+                if lost_rid is not None or first_5xx is not None:
+                    if not self._take_retry_token():
+                        self._release(rid)
+                        break
+                    with self._lock:
+                        self.retried_total += 1
+                    retried = True
+            tried.append(rid)
+            hop = None
+            if ctx is not None:
+                if retried:
+                    ctx.force()
+                hop = ctx.span(
+                    "router.retry" if retried else "router.dispatch",
+                    parent_id=(
+                        parent.span_id if parent is not None else None
+                    ),
+                    replica=rid,
+                    host=self._host_of(rid),
+                    endpoint=endpoint,
+                    codec=codec,
+                    transport="tcp",  # _aforward overwrites per dial
+                )
+            try:
+                status, payload, resp_ctype = await self._aforward(
+                    rid, path, body,
+                    trace_headers=(
+                        Tracer.headers_for(ctx, hop)
+                        if ctx is not None else None
+                    ),
+                    span=hop,
+                    fwd_headers=fwd_headers,
+                )
+            except Exception:
+                if hop is not None:
+                    ctx.force()
+                    hop.end(error="transport")
+                self._release(rid)
+                await loop.run_in_executor(
+                    self._httpd._executor,
+                    self.replicaset.report_failure, rid,
+                )
+                lost_rid = rid
+                if attempt == 0 and pinned is None:
+                    continue
+                break
+            if hop is not None:
+                hop.end(status=status)
+            if (
+                status >= 500
+                and attempt == 0
+                and pinned is None
+                and lost_rid is None
+            ):
+                if ctx is not None:
+                    ctx.force()
+                self._release(rid)
+                first_5xx = ((status, resp_ctype, payload), rid)
+                continue
+            self._release(rid)
+            ms = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                self.routed_total += 1
+            with self._lat_lock:
+                self._latencies_ms.append(ms)
+                self._fresh_lats.append(ms)
+                self._adm_lats.append((time.monotonic(), ms))
+                win = self._replica_lats.get(rid)
+                if win is None:
+                    win = self._replica_lats[rid] = deque(maxlen=512)
+                win.append(ms)
+            self._emit_request(ms, True, retried, rid, endpoint, ctx=ctx)
+            return (status, resp_ctype, payload), rid, retried
+        if first_5xx is not None:
+            (status, ctype, payload), rid = first_5xx
+            ms = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                self.routed_total += 1
+            with self._lat_lock:
+                self._latencies_ms.append(ms)
+                self._fresh_lats.append(ms)
+                self._adm_lats.append((time.monotonic(), ms))
+            self._emit_request(ms, True, retried, rid, endpoint, ctx=ctx)
+            return (status, ctype, payload), rid, retried
+        return None, lost_rid, retried
+
+    async def _act_async(self, path: str, body: bytes, headers):
+        ctx, root = self._trace_edge("router.act", headers)
+        out = None
+        try:
+            out = await self._act_async_inner(body, headers, ctx, root)
+            return out
+        finally:
+            self._trace_done(
+                ctx, root, status=out[0] if out is not None else 500
+            )
+
+    async def _act_async_inner(self, body: bytes, headers, ctx, root):
+        fwd = self._codec_headers(headers)
+        self._count_codec(fwd)
+        if self.injector is not None:
+            # chaos hooks kill replicas and replay storms — executor
+            await asyncio.get_running_loop().run_in_executor(
+                self._httpd._executor, self._chaos_tick, "/act", body
+            )
+        shed = self._admission_check(body, ctx=ctx, headers=headers)
+        if shed is not None:
+            return shed
+        if not _wire.is_binary_body(headers):
+            self._recent_obs.append(body)
+        result, rid, retried = await self._adispatch(
+            body=body, path="/act", endpoint="act",
+            ctx=ctx, parent=root, fwd_headers=fwd,
+        )
+        if result is not None:
+            return result
+        return self._unrouted(rid, retried, "act", stateless=True,
+                              ctx=ctx)
+
+    async def _session_act_async(self, path: str, body: bytes, headers):
+        ctx, root = self._trace_edge("router.session_act", headers)
+        out = None
+        try:
+            out = await self._session_act_async_inner(
+                path, body, headers, ctx, root
+            )
+            return out
+        finally:
+            self._trace_done(
+                ctx, root, status=out[0] if out is not None else 500
+            )
+
+    async def _session_act_async_inner(self, path: str, body: bytes,
+                                       headers, ctx, root):
+        fwd = self._codec_headers(headers)
+        self._count_codec(fwd)
+        if self.injector is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                self._httpd._executor, self._chaos_tick, path, body
+            )
+        parts = path.strip("/").split("/")
+        if len(parts) != 3 or parts[0] != "session" or parts[2] != "act":
+            return 404, _JSON, _body(
+                {"error": "unknown session path; have POST "
+                          "/session/<id>/act"}
+            )
+        sid = parts[1]
+        while True:
+            with self._lock:
+                aff = self._affinity.get(sid)
+            if aff is None:
+                return 404, _JSON, _body(
+                    {
+                        "error": (
+                            f"unknown session {sid!r} — mint one with "
+                            "POST /session"
+                        ),
+                        "code": "session_unknown",
+                    }
+                )
+            # the affinity lock is a THREADING lock shared with the
+            # sync drain/migration machinery. Acquire it by polling,
+            # never by parking an executor worker: the failover tail
+            # needs those workers, and eight blocked acquires would
+            # deadlock the executor against the lock holder's own
+            # finish task.
+            while not aff.lock.acquire(blocking=False):
+                await asyncio.sleep(0.001)
+            try:
+                with self._lock:
+                    if self._affinity.get(sid) is not aff:
+                        continue  # replaced/removed while we waited
+                return await self._session_act_pinned_async(
+                    sid, aff, body, ctx, root, fwd
+                )
+            finally:
+                aff.lock.release()
+
+    async def _session_act_pinned_async(self, sid: str, aff,
+                                        body: bytes, ctx, root, fwd):
+        body = self._stamp_seq(aff, body, fwd)
+        result, rid, retried = await self._adispatch(
+            body=body, path=f"/session/{sid}/act",
+            endpoint="session_act", pinned=aff.replica,
+            ctx=ctx, parent=root, fwd_headers=fwd,
+        )
+        # fast path: a clean non-404 answer with no pending drain
+        # notification needs none of the journal/failover/decoration
+        # tail — stay on the loop
+        if (
+            result is not None
+            and result[0] != 404
+            and not (
+                result[0] == 200
+                and aff.pending_resumed_steps is not None
+            )
+        ):
+            aff.last_used = time.monotonic()
+            if result[0] == 200:
+                with self._lock:
+                    aff.acts += 1
+            return result
+        # the anomaly tail (journal lookup, takeover/fence, sync
+        # re-dispatch) blocks — run the shared sync implementation on
+        # the server's executor, aff.lock still held by this coroutine
+        return await asyncio.get_running_loop().run_in_executor(
+            self._httpd._executor,
+            lambda: self._session_act_finish(
+                sid, aff, body, result, rid, retried,
+                ctx=ctx, root=root, fwd_headers=fwd,
+            ),
+        )
 
     def _emit_request(
         self, ms: float, ok: bool, retried: bool,
@@ -510,22 +1003,42 @@ class Router:
 
     # -- request tracing (ISSUE 15) ----------------------------------------
 
-    def _trace_edge(self, name: str):
+    def _trace_edge(self, name: str, headers=None):
         """Open one request's trace at the router's public edge:
         accept the client's ``X-Trace-Id`` (validated) or mint one,
         head-sample, and start the root span. With a chaos injector
         armed the trace is FORCED — every chaos-fired request has a
-        trace. ``(None, None)`` when the layer is off."""
+        trace. ``(None, None)`` when the layer is off. ``headers`` is
+        the request's header mapping when the caller already holds it
+        (the async core); sync handlers fall back to the thread-local."""
         if self.tracer is None:
             return None, None
-        from trpo_tpu.utils.httpd import request_headers
+        if headers is None:
+            from trpo_tpu.utils.httpd import request_headers
 
-        headers = request_headers()
+            headers = request_headers()
         tid = headers.get(TRACE_HEADER) if headers is not None else None
         ctx = self.tracer.begin(trace_id=tid)
         if self.injector is not None:
             ctx.force()
         return ctx, ctx.span(name)
+
+    @staticmethod
+    def _codec_headers(headers) -> Optional[dict]:
+        """The client's payload-negotiation headers, reduced to what
+        must ride the replica hop (ISSUE 16): ``Content-Type`` when the
+        body is a binary frame, ``Accept`` when the client declared a
+        response format. None = pure-JSON default (the pre-wire hop,
+        byte-identical headers)."""
+        if headers is None:
+            return None
+        fwd = {}
+        if _wire.is_binary_body(headers):
+            fwd["Content-Type"] = _wire.WIRE_CONTENT_TYPE
+        accept = headers.get("Accept")
+        if accept is not None:
+            fwd["Accept"] = accept
+        return fwd or None
 
     def _trace_done(self, ctx, root, status=None) -> None:
         """Close the root span and hand the buffered spans to the
@@ -562,7 +1075,8 @@ class Router:
 
     def _dispatch(self, path: str, body: bytes, endpoint: str,
                   pinned: Optional[str] = None, stateless: bool = True,
-                  ctx=None, parent=None):
+                  ctx=None, parent=None,
+                  fwd_headers: Optional[dict] = None):
         """The routed request core: pick (or follow the pin), forward,
         retry ONCE on transport failure, account, emit. Returns the
         upstream ``(status, ctype, body)`` plus the replica that finally
@@ -580,6 +1094,9 @@ class Router:
         tried = []
         lost_rid = None  # a replica we reached and lost mid-request
         first_5xx = None  # a server-side error answer held as fallback
+        codec = (
+            "binary" if _wire.is_binary_body(fwd_headers) else "json"
+        )
         for attempt in (0, 1):
             if pinned is not None and attempt == 0:
                 rid = pinned
@@ -636,15 +1153,18 @@ class Router:
                     replica=rid,
                     host=self._host_of(rid),
                     endpoint=endpoint,
+                    codec=codec,
+                    transport="tcp",
                 )
             try:
-                status, payload = self._forward(
+                status, payload, resp_ctype = self._forward(
                     rid, path, body,
                     trace_headers=(
                         Tracer.headers_for(ctx, hop)
                         if ctx is not None else None
                     ),
                     span=hop,
+                    fwd_headers=fwd_headers,
                 )
             except Exception:
                 # transport failure: the replica died under us — tell
@@ -677,7 +1197,7 @@ class Router:
                 if ctx is not None:
                     ctx.force()  # a 5xx-and-retry is an anomaly
                 self._release(rid)
-                first_5xx = ((status, _JSON, payload), rid)
+                first_5xx = ((status, resp_ctype, payload), rid)
                 continue
             self._release(rid)
             ms = (time.perf_counter() - t0) * 1e3
@@ -692,7 +1212,7 @@ class Router:
                     win = self._replica_lats[rid] = deque(maxlen=512)
                 win.append(ms)
             self._emit_request(ms, True, retried, rid, endpoint, ctx=ctx)
-            return (status, _JSON, payload), rid, retried
+            return (status, resp_ctype, payload), rid, retried
         if first_5xx is not None:
             # the 5xx retry found no (or no better) second replica:
             # pass the original upstream answer through rather than
@@ -774,7 +1294,7 @@ class Router:
         except Exception:
             pass
 
-    def _admission_check(self, body: bytes, ctx=None):
+    def _admission_check(self, body: bytes, ctx=None, headers=None):
         """Deadline-aware admission: a request declaring a
         ``deadline_ms`` that the observed windowed p99 already exceeds
         gets an immediate typed 503 instead of occupying a replica slot
@@ -788,10 +1308,18 @@ class Router:
         admits. Returns the refusal response, or None (admit)."""
         if b'"deadline_ms"' not in body:
             return None
-        try:
-            payload = json.loads(body)
-        except ValueError:
-            return None  # the replica's 400 owns malformed bodies
+        if _wire.is_binary_body(headers):
+            # a binary frame's scalar fields live in its JSON meta, so
+            # the substring probe above still gates the slow path
+            try:
+                payload = _wire.decode_frame(body)[0]
+            except _wire.WireError:
+                return None  # the replica's typed 400 owns bad frames
+        else:
+            try:
+                payload = json.loads(body)
+            except ValueError:
+                return None  # the replica's 400 owns malformed bodies
         if not isinstance(payload, dict):
             # a non-object body merely CONTAINING the substring (e.g.
             # ["deadline_ms"]) is the replica's 400, not ours
@@ -856,22 +1384,39 @@ class Router:
     def _act(self, body: bytes):
         return self._traced("router.act", self._act_inner, body)
 
-    def _act_inner(self, body: bytes, ctx, root):
+    def _act_inner(self, body: bytes, ctx, root, headers=None):
+        if headers is None:
+            from trpo_tpu.utils.httpd import request_headers
+
+            headers = request_headers()
+        fwd = self._codec_headers(headers)
+        self._count_codec(fwd)
         self._chaos_tick("/act", body)
-        shed = self._admission_check(body, ctx=ctx)
+        shed = self._admission_check(body, ctx=ctx, headers=headers)
         if shed is not None:
             return shed
         # keep a small ring of real request bodies: the canary gate's
         # action-parity sample mirrors ACTUAL traffic to the canary and
-        # an incumbent instead of guessing an obs distribution
-        self._recent_obs.append(body)
+        # an incumbent instead of guessing an obs distribution (JSON
+        # bodies only — the parity probe replays them as JSON)
+        if not _wire.is_binary_body(headers):
+            self._recent_obs.append(body)
         result, rid, retried = self._dispatch(body=body, path="/act",
                                               endpoint="act",
-                                              ctx=ctx, parent=root)
+                                              ctx=ctx, parent=root,
+                                              fwd_headers=fwd)
         if result is not None:
             return result
         return self._unrouted(rid, retried, "act", stateless=True,
                               ctx=ctx)
+
+    def _count_codec(self, fwd_headers: Optional[dict]) -> None:
+        with self._lock:
+            self.wire_frames_total[
+                "binary"
+                if _wire.is_binary_body(fwd_headers)
+                else "json"
+            ] += 1
 
     # -- the canary controller's probes ------------------------------------
 
@@ -1188,7 +1733,9 @@ class Router:
         no live state to move), or False (transport/flush failure)."""
         body = b"{}" if sid is None else _body({"session": sid})
         try:
-            status, payload = self._forward(replica_id, "/drain", body)
+            status, payload, _ = self._forward(
+                replica_id, "/drain", body
+            )
         except Exception:
             return False
         if status != 200:
@@ -1268,7 +1815,14 @@ class Router:
             "router.session_act", self._session_act_routed, path, body
         )
 
-    def _session_act_routed(self, path: str, body: bytes, ctx, root):
+    def _session_act_routed(self, path: str, body: bytes, ctx, root,
+                            headers=None):
+        if headers is None:
+            from trpo_tpu.utils.httpd import request_headers
+
+            headers = request_headers()
+        fwd = self._codec_headers(headers)
+        self._count_codec(fwd)
         self._chaos_tick(path, body)
         parts = path.strip("/").split("/")
         if len(parts) != 3 or parts[0] != "session" or parts[2] != "act":
@@ -1304,15 +1858,28 @@ class Router:
                     if self._affinity.get(sid) is not aff:
                         continue  # replaced/removed while we waited
                 return self._session_act_pinned(
-                    sid, aff, body, ctx=ctx, root=root
+                    sid, aff, body, ctx=ctx, root=root, fwd_headers=fwd
                 )
 
-    def _session_act_pinned(self, sid: str, aff, body: bytes,
-                            ctx=None, root=None):
-        # stamp the per-session sequence number: the replica dedupes a
-        # replay of an already-applied seq (the retry-idempotency
-        # contract) — an unparseable body forwards untouched and takes
-        # the replica's 400
+    def _stamp_seq(self, aff, body: bytes,
+                   fwd_headers=None) -> bytes:
+        """Stamp the per-session sequence number into the act body —
+        the replica dedupes a replay of an already-applied seq (the
+        retry-idempotency contract). A binary frame is restamped
+        (header rewrite + payload memcpy, obs bytes untouched); an
+        unparseable body forwards untouched and takes the replica's
+        typed 400 (a seq gap from the consumed increment is harmless —
+        dedupe compares equality, not contiguity)."""
+        if _wire.is_binary_body(fwd_headers):
+            with self._lock:
+                aff.seq += 1
+                seq = aff.seq
+            try:
+                return _wire.restamp(body, seq=seq)
+            except _wire.WireError:
+                with self._lock:
+                    self.wire_decode_errors_total += 1
+                return body
         try:
             payload = json.loads(body)
             if not isinstance(payload, dict):
@@ -1320,17 +1887,35 @@ class Router:
             with self._lock:
                 aff.seq += 1
                 payload["seq"] = aff.seq
-            body = _body(payload)
+            return _body(payload)
         except ValueError:
-            pass
+            return body
+
+    def _session_act_pinned(self, sid: str, aff, body: bytes,
+                            ctx=None, root=None, fwd_headers=None):
+        body = self._stamp_seq(aff, body, fwd_headers)
         pinned = aff.replica
-        resumed = reestablished = False
-        entry = None
         result, rid, retried = self._dispatch(
             body=body, path=f"/session/{sid}/act",
             endpoint="session_act", pinned=pinned,
-            ctx=ctx, parent=root,
+            ctx=ctx, parent=root, fwd_headers=fwd_headers,
         )
+        return self._session_act_finish(
+            sid, aff, body, result, rid, retried,
+            ctx=ctx, root=root, fwd_headers=fwd_headers,
+        )
+
+    def _session_act_finish(self, sid: str, aff, body: bytes,
+                            result, rid, retried,
+                            ctx=None, root=None, fwd_headers=None):
+        """Everything after the pinned dispatch returns: journal-backed
+        failover, fence, re-dispatch, and response decoration. Shared
+        verbatim by the thread core (inline) and the async core (on the
+        handler executor — this tail blocks on journals and sync
+        re-dispatch, so it never runs on the event loop)."""
+        pinned = aff.replica
+        resumed = reestablished = False
+        entry = None
         lost_pin = result is None
         if not lost_pin and result[0] == 404:
             # the pinned replica restarted with an empty store (or
@@ -1417,7 +2002,7 @@ class Router:
             result, rid, _ = self._dispatch(
                 body=body, path=f"/session/{sid}/act",
                 endpoint="session_act", pinned=rid,
-                ctx=ctx, parent=root,
+                ctx=ctx, parent=root, fwd_headers=fwd_headers,
             )
             if result is None:
                 return self._unrouted(rid, True, "session_act", ctx=ctx)
@@ -1441,6 +2026,18 @@ class Router:
                 resumed = True
                 resumed_steps = pending
         if status != 200 or not (resumed or reestablished):
+            return status, ctype, payload
+        # decorate the success with the failover outcome — a binary
+        # response is restamped (action bytes untouched), JSON is
+        # re-serialized, exactly as before
+        base = (ctype or "").split(";", 1)[0].strip().lower()
+        if base == _wire.WIRE_CONTENT_TYPE:
+            if resumed:
+                payload = _wire.restamp(
+                    payload, resumed=True, resumed_steps=resumed_steps
+                )
+            else:
+                payload = _wire.restamp(payload, reestablished=True)
             return status, ctype, payload
         out = json.loads(payload)
         if resumed:
@@ -1482,11 +2079,23 @@ class Router:
                 "sessions_drained_total": self.sessions_drained_total,
             }
         q, samples = self.latency_window((0.5, 0.99))
+        with self._lock:
+            data_plane = {
+                "core": self.core,
+                "uds_path": self.uds_path,
+                "wire_frames_total": dict(self.wire_frames_total),
+                "dispatch_transport_total": dict(
+                    self.dispatch_transport_total
+                ),
+                "wire_decode_errors_total":
+                    self.wire_decode_errors_total,
+            }
         return 200, _JSON, _body(
             {
                 "replicas": snap["replicas"],
                 "healthy": snap["healthy"],
                 "size": snap["size"],
+                "data_plane": data_plane,
                 "counters": counters,
                 "latency_ms": {str(k): v for k, v in q.items()},
                 # always alongside the quantiles: a 3-request "p99" must
@@ -1660,6 +2269,29 @@ class Router:
             "not a measurement — consumers gate on this)",
             [({}, lat_samples)],
         )
+        # data plane (ISSUE 16): what the requests and hops rode
+        with self._lock:
+            wire_rows = sorted(self.wire_frames_total.items())
+            transport_rows = sorted(
+                self.dispatch_transport_total.items()
+            )
+            decode_errors = self.wire_decode_errors_total
+        fam(
+            "trpo_router_wire_frames_total", "counter",
+            "client requests by negotiated payload codec",
+            [({"codec": c}, v) for c, v in wire_rows],
+        )
+        fam(
+            "trpo_router_wire_decode_errors_total", "counter",
+            "binary frames the router could not restamp (forwarded "
+            "untouched for the replica's typed 400)",
+            [({}, decode_errors)],
+        )
+        fam(
+            "trpo_router_dispatch_transport_total", "counter",
+            "replica hops by transport (same-host UDS vs TCP)",
+            [({"transport": t}, v) for t, v in transport_rows],
+        )
         if self.tracer is not None:
             # request tracing (ISSUE 15): writer-backpressure drops
             # are COUNTED, never silent — a scrape seeing
@@ -1705,4 +2337,18 @@ class Router:
         self._flush_shed_counts()
         httpd, self._httpd = self._httpd, None
         if httpd is not None:
+            loop = getattr(httpd, "loop", None)
+            if loop is not None and loop.is_running():
+                # drain the loop-owned replica pools ON the loop (the
+                # pools are loop-confined state) before stopping it
+
+                async def _drain():
+                    self._apool_close_all()
+
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        _drain(), loop
+                    ).result(timeout=2.0)
+                except Exception:
+                    pass
             httpd.close()

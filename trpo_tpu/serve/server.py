@@ -56,9 +56,12 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from trpo_tpu.serve import wire as _wire
+
 __all__ = ["PolicyServer"]
 
 _JSON = "application/json"
+_WIRE = _wire.WIRE_CONTENT_TYPE
 
 
 def _json_body(obj) -> bytes:
@@ -137,6 +140,7 @@ class PolicyServer:
         session_deadline_ms: float = 3.0,
         session_adaptive_deadline: bool = True,
         tracer=None,
+        uds_path: Optional[str] = None,
     ):
         if (checkpointer is None) != (template is None):
             raise ValueError(
@@ -185,6 +189,11 @@ class PolicyServer:
             if managed_reload and initial_step is not None
             else None
         )
+        # wire-codec accounting (ISSUE 16): per-codec act-plane frame
+        # counts and typed decode refusals — a malformed binary frame
+        # is a 400, and the refusal is COUNTED, never silent
+        self.wire_frames_total = {"json": 0, "binary": 0}
+        self.wire_decode_errors_total = 0
         self._counter_lock = threading.Lock()
         self._reload_lock = threading.Lock()  # watcher vs POST /reload
         self._stop = threading.Event()
@@ -263,9 +272,14 @@ class PolicyServer:
                 "POST /reload, GET /healthz, GET /metrics"
             ),
             thread_name="serve-http",
+            uds_path=uds_path,
         )
         self.host = host
         self.port = self._httpd.port
+        # same-host dial target (ISSUE 16): the router prefers this
+        # AF_UNIX path over the TCP port when the transport says the
+        # replica is local; None when the listener was not requested
+        self.uds_path = self._httpd.uds_path
 
     @property
     def url(self) -> str:
@@ -567,6 +581,43 @@ class PolicyServer:
 
     # -- handlers ----------------------------------------------------------
 
+    def _negotiate(self, body: bytes):
+        """Per-connection codec negotiation on the act plane (ISSUE
+        16): decode a ``Content-Type: application/x-trpo-wire`` body
+        into the SAME payload-dict shape the JSON path produces
+        (arrays merged under their field names), and decide the
+        response codec from ``Accept``. Returns ``(payload,
+        reply_binary, err)`` — ``payload`` is None for a JSON body
+        (the caller parses it exactly as before: JSON stays the
+        default external format and the compat fallback), ``err`` is
+        a ready typed-400 refusal (``code="bad_frame"``) for a
+        malformed frame — a client's framing bug is never a 500."""
+        from trpo_tpu.utils.httpd import request_headers
+
+        headers = request_headers()
+        binary = _wire.is_binary_body(headers)
+        reply_binary = _wire.wants_binary(headers)
+        with self._counter_lock:
+            self.wire_frames_total["binary" if binary else "json"] += 1
+        if not binary:
+            return None, reply_binary, None
+        try:
+            scalars, arrays = _wire.decode_frame(body)
+        except _wire.WireError as e:
+            with self._counter_lock:
+                self.wire_decode_errors_total += 1
+            return None, reply_binary, (
+                400, _JSON, _json_body(
+                    {
+                        "error": f"bad wire frame: {e.detail}",
+                        "code": e.code,
+                    }
+                ),
+            )
+        payload = dict(scalars)
+        payload.update(arrays)
+        return payload, reply_binary, None
+
     def _act(self, body: bytes):
         return self._traced("replica.act", self._act_inner, body)
 
@@ -592,8 +643,12 @@ class PolicyServer:
             return 503, _JSON, _json_body(
                 {"error": "no policy loaded yet (no complete checkpoint)"}
             )
+        payload, reply_binary, err = self._negotiate(body)
+        if err is not None:
+            return err
         try:
-            payload = json.loads(body)
+            if payload is None:
+                payload = json.loads(body)
             obs = np.asarray(payload["obs"], self.engine.obs_dtype)
         except (ValueError, KeyError, TypeError) as e:
             return 400, _JSON, _json_body(
@@ -630,6 +685,10 @@ class PolicyServer:
         # `step` is the snapshot the batch ACTUALLY ran on (captured
         # inside the engine call) — reading loaded_step here instead
         # could race a hot swap and mislabel this action's provenance
+        if reply_binary:
+            return 200, _WIRE, _wire.encode_frame(
+                {"step": step}, {"action": np.asarray(action)}
+            )
         return 200, _JSON, _json_body(
             {"action": np.asarray(action).tolist(), "step": step}
         )
@@ -776,8 +835,12 @@ class PolicyServer:
                     "code": "session_unknown",
                 }
             )
+        payload, reply_binary, err = self._negotiate(body)
+        if err is not None:
+            return err
         try:
-            payload = json.loads(body)
+            if payload is None:
+                payload = json.loads(body)
             obs = np.asarray(payload["obs"], self.engine.obs_dtype)
             seq = payload.get("seq")
             if seq is not None and (
@@ -808,16 +871,24 @@ class PolicyServer:
                     # action, do NOT advance the carry (exactly-once)
                     self.sessions.deduped_total += 1
                     sess.last_used = time.monotonic()
+                    meta = {
+                        "step": sess.last_step,
+                        "session": sid,
+                        "session_steps": sess.steps,
+                        "deduped": True,
+                    }
+                    if reply_binary:
+                        return 200, _WIRE, _wire.encode_frame(
+                            meta,
+                            {"action": np.asarray(sess.last_action)},
+                        )
                     return 200, _JSON, _json_body(
-                        {
-                            "action": np.asarray(
+                        dict(
+                            meta,
+                            action=np.asarray(
                                 sess.last_action
                             ).tolist(),
-                            "step": sess.last_step,
-                            "session": sid,
-                            "session_steps": sess.steps,
-                            "deduped": True,
-                        }
+                        )
                     )
                 # submit into the gather/scatter epoch (ISSUE 13): the
                 # batcher stacks this session's (carry, obs) with every
@@ -869,13 +940,17 @@ class PolicyServer:
             )
         with self._counter_lock:
             self.session_acts_total += 1
+        meta = {
+            "step": step,
+            "session": sid,
+            "session_steps": sess.steps,
+        }
+        if reply_binary:
+            return 200, _WIRE, _wire.encode_frame(
+                meta, {"action": np.asarray(action)}
+            )
         return 200, _JSON, _json_body(
-            {
-                "action": np.asarray(action).tolist(),
-                "step": step,
-                "session": sid,
-                "session_steps": sess.steps,
-            }
+            dict(meta, action=np.asarray(action).tolist())
         )
 
     def _healthz(self):
@@ -922,6 +997,38 @@ class PolicyServer:
             "trpo_trace_dropped_total", "counter",
             "trace spans dropped by writer backpressure",
             [("", self.tracer.dropped_total)],
+        )
+
+    def _wire_fams(self, fam) -> None:
+        """The act-plane codec counters (ISSUE 16), shared by both
+        /metrics branches: which wire format requests actually rode,
+        and how many binary frames were refused as malformed."""
+        with self._counter_lock:
+            frames = dict(self.wire_frames_total)
+            decode_errors = self.wire_decode_errors_total
+        fam(
+            "trpo_serve_wire_frames_total", "counter",
+            "act-plane requests by wire codec",
+            [
+                (f'{{codec="{codec}"}}', count)
+                for codec, count in sorted(frames.items())
+            ],
+        )
+        fam(
+            "trpo_serve_wire_decode_errors_total", "counter",
+            "binary frames refused as malformed (typed 400 bad_frame)",
+            [("", decode_errors)],
+        )
+        transports = dict(
+            getattr(self._httpd, "transport_requests_total", {})
+        )
+        fam(
+            "trpo_serve_transport_requests_total", "counter",
+            "requests served by listener family (tcp vs same-host uds)",
+            [
+                (f'{{transport="{t}"}}', count)
+                for t, count in sorted(transports.items())
+            ],
         )
 
     def _metrics(self):
@@ -1042,6 +1149,7 @@ class PolicyServer:
                 "trpo_serve_reloads_total", "counter",
                 "hot reloads applied", [("", self.reloads_total)],
             )
+            self._wire_fams(fam)
             self._trace_fams(fam)
             body = ("\n".join(lines) + "\n").encode()
             return 200, "text/plain; version=0.0.4; charset=utf-8", body
@@ -1106,6 +1214,7 @@ class PolicyServer:
             "trpo_serve_reloads_total", "counter",
             "hot reloads applied", [("", self.reloads_total)],
         )
+        self._wire_fams(fam)
         self._trace_fams(fam)
         body = ("\n".join(lines) + "\n").encode()
         return 200, "text/plain; version=0.0.4; charset=utf-8", body

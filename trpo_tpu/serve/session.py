@@ -307,7 +307,13 @@ class RecurrentServeEngine:
         action's provenance). A batch-1 view of :meth:`step_batch` —
         the single-session and epoch-batched paths run the SAME
         executables, so parity between them is structural."""
-        carry = np.asarray(carry, np.float32)
+        if isinstance(carry, jax.Array):
+            # device-resident carry (ISSUE 16): validate by metadata,
+            # never round-trip it through the host
+            if carry.dtype != jnp.float32:
+                carry = carry.astype(jnp.float32)
+        else:
+            carry = np.asarray(carry, np.float32)
         if carry.shape != (self.state_size,):
             raise ValueError(
                 f"carry must have shape ({self.state_size},), "
@@ -343,7 +349,19 @@ class RecurrentServeEngine:
                 "server at a checkpoint directory) before serving"
             )
         params, obs_norm, ck_step = snap
-        carries = np.asarray(carries, np.float32)
+        # device-resident carries (ISSUE 16): a jax.Array batch skips
+        # the host round-trip entirely — padding/slicing happen as
+        # device ops, and the NEW carries stay device-resident (the
+        # same AOT executables run either way, so per-row results are
+        # bit-exact vs the host path by construction). The host path
+        # is unchanged for np inputs (fresh sessions, journal resumes,
+        # direct callers).
+        on_device = isinstance(carries, jax.Array)
+        if on_device:
+            if carries.dtype != jnp.float32:
+                carries = carries.astype(jnp.float32)
+        else:
+            carries = np.asarray(carries, np.float32)
         obs = np.asarray(obs, self.obs_dtype)
         if (
             carries.ndim != 2
@@ -377,15 +395,28 @@ class RecurrentServeEngine:
             width = o_chunk.shape[0]
             rung = self.padded_shape(width)
             if width != rung:
-                c_chunk = np.concatenate(
-                    [
-                        c_chunk,
-                        np.zeros(
-                            (rung - width, self.state_size), np.float32
-                        ),
-                    ],
-                    axis=0,
-                )
+                if on_device:
+                    c_chunk = jnp.concatenate(
+                        [
+                            c_chunk,
+                            jnp.zeros(
+                                (rung - width, self.state_size),
+                                jnp.float32,
+                            ),
+                        ],
+                        axis=0,
+                    )
+                else:
+                    c_chunk = np.concatenate(
+                        [
+                            c_chunk,
+                            np.zeros(
+                                (rung - width, self.state_size),
+                                np.float32,
+                            ),
+                        ],
+                        axis=0,
+                    )
                 o_chunk = np.concatenate(
                     [
                         o_chunk,
@@ -399,8 +430,14 @@ class RecurrentServeEngine:
             action, carry_new = self._compiled[rung](
                 params, obs_norm, c_chunk, o_chunk
             )
+            # actions go to clients (host); new carries follow the
+            # input's residency — on the device path the slice is a
+            # device op and no carry byte touches the host here
             act_outs.append(np.asarray(action)[:width])
-            carry_outs.append(np.asarray(carry_new, np.float32)[:width])
+            carry_outs.append(
+                carry_new[:width] if on_device
+                else np.asarray(carry_new, np.float32)[:width]
+            )
             with self._lock:
                 self.shape_counts[rung] = (
                     self.shape_counts.get(rung, 0) + 1
@@ -416,7 +453,9 @@ class RecurrentServeEngine:
         new_carries = (
             carry_outs[0]
             if len(carry_outs) == 1
-            else np.concatenate(carry_outs, axis=0)
+            else (jnp if on_device else np).concatenate(
+                carry_outs, axis=0
+            )
         )
         out = (actions, new_carries)
         return out + (ck_step,) if return_step else out
@@ -808,11 +847,22 @@ class CarryJournal:
 
     @staticmethod
     def _jsonable(entry: dict) -> dict:
-        """Producer entries carry ndarray fields by reference (the act
+        """Producer entries carry array fields by reference (the act
         path never pays the list conversion); this is where they
-        become JSON, on the writer thread."""
+        become JSON, on the writer thread. A DEVICE-resident carry
+        (ISSUE 16) pays its host transfer here too — at journal-sync
+        cadence, on this thread, never on the act path — which is
+        exactly why durability/failover semantics are unchanged by
+        device residency: what lands in the file is the same float32
+        snapshot either way."""
         return {
-            k: (v.tolist() if isinstance(v, np.ndarray) else v)
+            k: (
+                v.tolist()
+                if isinstance(v, np.ndarray)
+                else np.asarray(v).tolist()
+                if isinstance(v, jax.Array)
+                else v
+            )
             for k, v in entry.items()
         }
 
@@ -993,7 +1043,12 @@ class SessionStore:
             ):
                 evicted, _ = self._sessions.popitem(last=False)  # LRU
                 self.evicted_total += 1
-            sess = _Session(np.asarray(initial_carry, np.float32), now)
+            sess = _Session(
+                initial_carry
+                if isinstance(initial_carry, jax.Array)
+                else np.asarray(initial_carry, np.float32),
+                now,
+            )
             sess.steps = int(steps)
             if seq is not None:
                 sess.last_seq = int(seq)

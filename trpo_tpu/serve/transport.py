@@ -134,6 +134,21 @@ class _ChaosGates:
         with self._lock:
             return host in self._lost_descriptors
 
+    def gate_delay(self, host: str) -> float:
+        """The non-blocking half of :meth:`gate` (ISSUE 16 — the
+        asyncio router must not ``time.sleep`` on its event loop):
+        raise :class:`TransportPartitioned` while a partition holds,
+        otherwise return the injected latency in ms the CALLER must
+        pay (``await asyncio.sleep`` on the loop, ``time.sleep`` in
+        :meth:`gate`) — 0.0 with no chaos armed."""
+        if self.partitioned(host):
+            raise TransportPartitioned(
+                f"transport to host {host!r} is partitioned"
+            )
+        with self._lock:
+            lat = self._latency_ms.get(host)
+        return float(lat) if lat else 0.0
+
     def gate(self, host: str) -> float:
         """Model one exchange with ``host``: raise
         :class:`TransportPartitioned` while a partition holds, pay the
@@ -142,16 +157,20 @@ class _ChaosGates:
         normally) so a traced caller can attribute an injected
         slow-network stall to the transport leg instead of the replica
         (ISSUE 15 — the ``gate_ms`` span attr)."""
-        if self.partitioned(host):
-            raise TransportPartitioned(
-                f"transport to host {host!r} is partitioned"
-            )
-        with self._lock:
-            lat = self._latency_ms.get(host)
+        lat = self.gate_delay(host)
         if lat:
             time.sleep(lat / 1e3)
-            return float(lat)
-        return 0.0
+        return lat
+
+    def same_host(self, host: str) -> bool:
+        """Does ``host`` share this process's machine? The router's
+        UDS dial predicate (ISSUE 16): same-host replica hops may ride
+        an AF_UNIX socket; cross-host hops stay TCP. Only the implicit
+        local host qualifies — a :class:`TemplateTransport`'s NAMED
+        hosts are remote by definition (even a test faking them
+        in-process models a cross-host topology, and must keep paying
+        the TCP/gate semantics it exists to exercise)."""
+        return host == LOCAL_HOST
 
 
 class LocalExecTransport(_ChaosGates):
