@@ -67,6 +67,8 @@ BENCH_FORCE_CPU=1 BENCH_BATCH=256 BENCH_WIDTHS= BENCH_HOST_PIPELINE=0 \
     BENCH_TAIL=0 \
     BENCH_FLEET_FAMILIES=cartpole BENCH_FLEET_NS=64,128 \
     BENCH_FLEET_K=5 BENCH_FLEET_BATCH=512 \
+    BENCH_OVERLAP_WIDTHS=64 BENCH_OVERLAP_T=16 \
+    BENCH_OVERLAP_ITERS=3 BENCH_OVERLAP_REAL_ITERS=1 \
     BENCH_EVENTS_JSONL="$OBS_TMP/bench_events.jsonl" \
     python bench.py > "$OBS_TMP/bench.json"
 python scripts/validate_events.py "$OBS_TMP/train_events.jsonl" \
@@ -444,6 +446,136 @@ print(
 )
 PYEOF
 rm -rf "$WIRE_TMP"
+
+echo "== training overlap smoke: bit-exact fill window, traced waterfall, >=1.3x =="
+# ISSUE 17 acceptance: (a) with train_overlap=1 the FIRST overlapped
+# iteration (fill window, staleness 0) is bit-exact vs the synchronous
+# driver on EVERY TrainState leaf — params, obs-norm stats, env carry,
+# rng; (b) a 3-iteration overlapped learn() traced at rate 1.0 yields a
+# validator-clean event log whose waterfall shows rollout k+1's chunk
+# spans INSIDE update k's span (validate_events.py's ISSUE 17 contract
+# re-checks the same intersection on every future log); (c) on the
+# calibrated CPU bench — real chunked window collection vs an update
+# calibrated to one rollout window and spent core-releasing, the
+# accelerator-resident-learner regime (bench.training_overlap_bench
+# docstring) — the overlapped driver sustains >= 1.3x the synchronous
+# env-steps/s.
+OVERLAP_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+    python - "$OVERLAP_TMP" <<'PYEOF'
+import json
+import sys
+
+import jax
+import numpy as np
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.obs.telemetry import Telemetry
+
+tmp = sys.argv[1]
+base = dict(
+    n_envs=8, batch_timesteps=8 * 16, rollout_chunk=4, cg_iters=3,
+    vf_train_steps=3, policy_hidden=(8,), vf_hidden=(16,),
+    normalize_obs=True, seed=0,
+)
+
+# (a) staleness 0: one overlapped iteration == one synchronous one
+sync = TRPOAgent("cartpole", TRPOConfig(**base))
+over = TRPOAgent("cartpole", TRPOConfig(**base, train_overlap=1))
+s_sync, _ = sync.run_iterations(sync.init_state(), 1)
+s_over, _ = over.run_iterations(over.init_state(), 1)
+
+
+def leaves(tree):
+    out = []
+    for x in jax.tree_util.tree_leaves(tree):
+        if hasattr(x, "dtype") and jax.numpy.issubdtype(
+            x.dtype, jax.dtypes.prng_key
+        ):
+            x = jax.random.key_data(x)
+        out.append(np.asarray(x))
+    return out
+
+
+a, b = leaves(s_sync), leaves(s_over)
+assert len(a) == len(b)
+for x, y in zip(a, b):
+    np.testing.assert_array_equal(x, y)
+print(
+    "overlap smoke: staleness-0 fill window bit-exact vs synchronous "
+    f"({len(a)} state leaves)"
+)
+
+# (b) 3 overlapped iterations through learn(), traced at rate 1.0
+events = f"{tmp}/overlap_events.jsonl"
+agent = TRPOAgent(
+    "cartpole",
+    TRPOConfig(**base, train_overlap=1, trace_sample_rate=1.0),
+)
+agent.learn(n_iterations=3, telemetry=Telemetry(events_jsonl=events))
+
+names = {}
+with open(events) as f:
+    for line in f:
+        ev = json.loads(line)
+        if ev.get("kind") == "span":
+            names.setdefault(ev["name"], []).append(ev)
+for need in (
+    "train/run", "train/rollout_chunk", "train/transfer",
+    "train/advantage", "train/fvp_cg_solve", "train/linesearch",
+    "train/vf_fit", "train/update",
+):
+    assert names.get(need), f"missing {need} spans"
+root = names["train/run"][0]
+assert root.get("overlap"), root
+assert root.get("staleness_bound") == 1, root
+
+
+def iv(e):
+    return e["start"], e["start"] + e["dur_ms"] / 1e3
+
+
+pairs = [
+    (c, u)
+    for c in names["train/rollout_chunk"]
+    for u in names["train/update"]
+    if max(iv(c)[0], iv(u)[0]) < min(iv(c)[1], iv(u)[1])
+]
+assert pairs, (
+    "waterfall is strictly sequential: no rollout-chunk span inside "
+    "an update span"
+)
+print(
+    f"overlap smoke: traced waterfall OK — {len(pairs)} rollout-chunk/"
+    f"update overlaps across {len(names['train/update'])} updates, "
+    f"staleness bound {root['staleness_bound']}"
+)
+PYEOF
+python scripts/validate_events.py "$OVERLAP_TMP/overlap_events.jsonl"
+# (c) the calibrated sync-vs-overlap driver gate
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+    BENCH_OVERLAP_WIDTHS=256 BENCH_OVERLAP_ITERS=6 \
+    BENCH_OVERLAP_REAL_ITERS=2 \
+    python - <<'PYEOF'
+import bench
+
+out = bench.training_overlap_bench()
+row = out["rows"][0]
+assert row["overlap_speedup"] >= 1.3, row
+assert (
+    row["overlap_env_steps_per_sec"]
+    >= 1.3 * row["sync_env_steps_per_sec"]
+), row
+print(
+    f"overlap bench gate OK: {row['overlap_speedup']}x "
+    f"({row['overlap_env_steps_per_sec']:.0f} vs "
+    f"{row['sync_env_steps_per_sec']:.0f} env-steps/s at "
+    f"n_envs={row['n_envs']}, calibrated update "
+    f"{row['calibrated_update_ms']} ms)"
+)
+PYEOF
+rm -rf "$OVERLAP_TMP"
 
 echo "== env fleet smoke: chunked == unchunked + wide-N beats the N=128 row =="
 # ISSUE 10 acceptance, cartpole-cheap: (a) a rollout_chunk training run

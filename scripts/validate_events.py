@@ -516,6 +516,54 @@ def validate_file(path: str) -> list:
                     f"({rec.get('name')}) has transport {transport!r} "
                     "— every hop must name tcp or uds"
                 )
+    # ISSUE 17 overlapped-training contract: a run whose training root
+    # span declares overlap (train/run with overlap truthy) must PROVE
+    # it pipelined — at least one rollout-chunk span's wall-clock
+    # interval must intersect an update span's interval (rollout k+1
+    # streaming while update k runs). A log with the overlap claim but
+    # strictly sequential spans is not a valid overlapped-run log; a
+    # synchronous-run log (no overlap root) is untouched. Enforced
+    # per overlap trace id, whole-file (spans flush out of order).
+    _overlap_roots = {
+        rec.get("trace") for _, rec in records
+        if rec.get("kind") == "span"
+        and rec.get("name") == "train/run"
+        and rec.get("overlap")
+    }
+    for tid in _overlap_roots:
+        _iv = lambda rec: (
+            rec["start"], rec["start"] + (rec.get("dur_ms") or 0.0) / 1e3
+        )
+        chunks = [
+            _iv(rec) for _, rec in records
+            if rec.get("kind") == "span" and rec.get("trace") == tid
+            and rec.get("name") == "train/rollout_chunk"
+            and isinstance(rec.get("start"), (int, float))
+        ]
+        updates = [
+            _iv(rec) for _, rec in records
+            if rec.get("kind") == "span" and rec.get("trace") == tid
+            and rec.get("name") == "train/update"
+            and isinstance(rec.get("start"), (int, float))
+        ]
+        if not chunks or not updates:
+            errs.append(
+                f"{path}: overlapped training trace {tid!r} is missing "
+                f"{'rollout-chunk' if not chunks else 'update'} spans — "
+                "the pipeline's stages were not traced"
+            )
+            continue
+        if not any(
+            c0 < u1 and u0 < c1
+            for (c0, c1) in chunks
+            for (u0, u1) in updates
+        ):
+            errs.append(
+                f"{path}: overlapped training trace {tid!r} has no "
+                "rollout-chunk span overlapping an update span — the "
+                "run claims overlap (train/run overlap=1) but its "
+                "waterfall is strictly sequential"
+            )
     # (3) a retried request that names its trace must have the retry
     # visible IN that trace — anomalies are always-sampled precisely so
     # the trace shows what the latency bought

@@ -1,0 +1,297 @@
+"""Pipelined actor/learner training loop (ISSUE 17).
+
+The contracts under test:
+
+- the staged update (``make_staged_trpo_update``: solve → finish over
+  the host seam) composes BIT-EXACTLY to the fused
+  ``make_trpo_update`` — feedforward, recurrent, and under a vmapped
+  population-member axis;
+- with ``train_overlap=1`` the FIRST overlapped iteration (fill window,
+  staleness 0) is bit-exact vs the synchronous driver on every state
+  leaf — params, obs-norm stats, env carry, and RNG all thread across
+  the pipeline boundary identically;
+- the importance-weight correction is exact: ``is_weight`` of ones is
+  the plain surrogate bit-for-bit, and under staleness 1 the
+  line-search KL bound still holds;
+- invalid overlap configs fail at CONSTRUCTION time with clear errors.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.envs import CartPole
+from trpo_tpu.models import make_policy
+from trpo_tpu.trpo import (
+    TRPOBatch,
+    make_staged_trpo_update,
+    make_trpo_update,
+    surrogate_loss,
+)
+
+
+def _np(x):
+    if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+        x = jax.random.key_data(x)
+    return np.asarray(x)
+
+
+def _assert_trees_equal(a, b, label=""):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb), label
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(_np(x), _np(y), label)
+
+
+def _ff_batch(policy, params, n=32, seed=0):
+    k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
+    obs = jax.random.normal(k1, (n, 4))
+    dist = policy.apply(params, obs)
+    actions = policy.dist.sample(k2, dist)
+    adv = jax.random.normal(k3, (n,))
+    return TRPOBatch(
+        obs=obs,
+        actions=actions,
+        advantages=adv,
+        old_dist=jax.lax.stop_gradient(dist),
+        weight=jnp.ones((n,)),
+    )
+
+
+def _agent_pair(overlap_extra=None, **kw):
+    """(synchronous agent, overlapped agent) over identical configs —
+    only ``train_overlap`` differs."""
+    base = dict(
+        env="cartpole",
+        n_envs=8,
+        batch_timesteps=8 * 16,
+        rollout_chunk=4,
+        cg_iters=3,
+        vf_train_steps=3,
+        policy_hidden=(8,),
+        vf_hidden=(16,),
+        normalize_obs=True,
+        seed=0,
+    )
+    base.update(kw)
+    env = base.pop("env")
+    sync = TRPOAgent(env, TRPOConfig(**base))
+    over = TRPOAgent(
+        env, TRPOConfig(**base, train_overlap=1, **(overlap_extra or {}))
+    )
+    return sync, over
+
+
+# ---------------------------------------------------------------------------
+# staged update ≡ fused update
+# ---------------------------------------------------------------------------
+
+
+def test_staged_update_matches_fused():
+    env = CartPole()
+    policy = make_policy(env.obs_shape, env.action_spec, hidden=(8,))
+    params = policy.init(jax.random.key(0))
+    batch = _ff_batch(policy, params, n=16)
+    cfg = TRPOConfig(cg_iters=3)
+
+    ref_params, ref_stats = jax.jit(make_trpo_update(policy, cfg))(
+        params, batch
+    )
+    solve, finish = make_staged_trpo_update(policy, cfg)
+    pack = jax.jit(solve)(params, batch)
+    new_params, stats = jax.jit(finish)(params, batch, pack)
+
+    _assert_trees_equal(ref_params, new_params, "staged params")
+    np.testing.assert_array_equal(
+        np.asarray(ref_stats.kl), np.asarray(stats.kl), "staged kl"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref_stats.surrogate_after),
+        np.asarray(stats.surrogate_after),
+        "staged surrogate",
+    )
+
+
+@pytest.mark.slow
+def test_staged_update_matches_fused_member_axis():
+    """The staged seam composes with the population-member vmap: a
+    member axis over solve → finish reproduces the vmapped fused
+    update bit-exactly (the analogue Population relies on for
+    train_overlap=0 members)."""
+    env = CartPole()
+    policy = make_policy(env.obs_shape, env.action_spec, hidden=(8,))
+    params = jax.vmap(
+        lambda k: policy.init(k)
+    )(jax.random.split(jax.random.key(0), 3))
+    batches = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[
+            _ff_batch(
+                policy,
+                jax.tree_util.tree_map(lambda x: x[i], params),
+                n=16,
+                seed=i,
+            )
+            for i in range(3)
+        ],
+    )
+    cfg = TRPOConfig(cg_iters=3)
+
+    fused = jax.jit(jax.vmap(make_trpo_update(policy, cfg)))
+    ref_params, ref_stats = fused(params, batches)
+
+    solve, finish = make_staged_trpo_update(policy, cfg)
+    packs = jax.jit(jax.vmap(solve))(params, batches)
+    new_params, stats = jax.jit(jax.vmap(finish))(params, batches, packs)
+
+    _assert_trees_equal(ref_params, new_params, "member-axis params")
+    np.testing.assert_array_equal(
+        np.asarray(ref_stats.kl), np.asarray(stats.kl), "member-axis kl"
+    )
+
+
+# ---------------------------------------------------------------------------
+# importance-weight correction
+# ---------------------------------------------------------------------------
+
+
+def test_is_weight_ones_is_plain_surrogate():
+    env = CartPole()
+    policy = make_policy(env.obs_shape, env.action_spec, hidden=(16,))
+    params = policy.init(jax.random.key(1))
+    batch = _ff_batch(policy, params, seed=2)
+    plain = surrogate_loss(policy, params, batch)
+    weighted = surrogate_loss(
+        policy,
+        params,
+        batch._replace(is_weight=jnp.ones_like(batch.advantages)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain), np.asarray(weighted)
+    )
+
+
+def test_is_weight_unity_when_policies_equal():
+    """The stale-window weight exp(logp_anchor − logp_behavior) is
+    exactly 1 when anchor and behavior params coincide — the correction
+    vanishes on-policy."""
+    env = CartPole()
+    policy = make_policy(env.obs_shape, env.action_spec, hidden=(16,))
+    params = policy.init(jax.random.key(3))
+    batch = _ff_batch(policy, params, seed=4)
+    dist = policy.apply(params, batch.obs)
+    w = jnp.exp(
+        policy.dist.logp(dist, batch.actions)
+        - policy.dist.logp(batch.old_dist, batch.actions)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(w), np.ones_like(np.asarray(w))
+    )
+
+
+# ---------------------------------------------------------------------------
+# overlap driver: staleness-0 bit-exactness + threading
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_first_iteration_bitexact_sync():
+    """Fill window (staleness 0): one overlapped iteration ≡ one
+    synchronous iteration on EVERY TrainState leaf — policy/vf params,
+    obs-norm stats, env carry, and rng."""
+    sync, over = _agent_pair()
+    s_sync, _ = sync.run_iterations(sync.init_state(), 1)
+    s_over, _ = over.run_iterations(over.init_state(), 1)
+    for name in s_sync._fields:
+        _assert_trees_equal(
+            getattr(s_sync, name), getattr(s_over, name), name
+        )
+
+
+@pytest.mark.slow
+def test_overlap_first_iteration_bitexact_sync_recurrent():
+    """Recurrent twin of the fill-window contract: ``policy_h`` threads
+    through the tuple-params rollout wrapper and the SeqObs batch
+    identically to the synchronous driver."""
+    sync, over = _agent_pair(env="cartpole-po", policy_gru=8)
+    s_sync, _ = sync.run_iterations(sync.init_state(), 1)
+    s_over, _ = over.run_iterations(over.init_state(), 1)
+    for name in s_sync._fields:
+        _assert_trees_equal(
+            getattr(s_sync, name), getattr(s_over, name), name
+        )
+
+
+@pytest.mark.slow
+def test_overlap_staleness_one_kl_and_threading():
+    """Three overlapped iterations: the line-search KL bound holds under
+    staleness 1 (the IS-corrected surrogate's anchor is the CURRENT
+    params, so kl_old_new stays a trust-region quantity), stats stay
+    finite, and the obs-norm/timestep accounting threads exactly one
+    batch per iteration."""
+    _, over = _agent_pair()
+    s0 = over.init_state()
+    s, rows = over.run_iterations(s0, 3)
+    kl = np.asarray(rows["kl_old_new"], np.float64)
+    assert kl.shape[0] == 3
+    # backtracking accepts kl <= 1.5 * max_kl (trpo.py line search)
+    assert np.all(kl <= 1.5 * over.cfg.max_kl + 1e-6), kl
+    assert np.all(np.isfinite(np.asarray(rows["entropy"])))
+    assert int(s.iteration) == 3
+    assert int(s.total_timesteps) == 3 * over.cfg.batch_timesteps
+    if s.obs_norm is not None:
+        assert float(np.asarray(s.obs_norm.count)) >= (
+            3 * over.cfg.batch_timesteps
+        )
+    # rng advanced and the env carry left the initial state
+    assert not np.array_equal(
+        np.asarray(jax.random.key_data(s.rng)),
+        np.asarray(jax.random.key_data(s0.rng)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# construction-time validation
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_config_rejections():
+    ok = dict(n_envs=8, batch_timesteps=8 * 16, rollout_chunk=4)
+    with pytest.raises(ValueError, match="train_overlap"):
+        TRPOConfig(train_overlap=2, **ok)
+    with pytest.raises(ValueError, match="rollout_chunk"):
+        TRPOConfig(train_overlap=1)
+    with pytest.raises(ValueError, match="host_async_pipeline"):
+        TRPOConfig(train_overlap=1, host_async_pipeline=True, **ok)
+    with pytest.raises(ValueError, match="fuse_iterations"):
+        TRPOConfig(train_overlap=1, fuse_iterations=2, **ok)
+    with pytest.raises(ValueError, match="mesh"):
+        TRPOConfig(train_overlap=1, mesh_shape=(2,), **ok)
+    with pytest.raises(ValueError, match="recover_on_nan"):
+        TRPOConfig(train_overlap=1, recover_on_nan="restore", **ok)
+    with pytest.raises(ValueError, match="inject_faults"):
+        TRPOConfig(train_overlap=1, inject_faults="nan_grad@2", **ok)
+
+
+def test_overlap_rejects_host_env():
+    cfg = TRPOConfig(
+        train_overlap=1,
+        rollout_chunk=4,
+        n_envs=2,
+        batch_timesteps=16,
+        vf_train_steps=2,
+        cg_iters=2,
+    )
+    with pytest.raises(ValueError, match="device env"):
+        TRPOAgent("gym:CartPole-v1", cfg)
+
+
+def test_population_rejects_overlap_agent():
+    from trpo_tpu.population import Population
+
+    _, over = _agent_pair()
+    with pytest.raises(ValueError, match="train_overlap"):
+        Population(over, seeds=[0, 1])

@@ -42,6 +42,7 @@ chunk-count-independent — the live-buffer gauges (obs/memory.py) pin
 from __future__ import annotations
 
 import math
+import time
 from functools import partial
 from typing import Any, NamedTuple, Optional
 
@@ -336,6 +337,24 @@ class TRPOAgent:
                 f"steps per rollout window ({self.n_steps})"
             )
 
+        # Overlapped actor/learner pipeline (ISSUE 17): while update k
+        # runs on the learner device, rollout k+1 streams its chunks
+        # through rollout.ChunkedRollout on the actor device into a
+        # host-side double buffer — staleness hard-bounded at one
+        # window, corrected with a per-sample importance weight on the
+        # TRPO surrogate (trpo.TRPOBatch.is_weight). config validates
+        # the knob combinations; the env-family requirement needs the
+        # constructed env, so it lives here.
+        self._overlap = bool(cfg.train_overlap)
+        if self._overlap and not self.is_device_env:
+            raise ValueError(
+                "train_overlap applies to pure-JAX device envs (the "
+                "overlapped pipeline streams rollout.ChunkedRollout "
+                "chunks off the actor device while the learner updates); "
+                "host-simulator envs overlap host stepping with "
+                "host_async_pipeline instead"
+            )
+
         if cfg.host_async_pipeline:
             # fail at construction, not mid-training (same policy as the
             # pipelined-rollout checks below)
@@ -513,6 +532,10 @@ class TRPOAgent:
         # memory_analysis() as a `memory` event.
         self._capture_program_args = False
         self._program_args: dict = {}   # name -> (jitted_fn, abstract args)
+
+        self._overlap_rollout = None
+        if self._overlap:
+            self._setup_overlap()
 
     # ------------------------------------------------------------------
     # state
@@ -1139,6 +1162,17 @@ class TRPOAgent:
             )
         if n < 1:
             raise ValueError(f"n must be >= 1, got {n}")
+        if self._overlap:
+            # cfg.train_overlap replaces the fused scan with the
+            # overlapped host-driven pipeline (the overlap IS a host
+            # loop — there is no single device program to fuse); same
+            # ``(state, stacked-stats)`` contract, numpy leaves
+            state, rows = self._overlap_run(train_state, n)
+            stack = {
+                key: np.stack([np.asarray(r[key]) for r in rows])
+                for key in rows[0]
+            }
+            return state, stack
         fn = self._multi_iter_fns.get(n)
         if fn is None:
             # donate the chunk's input state — the scan carry reuses its
@@ -1494,6 +1528,521 @@ class TRPOAgent:
         return mean_ret, n_done
 
     # ------------------------------------------------------------------
+    # overlapped actor/learner pipeline (ISSUE 17)
+    # ------------------------------------------------------------------
+
+    def _setup_overlap(self) -> None:
+        """Build the overlapped pipeline's machinery.
+
+        Placement: the learner owns ``jax.devices()[0]`` (where every
+        jitted stage program runs by default), the actor owns the second
+        device when one exists — two devices of the same backend execute
+        their committed programs concurrently, which is the overlap.
+        Single-device fallback stays CORRECT (the runtime serializes the
+        two programs); it just cannot be faster.
+
+        The actor's chunk program takes ``(policy_params, obs_norm)`` as
+        its "params" pytree — the normalization stats stay a traced
+        input, so ONE compiled :class:`rollout.ChunkedRollout` program
+        serves every window with zero retraces.
+
+        The learner side runs FOUR separately-jitted stage programs
+        (advantage → FVP/CG solve → line search → merge, plus the VF
+        fit) instead of the fused iteration, so each stage's host-timed
+        dispatch+sync window is a real trace span."""
+        from trpo_tpu.rollout import ChunkedRollout
+        from trpo_tpu.trpo import make_staged_trpo_update
+        from trpo_tpu.utils.normalize import normalize
+
+        devs = jax.devices()
+        self._learner_device = devs[0]
+        self._actor_device = devs[1] if len(devs) > 1 else devs[0]
+
+        pol = self.policy
+        _n = lambda stats, o: o if stats is None else normalize(stats, o)
+        if self.is_recurrent:
+            # wrap BOTH entry points so the object stays self-consistent
+            # (the _normed_policy rule: a step that normalizes and an
+            # apply that doesn't is a silent-wrong-numbers trap)
+            roll_pol = pol._replace(
+                step=lambda ps, h, o: pol.step(ps[0], h, _n(ps[1], o)),
+                apply=lambda ps, seq: pol.apply(
+                    ps[0], seq._replace(obs=_n(ps[1], seq.obs))
+                ),
+            )
+        else:
+            roll_pol = pol._replace(
+                apply=lambda ps, o: pol.apply(ps[0], _n(ps[1], o))
+            )
+        self._overlap_rollout = ChunkedRollout(
+            self.env, roll_pol, self.cfg.rollout_chunk
+        )
+        solve, finish = make_staged_trpo_update(
+            self.policy, self.cfg, allow_fused=self.cfg.mesh_shape is None
+        )
+        self._overlap_solve_fn = jax.jit(solve)
+        self._overlap_finish_fn = jax.jit(finish)
+        # stale=False (the pipeline's fill window, collected by the
+        # CURRENT params) is the PLAIN synchronous batch — behavior dist
+        # as the anchor, no IS weight — so the first overlapped
+        # iteration is bit-exact vs the serial loop (test-pinned)
+        self._overlap_adv_fns = {
+            stale: jax.jit(partial(self._overlap_adv_phase, stale=stale))
+            for stale in (False, True)
+        }
+        self._overlap_merge_fn = jax.jit(self._overlap_merge_phase)
+        # the overlap analogue of the host drivers' phase-B program; the
+        # ONLY donating stage program — everything else keeps its inputs
+        # alive across the learner-thread boundary
+        self._overlap_vf_fn = jax.jit(
+            self._vf_stats_phase, donate_argnums=0
+        )
+
+    def _overlap_adv_phase(self, train_state, traj, roll_stats, *, stale):
+        """Learner stage 1: obs-norm fold + roll-stats normalization →
+        GAE → advantage standardization → ``TRPOBatch`` assembly — the
+        head of ``_policy_phase``, taking the normalization stats the
+        ROLLOUT used explicitly (``roll_stats``) because under overlap
+        they are one window older than ``train_state.obs_norm``.
+
+        Staleness correction (``stale=True``, every steady-state
+        window): the KL/Fisher anchor is recomputed at the CURRENT
+        params (stop-gradient) and the per-sample importance weight
+        π_anchor/π_behavior multiplies the surrogate ratio (trpo.py) —
+        the trust region is taken around the policy being updated, not
+        the one-window-old behavior policy. Every distribution (behavior
+        from the trajectory, anchor recomputed here) is evaluated over
+        the SAME roll-stats-normalized observations: one normalization
+        space. ``stale=False`` (the fill window, collected by the
+        current params) skips both — the plain synchronous batch,
+        bit-exact by construction."""
+        cfg = self.cfg
+        T, N = traj.rewards.shape
+        flat = lambda x: x.reshape((T * N,) + x.shape[2:])
+
+        new_obs_norm = train_state.obs_norm
+        if self._obs_norm_on_device and train_state.obs_norm is not None:
+            from trpo_tpu.utils.normalize import normalize, update_stats
+
+            # fold the raw window into the CURRENT stats (every window
+            # folded exactly once, in consumption order); normalize with
+            # the stats the rollout used so the replayed behavior
+            # distributions match traj.old_dist exactly
+            new_obs_norm = update_stats(
+                train_state.obs_norm, flat(traj.obs)
+            )
+            traj = traj._replace(
+                obs=normalize(roll_stats, traj.obs),
+                next_obs=normalize(roll_stats, traj.next_obs),
+            )
+
+        adv, vtarg, values = self._advantages(train_state.vf_state, traj)
+        weight = jnp.ones(T * N, jnp.float32)
+        adv_flat = flat(adv)
+        if cfg.standardize_advantages:
+            adv_flat = standardize_advantages(adv_flat, weight)
+        vf_in, _ = self._vf_features(traj)
+
+        if self.is_recurrent:
+            from trpo_tpu.models.recurrent import SeqObs
+
+            batch = TRPOBatch(
+                obs=SeqObs(traj.obs, traj.reset, traj.policy_h0),
+                actions=traj.actions,
+                advantages=adv_flat.reshape(T, N),
+                old_dist=traj.old_dist,
+                weight=weight.reshape(T, N),
+            )
+        else:
+            batch = TRPOBatch(
+                obs=flat(traj.obs),
+                actions=flat(traj.actions),
+                advantages=adv_flat,
+                old_dist=jax.tree_util.tree_map(flat, traj.old_dist),
+                weight=weight,
+            )
+        if stale:
+            anchor = jax.tree_util.tree_map(
+                jax.lax.stop_gradient,
+                self.policy.apply(train_state.policy_params, batch.obs),
+            )
+            logp_anchor = self.policy.dist.logp(anchor, batch.actions)
+            logp_behavior = self.policy.dist.logp(
+                batch.old_dist, batch.actions
+            )
+            batch = batch._replace(
+                old_dist=anchor,
+                is_weight=jax.lax.stop_gradient(
+                    jnp.exp(logp_anchor - logp_behavior)
+                ),
+            )
+
+        done_f = traj.done.astype(jnp.float32)
+        n_episodes = jnp.sum(traj.done)
+        ep_denom = jnp.maximum(n_episodes, 1)
+        no_eps = n_episodes == 0
+        aux = {
+            "vf_in": vf_in,
+            "vtarg": flat(vtarg),
+            "values": flat(values),
+            "weight": weight,
+            "new_obs_norm": new_obs_norm,
+            "n_episodes": n_episodes.astype(jnp.int32),
+            "mean_episode_reward": jnp.where(
+                no_eps, jnp.nan,
+                jnp.sum(traj.episode_return * done_f) / ep_denom,
+            ),
+            "mean_episode_length": jnp.where(
+                no_eps, jnp.nan,
+                jnp.sum(traj.episode_length.astype(jnp.float32) * done_f)
+                / ep_denom,
+            ),
+        }
+        return batch, aux
+
+    def _overlap_merge_phase(self, train_state, new_policy_params,
+                             trpo_stats, aux):
+        """Learner stage 4: fold the update's outputs into the
+        ``TrainState`` and assemble the fit-pack the VF/stats program
+        consumes — the exact tail of ``_policy_phase`` (same fields,
+        same order; the bit-exactness pins in tests/test_overlap.py
+        keep the two copies honest)."""
+        T_N = aux["weight"].shape[0]
+        new_metrics = train_state.metrics
+        if new_metrics is not None:
+            new_metrics = accumulate_update(
+                new_metrics, trpo_stats, trpo_stats.cg_budget
+            )
+        new_state = train_state._replace(
+            policy_params=new_policy_params,
+            obs_norm=aux["new_obs_norm"],
+            iteration=train_state.iteration + 1,
+            total_episodes=train_state.total_episodes + aux["n_episodes"],
+            total_timesteps=train_state.total_timesteps + T_N,
+            cg_damping=trpo_stats.damping_next
+            if self.cfg.adaptive_damping
+            else train_state.cg_damping,
+            precond=trpo_stats.precond_next
+            if trpo_stats.precond_next is not None
+            else train_state.precond,
+            metrics=new_metrics,
+            ladder=trpo_stats.ladder_next
+            if trpo_stats.ladder_next is not None
+            else train_state.ladder,
+        )
+        trpo_stats = trpo_stats._replace(
+            precond_next=None, ladder_next=None
+        )
+        fit_pack = {
+            "vf_in": aux["vf_in"],
+            "vtarg": aux["vtarg"],
+            "values": aux["values"],
+            "weight": aux["weight"],
+            "trpo_stats": trpo_stats,
+            "total_episodes": new_state.total_episodes,
+            "mean_episode_reward": aux["mean_episode_reward"],
+            "mean_episode_length": aux["mean_episode_length"],
+            "episodes_in_batch": aux["n_episodes"],
+            "device_metrics": new_metrics,
+            "ladder": new_state.ladder,
+        }
+        return new_state, fit_pack
+
+    def _overlap_collect(self, roll_params, carry, key, ctx, root_id):
+        """Collect ONE ``(T, N)`` window on the actor device by
+        streaming :class:`rollout.ChunkedRollout` chunks into a host
+        buffer — the double buffer the learner consumes NEXT iteration
+        lives on the host as numpy, so a window never pins actor memory
+        across the overlap boundary. Per chunk, two spans:
+        ``train/rollout_chunk`` (dispatch → chunk ready on the actor)
+        and ``train/transfer`` (the device→host fetch). ``carry`` is
+        DONATED chunk-to-chunk (ChunkedRollout's contract); returns
+        ``(final_carry, Trajectory_host)``."""
+        parts = []
+        h0 = None
+        for carry, cj in self._overlap_rollout.iter_chunks(
+            roll_params, carry, key, self.n_steps
+        ):
+            if ctx is not None:
+                t0, p0 = time.time(), time.perf_counter()
+                jax.block_until_ready(cj)
+                ctx.record(
+                    "train/rollout_chunk", t0,
+                    (time.perf_counter() - p0) * 1e3,
+                    parent_id=root_id,
+                )
+                t0, p0 = time.time(), time.perf_counter()
+                cj = jax.device_get(cj)
+                ctx.record(
+                    "train/transfer", t0,
+                    (time.perf_counter() - p0) * 1e3,
+                    parent_id=root_id,
+                )
+            else:
+                cj = jax.device_get(cj)
+            if self.is_recurrent:
+                if h0 is None:
+                    h0 = cj.policy_h0  # window-entry memory: chunk 0's
+                cj = cj._replace(policy_h0=None)
+            parts.append(cj)
+        if len(parts) == 1:
+            traj = parts[0]
+        else:
+            traj = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(xs, axis=0), *parts
+            )
+        if self.is_recurrent:
+            traj = traj._replace(policy_h0=h0)
+        return carry, traj
+
+    def _overlap_learner_step(self, state, window, roll_stats, stale,
+                              ctx, root_id):
+        """ONE learner update against a host-buffered window — runs on
+        the pipeline's learner thread while the main thread streams the
+        next window's chunks. Four stage programs with a hard sync
+        between each, so the spans are true host-side stage times:
+        ``train/update`` ⊃ {advantage, fvp_cg_solve, linesearch,
+        vf_fit}. Returns ``(new_state, host_stats)``."""
+        def staged(name, parent, fn, *args):
+            t0, p0 = time.time(), time.perf_counter()
+            out = fn(*args)
+            jax.block_until_ready(out)
+            if ctx is not None:
+                ctx.record(
+                    name, t0, (time.perf_counter() - p0) * 1e3,
+                    parent_id=parent,
+                )
+            return out
+
+        up_id = None
+        t_up, p_up = time.time(), time.perf_counter()
+        if ctx is not None:
+            from trpo_tpu.obs.trace import mint_span_id
+
+            up_id = mint_span_id()
+        batch, aux = staged(
+            "train/advantage", up_id, self._overlap_adv_fns[bool(stale)],
+            state, window, roll_stats,
+        )
+        pack = staged(
+            "train/fvp_cg_solve", up_id, self._overlap_solve_fn,
+            state.policy_params, batch, state.cg_damping, state.precond,
+            state.ladder,
+        )
+        new_params, trpo_stats = staged(
+            "train/linesearch", up_id, self._overlap_finish_fn,
+            state.policy_params, batch, pack,
+        )
+        new_state, fit_pack = self._overlap_merge_fn(
+            state, new_params, trpo_stats, aux
+        )
+        new_vf_state, stats = staged(
+            "train/vf_fit", up_id, self._overlap_vf_fn,
+            new_state.vf_state, fit_pack,
+        )
+        host_stats = jax.device_get(stats)
+        if ctx is not None:
+            ctx.record(
+                "train/update", t_up,
+                (time.perf_counter() - p_up) * 1e3,
+                parent_id=root_id, span_id=up_id, stale=bool(stale),
+            )
+        return new_state._replace(vf_state=new_vf_state), host_stats
+
+    def _overlap_run(self, state, n_iterations, *, tracer=None,
+                     timer=None, on_row=None, pre_iter=None):
+        """The overlapped actor/learner loop (``cfg.train_overlap``).
+
+        Schedule (staleness hard-bounded at ONE window): collect window
+        0 with (θ₀, ν₀); then per iteration k, submit the learner step
+        for window k while the main thread streams window k+1's chunks
+        with the params/stats of the state the learner STARTED from —
+        so window k+1 is consumed one update later than it was
+        collected, and the ``stale=True`` advantage program applies the
+        importance-weight correction. The fill window (k=0) was
+        collected by the current params: ``stale=False``, bit-exact vs
+        the serial loop.
+
+        ``on_row(k, state, host_stats, iter_ms) -> stop`` runs per
+        iteration on the main thread after the learner joins;
+        ``pre_iter(k, state)`` runs before each submission (guard/
+        profiler hooks). A triggered stop discards the in-flight window
+        — stop conditions can overshoot COLLECTION by one window, never
+        the update. Returns ``(state, rows)``; ``state.env_carry``/
+        ``rng`` are refreshed every iteration, so checkpoints taken
+        from ``on_row`` resume the env and key chains correctly."""
+        from concurrent.futures import ThreadPoolExecutor
+        from contextlib import nullcontext
+
+        ctx = root_id = None
+        run_t0 = run_p0 = None
+        if tracer is not None:
+            from trpo_tpu.obs.trace import TraceContext, mint_span_id
+
+            ctx = tracer.begin()
+            root_id = mint_span_id()
+            run_t0, run_p0 = time.time(), time.perf_counter()
+        tphase = (
+            timer.phase if timer is not None
+            else (lambda name: nullcontext())
+        )
+
+        rng = state.rng
+        rows: list = []
+        # the env/episode/recurrent-h carry lives on the ACTOR device
+        # for the whole run; jnp.copy first — on the single-device
+        # fallback device_put aliases, and the chunk program DONATES the
+        # carry it is handed, which must never invalidate state.env_carry
+        carry = jax.device_put(
+            jax.tree_util.tree_map(jnp.copy, state.env_carry),
+            self._actor_device,
+        )
+        actor_dev = self._actor_device
+        try:
+            with ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="trpo-learner"
+            ) as learner:
+                rng, k_roll = jax.random.split(rng)
+                roll_stats = state.obs_norm
+                carry, window = self._overlap_collect(
+                    jax.device_put(
+                        (state.policy_params, roll_stats), actor_dev
+                    ),
+                    carry, jax.device_put(k_roll, actor_dev),
+                    ctx, root_id,
+                )
+                for k in range(n_iterations):
+                    if pre_iter is not None:
+                        pre_iter(k, state)
+                    it_p0 = time.perf_counter()
+                    with tphase("iteration"):
+                        fut = learner.submit(
+                            self._overlap_learner_step, state, window,
+                            roll_stats, k > 0, ctx, root_id,
+                        )
+                        next_window = next_stats = None
+                        if k + 1 < n_iterations:
+                            # params/stats read BEFORE the join: the
+                            # state the learner started from — the
+                            # behavior policy of the stale window
+                            rng, k_roll = jax.random.split(rng)
+                            next_stats = state.obs_norm
+                            carry, next_window = self._overlap_collect(
+                                jax.device_put(
+                                    (state.policy_params, next_stats),
+                                    actor_dev,
+                                ),
+                                carry,
+                                jax.device_put(k_roll, actor_dev),
+                                ctx, root_id,
+                            )
+                        state, host_stats = fut.result()
+                    iter_ms = (time.perf_counter() - it_p0) * 1e3
+                    # refresh the host-driven carries into the state so
+                    # mid-run checkpoints resume both chains (jnp.copy:
+                    # the chunk program will donate `carry`'s buffers)
+                    state = state._replace(
+                        env_carry=jax.device_put(
+                            jax.tree_util.tree_map(jnp.copy, carry),
+                            self._learner_device,
+                        ),
+                        rng=rng,
+                    )
+                    rows.append(host_stats)
+                    if tracer is not None:
+                        # flush this window's (all-ended) spans, then
+                        # renew the context: bounds the tracer's pending
+                        # buffer to one window regardless of run length.
+                        # The root span is booked retroactively at the
+                        # end — the validator's orphan/unterminated
+                        # checks are whole-file, not ordered.
+                        tracer.finish(ctx)
+                        ctx = TraceContext(ctx.trace_id, ctx.sampled)
+                    stop = on_row is not None and on_row(
+                        k, state, host_stats, iter_ms
+                    )
+                    if stop:
+                        break
+                    window, roll_stats = next_window, next_stats
+        finally:
+            if tracer is not None:
+                ctx.record(
+                    "train/run", run_t0,
+                    (time.perf_counter() - run_p0) * 1e3,
+                    span_id=root_id, overlap=1,
+                    staleness_bound=int(self.cfg.train_overlap),
+                    iterations=len(rows),
+                )
+                tracer.finish(ctx)
+        return state, rows
+
+    def _learn_overlap(self, n_iterations, state, logger, checkpointer,
+                       callback, timer, telemetry, *, guard):
+        """``learn``'s overlapped driver: the same per-row semantics as
+        the serial loop — every row flows through
+        ``_finish_iteration_stats`` (stop rules, NaN abort, logging,
+        health checks) — around :meth:`_overlap_run`. The chaos
+        injector and NaN-restore recovery are refused by config
+        validation (they assume the serial driver's state handoff), so
+        neither threads through here."""
+        cfg = self.cfg
+        from trpo_tpu.envs.episode_stats import RunningEpisodeMean
+
+        reward_running = RunningEpisodeMean()
+        it0 = int(state.iteration)
+        bus = telemetry.bus if telemetry is not None else None
+        tracer = None
+        if telemetry is not None and cfg.trace_sample_rate > 0:
+            from trpo_tpu.obs.trace import Tracer
+
+            tracer = Tracer(
+                telemetry.bus, cfg.trace_sample_rate, process="train"
+            )
+
+        def pre_iter(k, st):
+            if guard.triggered:
+                # rows of every finished iteration are already processed
+                # (on_row runs synchronously), and st carries the
+                # refreshed env/rng chains — clean to persist
+                self._preempt_shutdown(st, checkpointer, bus, guard)
+            if telemetry is not None:
+                telemetry.profile_tick(it0 + k + 1, span=1)
+
+        def on_row(k, st, host_stats, iter_ms):
+            row = {
+                key: np.asarray(v).item()
+                for key, v in host_stats.items()
+            }
+            it = it0 + k + 1
+            stop = self._finish_iteration_stats(
+                row, reward_running, logger,
+                iteration=it, iteration_ms=iter_ms,
+                timesteps_total=int(st.total_timesteps),
+                telemetry=telemetry,
+            )
+            if telemetry is not None and k + 1 >= 2:
+                # every program (fill-window stale=False at k=0, steady
+                # stale=True at k=1, the chunk program at window 0) has
+                # compiled by the end of iteration 1
+                telemetry.mark_steady()
+            if callback is not None:
+                callback(st, row)
+            if checkpointer is not None and it % cfg.checkpoint_every == 0:
+                checkpointer.save(it, st)
+            return stop
+
+        try:
+            state, _ = self._overlap_run(
+                state, n_iterations, tracer=tracer, timer=timer,
+                on_row=on_row, pre_iter=pre_iter,
+            )
+        finally:
+            if tracer is not None:
+                tracer.drain()
+                tracer.close()
+        return state
+
+    # ------------------------------------------------------------------
     # learn (ref trpo_inksci.py:88-176)
     # ------------------------------------------------------------------
 
@@ -1572,7 +2121,9 @@ class TRPOAgent:
                 logger.bus = telemetry.bus
             telemetry.start_run(
                 cfg,
-                driver="async"
+                driver="overlap"
+                if self._overlap
+                else "async"
                 if cfg.host_async_pipeline and not self.is_device_env
                 else "serial",
                 n_iterations=n_iterations,
@@ -1626,6 +2177,20 @@ class TRPOAgent:
                         n_iterations, state, logger, checkpointer,
                         callback, timer, telemetry,
                         injector=injector, recovery=recovery, guard=guard,
+                    )
+            finally:
+                if telemetry is not None:
+                    telemetry.finish_run(timer)
+                if own_logger:
+                    logger.close()
+        if self._overlap:
+            # the overlapped actor/learner pipeline (injector/recovery
+            # are refused by config validation for this driver)
+            try:
+                with guard:
+                    return self._learn_overlap(
+                        n_iterations, state, logger, checkpointer,
+                        callback, timer, telemetry, guard=guard,
                     )
             finally:
                 if telemetry is not None:

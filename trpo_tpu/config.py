@@ -347,6 +347,27 @@ class TRPOConfig:
     #                                pipeline depth (≤ 2 iterations) — the
     #                                same granularity trade fuse_iterations
     #                                makes for device envs.
+    train_overlap: int = 0         # pure-JAX device envs only: the training
+    #                                pipeline's HARD staleness bound, in
+    #                                windows. 0 (default) = the synchronous
+    #                                loop, bit-exact vs every pre-overlap
+    #                                driver. 1 = the overlapped actor/learner
+    #                                pipeline (agent._learn_overlap): while
+    #                                update k runs on the learner device,
+    #                                rollout k+1 streams its chunks through
+    #                                rollout.ChunkedRollout (requires
+    #                                rollout_chunk) into a double-buffered
+    #                                host-side window on a second device when
+    #                                one exists — so window k+1 is collected
+    #                                under the behavior policy θ_k, exactly
+    #                                one update stale. The update stays a
+    #                                sound trust region via a per-sample
+    #                                importance weight on the surrogate
+    #                                (TRPOBatch.is_weight — π_cur/π_behavior,
+    #                                stop-gradient) with the KL anchor
+    #                                recomputed at the CURRENT params.
+    #                                Values > 1 are rejected: the bound is
+    #                                the contract.
     stats_drain_maxsize: int = 2   # async pipeline only: bound on the
     #                                deferred-stats queue
     #                                (utils/async_pipe.StatsDrain). When the
@@ -723,6 +744,55 @@ class TRPOConfig:
                     f"ceil(batch_timesteps={self.batch_timesteps} / "
                     f"n_envs={self.resolved_n_envs()})) — pick a divisor "
                     "or adjust batch_timesteps/the fleet width"
+                )
+        if self.train_overlap not in (0, 1):
+            raise ValueError(
+                f"train_overlap must be 0 (synchronous) or 1 (one-window "
+                f"staleness), got {self.train_overlap} — the bound is a "
+                "hard contract, not a queue depth"
+            )
+        if self.train_overlap:
+            # fail at construction, not mid-training (the repo-wide policy):
+            # each of these owns the iteration's sequencing in a way the
+            # overlapped driver cannot compose with
+            if self.rollout_chunk is None:
+                raise ValueError(
+                    "train_overlap=1 streams the rollout through the "
+                    "chunked-rollout seam (rollout.ChunkedRollout) — set "
+                    "rollout_chunk (a divisor of the steps per window)"
+                )
+            if self.host_async_pipeline:
+                raise ValueError(
+                    "train_overlap and host_async_pipeline are mutually "
+                    "exclusive pipelines (device-env overlap vs host-env "
+                    "overlap) — pick the one matching the env family"
+                )
+            if self.fuse_iterations != 1:
+                raise ValueError(
+                    f"train_overlap=1 is incompatible with fuse_iterations="
+                    f"{self.fuse_iterations}: the overlap driver already "
+                    "owns the iteration boundary (rollout k+1 streams "
+                    "inside update k) — a fused multi-iteration program "
+                    "has no boundary to overlap across"
+                )
+            if self.mesh_shape is not None:
+                raise ValueError(
+                    "train_overlap=1 places the actor and learner programs "
+                    "by device itself and cannot compose with a GSPMD mesh "
+                    "(mesh_shape) — drop one of the two"
+                )
+            if self.recover_on_nan == "restore":
+                raise ValueError(
+                    'train_overlap=1 does not support recover_on_nan='
+                    '"restore": the rewind would have to unwind an '
+                    "in-flight stale window as well as the update — run "
+                    "the synchronous loop when restore-recovery matters"
+                )
+            if self.inject_faults:
+                raise ValueError(
+                    "train_overlap=1 does not support inject_faults: the "
+                    "chaos injector's iteration triggers assume the "
+                    "serial driver's state handoff"
                 )
         if self.host_inference not in ("device", "cpu"):
             raise ValueError(

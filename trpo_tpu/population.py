@@ -65,6 +65,13 @@ class Population:
                 "pass a meshless agent; the population axis is the thing "
                 "being sharded (mesh=... here)"
             )
+        if agent.cfg.train_overlap:
+            raise ValueError(
+                "Population cannot drive the overlapped training "
+                "pipeline (train_overlap): the member vmap wraps the "
+                "fused device iteration, and the overlap is a host-side "
+                "driver — train population members with train_overlap=0"
+            )
         if len(seeds) == 0:
             raise ValueError("population needs at least one seed")
         if lam is not None and len(lam) != len(seeds):

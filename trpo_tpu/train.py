@@ -231,6 +231,25 @@ def build_parser() -> argparse.ArgumentParser:
         "serial driver",
     )
     p.add_argument(
+        "--overlap",
+        action="store_true",
+        help="pure-JAX device envs with --rollout-chunk: overlapped "
+        "actor/learner training pipeline — rollout k+1 streams its "
+        "chunks off the actor device while update k runs on the "
+        "learner device, staleness hard-bounded at one window and "
+        "corrected with a per-sample importance weight on the TRPO "
+        "surrogate (cfg.train_overlap=1)",
+    )
+    p.add_argument(
+        "--trace-sample-rate",
+        type=float,
+        metavar="RATE",
+        help="with --metrics-jsonl: head-sampling rate in [0, 1] for "
+        "training-loop trace spans (rollout-chunk / transfer / "
+        "advantage / FVP+CG solve / line search / VF fit under each "
+        "update) — 1.0 traces every iteration, 0 (default) disables",
+    )
+    p.add_argument(
         "--no-host-staged-transfers",
         action="store_true",
         help="disable staged trajectory transfers in the pipelined host "
@@ -426,6 +445,10 @@ _OVERRIDES = {
     "policy_experts": "policy_experts",
     "host_pipeline_groups": "host_pipeline_groups",
     "host_async_pipeline": "host_async_pipeline",
+    # --overlap (store_true) maps to the staleness bound: True == 1,
+    # the one-window pipeline
+    "overlap": "train_overlap",
+    "trace_sample_rate": "trace_sample_rate",
     "host_inference": "host_inference",
     "compute_dtype": "compute_dtype",
     "log_jsonl": "log_jsonl",

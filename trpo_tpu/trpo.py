@@ -63,11 +63,13 @@ from trpo_tpu.ops.treemath import (
 
 __all__ = [
     "LadderState",
+    "SolvePack",
     "TRPOBatch",
     "TRPOStats",
     "init_ladder",
     "ladder_enabled",
     "ladder_stateful",
+    "make_staged_trpo_update",
     "make_trpo_update",
     "make_tree_trpo_update",
     "surrogate_and_dist",
@@ -90,6 +92,13 @@ class TRPOBatch(NamedTuple):
     advantages: jax.Array   # (B,) or (T, N) — already standardized
     old_dist: Any           # dist params pytree, leading (B, ...)/(T, N, ...)
     weight: jax.Array       # (B,) or (T, N) — 1.0 real step, 0.0 padding
+    is_weight: Any = None   # (B,) or (T, N) importance weight for STALE
+    #   windows (the overlapped actor/learner pipeline, cfg.train_overlap):
+    #   stop-gradient π_anchor(a|s)/π_behavior(a|s), multiplied into the
+    #   surrogate's ratio so the gradient is the off-policy-corrected
+    #   policy gradient while old_dist holds the KL/Fisher ANCHOR (the
+    #   current params' dist). None (every on-policy caller) keeps the
+    #   surrogate bit-exact with the pre-overlap op sequence.
 
 
 class TRPOStats(NamedTuple):
@@ -210,9 +219,13 @@ def surrogate_and_dist(
         logp_old = policy.dist.logp(batch.old_dist, batch.actions)
     dist_params = policy.apply(params, batch.obs)
     logp = policy.dist.logp(dist_params, batch.actions)
-    surr = -_wmean(
-        jnp.exp(logp - logp_old) * batch.advantages, batch.weight
-    )
+    ratio = jnp.exp(logp - logp_old)
+    if batch.is_weight is not None:
+        # stale-window correction (cfg.train_overlap): the ratio is
+        # anchored at the current params (old_dist = anchor), and the
+        # behavior policy's mismatch is a constant per-sample weight
+        ratio = ratio * jax.lax.stop_gradient(batch.is_weight)
+    surr = -_wmean(ratio * batch.advantages, batch.weight)
     return surr, dist_params
 
 
@@ -418,6 +431,35 @@ def _skewed_operator(op, skew: float):
     return lambda v: scale(op(scale(v)))
 
 
+class SolvePack(NamedTuple):
+    """Everything crossing the gradient/CG-solve → line-search seam.
+
+    The update body is written as two pure stages composed by
+    :func:`_natural_gradient_update` — tracing the composition produces
+    the SAME op sequence as the historical single body (the bit-exactness
+    contract), while the overlapped training driver
+    (``agent._learn_overlap`` via :func:`make_staged_trpo_update`) jits
+    the stages as SEPARATE programs so each gets its own host-timed
+    trace span (train/fvp_cg_solve, train/linesearch)."""
+
+    fullstep: Any                 # KL-radius-scaled step direction
+    expected_improve_rate: jax.Array
+    surr_before: jax.Array        # the line search's f0
+    dist0: Any                    # dist at x0 (the search's aux0)
+    logp_old: jax.Array           # parameter-independent rollout logp
+    grad_norm: jax.Array
+    cg_iterations: jax.Array
+    cg_residual: jax.Array
+    damping: jax.Array            # λ used this update (resolved f32)
+    precond_next: Any             # PrecondState | None
+    ladder_next: Any              # LadderState | None
+    solve_cosine: jax.Array
+    solve_audited: Any
+    solve_fallback: Any
+    solve_pinned: Any
+    cg_budget: jax.Array
+
+
 def _natural_gradient_update(
     policy: Policy, cfg: TRPOConfig, to_params: Callable[[Any], Any],
     x0: Any, batch: TRPOBatch, damping=None, allow_fused: bool = True,
@@ -461,7 +503,28 @@ def _natural_gradient_update(
     (``cfg.linesearch_kl_cap``) reads the same forward instead of running
     its own — so a first-try-accepted update runs exactly ONE full-batch
     forward beyond grad + FVPs, where the pre-fusion program ran four.
+
+    Internally composed from :func:`_solve_stage` and
+    :func:`_finish_stage` (the overlapped driver's staged seam — see
+    :class:`SolvePack`); tracing the composition in one jit yields the
+    same jaxpr as the historical single body.
     """
+    pack = _solve_stage(
+        policy, cfg, to_params, x0, batch, damping,
+        allow_fused=allow_fused, precond=precond, ladder=ladder,
+    )
+    return _finish_stage(policy, cfg, to_params, x0, batch, pack)
+
+
+def _solve_stage(
+    policy: Policy, cfg: TRPOConfig, to_params: Callable[[Any], Any],
+    x0: Any, batch: TRPOBatch, damping=None, allow_fused: bool = True,
+    precond=None, ladder=None,
+) -> SolvePack:
+    """Stage 1 of the update: one fused gradient/surrogate pass →
+    damped-Fisher operator → (audited / budget-adaptive) CG solve →
+    KL-radius step scaling. Returns the :class:`SolvePack` the
+    line-search stage consumes."""
 
     # logp under the ROLLOUT distributions is parameter-independent —
     # computed once, shared by the surrogate at every evaluation point
@@ -801,6 +864,42 @@ def _natural_gradient_update(
         fullstep = tree_scale(1.0 / lm, stepdir)
         expected_improve_rate = tree_vdot(neg_g, stepdir) / lm
 
+    return SolvePack(
+        fullstep=fullstep,
+        expected_improve_rate=expected_improve_rate,
+        surr_before=surr_before,
+        dist0=dist0,
+        logp_old=logp_old,
+        grad_norm=grad_norm,
+        cg_iterations=cg_iterations,
+        cg_residual=cg_residual,
+        damping=damping,
+        precond_next=precond_next,
+        ladder_next=ladder_next,
+        solve_cosine=solve_cosine,
+        solve_audited=audited,
+        solve_fallback=fallback,
+        solve_pinned=pinned,
+        cg_budget=budget_used,
+    )
+
+
+def _finish_stage(
+    policy: Policy, cfg: TRPOConfig, to_params: Callable[[Any], Any],
+    x0: Any, batch: TRPOBatch, pack: SolvePack,
+) -> Tuple[Any, TRPOStats]:
+    """Stage 2 of the update: backtracking line search along the scaled
+    step → KL rollback → final params + the full :class:`TRPOStats`."""
+    logp_old = pack.logp_old
+    surr_before = pack.surr_before
+    dist0 = pack.dist0
+    fullstep = pack.fullstep
+    expected_improve_rate = pack.expected_improve_rate
+    damping = pack.damping
+
+    def surr_with_dist(x):
+        return surrogate_and_dist(policy, to_params(x), batch, logp_old)
+
     ls_constraint = None
     if cfg.linesearch_kl_cap:
         # KL-aware acceptance: backtrack past cap-violating candidates
@@ -845,9 +944,12 @@ def _natural_gradient_update(
         # full forward here.
         final_dist = tree_where(rollback, dist0, dist_ls)
         logp_new = policy.dist.logp(final_dist, batch.actions)
-        surr_after = -_wmean(
-            jnp.exp(logp_new - logp_old) * batch.advantages, batch.weight
-        )
+        ratio_new = jnp.exp(logp_new - logp_old)
+        if batch.is_weight is not None:
+            # stale-window correction — same weighting as the surrogate
+            # the search optimized (surrogate_and_dist)
+            ratio_new = ratio_new * batch.is_weight
+        surr_after = -_wmean(ratio_new * batch.advantages, batch.weight)
         damping_next = (
             _next_damping(cfg, damping, ls.success, rollback)
             if cfg.adaptive_damping
@@ -860,7 +962,7 @@ def _natural_gradient_update(
         # the host-side NaN-entropy abort, and the device counter
         # (obs/device_metrics.py) count trips with no extra transfers
         nan_guard = jnp.logical_not(
-            jnp.isfinite(grad_norm)
+            jnp.isfinite(pack.grad_norm)
             & jnp.isfinite(surr_after)
             & jnp.isfinite(entropy)
         )
@@ -869,24 +971,24 @@ def _natural_gradient_update(
         surrogate_after=surr_after,
         kl=_wmean(policy.dist.kl(batch.old_dist, final_dist), batch.weight),
         entropy=entropy,
-        grad_norm=grad_norm,
+        grad_norm=pack.grad_norm,
         step_norm=tree_norm(tree_sub(x_new, x0)),
-        cg_iterations=cg_iterations,
-        cg_residual=cg_residual,
+        cg_iterations=pack.cg_iterations,
+        cg_residual=pack.cg_residual,
         linesearch_success=ls.success,
         step_fraction=ls.step_fraction,
         rolled_back=rollback,
         damping=damping,
         damping_next=damping_next,
-        precond_next=precond_next,
+        precond_next=pack.precond_next,
         linesearch_trials=ls.trials,
         nan_guard=nan_guard,
-        solve_cosine=solve_cosine,
-        solve_audited=audited,
-        solve_fallback=fallback,
-        solve_pinned=pinned,
-        cg_budget=budget_used,
-        ladder_next=ladder_next,
+        solve_cosine=pack.solve_cosine,
+        solve_audited=pack.solve_audited,
+        solve_fallback=pack.solve_fallback,
+        solve_pinned=pack.solve_pinned,
+        cg_budget=pack.cg_budget,
+        ladder_next=pack.ladder_next,
     )
     return new_params, stats
 
@@ -917,6 +1019,41 @@ def make_trpo_update(
         )
 
     return update
+
+
+def make_staged_trpo_update(
+    policy: Policy, cfg: TRPOConfig, allow_fused: bool = True
+):
+    """:func:`make_trpo_update` split at the solve → line-search seam:
+    returns ``(solve, finish)`` where ``solve(params, batch, damping,
+    precond, ladder) -> SolvePack`` runs the gradient pass + FVP/CG solve
+    + step scaling and ``finish(params, batch, pack) -> (new_params,
+    stats)`` runs the line search, KL rollback, and stats assembly.
+
+    ``finish(params, batch, solve(params, batch, ...))`` computes exactly
+    what ``make_trpo_update``'s fused update computes (both are the same
+    two stage bodies; the fused update traces their composition). The
+    split exists for the overlapped training driver
+    (``agent._learn_overlap``): jitted as separate programs, each stage's
+    host-side dispatch+sync window is a REAL trace span
+    (train/fvp_cg_solve, train/linesearch), not an estimate.
+    Flat-vector domain only (the overlap driver rejects meshes)."""
+
+    def solve(params, batch: TRPOBatch, damping=None, precond=None,
+              ladder=None) -> SolvePack:
+        flat0, unravel = flatten_params(params)
+        flat0 = jnp.asarray(flat0, jnp.float32)
+        return _solve_stage(
+            policy, cfg, unravel, flat0, batch, damping,
+            allow_fused=allow_fused, precond=precond, ladder=ladder,
+        )
+
+    def finish(params, batch: TRPOBatch, pack: SolvePack):
+        flat0, unravel = flatten_params(params)
+        flat0 = jnp.asarray(flat0, jnp.float32)
+        return _finish_stage(policy, cfg, unravel, flat0, batch, pack)
+
+    return solve, finish
 
 
 def make_tree_trpo_update(
