@@ -7,6 +7,8 @@
     python scripts/analyze_run.py ROUTER.jsonl --merge replica0.jsonl \\
         --merge replica1.jsonl --trace <id>        # one trace waterfall
     python scripts/analyze_run.py ROUTER.jsonl --slowest-traces 5
+    python scripts/analyze_run.py ROUTER.jsonl --merge replica0.jsonl \\
+        --export-bundle <trace_id> --journal-dir JDIR --out B.json
 
 Single file: a run report — per-phase time table, throughput (steady
 iteration ms + timesteps/s), health/recompile/fault summary, peak-memory
@@ -30,6 +32,14 @@ renders one assembled trace as a text waterfall (``--json``: the raw
 span list); ``--slowest-traces K`` ranks the top-K traces by root
 duration with their per-stage breakdown (``--json``: machine-readable
 rows — stdout stays parseable, the fleet CLI contract).
+
+Deterministic replay (ISSUE 18): ``--export-bundle <trace_id>`` (or
+``--export-bundle --window START END`` for an incident window) joins
+the capture log, the assembled traces, and — via ``--journal-dir`` —
+the carry journals into a self-contained replay bundle that
+``scripts/replay_run.py`` re-executes bit-exact against a shadow
+replica set. An unknown trace id or a capture log without payloads is
+a one-line refusal and exit 2, never a stack trace.
 
 Exit codes (the contract ``scripts/check.sh``'s regression gate relies
 on): **0** = summarized / compared clean, **1** = at least one metric
@@ -96,6 +106,30 @@ def build_parser() -> argparse.ArgumentParser:
         "--slowest-traces", metavar="K", type=int,
         help="rank the top-K assembled traces by root duration with "
         "their per-stage breakdown",
+    )
+    p.add_argument(
+        "--export-bundle", metavar="TRACE_ID", nargs="?", const="",
+        default=None,
+        help="build a deterministic-replay bundle (ISSUE 18) for ONE "
+        "captured trace id, or — with --window — every captured "
+        "trace in an incident window; exit 2 with a named reason "
+        "when the trace is unknown or the capture log lacks its "
+        "payloads",
+    )
+    p.add_argument(
+        "--window", nargs=2, metavar=("START", "END"), type=float,
+        help="with --export-bundle: select every capture whose unix "
+        "arrival time falls in [START, END]",
+    )
+    p.add_argument(
+        "--journal-dir", metavar="DIR",
+        help="carry-journal directory — seeds mid-window sessions "
+        "from the snapshot at first_captured_seq - 1",
+    )
+    p.add_argument(
+        "--out", metavar="FILE",
+        help="bundle output path (default: <trace_id|window>.bundle."
+        "json next to the run log)",
     )
     return p
 
@@ -195,6 +229,68 @@ def _trace_views(args) -> int:
     return 0
 
 
+def _export_bundle(args) -> int:
+    """``--export-bundle``: capture log (+ merges) → one replay
+    bundle on disk. Every refusal is a one-line named reason and
+    exit 2 — never a stack trace (the fleet-CLI contract)."""
+    from trpo_tpu.obs.replay import BundleError, build_bundle, write_bundle
+
+    trace_id = args.export_bundle or None
+    if (trace_id is None) == (args.window is None):
+        print(
+            "ERROR    --export-bundle needs exactly one selector: a "
+            "trace id, or --window START END",
+            file=sys.stderr,
+        )
+        return 2
+    records = []
+    for path in [args.run] + list(args.merge):
+        try:
+            records.extend(_load_records(path))
+        except OSError as e:
+            print(f"ERROR    {path}: unreadable ({e})", file=sys.stderr)
+            return 2
+    try:
+        bundle = build_bundle(
+            records,
+            trace_id=trace_id,
+            window=tuple(args.window) if args.window else None,
+            journal_dir=args.journal_dir,
+        )
+    except BundleError as e:
+        print(f"ERROR    {e}", file=sys.stderr)
+        return 2
+    out = args.out
+    if out is None:
+        stem = trace_id or (
+            f"window-{int(args.window[0])}-{int(args.window[1])}"
+        )
+        out = os.path.join(
+            os.path.dirname(os.path.abspath(args.run)),
+            f"{stem}.bundle.json",
+        )
+    write_bundle(bundle, out)
+    broken = [c for c in bundle["completeness"] if not c["replayable"]]
+    print(
+        f"wrote {out}: {bundle['acts_total']} act(s), "
+        f"{len(bundle['sessions'])} session(s), "
+        f"checkpoint step {bundle['checkpoint_step']}, "
+        f"{len(bundle['completeness']) - len(broken)}/"
+        f"{len(bundle['completeness'])} trace(s) replayable"
+    )
+    for c in broken:
+        for piece in c["missing"]:
+            print(f"  NOT REPLAYABLE {c['trace']}: {piece}")
+    if args.json:
+        print(json.dumps({
+            "bundle": out,
+            "acts": bundle["acts_total"],
+            "replayable": bundle["replayable"],
+            "completeness": bundle["completeness"],
+        }))
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from trpo_tpu.obs.analyze import (
@@ -202,6 +298,16 @@ def main(argv=None) -> int:
         render_comparison,
         render_summary,
     )
+
+    if args.export_bundle is not None or args.window is not None:
+        if args.compare or args.trace or args.slowest_traces:
+            print(
+                "ERROR    --export-bundle is its own view — run "
+                "--compare/--trace separately",
+                file=sys.stderr,
+            )
+            return 2
+        return _export_bundle(args)
 
     if args.trace is not None or args.slowest_traces is not None:
         if args.compare:

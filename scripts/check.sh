@@ -266,6 +266,130 @@ for f in "$PART_TMP"/child-*.jsonl; do PART_MERGE+=(--merge "$f"); done
 python scripts/analyze_run.py "$PART_TMP/partition_events.jsonl" \
     "${PART_MERGE[@]}" --slowest-traces 5
 
+echo "== deterministic replay smoke: takeover bundle -> shadow set, bit-exact =="
+# ISSUE 18 acceptance: the partition smoke above ran with request
+# capture armed (rate 1.0, zero drops asserted in-driver). Export the
+# partition-era takeover request — a MID-WINDOW bundle whose session
+# must seed from the fenced zombie's frozen journal snapshot — and
+# re-execute it against a FRESH in-process shadow replica set from the
+# recorded checkpoint step: actions must diff bit-exact (hard fail),
+# the per-stage p99 rows must ride compare_runs against the recorded
+# trace summary, and the replay event log must pass the validator's
+# replay-complete contracts (every act answered, every verdict
+# emitted).
+TAKEOVER_TID=$(cat "$PART_TMP/takeover_trace.txt")
+python scripts/analyze_run.py "$PART_TMP/partition_events.jsonl" \
+    "${PART_MERGE[@]}" --export-bundle "$TAKEOVER_TID" \
+    --journal-dir "$PART_TMP/carry_journal" \
+    --out "$PART_TMP/takeover.bundle.json"
+JAX_PLATFORMS=cpu python scripts/replay_run.py \
+    "$PART_TMP/takeover.bundle.json" \
+    --checkpoint-dir "$PART_TMP/ck" \
+    --events "$PART_TMP/replay_events.jsonl"
+python scripts/validate_events.py "$PART_TMP/replay_events.jsonl"
+
+echo "== capture overhead: <=2% on the calibrated serving bench, 0 drops =="
+# the capture hot path is a note in a side table + one deque append;
+# the encode/emit work rides the write-behind writer thread. Gate it:
+# on the calibrated session bench (5 ms simulated per-dispatch device
+# cost), mean act latency with capture armed must be within 2% of
+# capture-off, with ZERO drops at sample rate 1.0.
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json
+import time
+import urllib.request
+
+import numpy as np
+
+from trpo_tpu.agent import TRPOAgent
+from trpo_tpu.config import TRPOConfig
+from trpo_tpu.obs.capture import RequestCapture
+from trpo_tpu.obs.events import EventBus
+from trpo_tpu.obs.trace import Tracer
+from trpo_tpu.serve import PolicyServer
+from trpo_tpu.serve.session import SimulatedCostSessionEngine
+
+cfg = TRPOConfig(
+    n_envs=4, batch_timesteps=32, policy_hidden=(8,), vf_hidden=(8,),
+    seed=0, policy_gru=8,
+)
+agent = TRPOAgent("pendulum", cfg)
+state = agent.init_state(seed=0)
+
+
+def bench(with_capture, n=300, cost_ms=5.0):
+    recs = []
+    bus = EventBus(lambda r: recs.append(r))
+    tracer = Tracer(bus, 1.0, process="bench")
+    cap = RequestCapture(bus, process="bench") if with_capture else None
+    engine = SimulatedCostSessionEngine(
+        agent.serve_session_engine(), cost_ms
+    )
+    engine.load(state.policy_params, state.obs_norm, step=1)
+    server = PolicyServer(
+        engine, None, port=0, bus=bus, tracer=tracer, capture=cap
+    )
+    url = f"http://127.0.0.1:{server.port}"
+    with urllib.request.urlopen(
+        urllib.request.Request(url + "/session", data=b""), timeout=30.0
+    ) as r:
+        sid = json.loads(r.read())["session"]
+    body = json.dumps(
+        {"obs": np.zeros(agent.obs_shape, np.float32).tolist()}
+    ).encode()
+    req = urllib.request.Request(
+        url + f"/session/{sid}/act", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    for _ in range(20):  # warmup: batcher + engine steady state
+        urllib.request.urlopen(req, timeout=30.0).read()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        urllib.request.urlopen(req, timeout=30.0).read()
+    mean_ms = (time.perf_counter() - t0) / n * 1000
+    dropped = None
+    if cap is not None:
+        cap.drain()
+        dropped = cap.dropped_total
+        assert cap.requests_total == n + 20, cap.requests_total
+    server.close()
+    tracer.close()
+    if cap is not None:
+        cap.close()
+    bus.close()
+    return mean_ms, dropped
+
+
+off_ms, _ = bench(False)
+on_ms, dropped = bench(True)
+pct = (on_ms - off_ms) / off_ms * 100
+assert dropped == 0, f"capture dropped {dropped} at rate 1.0"
+assert on_ms <= off_ms * 1.02, (
+    f"capture overhead {pct:.2f}% > 2% "
+    f"(off {off_ms:.3f} ms, on {on_ms:.3f} ms)"
+)
+print(
+    f"capture overhead OK: {pct:+.2f}% (off {off_ms:.3f} ms, "
+    f"on {on_ms:.3f} ms, 320/320 requests captured, 0 dropped)"
+)
+PYEOF
+
+echo "== replay corpus gate: checked-in bundles replay bit-exact =="
+# the standing regression corpus (corpus/README.md): every committed
+# bundle re-executes against a shadow set whose weights are
+# regenerated from the pinned recipe — any action mismatch fails the
+# build, and each replay log must pass the replay-complete contracts.
+CORPUS_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu python scripts/seed_corpus.py --checkpoint-only \
+    --out "$CORPUS_TMP"
+for b in corpus/*.bundle.json; do
+    JAX_PLATFORMS=cpu python scripts/replay_run.py "$b" \
+        --checkpoint-dir "$CORPUS_TMP/ck" \
+        --events "$CORPUS_TMP/$(basename "$b").replay.jsonl"
+    python scripts/validate_events.py \
+        "$CORPUS_TMP/$(basename "$b").replay.jsonl"
+done
+
 echo "== session batching smoke: 16 concurrent sessions, parity + >=4x =="
 # ISSUE 13 acceptance: (a) a recurrent replica under >= 16 CONCURRENT
 # HTTP sessions serves every session's action stream BIT-EXACT vs

@@ -42,7 +42,14 @@ network):
    ``router.takeover`` span on the survivor (``resumed=True``,
    journal-backed), with a critical-path breakdown attributing queue/
    epoch/network stages.
-7. Every event log (the router's and each child's) must pass
+7. **Request capture** (ISSUE 18) — the router runs with
+   :class:`~trpo_tpu.obs.capture.RequestCapture` armed (sample rate
+   1.0 via the tracer's verdict): every act body + recorded action
+   lands in the router log with ZERO drops, and the takeover trace id
+   is written to ``takeover_trace.txt`` so check.sh can export the
+   incident window (``analyze_run.py --export-bundle``) and replay it
+   bit-exact against a fresh shadow set (``scripts/replay_run.py``).
+8. Every event log (the router's and each child's) must pass
    ``scripts/validate_events.py`` — including the partition fault's
    detection pairing (lease_expired on that host + session resumed +
    the traced-log takeover-span contract) — and the router log must
@@ -91,6 +98,7 @@ def main(argv=None) -> int:
 
     from trpo_tpu.agent import TRPOAgent
     from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.obs.capture import RequestCapture
     from trpo_tpu.obs.events import EventBus, JsonlSink, manifest_fields
     from trpo_tpu.obs.trace import Tracer, mint_trace_id
     from trpo_tpu.resilience.inject import FaultInjector
@@ -157,8 +165,12 @@ def main(argv=None) -> int:
     # tracing at rate 1.0: every probe has an assembled trace; the
     # children run the same rate via the template flag above
     tracer = Tracer(bus, 1.0, process="router")
+    # request capture armed at the public edge (ISSUE 18): every
+    # sampled request's replayable inputs land in the event log, so the
+    # partition-era takeover below can be exported as a replay bundle
+    capture = RequestCapture(bus, process="router")
     router = Router(rs, port=0, bus=bus, journal_dir=jdir,
-                    tracer=tracer)
+                    tracer=tracer, capture=capture)
     try:
         snap = rs.snapshot()
         hosts = {rid: row["host"] for rid, row in snap["replicas"].items()}
@@ -280,6 +292,11 @@ def main(argv=None) -> int:
         takeover_tid = mint_trace_id()
         step(victim_sess, expect_resumed=True, trace_id=takeover_tid)
         assert router.injector.all_fired
+        # the replay gate (check.sh) exports THIS trace's bundle
+        with open(
+            os.path.join(args.tmp, "takeover_trace.txt"), "w"
+        ) as f:
+            f.write(takeover_tid + "\n")
         # every OTHER session pinned to the same host must also resume
         for sess in sessions[1:]:
             if hosts[sess["pinned"]] == victim_host:
@@ -337,6 +354,19 @@ def main(argv=None) -> int:
         assert not bg_errors, (
             f"{len(bg_errors)} non-typed client errors: {bg_errors[:5]}"
         )
+        # capture accounting: at sample rate 1.0 the log must hold
+        # EVERY request's replayable inputs — one whole drop and the
+        # exported bundle is no longer the incident
+        capture.drain()
+        assert capture.requests_total > 0, "capture recorded nothing"
+        assert capture.dropped_total == 0, (
+            f"capture dropped {capture.dropped_total} of "
+            f"{capture.requests_total} requests at rate 1.0"
+        )
+        print(
+            f"capture: {capture.requests_total} requests recorded "
+            f"({capture.bytes_total} body bytes), 0 dropped"
+        )
         resumed_count = router.sessions_resumed_total
         print(
             f"partition smoke: host {victim_host} partitioned "
@@ -350,6 +380,7 @@ def main(argv=None) -> int:
     finally:
         router.close()
         tracer.close()  # flush pending spans before the bus closes
+        capture.close()
         rs.close()
         bus.close()
 

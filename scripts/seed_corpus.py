@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Regenerate the deterministic-replay regression corpus (ISSUE 18).
+
+    python scripts/seed_corpus.py --out corpus/          # (re)seed bundles
+    python scripts/seed_corpus.py --checkpoint-only --out DIR
+
+The checked-in ``corpus/`` holds replay bundles that ``scripts/
+check.sh`` re-executes against a shadow replica set on EVERY run — a
+standing gate that the serving stack still reproduces recorded
+incidents bit-exact. Bundles embed their obs payloads, journal seeds,
+and recorded actions, but NOT the checkpoint weights; instead the
+weights are pinned by recipe — the exact config + seeds below — so the
+gate regenerates them on the fly (``--checkpoint-only``) instead of
+committing orbax binaries. Changing the recipe (config fields, seeds,
+init scheme) invalidates every recorded action in the corpus: re-seed
+with this script and commit the new bundles alongside the change.
+
+The seeded bundle is the hard case on purpose: a MID-WINDOW export
+whose session predates the capture window, so replay must seed from
+the bundled carry-journal snapshot (seq = first_captured_seq - 1) —
+the same reconstruction a takeover-era incident bundle needs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# THE corpus recipe — mirrors the partition smoke's serving stack.
+# Every recorded action in corpus/ is a function of these values.
+CORPUS_CFG = dict(
+    n_envs=4, batch_timesteps=32, cg_iters=2, vf_train_steps=2,
+    policy_hidden=(8,), vf_hidden=(8,), seed=5, policy_gru=8,
+)
+CORPUS_PRESET = "pendulum"
+CORPUS_INIT_SEED = 0
+CORPUS_STEP = 1
+CORPUS_OBS_SEED = 100  # act i uses RandomState(CORPUS_OBS_SEED + i)
+CORPUS_ACTS = 6
+CORPUS_WINDOW_FROM = 3  # export acts [3:] -> journal-seeded bundle
+
+
+def _build_agent():
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+
+    agent = TRPOAgent(CORPUS_PRESET, TRPOConfig(**CORPUS_CFG))
+    return agent, agent.init_state(seed=CORPUS_INIT_SEED)
+
+
+def write_checkpoint(out_dir: str) -> str:
+    """The corpus checkpoint, regenerated from the pinned recipe."""
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    agent, state = _build_agent()
+    ck_dir = os.path.join(out_dir, "ck")
+    ck = Checkpointer(ck_dir)
+    ck.save(CORPUS_STEP, state)
+    ck.close()
+    return ck_dir
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="seed_corpus.py")
+    p.add_argument("--out", required=True)
+    p.add_argument(
+        "--checkpoint-only", action="store_true",
+        help="only regenerate the corpus checkpoint (the check.sh "
+        "gate's per-run step) — no recording, no bundles",
+    )
+    args = p.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+
+    if args.checkpoint_only:
+        ck_dir = write_checkpoint(args.out)
+        print(f"corpus checkpoint (step {CORPUS_STEP}) at {ck_dir}")
+        return 0
+
+    import tempfile
+
+    import numpy as np
+
+    from trpo_tpu.obs.capture import RequestCapture, capture_records
+    from trpo_tpu.obs.events import EventBus, JsonlSink, manifest_fields
+    from trpo_tpu.obs.replay import build_bundle, write_bundle
+    from trpo_tpu.obs.trace import TRACE_HEADER, Tracer, mint_trace_id
+    from trpo_tpu.serve import (
+        InProcessReplica,
+        PolicyServer,
+        ReplicaSet,
+        Router,
+    )
+
+    def _post(url, payload=None, headers=None, timeout=30.0):
+        import urllib.error
+
+        data = b"" if payload is None else json.dumps(payload).encode()
+        h = {"Content-Type": "application/json"}
+        h.update(headers or {})
+        req = urllib.request.Request(url, data=data, headers=h)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    agent, state = _build_agent()
+    tmp = tempfile.mkdtemp(prefix="seed_corpus_")
+    log = os.path.join(tmp, "recorded.jsonl")
+    bus = EventBus(JsonlSink(log))
+    bus.emit(
+        "run_manifest",
+        **manifest_fields(None, extra={"driver": "seed_corpus"}),
+    )
+    tracer = Tracer(bus, 1.0, process="router")
+    capture = RequestCapture(bus, process="router")
+    jdir = os.path.join(tmp, "cj")
+
+    def factory(rid):
+        def build():
+            engine = agent.serve_session_engine()
+            engine.load(
+                state.policy_params, state.obs_norm, step=CORPUS_STEP
+            )
+            server = PolicyServer(
+                engine, None, port=0, bus=bus, tracer=tracer,
+                replica_name=rid, carry_journal_dir=jdir,
+            )
+            return server, []
+
+        return build
+
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(factory(rid)), 2, bus=bus,
+        health_interval=60.0, backoff=0.05, health_fail_threshold=1,
+        max_restarts=2,
+    )
+    assert rs.wait_healthy(2, timeout=120.0), rs.snapshot()
+    router = Router(
+        rs, port=0, bus=bus, journal_dir=jdir, tracer=tracer,
+        capture=capture,
+    )
+    try:
+        status, out = _post(router.url + "/session")
+        assert status == 200, out
+        sid = out["session"]
+        for i in range(CORPUS_ACTS):
+            obs = (
+                np.random.RandomState(CORPUS_OBS_SEED + i)
+                .randn(*agent.obs_shape).astype(np.float32)
+            )
+            status, out = _post(
+                router.url + f"/session/{sid}/act",
+                {"obs": obs.tolist()},
+                headers={TRACE_HEADER: mint_trace_id()},
+            )
+            assert status == 200, (status, out)
+        capture.drain()
+        assert capture.dropped_total == 0, capture.dropped_total
+    finally:
+        router.close()
+        tracer.drain()
+        tracer.close()
+        capture.close()
+        rs.close()
+        bus.close()
+
+    from trpo_tpu.obs.analyze import load_events
+
+    records = load_events(log)
+    caps = capture_records(records)
+    assert len(caps) == CORPUS_ACTS, len(caps)
+    bundle = build_bundle(
+        records,
+        window=(caps[CORPUS_WINDOW_FROM]["t"] - 1e-4, time.time()),
+        journal_dir=jdir,
+    )
+    assert bundle["replayable"], bundle["completeness"]
+    assert bundle["sessions"][sid]["seed"] is not None, (
+        "the corpus bundle must exercise journal seeding"
+    )
+    out_path = os.path.join(
+        args.out, "session-takeover-window.bundle.json"
+    )
+    write_bundle(bundle, out_path)
+    print(
+        f"seeded {out_path}: {bundle['acts_total']} act(s), "
+        f"journal seed at seq "
+        f"{bundle['sessions'][sid]['seed'].get('seq')}, checkpoint "
+        f"step {bundle['checkpoint_step']} (recipe: {CORPUS_PRESET} "
+        f"init_seed={CORPUS_INIT_SEED})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

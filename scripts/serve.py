@@ -320,6 +320,16 @@ def build_parser() -> argparse.ArgumentParser:
         "process logs with --merge)",
     )
     p.add_argument(
+        "--capture", action="store_true",
+        help="record every SAMPLED request's replayable inputs "
+        "(wire-encoded obs payload, session, seq, checkpoint step, "
+        "answered action) as capture events on the bus — the ISSUE "
+        "18 deterministic-replay feed; needs --trace-sample-rate > 0 "
+        "(capture agrees with the head-sampling verdict) and "
+        "--metrics-jsonl. Export with analyze_run.py --export-bundle, "
+        "re-execute with replay_run.py",
+    )
+    p.add_argument(
         "--run-descriptor",
         help="write an atomic run.json here at startup (pid, bound "
         "port, url, endpoints) — tooling discovery without stdout "
@@ -571,6 +581,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.capture and (
+        cfg.trace_sample_rate <= 0 or not args.metrics_jsonl
+    ):
+        print(
+            "error: --capture records SAMPLED requests — pass "
+            "--trace-sample-rate > 0 and --metrics-jsonl.",
+            file=sys.stderr,
+        )
+        return 2
 
     bus = None
     if args.metrics_jsonl:
@@ -614,6 +633,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 bus, cfg.trace_sample_rate, process=name, host=host
             )
         return _tracers[name]
+
+    # request capture (ISSUE 18): same per-role caching as the
+    # tracers — capture fires iff the trace context is emitting, so
+    # the two always agree on which requests are recorded
+    _captures: dict = {}
+
+    def make_capture(name: str):
+        if not args.capture or bus is None:
+            return None
+        if name not in _captures:
+            from trpo_tpu.obs.capture import RequestCapture
+
+            host = name.split("--", 1)[0] if "--" in name else None
+            _captures[name] = RequestCapture(
+                bus, process=name, host=host
+            )
+        return _captures[name]
 
     def build_replica(
         replica_name: Optional[str], port: int,
@@ -660,6 +696,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             session_deadline_ms=cfg.serve_session_deadline_ms,
             session_adaptive_deadline=cfg.serve_adaptive_deadline,
             tracer=make_tracer(replica_name or "solo"),
+            capture=make_capture(replica_name or "solo"),
             uds_path=uds_path,
         )
         closers = ([batcher] if batcher is not None else []) + [
@@ -752,6 +789,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             injector=injector,
             min_latency_samples=cfg.serve_autoscale_min_samples,
             tracer=make_tracer("router"),
+            capture=make_capture("router"),
             uds_path=args.uds_path,
             core=args.router_core,
         )
@@ -851,6 +889,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             c.close()
         for t in _tracers.values():
             t.close()  # flush pending spans BEFORE the bus closes
+        for c_ in _captures.values():
+            c_.close()  # flush pending captures BEFORE the bus closes
         if injector is not None and injector.unfired:
             # a chaos run whose faults never fired tested NOTHING —
             # same loud-completion contract as the training injector

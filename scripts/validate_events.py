@@ -70,7 +70,12 @@ the lease bookkeeping); and — ISSUE 16 — in a log whose dispatch
 spans carry wire attrs at all, EVERY ``router.dispatch``/
 ``router.retry`` span must name ``codec`` (json|binary) and
 ``transport`` (tcp|uds), so the per-format p99 breakdown attributes
-every hop.
+every hop; and — ISSUE 18 — the replay-complete contracts, in a log
+carrying ``replay`` records at all: every act the replayer planned
+(``begin.acts``) must be driven, every driven act must have its diff
+``verdict`` (same trace + order — an uncompared act cannot be called
+bit-exact), and a replay that began must terminate in a ``complete``
+record whose act count matches the plan.
 Exits non-zero with per-line diagnostics on any failure; prints a
 per-kind count summary on success. Used by ``scripts/check.sh`` against
 both a training run's ``--metrics-jsonl`` output and ``bench.py``'s
@@ -585,6 +590,62 @@ def validate_file(path: str) -> list:
                     f"{path}:{n}: retried request's trace "
                     f"{rec['trace']!r} has no router.retry span in "
                     "this file — the trace hides the retry"
+                )
+    # ISSUE 18 replay-complete contracts, gated on the log carrying
+    # replay records at all: (1) every captured act the replayer
+    # planned (begin.acts) was actually driven — a replay that silently
+    # answered fewer acts than it promised replayed a DIFFERENT
+    # incident; (2) every driven act has its diff verdict — an act
+    # without a verdict was never compared, and "bit-exact" cannot be
+    # claimed over uncompared acts; (3) a replay that began must have
+    # its complete record, whose act count matches the plan.
+    replay_recs = [
+        (n, rec) for n, rec in records if rec.get("kind") == "replay"
+    ]
+    if replay_recs:
+        begins = [
+            (n, rec) for n, rec in replay_recs
+            if rec.get("event") == "begin"
+        ]
+        completes = [
+            (n, rec) for n, rec in replay_recs
+            if rec.get("event") == "complete"
+        ]
+        acts = [
+            (n, rec) for n, rec in replay_recs
+            if rec.get("event") == "act"
+        ]
+        verdict_keys = {
+            (rec.get("trace"), rec.get("order"))
+            for _, rec in replay_recs
+            if rec.get("event") == "verdict"
+        }
+        planned = sum(rec.get("acts", 0) for _, rec in begins)
+        if len(acts) != planned:
+            errs.append(
+                f"{path}: replay drove {len(acts)} act(s) but "
+                f"planned {planned} (begin.acts) — the replayed "
+                "request set is not the captured one"
+            )
+        for n, rec in acts:
+            if (rec.get("trace"), rec.get("order")) not in verdict_keys:
+                errs.append(
+                    f"{path}:{n}: replayed act trace "
+                    f"{rec.get('trace')!r} order {rec.get('order')} "
+                    "has no diff verdict — the act was driven but "
+                    "never compared"
+                )
+        if begins and not completes:
+            errs.append(
+                f"{path}: replay began but never emitted its "
+                "complete record — the diff summary is missing"
+            )
+        for n, rec in completes:
+            if rec.get("acts") != planned:
+                errs.append(
+                    f"{path}:{n}: replay complete counts "
+                    f"{rec.get('acts')} act(s) but the plan was "
+                    f"{planned}"
                 )
     # ISSUE 12 drain contract (the canary `started` pattern): a drain
     # that started with no later same-replica completed/aborted

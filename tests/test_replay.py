@@ -1,0 +1,811 @@
+"""Deterministic replay (ISSUE 18): request capture round-trip,
+capture/trace sampling agreement, bounded-writer drop accounting,
+bundle reconstruction (including the mid-window takeover seed from a
+fenced zombie's frozen journal), the export CLI's exit-2 contract, the
+bit-exact diff oracle, the validator's replay-complete contracts, and
+— slow leg — a live in-process shadow replay driven end to end through
+``scripts/replay_run.py``."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trpo_tpu.obs.capture import (
+    RequestCapture,
+    capture_records,
+    decode_payload,
+    encode_obs_payload,
+)
+from trpo_tpu.obs.events import (
+    SCHEMA_VERSION,
+    EventBus,
+    JsonlSink,
+    manifest_fields,
+    validate_event,
+)
+from trpo_tpu.obs.replay import (
+    BundleError,
+    action_match,
+    build_bundle,
+    load_bundle,
+    scan_journals,
+    write_bundle,
+)
+from trpo_tpu.obs.trace import Tracer
+from trpo_tpu.serve import wire as _wire
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ANALYZE = os.path.join(_REPO, "scripts", "analyze_run.py")
+_REPLAY = os.path.join(_REPO, "scripts", "replay_run.py")
+_VALIDATE = os.path.join(_REPO, "scripts", "validate_events.py")
+
+
+def _collect_bus():
+    recs = []
+    return recs, EventBus(lambda r: recs.append(r))
+
+
+def _run(script, *argv):
+    return subprocess.run(
+        [sys.executable, script, *argv], capture_output=True, text=True
+    )
+
+
+# -- capture round-trip ----------------------------------------------------
+
+
+def test_capture_roundtrip_json_body_bit_exact():
+    """A JSON act body captured at the router comes back as the exact
+    float32 obs array, with the seq the router stamped and the
+    action/step parsed out of the recorded response."""
+    recs, bus = _collect_bus()
+    tracer = Tracer(bus, 1.0)
+    cap = RequestCapture(bus, process="router")
+    obs = (np.arange(5, dtype=np.float32) - 2.1) / 3.0
+    body = json.dumps({"obs": obs.tolist(), "seq": 7}).encode()
+    resp = json.dumps(
+        {"action": [0.1234567890123456, -1.5], "step": 42}
+    ).encode()
+    ctx = tracer.begin("a" * 16, sampled=True)
+    assert cap.record(
+        ctx, path="/session/s1/act", endpoint="session_act",
+        body=body, status=200, session="s1", replica="r0",
+        response=resp, response_ctype="application/json",
+    )
+    cap.drain()
+    cap.close()
+    tracer.close()
+    bus.close()
+    caps = capture_records(recs)
+    assert len(caps) == 1
+    rec = caps[0]
+    assert not validate_event(rec)
+    assert rec["seq"] == 7
+    assert rec["step"] == 42
+    assert rec["action"] == [0.1234567890123456, -1.5]
+    scalars, decoded = decode_payload(rec)
+    assert decoded.dtype == np.float32
+    assert np.array_equal(decoded, obs)
+
+
+def test_capture_roundtrip_wire_body_bit_exact():
+    """A binary wire-frame body (the PR 16 codec) round-trips through
+    the base64 payload bit-exact, and the wire response yields the
+    action + step."""
+    recs, bus = _collect_bus()
+    tracer = Tracer(bus, 1.0)
+    cap = RequestCapture(bus, process="router")
+    obs = np.random.RandomState(3).randn(4).astype(np.float32)
+    body = _wire.encode_frame(scalars={"seq": 9}, arrays={"obs": obs})
+    action = np.array([0.5, -0.25], np.float64)
+    resp = _wire.encode_frame(
+        scalars={"step": 6}, arrays={"action": action}
+    )
+    ctx = tracer.begin("b" * 16, sampled=True)
+    assert cap.record(
+        ctx, path="/session/s2/act", endpoint="session_act",
+        body=body, binary=True, status=200, session="s2",
+        response=resp, response_ctype=_wire.WIRE_CONTENT_TYPE,
+    )
+    cap.drain()
+    cap.close()
+    tracer.close()
+    bus.close()
+    (rec,) = capture_records(recs)
+    assert rec["seq"] == 9 and rec["step"] == 6
+    assert rec["action"] == action.tolist()
+    _, decoded = decode_payload(rec)
+    assert np.array_equal(decoded, obs)
+
+
+def test_capture_unparseable_body_still_emits_payloadless():
+    """Garbage bodies yield a capture record WITHOUT a payload — the
+    miss must be loud downstream (bundle: not replayable), never a
+    silently absent record."""
+    recs, bus = _collect_bus()
+    tracer = Tracer(bus, 1.0)
+    cap = RequestCapture(bus)
+    ctx = tracer.begin("c" * 16, sampled=True)
+    assert cap.record(
+        ctx, path="/act", endpoint="act", body=b"\x00not json",
+        status=200,
+    )
+    cap.drain()
+    cap.close()
+    tracer.close()
+    bus.close()
+    (rec,) = capture_records(recs)
+    assert "payload" not in rec
+    assert decode_payload(rec) is None
+
+
+# -- sampling agreement ----------------------------------------------------
+
+
+def test_capture_agrees_with_head_sampling_verdict():
+    """Capture records exactly the requests the tracer samples: an
+    unsampled context is refused, a FORCED (anomaly) context is
+    captured even when unsampled — span stream and capture log always
+    name the same request set."""
+    recs, bus = _collect_bus()
+    tracer = Tracer(bus, 1.0)
+    cap = RequestCapture(bus)
+    body = json.dumps({"obs": [0.0]}).encode()
+    sampled = tracer.begin("d" * 16, sampled=True)
+    unsampled = tracer.begin("e" * 16, sampled=False)
+    forced = tracer.begin("f" * 16, sampled=False)
+    forced.force()
+    assert cap.record(
+        sampled, path="/act", endpoint="act", body=body, status=200
+    )
+    assert not cap.record(
+        unsampled, path="/act", endpoint="act", body=body, status=200
+    )
+    assert cap.record(
+        forced, path="/act", endpoint="act", body=body, status=500
+    )
+    assert not cap.record(
+        None, path="/act", endpoint="act", body=body, status=200
+    )
+    cap.drain()
+    cap.close()
+    tracer.close()
+    bus.close()
+    traces = {r["trace"] for r in capture_records(recs)}
+    assert traces == {"d" * 16, "f" * 16}
+    forced_rec = [
+        r for r in capture_records(recs) if r["trace"] == "f" * 16
+    ][0]
+    assert forced_rec.get("forced") is True
+
+
+# -- drop accounting -------------------------------------------------------
+
+
+def test_capture_backpressure_drops_counted_forced_overshoots():
+    """The bounded write-behind buffer drops WHOLE requests, counted
+    on dropped_total; a forced (anomaly) request overshoots the bound
+    instead — the tracer-writer contract, applied to capture."""
+    gate = threading.Event()
+    emitted = []
+
+    def blocking_sink(rec):
+        gate.wait(10.0)
+        emitted.append(rec)
+
+    bus = EventBus(blocking_sink)
+    tracer = Tracer(bus, 1.0)
+    cap = RequestCapture(bus, max_pending=2, poll_interval=0.01)
+    body = json.dumps({"obs": [1.0]}).encode()
+
+    def rec_one(tid, force=False):
+        ctx = tracer.begin(tid, sampled=not force)
+        if force:
+            ctx.force()
+        return cap.record(
+            ctx, path="/act", endpoint="act", body=body, status=200
+        )
+
+    # wedge the writer on the first record so the bound fills
+    assert rec_one("1" * 16)
+    time.sleep(0.15)  # writer now blocked inside the sink
+    assert rec_one("2" * 16)
+    assert rec_one("3" * 16)
+    assert not rec_one("4" * 16)  # over the bound: dropped, counted
+    assert cap.dropped_total == 1
+    assert rec_one("5" * 16, force=True)  # forced overshoots
+    assert cap.dropped_total == 1
+    gate.set()
+    cap.drain()
+    cap.close()
+    tracer.close()
+    bus.close()
+    got = {r["trace"] for r in emitted if r.get("kind") == "capture"}
+    assert "4" * 16 not in got
+    assert {"1" * 16, "2" * 16, "3" * 16, "5" * 16} <= got
+    assert cap.requests_total == 4  # the drop is not a request
+
+
+def test_capture_writer_failure_counts_drops_and_survives():
+    """A sink error inside the writer drains counts the whole batch
+    dropped and the writer keeps serving later records."""
+    state = {"fail": True}
+    emitted = []
+
+    def flaky_sink(rec):
+        if state["fail"]:
+            raise RuntimeError("sink down")
+        emitted.append(rec)
+
+    bus = EventBus(flaky_sink)
+    tracer = Tracer(bus, 1.0)
+    cap = RequestCapture(bus, poll_interval=0.01)
+    body = json.dumps({"obs": [1.0]}).encode()
+    ctx = tracer.begin("a" * 16, sampled=True)
+    cap.record(ctx, path="/act", endpoint="act", body=body, status=200)
+    deadline = time.monotonic() + 5.0
+    while cap.dropped_total == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert cap.dropped_total == 1
+    state["fail"] = False
+    ctx2 = tracer.begin("b" * 16, sampled=True)
+    cap.record(ctx2, path="/act", endpoint="act", body=body, status=200)
+    cap.drain()
+    cap.close()
+    tracer.close()
+    bus.close()
+    assert [r["trace"] for r in emitted] == ["b" * 16]
+    assert cap.dropped_total == 1
+
+
+# -- bundle reconstruction -------------------------------------------------
+
+
+def _mk_capture(tid, order, t, seq, obs, action, sid="s1", step=1):
+    rec = {
+        "v": SCHEMA_VERSION, "kind": "capture", "t": t,
+        "trace": tid, "order": order, "path": f"/session/{sid}/act",
+        "endpoint": "session_act", "status": 200, "session": sid,
+        "seq": seq, "step": step, "action": list(action),
+        "payload": encode_obs_payload(
+            np.asarray(obs, np.float32), seq=seq
+        ),
+        "process": "router",
+    }
+    assert not validate_event(rec), validate_event(rec)
+    return rec
+
+
+def _mk_span(tid, name, t, dur=1.0, **attrs):
+    rec = {
+        "v": SCHEMA_VERSION, "kind": "span", "t": t, "trace": tid,
+        "span": f"{name}-{t}", "name": name, "start": t,
+        "dur_ms": dur, **attrs,
+    }
+    assert not validate_event(rec), validate_event(rec)
+    return rec
+
+
+def _journal_write(path, entries):
+    with open(path, "w") as f:
+        for e in entries:
+            f.write(json.dumps(e) + "\n")
+
+
+def test_bundle_mid_window_seed_from_zombie_journal(tmp_path):
+    """The takeover scenario: the capture window opens at seq 5 of a
+    session whose earlier life is journaled on the FENCED zombie
+    replica (frozen at seq 4) while the survivor journals seqs 5-6.
+    The bundle must seed from the zombie's seq-4 snapshot — the only
+    aligned one — scanning ALL entries, not latest-per-file."""
+    jdir = tmp_path / "cj"
+    jdir.mkdir()
+    mk = lambda seq: {
+        "session": "s1", "steps": seq, "seq": seq,
+        "carry": [0.1 * seq] * 3, "t": 100.0 + seq,
+        "last_action": [0.5], "last_step": 1,
+    }
+    _journal_write(jdir / "hostA--r0.carry.jsonl", [mk(s) for s in (1, 2, 3, 4)])
+    _journal_write(jdir / "hostB--r1.carry.jsonl", [mk(s) for s in (5, 6)])
+    obs = np.ones(3, np.float32)
+    records = []
+    for i, seq in enumerate((5, 6)):
+        tid = f"{seq:016x}"
+        t = 200.0 + i
+        records.append(_mk_capture(tid, i, t, seq, obs, [0.1]))
+        records.append(_mk_span(tid, "router.session_act", t))
+    bundle = build_bundle(
+        records, window=(199.0, 203.0), journal_dir=str(jdir)
+    )
+    assert bundle["replayable"] is True, bundle["completeness"]
+    sess = bundle["sessions"]["s1"]
+    assert sess["first_seq"] == 5
+    assert sess["seed"]["seq"] == 4
+    assert sess["seed"]["journal"] == "hostA--r0.carry.jsonl"
+    assert bundle["checkpoint_step"] == 1
+    # scan_journals keeps every entry, fenced files included
+    scanned = scan_journals(str(jdir))
+    assert [e["seq"] for e in scanned["s1"]] == [1, 2, 3, 4, 5, 6]
+
+
+def test_bundle_missing_journal_seed_named(tmp_path):
+    """No snapshot at first_seq - 1 → the trace is marked
+    non-replayable and the missing piece NAMES the seq it needs."""
+    jdir = tmp_path / "cj"
+    jdir.mkdir()
+    _journal_write(
+        jdir / "hostA--r0.carry.jsonl",
+        [{"session": "s1", "steps": 2, "seq": 2, "carry": [0.0],
+          "t": 100.0}],
+    )
+    obs = np.zeros(2, np.float32)
+    records = [
+        _mk_capture("a" * 16, 0, 200.0, 5, obs, [0.1]),
+        _mk_span("a" * 16, "router.session_act", 200.0),
+    ]
+    bundle = build_bundle(
+        records, trace_id="a" * 16, journal_dir=str(jdir)
+    )
+    assert bundle["replayable"] is False
+    (comp,) = bundle["completeness"]
+    assert not comp["replayable"]
+    assert any("journal snapshot at seq 4" in m for m in comp["missing"])
+
+
+def test_bundle_payloadless_and_spanless_named():
+    """A capture without its obs payload, and a trace without
+    assembled spans, each name the exact missing piece."""
+    rec = {
+        "v": SCHEMA_VERSION, "kind": "capture", "t": 50.0,
+        "trace": "b" * 16, "order": 0, "path": "/act",
+        "endpoint": "act", "status": 200,
+    }
+    assert not validate_event(rec)
+    bundle = build_bundle([rec], trace_id="b" * 16)
+    (comp,) = bundle["completeness"]
+    assert not comp["replayable"]
+    missing = " | ".join(comp["missing"])
+    assert "capture payload" in missing
+    assert "recorded action" in missing
+    assert "assembled trace spans" in missing
+
+
+def test_bundle_unknown_trace_and_uncaptured_trace_errors():
+    spans_only = [_mk_span("c" * 16, "router.act", 10.0)]
+    with pytest.raises(BundleError, match="unknown trace id"):
+        build_bundle(spans_only, trace_id="9" * 16)
+    # the trace EXISTS in the span stream but capture never saw it:
+    # the refusal must say so (capture not armed ≠ unknown trace)
+    with pytest.raises(BundleError, match="NO capture records"):
+        build_bundle(spans_only, trace_id="c" * 16)
+    with pytest.raises(BundleError, match="no capture records in window"):
+        build_bundle(spans_only, window=(0.0, 100.0))
+    with pytest.raises(BundleError, match="exactly one"):
+        build_bundle(spans_only)
+
+
+def test_bundle_roundtrip_and_version_gate(tmp_path):
+    obs = np.ones(1, np.float32)
+    records = [
+        _mk_capture("d" * 16, 0, 10.0, 1, obs, [0.3]),
+        _mk_span("d" * 16, "router.session_act", 10.0),
+    ]
+    bundle = build_bundle(records, trace_id="d" * 16)
+    assert bundle["replayable"] is True  # seq 1 = born in-window
+    path = str(tmp_path / "b.json")
+    write_bundle(bundle, path)
+    assert load_bundle(path) == bundle
+    bad = dict(bundle, bundle_version=99)
+    write_bundle(bad, path)
+    with pytest.raises(BundleError, match="version"):
+        load_bundle(path)
+    with pytest.raises(BundleError, match="cannot read"):
+        load_bundle(str(tmp_path / "absent.json"))
+
+
+def test_assemble_traces_reports_dropped_records():
+    """The ISSUE 18 silent-miss fix: span records the assembler cannot
+    join by trace id are handed back via the out-param, not silently
+    discarded."""
+    from trpo_tpu.obs.analyze import assemble_traces
+
+    good = _mk_span("e" * 16, "router.act", 5.0)
+    bad = dict(_mk_span("e" * 16, "router.act", 6.0), trace=None)
+    dropped = []
+    traces = assemble_traces([good, bad], dropped=dropped)
+    assert "e" * 16 in traces
+    assert dropped == [bad]
+    # the default path stays compatible: no out-param, no error
+    assert "e" * 16 in assemble_traces([good, bad])
+
+
+# -- diff oracle -----------------------------------------------------------
+
+
+def test_action_match_is_bit_exact_float64():
+    a = [0.1234567890123456, -1.0000000000000002]
+    assert action_match(a, list(a))
+    assert not action_match(a, [0.1234567890123456, -1.0])
+    assert not action_match([0.1], [0.1, 0.2])
+    assert not action_match([[0.1]], [0.1])
+    assert not action_match(None, [0.1])
+    assert action_match([1, 2], [1.0, 2.0])  # int/float same value
+
+
+# -- export CLI ------------------------------------------------------------
+
+
+def _write_log(path, records):
+    mani = {
+        "v": SCHEMA_VERSION, "kind": "run_manifest", "t": 1.0,
+        **manifest_fields(None, extra={"driver": "test"}),
+    }
+    with open(path, "w") as f:
+        for r in [mani] + records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_export_bundle_cli_contract(tmp_path):
+    """--export-bundle: exit 0 + bundle on disk for a captured trace,
+    exit 2 with a one-line named reason (never a stack trace) on an
+    unknown trace or a missing selector."""
+    obs = np.ones(2, np.float32)
+    log = str(tmp_path / "run.jsonl")
+    _write_log(log, [
+        _mk_capture("f" * 16, 0, 10.0, 1, obs, [0.7]),
+        _mk_span("f" * 16, "router.session_act", 10.0),
+    ])
+    out = str(tmp_path / "b.json")
+    r = _run(_ANALYZE, log, "--export-bundle", "f" * 16, "--out", out)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(out)
+    assert load_bundle(out)["acts_total"] == 1
+
+    r = _run(_ANALYZE, log, "--export-bundle", "0" * 16)
+    assert r.returncode == 2
+    assert "unknown trace id" in r.stderr
+    assert "Traceback" not in r.stderr
+
+    r = _run(_ANALYZE, log, "--export-bundle")
+    assert r.returncode == 2
+    assert "exactly one selector" in r.stderr
+    assert "Traceback" not in r.stderr
+
+    r = _run(
+        _ANALYZE, log, "--export-bundle", "--window", "900.0", "901.0"
+    )
+    assert r.returncode == 2
+    assert "no capture records in window" in r.stderr
+    assert "Traceback" not in r.stderr
+
+
+# -- validator replay-complete contracts -----------------------------------
+
+
+def _replay_recs(drop_verdict=False, drop_complete=False, planned=None):
+    tid = "a" * 16
+    recs = [
+        {"v": SCHEMA_VERSION, "kind": "replay", "t": 2.0,
+         "event": "begin", "acts": planned or 1},
+        {"v": SCHEMA_VERSION, "kind": "replay", "t": 3.0,
+         "event": "act", "trace": tid, "order": 0, "status": 200},
+    ]
+    if not drop_verdict:
+        recs.append(
+            {"v": SCHEMA_VERSION, "kind": "replay", "t": 4.0,
+             "event": "verdict", "trace": tid, "order": 0,
+             "match": True}
+        )
+    if not drop_complete:
+        recs.append(
+            {"v": SCHEMA_VERSION, "kind": "replay", "t": 5.0,
+             "event": "complete", "acts": planned or 1,
+             "mismatches": 0}
+        )
+    return recs
+
+
+def test_validator_replay_contracts(tmp_path):
+    good = str(tmp_path / "good.jsonl")
+    _write_log(good, _replay_recs())
+    r = _run(_VALIDATE, good)
+    assert r.returncode == 0, r.stderr
+
+    no_verdict = str(tmp_path / "nv.jsonl")
+    _write_log(no_verdict, _replay_recs(drop_verdict=True))
+    r = _run(_VALIDATE, no_verdict)
+    assert r.returncode == 1
+    assert "no diff verdict" in r.stderr
+
+    no_complete = str(tmp_path / "nc.jsonl")
+    _write_log(no_complete, _replay_recs(drop_complete=True))
+    r = _run(_VALIDATE, no_complete)
+    assert r.returncode == 1
+    assert "never emitted its complete" in r.stderr
+
+    short = str(tmp_path / "short.jsonl")
+    _write_log(short, _replay_recs(planned=2))
+    r = _run(_VALIDATE, short)
+    assert r.returncode == 1
+    assert "planned 2" in r.stderr
+
+
+# -- /metrics counters -----------------------------------------------------
+
+
+def test_server_capture_fams_emit_counters():
+    """The replica-side /metrics block names the three capture
+    counters (and stays silent when capture is off)."""
+    from types import SimpleNamespace
+
+    from trpo_tpu.serve.server import PolicyServer
+
+    recs, bus = _collect_bus()
+    cap = RequestCapture(bus)
+    cap.requests_total, cap.dropped_total, cap.bytes_total = 3, 1, 99
+    rows = []
+
+    def fam(name, mtype, help_, samples):
+        rows.append((name, samples))
+
+    PolicyServer._capture_fams(SimpleNamespace(capture=cap), fam)
+    names = {n for n, _ in rows}
+    assert names == {
+        "trpo_capture_requests_total",
+        "trpo_capture_dropped_total",
+        "trpo_capture_bytes_total",
+    }
+    values = {n: s[0][1] for n, s in rows}
+    assert values["trpo_capture_requests_total"] == 3
+    assert values["trpo_capture_dropped_total"] == 1
+    assert values["trpo_capture_bytes_total"] == 99
+    rows.clear()
+    PolicyServer._capture_fams(SimpleNamespace(capture=None), fam)
+    assert rows == []
+    cap.close()
+    bus.close()
+
+
+# -- live shadow replay (e2e, slow) ----------------------------------------
+
+
+_E2E_CFG = dict(
+    n_envs=4, batch_timesteps=32, cg_iters=2, vf_train_steps=2,
+    policy_hidden=(8,), vf_hidden=(8,), seed=5, policy_gru=8,
+)
+
+
+def _post(url, payload=None, headers=None, timeout=30.0):
+    import urllib.error
+
+    data = b"" if payload is None else json.dumps(payload).encode()
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    req = urllib.request.Request(url, data=data, headers=h)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.mark.slow  # e2e replay leg: records a live in-process serve
+# run with capture armed, exports a MID-WINDOW bundle (journal-seeded),
+# and re-executes it through scripts/replay_run.py — bit-exact
+def test_live_shadow_replay_bit_exact(tmp_path):
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.obs.analyze import load_events
+    from trpo_tpu.obs.trace import TRACE_HEADER, mint_trace_id
+    from trpo_tpu.serve import (
+        InProcessReplica,
+        PolicyServer,
+        ReplicaSet,
+        Router,
+    )
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    agent = TRPOAgent("pendulum", TRPOConfig(**_E2E_CFG))
+    state = agent.init_state(seed=0)
+    ck_dir = str(tmp_path / "ck")
+    ck = Checkpointer(ck_dir)
+    ck.save(1, state)
+    ck.close()
+
+    log = str(tmp_path / "recorded.jsonl")
+    bus = EventBus(JsonlSink(log))
+    bus.emit(
+        "run_manifest",
+        **manifest_fields(None, extra={"driver": "test_replay"}),
+    )
+    tracer = Tracer(bus, 1.0, process="router")
+    cap = RequestCapture(bus, process="router")
+    jdir = str(tmp_path / "cj")
+
+    def factory(rid):
+        def build():
+            engine = agent.serve_session_engine()
+            engine.load(state.policy_params, state.obs_norm, step=1)
+            server = PolicyServer(
+                engine, None, port=0, bus=bus, tracer=tracer,
+                replica_name=rid, carry_journal_dir=jdir,
+            )
+            return server, []
+
+        return build
+
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(factory(rid)), 2, bus=bus,
+        health_interval=60.0, backoff=0.05, health_fail_threshold=1,
+        max_restarts=2,
+    )
+    assert rs.wait_healthy(2, timeout=120.0), rs.snapshot()
+    router = Router(
+        rs, port=0, bus=bus, journal_dir=jdir, tracer=tracer,
+        capture=cap,
+    )
+    try:
+        status, out = _post(router.url + "/session")
+        assert status == 200, out
+        sid = out["session"]
+        obs_seq = [
+            np.random.RandomState(100 + i)
+            .randn(*agent.obs_shape).astype(np.float32)
+            for i in range(6)
+        ]
+        for o in obs_seq:
+            status, out = _post(
+                router.url + f"/session/{sid}/act",
+                {"obs": o.tolist()},
+                headers={TRACE_HEADER: mint_trace_id()},
+            )
+            assert status == 200, (status, out)
+        # the replica-side /metrics carries the capture counters too
+        # (here capture is router-side, so the ROUTER scrape names
+        # them; the replica wiring is scripts/serve.py --capture)
+        body = urllib.request.urlopen(
+            router.url + "/metrics", timeout=30.0
+        ).read().decode()
+        assert "trpo_capture_requests_total" in body
+        assert "trpo_capture_dropped_total 0" in body
+        cap.drain()
+        assert cap.requests_total == 6
+        assert cap.dropped_total == 0
+    finally:
+        router.close()
+        tracer.drain()
+        tracer.close()
+        cap.close()
+        rs.close()
+        bus.close()
+
+    # export a MID-WINDOW bundle: the last 3 acts, seeded from the
+    # journal snapshot at the preceding seq
+    records = load_events(log)
+    caps = capture_records(records)
+    assert [c["seq"] for c in caps] == [1, 2, 3, 4, 5, 6]
+    bundle_path = str(tmp_path / "win.bundle.json")
+    r = _run(
+        _ANALYZE, log, "--export-bundle",
+        "--window", str(caps[3]["t"] - 1e-4), str(time.time()),
+        "--journal-dir", jdir, "--out", bundle_path,
+    )
+    assert r.returncode == 0, r.stderr
+    bundle = load_bundle(bundle_path)
+    assert bundle["replayable"] is True, bundle["completeness"]
+    assert bundle["sessions"][sid]["first_seq"] == 4
+    assert bundle["sessions"][sid]["seed"]["seq"] == 3
+
+    # shadow re-execution through the CLI: bit-exact, validator-clean
+    r = _run(_REPLAY, bundle_path, "--checkpoint-dir", ck_dir)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "REPLAY BIT-EXACT" in r.stdout
+    assert "0 mismatch(es)" in r.stdout
+    replay_log = bundle_path + ".replay_events.jsonl"
+    r = _run(_VALIDATE, replay_log)
+    assert r.returncode == 0, r.stderr
+    replays = [
+        rec for rec in load_events(replay_log)
+        if rec.get("kind") == "replay"
+    ]
+    verdicts = [r_ for r_ in replays if r_.get("event") == "verdict"]
+    assert len(verdicts) == 3
+    assert all(v["match"] for v in verdicts)
+
+
+@pytest.mark.slow  # a shadow set serving the WRONG weights must fail
+# the diff loudly (exit 1 + named mismatches) — the oracle's teeth
+def test_live_shadow_replay_detects_divergence(tmp_path):
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+    from trpo_tpu.obs.trace import TRACE_HEADER, mint_trace_id
+    from trpo_tpu.serve import (
+        InProcessReplica,
+        PolicyServer,
+        ReplicaSet,
+        Router,
+    )
+    from trpo_tpu.utils.checkpoint import Checkpointer
+
+    agent = TRPOAgent("pendulum", TRPOConfig(**_E2E_CFG))
+    state = agent.init_state(seed=0)
+    ck_dir = str(tmp_path / "ck")
+    ck = Checkpointer(ck_dir)
+    ck.save(1, state)
+    # a DIFFERENT step 2: replaying a step-1 recording against it
+    # must diverge
+    ck.save(2, agent.init_state(seed=123))
+    ck.close()
+
+    log = str(tmp_path / "recorded.jsonl")
+    bus = EventBus(JsonlSink(log))
+    bus.emit(
+        "run_manifest",
+        **manifest_fields(None, extra={"driver": "test_replay"}),
+    )
+    tracer = Tracer(bus, 1.0, process="router")
+    cap = RequestCapture(bus, process="router")
+    jdir = str(tmp_path / "cj")
+
+    def factory(rid):
+        def build():
+            engine = agent.serve_session_engine()
+            engine.load(state.policy_params, state.obs_norm, step=1)
+            server = PolicyServer(
+                engine, None, port=0, bus=bus, tracer=tracer,
+                replica_name=rid, carry_journal_dir=jdir,
+            )
+            return server, []
+
+        return build
+
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(factory(rid)), 1, bus=bus,
+        health_interval=60.0, backoff=0.05, health_fail_threshold=1,
+        max_restarts=2,
+    )
+    assert rs.wait_healthy(1, timeout=120.0), rs.snapshot()
+    router = Router(
+        rs, port=0, bus=bus, journal_dir=jdir, tracer=tracer,
+        capture=cap,
+    )
+    try:
+        status, out = _post(router.url + "/session")
+        sid = out["session"]
+        obs = np.random.RandomState(7).randn(
+            *agent.obs_shape
+        ).astype(np.float32)
+        status, out = _post(
+            router.url + f"/session/{sid}/act", {"obs": obs.tolist()},
+            headers={TRACE_HEADER: mint_trace_id()},
+        )
+        assert status == 200
+        cap.drain()
+    finally:
+        router.close()
+        tracer.drain()
+        tracer.close()
+        cap.close()
+        rs.close()
+        bus.close()
+
+    from trpo_tpu.obs.analyze import load_events
+
+    bundle = build_bundle(
+        load_events(log), window=(0.0, time.time()), journal_dir=jdir
+    )
+    # lie about the step: point the shadow at the seed-123 weights
+    bundle["checkpoint_step"] = 2
+    bundle_path = str(tmp_path / "b.json")
+    write_bundle(bundle, bundle_path)
+    r = _run(_REPLAY, bundle_path, "--checkpoint-dir", ck_dir)
+    assert r.returncode == 1, (r.returncode, r.stdout, r.stderr)
+    assert "MISMATCH" in r.stdout
+    assert "REPLAY DIVERGED" in r.stdout

@@ -5,6 +5,12 @@ live status/metrics endpoint (``obs/server``), device-memory accounting
 (``obs/memory``) and cross-run analysis (``obs/analyze``). See
 ``ARCHITECTURE.md`` "Telemetry" and "Introspection"."""
 
+from trpo_tpu.obs.capture import (  # noqa: F401
+    RequestCapture,
+    capture_records,
+    decode_payload,
+    encode_obs_payload,
+)
 from trpo_tpu.obs.device_metrics import (  # noqa: F401
     DeviceMetrics,
     accumulate_update,
@@ -28,10 +34,23 @@ from trpo_tpu.obs.memory import (  # noqa: F401
     program_memory_analysis,
 )
 from trpo_tpu.obs.recompile import RecompileMonitor  # noqa: F401
+from trpo_tpu.obs.replay import (  # noqa: F401
+    BUNDLE_VERSION,
+    BundleError,
+    action_match,
+    build_bundle,
+    load_bundle,
+    scan_journals,
+    write_bundle,
+)
 from trpo_tpu.obs.server import StatusServer, StatusSink  # noqa: F401
 from trpo_tpu.obs.telemetry import Telemetry  # noqa: F401
 
 __all__ = [
+    "RequestCapture",
+    "capture_records",
+    "decode_payload",
+    "encode_obs_payload",
     "DeviceMetrics",
     "accumulate_update",
     "init_device_metrics",
@@ -50,6 +69,13 @@ __all__ = [
     "live_memory_gauges",
     "program_memory_analysis",
     "RecompileMonitor",
+    "BUNDLE_VERSION",
+    "BundleError",
+    "action_match",
+    "build_bundle",
+    "load_bundle",
+    "scan_journals",
+    "write_bundle",
     "StatusServer",
     "StatusSink",
     "Telemetry",

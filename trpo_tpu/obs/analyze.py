@@ -509,12 +509,19 @@ TRACE_STAGES = (
 )
 
 
-def assemble_traces(records: list) -> dict:
+def assemble_traces(records: list, dropped: Optional[list] = None) -> dict:
     """Join span records — from ONE log or several concatenated
     per-process logs (router + N replicas + hosts; the caller merges
     with ``load_events`` per file) — into ``{trace_id: [spans sorted by
     start]}``. Duplicate records (the same file merged twice) collapse
-    on ``(span, trace, name)``."""
+    on ``(span, trace, name)``.
+
+    ``dropped`` (ISSUE 18): a span record with a malformed/missing
+    trace id used to be skipped SILENTLY — a replay-bundle builder
+    that needed it could only read the miss as "trace never existed".
+    Pass a list and every unjoinable record is appended to it, so
+    reconstruction can report per-trace completeness instead of
+    guessing."""
     traces: dict = {}
     seen = set()
     for r in records:
@@ -522,6 +529,8 @@ def assemble_traces(records: list) -> dict:
             continue
         tid = r.get("trace")
         if not isinstance(tid, str):
+            if dropped is not None:
+                dropped.append(r)
             continue
         key = (tid, r.get("span"), r.get("name"))
         if key in seen:

@@ -242,6 +242,12 @@ ROUTER_HOST_STATES = ("suspect", "healthy")
 # pattern.
 LEASE_EVENTS = ("granted", "renewed", "expired", "fenced_write_refused")
 
+# Shadow-replay lifecycle (ISSUE 18, scripts/replay_run.py): `begin`
+# announces the bundle and how many captured acts it will drive, one
+# `act` per replayed request, one `verdict` per bit-exact action diff,
+# `complete` closes with the tallies — the validator pairs them.
+REPLAY_EVENTS = ("begin", "act", "verdict", "complete")
+
 _SCALAR = (bool, int, float, str, type(None))
 
 # kind -> {field: predicate}; extra fields are always allowed (the schema
@@ -381,6 +387,34 @@ _REQUIRED = {
         "event": lambda v: v in AUTOSCALE_EVENTS,
         "reason": lambda v: isinstance(v, str) and v,
     },
+    "capture": {
+        # one captured request (ISSUE 18, obs/capture.py): the
+        # replayable inputs of one sampled/forced request — path,
+        # arrival order, answered status. `payload` (the base64
+        # wire-frame obs), `session`, `seq`, `step` (the answering
+        # replica's loaded checkpoint step), `action` (the answered
+        # action — the replay diff's recorded side), `replica`,
+        # `forced`, and the writer's `process`/`host` stamps ride
+        # along as optional fields: a body the writer could not parse
+        # still produces a record (the bundle builder reports it as
+        # non-replayable instead of the miss being invisible).
+        "trace": lambda v: isinstance(v, str) and 8 <= len(v) <= 64,
+        "order": lambda v: isinstance(v, int)
+        and not isinstance(v, bool)
+        and v >= 0,
+        "path": lambda v: isinstance(v, str) and v.startswith("/"),
+        "endpoint": lambda v: v in ("act", "session_act"),
+        "status": lambda v: isinstance(v, int) and not isinstance(v, bool),
+    },
+    "replay": {
+        # one shadow-replay lifecycle record (ISSUE 18,
+        # scripts/replay_run.py); per-event required fields live in
+        # _REPLAY_SCOPED below. The validator's replay-complete
+        # contracts pair these: every captured act announced by
+        # `begin` must have an `act` record, every `act` its diff
+        # `verdict`.
+        "event": lambda v: v in REPLAY_EVENTS,
+    },
 }
 
 _BYTES = lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 0
@@ -450,6 +484,39 @@ _AUTOSCALE_SCOPED = {
     },
 }
 
+# replay events are EVENT-discriminated: begin/complete carry the
+# tallies the validator's replay-complete pairing counts against, each
+# act/verdict names the captured request it answers by (trace, order)
+_REPLAY_SCOPED = {
+    "begin": {
+        "acts": lambda v: isinstance(v, int)
+        and not isinstance(v, bool)
+        and v >= 0,
+    },
+    "act": {
+        "trace": lambda v: isinstance(v, str) and 8 <= len(v) <= 64,
+        "order": lambda v: isinstance(v, int)
+        and not isinstance(v, bool)
+        and v >= 0,
+        "status": _INT,
+    },
+    "verdict": {
+        "trace": lambda v: isinstance(v, str) and 8 <= len(v) <= 64,
+        "order": lambda v: isinstance(v, int)
+        and not isinstance(v, bool)
+        and v >= 0,
+        "match": lambda v: isinstance(v, bool),
+    },
+    "complete": {
+        "acts": lambda v: isinstance(v, int)
+        and not isinstance(v, bool)
+        and v >= 0,
+        "mismatches": lambda v: isinstance(v, int)
+        and not isinstance(v, bool)
+        and v >= 0,
+    },
+}
+
 EVENT_KINDS = tuple(sorted(_REQUIRED))
 
 
@@ -482,6 +549,7 @@ def validate_event(rec: Any) -> list:
         ("router", "scope", _ROUTER_SCOPED),
         ("autoscale", "event", _AUTOSCALE_SCOPED),
         ("lease", "event", _LEASE_SCOPED),
+        ("replay", "event", _REPLAY_SCOPED),
     ):
         if kind != scoped_kind:
             continue

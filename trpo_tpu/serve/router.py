@@ -213,6 +213,7 @@ class Router:
         tracer: Optional[Tracer] = None,
         core: str = "async",
         uds_path: Optional[str] = None,
+        capture=None,
     ):
         if core not in ("async", "thread"):
             raise ValueError(
@@ -256,6 +257,16 @@ class Router:
         # the id + verdict on every replica hop. None = layer off,
         # zero per-request cost (owned by the caller, like the bus).
         self.tracer = tracer
+        # request capture (ISSUE 18): a RequestCapture recording each
+        # EMITTED trace's replayable inputs — same deterministic
+        # sampling verdict as the tracer, so capture and spans name
+        # exactly the same traces. None = layer off; caller-owned,
+        # like the tracer. `_capture_notes` parks each in-flight
+        # request's raw capture fields (body/response bytes) keyed by
+        # its context until _trace_done knows the final verdict —
+        # TraceContext is __slots__'d, so the side table is the seam.
+        self.capture = capture
+        self._capture_notes: Dict[int, dict] = {}
 
         self.min_latency_samples = int(min_latency_samples)
 
@@ -881,6 +892,11 @@ class Router:
             ctx=ctx, parent=root, fwd_headers=fwd,
         )
         if result is not None:
+            self._capture_note(
+                ctx, path="/act", endpoint="act", body=body,
+                binary=_wire.is_binary_body(headers), replica=rid,
+                response=result[2], response_ctype=result[1],
+            )
             return result
         return self._unrouted(rid, retried, "act", stateless=True,
                               ctx=ctx)
@@ -967,6 +983,12 @@ class Router:
             if result[0] == 200:
                 with self._lock:
                     aff.acts += 1
+            self._capture_note(
+                ctx, path=f"/session/{sid}/act", endpoint="session_act",
+                session=sid, body=body,
+                binary=_wire.is_binary_body(fwd), replica=aff.replica,
+                response=result[2], response_ctype=result[1],
+            )
             return result
         # the anomaly tail (journal lookup, takeover/fence, sync
         # re-dispatch) blocks — run the shared sync implementation on
@@ -1055,7 +1077,27 @@ class Router:
             ctx.force()
         if root is not None:
             root.end(**({} if status is None else {"status": status}))
+        if self.capture is not None:
+            # capture AFTER the anomaly forcing above: a late-forced
+            # trace (a passed-through 5xx) captures exactly when its
+            # spans emit — the agreement the replay join depends on
+            with self._lock:
+                note = self._capture_notes.pop(id(ctx), None)
+            if note is not None:
+                self.capture.record(
+                    ctx, status=status if status is not None else 500,
+                    **note,
+                )
         self.tracer.finish(ctx)
+
+    def _capture_note(self, ctx, **fields) -> None:
+        """Park one answered request's raw capture fields until its
+        ``_trace_done`` — where the sampling/forcing verdict is final.
+        One dict assignment; no-op when the capture layer is off."""
+        if self.capture is None or ctx is None:
+            return
+        with self._lock:
+            self._capture_notes[id(ctx)] = fields
 
     def _traced(self, name: str, fn, *args):
         """THE handler trace wrapper: open the edge context, run the
@@ -1406,6 +1448,11 @@ class Router:
                                               ctx=ctx, parent=root,
                                               fwd_headers=fwd)
         if result is not None:
+            self._capture_note(
+                ctx, path="/act", endpoint="act", body=body,
+                binary=_wire.is_binary_body(headers), replica=rid,
+                response=result[2], response_ctype=result[1],
+            )
             return result
         return self._unrouted(rid, retried, "act", stateless=True,
                               ctx=ctx)
@@ -1710,6 +1757,61 @@ class Router:
                 pass
         return True, rid, resumed
 
+    def restore_session(self, session_id: str, entry: dict) -> str:
+        """Seed one session from a journal snapshot (ISSUE 18 — the
+        shadow-replay surface). The public ``POST /session`` refuses
+        client-supplied ids on purpose; replay legitimately needs to
+        re-create a RECORDED session under its recorded id with its
+        journaled carry, so this is the documented in-process door:
+        the entry (the ``read_carry_journal`` shape — ``carry`` +
+        ``steps``, optionally ``seq``/``last_action``/``last_step``)
+        is driven through the same replica restore protocol a failover
+        takeover uses, the affinity is pinned, and the seq counter
+        continues from the snapshot so subsequent acts through the
+        public HTTP surface stamp the recorded session's next seqs.
+        Returns the replica id the session landed on; raises
+        ``ValueError`` on a malformed entry or duplicate session,
+        ``RuntimeError`` when no replica accepted the restore."""
+        entry = dict(entry)
+        if "carry" not in entry or "steps" not in entry:
+            raise ValueError(
+                "entry needs 'carry' and 'steps' — a carry-journal "
+                "snapshot (read_carry_journal shape)"
+            )
+        aff = _Affinity("", time.monotonic())
+        with self._lock:
+            if session_id in self._affinity:
+                raise ValueError(
+                    f"session {session_id!r} already exists on this "
+                    "router"
+                )
+            self._affinity[session_id] = aff
+        with aff.lock:
+            ok, rid, _resumed = self._reestablish(session_id, aff, entry)
+        if ok is not True:
+            with self._lock:
+                self._affinity.pop(session_id, None)
+            detail = None
+            if ok is not None:
+                try:
+                    detail = json.loads(ok[2]).get("error")
+                except (ValueError, TypeError, IndexError):
+                    detail = None
+            raise RuntimeError(
+                f"no replica accepted the restore of {session_id!r}"
+                + (f": {detail}" if detail else "")
+            )
+        with self._lock:
+            # dedupe continuity: the next act stamps snapshot seq + 1,
+            # exactly what the recorded session would have stamped
+            seq = entry.get("seq")
+            aff.seq = (
+                int(seq)
+                if isinstance(seq, int) and not isinstance(seq, bool)
+                else 0
+            )
+        return rid
+
     # -- the autoscaler's drain protocol (ISSUE 12) ------------------------
 
     def sessions_pinned_to(self, replica_id: str) -> list:
@@ -2011,6 +2113,15 @@ class Router:
         if status == 200:
             with self._lock:
                 aff.acts += 1
+        # capture the STAMPED body (seq travels) and the replica's raw
+        # answer — the failover decoration below touches neither the
+        # obs nor the action bytes
+        self._capture_note(
+            ctx, path=f"/session/{sid}/act", endpoint="session_act",
+            session=sid, body=body,
+            binary=_wire.is_binary_body(fwd_headers), replica=rid,
+            response=payload, response_ctype=ctype,
+        )
         resumed_steps = int(entry["steps"]) if resumed else None
         if status == 200 and aff.pending_resumed_steps is not None:
             pending = aff.pending_resumed_steps
@@ -2310,6 +2421,25 @@ class Router:
                 "trpo_trace_dropped_total", "counter",
                 "trace spans dropped by writer backpressure",
                 [({}, self.tracer.dropped_total)],
+            )
+        if self.capture is not None:
+            # request capture (ISSUE 18): the tracer contract again —
+            # writer-backpressure drops are counted, never silent, so
+            # dropped_total=0 certifies the capture log is complete
+            fam(
+                "trpo_capture_requests_total", "counter",
+                "requests captured for deterministic replay",
+                [({}, self.capture.requests_total)],
+            )
+            fam(
+                "trpo_capture_dropped_total", "counter",
+                "capture records dropped by writer backpressure",
+                [({}, self.capture.dropped_total)],
+            )
+            fam(
+                "trpo_capture_bytes_total", "counter",
+                "request payload bytes accepted for capture",
+                [({}, self.capture.bytes_total)],
             )
         body = ("\n".join(lines) + "\n").encode()
         return 200, "text/plain; version=0.0.4; charset=utf-8", body

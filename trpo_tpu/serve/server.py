@@ -52,7 +52,7 @@ import math
 import threading
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
@@ -141,6 +141,7 @@ class PolicyServer:
         session_adaptive_deadline: bool = True,
         tracer=None,
         uds_path: Optional[str] = None,
+        capture=None,
     ):
         if (checkpointer is None) != (template is None):
             raise ValueError(
@@ -181,6 +182,14 @@ class PolicyServer:
         # headers carry (or acts as the edge for direct clients); owned
         # by the caller, like the bus. None = layer off.
         self.tracer = tracer
+        # request capture (ISSUE 18): this replica's own record of the
+        # sampled/forced acts it answered — the router-side capture's
+        # twin for direct clients and multi-host incident windows.
+        # Caller-owned like the tracer; None = layer off. Notes park
+        # each in-flight act's capture fields until _trace_done knows
+        # the final sampling verdict (TraceContext is __slots__'d).
+        self.capture = capture
+        self._capture_notes: Dict[int, dict] = {}
         self.managed_reload = bool(managed_reload)
         # managed mode: the ONLY step this replica may serve; None =
         # "adopt whatever first checkpoint appears" (cold directory)
@@ -556,7 +565,26 @@ class PolicyServer:
             return
         if span is not None:
             span.end(**({} if status is None else {"status": status}))
+        if self.capture is not None:
+            # capture rides the final verdict: _traced forces the
+            # context on replica-side anomalies BEFORE calling here,
+            # so capture and span emission agree exactly (ISSUE 18)
+            with self._counter_lock:
+                note = self._capture_notes.pop(id(ctx), None)
+            if note is not None:
+                self.capture.record(
+                    ctx, status=status if status is not None else 500,
+                    **note,
+                )
         self.tracer.finish(ctx)
+
+    def _capture_note(self, ctx, **fields) -> None:
+        """Park one answered act's capture fields (ISSUE 18) until its
+        ``_trace_done``; no-op when the capture layer is off."""
+        if self.capture is None or ctx is None:
+            return
+        with self._counter_lock:
+            self._capture_notes[id(ctx)] = fields
 
     def _traced(self, name: str, fn, *args):
         """THE handler trace wrapper (the router has its twin): open
@@ -646,6 +674,7 @@ class PolicyServer:
         payload, reply_binary, err = self._negotiate(body)
         if err is not None:
             return err
+        body_binary = payload is not None  # _negotiate decoded a frame
         try:
             if payload is None:
                 payload = json.loads(body)
@@ -685,6 +714,11 @@ class PolicyServer:
         # `step` is the snapshot the batch ACTUALLY ran on (captured
         # inside the engine call) — reading loaded_step here instead
         # could race a hot swap and mislabel this action's provenance
+        self._capture_note(
+            ctx, path="/act", endpoint="act", body=body,
+            binary=body_binary, replica=self.replica_name, step=step,
+            action=np.asarray(action).tolist(),
+        )
         if reply_binary:
             return 200, _WIRE, _wire.encode_frame(
                 {"step": step}, {"action": np.asarray(action)}
@@ -838,6 +872,7 @@ class PolicyServer:
         payload, reply_binary, err = self._negotiate(body)
         if err is not None:
             return err
+        body_binary = payload is not None  # _negotiate decoded a frame
         try:
             if payload is None:
                 payload = json.loads(body)
@@ -877,6 +912,13 @@ class PolicyServer:
                         "session_steps": sess.steps,
                         "deduped": True,
                     }
+                    self._capture_note(
+                        ctx, path=path, endpoint="session_act",
+                        body=body, binary=body_binary, session=sid,
+                        replica=self.replica_name,
+                        step=sess.last_step,
+                        action=np.asarray(sess.last_action).tolist(),
+                    )
                     if reply_binary:
                         return 200, _WIRE, _wire.encode_frame(
                             meta,
@@ -945,6 +987,12 @@ class PolicyServer:
             "session": sid,
             "session_steps": sess.steps,
         }
+        self._capture_note(
+            ctx, path=path, endpoint="session_act", body=body,
+            binary=body_binary, session=sid,
+            replica=self.replica_name, step=step,
+            action=np.asarray(action).tolist(),
+        )
         if reply_binary:
             return 200, _WIRE, _wire.encode_frame(
                 meta, {"action": np.asarray(action)}
@@ -997,6 +1045,29 @@ class PolicyServer:
             "trpo_trace_dropped_total", "counter",
             "trace spans dropped by writer backpressure",
             [("", self.tracer.dropped_total)],
+        )
+
+    def _capture_fams(self, fam) -> None:
+        """The request-capture counters (ISSUE 18), appended to
+        whichever /metrics branch is rendering — the tracer contract
+        again: writer-backpressure drops are counted, never silent,
+        so dropped_total=0 certifies a complete capture log."""
+        if self.capture is None:
+            return
+        fam(
+            "trpo_capture_requests_total", "counter",
+            "requests captured for deterministic replay",
+            [("", self.capture.requests_total)],
+        )
+        fam(
+            "trpo_capture_dropped_total", "counter",
+            "capture records dropped by writer backpressure",
+            [("", self.capture.dropped_total)],
+        )
+        fam(
+            "trpo_capture_bytes_total", "counter",
+            "request payload bytes accepted for capture",
+            [("", self.capture.bytes_total)],
         )
 
     def _wire_fams(self, fam) -> None:
@@ -1151,6 +1222,7 @@ class PolicyServer:
             )
             self._wire_fams(fam)
             self._trace_fams(fam)
+            self._capture_fams(fam)
             body = ("\n".join(lines) + "\n").encode()
             return 200, "text/plain; version=0.0.4; charset=utf-8", body
 
@@ -1216,6 +1288,7 @@ class PolicyServer:
         )
         self._wire_fams(fam)
         self._trace_fams(fam)
+        self._capture_fams(fam)
         body = ("\n".join(lines) + "\n").encode()
         return 200, "text/plain; version=0.0.4; charset=utf-8", body
 
