@@ -56,6 +56,10 @@ echo "== tier-1: env fleet (chunked rollouts, wide-N presets, env-steps/s) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_env_fleet.py -q \
     -m 'not slow'
 
+echo "== tier-1: train->serve flywheel (promotion, reward gate, PBT) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_flywheel.py -q \
+    -m 'not slow'
+
 echo "== event-stream smoke: train + bench emit schema-valid JSONL =="
 OBS_TMP=$(mktemp -d)
 JAX_PLATFORMS=cpu python -m trpo_tpu.train --preset cartpole \
@@ -814,6 +818,55 @@ assert last["rollback_total"] == 0, last  # ladder must not cost rollbacks
 print(
     "ladder smoke OK: audits=%d fallbacks=0 rollbacks=0 cosine_min=%.4f"
     % (last["audit_runs"], last["solve_cosine_min"])
+)
+PYEOF
+
+echo "== flywheel smoke: fleet -> reward-aware canary promotion -> feedback =="
+# ISSUE 19 acceptance: a real 2-member recurrent pendulum fleet trains
+# under the scheduler, pick_winner names the winner through the gate,
+# and the winner promotes into a LIVE 2-replica serving tier through
+# the reward-aware canary gate under concurrent SESSION traffic (the
+# exact plane PR 11's canary had to refuse with exit 2) — with chaos
+# across the plane boundary: (a) kill_promoter fells the controller
+# mid-promotion AFTER the durable publish, and a restarted controller
+# converges on the journal (no re-publish) and promotes; (b) a
+# regress_checkpoint candidate (weights x8 — saves cleanly, LOADS
+# cleanly, only behaves worse; invisible to p99 and parity) is
+# REJECTED by the realized-return gate, incumbent untouched; (c) a
+# corrupt_checkpoint candidate (files torn AFTER the completion
+# marker) fails its canary reload loudly and is REJECTED. Zero
+# client-visible errors throughout, the served episode returns book as
+# a promote feedback record that feedback_scores reads back for the
+# next fleet round, and the whole log validates (every fault matched
+# by its REQUIRED detector — the regress rollback must name the
+# realized return, not a latency flake; no stranded promotions).
+FLY_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu python scripts/flywheel_smoke.py --tmp "$FLY_TMP" \
+    --quick
+python scripts/validate_events.py "$FLY_TMP/flywheel_events.jsonl"
+python - "$FLY_TMP" <<'PYEOF'
+import sys
+
+from trpo_tpu.fleet.promote import feedback_scores
+from trpo_tpu.obs.analyze import load_events, summarize_run
+
+records = load_events(sys.argv[1] + "/flywheel_events.jsonl")
+router = summarize_run(records)["router"]
+promote = router["promote"]
+assert promote["promoted"] == 1, promote
+assert promote["rejected"] == 2, promote
+assert promote["feedback_episodes"] > 0, promote
+outcomes = {
+    int(k): v["outcome"] for k, v in promote["steps"].items()
+}
+assert outcomes == {1: "promoted", 2: "rejected", 3: "rejected"}, outcomes
+episodes = router["episodes"]
+assert episodes["episodes"] > 0, episodes
+assert len(feedback_scores(records)) == 1, "feedback edge missing"
+print(
+    "flywheel smoke OK: promoted@1 after promoter kill, regress@2 + "
+    "corrupt@3 rejected, %d served episodes fed back"
+    % episodes["episodes"]
 )
 PYEOF
 

@@ -89,6 +89,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cull-bottom-k", type=int, default=None,
                    help="mark the k lowest-scoring finished members "
                    "culled (default 0)")
+    p.add_argument("--pbt-rounds", type=int, default=None,
+                   help="PBT exploit/explore rounds (default 0 = off): "
+                   "after each round, every culled member respawns "
+                   "from the winner's checkpoint with perturbed "
+                   "hyperparameters (seed, lam, cg_damping) and "
+                   "trains another segment")
+    p.add_argument("--pbt-iterations", type=int, default=None,
+                   help="explore-segment length in train iterations "
+                   "(default: the remainder of the base run)")
+    p.add_argument("--pbt-perturb", type=float, default=None,
+                   help="multiplicative perturbation factor for "
+                   "explored hypers, 0 < f < 1 (default 0.2 — "
+                   "hypers scale by 0.8x or 1.2x)")
+    p.add_argument(
+        "--feedback", default=None, metavar="EVENTS_JSONL",
+        help="serving-plane event log(s) with promote feedback "
+        "records (comma-separated): realized episode returns from "
+        "served traffic blend episode-weighted into member scores — "
+        "the flywheel's serve→train feedback path",
+    )
     p.add_argument("--scrape-interval", type=float, default=None,
                    help="seconds between /status scrapes (default 2)")
     p.add_argument(
@@ -133,7 +153,28 @@ _SPEC_OVERRIDES = {
     "gate_reference": "gate_reference",
     "cull_bottom_k": "cull_bottom_k",
     "scrape_interval": "scrape_interval",
+    "pbt_rounds": "pbt_rounds",
+    "pbt_iterations": "pbt_iterations",
+    "pbt_perturb": "pbt_perturb",
 }
+
+
+def _load_feedback(spec_arg: str) -> dict:
+    """Pool promote ``feedback`` records from serving-plane logs into
+    the scheduler's ``{member: (mean_return, episodes)}`` blend form.
+    """
+    from trpo_tpu.fleet.promote import feedback_scores
+    from trpo_tpu.obs.analyze import load_events
+
+    records = []
+    for path in spec_arg.split(","):
+        path = path.strip()
+        if not path:
+            continue
+        if not os.path.exists(path):
+            raise OSError(f"--feedback {path}: no such event log")
+        records.extend(load_events(path))
+    return feedback_scores(records)
 
 
 def _build_spec(args):
@@ -228,6 +269,19 @@ def _render_report(result: dict) -> str:
         out.append(f"  gate: {gate['reason']}")
     if result["culled"]:
         out.append(f"culled (bottom-k): {', '.join(result['culled'])}")
+    if result.get("respawned"):
+        out.append(
+            f"pbt respawned: {', '.join(result['respawned'])}"
+        )
+    bench = result.get("bench")
+    if bench:
+        out.append(
+            "bench: fleet wall "
+            f"{bench['fleet_wall_ms'] / 1e3:.1f}s vs member sum "
+            f"{bench['members_wall_ms'] / 1e3:.1f}s "
+            f"(speedup x{bench['parallel_speedup']:.2f} over "
+            f"{bench['max_workers']} workers)"
+        )
     verdict = {0: "CLEAN", 1: "FAILED/REGRESSED", 2: "UNREADABLE"}[
         result["exit_code"]
     ]
@@ -248,6 +302,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     jax.config.update("jax_platforms", args.platform)
     try:
         spec = _build_spec(args)
+        feedback = _load_feedback(args.feedback) if args.feedback else None
     except (ValueError, OSError) as e:
         print(f"ERROR    {e}", file=sys.stderr)
         return 2
@@ -273,7 +328,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ),
     )
     scheduler = FleetScheduler(
-        spec, fleet_dir, bus=bus, status_port=args.status_port
+        spec, fleet_dir, bus=bus, status_port=args.status_port,
+        feedback=feedback,
     )
     try:
         if scheduler.status_server is not None:

@@ -302,6 +302,27 @@ def build_parser() -> argparse.ArgumentParser:
         "requires finite actions)",
     )
     p.add_argument(
+        "--reward-window", type=int,
+        help="arm the reward-aware canary gate (default 0 = off): "
+        "after the p99 leg, the gate waits for this many REALIZED "
+        "episode returns on the canary (clients report reward/done in "
+        "their /session/act bodies) and judges the canary's mean "
+        "return against the pooled incumbents — the session-aware "
+        "path that makes recurrent canary deployment judgeable",
+    )
+    p.add_argument(
+        "--reward-min-episodes", type=int,
+        help="minimum pooled INCUMBENT episodes before the reward "
+        "gate judges (default: --reward-window; below the floor the "
+        "gate retries instead of blacklisting)",
+    )
+    p.add_argument(
+        "--reward-budget", type=float,
+        help="absolute mean-return drop the reward gate tolerates "
+        "before rejecting the canary (default 0 — any regression "
+        "beyond noise in the window rolls back)",
+    )
+    p.add_argument(
         "--inject-faults",
         help="serving-plane chaos spec (resilience/inject.py grammar): "
         "kill_replica@request=K:replica=R, "
@@ -457,6 +478,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         updates["serve_canary_fraction"] = args.canary_fraction
     if args.canary_window is not None:
         updates["serve_canary_window"] = args.canary_window
+    if args.reward_window is not None:
+        updates["serve_reward_window"] = args.reward_window
+    if args.reward_min_episodes is not None:
+        updates["serve_reward_min_episodes"] = args.reward_min_episodes
+    if args.reward_budget is not None:
+        updates["serve_reward_budget"] = args.reward_budget
     if args.trace_sample_rate is not None:
         updates["trace_sample_rate"] = args.trace_sample_rate
     if updates:
@@ -555,17 +582,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             file=sys.stderr,
         )
         return 2
-    if canary and recurrent:
-        # the gate windows STATELESS traffic and keeps sessions off the
-        # canary — a recurrent set would starve every gate window and
-        # blacklist every new checkpoint. Refuse loudly instead of
-        # silently pinning the fleet to its first checkpoint.
+    if canary and recurrent and cfg.serve_reward_window < 1:
+        # without the reward gate the canary judges only windowed
+        # STATELESS traffic — a recurrent set serves only sessions, so
+        # no gate window could ever fill and every new checkpoint
+        # would be starved into a blacklist. The reward gate (ISSUE
+        # 19) is the session-aware path: the router strides a fraction
+        # of NEW sessions onto the canary and the gate judges realized
+        # episode returns, so recurrent+canary is judgeable when it is
+        # armed. Refuse loudly only when it is not.
         print(
-            "error: --canary-fraction gates stateless /act traffic; a "
-            "recurrent policy serves only sessions (which never route "
-            "to the canary), so no gate window could ever fill. Run "
-            "recurrent serving without --canary-fraction (session-"
-            "aware gating is a ROADMAP item).",
+            "error: --canary-fraction on a recurrent policy needs the "
+            "reward-aware gate — sessions (the only recurrent "
+            "traffic) are judged by realized episode returns, not the "
+            "stateless p99/parity window. Pass --reward-window N (and "
+            "have clients report reward/done in /session/act bodies), "
+            "or drop --canary-fraction.",
             file=sys.stderr,
         )
         return 2
@@ -806,6 +838,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 parity_tol=args.canary_parity_tol,
                 poll_interval=cfg.serve_poll_interval,
                 bus=bus,
+                reward_window_episodes=cfg.serve_reward_window,
+                reward_min_episodes=(
+                    cfg.serve_reward_min_episodes or None
+                ),
+                reward_budget=cfg.serve_reward_budget,
             )
             controller.start()
             closers.append(canary_ck)

@@ -75,7 +75,20 @@ carrying ``replay`` records at all: every act the replayer planned
 (``begin.acts``) must be driven, every driven act must have its diff
 ``verdict`` (same trace + order — an uncompared act cannot be called
 bit-exact), and a replay that began must terminate in a ``complete``
-record whose act count matches the plan.
+record whose act count matches the plan; and — ISSUE 19 — the
+train→serve flywheel contracts: every ``promote`` record with
+``event="candidate"`` must be FOLLOWED by the same step's
+``promoted``/``rejected``/``rolled_back`` terminal (a stranded
+promotion means the controller's crash-convergence loop is broken —
+whole-log, so a killed-and-restarted controller that converges
+satisfies it), and the boundary faults must each be matched:
+``corrupt_checkpoint`` by the torn step's canary/promote rejection
+(the failed reload is the detector), ``regress_checkpoint`` by a
+rejection whose reason names the *realized return* (only the reward
+gate can catch a checkpoint that is fast, finite, and worse at the
+task — a p99 or parity rejection does NOT satisfy it),
+``kill_promoter`` by a later ``promote`` terminal for the killed step
+(the restarted controller re-read journal + markers and converged).
 Exits non-zero with per-line diagnostics on any failure; prints a
 per-kind count summary on success. Used by ``scripts/check.sh`` against
 both a training run's ``--metrics-jsonl`` output and ``bench.py``'s
@@ -176,6 +189,55 @@ def _fault_matcher(fault_rec: dict):
         ) or (
             rec.get("kind") == "canary"
             and rec.get("event") == "rolled_back"
+            and rec.get("step") == at
+        )
+    if fault_kind == "corrupt_checkpoint":
+        # a checkpoint torn AFTER its completion marker landed: the
+        # marker protocol cannot see it, so the REQUIRED detector is
+        # the canary's failed reload — a canary/health rejection for
+        # the torn step, or the promotion controller's own terminal
+        # rejection of it
+        at = fault_rec.get("at")
+        return lambda rec: (
+            rec.get("kind") == "health"
+            and rec.get("check") == "canary_rejected"
+            and (rec.get("data") or {}).get("step") == at
+        ) or (
+            rec.get("kind") == "canary"
+            and rec.get("event") == "rolled_back"
+            and rec.get("step") == at
+        ) or (
+            rec.get("kind") == "promote"
+            and rec.get("event") in ("rejected", "rolled_back")
+            and rec.get("step") == at
+        )
+    if fault_kind == "regress_checkpoint":
+        # loads cleanly, answers fast and finite, scores WORSE: only
+        # the reward gate can catch it, so the rejection reason must
+        # name the realized return — a p99 or parity rejection of the
+        # same step would mean some other gate fired on noise while
+        # the regression itself went undetected
+        at = fault_rec.get("at")
+        return lambda rec: (
+            rec.get("kind") == "canary"
+            and rec.get("event") == "rolled_back"
+            and rec.get("step") == at
+            and "realized return" in str(rec.get("reason", ""))
+        ) or (
+            rec.get("kind") == "health"
+            and rec.get("check") == "canary_rejected"
+            and (rec.get("data") or {}).get("step") == at
+            and "realized return" in str(rec.get("message", ""))
+        )
+    if fault_kind == "kill_promoter":
+        # the controller died after publish, before the gate: the
+        # detection is CONVERGENCE — a later promote terminal for the
+        # killed step proves a restarted controller re-read the
+        # journal + markers and finished the promotion either way
+        at = fault_rec.get("at")
+        return lambda rec: (
+            rec.get("kind") == "promote"
+            and rec.get("event") in ("promoted", "rejected", "rolled_back")
             and rec.get("step") == at
         )
     if fault_kind in ("partition_host", "slow_network"):
@@ -428,6 +490,29 @@ def validate_file(path: str) -> list:
             errs.append(
                 f"{path}:{n}: canary for step {step} started with no "
                 "matching promoted/rolled_back terminal record after it"
+            )
+    # ISSUE 19 flywheel contract (the canary `started` pattern, but
+    # whole-log on BOTH sides): a promote candidate with no terminal
+    # for the same serving step means the promotion controller's
+    # crash-convergence loop is broken — a kill_promoter run satisfies
+    # it precisely because the restarted controller's terminal lands
+    # later in the same log
+    for idx, (n, rec) in enumerate(records):
+        if rec.get("kind") != "promote" or rec.get("event") != "candidate":
+            continue
+        step = rec.get("step")
+        if not any(
+            later.get("kind") == "promote"
+            and later.get("step") == step
+            and later.get("event") in (
+                "promoted", "rejected", "rolled_back"
+            )
+            for _, later in records[idx + 1:]
+        ):
+            errs.append(
+                f"{path}:{n}: promote candidate for serving step {step} "
+                "has no matching promoted/rejected/rolled_back terminal "
+                "record after it — a stranded promotion"
             )
     # ISSUE 14 lease contract (the replica `died` pattern): an expired
     # lease the supervisor neither evicted on nor re-granted means the

@@ -1,0 +1,1064 @@
+"""Train→serve flywheel (ISSUE 19): promotion controller, reward-aware
+canary gating, PBT exploit/explore, served-return feedback, and the
+boundary-chaos validator contracts.
+
+Contracts pinned here:
+
+* :func:`pick_winner` names the best finished member THROUGH the fleet
+  compare-gate (regressed/unreadable/culled/failed never promote;
+  ``skipped`` passes — no clean baseline is not a verdict against the
+  member), ties break toward the lower member id;
+* the :class:`PromotionController` state machine: ``candidate`` →
+  ``canary`` → ``promoted``/``rejected``/``rolled_back`` with every
+  transition journaled; a terminal promotion is cached (never
+  re-published, never re-gated — the no-double-promote guarantee); a
+  controller killed mid-promotion (``kill_promoter``) RESTARTS and
+  converges on the journal + completion markers without re-publishing;
+  a torn ``publishing`` phase re-publishes the SAME serving step; a
+  rejected serving step is never reassigned;
+* the reward-aware gate verdicts: clean pass, judged regression (the
+  reason MUST name the realized return — the validator's
+  ``regress_checkpoint`` matcher keys on it), starved canary window and
+  thin incumbent baseline are TRANSIENT (prefix-matched against
+  ``_TRANSIENT_REASONS`` so they never blacklist), a canary death
+  mid-window resolves transient; the gate is disarmed by default
+  (``reward_window_episodes=0`` — the PR 11 behavior);
+* the router's flywheel half: session CREATES stride
+  ``canary_fraction`` onto the canary, and client-reported per-act
+  ``reward``/``done`` books completed-episode returns per replica;
+* PBT exploit/explore: a culled member respawns FROM THE WINNER'S
+  checkpoint with deterministically perturbed hypers, its event log
+  rotates aside, the fleet gate skips the respawn segment, and the
+  fleet result carries the ``fleet/wall`` BENCH row;
+* served feedback blends into member scores episode-weighted;
+* the validator fails a stranded ``promote`` candidate and matches the
+  three boundary faults by their REQUIRED detectors.
+"""
+
+import json
+import math
+import os
+import random
+import sys
+import threading
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trpo_tpu.fleet import FleetScheduler, FleetSpec, MemberSpec
+from trpo_tpu.fleet.promote import (
+    JOURNAL_NAME,
+    PromotionController,
+    feedback_scores,
+    pick_winner,
+)
+from trpo_tpu.obs.events import EventBus, validate_event
+from trpo_tpu.resilience.inject import FaultInjector, PromoterKilled
+from trpo_tpu.serve.replicaset import CanaryController
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _recording_bus():
+    events = []
+    return EventBus(lambda rec: events.append(rec)), events
+
+
+def _post(url, payload=None, timeout=30.0):
+    data = b"" if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class FakeCheckpointer:
+    """Marker-faithful in-memory checkpointer: per-directory backing
+    store shared across handles (the on-disk persistence a restarted
+    controller converges on), a save/marker split so torn publishes can
+    be staged, and a save counter pinning no-double-publish."""
+
+    registry: dict = {}
+
+    def __init__(self, directory):
+        self.dir = os.path.abspath(directory)
+        self.store = self.registry.setdefault(
+            self.dir, {"steps": {}, "markers": set(), "saves": 0}
+        )
+
+    def latest_step(self, refresh=False):
+        return max(self.store["markers"]) if self.store["markers"] else None
+
+    def restore(self, template, step=None, prune=True):
+        return self.store["steps"][step]
+
+    def save(self, step, state):
+        self.store["steps"][step] = state
+        self.store["markers"].add(step)
+        self.store["saves"] += 1
+
+    def refresh(self):
+        pass
+
+    def prune_incomplete(self):
+        for s in list(self.store["steps"]):
+            if s not in self.store["markers"]:
+                del self.store["steps"][s]
+
+    def _complete_steps(self):
+        return sorted(self.store["markers"])
+
+    def close(self):
+        pass
+
+
+def _seed_member_ck(directory, step, state):
+    FakeCheckpointer(directory).save(step, state)
+
+
+class FakeCanary:
+    """A scripted gate: ``script[serve_step]`` is ``"promote"`` /
+    ``"reject"`` / absent (never resolves — the controller's deadline
+    fires). Carries the real controller's observable surface — the
+    shared ``incumbent`` cell and the ``_rejected_steps`` blacklist —
+    which is all :meth:`PromotionController._drive_gate` reads."""
+
+    def __init__(self, serve_dir, script=None):
+        self.serve_dir = serve_dir
+        self.script = dict(script or {})
+        self.incumbent = {"step": None}
+        self._rejected_steps = set()
+        self.ticks = 0
+        self.router = None
+        self.replicaset = None
+
+    def tick(self):
+        self.ticks += 1
+        step = FakeCheckpointer(self.serve_dir).latest_step()
+        if (
+            step is None
+            or step == self.incumbent["step"]
+            or step in self._rejected_steps
+        ):
+            return
+        verdict = self.script.get(step)
+        if verdict == "promote":
+            self.incumbent["step"] = step
+        elif verdict == "reject":
+            self._rejected_steps.add(step)
+
+
+def _controller(serve_dir, canary, bus=None, injector=None, **kw):
+    kw.setdefault("gate_timeout_s", 10.0)
+    kw.setdefault("poll_interval", 0.005)
+    return PromotionController(
+        serve_dir, template=None, canary=canary, bus=bus,
+        injector=injector, checkpointer_factory=FakeCheckpointer, **kw,
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fake_stores():
+    FakeCheckpointer.registry.clear()
+    yield
+    FakeCheckpointer.registry.clear()
+
+
+# ---------------------------------------------------------------------------
+# pick_winner / feedback_scores (pure)
+# ---------------------------------------------------------------------------
+
+
+def test_pick_winner_goes_through_the_gate():
+    result = {
+        "scores": {"a": 3.0, "b": 9.0, "c": 7.0, "d": 8.0, "e": 6.0,
+                   "f": float("-inf")},
+        "culled": ["c"],
+        "failed": ["e"],
+        "gate": {"members": {
+            "a": {"verdict": "ok"},
+            "b": {"verdict": "regressed"},
+            "d": {"verdict": "skipped"},
+        }},
+    }
+    # b scored best but the gate judged it regressed; c culled, e
+    # failed, f non-finite — d (gate skipped) wins
+    assert pick_winner(result) == "d"
+    result["gate"]["members"]["d"] = {"verdict": "unreadable"}
+    assert pick_winner(result) == "a"
+    assert pick_winner({"scores": {}}) is None
+    # ties break toward the lower member id, deterministically
+    tied = {"scores": {"m2": 5.0, "m1": 5.0, "m0": 4.0}, "gate": {}}
+    assert pick_winner(tied) == "m1"
+
+
+def test_feedback_scores_pools_episode_weighted():
+    def fb(member, mean, episodes, **extra):
+        return {"v": 1, "t": 1.0, "kind": "promote", "member": member,
+                "event": "feedback", "step": 1, "mean_return": mean,
+                "episodes": episodes, **extra}
+
+    records = [
+        fb("m0", 2.0, 3),
+        fb("m0", 4.0, 1),
+        fb("m1", -1.0, 2),
+        fb("m2", float("nan"), 2),          # non-finite mean: skipped
+        fb("m3", 1.0, 0),                    # zero episodes: skipped
+        {"v": 1, "kind": "promote", "member": "m0",
+         "event": "promoted", "step": 1},     # not a feedback record
+        {"kind": "iteration", "iteration": 1},
+    ]
+    scores = feedback_scores(records)
+    assert scores == {"m0": ((2.0 * 3 + 4.0) / 4, 4), "m1": (-1.0, 2)}
+    assert feedback_scores([]) == {}
+
+
+# ---------------------------------------------------------------------------
+# PromotionController state machine (stub canary + checkpointer, no HTTP)
+# ---------------------------------------------------------------------------
+
+
+def test_promote_walks_candidate_canary_promoted_and_caches(tmp_path):
+    src = str(tmp_path / "member")
+    serve = str(tmp_path / "serve")
+    _seed_member_ck(src, 7, {"w": [1.0, 2.0]})
+    canary = FakeCanary(serve, script={1: "promote"})
+    bus, events = _recording_bus()
+    ctrl = _controller(serve, canary, bus=bus)
+
+    res = ctrl.promote("m0", src)
+    assert res["outcome"] == "promoted" and res["reason"] is None
+    assert res["member"] == "m0"
+    assert res["src_step"] == 7 and res["serve_step"] == 1
+    # the member's state landed, marker-complete, in the serving dir
+    serve_store = FakeCheckpointer(serve).store
+    assert serve_store["steps"][1] == {"w": [1.0, 2.0]}
+    assert serve_store["saves"] == 1
+    assert canary.incumbent["step"] == 1
+    # typed promote events, in lifecycle order, schema-valid
+    promote_evs = [e for e in events if e["kind"] == "promote"]
+    assert [(e["event"], e["step"]) for e in promote_evs] == [
+        ("candidate", 1), ("canary", 1), ("promoted", 1),
+    ]
+    for e in events:
+        assert validate_event(e) == [], e
+    # the journal holds the terminal entry
+    with open(os.path.join(serve, JOURNAL_NAME)) as f:
+        journal = json.load(f)
+    assert journal["entries"]["m0@7"]["outcome"] == "promoted"
+
+    # no-double-promote: the repeat is answered from the journal —
+    # no new publish, no new gate, no new events
+    n_events = len(events)
+    res2 = ctrl.promote("m0", src)
+    assert res2["outcome"] == "promoted"
+    assert serve_store["saves"] == 1
+    assert len(events) == n_events
+
+
+def test_rejected_step_blacklists_and_is_never_reassigned(tmp_path):
+    src = str(tmp_path / "member")
+    serve = str(tmp_path / "serve")
+    _seed_member_ck(src, 3, {"w": [0.5]})
+    canary = FakeCanary(serve, script={1: "reject", 2: "promote"})
+    bus, events = _recording_bus()
+    ctrl = _controller(serve, canary, bus=bus)
+
+    res = ctrl.promote("m0", src)
+    assert res["outcome"] == "rejected"
+    assert "rejected" in res["reason"]
+    assert 1 in canary._rejected_steps
+    assert canary.incumbent["step"] is None
+    # a different candidate NEVER reuses the blacklisted serving step
+    src2 = str(tmp_path / "member2")
+    _seed_member_ck(src2, 5, {"w": [0.7]})
+    res2 = ctrl.promote("m1", src2)
+    assert res2["serve_step"] == 2 and res2["outcome"] == "promoted"
+    terminal = [
+        (e["member"], e["event"], e["step"])
+        for e in events
+        if e["kind"] == "promote"
+        and e["event"] in ("promoted", "rejected", "rolled_back")
+    ]
+    assert terminal == [("m0", "rejected", 1), ("m1", "promoted", 2)]
+
+
+def test_unresolved_gate_rolls_back_on_deadline(tmp_path):
+    src = str(tmp_path / "member")
+    serve = str(tmp_path / "serve")
+    _seed_member_ck(src, 2, {"w": [1.0]})
+    canary = FakeCanary(serve, script={})  # the gate never resolves
+    ctrl = _controller(serve, canary)
+    res = ctrl.promote("m0", src, timeout_s=0.15)
+    assert res["outcome"] == "rolled_back"
+    assert "did not resolve" in res["reason"]
+    assert canary.ticks > 0  # the controller was driving the gate
+
+
+def test_kill_promoter_restart_converges_without_republishing(tmp_path):
+    src = str(tmp_path / "member")
+    serve = str(tmp_path / "serve")
+    _seed_member_ck(src, 4, {"w": [9.0]})
+    bus, events = _recording_bus()
+    injector = FaultInjector.from_spec("kill_promoter@step=1", bus=bus)
+    canary = FakeCanary(serve, script={1: "promote"})
+    ctrl = _controller(serve, canary, bus=bus, injector=injector)
+
+    with pytest.raises(PromoterKilled):
+        ctrl.promote("m0", src)
+    assert injector.all_fired
+    serve_store = FakeCheckpointer(serve).store
+    # the controller died AFTER the durable publish, BEFORE the gate
+    assert serve_store["saves"] == 1 and 1 in serve_store["markers"]
+    with open(os.path.join(serve, JOURNAL_NAME)) as f:
+        entry = json.load(f)["entries"]["m0@4"]
+    assert entry["phase"] == "published" and entry["outcome"] is None
+    # mid-promotion: a candidate event exists but no terminal yet
+    assert [(e["event"]) for e in events if e["kind"] == "promote"] == [
+        "candidate"
+    ]
+
+    # the restarted controller (fresh instance, no injector) re-reads
+    # journal + markers and converges — WITHOUT a second publish
+    ctrl2 = _controller(serve, canary, bus=bus)
+    res = ctrl2.promote("m0", src)
+    assert res["outcome"] == "promoted" and res["serve_step"] == 1
+    assert serve_store["saves"] == 1
+    for e in events:
+        assert validate_event(e) == [], e
+    kinds = [(e["event"], e["step"]) for e in events
+             if e["kind"] == "promote"]
+    # candidate emitted ONCE (before the kill); the restart goes
+    # straight to the gate and lands the terminal
+    assert kinds == [("candidate", 1), ("canary", 1), ("promoted", 1)]
+
+
+def test_torn_publishing_phase_republishes_same_step(tmp_path):
+    src = str(tmp_path / "member")
+    serve = str(tmp_path / "serve")
+    _seed_member_ck(src, 6, {"w": [3.0]})
+    canary = FakeCanary(serve, script={2: "promote"})
+    ctrl = _controller(serve, canary)
+    # a previous incarnation died mid-publish: journal says publishing
+    # at serve step 2, and the serving dir holds a TORN (marker-less)
+    # half-save of that step
+    os.makedirs(serve, exist_ok=True)
+    with open(os.path.join(serve, JOURNAL_NAME), "w") as f:
+        json.dump({"entries": {"m0@6": {
+            "member": "m0", "src_step": 6, "serve_step": 2,
+            "phase": "publishing", "outcome": None, "reason": None,
+        }}}, f)
+    serve_store = FakeCheckpointer(serve).store
+    serve_store["steps"][2] = {"w": ["TORN"]}  # no marker
+    res = ctrl.promote("m0", src)
+    # the SAME serving step was pruned, re-published and promoted
+    assert res["serve_step"] == 2 and res["outcome"] == "promoted"
+    assert serve_store["steps"][2] == {"w": [3.0]}
+    assert serve_store["saves"] == 1
+
+
+def test_next_serve_step_is_monotonic_over_all_floors(tmp_path):
+    serve = str(tmp_path / "serve")
+    canary = FakeCanary(serve)
+    ctrl = _controller(serve, canary)
+    assert ctrl._next_serve_step() == 1
+    canary.incumbent["step"] = 5
+    assert ctrl._next_serve_step() == 6
+    FakeCheckpointer(serve).save(7, {})
+    assert ctrl._next_serve_step() == 8
+    # journal-assigned steps floor it too — a blacklisted step from a
+    # crashed promotion is never handed to the next candidate
+    ctrl._save_entry("mX@1", {"serve_step": 11})
+    assert ctrl._next_serve_step() == 12
+
+
+def test_promote_without_source_checkpoint_raises(tmp_path):
+    ctrl = _controller(
+        str(tmp_path / "serve"), FakeCanary(str(tmp_path / "serve"))
+    )
+    with pytest.raises(FileNotFoundError, match="no complete checkpoint"):
+        ctrl.promote("m0", str(tmp_path / "empty"))
+
+
+def test_promotion_feedback_pools_served_episodes(tmp_path):
+    serve = str(tmp_path / "serve")
+    canary = FakeCanary(serve)
+    canary.router = types.SimpleNamespace(
+        replica_episode_returns=lambda rid: {
+            "r0": [1.0, 3.0], "r1": [5.0]
+        }.get(rid, [])
+    )
+    canary.replicaset = types.SimpleNamespace(
+        lock=threading.Lock(), replicas={"r0": None, "r1": None}
+    )
+    bus, events = _recording_bus()
+    ctrl = _controller(serve, canary, bus=bus)
+    out = ctrl.feedback("m0", 3)
+    assert out["episodes"] == 3 and out["mean_return"] == 3.0
+    fb = [e for e in events if e["kind"] == "promote"]
+    assert len(fb) == 1 and fb[0]["event"] == "feedback"
+    assert validate_event(fb[0]) == []
+    # round-trips through the reader the next fleet round uses
+    assert feedback_scores(events) == {"m0": (3.0, 3)}
+
+
+# ---------------------------------------------------------------------------
+# reward-aware gate verdicts (stub router/replicaset, no HTTP)
+# ---------------------------------------------------------------------------
+
+
+class _StubRouter:
+    def __init__(self):
+        self.eps = {}
+        self.bodies = []
+
+    def replica_episode_returns(self, rid):
+        return list(self.eps.get(rid, []))
+
+    def reset_replica_episodes(self):
+        self.eps.clear()
+
+    def recent_act_bodies(self, n=8):
+        return self.bodies[-n:]
+
+
+def _reward_gate(**kw):
+    rs = types.SimpleNamespace(lock=threading.Lock(), replicas={})
+    router = _StubRouter()
+    kw.setdefault("window_requests", 1)
+    kw.setdefault("gate_timeout_s", 0.25)
+    kw.setdefault("poll_interval", 0.01)
+    ctrl = CanaryController(rs, router, lambda: None, **kw)
+    rec = types.SimpleNamespace(id="c0", state="healthy", restarts=0)
+    return ctrl, router, rec
+
+
+def test_reward_gate_passes_within_budget():
+    ctrl, router, rec = _reward_gate(
+        reward_window_episodes=3, reward_min_episodes=2,
+        reward_budget=0.5,
+    )
+    router.eps = {"c0": [1.0, 1.2, 0.8], "r0": [1.1], "r1": [1.3]}
+    ok, reason = ctrl._judge_reward(rec, ["r0", "r1"], 0)
+    assert ok and reason is None
+    # worse — but within the budget — still passes
+    router.eps["c0"] = [0.8, 0.8, 0.8]
+    ok, _ = ctrl._judge_reward(rec, ["r0", "r1"], 0)
+    assert ok
+
+
+def test_reward_gate_judges_regression_naming_realized_return():
+    ctrl, router, rec = _reward_gate(
+        reward_window_episodes=2, reward_budget=0.5,
+    )
+    router.eps = {"c0": [0.0, 0.1], "r0": [2.0, 2.2]}
+    ok, reason = ctrl._judge_reward(rec, ["r0"], 0)
+    assert not ok
+    # the validator's regress_checkpoint matcher keys on this phrase —
+    # a reworded reason silently breaks the chaos contract
+    assert "realized return" in reason
+    assert "2 canary vs 2 incumbent" in reason
+    # a JUDGED reason is not transient: it must blacklist
+    assert not any(
+        reason.startswith(t) for t in CanaryController._TRANSIENT_REASONS
+    )
+
+
+def test_reward_gate_starved_and_thin_baseline_are_transient():
+    ctrl, router, rec = _reward_gate(
+        reward_window_episodes=3, reward_min_episodes=2,
+    )
+    # canary never fills its window within the gate timeout
+    router.eps = {"c0": [1.0], "r0": [1.0, 1.0]}
+    ok, reason = ctrl._judge_reward(rec, ["r0"], 0)
+    assert not ok and reason.startswith("reward window starved")
+    # incumbents under the min-episode floor: unusable baseline
+    router.eps = {"c0": [1.0, 1.0, 1.0], "r0": [1.0]}
+    ok, reason = ctrl._judge_reward(rec, ["r0"], 0)
+    assert not ok and reason.startswith("no usable reward baseline")
+    # both are prefix-matched transient — retried, never blacklisted
+    for r in ("reward window starved: 1/3", "no usable reward baseline"):
+        assert any(
+            r.startswith(t) for t in CanaryController._TRANSIENT_REASONS
+        )
+
+
+def test_reward_gate_canary_death_is_transient():
+    ctrl, router, rec = _reward_gate(reward_window_episodes=2)
+    router.eps = {"c0": []}
+    rec.restarts = 1  # relaunched mid-window: the snapshot is gone
+    ok, reason = ctrl._judge_reward(rec, [], 0)
+    assert not ok and reason == "canary died mid-gate"
+
+
+def test_reward_gate_defaults_disarmed_and_validates_params():
+    ctrl, _, _ = _reward_gate()
+    assert ctrl.reward_window_episodes == 0  # PR 11 behavior untouched
+    assert ctrl.reward_min_episodes == 1
+    assert ctrl.reward_budget == 0.0
+    with pytest.raises(ValueError, match="reward_window_episodes"):
+        _reward_gate(reward_window_episodes=-1)
+    with pytest.raises(ValueError, match="reward_min_episodes"):
+        _reward_gate(reward_min_episodes=0)
+    with pytest.raises(ValueError, match="reward_budget"):
+        _reward_gate(reward_budget=-0.1)
+
+
+def test_config_reward_fields_validate():
+    from trpo_tpu.config import TRPOConfig
+
+    cfg = TRPOConfig(serve_reward_window=4, serve_reward_min_episodes=2,
+                     serve_reward_budget=0.5)
+    assert cfg.serve_reward_window == 4
+    with pytest.raises(ValueError, match="serve_reward_window"):
+        TRPOConfig(serve_reward_window=-1)
+    with pytest.raises(ValueError, match="serve_reward_budget"):
+        TRPOConfig(serve_reward_budget=-0.5)
+
+
+# ---------------------------------------------------------------------------
+# router: session striding + realized-return booking (recurrent stack)
+# ---------------------------------------------------------------------------
+
+_CFG = dict(
+    n_envs=4, batch_timesteps=32, cg_iters=2, vf_train_steps=2,
+    policy_hidden=(8,), vf_hidden=(8,), seed=11,
+)
+
+
+@pytest.fixture(scope="module")
+def rec():
+    from trpo_tpu.agent import TRPOAgent
+    from trpo_tpu.config import TRPOConfig
+
+    agent = TRPOAgent("pendulum", TRPOConfig(**{**_CFG, "policy_gru": 8}))
+    state = agent.init_state(seed=0)
+    return agent, state
+
+
+def _rec_factory(agent, state, bus=None):
+    from trpo_tpu.serve import PolicyServer
+
+    def make(rid):
+        def factory():
+            engine = agent.serve_session_engine()
+            engine.load(state.policy_params, state.obs_norm, step=1)
+            server = PolicyServer(
+                engine, None, port=0, bus=bus, replica_name=rid,
+            )
+            return server, []
+
+        return factory
+
+    return make
+
+
+def _replicaset(make, n, bus=None, **kw):
+    from trpo_tpu.serve import InProcessReplica, ReplicaSet
+
+    kw.setdefault("health_interval", 60.0)
+    kw.setdefault("backoff", 0.05)
+    kw.setdefault("health_fail_threshold", 1)
+    kw.setdefault("max_restarts", 2)
+    rs = ReplicaSet(
+        lambda rid: InProcessReplica(make(rid)), n, bus=bus, **kw
+    )
+    assert rs.wait_healthy(n, timeout=60.0), rs.snapshot()
+    return rs
+
+
+def test_session_stride_and_episode_booking(rec):
+    from trpo_tpu.serve import Router
+
+    agent, state = rec
+    bus, events = _recording_bus()
+    rs = _replicaset(_rec_factory(agent, state, bus=bus), 2, bus=bus)
+    router = Router(rs, port=0, bus=bus, canary_fraction=0.5)
+    try:
+        with rs.lock:
+            rs.replicas["r1"].canary = True
+        pins = []
+        for _ in range(8):
+            s, out = _post(router.url + "/session")
+            assert s == 200
+            pins.append((out["session"], out["replica"]))
+        # deterministic session stride at 0.5: exactly half the CREATES
+        # pin to the canary — whole episodes, the reward gate's unit
+        assert sum(1 for _, r in pins if r == "r1") == 4, pins
+        obs = np.zeros(agent.obs_shape, np.float32).tolist()
+        for sid, rid in pins:
+            reward = 1.0 if rid == "r1" else 0.5
+            for t in range(3):
+                s, out = _post(
+                    router.url + f"/session/{sid}/act",
+                    {"obs": obs, "reward": reward, "done": t == 2},
+                )
+                assert s == 200, out
+        assert sorted(router.replica_episode_returns("r1")) == [3.0] * 4
+        assert sorted(router.replica_episode_returns("r0")) == [1.5] * 4
+        assert router.episodes_total == 8
+        # episode events rode the bus (the fleet feedback path)
+        eps = [e for e in events if e["kind"] == "session"
+               and e["event"] == "episode"]
+        assert len(eps) == 8
+        for e in eps:
+            assert validate_event(e) == [], e
+        assert {e["replica"] for e in eps} == {"r0", "r1"}
+        # a malformed reward is ignored, not booked and not a 500
+        s, out = _post(router.url + "/session")
+        sid = out["session"]
+        s, out = _post(
+            router.url + f"/session/{sid}/act",
+            {"obs": obs, "reward": "seven"},
+        )
+        assert s == 200
+        assert router.episodes_total == 8
+        # the gate's fresh-window reset
+        router.reset_replica_episodes()
+        assert router.replica_episode_returns("r1") == []
+    finally:
+        router.close()
+        rs.close()
+
+
+# ---------------------------------------------------------------------------
+# PBT exploit/explore on the fleet (stub subprocess members)
+# ---------------------------------------------------------------------------
+
+_STUB_MEMBER = """
+import sys, os, json
+member_dir, reward = sys.argv[1], float(sys.argv[2])
+with open(os.path.join(member_dir, "events.jsonl"), "a") as f:
+    f.write(json.dumps({"v":1,"t":0.0,"kind":"run_manifest",
+        "schema":"trpo-tpu-events","jax_version":"0","backend":"cpu",
+        "config_hash":"0123456789abcdef","config":None}) + "\\n")
+    for i in (1, 2):
+        f.write(json.dumps({"v":1,"t":float(i),"kind":"iteration",
+            "iteration":i,"stats":{"iteration_ms":5.0,
+            "cg_iters_total":1,"linesearch_trials_total":1,
+            "mean_episode_reward":reward,"episodes_in_batch":4}}) + "\\n")
+sys.exit(0)
+"""
+
+
+def _member_launcher(rewards, respawn_reward=None):
+    calls = {}
+
+    def launcher(member, ctx):
+        mid = member.member_id
+        n = calls.get(mid, 0)
+        calls[mid] = n + 1
+        reward = rewards[mid]
+        if n > 0 and respawn_reward is not None:
+            reward = respawn_reward  # the explore segment paid off
+        return [sys.executable, "-c", _STUB_MEMBER, ctx["member_dir"],
+                str(reward)]
+
+    return launcher
+
+
+def _pbt_spec(members, **kw):
+    kw.setdefault("requeue_backoff", 0.01)
+    kw.setdefault("poll_interval", 0.02)
+    kw.setdefault("scrape_interval", 60.0)
+    kw.setdefault("max_workers", 3)
+    return FleetSpec(members=tuple(members), **kw)
+
+
+def test_pbt_spec_fields_validate():
+    spec = _pbt_spec([MemberSpec("m0")], pbt_rounds=2,
+                     pbt_iterations=3, pbt_perturb=0.25)
+    assert spec.pbt_rounds == 2 and spec.pbt_perturb == 0.25
+    with pytest.raises(ValueError, match="pbt_rounds"):
+        _pbt_spec([MemberSpec("m0")], pbt_rounds=-1)
+    with pytest.raises(ValueError, match="pbt_iterations"):
+        _pbt_spec([MemberSpec("m0")], pbt_iterations=0)
+    with pytest.raises(ValueError, match="pbt_perturb"):
+        _pbt_spec([MemberSpec("m0")], pbt_perturb=1.5)
+
+
+def test_pbt_respawns_culled_member_from_winner(tmp_path):
+    rewards = {"good": 2.0, "mid": 1.0, "bad": 0.0}
+    spec = _pbt_spec(
+        [
+            MemberSpec("good"),
+            MemberSpec("mid"),
+            MemberSpec("bad", (("lam", "0.9"), ("cg_damping", "0.2"),
+                               ("seed", "3"))),
+        ],
+        cull_bottom_k=1, pbt_rounds=1, pbt_iterations=2,
+        pbt_perturb=0.2,
+    )
+    bus, events = _recording_bus()
+    sch = FleetScheduler(
+        spec, str(tmp_path), bus=bus,
+        launcher=_member_launcher(rewards, respawn_reward=3.0),
+        latest_step_fn=lambda d: 5 if os.path.isdir(d) else None,
+    )
+    # the winner's "checkpoint": a real directory the exploit copies
+    win_ck = sch.members["good"].checkpoint_dir
+    os.makedirs(win_ck, exist_ok=True)
+    with open(os.path.join(win_ck, "5.ckpt"), "w") as f:
+        f.write("winner-weights")
+    try:
+        result = sch.run()
+    finally:
+        sch.close()
+        bus.close()
+    # bad was culled, then respawned from good@5 with perturbed hypers
+    assert result["respawned"] == ["bad"]
+    rec = sch.members["bad"]
+    assert rec.respawned is True
+    assert os.path.exists(
+        os.path.join(rec.checkpoint_dir, "5.ckpt")
+    ), "exploit did not copy the winner's checkpoint"
+    # deterministic explore: recompute from the same (member, attempt)
+    # seed — the respawn perturbed at attempt 1, BEFORE the relaunch
+    # bumped the counter to 2
+    assert rec.attempt == 2
+    ov = rec.spec.overrides_dict
+    rng = random.Random(f"bad:{rec.attempt - 1}")
+    factor = 0.8 if rng.random() < 0.5 else 1.2
+    assert int(ov["seed"]) == rng.randrange(2 ** 31)
+    assert float(ov["lam"]) == round(
+        min(max(1.0 - (1.0 - 0.9) * factor, 0.0), 1.0), 6
+    )
+    assert float(ov["cg_damping"]) == round(0.2 * factor, 8)
+    # the explore segment resumes at the winner's step, bounded
+    assert rec.resume_step == 5 and rec.total_override == 7
+    # the first segment's log rotated aside; the respawn ran fresh
+    assert os.path.exists(os.path.join(rec.member_dir,
+                                       "events.gen1.jsonl"))
+    assert rec.state == "finished"
+    # lifecycle events: culled -> respawned (with the exploit recipe),
+    # all schema-valid
+    fleet_evs = [e for e in events if e["kind"] == "fleet"]
+    for e in fleet_evs:
+        assert validate_event(e) == [], e
+    resp = [e for e in fleet_evs if e["state"] == "respawned"]
+    assert len(resp) == 1 and resp[0]["member"] == "bad"
+    assert "pbt exploit good@5" in resp[0]["reason"]
+    assert resp[0]["resume_step"] == 5
+    # the gate skips the respawn segment (a resumed explore budget is
+    # not comparable to a full reference run)
+    assert result["gate"]["members"]["bad"]["verdict"] == "skipped"
+    assert "respawn" in result["gate"]["members"]["bad"]["reason"]
+    # the fleet BENCH row rode the result and the bus
+    bench = result["bench"]
+    assert bench["fleet_wall_ms"] > 0
+    assert bench["members_wall_ms"] >= 0
+    assert bench["max_workers"] == 3
+    walls = [e for e in events if e["kind"] == "phase"
+             and e["name"] == "fleet/wall"]
+    assert walls and walls[-1]["ms"] > 0
+    for e in walls:
+        assert validate_event(e) == [], e
+
+
+def test_member_final_scores_blends_served_feedback(tmp_path):
+    spec = _pbt_spec([MemberSpec("m0")])
+    sch = FleetScheduler(
+        spec, str(tmp_path),
+        launcher=_member_launcher({"m0": 2.0}),
+        feedback={"m0": (10.0, 4)},
+    )
+    try:
+        sch.run()
+        # training: reward 2.0 over 2 iterations x 4 episodes (8 eps);
+        # served: mean 10.0 over 4 episodes — pooled episode-weighted
+        scores = sch.member_final_scores()
+    finally:
+        sch.close()
+    assert scores["m0"] == pytest.approx(
+        (2.0 * 8 + 10.0 * 4) / 12
+    )
+
+
+# ---------------------------------------------------------------------------
+# validator contracts: stranded promotions + the three boundary faults
+# ---------------------------------------------------------------------------
+
+
+def _write(path, recs):
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+@pytest.fixture()
+def validate_file():
+    sys.path.insert(0, "scripts")
+    from validate_events import validate_file as vf
+
+    return vf
+
+
+def _manifest():
+    from trpo_tpu.obs.events import manifest_fields
+
+    return {"v": 1, "kind": "run_manifest", "t": 0.0,
+            **manifest_fields(None)}
+
+
+def _promote(event, step, t, **extra):
+    return {"v": 1, "kind": "promote", "t": t, "member": "m0",
+            "event": event, "step": step, **extra}
+
+
+def test_validator_fails_stranded_promote_candidate(
+    tmp_path, validate_file
+):
+    manifest = _manifest()
+    ok = _write(tmp_path / "ok.jsonl", [
+        manifest,
+        _promote("candidate", 2, 1.0, src_step=5),
+        _promote("canary", 2, 2.0),
+        _promote("promoted", 2, 3.0),
+        _promote("feedback", 2, 4.0, episodes=3, mean_return=1.5),
+    ])
+    assert validate_file(ok) == []
+    # no terminal: stranded
+    errs = validate_file(_write(tmp_path / "bad.jsonl", [
+        manifest, _promote("candidate", 2, 1.0),
+    ]))
+    assert errs and any("stranded promotion" in e for e in errs)
+    # a terminal for a DIFFERENT serving step does not resolve it
+    errs = validate_file(_write(tmp_path / "bad2.jsonl", [
+        manifest, _promote("candidate", 2, 1.0),
+        _promote("rejected", 3, 2.0),
+    ]))
+    assert any("stranded promotion" in e for e in errs)
+    # malformed promote records fail outright
+    assert validate_event(_promote("teleported", 2, 1.0))
+    assert validate_event({k: v for k, v in
+                           _promote("candidate", 2, 1.0).items()
+                           if k != "member"})
+
+
+def _fault(kind, at):
+    return {"v": 1, "kind": "fault_injected", "t": 1.0, "fault": kind,
+            "at": at, "spec": f"{kind}@step={at}"}
+
+
+def test_validator_matches_corrupt_checkpoint(tmp_path, validate_file):
+    manifest = _manifest()
+    health = {
+        "v": 1, "kind": "health", "t": 2.0, "check": "canary_rejected",
+        "level": "warn", "message": "reload failed",
+        "data": {"step": 3, "replica": "r1"},
+    }
+    assert validate_file(_write(tmp_path / "ok.jsonl", [
+        manifest, _fault("corrupt_checkpoint", 3), health,
+    ])) == []
+    # the promotion controller's own terminal also satisfies it
+    assert validate_file(_write(tmp_path / "ok2.jsonl", [
+        manifest, _promote("candidate", 3, 0.5),
+        _fault("corrupt_checkpoint", 3),
+        _promote("rejected", 3, 2.0),
+    ])) == []
+    errs = validate_file(_write(tmp_path / "bad.jsonl", [
+        manifest, _fault("corrupt_checkpoint", 3),
+        {**health, "data": {"step": 4, "replica": "r1"}},
+    ]))
+    assert any("no matching detection" in e for e in errs)
+
+
+def test_validator_regress_requires_realized_return(
+    tmp_path, validate_file
+):
+    manifest = _manifest()
+    rolled = {
+        "v": 1, "kind": "canary", "t": 2.0, "step": 5,
+        "event": "rolled_back", "replica": "r1",
+        "reason": "canary realized return -3.1 under incumbent -0.2 "
+                  "by more than budget 0.5",
+    }
+    assert validate_file(_write(tmp_path / "ok.jsonl", [
+        manifest, _fault("regress_checkpoint", 5),
+        {**rolled, "t": 1.5, "event": "started", "reason": None},
+        rolled,
+    ])) == []
+    # a p99 rejection of the same step does NOT satisfy the matcher —
+    # the regression itself went undetected
+    errs = validate_file(_write(tmp_path / "bad.jsonl", [
+        manifest, _fault("regress_checkpoint", 5),
+        {**rolled, "t": 1.5, "event": "started", "reason": None},
+        {**rolled, "reason": "canary p99 9.0ms over budget 5.0ms"},
+    ]))
+    assert any("no matching detection" in e for e in errs)
+
+
+def test_validator_kill_promoter_needs_convergence(
+    tmp_path, validate_file
+):
+    manifest = _manifest()
+    assert validate_file(_write(tmp_path / "ok.jsonl", [
+        manifest, _promote("candidate", 4, 0.5),
+        _fault("kill_promoter", 4),
+        _promote("promoted", 4, 3.0),
+    ])) == []
+    errs = validate_file(_write(tmp_path / "bad.jsonl", [
+        manifest, _fault("kill_promoter", 4),
+    ]))
+    assert any("no matching detection" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# chaos hooks at the plane boundary (real injector, no serving stack)
+# ---------------------------------------------------------------------------
+
+
+def test_boundary_fault_specs_parse_and_hooks_fire():
+    from collections import namedtuple
+
+    from trpo_tpu.resilience.inject import parse_fault_specs
+
+    specs = parse_fault_specs(
+        "corrupt_checkpoint@step=2;regress_checkpoint@step=3;"
+        "kill_promoter@step=4"
+    )
+    assert [s.kind for s in specs] == [
+        "corrupt_checkpoint", "regress_checkpoint", "kill_promoter",
+    ]
+    assert all(s.serve_level for s in specs)
+    for s in specs:
+        assert parse_fault_specs(str(s))[0] == s
+    inj = FaultInjector(specs)
+    # training hook sites never fire serving faults
+    assert inj.before_iteration(2, None, span=10) is None
+    assert not inj._fired
+    # regress: float policy leaves scale x8 (finite — only the reward
+    # gate can catch it); other steps pass through untouched
+    State = namedtuple("State", ["policy_params", "vf_params"])
+    state = State(
+        policy_params={"w": np.ones(3, np.float32),
+                       "n": np.ones(2, np.int32)},
+        vf_params={"v": np.ones(2, np.float32)},
+    )
+    out = inj.on_checkpoint_publish(3, state)
+    w = np.asarray(out.policy_params["w"])
+    assert np.all(w == 8.0) and np.all(np.isfinite(w))
+    assert np.all(np.asarray(out.policy_params["n"]) == 1)
+    assert np.all(np.asarray(out.vf_params["v"]) == 1.0)  # policy only
+    # one-shot: a second publish at the same step is clean
+    again = inj.on_checkpoint_publish(3, state)
+    assert np.all(np.asarray(again.policy_params["w"]) == 1.0)
+    # kill: raises exactly once at its step
+    inj.on_promotion(99)  # not its step: no-op
+    with pytest.raises(PromoterKilled, match="serving step 4"):
+        inj.on_promotion(4)
+    inj.on_promotion(4)  # fired: converging restart passes through
+
+
+def test_corrupt_checkpoint_tears_published_files(tmp_path):
+    inj = FaultInjector.from_spec("corrupt_checkpoint@step=2")
+    step_dir = tmp_path / "2"
+    (step_dir / "sub").mkdir(parents=True)
+    (step_dir / "weights.bin").write_bytes(b"x" * 100)
+    (step_dir / "sub" / "meta.json").write_bytes(b"y" * 40)
+    inj.on_checkpoint_published(2, str(step_dir))
+    assert (step_dir / "weights.bin").stat().st_size == 50
+    assert (step_dir / "sub" / "meta.json").stat().st_size == 20
+    assert inj.all_fired
+    # an empty step dir cannot execute the fault: loud, and UNFIRED
+    inj2 = FaultInjector.from_spec("corrupt_checkpoint@step=3")
+    empty = tmp_path / "3"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="no payload files"):
+        inj2.on_checkpoint_published(3, str(empty))
+    assert not inj2.all_fired
+
+
+# ---------------------------------------------------------------------------
+# analyze rows
+# ---------------------------------------------------------------------------
+
+
+def test_analyze_promote_and_episode_rows():
+    from trpo_tpu.obs.analyze import (
+        compare_runs,
+        render_summary,
+        summarize_run,
+    )
+
+    def rec_(kind, t, **f):
+        return {"v": 1, "kind": kind, "t": t, **f}
+
+    records = [
+        rec_("run_manifest", 0.0, schema="trpo-tpu-events",
+             jax_version="x", backend="cpu", config_hash="0" * 16,
+             config=None),
+        rec_("session", 1.0, session="a", event="episode", replica="r0",
+             ep_return=1.0, ep_steps=10),
+        rec_("session", 2.0, session="b", event="episode", replica="r1",
+             ep_return=3.0, ep_steps=10),
+        rec_("promote", 3.0, member="m0", event="candidate", step=2,
+             src_step=5),
+        rec_("promote", 4.0, member="m0", event="canary", step=2),
+        rec_("promote", 5.0, member="m0", event="rejected", step=2,
+             reason="canary realized return -2 under incumbent 0"),
+        rec_("promote", 6.0, member="m1", event="candidate", step=3),
+        rec_("promote", 7.0, member="m1", event="promoted", step=3),
+        rec_("promote", 8.0, member="m1", event="feedback", step=3,
+             episodes=2, mean_return=2.0),
+    ]
+    summary = summarize_run(records)
+    rt = summary["router"]
+    assert rt["episodes"]["episodes"] == 2
+    assert rt["episodes"]["mean_return"] == 2.0
+    pr = rt["promote"]
+    assert pr["candidates"] == 2
+    assert pr["promoted"] == 1 and pr["rejected"] == 1
+    assert pr["steps"]["2"]["outcome"] == "rejected"
+    assert pr["steps"]["3"]["member"] == "m1"
+    assert pr["feedback_episodes"] == 2
+    text = render_summary(summary)
+    assert "promote:" in text and "episodes" in text
+    # a rolled_back rise is a strict-counter regression
+    worse = records + [
+        rec_("promote", 9.0, member="m2", event="candidate", step=4),
+        rec_("promote", 10.0, member="m2", event="rolled_back", step=4),
+    ]
+    cmp_bad = compare_runs(summarize_run(records), summarize_run(worse))
+    rows = {v["metric"]: v for v in cmp_bad["verdicts"]}
+    assert rows["router/promote_rolled_back"]["verdict"] == "regressed"
+    assert cmp_bad["regressed"]
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end flywheel smoke (slow: trains a real fleet, serves it)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_flywheel_smoke_driver(tmp_path):
+    """The check.sh acceptance scenario, runnable standalone: a small
+    trained fleet's winner promotes through the reward-aware canary
+    under live session traffic; an injected ``regress_checkpoint`` is
+    rejected by the realized-return gate; ``kill_promoter`` converges
+    on restart; zero client-visible errors; all logs validator-clean
+    (the driver asserts all of it and exits nonzero otherwise)."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts",
+                                      "flywheel_smoke.py"),
+         "--tmp", str(tmp_path), "--quick"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, (
+        f"flywheel smoke failed\n--- stdout ---\n{proc.stdout[-4000:]}"
+        f"\n--- stderr ---\n{proc.stderr[-4000:]}"
+    )

@@ -618,6 +618,27 @@ class TRPOConfig:
     #                                requests observed before the gate
     #                                judges p99 + action parity (small =
     #                                fast promotion, large = confident)
+    serve_reward_window: int = 0   # reward-aware canary gate (ISSUE
+    #                                19): > 0 arms the episode-level
+    #                                realized-return gate — the router
+    #                                strides canary_fraction of session
+    #                                CREATES onto the canary, and its
+    #                                mean return over this many
+    #                                completed episodes must stay
+    #                                within serve_reward_budget of the
+    #                                pooled incumbents'. 0 (default)
+    #                                keeps the PR 11 p99+parity gate
+    #                                only — and keeps recurrent+canary
+    #                                an unjudgeable (exit 2) config
+    serve_reward_min_episodes: int = 0  # incumbent-baseline floor for
+    #                                the reward gate; 0 (default) =
+    #                                serve_reward_window — a 1-episode
+    #                                fluke never convicts or acquits
+    serve_reward_budget: float = 0.0  # allowed ABSOLUTE drop of the
+    #                                canary's mean episode return below
+    #                                the pooled incumbents' (absolute,
+    #                                not relative: returns can be
+    #                                negative)
 
     # --- elastic serving (serve/autoscaler — ISSUE 12) --------------------
     serve_min_replicas: int = 1    # autoscaler floor: scale-in never
@@ -998,6 +1019,21 @@ class TRPOConfig:
             raise ValueError(
                 "serve_canary_window must be >= 1, got "
                 f"{self.serve_canary_window}"
+            )
+        if self.serve_reward_window < 0:
+            raise ValueError(
+                "serve_reward_window must be >= 0, got "
+                f"{self.serve_reward_window}"
+            )
+        if self.serve_reward_min_episodes < 0:
+            raise ValueError(
+                "serve_reward_min_episodes must be >= 0, got "
+                f"{self.serve_reward_min_episodes}"
+            )
+        if self.serve_reward_budget < 0:
+            raise ValueError(
+                "serve_reward_budget must be >= 0, got "
+                f"{self.serve_reward_budget}"
             )
         if self.serve_min_replicas < 1:
             raise ValueError(

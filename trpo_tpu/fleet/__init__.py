@@ -17,7 +17,12 @@ checkpoints) with PR 5's observability (/status, /metrics,
   descriptors, /status scraping, and the fleet-level ``/status`` +
   ``/metrics`` endpoint;
 * :mod:`trpo_tpu.fleet.events` — the typed ``fleet`` lifecycle records
-  on the PR 3 run-event bus.
+  on the PR 3 run-event bus;
+* :mod:`trpo_tpu.fleet.promote` — the train→serve flywheel (ISSUE 19):
+  :func:`pick_winner` through the compare-gate, the crash-safe
+  :class:`PromotionController` driving marker-gated checkpoints through
+  the serving canary, and :func:`feedback_scores` reading served
+  realized returns back into the next round's scoring.
 
 ``scripts/fleet.py`` is the CLI; see ARCHITECTURE.md "Fleet".
 """
@@ -26,6 +31,11 @@ from trpo_tpu.fleet.events import (  # noqa: F401
     FLEET_STATES,
     TERMINAL_STATES,
     emit_fleet,
+)
+from trpo_tpu.fleet.promote import (  # noqa: F401
+    PromotionController,
+    feedback_scores,
+    pick_winner,
 )
 from trpo_tpu.fleet.scheduler import (  # noqa: F401
     FleetScheduler,
@@ -52,6 +62,9 @@ __all__ = [
     "FLEET_STATES",
     "TERMINAL_STATES",
     "emit_fleet",
+    "PromotionController",
+    "feedback_scores",
+    "pick_winner",
     "FleetScheduler",
     "MemberRecord",
     "default_member_argv",

@@ -20,11 +20,21 @@ drives the lifecycle state machine in :mod:`trpo_tpu.fleet.events`:
   ``max_restarts`` budget; past it the member is ``failed`` — the
   member, never the fleet;
 * at the end, the selection hook scores every finished member (the
-  same episode-weighted mean return as ``population.member_scores``)
-  and marks the bottom-k ``culled`` — the seam a PBT exploit/explore
-  step later plugs into — and the fleet gate runs
+  same episode-weighted mean return as ``population.member_scores``,
+  optionally pooled with served realized-return ``feedback`` from the
+  promotion controller — ISSUE 19's return edge) and marks the
+  bottom-k ``culled``, and the fleet gate runs
   ``obs/analyze.compare_runs`` per clean finished member against the
-  reference member under the existing 0/1/2 exit contract.
+  reference member under the existing 0/1/2 exit contract;
+* with ``spec.pbt_rounds`` > 0, the cull hook finally gets its PBT
+  consumer (Jaderberg et al. 2017): each round's culled members
+  respawn from the winner's checkpoint (*exploit* — ``shutil.copytree``
+  of the marker-gated dir, resume from its newest complete step) with
+  perturbed hyperparameters (*explore* — redrawn ``seed``, ``lam`` and
+  ``cg_damping`` scaled ``×(1±pbt_perturb)``), booked as ``fleet``
+  ``respawned`` events, and the fleet drives another round; respawn
+  segments are gate-``skipped`` (their wall-clock metrics measure the
+  explore budget, not a full run).
 
 While members run, the scheduler scrapes each live member's ``/status``
 (discovered via its descriptor, never via console parsing) into one
@@ -92,12 +102,11 @@ def default_member_argv(
     return argv
 
 
-def score_event_records(records: List[dict]) -> float:
-    """A member's final score from its event log: episode-weighted mean
-    return over every iteration record — the same semantics as
-    ``population.Population.member_scores`` (NaN batches contribute
-    nothing; a member that never finished an episode scores ``-inf``),
-    read from JSONL instead of a device stats pytree."""
+def _score_totals(records: List[dict]) -> "tuple":
+    """``(weighted_return_sum, episode_weight_sum)`` over a member's
+    iteration records — the raw totals, so callers can POOL additional
+    weighted evidence (the served realized-return feedback of ISSUE 19)
+    before dividing."""
     import math
 
     total_w = 0.0
@@ -115,6 +124,16 @@ def score_event_records(records: List[dict]) -> float:
         w = float(w) if isinstance(w, (int, float)) and w > 0 else 1.0
         total_r += float(r) * w
         total_w += w
+    return total_r, total_w
+
+
+def score_event_records(records: List[dict]) -> float:
+    """A member's final score from its event log: episode-weighted mean
+    return over every iteration record — the same semantics as
+    ``population.Population.member_scores`` (NaN batches contribute
+    nothing; a member that never finished an episode scores ``-inf``),
+    read from JSONL instead of a device stats pytree."""
+    total_r, total_w = _score_totals(records)
     return total_r / total_w if total_w > 0 else float("-inf")
 
 
@@ -126,6 +145,7 @@ class MemberRecord:
         "not_before", "resume_step", "exit_code", "member_dir",
         "checkpoint_dir", "events_path", "console_path",
         "descriptor_file", "descriptor", "live", "score",
+        "run_s", "seg_t0", "total_override", "respawned",
     )
 
     def __init__(self, spec: MemberSpec, member_dir: str):
@@ -146,6 +166,15 @@ class MemberRecord:
         self.descriptor: Optional[dict] = None
         self.live: Optional[dict] = None
         self.score: Optional[float] = None
+        self.run_s = 0.0          # summed wall time of running segments
+        self.seg_t0: Optional[float] = None
+        # PBT respawn bookkeeping (ISSUE 19): an explicit total for the
+        # respawned segment (resume step + explore budget — the spec's
+        # stated total no longer applies), and the respawned mark that
+        # keeps the compare-gate honest (a respawn SEGMENT's wall-clock
+        # metrics measure the resume, not the member)
+        self.total_override: Optional[int] = None
+        self.respawned = False
 
     @property
     def terminal(self) -> bool:
@@ -162,6 +191,7 @@ class MemberRecord:
             "pid": self.proc.pid if self.proc is not None else None,
             "live": dict(self.live) if self.live else None,
             "score": self.score,
+            "respawned": self.respawned,
             "events_jsonl": self.events_path,
         }
 
@@ -192,6 +222,7 @@ class FleetScheduler:
         latest_step_fn: Optional[Callable[[str], Optional[int]]] = None,
         selection: Optional[Callable[[Dict[str, float]], List[str]]] = None,
         subprocess_env: Optional[Dict[str, str]] = None,
+        feedback: Optional[Dict[str, "tuple"]] = None,
     ):
         self.spec = spec
         self.fleet_dir = os.path.abspath(fleet_dir)
@@ -202,6 +233,11 @@ class FleetScheduler:
         self._latest_step_fn = latest_step_fn or self._checkpoint_latest
         self._selection = selection
         self._env = dict(subprocess_env) if subprocess_env else None
+        # served realized-return feedback (ISSUE 19): {member: (mean,
+        # episodes)} from fleet.promote.feedback_scores — pooled into
+        # member_final_scores episode-weighted, so served reality and
+        # training batches carry exactly their episode counts' worth
+        self._feedback = dict(feedback) if feedback else {}
         # members import trpo_tpu via `python -m trpo_tpu.train`: run
         # them from the repo root regardless of the orchestrator's cwd
         import trpo_tpu
@@ -210,6 +246,7 @@ class FleetScheduler:
             os.path.dirname(os.path.abspath(trpo_tpu.__file__))
         )
         self._started_t = time.time()
+        self._started_m = time.monotonic()
         self._finished = False
         os.makedirs(self.fleet_dir, exist_ok=True)
         self.members: Dict[str, MemberRecord] = {}
@@ -304,7 +341,9 @@ class FleetScheduler:
         # recorded in its first run_manifest — without it a relaunch
         # would run the FULL default budget on top of the restored
         # counter (the documented resume semantics) and overshoot
-        total = member_total_iterations(self.spec, rec.spec)
+        total = rec.total_override
+        if total is None:
+            total = member_total_iterations(self.spec, rec.spec)
         if total is None:
             total = self._total_from_manifest(rec)
         if total is None or rec.resume_step is None:
@@ -340,6 +379,7 @@ class FleetScheduler:
                 cwd=self._cwd,
             )
         rec.state = "running"
+        rec.seg_t0 = time.monotonic()
         emit_fleet(
             self.bus, rec.spec.member_id, "launched", rec.attempt,
             resume_step=rec.resume_step,
@@ -364,6 +404,9 @@ class FleetScheduler:
         # fleet view still shows what the member was doing
         rec.proc = None
         rec.exit_code = code
+        if rec.seg_t0 is not None:
+            rec.run_s += time.monotonic() - rec.seg_t0
+            rec.seg_t0 = None
         mid = rec.spec.member_id
         if code == 0:
             rec.state = "finished"
@@ -450,13 +493,38 @@ class FleetScheduler:
     def _running(self) -> List[MemberRecord]:
         return [r for r in self.members.values() if r.state == "running"]
 
-    def run(self, timeout: Optional[float] = None) -> dict:
+    def run(
+        self,
+        timeout: Optional[float] = None,
+        pbt_rounds: Optional[int] = None,
+    ) -> dict:
         """Drive the fleet to completion; returns the result dict
         (member rows, scores, culled ids, gate verdicts + ``exit_code``
-        under the 0/1/2 contract)."""
+        under the 0/1/2 contract).
+
+        With ``pbt_rounds`` > 0 (default: ``spec.pbt_rounds``), each
+        round's culled members respawn from the winner's checkpoint
+        with perturbed hyperparameters (exploit/explore — Jaderberg et
+        al. 2017) and the fleet drives again; the ``timeout`` budget
+        spans ALL rounds."""
         deadline = (
             time.monotonic() + timeout if timeout is not None else None
         )
+        self._drive_loop(deadline)
+        result = self._finalize()
+        rounds = self.spec.pbt_rounds if pbt_rounds is None else pbt_rounds
+        for _ in range(max(rounds, 0)):
+            if deadline is not None and time.monotonic() > deadline:
+                break
+            if not self._pbt_respawn(result):
+                break
+            self._drive_loop(deadline)
+            result = self._finalize()
+        return result
+
+    def _drive_loop(self, deadline: Optional[float]) -> None:
+        """The scheduling loop proper: launch/reap/scrape until every
+        member is terminal (or the deadline aborts the stragglers)."""
         next_scrape = time.monotonic()
         try:
             while True:
@@ -490,8 +558,6 @@ class FleetScheduler:
         except BaseException:
             self._abort_running("scheduler aborted")
             raise
-        result = self._finalize()
-        return result
 
     def _abort_running(self, reason: str) -> None:
         for rec in self.members.values():
@@ -512,6 +578,9 @@ class FleetScheduler:
                 rec.proc.wait(timeout=5.0)
             rec.exit_code = rec.proc.returncode
             rec.proc = None
+            if rec.seg_t0 is not None:
+                rec.run_s += time.monotonic() - rec.seg_t0
+                rec.seg_t0 = None
         # EVERY non-terminal member fails here — including pending ones
         # that never launched or sat in requeue backoff: an aborted
         # fleet must not report skipped-but-clean for work it never ran
@@ -557,7 +626,23 @@ class FleetScheduler:
         for mid, records in records_map.items():
             if records is None:
                 continue
-            self.members[mid].score = score_event_records(records)
+            total_r, total_w = _score_totals(records)
+            fb = self._feedback.get(mid)
+            if fb:
+                # served reality pools in episode-weighted (ISSUE 19):
+                # a feedback mean over n served episodes carries exactly
+                # n episodes' worth against the training batches
+                mean, n = fb
+                if (
+                    isinstance(mean, (int, float))
+                    and isinstance(n, (int, float))
+                    and n > 0
+                ):
+                    total_r += float(mean) * float(n)
+                    total_w += float(n)
+            self.members[mid].score = (
+                total_r / total_w if total_w > 0 else float("-inf")
+            )
             scores[mid] = self.members[mid].score
         return scores
 
@@ -578,6 +663,114 @@ class FleetScheduler:
                 reason="selection bottom-k",
             )
         return culled
+
+    # -- PBT exploit/explore (ISSUE 19) ------------------------------------
+
+    def _pbt_respawn(self, result: dict) -> List[str]:
+        """Respawn this round's culled members from the winner's
+        checkpoint with perturbed hyperparameters — the PBT
+        exploit/explore step (Jaderberg et al. 2017) the cull hook
+        always pointed at. Returns the respawned member ids (empty =
+        nothing to respawn: no cull, no finite winner, or no winner
+        checkpoint — the PBT loop stops).
+
+        *Exploit*: the culled member's checkpoint dir is replaced by a
+        copy of the winner's (markers and all), and the member resumes
+        from the winner's newest complete step. *Explore*: its
+        ``seed`` is redrawn and its ``lam`` (GAE-λ) / ``cg_damping``
+        overrides are multiplicatively perturbed by ``×(1±
+        spec.pbt_perturb)`` — deterministically per (member, attempt),
+        so a rerun respawns identically. The member's event log is
+        rotated aside (``events.gen<N>.jsonl``) so next round's scoring
+        reflects only the post-respawn segment."""
+        import math
+        import random
+        import shutil
+
+        scores = result.get("scores") or {}
+        culled = [
+            mid for mid in result.get("culled") or []
+            if self.members[mid].state == "culled"
+        ]
+        eligible = {
+            mid: s for mid, s in scores.items()
+            if mid not in culled
+            and self.members[mid].state == "finished"
+            and isinstance(s, (int, float)) and math.isfinite(s)
+        }
+        if not culled or not eligible:
+            return []
+        winner = max(eligible, key=lambda m: (eligible[m], m))
+        win_rec = self.members[winner]
+        win_step = self._latest_step_fn(win_rec.checkpoint_dir)
+        if win_step is None:
+            return []
+        respawned = []
+        for mid in culled:
+            rec = self.members[mid]
+            # exploit: inherit the winner's weights wholesale
+            if os.path.isdir(rec.checkpoint_dir):
+                shutil.rmtree(rec.checkpoint_dir)
+            shutil.copytree(win_rec.checkpoint_dir, rec.checkpoint_dir)
+            # explore: perturb — deterministic per (member, attempt)
+            rng = random.Random(f"{mid}:{rec.attempt}")
+            factor = (
+                1.0 - self.spec.pbt_perturb
+                if rng.random() < 0.5
+                else 1.0 + self.spec.pbt_perturb
+            )
+            ov = rec.spec.overrides_dict
+            ov["seed"] = rng.randrange(2 ** 31)
+            if "lam" in ov:
+                # perturb λ through its distance from 1 (its natural
+                # scale near the ceiling) and keep it a valid GAE(λ)
+                lam = float(ov["lam"])
+                ov["lam"] = round(
+                    min(max(1.0 - (1.0 - lam) * factor, 0.0), 1.0), 6
+                )
+            if "cg_damping" in ov:
+                ov["cg_damping"] = round(
+                    float(ov["cg_damping"]) * factor, 8
+                )
+            rec.spec = MemberSpec(mid, tuple(ov.items()))
+            # next round's score must reflect the post-respawn segment
+            # only: rotate the log aside (the new segment starts with
+            # its own run_manifest, keeping rotated files valid too)
+            try:
+                os.replace(
+                    rec.events_path,
+                    os.path.join(
+                        rec.member_dir,
+                        f"events.gen{rec.attempt}.jsonl",
+                    ),
+                )
+            except OSError:
+                pass
+            rec.state = "pending"
+            rec.not_before = 0.0
+            rec.exit_code = None
+            rec.score = None
+            rec.resume_step = int(win_step)
+            rec.respawned = True
+            total = member_total_iterations(self.spec, rec.spec)
+            explore_budget = self.spec.pbt_iterations
+            if explore_budget is None:
+                explore_budget = max(
+                    (total or 0) - int(win_step), 1
+                )
+            rec.total_override = int(win_step) + int(explore_budget)
+            emit_fleet(
+                self.bus, mid, "respawned", rec.attempt,
+                reason=(
+                    f"pbt exploit {winner}@{win_step} explore "
+                    f"x{factor:g}"
+                ),
+                resume_step=int(win_step),
+            )
+            respawned.append(mid)
+        self._finished = False
+        self._refresh()
+        return respawned
 
     def run_gate(
         self, records_map: Optional[Dict[str, Optional[list]]] = None
@@ -608,6 +801,21 @@ class FleetScheduler:
                 if mid != ref_id:
                     gate["members"][mid] = {
                         "verdict": "skipped", "reason": "no reference",
+                    }
+            return gate
+        if ref_rec.respawned:
+            # a respawned reference's current log is an explore SEGMENT
+            # resumed from someone else's checkpoint — no clean baseline
+            gate["reason"] = (
+                f"reference member {ref_id!r} was PBT-respawned — its "
+                "log is a resume segment, not a clean baseline; gate "
+                "skipped"
+            )
+            for mid in self.members:
+                if mid != ref_id:
+                    gate["members"][mid] = {
+                        "verdict": "skipped",
+                        "reason": "reference not clean",
                     }
             return gate
         if ref_rec.requeues > 0 or ref_rec.failures > 0:
@@ -652,6 +860,14 @@ class FleetScheduler:
                     "measure the preemption, not the member",
                 }
                 continue
+            if rec.respawned:
+                gate["members"][mid] = {
+                    "verdict": "skipped",
+                    "reason": "pbt respawn segment — resumed from the "
+                    "winner's checkpoint mid-run; its metrics measure "
+                    "the explore budget, not a full member run",
+                }
+                continue
             records = records_map.get(mid)
             if records is None:
                 gate["members"][mid] = {
@@ -687,6 +903,33 @@ class FleetScheduler:
         exit_code = gate["exit_code"]
         if failed and exit_code == 0:
             exit_code = 1
+        # fleet-level BENCH row (ISSUE 19 satellite): fleet wall time vs
+        # the sum of member run segments — the parallel-speedup number
+        # the scenario-portfolio item asks for, as a `phase` record so
+        # it rides the same compare_runs machinery as every other
+        # timing row
+        fleet_wall_s = time.monotonic() - self._started_m
+        members_wall_s = sum(
+            rec.run_s for rec in self.members.values()
+        )
+        bench = {
+            "fleet_wall_ms": fleet_wall_s * 1e3,
+            "members_wall_ms": members_wall_s * 1e3,
+            "parallel_speedup": (
+                members_wall_s / fleet_wall_s if fleet_wall_s > 0 else None
+            ),
+            "max_workers": self.spec.max_workers,
+        }
+        if self.bus is not None:
+            try:
+                self.bus.emit(
+                    "phase", name="fleet/wall", ms=fleet_wall_s * 1e3,
+                    members_ms=members_wall_s * 1e3,
+                    max_workers=self.spec.max_workers,
+                    members=len(self.members),
+                )
+            except Exception:
+                pass
         return {
             "members": {
                 mid: rec.row() for mid, rec in self.members.items()
@@ -694,7 +937,11 @@ class FleetScheduler:
             "scores": scores,
             "culled": culled,
             "failed": failed,
+            "respawned": sorted(
+                mid for mid, rec in self.members.items() if rec.respawned
+            ),
             "gate": gate,
+            "bench": bench,
             "exit_code": exit_code,
         }
 

@@ -93,6 +93,15 @@ class FleetSpec:
     cull_bottom_k: int = 0
     poll_interval: float = 0.2
     scrape_interval: float = 2.0
+    # PBT exploit/explore (ISSUE 19): after a round's cull, respawn the
+    # culled members from the winner's checkpoint with perturbed
+    # hyperparameters and drive another round — up to pbt_rounds times.
+    # pbt_iterations is the per-respawn iteration budget (default: the
+    # member's remaining budget past the winner's resume step, min 1);
+    # pbt_perturb is the multiplicative explore factor (×(1±p)).
+    pbt_rounds: int = 0
+    pbt_iterations: Optional[int] = None
+    pbt_perturb: float = 0.2
 
     def __post_init__(self):
         self.members = tuple(
@@ -140,6 +149,18 @@ class FleetSpec:
             )
         if self.poll_interval <= 0 or self.scrape_interval <= 0:
             raise ValueError("poll/scrape intervals must be > 0")
+        if self.pbt_rounds < 0:
+            raise ValueError(
+                f"pbt_rounds must be >= 0, got {self.pbt_rounds}"
+            )
+        if self.pbt_iterations is not None and self.pbt_iterations < 1:
+            raise ValueError(
+                f"pbt_iterations must be >= 1, got {self.pbt_iterations}"
+            )
+        if not 0 < self.pbt_perturb < 1:
+            raise ValueError(
+                f"pbt_perturb must be in (0, 1), got {self.pbt_perturb}"
+            )
         self.base_args = tuple(str(a) for a in self.base_args)
 
     @property

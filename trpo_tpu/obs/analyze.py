@@ -221,6 +221,7 @@ def _summarize_router(records: list) -> Optional[dict]:
     ]
     sessions = [r for r in records if r.get("kind") == "session"]
     canary = [r for r in records if r.get("kind") == "canary"]
+    promote = [r for r in records if r.get("kind") == "promote"]
     autoscale = [r for r in records if r.get("kind") == "autoscale"]
     lease = [r for r in records if r.get("kind") == "lease"]
     host_recs = [
@@ -232,9 +233,10 @@ def _summarize_router(records: list) -> Optional[dict]:
         if r.get("kind") == "fault_injected"
         and r.get("fault") == "partition_host"
     ]
-    if not reqs and not lifecycle and not lease:
+    if not reqs and not lifecycle and not lease and not promote:
         # lease-only logs (a fenced zombie's own event file) still get
-        # a summary — the fencing refusals are the story there
+        # a summary — the fencing refusals are the story there; same
+        # for promote-only logs (a promotion controller's own file)
         return None
     ok_reqs = [r for r in reqs if r.get("ok")]
     lats = [r.get("ms") for r in ok_reqs]
@@ -309,6 +311,8 @@ def _summarize_router(records: list) -> Optional[dict]:
         ) if sessions else None,
         "failover": _failover_rows(sessions),
         "canary": _canary_rows(canary),
+        "episodes": _episode_rows(sessions),
+        "promote": _promote_rows(promote),
         "autoscale": _autoscale_rows(autoscale),
         "hosts": _host_rows(lifecycle, lease, host_recs),
         "lease": _lease_rows(lease, partitions),
@@ -442,6 +446,83 @@ def _canary_rows(canary: list) -> Optional[dict]:
         "started": counts.get("started", 0),
         "promoted": counts.get("promoted", 0),
         "rolled_back": counts.get("rolled_back", 0),
+        "steps": steps,
+    }
+
+
+def _episode_rows(sessions: list) -> Optional[dict]:
+    """Served realized-return summary (ISSUE 19): the router books a
+    ``session``/``episode`` record per completed client episode — the
+    feed the canary's reward gate judges and the promotion controller's
+    feedback pools. None for logs with no episode records."""
+    eps = [r for r in sessions if r.get("event") == "episode"]
+    if not eps:
+        return None
+    returns = [
+        r.get("ep_return") for r in eps
+        if _finite(r.get("ep_return")) is not None
+    ]
+    steps = [
+        r.get("ep_steps") for r in eps
+        if isinstance(r.get("ep_steps"), int)
+        and not isinstance(r.get("ep_steps"), bool)
+    ]
+    by_replica = Counter(
+        str(r.get("replica")) for r in eps if r.get("replica") is not None
+    )
+    return {
+        "episodes": len(eps),
+        "mean_return": (
+            sum(returns) / len(returns) if returns else None
+        ),
+        "steps_total": sum(steps) if steps else None,
+        "by_replica": dict(sorted(by_replica.items())),
+    }
+
+
+def _promote_rows(promote: list) -> Optional[dict]:
+    """Train→serve promotion verdicts (ISSUE 19): per-lifecycle counts,
+    the per-serving-step outcome table, and the pooled served-return
+    feedback. None for logs with no promote records."""
+    if not promote:
+        return None
+    counts = Counter(r.get("event") for r in promote)
+    steps: dict = {}
+    fb_n = 0
+    fb_weighted = 0.0
+    for r in promote:
+        step = r.get("step")
+        if r.get("event") == "feedback":
+            n = r.get("episodes")
+            m = r.get("mean_return")
+            if (
+                isinstance(n, int) and not isinstance(n, bool) and n > 0
+                and _finite(m) is not None
+            ):
+                fb_n += n
+                fb_weighted += float(m) * n
+            continue
+        if step is None:
+            continue
+        row = steps.setdefault(
+            str(step), {"member": None, "outcome": "unresolved",
+                        "reason": None}
+        )
+        if isinstance(r.get("member"), str):
+            row["member"] = r["member"]
+        if r.get("event") in ("promoted", "rejected", "rolled_back"):
+            row["outcome"] = r["event"]
+            if r.get("reason") is not None:
+                row["reason"] = r["reason"]
+    return {
+        "candidates": counts.get("candidate", 0),
+        "promoted": counts.get("promoted", 0),
+        "rejected": counts.get("rejected", 0),
+        "rolled_back": counts.get("rolled_back", 0),
+        "feedback_episodes": fb_n,
+        "feedback_mean_return": (
+            fb_weighted / fb_n if fb_n > 0 else None
+        ),
         "steps": steps,
     }
 
@@ -1174,6 +1255,40 @@ def compare_runs(
                     threshold_pct, "rate",
                 )
             )
+        # promotion verdicts (ISSUE 19): an unresolved/timed-out
+        # promotion (rolled_back) is a strict counter — the canary
+        # rolled_back pattern; promoted throughput and the served
+        # realized return are rate-like (lower is worse)
+        b_pm = b_rt.get("promote") or {}
+        n_pm = n_rt.get("promote") or {}
+        if b_pm or n_pm:
+            b_rb = b_pm.get("rolled_back") or 0
+            n_rb = n_pm.get("rolled_back") or 0
+            verdicts.append({
+                "metric": "router/promote_rolled_back",
+                "base": b_rb,
+                "new": n_rb,
+                "direction": "count",
+                "delta_pct": None,
+                "verdict": "regressed" if n_rb > b_rb else "ok",
+            })
+            verdicts.append(
+                _verdict(
+                    "router/promote_promoted",
+                    b_pm.get("promoted"), n_pm.get("promoted"),
+                    threshold_pct, "rate",
+                )
+            )
+        b_ep = b_rt.get("episodes") or {}
+        n_ep = n_rt.get("episodes") or {}
+        if b_ep or n_ep:
+            verdicts.append(
+                _verdict(
+                    "router/served_episodes",
+                    b_ep.get("episodes"), n_ep.get("episodes"),
+                    threshold_pct, "rate",
+                )
+            )
         # elastic-serving verdicts (ISSUE 12): an aborted drain is a
         # strict counter (the canary_rolled_back pattern — a drain
         # that could not move its sessions losslessly is never noise);
@@ -1557,6 +1672,38 @@ def render_summary(summary: dict) -> str:
                         )
                     ],
                     ["step", "canary", "outcome", "reason"],
+                ))
+        ep = rt.get("episodes") or {}
+        if ep:
+            out.append(
+                f"episodes: served={ep.get('episodes')}"
+                f" mean_return={_fmt(ep.get('mean_return'))}"
+                f" steps={ep.get('steps_total')}"
+            )
+        pm = rt.get("promote") or {}
+        if pm:
+            out.append(
+                f"promote: candidates={pm.get('candidates')}"
+                f" promoted={pm.get('promoted')}"
+                f" rejected={pm.get('rejected')}"
+                f" rolled_back={pm.get('rolled_back')}"
+                + (
+                    f"  feedback={pm.get('feedback_episodes')}eps"
+                    f" mean={_fmt(pm.get('feedback_mean_return'))}"
+                    if pm.get("feedback_episodes") else ""
+                )
+            )
+            steps = pm.get("steps") or {}
+            if steps:
+                out.append(format_table(
+                    [
+                        [step, row.get("member"), row.get("outcome"),
+                         row.get("reason") or ""]
+                        for step, row in sorted(
+                            steps.items(), key=lambda kv: _rung_key(kv[0])
+                        )
+                    ],
+                    ["step", "member", "outcome", "reason"],
                 ))
     tr = summary.get("traces") or {}
     if tr:

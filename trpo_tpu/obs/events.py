@@ -55,8 +55,10 @@ Kinds:
 * ``fleet`` — one member lifecycle transition recorded by the fleet
   orchestrator (``fleet/scheduler.py``): which member, which state
   (``FLEET_STATES``: launched / preempted / requeued / finished /
-  failed / culled), and the launch attempt it happened on. A fleet's
-  event log is self-auditing the same way a chaos run's is —
+  failed / culled / respawned — the last is the PBT exploit/explore
+  transition: a culled member reborn from the winner's checkpoint with
+  perturbed hyperparameters), and the launch attempt it happened on. A
+  fleet's event log is self-auditing the same way a chaos run's is —
   ``scripts/validate_events.py`` checks every ``preempted`` record is
   followed by the member's ``requeued`` or ``failed`` resolution (a
   preemption the scheduler never resolved means the requeue loop is
@@ -99,8 +101,12 @@ Kinds:
   carries ``steps`` replayed and the journal ``lag``),
   ``reestablished`` (the fresh-carry fallback when no journal entry
   existed), ``expired`` (TTL eviction), ``evicted`` (capacity eviction
-  from the bounded store). ``resumed`` vs ``reestablished`` is the
-  failover-quality discriminator ``obs/analyze.py`` reports.
+  from the bounded store), ``episode`` (the router booked one
+  client-reported episode return against the answering replica —
+  carries ``replica``, ``ep_return``, ``ep_steps``; the realized-return
+  feed the reward-aware canary gate and the fleet feedback loop read).
+  ``resumed`` vs ``reestablished`` is the failover-quality
+  discriminator ``obs/analyze.py`` reports.
 * ``canary`` — one gated-deployment transition
   (``serve/replicaset.CanaryController``): which checkpoint ``step``,
   which ``replica`` wore it, and the lifecycle ``event``
@@ -109,6 +115,18 @@ Kinds:
   same way the fleet's is: ``scripts/validate_events.py`` FAILS a
   ``started`` with no later terminal ``promoted``/``rolled_back`` for
   the same step — an unresolved canary means the gate loop is broken.
+* ``promote`` — one train→serve promotion transition
+  (``fleet/promote.PromotionController``): which fleet ``member``
+  supplies the weights, which serving-side ``step`` they publish as,
+  and the lifecycle ``event`` (``PROMOTE_EVENTS``: ``candidate`` —
+  winner picked and publish begun — / ``canary`` — marker-gated
+  checkpoint published, the serving canary gate is driving — /
+  ``promoted`` / ``rejected`` / ``rolled_back`` terminals, plus
+  ``feedback`` — served realized-return stats booked back for the next
+  fleet round's scoring). Self-auditing like the canary's:
+  ``scripts/validate_events.py`` FAILS a ``candidate`` with no later
+  same-step terminal — an unresolved promotion means the controller
+  died and nothing converged it (the crash-safety contract).
 * ``span`` — one finished request-trace span (ISSUE 15:
   ``obs/trace.py`` — the serving plane's per-request attribution
   layer): 128-bit ``trace`` id (minted at the router's public edge or
@@ -170,6 +188,7 @@ __all__ = [
     "ROUTER_HOST_STATES",
     "SESSION_EVENTS",
     "CANARY_EVENTS",
+    "PROMOTE_EVENTS",
     "AUTOSCALE_EVENTS",
     "LEASE_EVENTS",
     "EventBus",
@@ -186,6 +205,7 @@ SCHEMA_VERSION = 1
 # validator needs no fleet import)
 FLEET_STATES = (
     "launched", "preempted", "requeued", "finished", "failed", "culled",
+    "respawned",
 )
 
 # replica lifecycle states the serving replica supervisor may record
@@ -210,7 +230,7 @@ ROUTER_REPLICA_STATES = (
 # the failover-quality metrics
 SESSION_EVENTS = (
     "created", "resumed", "reestablished", "expired", "evicted",
-    "drained",
+    "drained", "episode",
 )
 
 # gated-deployment transitions the canary controller records (the state
@@ -218,6 +238,18 @@ SESSION_EVENTS = (
 # lives HERE so the validator needs no serve import — the FLEET_STATES
 # pattern). `started` must resolve to `promoted` or `rolled_back`.
 CANARY_EVENTS = ("started", "promoted", "rolled_back")
+
+# train→serve promotion transitions the flywheel controller records
+# (the state machine lives in fleet/promote.PromotionController; the
+# vocabulary lives HERE so the validator needs no fleet import — the
+# FLEET_STATES pattern). `candidate` must resolve to a same-step
+# `promoted` / `rejected` / `rolled_back` terminal — possibly by a
+# RESTARTED controller converging a predecessor's half-done promotion;
+# `feedback` books served realized-return stats for fleet re-scoring.
+PROMOTE_EVENTS = (
+    "candidate", "canary", "promoted", "rejected", "rolled_back",
+    "feedback",
+)
 
 # elastic-serving control actions (ISSUE 12: serve/autoscaler.py and
 # the router's overload sheds; vocabulary HERE so the validator needs
@@ -356,6 +388,16 @@ _REQUIRED = {
         "step": lambda v: isinstance(v, int) and not isinstance(v, bool),
         "event": lambda v: v in CANARY_EVENTS,
         "replica": lambda v: isinstance(v, str) and v,
+    },
+    "promote": {
+        # one train→serve promotion transition
+        # (fleet/promote.PromotionController): source fleet member,
+        # the serving-side step the weights publish as, lifecycle
+        # event; `src_step`/`reason`/`score`/`episodes`/`mean_return`
+        # ride along as optional fields
+        "member": lambda v: isinstance(v, str) and v,
+        "event": lambda v: v in PROMOTE_EVENTS,
+        "step": lambda v: isinstance(v, int) and not isinstance(v, bool),
     },
     "span": {
         # one finished request-trace span (ISSUE 15, obs/trace.py);

@@ -80,6 +80,27 @@ process stays healthy:
   record naming the descriptor), never leave a phantom ``starting``
   record holding the autoscaler's warming gate.
 
+The BOUNDARY grammar (ISSUE 19) drives the train→serve promotion seam
+(``fleet/promote.py``) — faults that land exactly where a trained
+checkpoint crosses into serving:
+
+* ``corrupt_checkpoint@step=N`` — tear the published serving-side step
+  ``N`` on disk AFTER its completion marker lands (every payload file
+  truncated to half — a partial flush the marker protocol cannot see).
+  The canary's reload must FAIL, the gate must reject the step, and no
+  client request may ever be answered from the torn weights.
+* ``regress_checkpoint@step=N`` — perturb the weights as step ``N``
+  publishes so they stay FINITE and load cleanly but behave worse at
+  the task (policy leaves scaled into saturation). p99 and parity both
+  pass — only the realized-return gate can catch this one, which is
+  why it exists.
+* ``kill_promoter@step=N`` — raise :class:`PromoterKilled` out of the
+  promotion controller immediately after step ``N`` publishes and
+  before the gate drives: the controller dies mid-promotion, and a
+  RESTARTED controller must re-read the markers/journal and converge
+  the orphaned step to a terminal verdict (never double-promote,
+  never strand the canary).
+
 Specs are ``;``-separated; each fires EXACTLY ONCE (a recovery that
 re-runs the target iteration re-runs it clean — which is what lets the
 chaos suite pin bit-exact continuation against an unfaulted run). Every
@@ -97,7 +118,15 @@ import threading
 import time
 from typing import Optional, Tuple
 
-__all__ = ["FaultSpec", "FaultInjector", "parse_fault_specs"]
+__all__ = [
+    "FaultSpec", "FaultInjector", "parse_fault_specs", "PromoterKilled",
+]
+
+
+class PromoterKilled(RuntimeError):
+    """Raised by a ``kill_promoter`` spec mid-promotion — the simulated
+    controller crash. The promotion journal/markers already persisted;
+    a restarted controller converges from them."""
 
 # fault kind -> (trigger key, level); level discriminates which hook
 # site fires it: "env" = on_env_step (host env steps), "update" =
@@ -119,7 +148,17 @@ _KINDS = {
     "partition_host": ("request", "serve"),
     "slow_network": ("request", "serve"),
     "lost_descriptor": ("request", "serve"),
+    "corrupt_checkpoint": ("step", "serve"),
+    "regress_checkpoint": ("step", "serve"),
+    "kill_promoter": ("step", "serve"),
 }
+
+# serve-level faults clocked by a checkpoint STEP rather than the
+# router's request counter — on_serve_request must never consume these
+_STEP_SERVE_KINDS = (
+    "wedge_reload", "corrupt_checkpoint", "regress_checkpoint",
+    "kill_promoter",
+)
 
 # faults that target a HOST (the multi-host transport) rather than a
 # replica — host= is required for these
@@ -244,7 +283,7 @@ def parse_fault_specs(spec: str) -> Tuple[FaultSpec, ...]:
         if trigger_key not in fields:
             trigger_name = {
                 "step": (
-                    "checkpoint step" if kind == "wedge_reload"
+                    "checkpoint step" if kind in _STEP_SERVE_KINDS
                     else "host env step"
                 ),
                 "iter": "iteration",
@@ -427,7 +466,7 @@ class FaultInjector:
                 if (
                     i in self._fired
                     or not s.serve_level
-                    or s.kind == "wedge_reload"
+                    or s.kind in _STEP_SERVE_KINDS
                     or s.at != request_idx
                 ):
                     continue
@@ -737,3 +776,88 @@ class FaultInjector:
         params = jax.tree_util.tree_map(poison, params)
         self._emit(due, step=step)
         return params
+
+    # -- train→serve boundary (ISSUE 19) -----------------------------------
+
+    def _take_step_fault(self, kind: str, step: int):
+        """Atomically claim the one unfired ``kind`` spec due at
+        checkpoint ``step`` (the on_checkpoint_load discipline)."""
+        with self._lock:
+            for i, s in enumerate(self.specs):
+                if i in self._fired or s.kind != kind or s.at != step:
+                    continue
+                self._fired.add(i)
+                return i, s
+        return None, None
+
+    def on_checkpoint_publish(self, step: int, state):
+        """Fire ``regress_checkpoint`` specs due at serving step
+        ``step``: returns the TrainState with every floating-point
+        policy leaf scaled deep into tanh saturation — finite, loads
+        cleanly, passes p99 and finite-parity, behaves degenerately at
+        the task. Called by the promotion controller with the restored
+        winner state just before it saves into the serving directory."""
+        _, due = self._take_step_fault("regress_checkpoint", step)
+        if due is None:
+            return state
+        import jax
+        import jax.numpy as jnp
+
+        def saturate(x):
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                return jnp.asarray(x) * 8.0
+            return x
+
+        state = state._replace(
+            policy_params=jax.tree_util.tree_map(
+                saturate, state.policy_params
+            )
+        )
+        self._emit(due, step=step)
+        return state
+
+    def on_checkpoint_published(self, step: int, step_dir: str) -> None:
+        """Fire ``corrupt_checkpoint`` specs due at serving step
+        ``step``: truncate every payload file under the just-published
+        ``step_dir`` to half its size, AFTER the completion marker
+        landed — the torn-flush shape the marker protocol cannot see.
+        The canary's restore must fail loudly and the gate must
+        reject."""
+        i, due = self._take_step_fault("corrupt_checkpoint", step)
+        if due is None:
+            return
+        torn = 0
+        for root, _dirs, files in os.walk(step_dir):
+            for name in files:
+                path = os.path.join(root, name)
+                try:
+                    size = os.path.getsize(path)
+                    if size > 0:
+                        os.truncate(path, size // 2)
+                        torn += 1
+                except OSError:
+                    continue
+        if torn == 0:
+            # nothing to tear = the fault could not execute; end the
+            # run UNFIRED so the loud-completion warning names it
+            with self._lock:
+                self._fired.discard(i)
+            raise ValueError(
+                f"fault {due}: published step dir {step_dir!r} has no "
+                "payload files to corrupt"
+            )
+        self._emit(due, step=step, files=torn)
+
+    def on_promotion(self, step: int) -> None:
+        """Fire ``kill_promoter`` specs due at serving step ``step``:
+        raises :class:`PromoterKilled` — the controller "dies" after
+        publishing and before the gate drives. The journal/markers are
+        already durable; a restarted controller must converge."""
+        _, due = self._take_step_fault("kill_promoter", step)
+        if due is None:
+            return
+        self._emit(due, step=step)
+        raise PromoterKilled(
+            f"kill_promoter: promotion controller killed at serving "
+            f"step {step} (mid-promotion, after publish)"
+        )

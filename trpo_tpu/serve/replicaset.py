@@ -1042,11 +1042,25 @@ class CanaryController:
        ``window_requests`` routed requests, then judge:
        (a) *windowed p99*: the canary's p99 over the gate window must
        be within ``p99_budget_pct`` of the pooled incumbents' p99 over
-       the SAME window; (b) *action parity*: recent REAL request
+       the SAME window; (b) *realized return* (ISSUE 19, armed by
+       ``reward_window_episodes`` > 0): the router strides
+       ``canary_fraction`` of session CREATES onto the canary, clients
+       report per-act ``reward``/``done`` and the router books each
+       completed episode's return against its replica — the canary's
+       mean return over ``reward_window_episodes`` episodes must stay
+       within ``reward_budget`` of the pooled incumbents' (both sides
+       under a ``reward_min_episodes`` floor, so a 1-episode fluke
+       never convicts or acquits). The failure class p99 and parity
+       CANNOT see — a checkpoint that is fast, finite, and worse at
+       the task — dies here; (c) *action parity*: recent REAL request
        bodies are mirrored to the canary and an incumbent — every
        canary action must be finite, and (when ``parity_tol`` is set)
        within it of the incumbent's mean absolute difference. A wedged
-       checkpoint — loads fine, answers garbage — dies here.
+       checkpoint — loads fine, answers garbage — dies here. In a
+       session-only plane (recurrent policies) there are no stateless
+       bodies to mirror: when the reward gate is armed and has judged,
+       parity stands down instead of starving the gate forever — which
+       is exactly what lifts the PR 11 recurrent exit-2 restriction.
     3. **promoted** — a clean gate reloads the step onto every other
        replica (serially; each one's ``reloading`` window takes it out
        of rotation, so no request is ever dropped), updates the
@@ -1081,6 +1095,9 @@ class CanaryController:
         poll_interval: float = 1.0,
         reload_timeout_s: float = 120.0,
         bus=None,
+        reward_window_episodes: int = 0,
+        reward_min_episodes: Optional[int] = None,
+        reward_budget: float = 0.0,
     ):
         if window_requests < 1:
             raise ValueError(
@@ -1089,6 +1106,20 @@ class CanaryController:
         if p99_budget_pct < 0:
             raise ValueError(
                 f"p99_budget_pct must be >= 0, got {p99_budget_pct}"
+            )
+        if reward_window_episodes < 0:
+            raise ValueError(
+                f"reward_window_episodes must be >= 0, got "
+                f"{reward_window_episodes}"
+            )
+        if reward_min_episodes is not None and reward_min_episodes < 1:
+            raise ValueError(
+                f"reward_min_episodes must be >= 1, got "
+                f"{reward_min_episodes}"
+            )
+        if reward_budget < 0:
+            raise ValueError(
+                f"reward_budget must be >= 0, got {reward_budget}"
             )
         self.replicaset = replicaset
         self.router = router
@@ -1106,6 +1137,16 @@ class CanaryController:
         self.gate_timeout_s = float(gate_timeout_s)
         self.poll_interval = float(poll_interval)
         self.reload_timeout_s = float(reload_timeout_s)
+        # the realized-return gate (ISSUE 19): 0 episodes = disarmed
+        # (the PR 11 p99 + parity gate, byte-identical); the floor
+        # defaults to the window so both sides judge over full windows
+        self.reward_window_episodes = int(reward_window_episodes)
+        self.reward_min_episodes = (
+            int(reward_min_episodes)
+            if reward_min_episodes is not None
+            else max(1, self.reward_window_episodes)
+        )
+        self.reward_budget = float(reward_budget)
         self.bus = bus
         self.promoted_total = 0
         self.rolled_back_total = 0
@@ -1292,6 +1333,8 @@ class CanaryController:
         "canary died mid-gate",
         "gate window starved",
         "no usable parity sample",
+        "reward window starved",
+        "no usable reward baseline",
     )
 
     def _deploy_and_judge(self, rec: ReplicaRecord, step: int):
@@ -1304,8 +1347,11 @@ class CanaryController:
                 f"canary reload to step {step} failed "
                 f"(status={status}, {out})"
             )
-        # 2. observe a fresh window of routed traffic
+        # 2. observe a fresh window of routed traffic (and, when the
+        # reward gate is armed, a fresh window of completed episodes)
         self.router.reset_replica_latencies()
+        if self.reward_window_episodes > 0:
+            self.router.reset_replica_episodes()
         deadline = time.monotonic() + self.gate_timeout_s
         while True:
             if self._canary_lost(rec, restarts0):
@@ -1341,8 +1387,67 @@ class CanaryController:
                     f"{budget:.1f}ms (incumbent p99 {i99:.1f}ms + "
                     f"{self.p99_budget_pct:g}%)"
                 )
-        # 3b. action parity on mirrored REAL traffic
+        # 3b. realized return vs the pooled incumbents (armed gate only)
+        if self.reward_window_episodes > 0:
+            ok, reason = self._judge_reward(rec, others, restarts0)
+            if not ok:
+                return False, reason
+            if not self.router.recent_act_bodies(1):
+                # session-only plane (recurrent policies): there are no
+                # stateless bodies to mirror, and mirroring a mid-episode
+                # body at a blank canary carry would judge noise. The
+                # realized-return gate already judged BEHAVIOR over whole
+                # episodes — parity stands down instead of starving.
+                return True, None
+        # 3c. action parity on mirrored REAL traffic
         return self._judge_parity(rec, others)
+
+    def _judge_reward(self, rec: ReplicaRecord, others, restarts0) -> tuple:
+        """Judge the canary's windowed realized return against the
+        pooled incumbents'. Episode returns are booked by the router
+        from client-reported per-act ``reward`` / ``done`` fields; the
+        session router strides ``canary_fraction`` of session CREATES
+        onto the canary, so both sides accumulate episodes from live
+        traffic. A thin canary window is a starved (transient) gate; a
+        thin INCUMBENT baseline is equally unusable — ``min_episodes``
+        floors both sides so one lucky episode never decides. The only
+        judged failure is the one no other gate can see: the canary's
+        mean return falling more than ``reward_budget`` below the
+        incumbents'."""
+        deadline = time.monotonic() + self.gate_timeout_s
+        while True:
+            if self._canary_lost(rec, restarts0):
+                return False, "canary died mid-gate"
+            canary_eps = self.router.replica_episode_returns(rec.id)
+            if len(canary_eps) >= self.reward_window_episodes:
+                break
+            if time.monotonic() >= deadline:
+                return False, (
+                    f"reward window starved: {len(canary_eps)}/"
+                    f"{self.reward_window_episodes} canary episodes "
+                    f"within {self.gate_timeout_s:g}s"
+                )
+            time.sleep(0.02)
+        incumbent_eps: list = []
+        for rid in others:
+            incumbent_eps.extend(self.router.replica_episode_returns(rid))
+        floor = max(1, self.reward_min_episodes)
+        if len(incumbent_eps) < floor:
+            return False, (
+                f"no usable reward baseline: {len(incumbent_eps)}/"
+                f"{floor} incumbent episodes"
+            )
+        c_mean = sum(canary_eps) / len(canary_eps)
+        i_mean = sum(incumbent_eps) / len(incumbent_eps)
+        if c_mean < i_mean - self.reward_budget:
+            return False, (
+                f"canary realized return {c_mean:.4f} under incumbent "
+                f"{i_mean:.4f} by more than budget "
+                f"{self.reward_budget:g} "
+                f"({len(canary_eps)} canary vs {len(incumbent_eps)} "
+                "incumbent episodes)"
+            )
+        return True, None
 
     def _judge_parity(self, rec: ReplicaRecord, others) -> tuple:
         """Mirror recent REAL request bodies to the canary (and an

@@ -123,6 +123,7 @@ from __future__ import annotations
 import asyncio
 import http.client
 import json
+import math
 import socket
 import threading
 import time
@@ -149,7 +150,7 @@ def _body(obj) -> bytes:
 class _Affinity:
     __slots__ = (
         "replica", "host", "last_used", "seq", "acts", "lock",
-        "pending_resumed_steps",
+        "pending_resumed_steps", "ep_return", "ep_steps",
     )
 
     def __init__(self, replica: str, now: float, host: str = "local"):
@@ -173,6 +174,12 @@ class _Affinity:
         # carries `resumed: true` + the replayed step count, so the
         # client learns its session moved losslessly
         self.pending_resumed_steps = None
+        # client-reported realized return (ISSUE 19): per-act `reward`
+        # accumulates here; `done: true` closes the episode and books
+        # the total against the answering replica — the reward-aware
+        # canary gate's feed
+        self.ep_return = 0.0
+        self.ep_steps = 0
 
 
 class Router:
@@ -322,10 +329,19 @@ class Router:
         # per-replica rolling windows: the canary gate compares the
         # canary's p99 against the incumbents' over the same period
         self._replica_lats: Dict[str, deque] = {}
+        # per-replica completed-episode returns (ISSUE 19): the
+        # reward-aware canary gate judges the canary's windowed
+        # realized return against the pooled incumbents' from here
+        self._replica_eps: Dict[str, deque] = {}
+        self.episodes_total = 0
         # recent stateless request bodies, mirrored by the canary
         # gate's action-parity sample (real traffic, not synthetic obs)
         self._recent_obs: deque = deque(maxlen=64)
         self._canary_clock = 0.0  # deterministic fraction accumulator
+        # the SESSION-level stride (ISSUE 19): a separate accumulator
+        # deciding which /session CREATES pin to the canary — whole
+        # episodes ride it, which is what the reward gate judges
+        self._canary_session_clock = 0.0
         self._chaos_requests = 0
         self._tls = threading.local()  # per-thread replica conn pool
         #                                (core="thread" + executor paths)
@@ -393,7 +409,8 @@ class Router:
 
     # -- dispatch core -----------------------------------------------------
 
-    def _pick(self, exclude=(), stateless: bool = True) -> Optional[str]:
+    def _pick(self, exclude=(), stateless: bool = True,
+              want_canary: Optional[bool] = None) -> Optional[str]:
         """Least-inflight healthy replica id under ``max_inflight``, or
         None (saturated / empty rotation). Bumps the winner's inflight
         under the set's lock — the reservation IS the queue-depth
@@ -401,8 +418,13 @@ class Router:
 
         Canary-aware: while a replica is marked canary, STATELESS
         requests route to it on a deterministic ``canary_fraction``
-        stride and everything else routes around it (sessions never
-        pin to an unvalidated checkpoint). If the canary is the only
+        stride and everything else routes around it. Session traffic is
+        canary-striden at CREATE time instead (ISSUE 19): the session
+        path passes an explicit ``want_canary`` verdict from
+        ``_canary_session_take`` — True pins the new session (and the
+        whole episode it carries) onto the canary, False/None keeps it
+        on the incumbents, so the reward gate judges whole realized
+        episodes rather than stray acts. If the canary is the only
         viable candidate it still serves — degraded beats dropped.
 
         Shed order (ISSUE 12): under sustained saturation (a 503/shed
@@ -445,10 +467,12 @@ class Router:
                 r for r in candidates if not getattr(r, "canary", False)
             ]
             if canary and incumbents:
-                if stateless and self._canary_take():
-                    candidates = canary
-                else:
-                    candidates = incumbents
+                take = (
+                    want_canary
+                    if want_canary is not None
+                    else stateless and self._canary_take()
+                )
+                candidates = canary if take else incumbents
             best = min(candidates, key=lambda r: (r.inflight, r.id))
             best.inflight += 1
             return best.id
@@ -466,6 +490,27 @@ class Router:
         if self._canary_clock >= 1.0:
             self._canary_clock -= 1.0
             return True
+        return False
+
+    def _canary_session_take(self) -> bool:
+        """The session-level twin of :meth:`_canary_take` (ISSUE 19):
+        strides ``canary_fraction`` of session CREATES onto the canary
+        — whole episodes, the unit the reward gate judges. A separate
+        accumulator so interleaved stateless traffic never skews which
+        sessions land on the canary. Needs a live canary in rotation:
+        a session pinned to a replica that stops being the canary
+        mid-episode is fine (the pin outlives the gate), but a stride
+        burned with NO canary present would starve the reward window."""
+        if self.canary_fraction <= 0.0:
+            return False
+        rotation = self.replicaset.in_rotation()
+        if not any(getattr(r, "canary", False) for r in rotation):
+            return False
+        with self._lock:
+            self._canary_session_clock += self.canary_fraction
+            if self._canary_session_clock >= 1.0:
+                self._canary_session_clock -= 1.0
+                return True
         return False
 
     def _release(self, replica_id: str) -> None:
@@ -742,7 +787,8 @@ class Router:
                          pinned: Optional[str] = None,
                          stateless: bool = True,
                          ctx=None, parent=None,
-                         fwd_headers: Optional[dict] = None):
+                         fwd_headers: Optional[dict] = None,
+                         want_canary: Optional[bool] = None):
         """:meth:`_dispatch`, line for line, on the loop — every
         decision (pin handling, pick, retry budget, 5xx hold,
         accounting, emit) is the same sync code; only the forward
@@ -774,7 +820,8 @@ class Router:
                 if not pinned_ok:
                     return None, None, retried
             else:
-                rid = self._pick(exclude=tried, stateless=stateless)
+                rid = self._pick(exclude=tried, stateless=stateless,
+                                 want_canary=want_canary)
                 if rid is None:
                     break
                 if lost_rid is not None or first_5xx is not None:
@@ -983,6 +1030,7 @@ class Router:
             if result[0] == 200:
                 with self._lock:
                     aff.acts += 1
+                self._book_feedback(sid, aff, rid, body, fwd)
             self._capture_note(
                 ctx, path=f"/session/{sid}/act", endpoint="session_act",
                 session=sid, body=body,
@@ -1118,7 +1166,8 @@ class Router:
     def _dispatch(self, path: str, body: bytes, endpoint: str,
                   pinned: Optional[str] = None, stateless: bool = True,
                   ctx=None, parent=None,
-                  fwd_headers: Optional[dict] = None):
+                  fwd_headers: Optional[dict] = None,
+                  want_canary: Optional[bool] = None):
         """The routed request core: pick (or follow the pin), forward,
         retry ONCE on transport failure, account, emit. Returns the
         upstream ``(status, ctype, body)`` plus the replica that finally
@@ -1160,7 +1209,8 @@ class Router:
                     # (session path) re-establishes; plain /act never pins
                     return None, None, retried
             else:
-                rid = self._pick(exclude=tried, stateless=stateless)
+                rid = self._pick(exclude=tried, stateless=stateless,
+                                 want_canary=want_canary)
                 if rid is None:
                     break
                 if lost_rid is not None or first_5xx is not None:
@@ -1482,6 +1532,18 @@ class Router:
         with self._lat_lock:
             self._replica_lats.clear()
 
+    def replica_episode_returns(self, replica_id: str) -> list:
+        """Completed-episode returns booked against one replica since
+        the last reset — the reward gate's realized-return window."""
+        with self._lat_lock:
+            win = self._replica_eps.get(replica_id)
+            return list(win) if win is not None else []
+
+    def reset_replica_episodes(self) -> None:
+        """Start a fresh realized-return window (gate start)."""
+        with self._lat_lock:
+            self._replica_eps.clear()
+
     def _unrouted(self, rid, retried: bool, endpoint: str,
                   stateless: bool = False, ctx=None):
         """No replica answered: 502 when we reached-and-lost replicas
@@ -1588,10 +1650,15 @@ class Router:
                     {"error": "session table full — retry later"}
                 )
         sid = mint_session_id()
+        # session-aware canary striding (ISSUE 19): the create-time
+        # verdict pins this session — and every act/episode it carries
+        # — onto the canary (or keeps it off). A stride decided here
+        # means the reward gate judges whole realized episodes.
         result, rid, _retried = self._dispatch(
             body=_body({"session_id": sid}), path="/session",
             endpoint="session", stateless=False,
             ctx=ctx, parent=root,
+            want_canary=self._canary_session_take() or None,
         )
         if result is None:
             return self._unrouted(rid, False, "session", ctx=ctx)
@@ -1993,6 +2060,55 @@ class Router:
         except ValueError:
             return body
 
+    def _book_feedback(self, sid: str, aff, rid, body: bytes,
+                       fwd_headers=None) -> None:
+        """Realized-return feedback (ISSUE 19): clients may ride a
+        per-act ``reward`` (float) and ``done`` (bool, episode end) in
+        their JSON session-act bodies — the replica ignores the extra
+        fields. Rewards accumulate on the affinity; ``done: true``
+        books the completed episode's return against the replica that
+        answered it (per-replica windows the reward-aware canary gate
+        judges) and emits a ``session``/``episode`` event for the
+        fleet feedback loop. JSON bodies only — the binary wire frame
+        has no reward field (documented in serve/wire.py's framing
+        contract); binary clients simply don't feed the reward gate."""
+        if rid is None or _wire.is_binary_body(fwd_headers):
+            return
+        try:
+            payload = json.loads(body)
+            if not isinstance(payload, dict):
+                return
+        except ValueError:
+            return
+        reward = payload.get("reward")
+        done = payload.get("done")
+        if reward is None and not done:
+            return
+        with self._lock:
+            if isinstance(reward, (int, float)) and not isinstance(
+                reward, bool
+            ) and math.isfinite(reward):
+                aff.ep_return += float(reward)
+                aff.ep_steps += 1
+            if done is not True:
+                return
+            ep_return, ep_steps = aff.ep_return, aff.ep_steps
+            aff.ep_return, aff.ep_steps = 0.0, 0
+        if ep_steps == 0:
+            return  # a bare done with no rewarded step books nothing
+        with self._lat_lock:
+            win = self._replica_eps.get(rid)
+            if win is None:
+                win = self._replica_eps[rid] = deque(maxlen=512)
+            win.append(ep_return)
+        with self._lock:
+            self.episodes_total += 1
+        if self.bus is not None:
+            self.bus.emit(
+                "session", session=sid, event="episode", replica=rid,
+                ep_return=ep_return, ep_steps=ep_steps,
+            )
+
     def _session_act_pinned(self, sid: str, aff, body: bytes,
                             ctx=None, root=None, fwd_headers=None):
         body = self._stamp_seq(aff, body, fwd_headers)
@@ -2113,6 +2229,7 @@ class Router:
         if status == 200:
             with self._lock:
                 aff.acts += 1
+            self._book_feedback(sid, aff, rid, body, fwd_headers)
         # capture the STAMPED body (seq travels) and the replica's raw
         # answer — the failover decoration below touches neither the
         # obs nor the action bytes
@@ -2188,6 +2305,7 @@ class Router:
                     self.sessions_reestablished_total,
                 "sessions_resumed_total": self.sessions_resumed_total,
                 "sessions_drained_total": self.sessions_drained_total,
+                "episodes_total": self.episodes_total,
             }
         q, samples = self.latency_window((0.5, 0.99))
         with self._lock:
@@ -2357,6 +2475,10 @@ class Router:
                  "sessions moved losslessly off a draining replica "
                  "(elastic scale-in)",
                  self.sessions_drained_total),
+                ("trpo_router_episodes_total",
+                 "client-reported episodes booked against replicas "
+                 "(the realized-return feed the reward gate judges)",
+                 self.episodes_total),
             ]
             sessions_live = len(self._affinity)
         for name, help_, value in counter_rows:
