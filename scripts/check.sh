@@ -78,31 +78,41 @@ BENCH_FORCE_CPU=1 BENCH_BATCH=256 BENCH_WIDTHS= BENCH_HOST_PIPELINE=0 \
 python scripts/validate_events.py "$OBS_TMP/train_events.jsonl" \
     "$OBS_TMP/bench_events.jsonl"
 
-echo "== regression gate: clean re-run compares OK, injected slowdown fails =="
-# the repo's first automated perf gate (ISSUE 5): two identical tiny
-# gymproc runs must compare clean at the gate threshold, and a third run
+echo "== regression gate: clean run vs checked-in baseline at 30% =="
+# the repo's first automated perf gate (ISSUE 5), tightened by ISSUE
+# 20: one tiny gymproc run must compare clean against the CHECKED-IN
+# baseline scripts/gate_baseline_cpu.jsonl at 30%, and a second run
 # with a delay_step chaos fault (PR 4's injector) stretching one host
-# step by 3 s must make analyze_run.py --compare exit nonzero. Threshold
-# 200% swallows CPU scheduler noise between the clean legs while the
-# injected delay (+3 s over ~57 ms steady iterations) overshoots it
-# >6x on steady_iteration_ms and timesteps/s.
+# step by 3 s must make analyze_run.py --compare exit nonzero. The
+# old gate trained a twin "base" run per invocation and compared at
+# 200% — wide enough to hide a 2x regression; against a pinned
+# baseline, measured same-machine noise is 5-11% on the >=5 ms rows
+# (reward is seed-deterministic, bit-exact), so 30% is honest
+# headroom AND catches what 200% waved through. If this leg fails
+# with every timing row uniformly slower, the machine is loaded —
+# re-run; if it fails after an intentional recipe/perf change,
+# REFRESH the baseline on a quiet machine and commit it:
+#   JAX_PLATFORMS=cpu python -m trpo_tpu.train \
+#       --env "gymproc:CartPole-v1" --iterations 5 \
+#       --batch-timesteps 32 --n-envs 2 --platform cpu \
+#       --metrics-jsonl scripts/gate_baseline_cpu.jsonl
 GATE_TMP=$(mktemp -d)
-for leg in base clean; do
-    JAX_PLATFORMS=cpu python -m trpo_tpu.train --env "gymproc:CartPole-v1" \
-        --iterations 5 --batch-timesteps 32 --n-envs 2 --platform cpu \
-        --metrics-jsonl "$GATE_TMP/$leg.jsonl" > /dev/null
-done
-python scripts/validate_events.py "$GATE_TMP/base.jsonl" \
+JAX_PLATFORMS=cpu python -m trpo_tpu.train --env "gymproc:CartPole-v1" \
+    --iterations 5 --batch-timesteps 32 --n-envs 2 --platform cpu \
+    --metrics-jsonl "$GATE_TMP/clean.jsonl" > /dev/null
+python scripts/validate_events.py scripts/gate_baseline_cpu.jsonl \
     "$GATE_TMP/clean.jsonl"
 python scripts/analyze_run.py "$GATE_TMP/clean.jsonl" \
-    --compare "$GATE_TMP/base.jsonl" --threshold-pct 200 --min-ms 5
+    --compare scripts/gate_baseline_cpu.jsonl --threshold-pct 30 \
+    --min-ms 5
 JAX_PLATFORMS=cpu python -m trpo_tpu.train --env "gymproc:CartPole-v1" \
     --iterations 5 --batch-timesteps 32 --n-envs 2 --platform cpu \
     --inject-faults "delay_step@step=20:seconds=3" \
     --metrics-jsonl "$GATE_TMP/slow.jsonl" > /dev/null
 set +e
 python scripts/analyze_run.py "$GATE_TMP/slow.jsonl" \
-    --compare "$GATE_TMP/base.jsonl" --threshold-pct 200 --min-ms 5
+    --compare scripts/gate_baseline_cpu.jsonl --threshold-pct 30 \
+    --min-ms 5
 GATE_CODE=$?
 set -e
 if [[ "$GATE_CODE" != 1 ]]; then
@@ -237,6 +247,41 @@ JAX_PLATFORMS=cpu python scripts/router_smoke.py --tmp "$ROUTER_TMP"
 python scripts/validate_events.py "$ROUTER_TMP/router_events.jsonl"
 python scripts/analyze_run.py "$ROUTER_TMP/router_events.jsonl"
 
+echo "== observatory: storm alerts fired AND resolved in the smoke log =="
+# ISSUE 20: the storm leg above ran under the live aggregation plane
+# (MetricsAggregator polling /status + AlertEngine on the bus) — the
+# observatory's event-sourced view of that log must show slo_p99 and
+# shed_rate each fired >=1 and fully resolved with NOTHING left
+# firing, and the per-rule alert summary must ride analyze_run. The
+# validator pass above already held the same log to the alert
+# contracts (armed fault -> firing alert, lifecycle pairing, zero
+# false positives).
+python scripts/observatory.py --events "$ROUTER_TMP/router_events.jsonl" \
+    --once > /dev/null
+python - "$ROUTER_TMP" <<'PYEOF'
+import json, subprocess, sys
+
+out = subprocess.run(
+    [sys.executable, "scripts/observatory.py",
+     "--events", sys.argv[1] + "/router_events.jsonl",
+     "--once", "--json"],
+    check=True, capture_output=True, text=True,
+).stdout
+state = json.loads(out)
+alerts = state["alerts"]
+assert not alerts["firing"], alerts["firing"]
+rules = alerts["rules"]
+for rule in ("slo_p99", "shed_rate"):
+    row = rules.get(rule)
+    assert row and row["fired"] >= 1, (rule, rules)
+    assert row["resolved"] >= row["fired"], (rule, row)
+    assert not row["active"], (rule, row)
+print(
+    "observatory OK: storm fired+resolved "
+    + ", ".join(f"{r}x{rules[r]['fired']}" for r in sorted(rules))
+)
+PYEOF
+
 echo "== partition smoke: 2-host set, 10 s partition, lease-fenced zombie =="
 # the ISSUE 14 acceptance scenario: a 2-host recurrent replica set
 # (real serve.py children behind a local TemplateTransport — the exact
@@ -291,6 +336,25 @@ JAX_PLATFORMS=cpu python scripts/replay_run.py \
     --checkpoint-dir "$PART_TMP/ck" \
     --events "$PART_TMP/replay_events.jsonl"
 python scripts/validate_events.py "$PART_TMP/replay_events.jsonl"
+
+echo "== corpus miner: slowest partition-smoke trace replays bit-exact =="
+# ISSUE 20 (the remaining PR 18 rung): mine the partition smoke's own
+# merged logs for their slowest captured traces and re-execute the
+# top one against a fresh shadow set from the recorded checkpoint —
+# the run's worst real latency incident becomes standing replay
+# material, proving --from-run mining yields whole, bit-exact bundles
+# from live multi-process logs (not just the synthetic corpus recipe).
+MINE_TMP=$(mktemp -d)
+python scripts/seed_corpus.py \
+    --from-run "$PART_TMP/partition_events.jsonl" \
+    "$PART_TMP"/child-*.jsonl \
+    --slowest 2 --journal-dir "$PART_TMP/carry_journal" \
+    --out "$MINE_TMP"
+JAX_PLATFORMS=cpu python scripts/replay_run.py \
+    "$MINE_TMP"/slow-1-*.bundle.json \
+    --checkpoint-dir "$PART_TMP/ck" \
+    --events "$MINE_TMP/mined_replay.jsonl"
+python scripts/validate_events.py "$MINE_TMP/mined_replay.jsonl"
 
 echo "== capture overhead: <=2% on the calibrated serving bench, 0 drops =="
 # the capture hot path is a note in a side table + one deque append;
@@ -867,6 +931,36 @@ print(
     "flywheel smoke OK: promoted@1 after promoter kill, regress@2 + "
     "corrupt@3 rejected, %d served episodes fed back"
     % episodes["episodes"]
+)
+PYEOF
+
+echo "== observatory: flywheel chaos alerts fired AND resolved =="
+# ISSUE 20: the flywheel ran under the aggregation plane (promoter
+# journal + router + canary counters as scrape targets) — the killed
+# promoter must have paged promoter_stuck BEFORE the restarted
+# controller converged, the rejected candidates must have paged
+# canary_rejected, and both must have fully resolved. Same validator
+# contracts as the storm leg; this asserts the dashboard view agrees.
+python - "$FLY_TMP" <<'PYEOF'
+import json, subprocess, sys
+
+out = subprocess.run(
+    [sys.executable, "scripts/observatory.py",
+     "--events", sys.argv[1] + "/flywheel_events.jsonl",
+     "--once", "--json"],
+    check=True, capture_output=True, text=True,
+).stdout
+alerts = json.loads(out)["alerts"]
+assert not alerts["firing"], alerts["firing"]
+rules = alerts["rules"]
+for rule in ("promoter_stuck", "canary_rejected"):
+    row = rules.get(rule)
+    assert row and row["fired"] >= 1, (rule, rules)
+    assert row["resolved"] >= row["fired"], (rule, row)
+    assert not row["active"], (rule, row)
+print(
+    "observatory OK: flywheel fired+resolved "
+    + ", ".join(f"{r}x{rules[r]['fired']}" for r in sorted(rules))
 )
 PYEOF
 

@@ -194,6 +194,31 @@ def main(argv=None) -> int:
         "serving step 1 (publish durable, gate never driven)"
     )
 
+    # -- the live observability plane (ISSUE 20), armed on the wreck ----
+    # The aggregator watches the promotion journal the dead controller
+    # left behind: its mtime stopped at the kill, so while the step-1
+    # entry sits non-terminal the `promote.unconverged_s` series grows
+    # and the promoter_stuck rule must FIRE — detection precedes the
+    # restarted controller's recovery below, exactly the order an
+    # operator would live. The canary's rollback counter joins as an
+    # in-process target once the gate exists; the router's /status
+    # joins once it serves.
+    from trpo_tpu.obs.aggregate import (
+        CallbackTarget,
+        HttpTarget,
+        JournalTarget,
+        MetricsAggregator,
+    )
+    from trpo_tpu.obs.alerts import AlertEngine, default_rules
+
+    alert_eng = AlertEngine(
+        default_rules(window_s=2.0, promoter_stuck_s=6.0), bus=bus
+    )
+    agg = MetricsAggregator(
+        [JournalTarget("promoter", serve_ck)],
+        bus=bus, engine=alert_eng, interval=0.25,
+    ).start()
+
     # -- serving tier: managed recurrent replicas + striding router ------
     def managed_factory(rid):
         def factory():
@@ -229,6 +254,13 @@ def main(argv=None) -> int:
     ctrl = PromotionController(
         serve_ck, template, canary, bus=bus, injector=injector,
         gate_timeout_s=120.0, poll_interval=0.1,
+    )
+    agg.add_target(HttpTarget("router", router.url))
+    agg.add_target(
+        CallbackTarget(
+            "canary",
+            lambda: {"rolled_back_total": canary.rolled_back_total},
+        )
     )
 
     # -- 3. live flywheel traffic: sessions reporting reward/done --------
@@ -270,6 +302,25 @@ def main(argv=None) -> int:
 
     try:
         time.sleep(0.5)  # episodes are flowing
+
+        # detection BEFORE recovery: the promoter_stuck alert must fire
+        # off the wrecked journal while the restarted controller has
+        # not yet touched it — an operator is paged about the stuck
+        # promotion, not told after the fact
+        deadline = time.time() + 60.0
+        while (
+            time.time() < deadline
+            and not alert_eng.firing_total.get("promoter_stuck")
+        ):
+            time.sleep(0.2)
+        assert alert_eng.firing_total.get("promoter_stuck", 0) >= 1, (
+            "promoter_stuck never fired off the killed promotion's "
+            f"journal: {alert_eng.firing_total}"
+        )
+        print(
+            "alert: promoter_stuck FIRING off the dead controller's "
+            "journal (mtime age > threshold, entry non-terminal)"
+        )
 
         # -- 2b. the RESTARTED controller converges and promotes --------
         res = ctrl.promote(winner, winner_ck)
@@ -319,6 +370,13 @@ def main(argv=None) -> int:
             r["loaded_step"] == 1 for r in snap["replicas"].values()
         ), snap
 
+        # the gate rollbacks must have PAGED: the canary_rejected rule
+        # watches the controller's rolled_back counter
+        assert alert_eng.firing_total.get("canary_rejected", 0) >= 1, (
+            "canary_rejected never fired across two gate rollbacks: "
+            f"{alert_eng.firing_total}"
+        )
+
         stop.set()
         for t in threads:
             t.join(timeout=30.0)
@@ -328,7 +386,28 @@ def main(argv=None) -> int:
             f"{errors[:5]}"
         )
         assert injector.all_fired, injector.unfired
+
+        # every firing alert must RESOLVE on the recovered system (the
+        # journal converged, the rollback deltas drained, the recent
+        # p99 window decayed) — the validator's lifecycle contract
+        # gates this too
+        deadline = time.time() + 45.0
+        while time.time() < deadline and alert_eng.active():
+            time.sleep(0.25)
+        assert not alert_eng.active(), (
+            f"alerts never resolved: {alert_eng.active()}"
+        )
+        assert alert_eng.resolved_total.get("promoter_stuck", 0) >= 1
+        assert alert_eng.resolved_total.get("canary_rejected", 0) >= 1
+        print(
+            f"alerts: fired {alert_eng.firing_total}, all resolved, "
+            "zero left active"
+        )
     finally:
+        # the watcher goes down FIRST — a serving tier torn down under
+        # a still-polling aggregator would manufacture target_stale
+        # noise in the log's final seconds
+        agg.close()
         stop.set()
         canary.close()
         gate_ck.close()

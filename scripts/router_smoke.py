@@ -46,12 +46,19 @@ Six legs, end to end in one process:
    on a survivor from the journal (``resumed: true``, BIT-EXACT
    continuation) — and the metric-driven loop drains the set back to
    2 with zero aborted drains and every migrated session resumed.
+   The leg runs under the live observability plane (ISSUE 20): a
+   ``MetricsAggregator`` polls the router's ``/status`` out-of-band
+   while ``slo_p99`` + ``shed_rate`` alert rules watch the series —
+   the storm must make both FIRE and recovery must make both RESOLVE
+   (asserted here AND validator-gated, with zero false positives).
 7. The whole run's event log is left at ``DIR/router_events.jsonl``
    for ``scripts/validate_events.py`` (died→restarted/evicted,
    canary started→terminal, drain_started→terminal, every injected
    serving fault — including the storm — matched by its detection
-   record) and ``scripts/analyze_run.py`` (per-replica table +
-   scaling row + failover/canary/autoscale rows).
+   record, armed faults matched by firing alerts, firing alerts
+   paired with their resolves and their causes) and
+   ``scripts/analyze_run.py`` (per-replica table + scaling row +
+   failover/canary/autoscale/alert rows).
 
 Exit 0 on success; any assertion failure exits nonzero with the reason.
 """
@@ -629,6 +636,27 @@ def main(argv=None) -> int:
         breach_ticks=2, clear_ticks=6, cooldown_s=1.0,
         latency_window_s=4.0, drain_timeout_s=20.0, bus=bus,
     )
+    # the live observability plane (ISSUE 20), armed BEFORE the storm:
+    # the aggregator polls the router's /status out-of-band while the
+    # alert engine's slo_p99 + shed_rate rules watch the aggregated
+    # series — the storm below must make them FIRE, recovery must make
+    # them RESOLVE, and the validator holds the whole log to the
+    # zero-false-positive contract
+    from trpo_tpu.obs.aggregate import HttpTarget, MetricsAggregator
+    from trpo_tpu.obs.alerts import AlertEngine, default_rules
+
+    # slo_p99_ms=250: the alert watches ROUTED-request p99 and the
+    # router's bounded admission queue (max_inflight) converts excess
+    # storm demand into sheds rather than arbitrarily slow routed
+    # requests, so storm p99 plateaus ~300-390 ms — well above the
+    # ~65-125 ms steady state but below the autoscaler's 500 ms SLO
+    alert_eng = AlertEngine(
+        default_rules(slo_p99_ms=250.0, window_s=2.0), bus=bus
+    )
+    agg = MetricsAggregator(
+        [HttpTarget("router", router.url)],
+        bus=bus, engine=alert_eng, interval=0.25,
+    ).start()
     try:
         status, out = _post(router.url + "/session")
         assert status == 200, out
@@ -732,6 +760,24 @@ def main(argv=None) -> int:
         assert snap["size"] == 4 and snap["healthy"] == 4, snap
         assert asc.scale_outs_total == 2, asc.scale_outs_total
 
+        # detection: the storm must have PAGED — both the SLO-p99 rule
+        # (over the router's time-expiring recent window) and the shed
+        # burn-rate rule fire while it blows
+        deadline = time.time() + 30.0
+        while time.time() < deadline and not (
+            alert_eng.firing_total.get("slo_p99")
+            and alert_eng.firing_total.get("shed_rate")
+        ):
+            time.sleep(0.2)
+        assert alert_eng.firing_total.get("slo_p99", 0) >= 1, (
+            "storm never fired the slo_p99 alert: "
+            f"{alert_eng.firing_total}"
+        )
+        assert alert_eng.firing_total.get("shed_rate", 0) >= 1, (
+            "storm never fired the shed_rate alert: "
+            f"{alert_eng.firing_total}"
+        )
+
         # p99 recovery: once capacity landed (storm may still be
         # blowing), probe latencies sit back under the SLO
         while time.time() < storm_end:
@@ -768,6 +814,20 @@ def main(argv=None) -> int:
         for t in (8, 9):
             probe_act(t)
 
+        # resolution: with the storm gone and capacity drained back,
+        # every firing alert must RESOLVE (the recent-window p99 decays
+        # by wall clock; the shed burn windows run dry) — an alert that
+        # cannot distinguish recovery is noise, and the validator's
+        # lifecycle contract would fail the log anyway
+        deadline = time.time() + 45.0
+        while time.time() < deadline and alert_eng.active():
+            time.sleep(0.25)
+        assert not alert_eng.active(), (
+            f"alerts never resolved: {alert_eng.active()}"
+        )
+        assert alert_eng.resolved_total.get("slo_p99", 0) >= 1
+        assert alert_eng.resolved_total.get("shed_rate", 0) >= 1
+
         stop.set()
         for t_ in bg:
             t_.join(timeout=30.0)
@@ -785,9 +845,15 @@ def main(argv=None) -> int:
             f"{router.sessions_drained_total} sessions moved "
             "losslessly, 0 aborted), probe session BIT-EXACT across "
             f"storm + drain, {len(sheds) + bg_sheds[0]} typed 503 "
-            "sheds, zero other client-visible errors"
+            "sheds, zero other client-visible errors, alerts "
+            f"slo_p99+shed_rate fired {alert_eng.firing_total} and "
+            "resolved (zero left active)"
         )
     finally:
+        # the watcher goes down FIRST: a router torn down under a
+        # still-polling aggregator would manufacture target_stale
+        # noise in the log's final seconds
+        agg.close()
         asc.close()
         router.close()
         rs.close()

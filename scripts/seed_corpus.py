@@ -3,6 +3,7 @@
 
     python scripts/seed_corpus.py --out corpus/          # (re)seed bundles
     python scripts/seed_corpus.py --checkpoint-only --out DIR
+    python scripts/seed_corpus.py --from-run RUN.jsonl --slowest 3 --out DIR
 
 The checked-in ``corpus/`` holds replay bundles that ``scripts/
 check.sh`` re-executes against a shadow replica set on EVERY run — a
@@ -19,6 +20,18 @@ The seeded bundle is the hard case on purpose: a MID-WINDOW export
 whose session predates the capture window, so replay must seed from
 the bundled carry-journal snapshot (seq = first_captured_seq - 1) —
 the same reconstruction a takeover-era incident bundle needs.
+
+``--from-run`` (ISSUE 20) mines a REAL run instead of recording a
+synthetic one: it ranks the log's assembled traces by root-span
+duration and exports the ``--slowest K`` as per-trace replay bundles
+(``slow-<rank>-<trace>.bundle.json``) — the worst latency incidents a
+run actually served become standing replay material. Traces the
+capture plane did not record payloads for cannot bundle; they are
+reported and skipped, and the ranking keeps descending until K bundles
+exist or the captured traces run out. Pass ``--journal-dir`` when the
+run's carry journals still exist so mid-session traces get their
+journal seed; without it such traces export loudly-partial and are
+skipped too (a corpus bundle must be whole).
 """
 
 from __future__ import annotations
@@ -68,6 +81,91 @@ def write_checkpoint(out_dir: str) -> str:
     return ck_dir
 
 
+def mine_slowest(
+    run_paths: list, out_dir: str, k: int,
+    journal_dir: str | None = None,
+) -> list:
+    """Export the ``k`` slowest captured traces of a finished run
+    (its event logs, merged — pass router + every child log of a
+    multi-process run so traces assemble whole) as replay bundles.
+    Returns the written paths (possibly fewer than ``k`` — every skip
+    is printed, never silent)."""
+    from trpo_tpu.obs.analyze import assemble_traces, load_events
+    from trpo_tpu.obs.capture import capture_records
+    from trpo_tpu.obs.replay import BundleError, build_bundle, write_bundle
+
+    records = []
+    for path in run_paths:
+        records.extend(load_events(path))
+    records.sort(key=lambda r: r.get("t") or 0.0)
+    traces = assemble_traces(records)
+    captured = {r.get("trace") for r in capture_records(records)}
+
+    # rank every assembled trace by its root span's duration — the
+    # root is the span with no parent (joined cross-process, so this
+    # is true end-to-end time, not one hop's share)
+    ranked = []
+    for tid, spans in traces.items():
+        roots = [s for s in spans if not s.get("parent")]
+        if not roots:
+            continue
+        ranked.append((max(_dur_ms(s) for s in roots), tid))
+    ranked.sort(reverse=True)
+    if not ranked:
+        print(
+            f"no assembled traces in {' '.join(run_paths)} — "
+            "nothing to mine"
+        )
+        return []
+
+    written = []
+    skipped_uncaptured = 0
+    for dur, tid in ranked:
+        if len(written) >= k:
+            break
+        if tid not in captured:
+            skipped_uncaptured += 1
+            continue
+        try:
+            bundle = build_bundle(
+                records, trace_id=tid, journal_dir=journal_dir
+            )
+        except BundleError as e:
+            print(f"skip {tid} ({dur:.1f} ms): {e}")
+            continue
+        if not bundle["replayable"]:
+            print(
+                f"skip {tid} ({dur:.1f} ms): partial — "
+                f"{bundle['completeness']}"
+            )
+            continue
+        rank = len(written) + 1
+        path = os.path.join(out_dir, f"slow-{rank}-{tid}.bundle.json")
+        write_bundle(bundle, path)
+        written.append(path)
+        print(
+            f"mined #{rank}: trace {tid} root {dur:.1f} ms, "
+            f"{bundle['acts_total']} act(s) -> {path}"
+        )
+    if skipped_uncaptured:
+        print(
+            f"note: {skipped_uncaptured} slower trace(s) had no "
+            "capture payloads (capture sampling) — ranking descended "
+            "past them"
+        )
+    if len(written) < k:
+        print(
+            f"mined {len(written)}/{k} bundle(s): the run's captured "
+            "traces ran out"
+        )
+    return written
+
+
+def _dur_ms(span: dict) -> float:
+    v = span.get("dur_ms")
+    return float(v) if isinstance(v, (int, float)) else 0.0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="seed_corpus.py")
     p.add_argument("--out", required=True)
@@ -76,6 +174,22 @@ def main(argv=None) -> int:
         help="only regenerate the corpus checkpoint (the check.sh "
         "gate's per-run step) — no recording, no bundles",
     )
+    p.add_argument(
+        "--from-run", metavar="RUN.jsonl", nargs="+",
+        help="mine an existing run's event log(s) instead of "
+        "recording a synthetic session — pass router + child logs "
+        "together so multi-process traces assemble whole",
+    )
+    p.add_argument(
+        "--slowest", type=int, default=3, metavar="K",
+        help="with --from-run: export the K slowest captured traces "
+        "(default 3)",
+    )
+    p.add_argument(
+        "--journal-dir",
+        help="with --from-run: the run's carry-journal dir, for "
+        "bundles whose sessions predate their capture window",
+    )
     args = p.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
 
@@ -83,6 +197,15 @@ def main(argv=None) -> int:
         ck_dir = write_checkpoint(args.out)
         print(f"corpus checkpoint (step {CORPUS_STEP}) at {ck_dir}")
         return 0
+
+    if args.from_run:
+        if args.slowest < 1:
+            p.error("--slowest must be >= 1")
+        written = mine_slowest(
+            args.from_run, args.out, args.slowest,
+            journal_dir=args.journal_dir,
+        )
+        return 0 if written else 1
 
     import tempfile
 

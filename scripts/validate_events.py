@@ -88,7 +88,27 @@ rejection whose reason names the *realized return* (only the reward
 gate can catch a checkpoint that is fast, finite, and worse at the
 task — a p99 or parity rejection does NOT satisfy it),
 ``kill_promoter`` by a later ``promote`` terminal for the killed step
-(the restarted controller re-read journal + markers and converged).
+(the restarted controller re-read journal + markers and converged);
+and — ISSUE 20 — the alert contracts, in a log carrying ``alert``
+records at all: (1) every ``fault_injected`` whose kind appears in
+``trpo_tpu.obs.alerts.FAULT_ALERT_RULES`` and that was injected while
+the aggregation plane was ARMED (a ``metric_sample`` within a few
+seconds of the fault — faults injected before/without the watcher are
+covered by the original recovery contracts, not the alerting one) must
+be FOLLOWED by a FIRING ``alert`` of one of that fault's expected
+rules; (2) every firing alert must be FOLLOWED by its ``resolved``
+record for the same (rule, target) — an alert that never resolves
+after the fault window means the rule cannot distinguish recovery, and
+a ``resolved`` with no open firing means the lifecycle dedupe is
+broken; (3) ZERO FALSE POSITIVES: every firing alert of a known rule
+must have a matching cause inside its evaluation window — an injected
+fault (extended by the fault's own duration), the reacting control
+records (sheds, canary rollbacks, lease expiries, session
+reestablishes, unresolved promotions), or ``metric_sample`` evidence
+of the breach itself (the series the rule reads, breaching/moving, in
+window — the cross-file-safe form of the same cause). A firing alert
+with none of these FAILS the run: zero-false-positive is a gated
+property, not a hope.
 Exits non-zero with per-line diagnostics on any failure; prints a
 per-kind count summary on success. Used by ``scripts/check.sh`` against
 both a training run's ``--metrics-jsonl`` output and ``bench.py``'s
@@ -294,6 +314,262 @@ def _fault_matcher(fault_rec: dict):
             and rec.get("event") == "reestablished"
         )
     return None
+
+
+# ISSUE 20 alert-contract tolerances. An aggregation plane counts as
+# ARMED at an instant when a metric_sample landed AT OR BEFORE it,
+# within this many seconds (the fault→alert contract only binds faults
+# injected while someone was ALREADY watching — a plane that starts
+# scraping moments after an earlier leg's fault never saw the
+# incident's onset and must not be held to have paged on it; such
+# faults are covered by the recovery contracts above).
+_ALERT_ARMED_SLACK_S = 5.0
+# a firing alert's cause may land slightly AFTER the alert record (the
+# engine reads live counters; the aggregated event describing the same
+# thing can flush a beat later) ...
+_ALERT_FWD_SLACK_S = 5.0
+# ... and may precede it by the evaluation window plus this much: the
+# slo_p99 series is a ~10s time-expiring window, so the latency that
+# fired it can be that much older than the firing record.
+_ALERT_LOOKBACK_EXTRA_S = 15.0
+
+# fault kinds whose injection plausibly explains each rule firing
+# (beyond FAULT_ALERT_RULES, which is the DETECTION requirement; this
+# is the EXCUSE direction, so it is broader — e.g. a kill_replica may
+# legitimately spike p99 without being required to page)
+_ALERT_CAUSE_FAULTS = {
+    "slo_p99": (
+        "overload_storm", "slow_replica", "slow_network",
+        "stall_replica", "flap_replica", "kill_replica",
+        "partition_host",
+    ),
+    "shed_rate": (
+        "overload_storm", "slow_replica", "slow_network",
+        "stall_replica",
+    ),
+    "canary_rejected": (
+        "wedge_reload", "corrupt_checkpoint", "regress_checkpoint",
+    ),
+    "lease_expired": ("partition_host", "slow_network"),
+    "target_stale": (
+        "partition_host", "slow_network", "kill_replica",
+        "flap_replica", "stall_replica", "slow_replica",
+        "kill_promoter", "overload_storm", "sigterm",
+    ),
+}
+
+
+def _alert_cause_ok(firing: dict, records: list) -> bool:
+    """True when a firing alert has a matching cause in its window —
+    the zero-false-positive contract. ``records`` is the whole file's
+    ``(line, rec)`` list. Unknown rule names return True (custom rules
+    carry no cause contract here; the lifecycle pairing still binds
+    them)."""
+    import fnmatch as _fn
+
+    rule = firing.get("rule")
+    t0 = float(firing.get("t") or 0.0)
+    win = float(firing.get("window_s") or 0.0)
+    thr = float(firing.get("threshold") or 0.0)
+    target = firing.get("target")
+    lo = t0 - win - _ALERT_LOOKBACK_EXTRA_S
+    hi = t0 + _ALERT_FWD_SLACK_S
+
+    def in_win(rec):
+        return lo <= float(rec.get("t") or 0.0) <= hi
+
+    def fault_cause():
+        for _, rec in records:
+            if (
+                rec.get("kind") != "fault_injected"
+                or rec.get("fault") not in _ALERT_CAUSE_FAULTS.get(
+                    rule, ()
+                )
+            ):
+                continue
+            t = float(rec.get("t") or 0.0)
+            dur = rec.get("seconds")
+            dur = float(dur) if isinstance(dur, (int, float)) else 0.0
+            # the fault's EFFECT persists for its duration plus the
+            # rule's lookback — a 15s storm legitimately explains a
+            # p99 alert firing near its end
+            if t <= hi and t0 <= t + dur + win + _ALERT_LOOKBACK_EXTRA_S:
+                return True
+        return False
+
+    def sample_pts(series_pats):
+        """(t, series, value) metric_samples for THIS alert's target
+        matching the rule's series globs, inside the cause window."""
+        out = []
+        for _, rec in records:
+            if rec.get("kind") != "metric_sample" or not in_win(rec):
+                continue
+            if target and rec.get("target") != target:
+                continue
+            s = rec.get("series") or ""
+            v = rec.get("value")
+            if v is None or not any(
+                _fn.fnmatch(s, p) for p in series_pats
+            ):
+                continue
+            out.append((float(rec.get("t") or 0.0), s, float(v)))
+        return out
+
+    def sample_breach(series_pats, pred):
+        return any(pred(v) for _, _, v in sample_pts(series_pats))
+
+    def counter_moved(series_pats):
+        per = {}
+        for t, s, v in sample_pts(series_pats):
+            per.setdefault(s, []).append((t, v))
+        for pts in per.values():
+            pts.sort()
+            if any(b > a for (_, a), (_, b) in zip(pts, pts[1:])):
+                return True
+        return False
+
+    def any_rec(pred):
+        return any(pred(rec) for _, rec in records if in_win(rec))
+
+    if rule == "slo_p99":
+        return (
+            fault_cause()
+            or any_rec(
+                lambda r: r.get("kind") == "router"
+                and r.get("scope") == "request"
+                and isinstance(r.get("ms"), (int, float))
+                and r.get("ms") >= thr
+            )
+            or any_rec(lambda r: r.get("kind") == "autoscale")
+            or sample_breach(
+                ("status.latency_recent_ms*",), lambda v: v > thr
+            )
+        )
+    if rule == "shed_rate":
+        return (
+            fault_cause()
+            or any_rec(
+                lambda r: r.get("kind") == "autoscale"
+                and r.get("event") == "shed"
+            )
+            or counter_moved(
+                (
+                    "status.counters.shed_*_total",
+                    "status.counters.backpressure_total",
+                )
+            )
+        )
+    if rule == "resumed_fraction":
+        return (
+            any_rec(
+                lambda r: r.get("kind") == "session"
+                and r.get("event") == "reestablished"
+            )
+            or counter_moved(
+                ("status.counters.sessions_reestablished_total",)
+            )
+        )
+    if rule == "canary_rejected":
+        return (
+            fault_cause()
+            or any_rec(
+                lambda r: (
+                    r.get("kind") == "canary"
+                    and r.get("event") == "rolled_back"
+                )
+                or (
+                    r.get("kind") == "promote"
+                    and r.get("event") in ("rejected", "rolled_back")
+                )
+                or (
+                    r.get("kind") == "health"
+                    and r.get("check") == "canary_rejected"
+                )
+            )
+            or counter_moved(
+                ("*rolled_back_total*", "*canary_rejected*")
+            )
+        )
+    if rule == "lease_expired":
+        return (
+            fault_cause()
+            or any_rec(
+                lambda r: r.get("kind") == "lease"
+                and r.get("event") == "expired"
+            )
+            or counter_moved(("*lease*expired*",))
+        )
+    if rule == "dropped_events":
+        # the cause IS the drop: the watched *_dropped_total series
+        # must show movement (or a nonzero level) in window
+        return counter_moved(("*dropped_total*",)) or sample_breach(
+            ("*dropped_total*",), lambda v: v > 0
+        )
+    if rule == "kl_rollback_streak":
+        return (
+            any_rec(
+                lambda r: r.get("kind") == "health"
+                and r.get("check") == "kl_rollback_streak"
+            )
+            or any_rec(
+                lambda r: r.get("kind") == "iteration"
+                and (r.get("stats") or {}).get("kl_rolled_back")
+            )
+            or sample_breach(
+                ("status.stats.kl_rolled_back",), lambda v: v > 0
+            )
+        )
+    if rule == "promoter_stuck":
+        # cause = a promotion genuinely unresolved AT FIRING TIME: a
+        # candidate/canary promote record before the firing whose
+        # same-(member, step) terminal had not yet landed
+        def _unresolved_promotion():
+            for _, rec in records:
+                if (
+                    rec.get("kind") != "promote"
+                    or rec.get("event") not in ("candidate", "canary")
+                    or float(rec.get("t") or 0.0) > hi
+                ):
+                    continue
+                member, step = rec.get("member"), rec.get("step")
+                settled = any(
+                    r.get("kind") == "promote"
+                    and r.get("member") == member
+                    and r.get("step") == step
+                    and r.get("event")
+                    in ("promoted", "rejected", "rolled_back")
+                    and float(r.get("t") or 0.0)
+                    <= t0 + _ALERT_FWD_SLACK_S
+                    for _, r in records
+                )
+                if not settled:
+                    return True
+            return False
+
+        return _unresolved_promotion() or sample_breach(
+            ("promote.unconverged_s",), lambda v: v > thr
+        )
+    if rule == "target_stale":
+        return (
+            fault_cause()
+            or any_rec(
+                lambda r: r.get("kind") == "router"
+                and r.get("scope") == "replica"
+                and r.get("state") in ("died", "evicted", "failed")
+            )
+            or any_rec(
+                lambda r: r.get("kind") == "fleet"
+                and r.get("state")
+                in ("preempted", "failed", "culled", "finished")
+            )
+            or sample_breach(("up",), lambda v: v < 1)
+        )
+    if rule == "fleet_stall":
+        # absence-of-progress is its own evidence: the firing record
+        # carries how long the iteration series sat still; there is no
+        # event a NON-progressing member would have written
+        return True
+    return True
 
 
 def validate_file(path: str) -> list:
@@ -754,6 +1030,96 @@ def validate_file(path: str) -> list:
                 "started with no matching drain_completed/drain_aborted "
                 "terminal record after it"
             )
+    # ISSUE 20 alert contracts, gated on the log carrying alert
+    # records at all (a run without the aggregation plane armed owes
+    # nothing here — the recovery contracts above still bind it).
+    alert_recs = [
+        (n, rec) for n, rec in records if rec.get("kind") == "alert"
+    ]
+    if alert_recs:
+        import bisect
+
+        from trpo_tpu.obs.alerts import FAULT_ALERT_RULES
+
+        sample_ts = sorted(
+            float(rec.get("t") or 0.0)
+            for _, rec in records
+            if rec.get("kind") == "metric_sample"
+        )
+
+        def _armed_at(t):
+            i = bisect.bisect_left(
+                sample_ts, t - _ALERT_ARMED_SLACK_S
+            )
+            return i < len(sample_ts) and sample_ts[i] <= t
+
+        firing_recs = [
+            (n, rec) for n, rec in alert_recs
+            if rec.get("state") == "firing"
+        ]
+        # (1) fault → firing alert: an armed chaos fault of an
+        # alert-covered kind that no rule paged on means the alerting
+        # layer missed an incident the injector PROVED happened
+        for n, rec in records:
+            if rec.get("kind") != "fault_injected":
+                continue
+            expected = FAULT_ALERT_RULES.get(rec.get("fault"))
+            t = float(rec.get("t") or 0.0)
+            if not expected or not _armed_at(t):
+                continue
+            if not any(
+                fr.get("rule") in expected
+                and float(fr.get("t") or 0.0) >= t - 0.5
+                for _, fr in firing_recs
+            ):
+                errs.append(
+                    f"{path}:{n}: armed fault_injected "
+                    f"({rec.get('spec')}) was never matched by a "
+                    f"firing alert of {'/'.join(expected)} — the "
+                    "alerting layer missed a proven incident"
+                )
+        # (2) firing/resolved lifecycle pairing per (rule, target):
+        # the canary started→terminal pattern. A resolved with no
+        # open firing also fails — it means the engine's dedupe or
+        # state machine double-transitioned.
+        open_firing = {}
+        for n, rec in alert_recs:
+            key = (rec.get("rule"), rec.get("target"))
+            if rec.get("state") == "firing":
+                if key in open_firing:
+                    errs.append(
+                        f"{path}:{n}: alert {key[0]!r} on "
+                        f"{key[1]!r} fired again without resolving "
+                        f"(previous firing at line "
+                        f"{open_firing[key]}) — lifecycle dedupe "
+                        "broken"
+                    )
+                open_firing[key] = n
+            elif rec.get("state") == "resolved":
+                if key not in open_firing:
+                    errs.append(
+                        f"{path}:{n}: alert {key[0]!r} on "
+                        f"{key[1]!r} resolved without a matching "
+                        "open firing record"
+                    )
+                open_firing.pop(key, None)
+        for (rule, target), n in sorted(open_firing.items()):
+            errs.append(
+                f"{path}:{n}: alert {rule!r} on {target!r} fired "
+                "and never resolved — the rule cannot distinguish "
+                "recovery from the incident"
+            )
+        # (3) zero false positives: every firing alert of a known
+        # rule needs a matching cause inside its window
+        for n, rec in firing_recs:
+            if not _alert_cause_ok(rec, records):
+                errs.append(
+                    f"{path}:{n}: alert {rec.get('rule')!r} on "
+                    f"{rec.get('target')!r} fired (value "
+                    f"{rec.get('value')!r} vs threshold "
+                    f"{rec.get('threshold')!r}) with NO matching "
+                    "cause in its window — false positive"
+                )
     return errs
 
 

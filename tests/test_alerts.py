@@ -1,0 +1,614 @@
+"""ISSUE 20 — the live observability plane: aggregation series,
+declarative alert rules (threshold / rate / two-window burn-rate /
+streak / stall / stale), the firing→resolved lifecycle, the
+validator's chaos-validated alert contracts, and the observatory CLI.
+
+Everything here drives :class:`MetricsAggregator` in synchronous
+``tick(now=...)`` mode with an injected clock — deterministic window
+math, no sleeps — except the one slow-marked e2e test, which runs the
+real poller/evaluator threads against a live HTTP target.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from trpo_tpu.obs.aggregate import (
+    CallbackTarget,
+    HttpTarget,
+    MetricsAggregator,
+    Series,
+    flatten_status,
+    parse_prometheus,
+)
+from trpo_tpu.obs.alerts import (
+    FAULT_ALERT_RULES,
+    AlertEngine,
+    Rule,
+    default_rules,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+class _Bus:
+    """Capture emitted batches like an EventBus (no validation)."""
+
+    def __init__(self):
+        self.batches = []
+
+    def emit_batch(self, kind, fields):
+        self.batches.append((kind, [dict(f) for f in fields]))
+
+    def kinds(self, kind):
+        return [
+            f for k, batch in self.batches if k == kind for f in batch
+        ]
+
+
+# ---------------------------------------------------------------------------
+# series / parsing primitives
+# ---------------------------------------------------------------------------
+
+
+def test_series_delta_is_reset_aware():
+    s = Series()
+    for t, v in [(0, 0.0), (1, 10.0), (2, 25.0)]:
+        s.add(t, v)
+    assert s.delta(2.0, 2.5) == 25.0
+    # a counter reset (process restart) must not yield a negative
+    # delta: only increases count
+    s.add(3, 5.0)
+    s.add(4, 8.0)
+    assert s.delta(4.0, 4.5) == pytest.approx(25.0 + 8.0)
+    # fewer than two in-window points: not computable
+    assert s.delta(100.0, 1.0) is None
+
+
+def test_flatten_status_and_prometheus():
+    flat = flatten_status(
+        {"a": 1, "b": {"c": 2.5, "d": True}, "e": "str", "f": [1, 2]}
+    )
+    assert flat == {"status.a": 1.0, "status.b.c": 2.5, "status.b.d": 1.0}
+    prom = parse_prometheus(
+        "# HELP x y\nfoo 1.5\nbar{l=\"v\"} 2\nbad line here\n"
+    )
+    assert prom == {"foo": 1.5, 'bar{l="v"}': 2.0}
+
+
+# ---------------------------------------------------------------------------
+# rule evaluation
+# ---------------------------------------------------------------------------
+
+
+def _scripted_agg(values, rules, bus=None):
+    """Aggregator over one CallbackTarget reading ``values`` (mutable
+    dict), wired to an engine over ``rules``."""
+    eng = AlertEngine(rules, bus=bus)
+    agg = MetricsAggregator(
+        [CallbackTarget("svc", lambda: dict(values))],
+        bus=bus, engine=eng, interval=0.5,
+    )
+    return agg, eng
+
+
+def test_threshold_lifecycle_and_dedupe():
+    values = {"p99": 10.0, "samples": 100.0}
+    rule = Rule(
+        "slo", "threshold", series="p99", op=">", threshold=500.0,
+        window_s=2.0, guard_series="samples", guard_min=8.0,
+        for_ticks=2,
+    )
+    bus = _Bus()
+    agg, eng = _scripted_agg(values, [rule], bus=bus)
+    t0 = time.time()
+    agg.tick(now=t0)
+    assert eng.active() == []
+
+    values["p99"] = 900.0
+    agg.tick(now=t0 + 1)          # breach tick 1 of for_ticks=2
+    assert eng.active() == []
+    agg.tick(now=t0 + 2)          # breach tick 2 -> fires ONCE
+    assert eng.active() == [("slo", "svc")]
+    agg.tick(now=t0 + 3)          # still breaching -> NO duplicate
+    assert eng.firing_total == {"slo": 1}
+    firing = [e for e in eng.history if e["state"] == "firing"]
+    assert len(firing) == 1
+    assert firing[0]["value"] == 900.0
+    assert firing[0]["threshold"] == 500.0
+    assert firing[0]["window_s"] == 2.0
+
+    values["p99"] = 20.0
+    agg.tick(now=t0 + 4)          # first clean tick -> resolves ONCE
+    assert eng.active() == []
+    agg.tick(now=t0 + 5)
+    assert eng.resolved_total == {"slo": 1}
+    resolved = [e for e in eng.history if e["state"] == "resolved"]
+    assert len(resolved) == 1 and resolved[0]["rule"] == "slo"
+    # the bus saw exactly the two lifecycle events
+    assert [a["state"] for a in bus.kinds("alert")] == [
+        "firing", "resolved"
+    ]
+
+
+def test_threshold_guard_floor_blocks_thin_windows():
+    values = {"p99": 9999.0, "samples": 2.0}
+    rule = Rule(
+        "slo", "threshold", series="p99", op=">", threshold=500.0,
+        guard_series="samples", guard_min=8.0, for_ticks=1,
+    )
+    agg, eng = _scripted_agg(values, [rule])
+    t0 = time.time()
+    agg.tick(now=t0)
+    agg.tick(now=t0 + 1)
+    # guard unmet: not evaluable — never a breach
+    assert eng.firing_total == {}
+
+
+def test_burn_rate_needs_both_windows():
+    """The SRE two-window shape: a short blip burns the fast window
+    but not the slow one — no page; a sustained storm burns both."""
+    values = {"good_total": 0.0, "bad_total": 0.0}
+    rule = Rule(
+        "shed", "burn_rate", series="bad_total",
+        total_series=("good_total", "bad_total"),
+        objective=0.99, threshold=2.0,
+        window_s=2.0, long_window_s=8.0, min_total=8.0, for_ticks=1,
+    )
+    agg, eng = _scripted_agg(values, [rule])
+    t0 = time.time()
+    # 10 s of clean history at 50 good/s
+    for i in range(11):
+        values["good_total"] = 50.0 * i
+        agg.tick(now=t0 + i)
+    assert eng.firing_total == {}
+
+    # one-tick blip: +5 bad at t=11. Short window err 5/105 -> burn
+    # 4.8 > 2, long window err 5/405 -> burn 1.2 < 2: NO page.
+    values["good_total"] = 550.0
+    values["bad_total"] = 5.0
+    agg.tick(now=t0 + 11)
+    assert eng.firing_total == {}, "short-window blip must not page"
+
+    # sustained: bad keeps burning 5/s -> both windows exceed 2x
+    for i in range(12, 16):
+        values["good_total"] = 50.0 * i
+        values["bad_total"] = 5.0 * (i - 10)
+        agg.tick(now=t0 + i)
+    assert eng.firing_total == {"shed": 1}
+    fired = [e for e in eng.history if e["state"] == "firing"][0]
+    # the reported value is the BINDING (smaller) window's burn
+    assert fired["value"] > 2.0
+
+    # recovery: counters stop moving -> burn 0 -> resolves
+    for i in range(16, 20):
+        values["good_total"] = 50.0 * i
+        agg.tick(now=t0 + i)
+    assert eng.resolved_total == {"shed": 1}
+    assert eng.active() == []
+
+
+def test_burn_rate_min_total_floor():
+    values = {"good_total": 0.0, "bad_total": 0.0}
+    rule = Rule(
+        "shed", "burn_rate", series="bad_total",
+        total_series=("good_total", "bad_total"),
+        objective=0.99, threshold=2.0, window_s=2.0,
+        long_window_s=8.0, min_total=8.0, for_ticks=1,
+    )
+    agg, eng = _scripted_agg(values, [rule])
+    t0 = time.time()
+    # 100% error rate but only 3 requests total: below the floor,
+    # not evaluable — a near-idle plane must not page on one failure
+    for i in range(10):
+        values["good_total"] = 0.0
+        values["bad_total"] = 0.3 * i
+        agg.tick(now=t0 + i)
+    assert eng.firing_total == {}
+
+
+def test_streak_counts_distinct_keys():
+    values = {"kl_rolled_back": 0.0, "iteration": 0.0}
+    rule = Rule(
+        "kl_streak", "streak", series="kl_rolled_back",
+        key_series="iteration", streak_n=3, window_s=60.0,
+        for_ticks=1,
+    )
+    agg, eng = _scripted_agg(values, [rule])
+    t0 = time.time()
+    # iteration 1 rolled back, scraped THREE times: one vote, not 3
+    values.update(iteration=1.0, kl_rolled_back=1.0)
+    for i in range(3):
+        agg.tick(now=t0 + i)
+    assert eng.firing_total == {}
+    # two more distinct rolled-back iterations -> streak of 3 -> fires
+    values.update(iteration=2.0)
+    agg.tick(now=t0 + 3)
+    values.update(iteration=3.0)
+    agg.tick(now=t0 + 4)
+    assert eng.firing_total == {"kl_streak": 1}
+    fired = [e for e in eng.history if e["state"] == "firing"][0]
+    assert fired["threshold"] == 3.0  # streak_n rides the threshold
+    # a clean iteration breaks the streak -> resolves
+    values.update(iteration=4.0, kl_rolled_back=0.0)
+    agg.tick(now=t0 + 5)
+    assert eng.resolved_total == {"kl_streak": 1}
+
+
+def test_stall_rule_with_unless_suppressor():
+    values = {"iteration": 1.0}
+    rule = Rule(
+        "stall", "stall", series="iteration",
+        unless_series="finished", window_s=5.0, for_ticks=1,
+    )
+    agg, eng = _scripted_agg(values, [rule])
+    t0 = time.time()
+    for i in range(3):
+        values["iteration"] = float(i)
+        agg.tick(now=t0 + i)
+    # counter frozen past the window -> stall fires
+    for i in range(3, 10):
+        agg.tick(now=t0 + i)
+    assert eng.firing_total == {"stall": 1}
+    # the member finishing is not a stall: suppressor resolves it
+    values["finished"] = 1.0
+    agg.tick(now=t0 + 10)
+    assert eng.resolved_total == {"stall": 1}
+
+
+def test_default_rules_cover_issue_minimum():
+    names = {r.name for r in default_rules()}
+    assert {
+        "slo_p99", "shed_rate", "resumed_fraction", "canary_rejected",
+        "lease_expired", "dropped_events", "kl_rollback_streak",
+        "fleet_stall", "promoter_stuck", "target_stale",
+    } <= names
+    # every chaos fault in the contract maps to declared rules
+    for fault, rules in FAULT_ALERT_RULES.items():
+        assert rules, fault
+        assert set(rules) <= names, (fault, rules)
+
+
+# ---------------------------------------------------------------------------
+# stale-target tolerance
+# ---------------------------------------------------------------------------
+
+
+def test_dead_target_goes_stale_and_alerts_without_wedging():
+    """A dead scrape target is DATA (target_stale fires), never a
+    poller wedge: the live target keeps collecting on every tick."""
+    values = {"x": 1.0}
+    eng = AlertEngine(
+        [Rule("target_stale", "stale", threshold=2.0, for_ticks=2)]
+    )
+    bus = _Bus()
+    agg = MetricsAggregator(
+        [
+            # connection refused instantly — nothing listens there
+            HttpTarget("dead", "http://127.0.0.1:9"),
+            CallbackTarget("live", lambda: dict(values)),
+        ],
+        bus=bus, engine=eng, interval=0.5, stale_after=2.0,
+        timeout=0.2,
+    )
+    t0 = time.time()
+    for i in range(4):
+        values["x"] = float(i)
+        agg.tick(now=t0 + i * 2.0)  # never raises on the dead target
+    states = agg.target_states(now=t0 + 6.0)
+    assert states["dead"]["stale"] and not states["dead"]["up"]
+    assert states["live"]["up"] and not states["live"]["stale"]
+    assert eng.active() == [("target_stale", "dead")]
+    # the live series kept flowing the whole time
+    assert len(agg.get_series("live", "x")) == 4
+    # the dead target's up-sample is emitted (stale-flagged), so the
+    # gap is visible in the log, never silent
+    ups = [
+        s for s in bus.kinds("metric_sample")
+        if s["target"] == "dead" and s["series"] == "up"
+    ]
+    assert ups and ups[-1]["value"] == 0.0 and ups[-1]["stale"] is True
+
+
+# ---------------------------------------------------------------------------
+# validator alert contracts (good + bad synthetic logs)
+# ---------------------------------------------------------------------------
+
+
+def _write_log(tmp_path, name, records):
+    path = tmp_path / name
+    base = [
+        {
+            "v": 1, "t": time.time(), "kind": "run_manifest",
+            "schema": "trpo-tpu-events", "jax_version": "0",
+            "backend": "cpu", "config_hash": "deadbeefdeadbeef",
+            "config": None,
+        }
+    ]
+    with open(path, "w") as f:
+        for rec in base + records:
+            rec.setdefault("v", 1)
+            rec.setdefault("t", time.time())
+            f.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+def _storm_records(t0):
+    """An armed storm incident: samples BEFORE the fault (the plane
+    was watching), the old detection record (shed), and the expected
+    firing+resolved pair."""
+    sample = {
+        "kind": "metric_sample", "target": "router",
+        "series": "status.counters.shed_stateless_total",
+        "value": 0.0, "t": t0,
+    }
+    storm = {
+        "kind": "fault_injected", "fault": "overload_storm", "at": 3,
+        "spec": "overload_storm@request=3:rps=50:seconds=2",
+        "t": t0 + 1,
+    }
+    shed = {
+        "kind": "autoscale", "event": "shed",
+        "reason": "backpressure", "count": 12, "t": t0 + 1.5,
+    }
+    firing = {
+        "kind": "alert", "rule": "shed_rate", "state": "firing",
+        "target": "router", "window_s": 2.0, "value": 8.0,
+        "threshold": 2.0, "t": t0 + 2,
+    }
+    resolved = {
+        "kind": "alert", "rule": "shed_rate", "state": "resolved",
+        "target": "router", "window_s": 2.0, "firing_s": 3.0,
+        "t": t0 + 5,
+    }
+    return sample, storm, shed, firing, resolved
+
+
+def test_validator_alert_contracts(tmp_path):
+    from validate_events import validate_file
+
+    t0 = time.time()
+    sample, storm, shed, firing, resolved = _storm_records(t0)
+
+    # clean: armed fault, detection, firing+resolved pair
+    good = _write_log(
+        tmp_path, "good.jsonl",
+        [dict(sample), dict(storm), dict(shed),
+         dict(firing), dict(resolved)],
+    )
+    assert validate_file(good) == []
+
+    # an ARMED fault with no expected-rule firing FAILS — the alert
+    # layer missed an incident the injector proved. (fleet_stall is a
+    # paired bystander so the log still carries alert records.)
+    bystander_f = {
+        "kind": "alert", "rule": "fleet_stall", "state": "firing",
+        "target": "m0", "window_s": 30.0, "value": 60.0,
+        "threshold": 30.0, "t": t0 + 2,
+    }
+    bystander_r = {
+        "kind": "alert", "rule": "fleet_stall", "state": "resolved",
+        "target": "m0", "window_s": 30.0, "firing_s": 1.0, "t": t0 + 3,
+    }
+    missed = _write_log(
+        tmp_path, "missed.jsonl",
+        [dict(sample), dict(storm), dict(shed),
+         dict(bystander_f), dict(bystander_r)],
+    )
+    errs = validate_file(missed)
+    assert any("missed a proven incident" in e for e in errs), errs
+
+    # an UNARMED fault (the plane started scraping only later) is
+    # exempt: no sample at-or-before the fault, same missing alert
+    unarmed = _write_log(
+        tmp_path, "unarmed.jsonl",
+        [dict(storm), dict(shed), {**sample, "t": t0 + 4},
+         dict(bystander_f), dict(bystander_r)],
+    )
+    assert validate_file(unarmed) == []
+
+    # a firing with NO matching cause in its window FAILS: the
+    # zero-false-positive contract
+    fp = {
+        "kind": "alert", "rule": "lease_expired", "state": "firing",
+        "target": "router", "window_s": 4.0, "value": 1.0,
+        "threshold": 0.0, "t": t0 + 2.5,
+    }
+    fp_r = {
+        "kind": "alert", "rule": "lease_expired", "state": "resolved",
+        "target": "router", "window_s": 4.0, "firing_s": 1.0,
+        "t": t0 + 3.5,
+    }
+    fp_log = _write_log(
+        tmp_path, "fp.jsonl",
+        [dict(sample), dict(storm), dict(shed), dict(firing),
+         dict(resolved), dict(fp), dict(fp_r)],
+    )
+    errs = validate_file(fp_log)
+    assert any("false positive" in e for e in errs), errs
+
+    # lifecycle: fired and never resolved FAILS
+    stuck = _write_log(
+        tmp_path, "stuck.jsonl",
+        [dict(sample), dict(storm), dict(shed), dict(firing)],
+    )
+    errs = validate_file(stuck)
+    assert any("never resolved" in e for e in errs), errs
+
+    # lifecycle: double-fire without a resolve FAILS
+    twice = _write_log(
+        tmp_path, "twice.jsonl",
+        [dict(sample), dict(storm), dict(shed), dict(firing),
+         {**firing, "t": t0 + 3}, dict(resolved)],
+    )
+    errs = validate_file(twice)
+    assert any("fired again without resolving" in e for e in errs), errs
+
+    # lifecycle: a resolve with no open firing FAILS
+    orphan = _write_log(
+        tmp_path, "orphan.jsonl",
+        [dict(sample), dict(storm), dict(shed), dict(firing),
+         dict(resolved), {**resolved, "t": t0 + 6}],
+    )
+    errs = validate_file(orphan)
+    assert any(
+        "resolved without a matching open firing" in e for e in errs
+    ), errs
+
+    # schema: a firing without its numeric evidence FAILS outright
+    bad = dict(firing)
+    del bad["value"]
+    malformed = _write_log(tmp_path, "malformed.jsonl", [bad])
+    assert validate_file(malformed), "firing without value must fail"
+
+
+# ---------------------------------------------------------------------------
+# observatory CLI
+# ---------------------------------------------------------------------------
+
+
+def test_observatory_once_json(tmp_path):
+    t0 = time.time()
+    sample, storm, shed, firing, resolved = _storm_records(t0)
+    p99 = {
+        "kind": "metric_sample", "target": "router",
+        "series": "status.latency_recent_ms.0.99", "value": 333.0,
+        "t": t0 + 2,
+    }
+    log = _write_log(
+        tmp_path, "obs.jsonl",
+        [dict(sample), dict(p99), dict(storm), dict(shed),
+         dict(firing), dict(resolved)],
+    )
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "observatory.py"),
+         "--events", log, "--once", "--json"],
+        check=True, capture_output=True, text=True, cwd=REPO,
+    ).stdout
+    state = json.loads(out)
+    rules = state["alerts"]["rules"]
+    assert rules["shed_rate"]["fired"] == 1
+    assert rules["shed_rate"]["resolved"] == 1
+    assert not rules["shed_rate"]["active"]
+    assert not state["alerts"]["firing"]
+
+    # text mode renders the one-screen view and exits 0
+    txt = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "observatory.py"),
+         "--events", log, "--once"],
+        check=True, capture_output=True, text=True, cwd=REPO,
+    ).stdout
+    assert "shed_rate" in txt
+
+
+# ---------------------------------------------------------------------------
+# e2e: live threads, real HTTP target, log validates
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_live_plane_end_to_end(tmp_path):
+    """Real poller/evaluator threads against a live HTTP /status
+    endpoint: breach -> firing, recovery -> resolved, target death ->
+    target_stale, and the emitted event log passes the validator's
+    alert contracts."""
+    import http.server
+    import threading
+
+    from trpo_tpu.obs.events import EventBus, JsonlSink, manifest_fields
+    from validate_events import validate_file
+
+    # the series names mirror the router's real /status surface so
+    # the validator's slo_p99 cause matcher (which reads
+    # status.latency_recent_ms* samples) recognizes the breach
+    status = {
+        "latency_recent_ms": {"p99": 10.0},
+        "latency_recent_samples": 100.0,
+    }
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps(status).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    log = str(tmp_path / "e2e.jsonl")
+    bus = EventBus(JsonlSink(log))
+    bus.emit(
+        "run_manifest",
+        **manifest_fields(None, extra={"driver": "test_alerts"}),
+    )
+    eng = AlertEngine(
+        [
+            Rule(
+                "slo_p99", "threshold",
+                series="status.latency_recent_ms.p99",
+                op=">", threshold=500.0, window_s=1.0,
+                guard_series="status.latency_recent_samples",
+                guard_min=8.0, for_ticks=2,
+            ),
+            Rule("target_stale", "stale", threshold=1.0, for_ticks=2),
+        ],
+        bus=bus,
+    )
+    agg = MetricsAggregator(
+        [HttpTarget("svc", url)], bus=bus, engine=eng,
+        interval=0.05, timeout=0.5, stale_after=1.0,
+    ).start()
+
+    def wait(pred, timeout=20.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return False
+
+    try:
+        assert wait(
+            lambda: agg.latest("svc", "status.latency_recent_ms.p99")
+            is not None
+        )
+        status["latency_recent_ms"]["p99"] = 900.0
+        assert wait(lambda: eng.firing_total.get("slo_p99")), (
+            eng.firing_total
+        )
+        status["latency_recent_ms"]["p99"] = 15.0
+        assert wait(lambda: eng.resolved_total.get("slo_p99")), (
+            eng.resolved_total
+        )
+        # kill the target: the poller must not wedge, the stale rule
+        # must page
+        httpd.shutdown()
+        httpd.server_close()
+        assert wait(lambda: eng.firing_total.get("target_stale")), (
+            eng.firing_total
+        )
+    finally:
+        agg.close()
+        bus.close()
+
+    # the emitted log passes schema and the alert contracts, except
+    # the one EXPECTED lifecycle error: target_stale never resolved
+    # (the target is gone for good and the run ends mid-incident) —
+    # nothing else may fail
+    errs = validate_file(log)
+    assert errs and all("target_stale" in e for e in errs), errs

@@ -2,9 +2,25 @@
 metric accumulation, recompile/health monitors, the ``Telemetry`` bundle
 drivers thread through a run (ISSUE 3 tentpole), and — ISSUE 5 — the
 live status/metrics endpoint (``obs/server``), device-memory accounting
-(``obs/memory``) and cross-run analysis (``obs/analyze``). See
-``ARCHITECTURE.md`` "Telemetry" and "Introspection"."""
+(``obs/memory``), cross-run analysis (``obs/analyze``), and — ISSUE 20
+— the fleet-wide live observability plane: scrape-everything
+aggregation (``obs/aggregate``) and declarative SLO alerting
+(``obs/alerts``). See ``ARCHITECTURE.md`` "Telemetry",
+"Introspection", and "Live observability"."""
 
+from trpo_tpu.obs.aggregate import (  # noqa: F401
+    CallbackTarget,
+    HttpTarget,
+    JournalTarget,
+    MetricsAggregator,
+    Series,
+)
+from trpo_tpu.obs.alerts import (  # noqa: F401
+    FAULT_ALERT_RULES,
+    AlertEngine,
+    Rule,
+    default_rules,
+)
 from trpo_tpu.obs.capture import (  # noqa: F401
     RequestCapture,
     capture_records,
@@ -47,6 +63,15 @@ from trpo_tpu.obs.server import StatusServer, StatusSink  # noqa: F401
 from trpo_tpu.obs.telemetry import Telemetry  # noqa: F401
 
 __all__ = [
+    "CallbackTarget",
+    "HttpTarget",
+    "JournalTarget",
+    "MetricsAggregator",
+    "Series",
+    "FAULT_ALERT_RULES",
+    "AlertEngine",
+    "Rule",
+    "default_rules",
     "RequestCapture",
     "capture_records",
     "decode_payload",

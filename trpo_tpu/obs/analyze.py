@@ -60,6 +60,13 @@ Comparison semantics (:func:`compare_runs`):
   p50/p99 + share table and the slowest-trace rows, and
   ``compare_runs`` judges the root p99 and every per-stage p99
   time-like — a grown stage is a LOCATED regression;
+* alerting-plane runs (ISSUE 20, ``alert`` + ``metric_sample`` events
+  from ``obs/aggregate.py`` + ``obs/alerts.py``): the per-rule
+  fired/resolved/active table with time-to-detect against the log's
+  injected faults; ``false_positives`` (a firing in a provably quiet
+  phase — no fault at all in the 120 s before it) is a strict counter
+  between clean runs, time-to-detect is time-like, and per-rule fired
+  counts grow-is-worse;
 * phases below ``min_ms`` in BOTH runs are skipped (a 0.1 ms phase
   doubling is scheduler noise, not a regression), as are metrics absent
   from either run (no silent verdict about unmeasured things — they are
@@ -854,6 +861,105 @@ def _summarize_fleet(records: list) -> Optional[dict]:
     return {"members": members, "counts": dict(sorted(counts.items()))}
 
 
+def _summarize_alerts(records: list) -> Optional[dict]:
+    """Aggregate ``alert`` lifecycle records (obs/alerts.py) into a
+    per-rule table: fired / resolved / still-active counts plus the
+    fastest time-to-detect against the log's injected faults. None for
+    logs without an alerting plane.
+
+    ``false_positives`` is the STRICT counter ``compare_runs`` gates
+    on: in a log that injects faults, a firing alert is counted false
+    when NO fault at all was injected in the 120 s before it fired —
+    an alert going off in a provably quiet phase. The counter is
+    deliberately COARSER than the validator's per-rule cause analysis
+    (``scripts/validate_events.py`` cross-checks metric evidence and
+    control-plane reactions): a fault's collateral damage legitimately
+    fires rules outside its own ``FAULT_ALERT_RULES`` contract (a
+    checkpoint-chaos phase stalling serving long enough to breach the
+    latency SLO), and only the validator can tell that from noise.
+    This row is the cross-run trend of the indefensible case."""
+    alerts = [r for r in records if r.get("kind") == "alert"]
+    if not alerts:
+        return None
+    from trpo_tpu.obs.alerts import FAULT_ALERT_RULES
+
+    rules: dict = {}
+    open_keys: set = set()
+    for r in alerts:
+        rule = r.get("rule")
+        if not isinstance(rule, str):
+            continue
+        row = rules.setdefault(
+            rule,
+            {"fired": 0, "resolved": 0, "active": 0, "detect_s": None},
+        )
+        key = (rule, r.get("target"))
+        if r.get("state") == "firing":
+            row["fired"] += 1
+            open_keys.add(key)
+        elif r.get("state") == "resolved":
+            row["resolved"] += 1
+            open_keys.discard(key)
+    for rule, _target in open_keys:
+        rules[rule]["active"] += 1
+
+    # time-to-detect: for each armed fault, the first firing of a rule
+    # its contract expects; credited both fleet-wide and per rule
+    faults = [
+        r for r in records
+        if r.get("kind") == "fault_injected"
+        and isinstance(r.get("fault"), str)
+        and _finite(r.get("t")) is not None
+    ]
+    detects = []
+    for f in faults:
+        expected = FAULT_ALERT_RULES.get(f["fault"])
+        if not expected:
+            continue
+        best = None
+        for a in alerts:
+            if (
+                a.get("state") == "firing"
+                and a.get("rule") in expected
+                and _finite(a.get("t")) is not None
+                and a["t"] >= f["t"]
+            ):
+                d = a["t"] - f["t"]
+                if best is None or d < best[0]:
+                    best = (d, a["rule"])
+        if best is not None:
+            detects.append(best[0])
+            row = rules.get(best[1])
+            if row is not None and (
+                row["detect_s"] is None or best[0] < row["detect_s"]
+            ):
+                row["detect_s"] = best[0]
+
+    false_positives = 0
+    if faults:
+        for a in alerts:
+            if a.get("state") != "firing":
+                continue
+            t = _finite(a.get("t"))
+            if t is None:
+                continue
+            caused = any(
+                t - 120.0 <= f["t"] <= t for f in faults
+            )
+            if not caused:
+                false_positives += 1
+
+    return {
+        "rules": {k: rules[k] for k in sorted(rules)},
+        "fired_total": sum(r["fired"] for r in rules.values()),
+        "resolved_total": sum(r["resolved"] for r in rules.values()),
+        "active_total": sum(r["active"] for r in rules.values()),
+        "false_positives": false_positives,
+        "time_to_detect_mean_s": _mean(detects),
+        "time_to_detect_max_s": max(detects) if detects else None,
+    }
+
+
 def summarize_run(records: list) -> dict:
     """One run's report, computed from its event records alone."""
     manifest = next(
@@ -1037,6 +1143,7 @@ def summarize_run(records: list) -> dict:
         "traces": _summarize_traces(records),
         "solver_precision": solver_precision,
         "fleet": _summarize_fleet(records),
+        "alerts": _summarize_alerts(records),
         "events_total": dict(
             Counter(r.get("kind") for r in records)
         ),
@@ -1388,6 +1495,49 @@ def compare_runs(
                     threshold_pct, "time",
                 )
             )
+
+    # alerting-plane verdicts (ISSUE 20) — only when at least one run
+    # carried alert records. `false_positives` is a STRICT counter (the
+    # drain_aborted pattern): between two supposedly-clean runs an
+    # alert firing with no fault whose contract expects it is a broken
+    # alert contract, which no noise threshold excuses. Time-to-detect
+    # is time-like — a PR that makes the plane slower to notice a
+    # proven incident is a located observability regression. Per-rule
+    # fired counts are grow-is-worse counts under the threshold
+    # (comparable runs inject comparable faults — the shed_total
+    # pattern).
+    b_al = base.get("alerts") or {}
+    n_al = new.get("alerts") or {}
+    if b_al or n_al:
+        b_fp = b_al.get("false_positives") or 0
+        n_fp = n_al.get("false_positives") or 0
+        verdicts.append({
+            "metric": "alerts/false_positives",
+            "base": b_fp,
+            "new": n_fp,
+            "direction": "count",
+            "delta_pct": None,
+            "verdict": "regressed" if n_fp > b_fp else "ok",
+        })
+        verdicts.append(
+            _verdict(
+                "alerts/time_to_detect_mean_s",
+                b_al.get("time_to_detect_mean_s"),
+                n_al.get("time_to_detect_mean_s"),
+                threshold_pct, "time",
+            )
+        )
+        b_rules = b_al.get("rules") or {}
+        n_rules = n_al.get("rules") or {}
+        for rule in sorted(set(b_rules) | set(n_rules)):
+            row = _verdict(
+                f"alerts/{rule}_fired",
+                (b_rules.get(rule) or {}).get("fired"),
+                (n_rules.get(rule) or {}).get("fired"),
+                threshold_pct, "time",
+            )
+            row["direction"] = "count"
+            verdicts.append(row)
 
     # solver-precision counters (ISSUE 8) — only when at least one run
     # carried the ladder. `fallbacks` is judged as a strict counter: ANY
@@ -1793,6 +1943,26 @@ def render_summary(summary: dict) -> str:
                 for mid, row in sorted((fleet.get("members") or {}).items())
             ],
             ["member", "state", "attempts", "requeues"],
+        ))
+    al = summary.get("alerts") or {}
+    if al:
+        out.append("")
+        out.append(
+            f"alerts: fired={al.get('fired_total')}"
+            f" resolved={al.get('resolved_total')}"
+            f" active={al.get('active_total')}"
+            f" false_positives={al.get('false_positives')}"
+            f" detect_mean={_fmt(al.get('time_to_detect_mean_s'))}s"
+        )
+        out.append(format_table(
+            [
+                [rule, row.get("fired"), row.get("resolved"),
+                 row.get("active"),
+                 "-" if row.get("detect_s") is None
+                 else _fmt(row["detect_s"])]
+                for rule, row in (al.get("rules") or {}).items()
+            ],
+            ["rule", "fired", "resolved", "active", "detect_s"],
         ))
     mem = summary.get("memory") or {}
     progs = mem.get("programs") or {}

@@ -144,6 +144,31 @@ Kinds:
   ``scripts/validate_events.py`` FAILS an orphan span (non-remote
   parent never emitted in the same file), an unterminated root span,
   and a retried request whose trace lacks a retry span.
+* ``metric_sample`` — one polled value of one series on one scrape
+  target (ISSUE 20: ``obs/aggregate.MetricsAggregator`` — the live
+  aggregation plane): ``target`` (the registered endpoint's name),
+  ``series`` (the flattened ``/status`` key or Prometheus sample
+  name), ``value`` (numeric, or ``null`` when the target could not be
+  scraped), and ``stale`` (the target missed its scrape budget — a
+  failed scrape marks the target stale instead of blocking the poll
+  loop, and staleness is itself an alertable condition). The
+  aggregator emits a bounded WATCHED subset of what it stores (the
+  per-target ``up`` series plus the series its alert rules read), so
+  the log carries proof the aggregation plane was armed without
+  carrying every ring buffer.
+* ``alert`` — one alert-lifecycle transition (ISSUE 20:
+  ``obs/alerts.AlertEngine`` — declarative threshold / rate-of-change
+  / two-window burn-rate rules evaluated over the aggregated series):
+  ``rule`` (the rule's name), ``state`` (``ALERT_STATES``: ``firing``
+  / ``resolved``), and — on firing records (``_ALERT_SCOPED``) — the
+  evaluation ``window_s``, the observed ``value``, and the
+  ``threshold`` it breached; ``target`` (which scrape target the rule
+  fired for) rides along as an optional field. Self-auditing both
+  ways (``scripts/validate_events.py``): an armed chaos fault in a
+  log that carries alert events must be matched by a FIRING alert of
+  the right rule, every firing alert must RESOLVE, and a firing alert
+  with no matching cause in its window FAILS the run — the
+  zero-false-positive contract.
 * ``autoscale`` — one elastic-serving control action (ISSUE 12:
   ``serve/autoscaler.py`` decisions, ``serve/router.py`` sheds):
   ``AUTOSCALE_EVENTS`` — ``scale_out`` (a new replica launched from
@@ -191,6 +216,7 @@ __all__ = [
     "PROMOTE_EVENTS",
     "AUTOSCALE_EVENTS",
     "LEASE_EVENTS",
+    "ALERT_STATES",
     "EventBus",
     "JsonlSink",
     "ConsoleSink",
@@ -279,6 +305,12 @@ LEASE_EVENTS = ("granted", "renewed", "expired", "fenced_write_refused")
 # `act` per replayed request, one `verdict` per bit-exact action diff,
 # `complete` closes with the tallies — the validator pairs them.
 REPLAY_EVENTS = ("begin", "act", "verdict", "complete")
+
+# alert lifecycle (ISSUE 20, obs/alerts.AlertEngine; vocabulary HERE
+# so the validator needs no obs.alerts import — the FLEET_STATES
+# pattern). Every `firing` must resolve to a later `resolved` for the
+# same (rule, target) — the started-needs-terminal pattern.
+ALERT_STATES = ("firing", "resolved")
 
 _SCALAR = (bool, int, float, str, type(None))
 
@@ -457,6 +489,27 @@ _REQUIRED = {
         # `verdict`.
         "event": lambda v: v in REPLAY_EVENTS,
     },
+    "metric_sample": {
+        # one polled value of one series on one scrape target (ISSUE
+        # 20, obs/aggregate.MetricsAggregator). `value` is nullable:
+        # a failed scrape still produces the target's `up` sample
+        # (value 0.0) and marks it `stale` — the miss is representable
+        # instead of invisible. `stale` rides along as an optional
+        # bool.
+        "target": lambda v: isinstance(v, str) and v,
+        "series": lambda v: isinstance(v, str) and v,
+        "value": lambda v: v is None
+        or (isinstance(v, (int, float)) and not isinstance(v, bool)),
+    },
+    "alert": {
+        # one alert-lifecycle transition (ISSUE 20,
+        # obs/alerts.AlertEngine); per-state required fields (the
+        # evaluation evidence on firing records) live in
+        # _ALERT_SCOPED below. `target` (which scrape target the rule
+        # fired for) rides along as an optional field.
+        "rule": lambda v: isinstance(v, str) and v,
+        "state": lambda v: v in ALERT_STATES,
+    },
 }
 
 _BYTES = lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 0
@@ -559,6 +612,24 @@ _REPLAY_SCOPED = {
     },
 }
 
+# alert records are STATE-discriminated: a firing alert must carry its
+# evaluation evidence (the window it was judged over, the observed
+# value, the threshold it breached) — the validator's zero-false-
+# positive contract reads them; `resolved` needs nothing extra beyond
+# naming the rule it closes.
+_NUM = (
+    lambda v: isinstance(v, (int, float))
+    and not isinstance(v, bool)
+)
+_ALERT_SCOPED = {
+    "firing": {
+        "window_s": lambda v: _NUM(v) and v >= 0,
+        "value": _NUM,
+        "threshold": _NUM,
+    },
+    "resolved": {},
+}
+
 EVENT_KINDS = tuple(sorted(_REQUIRED))
 
 
@@ -592,6 +663,7 @@ def validate_event(rec: Any) -> list:
         ("autoscale", "event", _AUTOSCALE_SCOPED),
         ("lease", "event", _LEASE_SCOPED),
         ("replay", "event", _REPLAY_SCOPED),
+        ("alert", "state", _ALERT_SCOPED),
     ):
         if kind != scoped_kind:
             continue

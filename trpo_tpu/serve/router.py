@@ -2308,6 +2308,7 @@ class Router:
                 "episodes_total": self.episodes_total,
             }
         q, samples = self.latency_window((0.5, 0.99))
+        rq, rsamples = self.latency_recent((0.5, 0.99))
         with self._lock:
             data_plane = {
                 "core": self.core,
@@ -2330,6 +2331,15 @@ class Router:
                 # always alongside the quantiles: a 3-request "p99" must
                 # never be read as a measurement (ISSUE 12 satellite)
                 "latency_samples": samples,
+                # the TIME-expiring view (last _ADMISSION_STALE_S
+                # seconds) — the big window ages only by displacement,
+                # so a storm's p99 lingers there long after recovery;
+                # live alerting (ISSUE 20 slo_p99 rule) reads THIS so
+                # alerts resolve when the system does
+                "latency_recent_ms": {
+                    str(k): v for k, v in rq.items()
+                },
+                "latency_recent_samples": rsamples,
             }
         )
 
@@ -2346,6 +2356,26 @@ class Router:
 
         with self._lat_lock:
             lats = list(self._latencies_ms)
+        if not lats:
+            return {}, 0
+        return {q: quantile_nearest_rank(lats, q) for q in qs}, len(lats)
+
+    def latency_recent(self, qs=(0.5, 0.99)):
+        """``(quantiles, samples)`` over the TIME-expiring admission
+        window (the last ``_ADMISSION_STALE_S`` seconds) — the same
+        view ``_admission_check`` judges deadlines against. Unlike
+        ``latency_window`` (displacement-aged, so a storm's p99 lingers
+        until 4096 light requests flush it), this one decays by wall
+        clock: it is the series live SLO alerting reads so a firing
+        ``slo_p99`` alert RESOLVES when the system recovers, not when
+        the big window happens to rotate."""
+        from trpo_tpu.utils.metrics import quantile_nearest_rank
+
+        horizon = time.monotonic() - self._ADMISSION_STALE_S
+        with self._lat_lock:
+            while self._adm_lats and self._adm_lats[0][0] < horizon:
+                self._adm_lats.popleft()
+            lats = [ms for _, ms in self._adm_lats]
         if not lats:
             return {}, 0
         return {q: quantile_nearest_rank(lats, q) for q in qs}, len(lats)
